@@ -1,0 +1,19 @@
+PROTOC ?= protoc
+
+.PHONY: proto test native bench clean
+
+proto:
+	$(PROTOC) -Iseldon_core_tpu/proto --python_out=seldon_core_tpu/proto seldon_core_tpu/proto/seldon.proto
+
+native:
+	$(MAKE) -C native
+
+test:
+	python -m pytest tests/ -x -q
+
+bench:
+	python bench.py
+
+clean:
+	find . -name __pycache__ -type d -exec rm -rf {} + 2>/dev/null || true
+	$(MAKE) -C native clean 2>/dev/null || true

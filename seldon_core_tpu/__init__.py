@@ -1,0 +1,17 @@
+"""seldon-core-tpu: a TPU-native model-serving framework.
+
+A ground-up re-design of the Seldon Core serving platform (reference
+snapshot under /root/reference) for TPU hardware:
+
+* the wire contract (``SeldonMessage``) is kept compatible, with an added
+  zero-copy ``RawTensor`` payload that maps straight into device buffers;
+* the inference-graph orchestrator (the reference's Java "engine") is an
+  in-process async executor — co-located graph edges hand off
+  device-resident ``jax.Array``s instead of re-serialising JSON per hop;
+* models are jit-compiled to XLA with weights pinned in HBM, served
+  through a dynamic batcher, and optionally pjit-sharded over an ICI mesh;
+* the control plane places graph nodes onto TPU devices instead of
+  Kubernetes pods.
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,25 @@
+"""Payload codecs: proto <-> numpy <-> device, plus the plain-JSON path."""
+
+from seldon_core_tpu.codec.tensor import (  # noqa: F401
+    PayloadError,
+    array_to_datadef,
+    array_to_ndarray,
+    array_to_raw_tensor,
+    array_to_tensor,
+    build_message,
+    datadef_to_array,
+    get_data_from_proto,
+    message_data_kind,
+    ndarray_to_array,
+    np_dtype,
+    raw_tensor_to_array,
+    tensor_to_array,
+)
+from seldon_core_tpu.codec.jsonpath import (  # noqa: F401
+    build_json_payload,
+    extract_json_payload,
+    json_feedback_to_proto,
+    json_to_proto,
+    proto_to_json,
+)
+from seldon_core_tpu.codec.device import from_device, is_device_array, to_device  # noqa: F401

@@ -1,0 +1,118 @@
+"""Plain-JSON (dict) codec for the REST path.
+
+REST requests are decoded from JSON into plain dicts and kept as dicts
+end-to-end — no proto round-trip on the hot path (the same dual-path
+trick as the reference, reference: python/seldon_core/utils.py:558-631,
+seldon_methods.py:28-71).  The dict schema is json_format-compatible
+with ``SeldonMessage``, so the two paths interconvert losslessly when a
+graph edge crosses a transport boundary.
+"""
+
+from __future__ import annotations
+
+import base64
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from google.protobuf import json_format
+
+from seldon_core_tpu.codec.tensor import PayloadError, np_dtype
+from seldon_core_tpu.proto import pb
+
+
+def json_to_proto(body: Dict[str, Any]) -> pb.SeldonMessage:
+    msg = pb.SeldonMessage()
+    json_format.ParseDict(body, msg, ignore_unknown_fields=True)
+    return msg
+
+
+def proto_to_json(msg) -> Dict[str, Any]:
+    return json_format.MessageToDict(msg)
+
+
+def json_feedback_to_proto(body: Dict[str, Any]) -> pb.Feedback:
+    fb = pb.Feedback()
+    json_format.ParseDict(body, fb, ignore_unknown_fields=True)
+    return fb
+
+
+# ---------------------------------------------------------------------------
+# dict payload extraction / construction (no protos involved)
+# ---------------------------------------------------------------------------
+
+def extract_json_payload(body: Dict[str, Any]) -> Tuple[Any, Optional[Dict], Optional[Dict], str]:
+    """Decode a REST request dict.
+
+    Returns (features, meta_dict, datadef_dict, data_kind) where
+    data_kind is one of tensor|ndarray|rawTensor|binData|strData|jsonData.
+    """
+    meta = body.get("meta")
+    if "data" in body:
+        datadef = body["data"]
+        if "tensor" in datadef:
+            t = datadef["tensor"]
+            arr = np.asarray(t.get("values", []), dtype=np.float64)
+            shape = t.get("shape")
+            if shape:
+                arr = arr.reshape(shape)
+            return arr, meta, datadef, "tensor"
+        if "rawTensor" in datadef:
+            r = datadef["rawTensor"]
+            raw = base64.b64decode(r["data"]) if isinstance(r.get("data"), str) else r.get("data", b"")
+            arr = np.frombuffer(raw, dtype=np_dtype(r.get("dtype", "float32")))
+            shape = r.get("shape")
+            if shape:
+                arr = arr.reshape([int(d) for d in shape])
+            return arr, meta, datadef, "rawTensor"
+        if "ndarray" in datadef:
+            return np.asarray(datadef["ndarray"]), meta, datadef, "ndarray"
+        raise PayloadError("request 'data' has no tensor/ndarray/rawTensor")
+    if "binData" in body:
+        raw = body["binData"]
+        return (base64.b64decode(raw) if isinstance(raw, str) else raw), meta, None, "binData"
+    if "strData" in body:
+        return body["strData"], meta, None, "strData"
+    if "jsonData" in body:
+        return body["jsonData"], meta, None, "jsonData"
+    raise PayloadError("request carries no payload")
+
+
+def build_json_payload(
+    result: Any,
+    names: Optional[Sequence[str]] = None,
+    data_kind: str = "tensor",
+    meta: Optional[Dict[str, Any]] = None,
+) -> Dict[str, Any]:
+    """Encode a node result as a REST response dict, echoing the request's
+    encoding (reference: utils.py:426-498 construct_response_json)."""
+    body: Dict[str, Any] = {}
+    if meta:
+        body["meta"] = meta
+    if isinstance(result, bytes):
+        body["binData"] = base64.b64encode(result).decode("ascii")
+        return body
+    if isinstance(result, str):
+        body["strData"] = result
+        return body
+    if isinstance(result, dict):
+        body["jsonData"] = result
+        return body
+    arr = np.asarray(result)
+    datadef: Dict[str, Any] = {}
+    if names:
+        datadef["names"] = list(names)
+    if data_kind == "rawTensor":
+        arr = np.ascontiguousarray(arr)
+        datadef["rawTensor"] = {
+            "shape": list(arr.shape),
+            "dtype": arr.dtype.name,
+            "data": base64.b64encode(arr.tobytes()).decode("ascii"),
+        }
+    elif data_kind == "ndarray":
+        datadef["ndarray"] = arr.tolist()
+    else:  # tensor (default, also used when request was binData/strData/json)
+        arr = np.asarray(arr, dtype=np.float64)
+        datadef["tensor"] = {"shape": list(arr.shape), "values": arr.ravel().tolist()}
+    body["data"] = datadef
+    return body

@@ -1,0 +1,19 @@
+"""Generated protobuf bindings for the seldon-core-tpu wire contract.
+
+`seldon_pb2` is generated from `seldon.proto` (see the repo Makefile's
+`proto` target).  The contract is wire-compatible with the reference's
+`proto/prediction.proto:14-128`.
+"""
+
+from seldon_core_tpu.proto import seldon_pb2 as pb  # noqa: F401
+
+SeldonMessage = pb.SeldonMessage
+SeldonMessageList = pb.SeldonMessageList
+DefaultData = pb.DefaultData
+Tensor = pb.Tensor
+RawTensor = pb.RawTensor
+Meta = pb.Meta
+Metric = pb.Metric
+Status = pb.Status
+Feedback = pb.Feedback
+RequestResponse = pb.RequestResponse

@@ -1,0 +1,25 @@
+"""Test harness configuration.
+
+Tests run on a virtual 8-device CPU mesh (the role the reference's kind
+cluster plays for its e2e tier, reference: testing/scripts/kind_test_all.sh)
+so multi-chip sharding paths execute without TPU hardware.  Must run
+before anything imports jax.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rng():
+    import numpy as np
+
+    return np.random.default_rng(0)
