@@ -1,0 +1,16 @@
+"""Model-runtime layer: component API, dispatch, servers, CLI."""
+
+from seldon_core_tpu.runtime.component import (  # noqa: F401
+    MicroserviceError,
+    NotImplementedByUser,
+    TPUComponent,
+    counter_metric,
+    gauge_metric,
+    timer_metric,
+    validate_metrics,
+)
+from seldon_core_tpu.runtime.message import (  # noqa: F401
+    InternalFeedback,
+    InternalMessage,
+    MsgMeta,
+)

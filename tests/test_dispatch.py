@@ -1,0 +1,263 @@
+"""Dispatch-layer tests per node role and payload kind.
+
+Mirrors the reference's wrapper test strategy
+(reference: python/tests/test_model_microservice.py,
+test_router_microservice.py, test_combiner_microservice.py).
+"""
+
+import numpy as np
+import pytest
+
+from seldon_core_tpu.proto import pb
+from seldon_core_tpu.runtime import (
+    InternalFeedback,
+    InternalMessage,
+    MicroserviceError,
+    TPUComponent,
+    counter_metric,
+    gauge_metric,
+)
+from seldon_core_tpu.runtime import dispatch
+from seldon_core_tpu.runtime.params import ParameterError, parse_parameters
+
+
+class DoublerModel(TPUComponent):
+    def predict(self, X, names, meta=None):
+        return np.asarray(X) * 2
+
+    def class_names(self):
+        return ["c0", "c1"]
+
+    def tags(self):
+        return {"model": "doubler"}
+
+    def metrics(self):
+        return [counter_metric("seen", 1), gauge_metric("load", 0.5)]
+
+
+class EchoModel(TPUComponent):
+    def predict(self, X, names, meta=None):
+        return X
+
+
+class RawModel(TPUComponent):
+    def predict_raw(self, msg):
+        out = pb.SeldonMessage()
+        out.strData = "raw:" + (msg.strData or "")
+        return out
+
+
+class FirstRouter(TPUComponent):
+    def route(self, features, names):
+        return 0
+
+
+class BadRouter(TPUComponent):
+    def route(self, features, names):
+        return "nope"
+
+
+class MeanCombiner(TPUComponent):
+    def aggregate(self, features_list, names_list):
+        return np.mean([np.asarray(f) for f in features_list], axis=0)
+
+
+class FeedbackRecorder(TPUComponent):
+    def __init__(self):
+        self.seen = []
+
+    def send_feedback(self, features, names, reward, truth, routing=None):
+        self.seen.append((np.asarray(features).tolist(), reward, routing))
+        return None
+
+
+def tensor_msg(arr, names=None, kind="tensor"):
+    arr = np.asarray(arr, dtype=np.float64 if kind == "tensor" else np.float32)
+    return InternalMessage(payload=arr, names=list(names or []), kind=kind)
+
+
+class TestPredict:
+    def test_tensor(self):
+        out = dispatch.predict(DoublerModel(), tensor_msg([[1.0, 2.0]]))
+        np.testing.assert_array_equal(out.payload, [[2.0, 4.0]])
+        assert out.names == ["c0", "c1"]
+        assert out.kind == "tensor"
+        assert out.meta.tags == {"model": "doubler"}
+        assert [m["key"] for m in out.meta.metrics] == ["seen", "load"]
+
+    def test_kind_echo_raw(self):
+        out = dispatch.predict(DoublerModel(), tensor_msg([[1, 2]], kind="rawTensor"))
+        assert out.kind == "rawTensor"
+
+    def test_strdata(self):
+        class Upper(TPUComponent):
+            def predict(self, X, names, meta=None):
+                return X.upper()
+
+        out = dispatch.predict(Upper(), InternalMessage(payload="abc", kind="strData"))
+        assert out.payload == "ABC"
+
+    def test_bindata(self):
+        out = dispatch.predict(EchoModel(), InternalMessage(payload=b"xyz", kind="binData"))
+        assert out.payload == b"xyz"
+
+    def test_jsondata(self):
+        out = dispatch.predict(EchoModel(), InternalMessage(payload={"k": 1}, kind="jsonData"))
+        assert out.payload == {"k": 1}
+
+    def test_raw_override(self):
+        out = dispatch.predict(RawModel(), InternalMessage(payload="x", kind="strData"))
+        assert out.payload == "raw:x"
+
+    def test_device_array_materialized_by_default(self):
+        import jax.numpy as jnp
+
+        captured = {}
+
+        class Capture(TPUComponent):
+            def predict(self, X, names, meta=None):
+                captured["type"] = type(X)
+                return X
+
+        msg = InternalMessage(payload=jnp.ones((2, 2)), kind="rawTensor")
+        dispatch.predict(Capture(), msg)
+        assert captured["type"] is np.ndarray
+
+    def test_device_array_passthrough_opt_in(self):
+        import jax
+
+        class DeviceModel(TPUComponent):
+            accepts_device_arrays = True
+
+            def predict(self, X, names, meta=None):
+                assert isinstance(X, jax.Array)
+                return X * 3
+
+        import jax.numpy as jnp
+
+        msg = InternalMessage(payload=jnp.ones((2,)), kind="rawTensor")
+        out = dispatch.predict(DeviceModel(), msg)
+        np.testing.assert_array_equal(out.host_payload(), [3.0, 3.0])
+
+    def test_invalid_metrics_rejected(self):
+        class BadMetrics(EchoModel):
+            def metrics(self):
+                return [{"key": "x"}]
+
+        with pytest.raises(MicroserviceError):
+            dispatch.predict(BadMetrics(), tensor_msg([1.0]))
+
+
+class TestTransforms:
+    def test_transform_input(self):
+        class AddOne(TPUComponent):
+            def transform_input(self, X, names, meta=None):
+                return np.asarray(X) + 1
+
+        out = dispatch.transform_input(AddOne(), tensor_msg([[0.0]]))
+        np.testing.assert_array_equal(out.payload, [[1.0]])
+
+    def test_transform_output(self):
+        class Neg(TPUComponent):
+            def transform_output(self, X, names, meta=None):
+                return -np.asarray(X)
+
+        out = dispatch.transform_output(Neg(), tensor_msg([3.0]))
+        np.testing.assert_array_equal(out.payload, [-3.0])
+
+
+class TestRoute:
+    def test_route_wraps_branch(self):
+        out = dispatch.route(FirstRouter(), tensor_msg([[1.0]]))
+        assert np.asarray(out.payload).ravel()[0] == 0
+
+    def test_route_type_checked(self):
+        with pytest.raises(MicroserviceError):
+            dispatch.route(BadRouter(), tensor_msg([[1.0]]))
+
+
+class TestAggregate:
+    def test_mean(self):
+        msgs = [tensor_msg([[2.0, 4.0]]), tensor_msg([[4.0, 8.0]])]
+        out = dispatch.aggregate(MeanCombiner(), msgs)
+        np.testing.assert_array_equal(out.payload, [[3.0, 6.0]])
+
+    def test_tags_union(self):
+        m1 = tensor_msg([[1.0]])
+        m1.meta.tags["a"] = 1
+        m2 = tensor_msg([[2.0]])
+        m2.meta.tags["b"] = 2
+        out = dispatch.aggregate(MeanCombiner(), [m1, m2])
+        assert out.meta.tags["a"] == 1 and out.meta.tags["b"] == 2
+
+    def test_empty_raises(self):
+        with pytest.raises(MicroserviceError):
+            dispatch.aggregate(MeanCombiner(), [])
+
+
+class TestFeedback:
+    def test_feedback_routing_extraction(self):
+        rec = FeedbackRecorder()
+        resp = tensor_msg([[9.0]])
+        resp.meta.routing["router0"] = 1
+        fb = InternalFeedback(request=tensor_msg([[5.0]]), response=resp, reward=0.7)
+        out = dispatch.send_feedback(rec, fb, predictive_unit_id="router0")
+        assert rec.seen == [([[5.0]], 0.7, 1)]
+        assert np.asarray(out.payload).size == 0
+
+    def test_feedback_default_response(self):
+        out = dispatch.send_feedback(EchoModel(), InternalFeedback(request=tensor_msg([1.0]), reward=0.0))
+        assert np.asarray(out.payload).size == 0
+
+
+class TestMessageRoundtrips:
+    def test_proto_roundtrip_with_meta(self):
+        msg = tensor_msg([[1.0, 2.0]], names=["x", "y"])
+        msg.meta.puid = "p-123"
+        msg.meta.tags["t"] = "v"
+        msg.meta.routing["r"] = 2
+        msg.meta.metrics.append(counter_metric("c", 3))
+        proto = msg.to_proto()
+        back = InternalMessage.from_proto(proto)
+        assert back.meta.puid == "p-123"
+        assert back.meta.tags == {"t": "v"}
+        assert back.meta.routing == {"r": 2}
+        assert back.meta.metrics[0]["key"] == "c"
+        np.testing.assert_array_equal(back.payload, [[1.0, 2.0]])
+        assert back.names == ["x", "y"]
+
+    def test_json_roundtrip(self):
+        body = {"meta": {"puid": "j1"}, "data": {"names": ["a"], "ndarray": [[1, 2]]}}
+        msg = InternalMessage.from_json(body)
+        assert msg.meta.puid == "j1" and msg.kind == "ndarray"
+        out = msg.to_json()
+        assert out["data"]["ndarray"] == [[1, 2]]
+        assert out["meta"]["puid"] == "j1"
+
+    def test_feedback_proto_roundtrip(self):
+        fb = InternalFeedback(request=tensor_msg([1.0]), reward=0.5)
+        back = InternalFeedback.from_proto(fb.to_proto())
+        assert back.reward == 0.5
+        np.testing.assert_array_equal(back.request.payload, [1.0])
+
+
+class TestParams:
+    def test_typed_parsing(self):
+        kwargs = parse_parameters(
+            [
+                {"name": "s", "value": "hi", "type": "STRING"},
+                {"name": "i", "value": "3", "type": "INT"},
+                {"name": "f", "value": "0.5", "type": "FLOAT"},
+                {"name": "b", "value": "true", "type": "BOOL"},
+                {"name": "j", "value": '{"k": [1]}', "type": "JSON"},
+            ]
+        )
+        assert kwargs == {"s": "hi", "i": 3, "f": 0.5, "b": True, "j": {"k": [1]}}
+
+    def test_bad_type(self):
+        with pytest.raises(ParameterError):
+            parse_parameters([{"name": "x", "value": "1", "type": "NOPE"}])
+
+    def test_bad_value(self):
+        with pytest.raises(ParameterError):
+            parse_parameters([{"name": "x", "value": "abc", "type": "INT"}])
