@@ -1,0 +1,147 @@
+"""Predictor service: the request-facing shell around a GraphExecutor.
+
+Equivalent of the reference's PredictionService + lifecycle endpoints
+(reference: PredictionService.java:94-141 — puid assignment, graph
+dispatch, response status; RestClientController.java:73-118 —
+/ping /ready /live /pause /unpause semantics; App.java:60-97 —
+graceful drain).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import secrets
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+from seldon_core_tpu.engine.executor import GraphExecutor, Observer
+from seldon_core_tpu.engine.graph import UnitSpec
+from seldon_core_tpu.runtime.component import MicroserviceError
+from seldon_core_tpu.runtime.message import InternalFeedback, InternalMessage
+
+logger = logging.getLogger(__name__)
+
+
+def new_puid() -> str:
+    """Unique request id (reference: PredictionService.java:72-78)."""
+    return secrets.token_hex(13)
+
+
+def failure_message(error: Exception, puid: str = "") -> InternalMessage:
+    if isinstance(error, MicroserviceError):
+        status = error.to_status()
+    else:
+        status = {
+            "status": "FAILURE",
+            "code": 500,
+            "info": str(error),
+            "reason": "ENGINE_ERROR",
+        }
+    msg = InternalMessage(payload=None, kind="jsonData", status=status)
+    msg.meta.puid = puid
+    return msg
+
+
+class PredictorService:
+    """One deployed predictor: graph executor + lifecycle + bookkeeping."""
+
+    def __init__(
+        self,
+        graph: UnitSpec,
+        name: str = "default",
+        observer: Optional[Observer] = None,
+        log_requests: bool = False,
+        log_responses: bool = False,
+        request_logger: Optional[Callable[[InternalMessage, InternalMessage], None]] = None,
+    ):
+        self.name = name
+        self.executor = GraphExecutor(graph, observer=observer)
+        self.graph = graph
+        self._paused = False
+        self._inflight = 0
+        self._inflight_zero = asyncio.Event()
+        self._inflight_zero.set()
+        self.log_requests = log_requests
+        self.log_responses = log_responses
+        self.request_logger = request_logger
+        self.stats = {"requests": 0, "failures": 0, "feedback": 0}
+
+    # ------------------------------------------------------------- lifecycle
+
+    @property
+    def paused(self) -> bool:
+        return self._paused
+
+    def pause(self) -> None:
+        """Flip readiness off ahead of shutdown (reference: /pause)."""
+        self._paused = True
+
+    def unpause(self) -> None:
+        self._paused = False
+
+    async def live(self) -> bool:
+        return True
+
+    async def ready(self) -> bool:
+        if self._paused:
+            return False
+        return await self.executor.ready()
+
+    async def drain(self, timeout_s: float = 20.0) -> bool:
+        """Pause and wait for in-flight requests
+        (reference: App.java:60-97 Tomcat drain)."""
+        self.pause()
+        try:
+            await asyncio.wait_for(self._inflight_zero.wait(), timeout=timeout_s)
+            return True
+        except asyncio.TimeoutError:
+            return False
+
+    # --------------------------------------------------------------- serving
+
+    async def predict(self, request: InternalMessage) -> InternalMessage:
+        puid = request.meta.puid or new_puid()
+        request.meta.puid = puid
+        self._inflight += 1
+        self._inflight_zero.clear()
+        start = time.perf_counter()
+        try:
+            self.stats["requests"] += 1
+            if self.log_requests:
+                logger.info("request puid=%s payload_kind=%s", puid, request.kind)
+            response = await self.executor.predict(request)
+            if response.status is None:
+                response.status = {"status": "SUCCESS", "code": 200}
+            if self.log_responses:
+                logger.info("response puid=%s", puid)
+            if self.request_logger is not None:
+                try:
+                    self.request_logger(request, response)
+                except Exception:
+                    logger.exception("request logger failed")
+            return response
+        except Exception as e:
+            self.stats["failures"] += 1
+            logger.exception("predict failed puid=%s", puid)
+            return failure_message(e, puid)
+        finally:
+            self._inflight -= 1
+            if self._inflight == 0:
+                self._inflight_zero.set()
+            elapsed = time.perf_counter() - start
+            if self.executor.observer:
+                self.executor.observer("predict_done", self.name, elapsed)
+
+    async def send_feedback(self, feedback: InternalFeedback) -> InternalMessage:
+        try:
+            self.stats["feedback"] += 1
+            await self.executor.send_feedback(feedback)
+            out = InternalMessage(payload=None, kind="jsonData", status={"status": "SUCCESS", "code": 200})
+            return out
+        except Exception as e:
+            logger.exception("feedback failed")
+            return failure_message(e)
+
+    async def close(self) -> None:
+        await self.executor.close()

@@ -1,0 +1,271 @@
+"""Node transports: how the orchestrator invokes one graph node.
+
+Three client kinds behind one async interface:
+
+* ``LocalClient`` — the node's component lives in this process; methods
+  are direct dispatch calls (run on a worker thread so model compute
+  never blocks the event loop; XLA releases the GIL during device
+  execution).  This replaces the reference's per-hop REST/gRPC
+  microservice call + JSON/proto codec
+  (reference: InternalPredictionService.java:192-467) for co-located
+  nodes.
+* ``GrpcClient`` — remote node over gRPC with per-endpoint cached
+  channels and per-call deadlines
+  (reference: GrpcChannelHandler.java:21-39,
+  InternalPredictionService.java:294-340).
+* ``RestClient`` — remote node over REST/JSON with connect/read timeouts
+  and bounded retries (reference: InternalPredictionService.java:80-98,
+  439-467).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Any, Dict, List, Optional
+
+from seldon_core_tpu.engine.graph import (
+    AGGREGATE,
+    MODEL,
+    ROUTE,
+    SEND_FEEDBACK,
+    TRANSFORM_INPUT,
+    TRANSFORM_OUTPUT,
+    UnitSpec,
+)
+from seldon_core_tpu.runtime import dispatch
+from seldon_core_tpu.runtime.component import MicroserviceError
+from seldon_core_tpu.runtime.message import InternalFeedback, InternalMessage
+
+logger = logging.getLogger(__name__)
+
+
+class NodeClient:
+    """Async invocation surface for one graph node."""
+
+    async def transform_input(self, msg: InternalMessage) -> InternalMessage:
+        raise NotImplementedError
+
+    async def transform_output(self, msg: InternalMessage) -> InternalMessage:
+        raise NotImplementedError
+
+    async def route(self, msg: InternalMessage) -> InternalMessage:
+        raise NotImplementedError
+
+    async def aggregate(self, msgs: List[InternalMessage]) -> InternalMessage:
+        raise NotImplementedError
+
+    async def send_feedback(self, feedback: InternalFeedback) -> InternalMessage:
+        raise NotImplementedError
+
+    async def ready(self) -> bool:
+        return True
+
+    async def close(self) -> None:
+        pass
+
+
+class LocalClient(NodeClient):
+    """In-process node: direct dispatch, device arrays pass by handle."""
+
+    def __init__(self, unit: UnitSpec, component: Any):
+        self.unit = unit
+        self.component = component
+
+    async def _run(self, fn, *args):
+        return await asyncio.to_thread(fn, *args)
+
+    async def transform_input(self, msg: InternalMessage) -> InternalMessage:
+        # A MODEL node's input transform IS its predict
+        # (reference: InternalPredictionService.java transformInput routing).
+        if self.unit.type == MODEL:
+            return await self._run(dispatch.predict, self.component, msg)
+        return await self._run(dispatch.transform_input, self.component, msg)
+
+    async def transform_output(self, msg: InternalMessage) -> InternalMessage:
+        return await self._run(dispatch.transform_output, self.component, msg)
+
+    async def route(self, msg: InternalMessage) -> InternalMessage:
+        return await self._run(dispatch.route, self.component, msg)
+
+    async def aggregate(self, msgs: List[InternalMessage]) -> InternalMessage:
+        return await self._run(dispatch.aggregate, self.component, msgs)
+
+    async def send_feedback(self, feedback: InternalFeedback) -> InternalMessage:
+        return await self._run(dispatch.send_feedback, self.component, feedback, self.unit.name)
+
+    async def ready(self) -> bool:
+        return True
+
+
+_METHOD_TO_SERVICE = {
+    # method -> (service, rpc, REST path)
+    "predict": ("Model", "Predict", "/predict"),
+    "transform_input": ("Transformer", "TransformInput", "/transform-input"),
+    "transform_output": ("OutputTransformer", "TransformOutput", "/transform-output"),
+    "route": ("Router", "Route", "/route"),
+    "aggregate": ("Combiner", "Aggregate", "/aggregate"),
+    "send_feedback": ("Model", "SendFeedback", "/send-feedback"),
+}
+
+
+class GrpcClient(NodeClient):
+    """Remote node over gRPC (channel cached per endpoint)."""
+
+    _channels: Dict[str, Any] = {}
+
+    def __init__(self, unit: UnitSpec, deadline_s: float = 5.0):
+        if unit.endpoint is None:
+            raise ValueError(f"GrpcClient for {unit.name!r} needs an endpoint")
+        self.unit = unit
+        self.addr = f"{unit.endpoint.host}:{unit.endpoint.port}"
+        self.deadline_s = deadline_s
+
+    def _channel(self):
+        import grpc
+
+        chan = GrpcClient._channels.get(self.addr)
+        if chan is None:
+            chan = grpc.aio.insecure_channel(self.addr)
+            GrpcClient._channels[self.addr] = chan
+        return chan
+
+    async def _call(self, method: str, request_proto, service_override: Optional[str] = None):
+        from seldon_core_tpu.proto import services
+
+        service, rpc, _ = _METHOD_TO_SERVICE[method]
+        if service_override:
+            service = service_override
+        callable_ = services.unary_callable(self._channel(), service, rpc)
+        try:
+            return await callable_(request_proto, timeout=self.deadline_s)
+        except Exception as e:  # grpc.aio.AioRpcError and friends
+            raise MicroserviceError(
+                f"gRPC call {method} to {self.addr} failed: {e}",
+                status_code=502,
+                reason="UPSTREAM_GRPC_ERROR",
+            ) from e
+
+    async def transform_input(self, msg: InternalMessage) -> InternalMessage:
+        method = "predict" if self.unit.type == MODEL else "transform_input"
+        resp = await self._call(method, msg.to_proto())
+        return InternalMessage.from_proto(resp)
+
+    async def transform_output(self, msg: InternalMessage) -> InternalMessage:
+        resp = await self._call("transform_output", msg.to_proto())
+        return InternalMessage.from_proto(resp)
+
+    async def route(self, msg: InternalMessage) -> InternalMessage:
+        resp = await self._call("route", msg.to_proto())
+        return InternalMessage.from_proto(resp)
+
+    async def aggregate(self, msgs: List[InternalMessage]) -> InternalMessage:
+        from seldon_core_tpu.proto import pb
+
+        msg_list = pb.SeldonMessageList(seldonMessages=[m.to_proto() for m in msgs])
+        resp = await self._call("aggregate", msg_list)
+        return InternalMessage.from_proto(resp)
+
+    async def send_feedback(self, feedback: InternalFeedback) -> InternalMessage:
+        service = "Router" if self.unit.type == "ROUTER" else "Model"
+        resp = await self._call("send_feedback", feedback.to_proto(), service_override=service)
+        return InternalMessage.from_proto(resp)
+
+    async def ready(self) -> bool:
+        try:
+            chan = self._channel()
+            await asyncio.wait_for(chan.channel_ready(), timeout=self.deadline_s)
+            return True
+        except Exception:
+            return False
+
+    @classmethod
+    async def close_all(cls) -> None:
+        for chan in cls._channels.values():
+            await chan.close()
+        cls._channels.clear()
+
+
+class RestClient(NodeClient):
+    """Remote node over REST/JSON with retries."""
+
+    def __init__(
+        self,
+        unit: UnitSpec,
+        connect_timeout_s: float = 2.0,
+        read_timeout_s: float = 5.0,
+        retries: int = 3,
+    ):
+        if unit.endpoint is None:
+            raise ValueError(f"RestClient for {unit.name!r} needs an endpoint")
+        self.unit = unit
+        self.base = f"http://{unit.endpoint.host}:{unit.endpoint.port}"
+        self.connect_timeout_s = connect_timeout_s
+        self.read_timeout_s = read_timeout_s
+        self.retries = retries
+        self._session = None
+
+    def _get_session(self):
+        import aiohttp
+
+        if self._session is None or self._session.closed:
+            timeout = aiohttp.ClientTimeout(
+                connect=self.connect_timeout_s, total=self.read_timeout_s
+            )
+            self._session = aiohttp.ClientSession(timeout=timeout)
+        return self._session
+
+    async def _post(self, path: str, body: Dict[str, Any]) -> Dict[str, Any]:
+        last_err: Optional[Exception] = None
+        for attempt in range(self.retries):
+            try:
+                session = self._get_session()
+                async with session.post(self.base + path, json=body) as resp:
+                    payload = await resp.json(content_type=None)
+                    if resp.status >= 400:
+                        raise MicroserviceError(
+                            f"REST call {path} to {self.base} returned {resp.status}: {payload}",
+                            status_code=502,
+                            reason="UPSTREAM_REST_ERROR",
+                        )
+                    return payload
+            except MicroserviceError:
+                raise
+            except Exception as e:
+                last_err = e
+                logger.warning("REST %s attempt %d/%d failed: %s", path, attempt + 1, self.retries, e)
+                await asyncio.sleep(0.05 * (attempt + 1))
+        raise MicroserviceError(
+            f"REST call {path} to {self.base} failed after {self.retries} tries: {last_err}",
+            status_code=502,
+            reason="UPSTREAM_REST_ERROR",
+        )
+
+    async def transform_input(self, msg: InternalMessage) -> InternalMessage:
+        path = "/predict" if self.unit.type == MODEL else "/transform-input"
+        return InternalMessage.from_json(await self._post(path, msg.to_json()))
+
+    async def transform_output(self, msg: InternalMessage) -> InternalMessage:
+        return InternalMessage.from_json(await self._post("/transform-output", msg.to_json()))
+
+    async def route(self, msg: InternalMessage) -> InternalMessage:
+        return InternalMessage.from_json(await self._post("/route", msg.to_json()))
+
+    async def aggregate(self, msgs: List[InternalMessage]) -> InternalMessage:
+        body = {"seldonMessages": [m.to_json() for m in msgs]}
+        return InternalMessage.from_json(await self._post("/aggregate", body))
+
+    async def send_feedback(self, feedback: InternalFeedback) -> InternalMessage:
+        return InternalMessage.from_json(await self._post("/send-feedback", feedback.to_json()))
+
+    async def ready(self) -> bool:
+        try:
+            session = self._get_session()
+            async with session.get(self.base + "/health/ping") as resp:
+                return resp.status < 400
+        except Exception:
+            return False
+
+    async def close(self) -> None:
+        if self._session is not None and not self._session.closed:
+            await self._session.close()
