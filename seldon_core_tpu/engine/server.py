@@ -1,0 +1,209 @@
+"""Orchestrator front server: external REST + gRPC around predictors.
+
+The ingress-facing shell of the data plane, equivalent to the reference
+engine's controllers (reference: RestClientController.java:127-268,
+SeldonGrpcServer.java:30-60, SeldonService.java:30-67):
+
+    POST /api/v0.1/predictions   POST /api/v0.1/feedback
+    GET  /ping /ready /live      PUT/POST /pause /unpause
+    GET  /metrics
+    gRPC seldon.protos.Seldon/Predict, /SendFeedback
+
+A ``Gateway`` fronts one *deployment* = several predictors with traffic
+weights (canary / A-B across predictors, the reference's Istio
+VirtualService weight semantics,
+reference: seldondeployment_controller.go:171-239) plus optional shadow
+traffic (reference: ambassador.go:50-133).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import random
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import grpc
+from aiohttp import web
+
+from seldon_core_tpu.engine.service import PredictorService, failure_message
+from seldon_core_tpu.proto import pb, services
+from seldon_core_tpu.runtime.component import MicroserviceError
+from seldon_core_tpu.runtime.message import InternalFeedback, InternalMessage
+from seldon_core_tpu.runtime.rest import _error_response, _request_body
+
+logger = logging.getLogger(__name__)
+
+
+class Gateway:
+    """Weighted traffic split across predictors of one deployment."""
+
+    def __init__(
+        self,
+        predictors: Sequence[Tuple[PredictorService, float]],
+        shadows: Sequence[PredictorService] = (),
+        seed: Optional[int] = None,
+    ):
+        if not predictors:
+            raise ValueError("gateway needs at least one predictor")
+        self.entries: List[Tuple[PredictorService, float]] = list(predictors)
+        total = sum(w for _, w in self.entries)
+        if total <= 0:  # all-zero weights -> uniform
+            self.entries = [(p, 1.0) for p, _ in self.entries]
+            total = float(len(self.entries))
+        self._weights = [w / total for _, w in self.entries]
+        self.shadows = list(shadows)
+        self._rng = random.Random(seed)
+
+    @property
+    def predictors(self) -> List[PredictorService]:
+        return [p for p, _ in self.entries]
+
+    def pick(self) -> PredictorService:
+        r = self._rng.random()
+        acc = 0.0
+        for (svc, _), w in zip(self.entries, self._weights):
+            acc += w
+            if r < acc:
+                return svc
+        return self.entries[-1][0]
+
+    def by_name(self, name: str) -> Optional[PredictorService]:
+        for svc in self.predictors:
+            if svc.name == name:
+                return svc
+        return None
+
+    async def predict(self, request: InternalMessage, predictor: Optional[str] = None) -> InternalMessage:
+        svc = self.by_name(predictor) if predictor else None
+        if svc is None:
+            svc = self.pick()
+        # shadow traffic: fire-and-forget copies, responses dropped
+        for shadow in self.shadows:
+            asyncio.ensure_future(shadow.predict(request))
+        return await svc.predict(request)
+
+    async def send_feedback(self, feedback: InternalFeedback) -> InternalMessage:
+        # feedback goes to the predictor that served the request when
+        # identifiable, else to all
+        results = await asyncio.gather(*(p.send_feedback(feedback) for p in self.predictors))
+        return results[0]
+
+    async def ready(self) -> bool:
+        checks = await asyncio.gather(*(p.ready() for p in self.predictors))
+        return all(checks)
+
+    def pause(self) -> None:
+        for p in self.predictors:
+            p.pause()
+
+    def unpause(self) -> None:
+        for p in self.predictors:
+            p.unpause()
+
+    async def close(self) -> None:
+        await asyncio.gather(*(p.close() for p in self.predictors))
+
+
+def build_gateway_app(gateway: Gateway) -> web.Application:
+    app = web.Application(client_max_size=1024 * 1024 * 512)
+
+    async def predictions(request: web.Request) -> web.Response:
+        try:
+            body = await _request_body(request)
+            msg = InternalMessage.from_json(body)
+            out = await gateway.predict(msg, predictor=request.query.get("predictor"))
+            status_code = 200
+            if out.status and out.status.get("status") == "FAILURE":
+                status_code = int(out.status.get("code", 500))
+                if not (400 <= status_code < 600):
+                    status_code = 500
+            return web.json_response(out.to_json(), status=status_code)
+        except Exception as e:  # noqa: BLE001
+            return _error_response(e)
+
+    async def feedback(request: web.Request) -> web.Response:
+        try:
+            body = await _request_body(request)
+            fb = InternalFeedback.from_json(body)
+            out = await gateway.send_feedback(fb)
+            return web.json_response(out.to_json())
+        except Exception as e:  # noqa: BLE001
+            return _error_response(e)
+
+    async def ping(_r: web.Request) -> web.Response:
+        return web.Response(text="pong")
+
+    async def live(_r: web.Request) -> web.Response:
+        return web.Response(text="live")
+
+    async def ready(_r: web.Request) -> web.Response:
+        ok = await gateway.ready()
+        return web.Response(text="ready" if ok else "not ready", status=200 if ok else 503)
+
+    async def pause(_r: web.Request) -> web.Response:
+        gateway.pause()
+        return web.Response(text="paused")
+
+    async def unpause(_r: web.Request) -> web.Response:
+        gateway.unpause()
+        return web.Response(text="unpaused")
+
+    async def metrics_endpoint(_r: web.Request) -> web.Response:
+        from prometheus_client import CONTENT_TYPE_LATEST, generate_latest
+
+        return web.Response(body=generate_latest(), content_type=CONTENT_TYPE_LATEST.split(";")[0])
+
+    app.router.add_post("/api/v0.1/predictions", predictions)
+    app.router.add_get("/api/v0.1/predictions", predictions)
+    app.router.add_post("/predict", predictions)  # convenience alias
+    app.router.add_post("/api/v0.1/feedback", feedback)
+    app.router.add_get("/ping", ping)
+    app.router.add_get("/live", live)
+    app.router.add_get("/ready", ready)
+    app.router.add_route("*", "/pause", pause)
+    app.router.add_route("*", "/unpause", unpause)
+    app.router.add_get("/metrics", metrics_endpoint)
+    return app
+
+
+def add_seldon_service(server: grpc.aio.Server, gateway: Gateway) -> None:
+    """Register the external Seldon gRPC service."""
+
+    async def predict(request: pb.SeldonMessage, context) -> pb.SeldonMessage:
+        msg = InternalMessage.from_proto(request)
+        out = await gateway.predict(msg)
+        return out.to_proto()
+
+    async def send_feedback(request: pb.Feedback, context) -> pb.SeldonMessage:
+        fb = InternalFeedback.from_proto(request)
+        out = await gateway.send_feedback(fb)
+        return out.to_proto()
+
+    server.add_generic_rpc_handlers(
+        (services.generic_handler("Seldon", {"Predict": predict, "SendFeedback": send_feedback}),)
+    )
+
+
+async def serve_gateway(
+    gateway: Gateway,
+    host: str = "0.0.0.0",
+    http_port: int = 8000,
+    grpc_port: int = 5001,
+    max_message_bytes: int = 512 * 1024 * 1024,
+):
+    """Start REST + gRPC front servers; returns (runner, grpc_server)."""
+    from seldon_core_tpu.runtime import rest
+
+    app = build_gateway_app(gateway)
+    runner = await rest.serve(app, host=host, port=http_port)
+    server = grpc.aio.server(
+        options=[
+            ("grpc.max_send_message_length", max_message_bytes),
+            ("grpc.max_receive_message_length", max_message_bytes),
+        ]
+    )
+    add_seldon_service(server, gateway)
+    server.add_insecure_port(f"{host}:{grpc_port}")
+    await server.start()
+    return runner, server

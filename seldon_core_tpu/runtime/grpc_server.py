@@ -1,0 +1,110 @@
+"""gRPC server for a single graph-node microservice.
+
+Registers all seven node-role services against one user component, the
+same all-servicers-on-one-object pattern as the reference wrapper
+(reference: python/seldon_core/wrapper.py:133-158), using gRPC generic
+handlers (no generated stubs).  Uses the async server; user-model calls
+run on worker threads so device compute overlaps request handling.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Any, Optional
+
+import grpc
+
+from seldon_core_tpu.proto import pb, services
+from seldon_core_tpu.runtime import dispatch
+from seldon_core_tpu.runtime.component import MicroserviceError
+from seldon_core_tpu.runtime.message import InternalFeedback, InternalMessage
+
+logger = logging.getLogger(__name__)
+
+DEFAULT_MAX_MSG_BYTES = 512 * 1024 * 1024
+
+
+def _wrap_unary(user_model: Any, fn, unit_id: str = ""):
+    async def handler(request, context):
+        try:
+            if isinstance(request, pb.Feedback):
+                arg = InternalFeedback.from_proto(request)
+                out = await asyncio.to_thread(fn, user_model, arg, unit_id)
+            elif isinstance(request, pb.SeldonMessageList):
+                msgs = [InternalMessage.from_proto(m) for m in request.seldonMessages]
+                out = await asyncio.to_thread(fn, user_model, msgs)
+            else:
+                msg = InternalMessage.from_proto(request)
+                out = await asyncio.to_thread(fn, user_model, msg)
+            return out.to_proto()
+        except MicroserviceError as e:
+            resp = pb.SeldonMessage()
+            resp.status.status = pb.Status.FAILURE
+            resp.status.code = e.status_code
+            resp.status.info = e.message
+            resp.status.reason = e.reason
+            return resp
+        except Exception as e:  # noqa: BLE001
+            logger.exception("grpc handler error")
+            resp = pb.SeldonMessage()
+            resp.status.status = pb.Status.FAILURE
+            resp.status.code = 500
+            resp.status.info = str(e)
+            resp.status.reason = "MICROSERVICE_INTERNAL_ERROR"
+            return resp
+
+    return handler
+
+
+def add_component_services(server: grpc.aio.Server, user_model: Any, unit_id: str = "") -> None:
+    """Register Generic/Model/Router/Transformer/OutputTransformer/
+    Combiner for `user_model` on `server`."""
+    p = _wrap_unary(user_model, dispatch.predict)
+    ti = _wrap_unary(user_model, dispatch.transform_input)
+    to = _wrap_unary(user_model, dispatch.transform_output)
+    rt = _wrap_unary(user_model, dispatch.route)
+    ag = _wrap_unary(user_model, dispatch.aggregate)
+    fb = _wrap_unary(user_model, dispatch.send_feedback, unit_id)
+
+    server.add_generic_rpc_handlers(
+        (
+            services.generic_handler(
+                "Generic",
+                {"TransformInput": ti, "TransformOutput": to, "Route": rt, "Aggregate": ag, "SendFeedback": fb},
+            ),
+            services.generic_handler("Model", {"Predict": p, "SendFeedback": fb}),
+            services.generic_handler("Router", {"Route": rt, "SendFeedback": fb}),
+            services.generic_handler("Transformer", {"TransformInput": ti}),
+            services.generic_handler("OutputTransformer", {"TransformOutput": to}),
+            services.generic_handler("Combiner", {"Aggregate": ag}),
+        )
+    )
+
+
+def build_server(
+    user_model: Any,
+    unit_id: str = "",
+    max_message_bytes: int = DEFAULT_MAX_MSG_BYTES,
+) -> grpc.aio.Server:
+    server = grpc.aio.server(
+        options=[
+            ("grpc.max_send_message_length", max_message_bytes),
+            ("grpc.max_receive_message_length", max_message_bytes),
+        ]
+    )
+    add_component_services(server, user_model, unit_id)
+    return server
+
+
+async def serve(
+    user_model: Any,
+    port: int = 5000,
+    host: str = "0.0.0.0",
+    unit_id: str = "",
+    max_message_bytes: int = DEFAULT_MAX_MSG_BYTES,
+) -> grpc.aio.Server:
+    server = build_server(user_model, unit_id, max_message_bytes)
+    server.add_insecure_port(f"{host}:{port}")
+    await server.start()
+    return server

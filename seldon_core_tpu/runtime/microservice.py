@@ -1,0 +1,158 @@
+"""Microservice CLI — wrap one user component as a serving process.
+
+Equivalent of the reference's ``seldon-core-microservice`` entrypoint
+(reference: python/seldon_core/microservice.py:186-375):
+
+    seldon-tpu-microservice mypkg.MyModel --api BOTH --http-port 9000 \
+        --grpc-port 5000 --service-type MODEL \
+        --parameters '[{"name":"n","value":"2","type":"FLOAT"}]'
+
+Differences from the reference, by design:
+
+* one process serves REST **and** gRPC concurrently on one asyncio loop
+  (the reference forces a choice of one transport per container);
+* scale-out is replica processes managed by the control plane rather
+  than gunicorn forks — TPU devices can't be shared by forked workers;
+* component state restore/persist uses the checkpoint subsystem instead
+  of whole-object pickling to Redis.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import importlib
+import json
+import logging
+import os
+import signal
+import sys
+from typing import Any, Dict, List, Optional
+
+from seldon_core_tpu.runtime.params import (
+    PARAMETERS_ENV_NAME,
+    SERVICE_PORT_ENV_NAME,
+    UNIT_ID_ENV_NAME,
+    parse_parameters,
+)
+
+logger = logging.getLogger(__name__)
+
+SERVICE_TYPES = ("MODEL", "ROUTER", "TRANSFORMER", "COMBINER", "OUTLIER_DETECTOR")
+
+
+def import_component(dotted: str, **kwargs: Any) -> Any:
+    """Instantiate `pkg.module.Class` with typed parameter kwargs."""
+    module_name, _, class_name = dotted.rpartition(".")
+    if not module_name:
+        raise ValueError(f"component path must be 'module.Class', got {dotted!r}")
+    sys.path.insert(0, os.getcwd())
+    module = importlib.import_module(module_name)
+    cls = getattr(module, class_name)
+    return cls(**kwargs)
+
+
+def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
+    parser = argparse.ArgumentParser(description="seldon-core-tpu microservice")
+    parser.add_argument("component", help="dotted path module.Class of the user component")
+    parser.add_argument("--api", choices=("REST", "GRPC", "BOTH"), default="BOTH")
+    parser.add_argument("--service-type", choices=SERVICE_TYPES, default="MODEL")
+    parser.add_argument(
+        "--http-port",
+        type=int,
+        default=int(os.environ.get(SERVICE_PORT_ENV_NAME, 9000)),
+    )
+    parser.add_argument("--grpc-port", type=int, default=5000)
+    parser.add_argument("--host", default="0.0.0.0")
+    parser.add_argument(
+        "--parameters", default=os.environ.get(PARAMETERS_ENV_NAME, "[]"),
+        help="typed parameter list JSON",
+    )
+    parser.add_argument("--unit-id", default=os.environ.get(UNIT_ID_ENV_NAME, ""))
+    parser.add_argument("--persistence", action="store_true", help="periodically checkpoint component state")
+    parser.add_argument("--persistence-dir", default=os.environ.get("PERSISTENCE_DIR", "/tmp/seldon-tpu-state"))
+    parser.add_argument("--persistence-period-s", type=float, default=60.0)
+    parser.add_argument("--tracing", action="store_true", default=bool(int(os.environ.get("TRACING", "0"))))
+    parser.add_argument("--log-level", default=os.environ.get("SELDON_LOG_LEVEL", "INFO"))
+    return parser.parse_args(argv)
+
+
+async def run_servers(
+    user_model: Any,
+    api: str = "BOTH",
+    host: str = "0.0.0.0",
+    http_port: int = 9000,
+    grpc_port: int = 5000,
+    unit_id: str = "",
+    shutdown_event: Optional[asyncio.Event] = None,
+) -> None:
+    """Serve until `shutdown_event` (or forever)."""
+    from seldon_core_tpu.runtime import grpc_server, rest
+
+    runner = None
+    server = None
+    if api in ("REST", "BOTH"):
+        app = rest.build_app(user_model, unit_id=unit_id)
+        runner = await rest.serve(app, host=host, port=http_port)
+        logger.info("REST serving on %s:%d", host, http_port)
+    if api in ("GRPC", "BOTH"):
+        server = await grpc_server.serve(user_model, port=grpc_port, host=host, unit_id=unit_id)
+        logger.info("gRPC serving on %s:%d", host, grpc_port)
+
+    if shutdown_event is None:
+        shutdown_event = asyncio.Event()
+        loop = asyncio.get_running_loop()
+        for sig in (signal.SIGINT, signal.SIGTERM):
+            try:
+                loop.add_signal_handler(sig, shutdown_event.set)
+            except NotImplementedError:  # pragma: no cover
+                pass
+    await shutdown_event.wait()
+
+    if server is not None:
+        await server.stop(grace=20.0)
+    if runner is not None:
+        await runner.cleanup()
+
+
+def main(argv: Optional[List[str]] = None) -> None:
+    args = parse_args(argv)
+    logging.basicConfig(level=args.log_level.upper(), format="%(asctime)s %(name)s %(levelname)s %(message)s")
+
+    kwargs = parse_parameters(json.loads(args.parameters))
+    user_model = import_component(args.component, **kwargs)
+
+    if args.tracing:
+        from seldon_core_tpu.utils.tracing import setup_tracing
+
+        setup_tracing(service_name=args.unit_id or args.component)
+
+    persistence_thread = None
+    if args.persistence:
+        from seldon_core_tpu.utils.persistence import PersistenceManager
+
+        manager = PersistenceManager(args.persistence_dir, args.unit_id or args.component)
+        manager.restore(user_model)
+        persistence_thread = manager.start_background(user_model, period_s=args.persistence_period_s)
+
+    if hasattr(user_model, "load"):
+        user_model.load()
+
+    try:
+        asyncio.run(
+            run_servers(
+                user_model,
+                api=args.api,
+                host=args.host,
+                http_port=args.http_port,
+                grpc_port=args.grpc_port,
+                unit_id=args.unit_id,
+            )
+        )
+    finally:
+        if persistence_thread is not None:
+            persistence_thread.stop()
+
+
+if __name__ == "__main__":
+    main()

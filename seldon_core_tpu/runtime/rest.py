@@ -1,0 +1,148 @@
+"""REST server for a single graph-node microservice.
+
+aiohttp application exposing the reference wrapper's endpoint surface
+(reference: python/seldon_core/wrapper.py:21-98):
+
+    POST /predict  /transform-input  /transform-output
+         /route    /aggregate       /send-feedback
+    GET  /health/ping  /health/status  /metrics
+
+Requests are JSON bodies (or a ``json`` form/query field, as the
+reference accepts).  Payload stays in plain-dict form end-to-end —
+no proto round-trip on the REST path.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+from typing import Any, Callable, Dict, Optional
+
+from aiohttp import web
+
+from seldon_core_tpu.runtime import dispatch
+from seldon_core_tpu.runtime.component import MicroserviceError
+from seldon_core_tpu.runtime.message import InternalFeedback, InternalMessage
+
+logger = logging.getLogger(__name__)
+
+
+async def _request_body(request: web.Request) -> Dict[str, Any]:
+    """JSON body, or a `json` field in form/query (reference:
+    flask_utils.get_request semantics)."""
+    if request.content_type == "application/json":
+        try:
+            return await request.json()
+        except json.JSONDecodeError as e:
+            raise MicroserviceError(f"invalid JSON body: {e}", status_code=400, reason="BAD_REQUEST")
+    if request.method == "POST":
+        form = await request.post()
+        if "json" in form:
+            return json.loads(form["json"])
+        # raw body fallback
+        text = await request.text()
+        if text:
+            try:
+                return json.loads(text)
+            except json.JSONDecodeError as e:
+                raise MicroserviceError(f"invalid JSON body: {e}", status_code=400, reason="BAD_REQUEST")
+    if "json" in request.query:
+        return json.loads(request.query["json"])
+    raise MicroserviceError("empty request body", status_code=400, reason="BAD_REQUEST")
+
+
+def _error_response(e: Exception) -> web.Response:
+    if isinstance(e, MicroserviceError):
+        body = {"status": e.to_status()}
+        return web.json_response(body, status=e.status_code)
+    logger.exception("unhandled microservice error")
+    body = {"status": {"status": "FAILURE", "code": 500, "info": str(e), "reason": "MICROSERVICE_INTERNAL_ERROR"}}
+    return web.json_response(body, status=500)
+
+
+def _message_endpoint(user_model: Any, fn: Callable) -> Callable:
+    async def handler(request: web.Request) -> web.Response:
+        try:
+            body = await _request_body(request)
+            msg = InternalMessage.from_json(body)
+            out = await asyncio.to_thread(fn, user_model, msg)
+            return web.json_response(out.to_json())
+        except Exception as e:  # noqa: BLE001 — every error must map to a Status
+            return _error_response(e)
+
+    return handler
+
+
+def build_app(
+    user_model: Any,
+    unit_id: str = "",
+    extra_routes: Optional[Dict[str, Callable]] = None,
+) -> web.Application:
+    app = web.Application(client_max_size=1024 * 1024 * 512)
+
+    async def aggregate_handler(request: web.Request) -> web.Response:
+        try:
+            body = await _request_body(request)
+            raw_list = body.get("seldonMessages", body if isinstance(body, list) else [])
+            msgs = [InternalMessage.from_json(b) for b in raw_list]
+            out = await asyncio.to_thread(dispatch.aggregate, user_model, msgs)
+            return web.json_response(out.to_json())
+        except Exception as e:  # noqa: BLE001
+            return _error_response(e)
+
+    async def feedback_handler(request: web.Request) -> web.Response:
+        try:
+            body = await _request_body(request)
+            fb = InternalFeedback.from_json(body)
+            out = await asyncio.to_thread(dispatch.send_feedback, user_model, fb, unit_id)
+            return web.json_response(out.to_json())
+        except Exception as e:  # noqa: BLE001
+            return _error_response(e)
+
+    async def ping(_request: web.Request) -> web.Response:
+        return web.Response(text="pong")
+
+    async def status(_request: web.Request) -> web.Response:
+        try:
+            out = await asyncio.to_thread(dispatch.health_check, user_model)
+            return web.json_response(out.to_json())
+        except Exception as e:  # noqa: BLE001
+            return _error_response(e)
+
+    async def metrics_endpoint(_request: web.Request) -> web.Response:
+        from prometheus_client import CONTENT_TYPE_LATEST, generate_latest
+
+        return web.Response(body=generate_latest(), content_type=CONTENT_TYPE_LATEST.split(";")[0])
+
+    for path, fn in (
+        ("/predict", dispatch.predict),
+        ("/api/v0.1/predictions", dispatch.predict),  # engine-compatible alias
+        ("/transform-input", dispatch.transform_input),
+        ("/transform-output", dispatch.transform_output),
+        ("/route", dispatch.route),
+    ):
+        handler = _message_endpoint(user_model, fn)
+        app.router.add_post(path, handler)
+        app.router.add_get(path, handler)
+
+    app.router.add_post("/aggregate", aggregate_handler)
+    app.router.add_get("/aggregate", aggregate_handler)
+    app.router.add_post("/send-feedback", feedback_handler)
+    app.router.add_get("/send-feedback", feedback_handler)
+    app.router.add_get("/health/ping", ping)
+    app.router.add_get("/health/status", status)
+    app.router.add_get("/metrics", metrics_endpoint)
+
+    for path, handler in (extra_routes or {}).items():
+        app.router.add_route("*", path, handler)
+    return app
+
+
+async def serve(app: web.Application, host: str = "0.0.0.0", port: int = 9000):
+    """Run an app until cancelled; returns the runner for cleanup."""
+    runner = web.AppRunner(app, access_log=None)
+    await runner.setup()
+    site = web.TCPSite(runner, host, port)
+    await site.start()
+    return runner

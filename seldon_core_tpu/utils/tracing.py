@@ -1,0 +1,107 @@
+"""Distributed tracing.
+
+The reference wires Jaeger via opentracing in every tier
+(reference: engine tracing/TracingProvider.java:10-37, python
+microservice.py:124-155).  Neither jaeger-client nor opentelemetry is
+available in this environment, so the framework ships a small
+self-contained tracer with the same span model (operation name, start /
+duration, tags, parent linkage via puid) and pluggable export:
+
+* in-memory ring buffer (default) — inspectable in tests and via the
+  gateway's debug endpoint;
+* JSON-lines file exporter, one span per line, trivially shippable to
+  any backend;
+* an OTLP/Jaeger exporter can be slotted in where available — the span
+  dataclass carries exactly the fields those protocols need.
+
+Spans cover the same cut points as the reference: one span per external
+request, one per graph-node method call.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from collections import deque
+from contextlib import contextmanager
+from dataclasses import dataclass, field
+from typing import Any, Deque, Dict, List, Optional
+
+_tracer: Optional["Tracer"] = None
+
+
+@dataclass
+class Span:
+    trace_id: str  # the request puid
+    name: str  # e.g. "predictor.predict", "node.transform_input"
+    start_s: float
+    duration_s: float = 0.0
+    tags: Dict[str, Any] = field(default_factory=dict)
+    parent: Optional[str] = None
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "traceId": self.trace_id,
+            "name": self.name,
+            "startTimeUnixNano": int(self.start_s * 1e9),
+            "durationNano": int(self.duration_s * 1e9),
+            "tags": self.tags,
+            "parent": self.parent,
+        }
+
+
+class Tracer:
+    def __init__(self, service_name: str = "seldon-tpu", capacity: int = 4096, export_path: Optional[str] = None):
+        self.service_name = service_name
+        self.spans: Deque[Span] = deque(maxlen=capacity)
+        self.export_path = export_path
+        self._lock = threading.Lock()
+        self._file = open(export_path, "a") if export_path else None
+
+    @contextmanager
+    def span(self, name: str, trace_id: str = "", parent: Optional[str] = None, **tags: Any):
+        s = Span(trace_id=trace_id, name=name, start_s=time.time(), tags=dict(tags), parent=parent)
+        t0 = time.perf_counter()
+        try:
+            yield s
+        finally:
+            s.duration_s = time.perf_counter() - t0
+            self.record(s)
+
+    def record(self, s: Span) -> None:
+        with self._lock:
+            self.spans.append(s)
+            if self._file is not None:
+                self._file.write(json.dumps(s.to_dict()) + "\n")
+                self._file.flush()
+
+    def find(self, trace_id: str) -> List[Span]:
+        with self._lock:
+            return [s for s in self.spans if s.trace_id == trace_id]
+
+    def close(self) -> None:
+        if self._file is not None:
+            self._file.close()
+
+
+def setup_tracing(service_name: str = "seldon-tpu", export_path: Optional[str] = None) -> Tracer:
+    """Install the global tracer (reference: setup_tracing env-driven init)."""
+    global _tracer
+    _tracer = Tracer(service_name=service_name, export_path=export_path)
+    return _tracer
+
+
+def get_tracer() -> Optional[Tracer]:
+    return _tracer
+
+
+@contextmanager
+def maybe_span(name: str, trace_id: str = "", **tags: Any):
+    """A span if tracing is enabled, else a no-op."""
+    tracer = get_tracer()
+    if tracer is None:
+        yield None
+    else:
+        with tracer.span(name, trace_id=trace_id, **tags) as s:
+            yield s

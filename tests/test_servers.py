@@ -1,0 +1,342 @@
+"""Server tests: REST + gRPC microservice and the gateway, over real
+loopback sockets (reference tier-1 equivalent with sockets, plus the
+engine controller tests of tier 2).
+"""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+from seldon_core_tpu.engine import PredictorService, UnitSpec
+from seldon_core_tpu.engine.server import Gateway, build_gateway_app, serve_gateway
+from seldon_core_tpu.proto import pb, services
+from seldon_core_tpu.runtime import InternalMessage, TPUComponent
+from seldon_core_tpu.runtime import grpc_server, rest
+
+
+class Doubler(TPUComponent):
+    def predict(self, X, names, meta=None):
+        return np.asarray(X) * 2
+
+    def class_names(self):
+        return ["a", "b"]
+
+
+class FixedModel(TPUComponent):
+    """Deterministic fixed-output model, the reference's rollout-test trick
+    (reference: testing/docker/fixed-model/ModelV1.py)."""
+
+    def __init__(self, values=(1.0, 2.0, 3.0, 4.0)):
+        self.values = list(values)
+
+    def predict(self, X, names, meta=None):
+        return np.array([self.values])
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+async def _rest_client(app):
+    from aiohttp.test_utils import TestClient, TestServer
+
+    server = TestServer(app)
+    client = TestClient(server)
+    await client.start_server()
+    return client
+
+
+class TestRestMicroservice:
+    def test_predict_roundtrip(self):
+        async def scenario():
+            client = await _rest_client(rest.build_app(Doubler()))
+            resp = await client.post(
+                "/predict", json={"data": {"ndarray": [[1.0, 2.0]]}}
+            )
+            body = await resp.json()
+            await client.close()
+            return resp.status, body
+
+        status, body = run(scenario())
+        assert status == 200
+        assert body["data"]["ndarray"] == [[2.0, 4.0]]
+        assert body["data"]["names"] == ["a", "b"]
+
+    def test_bad_payload_gives_400(self):
+        async def scenario():
+            client = await _rest_client(rest.build_app(Doubler()))
+            resp = await client.post("/predict", json={"nope": 1})
+            body = await resp.json()
+            await client.close()
+            return resp.status, body
+
+        status, body = run(scenario())
+        assert status == 500 or status == 400
+        assert body["status"]["status"] == "FAILURE"
+
+    def test_health_and_metrics(self):
+        async def scenario():
+            client = await _rest_client(rest.build_app(Doubler()))
+            ping = await client.get("/health/ping")
+            status = await client.get("/health/status")
+            metrics = await client.get("/metrics")
+            out = (ping.status, await ping.text(), status.status, metrics.status)
+            await client.close()
+            return out
+
+        ping_status, ping_text, status_status, metrics_status = run(scenario())
+        assert (ping_status, ping_text) == (200, "pong")
+        assert status_status == 200
+        assert metrics_status == 200
+
+    def test_feedback_endpoint(self):
+        seen = []
+
+        class Fb(Doubler):
+            def send_feedback(self, features, names, reward, truth, routing=None):
+                seen.append(reward)
+
+        async def scenario():
+            client = await _rest_client(rest.build_app(Fb()))
+            resp = await client.post(
+                "/send-feedback",
+                json={"request": {"data": {"ndarray": [[1.0]]}}, "reward": 0.9},
+            )
+            await client.close()
+            return resp.status
+
+        assert run(scenario()) == 200
+        assert seen == [0.9]
+
+    def test_aggregate_endpoint(self):
+        class Mean(TPUComponent):
+            def aggregate(self, features_list, names_list):
+                return np.mean([np.asarray(f) for f in features_list], axis=0)
+
+        async def scenario():
+            client = await _rest_client(rest.build_app(Mean()))
+            resp = await client.post(
+                "/aggregate",
+                json={
+                    "seldonMessages": [
+                        {"data": {"ndarray": [[2.0]]}},
+                        {"data": {"ndarray": [[4.0]]}},
+                    ]
+                },
+            )
+            body = await resp.json()
+            await client.close()
+            return body
+
+        assert run(scenario())["data"]["ndarray"] == [[3.0]]
+
+
+class TestGrpcMicroservice:
+    def test_predict_over_socket(self):
+        async def scenario():
+            import grpc
+
+            server = grpc_server.build_server(Doubler())
+            port = server.add_insecure_port("127.0.0.1:0")
+            await server.start()
+            channel = grpc.aio.insecure_channel(f"127.0.0.1:{port}")
+            predict = services.unary_callable(channel, "Model", "Predict")
+            req = pb.SeldonMessage()
+            req.data.tensor.shape.extend([1, 2])
+            req.data.tensor.values.extend([1.0, 2.0])
+            resp = await predict(req, timeout=5)
+            await channel.close()
+            await server.stop(grace=None)
+            return resp
+
+        resp = run(scenario())
+        assert list(resp.data.tensor.values) == [2.0, 4.0]
+        assert list(resp.data.names) == ["a", "b"]
+
+    def test_raw_tensor_over_socket(self):
+        async def scenario():
+            import grpc
+
+            server = grpc_server.build_server(Doubler())
+            port = server.add_insecure_port("127.0.0.1:0")
+            await server.start()
+            channel = grpc.aio.insecure_channel(f"127.0.0.1:{port}")
+            predict = services.unary_callable(channel, "Model", "Predict")
+            arr = np.arange(4, dtype=np.float32).reshape(2, 2)
+            req = pb.SeldonMessage()
+            req.data.rawTensor.dtype = "float32"
+            req.data.rawTensor.shape.extend([2, 2])
+            req.data.rawTensor.data = arr.tobytes()
+            resp = await predict(req, timeout=5)
+            await channel.close()
+            await server.stop(grace=None)
+            return resp
+
+        resp = run(scenario())
+        out = np.frombuffer(resp.data.rawTensor.data, dtype=np.float32).reshape(2, 2)
+        np.testing.assert_array_equal(out, np.arange(4, dtype=np.float32).reshape(2, 2) * 2)
+
+    def test_component_error_maps_to_failure_status(self):
+        class Boom(TPUComponent):
+            def predict(self, X, names, meta=None):
+                raise ValueError("kaboom")
+
+        async def scenario():
+            import grpc
+
+            server = grpc_server.build_server(Boom())
+            port = server.add_insecure_port("127.0.0.1:0")
+            await server.start()
+            channel = grpc.aio.insecure_channel(f"127.0.0.1:{port}")
+            predict = services.unary_callable(channel, "Model", "Predict")
+            req = pb.SeldonMessage()
+            req.data.tensor.shape.extend([1])
+            req.data.tensor.values.extend([1.0])
+            resp = await predict(req, timeout=5)
+            await channel.close()
+            await server.stop(grace=None)
+            return resp
+
+        resp = run(scenario())
+        assert resp.status.status == pb.Status.FAILURE
+        assert "kaboom" in resp.status.info
+
+
+def model_unit(name, component):
+    return UnitSpec(name=name, type="MODEL", component=component)
+
+
+class TestGateway:
+    def test_predictions_endpoint(self):
+        async def scenario():
+            gw = Gateway([(PredictorService(model_unit("m", Doubler()), name="main"), 100.0)])
+            client = await _rest_client(build_gateway_app(gw))
+            resp = await client.post(
+                "/api/v0.1/predictions", json={"data": {"ndarray": [[3.0]]}}
+            )
+            body = await resp.json()
+            ready = await client.get("/ready")
+            await client.close()
+            return resp.status, body, ready.status
+
+        status, body, ready_status = run(scenario())
+        assert status == 200
+        assert body["data"]["ndarray"] == [[6.0]]
+        assert body["meta"]["puid"]
+        assert ready_status == 200
+
+    def test_traffic_split_and_pin(self):
+        async def scenario():
+            a = PredictorService(model_unit("m", FixedModel([1, 1, 1, 1])), name="a")
+            b = PredictorService(model_unit("m", FixedModel([2, 2, 2, 2])), name="b")
+            gw = Gateway([(a, 50.0), (b, 50.0)], seed=7)
+            client = await _rest_client(build_gateway_app(gw))
+            seen = set()
+            for _ in range(30):
+                resp = await client.post("/api/v0.1/predictions", json={"data": {"ndarray": [[0.0]]}})
+                body = await resp.json()
+                seen.add(tuple(body["data"]["ndarray"][0]))
+            pinned = await client.post(
+                "/api/v0.1/predictions?predictor=b", json={"data": {"ndarray": [[0.0]]}}
+            )
+            pinned_body = await pinned.json()
+            await client.close()
+            return seen, pinned_body
+
+        seen, pinned_body = run(scenario())
+        assert len(seen) == 2  # both predictors served traffic
+        assert pinned_body["data"]["ndarray"] == [[2.0, 2.0, 2.0, 2.0]]
+
+    def test_pause_unpause(self):
+        async def scenario():
+            gw = Gateway([(PredictorService(model_unit("m", Doubler())), 1.0)])
+            client = await _rest_client(build_gateway_app(gw))
+            r1 = (await client.get("/ready")).status
+            await client.post("/pause")
+            r2 = (await client.get("/ready")).status
+            await client.post("/unpause")
+            r3 = (await client.get("/ready")).status
+            await client.close()
+            return r1, r2, r3
+
+        assert run(scenario()) == (200, 503, 200)
+
+    def test_grpc_seldon_service(self):
+        async def scenario():
+            import grpc
+
+            gw = Gateway([(PredictorService(model_unit("m", Doubler())), 1.0)])
+            server = grpc.aio.server()
+            from seldon_core_tpu.engine.server import add_seldon_service
+
+            add_seldon_service(server, gw)
+            port = server.add_insecure_port("127.0.0.1:0")
+            await server.start()
+            channel = grpc.aio.insecure_channel(f"127.0.0.1:{port}")
+            predict = services.unary_callable(channel, "Seldon", "Predict")
+            req = pb.SeldonMessage()
+            req.data.tensor.shape.extend([1, 1])
+            req.data.tensor.values.extend([5.0])
+            resp = await predict(req, timeout=5)
+            await channel.close()
+            await server.stop(grace=None)
+            return resp
+
+        resp = run(scenario())
+        assert list(resp.data.tensor.values) == [10.0]
+        assert resp.meta.puid
+
+
+class TestRemoteGraphEdge:
+    """A graph whose node is served by a real remote microservice —
+    the reference's engine->microservice hop, over loopback gRPC."""
+
+    def test_remote_grpc_model_node(self):
+        async def scenario():
+            from seldon_core_tpu.engine.graph import Endpoint
+
+            server = grpc_server.build_server(Doubler())
+            port = server.add_insecure_port("127.0.0.1:0")
+            await server.start()
+
+            unit = UnitSpec(
+                name="remote-m",
+                type="MODEL",
+                endpoint=Endpoint(host="127.0.0.1", port=port, transport="GRPC"),
+            )
+            svc = PredictorService(unit)
+            out = await svc.predict(InternalMessage(payload=np.array([[7.0]]), kind="tensor"))
+            await server.stop(grace=None)
+            from seldon_core_tpu.engine.transport import GrpcClient
+
+            await GrpcClient.close_all()
+            return out
+
+        out = run(scenario())
+        np.testing.assert_array_equal(out.payload, [[14.0]])
+        assert out.status["status"] == "SUCCESS"
+
+    def test_remote_rest_model_node(self):
+        async def scenario():
+            from aiohttp.test_utils import TestServer
+
+            from seldon_core_tpu.engine.graph import Endpoint
+
+            app = rest.build_app(Doubler())
+            server = TestServer(app)
+            await server.start_server()
+
+            unit = UnitSpec(
+                name="remote-m",
+                type="MODEL",
+                endpoint=Endpoint(host="127.0.0.1", port=server.port, transport="REST"),
+            )
+            svc = PredictorService(unit)
+            out = await svc.predict(InternalMessage(payload=np.array([[7.0]]), kind="tensor"))
+            await svc.close()
+            await server.close()
+            return out
+
+        out = run(scenario())
+        np.testing.assert_array_equal(out.payload, [[14.0]])
