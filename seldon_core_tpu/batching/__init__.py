@@ -1,0 +1,8 @@
+"""Server-side dynamic batching."""
+
+from seldon_core_tpu.batching.batcher import (  # noqa: F401
+    BatcherStats,
+    DynamicBatcher,
+    bucket_for,
+    default_buckets,
+)

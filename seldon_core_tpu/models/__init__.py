@@ -1,0 +1,38 @@
+"""Model zoo + prepackaged servers.
+
+Importing this package registers the prepackaged server implementations
+in the graph builtin registry (the declarative
+``implementation: JAX_SERVER`` path, mirroring the reference's
+prepackaged-server enum, reference: proto/seldon_deployment.proto:102-113
+and operator/controllers/seldondeployment_prepackaged_servers.go:109).
+"""
+
+from seldon_core_tpu.engine.units import register_implementation
+from seldon_core_tpu.models.jaxserver import JaxServer  # noqa: F401
+
+register_implementation("JAX_SERVER", JaxServer)
+
+
+def _register_optional() -> None:
+    """Servers gated on optional third-party toolkits."""
+    try:
+        from seldon_core_tpu.models.sklearnserver import SKLearnServer
+
+        register_implementation("SKLEARN_SERVER", SKLearnServer)
+    except ImportError:
+        pass
+    try:
+        from seldon_core_tpu.models.xgboostserver import XGBoostServer
+
+        register_implementation("XGBOOST_SERVER", XGBoostServer)
+    except ImportError:
+        pass
+    try:
+        from seldon_core_tpu.models.torchserver import TorchServer
+
+        register_implementation("TORCH_SERVER", TorchServer)
+    except ImportError:
+        pass
+
+
+_register_optional()

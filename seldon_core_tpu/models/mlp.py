@@ -1,0 +1,25 @@
+"""Small MLP classifier — the tabular-model workhorse for tests,
+examples, and the iris/tabular benchmark configs (playing the role of
+the reference's sklearn/xgboost sample models on the TPU path)."""
+
+from __future__ import annotations
+
+from typing import Any, Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class MLPClassifier(nn.Module):
+    hidden_sizes: Sequence[int] = (64, 64)
+    num_classes: int = 3
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = jnp.asarray(x, self.dtype)
+        for i, width in enumerate(self.hidden_sizes):
+            x = nn.Dense(width, dtype=self.dtype, name=f"dense_{i}")(x)
+            x = nn.relu(x)
+        x = nn.Dense(self.num_classes, dtype=self.dtype, name="head")(x)
+        return jnp.asarray(x, jnp.float32)

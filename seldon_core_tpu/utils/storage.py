@@ -1,0 +1,100 @@
+"""Model-artifact storage downloader.
+
+Equivalent of the reference's KFServing-derived ``Storage.download``
+(reference: python/seldon_core/storage.py:40-184): resolve a model URI
+to a local directory/file before serving.  Supported schemes:
+
+* ``file://`` / bare paths — used directly (no copy);
+* ``http(s)://`` — fetched to the cache dir;
+* ``gs://`` / ``s3://`` — gated on google-cloud-storage / boto3|minio
+  being installed; raises a clear error otherwise (this environment is
+  egress-free, so cloud paths are exercised via mocks in tests).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import shutil
+import tempfile
+from typing import Optional
+from urllib.parse import urlparse
+
+logger = logging.getLogger(__name__)
+
+_CACHE_ENV = "SELDON_TPU_MODEL_CACHE"
+
+
+def _cache_dir() -> str:
+    d = os.environ.get(_CACHE_ENV) or os.path.join(tempfile.gettempdir(), "seldon-tpu-models")
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+def download(uri: str, out_dir: Optional[str] = None) -> str:
+    """Resolve `uri` to a local path, downloading if remote."""
+    parsed = urlparse(uri)
+    scheme = parsed.scheme
+
+    if scheme in ("", "file"):
+        path = parsed.path if scheme == "file" else uri
+        if not os.path.exists(path):
+            raise FileNotFoundError(f"model uri not found: {uri}")
+        return path
+
+    if scheme in ("http", "https"):
+        import requests
+
+        out_dir = out_dir or _cache_dir()
+        dest = os.path.join(out_dir, os.path.basename(parsed.path) or "model")
+        if not os.path.exists(dest):
+            logger.info("downloading %s -> %s", uri, dest)
+            with requests.get(uri, stream=True, timeout=60) as r:
+                r.raise_for_status()
+                with open(dest + ".tmp", "wb") as f:
+                    shutil.copyfileobj(r.raw, f)
+            os.replace(dest + ".tmp", dest)
+        return dest
+
+    if scheme == "gs":
+        try:
+            from google.cloud import storage as gcs  # type: ignore
+        except ImportError as e:
+            raise RuntimeError("gs:// model uris need google-cloud-storage installed") from e
+        out_dir = out_dir or os.path.join(_cache_dir(), parsed.netloc, parsed.path.lstrip("/"))
+        os.makedirs(out_dir, exist_ok=True)
+        client = gcs.Client()
+        bucket = client.bucket(parsed.netloc)
+        prefix = parsed.path.lstrip("/")
+        count = 0
+        for blob in client.list_blobs(bucket, prefix=prefix):
+            rel = os.path.relpath(blob.name, prefix) if blob.name != prefix else os.path.basename(blob.name)
+            dest = os.path.join(out_dir, rel)
+            os.makedirs(os.path.dirname(dest), exist_ok=True)
+            blob.download_to_filename(dest)
+            count += 1
+        if count == 0:
+            raise FileNotFoundError(f"no objects under {uri}")
+        return out_dir
+
+    if scheme == "s3":
+        try:
+            import boto3  # type: ignore
+        except ImportError as e:
+            raise RuntimeError("s3:// model uris need boto3 installed") from e
+        out_dir = out_dir or os.path.join(_cache_dir(), parsed.netloc, parsed.path.lstrip("/"))
+        os.makedirs(out_dir, exist_ok=True)
+        s3 = boto3.client("s3", endpoint_url=os.environ.get("S3_ENDPOINT") or None)
+        prefix = parsed.path.lstrip("/")
+        resp = s3.list_objects_v2(Bucket=parsed.netloc, Prefix=prefix)
+        contents = resp.get("Contents", [])
+        if not contents:
+            raise FileNotFoundError(f"no objects under {uri}")
+        for obj in contents:
+            rel = os.path.relpath(obj["Key"], prefix) if obj["Key"] != prefix else os.path.basename(obj["Key"])
+            dest = os.path.join(out_dir, rel)
+            os.makedirs(os.path.dirname(dest), exist_ok=True)
+            s3.download_file(parsed.netloc, obj["Key"], dest)
+        return out_dir
+
+    raise ValueError(f"unsupported model uri scheme: {uri!r}")
