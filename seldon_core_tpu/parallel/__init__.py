@@ -1,0 +1,16 @@
+"""Device-mesh parallelism: mesh construction, shardings, sharded training."""
+
+from seldon_core_tpu.parallel.mesh import (  # noqa: F401
+    DATA_AXIS,
+    MODEL_AXIS,
+    create_mesh,
+    mesh_shape,
+    single_device_mesh,
+)
+from seldon_core_tpu.parallel.sharding import (  # noqa: F401
+    data_sharded,
+    infer_param_specs,
+    replicated,
+    shard_params,
+)
+from seldon_core_tpu.parallel.train import ShardedTrainer, cross_entropy_loss  # noqa: F401

@@ -1,0 +1,62 @@
+"""Device-mesh construction.
+
+The reference's scale-out unit is the pod replica behind a Service
+(reference: SURVEY §2 request-level parallelism); the TPU-native unit is
+the **device mesh**: ICI-connected chips addressed by named axes, over
+which models are sharded with ``NamedSharding`` and XLA inserts the
+collectives.  DCN (multi-host) edges stay at the graph/transport layer.
+
+Conventions used across the framework:
+
+* ``data``  — batch-dimension sharding (throughput scaling)
+* ``model`` — tensor-parallel parameter sharding (fit + latency scaling)
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, List, Optional, Sequence, Tuple
+
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+
+
+def create_mesh(
+    axes: Optional[Dict[str, int]] = None,
+    devices: Optional[Sequence] = None,
+):
+    """Build a ``jax.sharding.Mesh``.
+
+    ``axes`` maps axis name -> size; a size of -1 means "everything
+    left" (at most one axis).  Default: all devices on ``data``.
+    """
+    import jax
+    import numpy as np
+
+    devices = list(devices if devices is not None else jax.devices())
+    if axes is None:
+        axes = {DATA_AXIS: len(devices)}
+
+    sizes = dict(axes)
+    wildcards = [k for k, v in sizes.items() if v == -1]
+    if len(wildcards) > 1:
+        raise ValueError("at most one mesh axis may be -1")
+    fixed = math.prod(v for v in sizes.values() if v != -1)
+    if wildcards:
+        if len(devices) % fixed:
+            raise ValueError(f"{len(devices)} devices not divisible by {fixed}")
+        sizes[wildcards[0]] = len(devices) // fixed
+    total = math.prod(sizes.values())
+    if total > len(devices):
+        raise ValueError(f"mesh {sizes} needs {total} devices, have {len(devices)}")
+    mesh_devices = np.asarray(devices[:total]).reshape(tuple(sizes.values()))
+    return jax.sharding.Mesh(mesh_devices, tuple(sizes.keys()))
+
+
+def single_device_mesh():
+    """Degenerate 1-device mesh so sharded code paths run anywhere."""
+    return create_mesh({DATA_AXIS: 1})
+
+
+def mesh_shape(mesh) -> Dict[str, int]:
+    return dict(zip(mesh.axis_names, mesh.devices.shape))

@@ -2,18 +2,25 @@
 
 Tests run on a virtual 8-device CPU mesh (the role the reference's kind
 cluster plays for its e2e tier, reference: testing/scripts/kind_test_all.sh)
-so multi-chip sharding paths execute without TPU hardware.  Must run
-before anything imports jax.
+so multi-chip sharding paths execute without TPU hardware.
+
+Note: this environment pre-imports jax from sitecustomize with
+JAX_PLATFORMS pointing at the TPU plugin, so plain env vars are too
+late — the platform must be forced through jax.config before the
+backend initialises.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", os.environ.get("SELDON_TPU_TEST_PLATFORM", "cpu"))
 
 import pytest  # noqa: E402
 
