@@ -1,0 +1,202 @@
+"""Headline benchmark: ResNet-50 served through the full data plane.
+
+Measures the framework the way the reference measures itself — through
+the external serving surface — but on the flagship model rather than a
+stub: a ResNet-50 (bfloat16, random weights; weights don't change the
+compute) behind a predictor graph, served over loopback gRPC
+(seldon.protos.Seldon/Predict), driven by concurrent clients sending
+single-image uint8 RawTensor requests.  The dynamic batcher coalesces
+them into padded-bucket XLA calls on the chip.
+
+Prints ONE JSON line:
+    {"metric": "resnet50_grpc_p50_ms", "value": <p50 ms>, "unit": "ms",
+     "vs_baseline": <10ms-target / p50>, "extra": {...}}
+
+vs_baseline > 1.0 means beating the BASELINE.md north-star target
+(<10 ms p50 gRPC on-chip).  extra carries QPS, tail latencies, batcher
+efficiency, and a stub-model data-plane QPS comparable to the
+reference's published engine benchmark
+(reference: doc/source/reference/benchmarking.md:54-58, 28,256 req/s).
+
+Env knobs: BENCH_MODEL (resnet50|resnet_tiny), BENCH_SECONDS,
+BENCH_CONCURRENCY, BENCH_MAX_BATCH, BENCH_QUICK=1 (tiny model, short).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import statistics
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+
+# persistent XLA compilation cache: later rounds skip recompiles
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", os.path.join(os.path.dirname(__file__), ".jax_cache"))
+
+import numpy as np
+
+if os.environ.get("BENCH_PLATFORM"):  # e.g. cpu for local smoke runs
+    import jax
+
+    jax.config.update("jax_platforms", os.environ["BENCH_PLATFORM"])
+
+QUICK = os.environ.get("BENCH_QUICK", "0") == "1"
+MODEL = os.environ.get("BENCH_MODEL", "resnet_tiny" if QUICK else "resnet50")
+SECONDS = float(os.environ.get("BENCH_SECONDS", "3" if QUICK else "10"))
+CONCURRENCY = int(os.environ.get("BENCH_CONCURRENCY", "32"))
+MAX_BATCH = int(os.environ.get("BENCH_MAX_BATCH", "32"))
+MAX_WAIT_MS = float(os.environ.get("BENCH_MAX_WAIT_MS", "1.0"))
+P50_TARGET_MS = 10.0  # BASELINE.md north star
+REFERENCE_GRPC_QPS = 28_256.39  # reference engine stub benchmark
+
+
+def build_gateway():
+    from seldon_core_tpu.engine import PredictorService, UnitSpec
+    from seldon_core_tpu.engine.server import Gateway
+    from seldon_core_tpu.models.jaxserver import JaxServer
+
+    shape = (224, 224, 3) if MODEL.startswith("resnet") and MODEL != "resnet_tiny" else (32, 32, 3)
+    num_classes = 1000 if MODEL == "resnet50" else 10
+    server = JaxServer(
+        model=MODEL,
+        num_classes=num_classes,
+        input_shape=shape,
+        dtype="bfloat16",
+        max_batch_size=MAX_BATCH,
+        max_wait_ms=MAX_WAIT_MS,
+        buckets=[1, 4, 16, MAX_BATCH] if MAX_BATCH > 16 else None,
+    )
+    unit = UnitSpec(name=MODEL, type="MODEL", component=server)
+    svc = PredictorService(unit, name="bench")
+    return Gateway([(svc, 1.0)]), server, shape
+
+
+def grpc_worker(port: int, shape, stop_at: float, latencies: list, errors: list):
+    """One sync-client thread: tight request loop until the deadline."""
+    import grpc
+
+    from seldon_core_tpu.proto import pb, services
+
+    channel = grpc.insecure_channel(f"127.0.0.1:{port}")
+    predict = services.unary_callable(channel, "Seldon", "Predict")
+    img = (np.random.default_rng(threading.get_ident() % 2**31).integers(
+        0, 255, size=(1, *shape), dtype=np.uint8))
+    req = pb.SeldonMessage()
+    req.data.rawTensor.dtype = "uint8"
+    req.data.rawTensor.shape.extend([1, *shape])
+    req.data.rawTensor.data = img.tobytes()
+    mine: list = []
+    while time.perf_counter() < stop_at:
+        t0 = time.perf_counter()
+        try:
+            resp = predict(req, timeout=30)
+            if resp.status.status != pb.Status.SUCCESS and resp.status.code not in (0, 200):
+                errors.append(resp.status.info)
+            else:
+                mine.append((time.perf_counter() - t0) * 1000.0)
+        except Exception as e:  # noqa: BLE001
+            errors.append(str(e))
+    latencies.extend(mine)
+    channel.close()
+
+
+async def stub_dataplane_qps(seconds: float = 2.0) -> float:
+    """In-process stub-model executor throughput (reference-comparable
+    data-plane number, no model compute, no wire)."""
+    from seldon_core_tpu.engine import PredictorService, UnitSpec
+    from seldon_core_tpu.runtime.message import InternalMessage
+
+    svc = PredictorService(UnitSpec(name="stub", type="MODEL", implementation="SIMPLE_MODEL"))
+    payload = np.asarray([[1.0, 2.0, 3.0]])
+
+    count = 0
+    stop_at = time.perf_counter() + seconds
+
+    async def worker():
+        nonlocal count
+        while time.perf_counter() < stop_at:
+            msg = InternalMessage(payload=payload, kind="tensor")
+            out = await svc.predict(msg)
+            assert out.status["status"] == "SUCCESS"
+            count += 1
+
+    await asyncio.gather(*(worker() for _ in range(64)))
+    return count / seconds
+
+
+async def main() -> None:
+    import grpc
+
+    import jax
+
+    t_setup = time.perf_counter()
+    gateway, server, shape = build_gateway()
+
+    from seldon_core_tpu.engine.server import add_seldon_service
+
+    grpc_server = grpc.aio.server(
+        options=[
+            ("grpc.max_send_message_length", 64 * 1024 * 1024),
+            ("grpc.max_receive_message_length", 64 * 1024 * 1024),
+        ]
+    )
+    add_seldon_service(grpc_server, gateway)
+    port = grpc_server.add_insecure_port("127.0.0.1:0")
+    await grpc_server.start()
+    setup_s = time.perf_counter() - t_setup
+
+    # ---- measured window -------------------------------------------------
+    latencies: list = []
+    errors: list = []
+    stop_at = time.perf_counter() + SECONDS
+    loop = asyncio.get_running_loop()
+    with ThreadPoolExecutor(max_workers=CONCURRENCY) as pool:
+        tasks = [
+            loop.run_in_executor(pool, grpc_worker, port, shape, stop_at, latencies, errors)
+            for _ in range(CONCURRENCY)
+        ]
+        await asyncio.gather(*tasks)
+
+    await grpc_server.stop(grace=None)
+
+    stub_qps = await stub_dataplane_qps(2.0)
+    server.unload()
+
+    if not latencies:
+        print(json.dumps({"metric": "resnet50_grpc_p50_ms", "value": None, "unit": "ms",
+                          "vs_baseline": 0.0, "extra": {"errors": errors[:5]}}))
+        return
+
+    latencies.sort()
+    p50 = statistics.median(latencies)
+    p99 = latencies[min(len(latencies) - 1, int(len(latencies) * 0.99))]
+    qps = len(latencies) / SECONDS
+    result = {
+        "metric": "resnet50_grpc_p50_ms" if MODEL == "resnet50" else f"{MODEL}_grpc_p50_ms",
+        "value": round(p50, 3),
+        "unit": "ms",
+        "vs_baseline": round(P50_TARGET_MS / p50, 3),
+        "extra": {
+            "model": MODEL,
+            "device": str(jax.devices()[0]),
+            "qps": round(qps, 1),
+            "p90_ms": round(latencies[int(len(latencies) * 0.90)], 3),
+            "p99_ms": round(p99, 3),
+            "mean_ms": round(statistics.fmean(latencies), 3),
+            "requests": len(latencies),
+            "errors": len(errors),
+            "concurrency": CONCURRENCY,
+            "mean_batch_rows": round(server.batcher.stats.mean_batch_rows, 2),
+            "device_batches": server.batcher.stats.batches,
+            "stub_engine_qps": round(stub_qps, 1),
+            "stub_vs_reference_grpc": round(stub_qps / REFERENCE_GRPC_QPS, 3),
+            "setup_s": round(setup_s, 1),
+        },
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    asyncio.run(main())

@@ -78,6 +78,7 @@ class JaxServer(TPUComponent):
         class_names_list: Optional[List[str]] = None,
         softmax_outputs: bool = False,
         warmup: bool = True,
+        warmup_dtypes: Sequence[str] = ("float32", "uint8"),
         seed: int = 0,
         mesh: Optional[Any] = None,
         data_axis: str = "data",
@@ -95,6 +96,11 @@ class JaxServer(TPUComponent):
         self._class_names = class_names_list
         self.softmax_outputs = bool(softmax_outputs)
         self.warmup = bool(warmup)
+        # XLA specialises on input dtype as well as shape: warm every
+        # (bucket, dtype) pair clients may send, and canonicalise anything
+        # else host-side so a stray float64 tensor payload can never
+        # trigger a mid-traffic recompile
+        self.warmup_dtypes = tuple(warmup_dtypes)
         self.seed = int(seed)
         self.mesh = mesh
         self.data_axis = data_axis
@@ -224,9 +230,10 @@ class JaxServer(TPUComponent):
         self.batcher.start()
 
         if self.warmup:
-            # pre-compile every bucket so no request pays a trace
+            # pre-compile every (bucket, dtype) pair so no request pays a trace
             for b in self.batcher.buckets:
-                device_call(np.zeros((b, *self.input_shape), np.float32))
+                for dt in self.warmup_dtypes:
+                    device_call(np.zeros((b, *self.input_shape), np.dtype(dt)))
         self._load_time_s = time.perf_counter() - t0
         self._loaded = True
         logger.info(
@@ -248,6 +255,8 @@ class JaxServer(TPUComponent):
         if not self._loaded:
             self.load()
         arr = np.asarray(X)
+        if arr.dtype.name not in self.warmup_dtypes:
+            arr = arr.astype(np.dtype(self.warmup_dtypes[0]))
         squeeze = False
         if arr.ndim == len(self.input_shape):  # single example without batch dim
             arr = arr[None]

@@ -50,6 +50,7 @@ class ShardedTrainer:
         model_axis: str = MODEL_AXIS,
         has_batch_stats: bool = True,
         seed: int = 0,
+        min_weight_size: int = 16_384,
     ):
         import jax
         import jax.numpy as jnp
@@ -68,7 +69,9 @@ class ShardedTrainer:
         batch_stats = variables.get("batch_stats", {})
 
         # layouts: tp specs for params, replicated opt-state mirrors params
-        param_specs = infer_param_specs(params, mesh, model_axis=model_axis)
+        param_specs = infer_param_specs(
+            params, mesh, model_axis=model_axis, min_weight_size=min_weight_size
+        )
         self.param_shardings = jax.tree.map(lambda s: NamedSharding(mesh, s), param_specs,
                                             is_leaf=lambda x: isinstance(x, P))
         repl = NamedSharding(mesh, P())

@@ -113,7 +113,7 @@ def mlp_server():
 
     server = JaxServer(
         model="mlp", num_classes=3, input_shape=(4,), dtype="float32",
-        max_batch_size=8, max_wait_ms=1.0,
+        max_batch_size=8, max_wait_ms=1.0, warmup_dtypes=("float32",),
     )
     server.load()
     yield server
