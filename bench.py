@@ -32,14 +32,20 @@ import threading
 import time
 from concurrent.futures import ThreadPoolExecutor
 
-# persistent XLA compilation cache: later rounds skip recompiles
-os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", os.path.join(os.path.dirname(__file__), ".jax_cache"))
-
 import numpy as np
 
-if os.environ.get("BENCH_PLATFORM"):  # e.g. cpu for local smoke runs
-    import jax
+import jax
 
+# persistent XLA compilation cache: later rounds skip recompiles.
+# (set through jax.config — this environment pre-imports jax from
+# sitecustomize, so env vars are read too early to matter)
+jax.config.update(
+    "jax_compilation_cache_dir",
+    os.environ.get("JAX_COMPILATION_CACHE_DIR", os.path.join(os.path.dirname(__file__), ".jax_cache")),
+)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+
+if os.environ.get("BENCH_PLATFORM"):  # e.g. cpu for local smoke runs
     jax.config.update("jax_platforms", os.environ["BENCH_PLATFORM"])
 
 QUICK = os.environ.get("BENCH_QUICK", "0") == "1"

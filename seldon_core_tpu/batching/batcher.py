@@ -18,9 +18,20 @@ turns the <10 ms p50 latency target and high QPS/chip into the same
 design problem: keep the MXU fed with large batches without holding
 any single request longer than the wait budget.
 
+The execution is a **two-stage pipeline**: a collector thread coalesces
+requests and *launches* the device call (XLA dispatch is async), then
+immediately starts an async device->host copy of the result and hands
+the in-flight batch to a finisher pool; finishers materialise results
+and resolve request futures.  Collection of batch N+1 overlaps the
+device compute and the host copy of batch N (and host-copy latencies of
+several in-flight batches overlap each other), so throughput is set by
+the slowest stage, not the sum — crucial when device->host readback has
+a high fixed latency, as it does both over PCIe-attached hosts and in
+this harness's relayed-TPU setup.
+
 Thread-based on purpose: model calls arrive from worker threads (the
 server runs user dispatch via ``asyncio.to_thread``) and XLA execution
-releases the GIL, so a single collector thread drives the device while
+releases the GIL, so the pipeline threads drive the device while
 request threads only block on their own future.
 """
 
@@ -97,6 +108,8 @@ class DynamicBatcher:
         max_wait_ms: float = 2.0,
         buckets: Optional[Sequence[int]] = None,
         name: str = "batcher",
+        pipeline_depth: int = 8,
+        finisher_threads: int = 4,
     ):
         if max_batch_size < 1:
             raise ValueError("max_batch_size must be >= 1")
@@ -109,7 +122,11 @@ class DynamicBatcher:
         self.name = name
         self.stats = BatcherStats()
         self._queue: "queue.Queue[Optional[_WorkItem]]" = queue.Queue()
+        # bounded: backpressure when `pipeline_depth` batches are in flight
+        self._inflight: "queue.Queue[Optional[tuple]]" = queue.Queue(maxsize=pipeline_depth)
         self._thread: Optional[threading.Thread] = None
+        self._finishers: List[threading.Thread] = []
+        self.finisher_threads = finisher_threads
         self._running = False
 
     # ---------------------------------------------------------------- public
@@ -120,6 +137,12 @@ class DynamicBatcher:
         self._running = True
         self._thread = threading.Thread(target=self._loop, daemon=True, name=f"seldon-tpu-{self.name}")
         self._thread.start()
+        self._finishers = [
+            threading.Thread(target=self._finish_loop, daemon=True, name=f"seldon-tpu-{self.name}-fin{i}")
+            for i in range(self.finisher_threads)
+        ]
+        for t in self._finishers:
+            t.start()
 
     def stop(self) -> None:
         if not self._running:
@@ -129,6 +152,11 @@ class DynamicBatcher:
         if self._thread is not None:
             self._thread.join(timeout=5.0)
             self._thread = None
+        for _ in self._finishers:
+            self._inflight.put(None)
+        for t in self._finishers:
+            t.join(timeout=5.0)
+        self._finishers = []
 
     def submit(self, x: np.ndarray, timeout_s: float = 30.0):
         """Blocking submit of one request batch [rows, ...]; returns [rows, ...out]."""
@@ -166,7 +194,8 @@ class DynamicBatcher:
             rows += item.rows
         return items
 
-    def _run_batch(self, items: List[_WorkItem]) -> None:
+    def _launch_batch(self, items: List[_WorkItem]) -> None:
+        """Stage 1 (collector thread): pad, launch, start async readback."""
         rows = sum(it.rows for it in items)
         batch = items[0].x if len(items) == 1 else np.concatenate([it.x for it in items], axis=0)
         bucket = bucket_for(rows, self.buckets)
@@ -176,13 +205,32 @@ class DynamicBatcher:
         if padded:
             pad_width = [(0, padded)] + [(0, 0)] * (batch.ndim - 1)
             batch = np.pad(batch, pad_width)
-        out = self.predict_fn(batch)
-        out = np.asarray(out)
+        out = self.predict_fn(batch)  # async XLA dispatch: returns immediately
+        if hasattr(out, "copy_to_host_async"):
+            out.copy_to_host_async()  # overlap readback with later batches
         self.stats.observe(len(items), rows, padded)
-        offset = 0
-        for it in items:
-            it.future.set_result(out[offset : offset + it.rows])
-            offset += it.rows
+        self._inflight.put((items, out))
+
+    def _finish_loop(self) -> None:
+        """Stage 2 (finisher pool): materialise results, resolve futures.
+        Several finishers run so the fixed device->host latency of
+        consecutive batches overlaps."""
+        while True:
+            entry = self._inflight.get()
+            if entry is None:
+                return
+            items, out = entry
+            try:
+                out = np.asarray(out)
+                offset = 0
+                for it in items:
+                    it.future.set_result(out[offset : offset + it.rows])
+                    offset += it.rows
+            except Exception as e:  # noqa: BLE001 — propagate to every caller
+                logger.exception("batch readback failed")
+                for it in items:
+                    if not it.future.done():
+                        it.future.set_exception(e)
 
     def _loop(self) -> None:
         while self._running:
@@ -190,9 +238,9 @@ class DynamicBatcher:
             if items is None:
                 break
             try:
-                self._run_batch(items)
+                self._launch_batch(items)
             except Exception as e:  # noqa: BLE001 — propagate to every caller
-                logger.exception("batch execution failed")
+                logger.exception("batch launch failed")
                 for it in items:
                     if not it.future.done():
                         it.future.set_exception(e)

@@ -1,0 +1,24 @@
+"""Control plane: spec, defaulting/validation, placement, deployer, supervisor."""
+
+from seldon_core_tpu.controlplane.spec import (  # noqa: F401
+    DeploymentSpecError,
+    PredictorSpec,
+    TpuDeployment,
+)
+from seldon_core_tpu.controlplane.defaulting import (  # noqa: F401
+    apply_defaults,
+    default_and_validate,
+    validate,
+)
+from seldon_core_tpu.controlplane.placement import plan_placement  # noqa: F401
+from seldon_core_tpu.controlplane.deployer import (  # noqa: F401
+    Deployer,
+    ManagedDeployment,
+    build_generation,
+    serve_deployment,
+)
+from seldon_core_tpu.controlplane.supervisor import (  # noqa: F401
+    ProcessSpec,
+    SupervisedProcess,
+    Supervisor,
+)

@@ -1,0 +1,238 @@
+"""Deployer — the reconciler that materialises deployments.
+
+Equivalent of the reference operator's reconcile loop
+(reference: seldondeployment_controller.go:268-494 createComponents,
+:1156-1211 Reconcile), re-imagined for a TPU host: instead of creating
+k8s Deployments/Services it
+
+1. runs the spec through defaulting + validation (the webhook stage),
+2. plans device placement,
+3. builds each predictor's graph executor in-process,
+4. wires a ``Gateway`` with the spec's traffic weights + shadows,
+5. on re-apply, performs a **rolling swap**: the new generation is
+   built and readiness-checked while the old one still serves, then
+   traffic cuts over atomically and the old generation drains
+   (the reference gets this from k8s rolling updates, tested with
+   fixed models — reference: testing/scripts/test_rolling_updates.py).
+
+``serve()`` exposes the deployment on HTTP/gRPC ports; ``DeployerCLI``
+(`seldon-tpu-deploy run spec.yaml`) is the operator daemon.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+from seldon_core_tpu.controlplane.defaulting import default_and_validate
+from seldon_core_tpu.controlplane.placement import PlacementPlan, plan_placement
+from seldon_core_tpu.controlplane.spec import TpuDeployment
+from seldon_core_tpu.engine.server import Gateway
+from seldon_core_tpu.engine.service import PredictorService
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass
+class Generation:
+    """One materialised version of a deployment."""
+
+    spec: TpuDeployment
+    gateway: Gateway
+    plan: PlacementPlan
+    created_at: float = field(default_factory=time.time)
+    generation: int = 0
+
+
+class ManagedDeployment:
+    """Holds the live generation; the serving layer reads through this
+    indirection so a rolling swap is one attribute store."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self.current: Optional[Generation] = None
+        self._lock = asyncio.Lock()
+
+    @property
+    def gateway(self) -> Gateway:
+        if self.current is None:
+            raise RuntimeError(f"deployment {self.name!r} has no live generation")
+        return self.current.gateway
+
+
+def build_generation(spec: TpuDeployment, device_ids: Optional[List[int]] = None) -> Generation:
+    """Webhook + placement + executor construction for one spec."""
+    spec = default_and_validate(spec)
+    plan = plan_placement(spec, device_ids=device_ids)
+    weighted: List[Tuple[PredictorService, float]] = []
+    shadows: List[PredictorService] = []
+    for p in spec.predictors:
+        svc = PredictorService(p.graph, name=p.name)
+        if p.shadow:
+            shadows.append(svc)
+        else:
+            weighted.append((svc, p.traffic))
+    return Generation(spec=spec, gateway=Gateway(weighted, shadows=shadows), plan=plan)
+
+
+class Deployer:
+    """Owns all deployments on this host."""
+
+    def __init__(self, device_ids: Optional[List[int]] = None):
+        self.deployments: Dict[str, ManagedDeployment] = {}
+        self.device_ids = device_ids
+
+    async def apply(self, spec: TpuDeployment, ready_timeout_s: float = 60.0) -> ManagedDeployment:
+        """Create or rolling-update a deployment."""
+        managed = self.deployments.get(spec.name)
+        fresh = managed is None
+        if fresh:
+            managed = ManagedDeployment(spec.name)
+
+        new_gen = build_generation(spec, device_ids=self.device_ids)
+        new_gen.generation = (managed.current.generation + 1) if managed.current else 1
+
+        # readiness gate before any traffic shifts (reference: engine
+        # /ready walks the whole graph before the pod joins the Service)
+        deadline = time.monotonic() + ready_timeout_s
+        while not await new_gen.gateway.ready():
+            if time.monotonic() > deadline:
+                await new_gen.gateway.close()
+                raise TimeoutError(f"new generation of {spec.name!r} never became ready")
+            await asyncio.sleep(0.1)
+
+        async with managed._lock:
+            old = managed.current
+            managed.current = new_gen  # atomic cutover
+        if old is not None:
+            # drain the old generation in the background
+            async def _drain(gen: Generation):
+                for svc in gen.gateway.predictors:
+                    await svc.drain(timeout_s=20.0)
+                await gen.gateway.close()
+
+            asyncio.ensure_future(_drain(old))
+        self.deployments[spec.name] = managed
+        logger.info(
+            "deployment %s generation %d live (%d predictors)",
+            spec.name,
+            new_gen.generation,
+            len(spec.predictors),
+        )
+        return managed
+
+    async def delete(self, name: str) -> bool:
+        managed = self.deployments.pop(name, None)
+        if managed is None or managed.current is None:
+            return False
+        managed.current.gateway.pause()
+        for svc in managed.current.gateway.predictors:
+            await svc.drain(timeout_s=20.0)
+        await managed.current.gateway.close()
+        managed.current = None
+        return True
+
+    async def status(self, name: str) -> Dict[str, Any]:
+        """Deployment status (the CR status the reference writes back,
+        reference: seldondeployment_controller.go:1200-1208)."""
+        managed = self.deployments.get(name)
+        if managed is None or managed.current is None:
+            return {"name": name, "state": "Absent"}
+        gen = managed.current
+        ready = await gen.gateway.ready()
+        return {
+            "name": name,
+            "state": "Available" if ready else "Creating",
+            "generation": gen.generation,
+            "predictors": {
+                svc.name: {
+                    "ready": await svc.ready(),
+                    "stats": dict(svc.stats),
+                    "devices": (
+                        gen.plan.for_predictor(svc.name).device_ids
+                        if gen.plan.for_predictor(svc.name)
+                        else []
+                    ),
+                }
+                for svc in gen.gateway.predictors
+            },
+        }
+
+
+async def serve_deployment(
+    deployer: Deployer,
+    name: str,
+    host: str = "0.0.0.0",
+    http_port: Optional[int] = None,
+    grpc_port: Optional[int] = None,
+):
+    """Expose a managed deployment on its spec ports.
+
+    The HTTP app and gRPC service resolve the gateway through the
+    ManagedDeployment on every request, so rolling swaps take effect
+    without socket churn.
+    """
+    import grpc
+    from aiohttp import web
+
+    from seldon_core_tpu.engine import server as engine_server
+    from seldon_core_tpu.runtime import rest
+
+    managed = deployer.deployments[name]
+    spec = managed.current.spec
+    http_port = http_port if http_port is not None else spec.http_port
+    grpc_port = grpc_port if grpc_port is not None else spec.grpc_port
+
+    class _GatewayProxy:
+        """Delegates to the live generation's gateway."""
+
+        def __getattr__(self, attr):
+            return getattr(managed.gateway, attr)
+
+    proxy = _GatewayProxy()
+    app = engine_server.build_gateway_app(proxy)
+    runner = await rest.serve(app, host=host, port=http_port)
+    grpc_srv = grpc.aio.server()
+    engine_server.add_seldon_service(grpc_srv, proxy)
+    grpc_srv.add_insecure_port(f"{host}:{grpc_port}")
+    await grpc_srv.start()
+    logger.info("deployment %s serving http=:%d grpc=:%d", name, http_port, grpc_port)
+    return runner, grpc_srv
+
+
+def main(argv: Optional[List[str]] = None) -> None:
+    """CLI: seldon-tpu-deploy run spec.yaml [--http-port N --grpc-port N]"""
+    import argparse
+
+    parser = argparse.ArgumentParser(description="seldon-core-tpu deployer")
+    parser.add_argument("command", choices=["run", "validate"])
+    parser.add_argument("spec", help="deployment spec yaml/json path")
+    parser.add_argument("--http-port", type=int, default=None)
+    parser.add_argument("--grpc-port", type=int, default=None)
+    parser.add_argument("--host", default="0.0.0.0")
+    args = parser.parse_args(argv)
+
+    logging.basicConfig(level="INFO")
+    spec = TpuDeployment.load(args.spec)
+
+    if args.command == "validate":
+        default_and_validate(spec)
+        print(f"deployment {spec.name!r} is valid")
+        return
+
+    async def _run():
+        deployer = Deployer()
+        await deployer.apply(spec)
+        await serve_deployment(
+            deployer, spec.name, host=args.host, http_port=args.http_port, grpc_port=args.grpc_port
+        )
+        await asyncio.Event().wait()  # serve forever
+
+    asyncio.run(_run())
+
+
+if __name__ == "__main__":
+    main()

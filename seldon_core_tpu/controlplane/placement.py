@@ -1,0 +1,89 @@
+"""Device placement — graph nodes onto TPU chips.
+
+The reference's scheduler is Kubernetes: one container per graph node,
+kube-scheduler picks machines.  Here the schedulable resource is the
+TPU device set of this host (and, later, of peer hosts over DCN): each
+predictor gets a device group sized by its ``mesh_axes`` request (or
+one device), chosen round-robin so co-deployed predictors don't
+contend for the same chip (the multi-tenancy concern of SURVEY §7
+"hard parts").
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+from seldon_core_tpu.controlplane.spec import DeploymentSpecError, TpuDeployment
+
+
+@dataclass
+class PredictorPlacement:
+    predictor: str
+    device_ids: List[int]
+    mesh_axes: Optional[Dict[str, int]] = None
+
+    def build_mesh(self):
+        """Materialise the jax Mesh for this placement (None = 1 device)."""
+        import jax
+
+        from seldon_core_tpu.parallel.mesh import create_mesh
+
+        all_devices = {d.id: d for d in jax.devices()}
+        devices = [all_devices[i] for i in self.device_ids]
+        if self.mesh_axes:
+            return create_mesh(dict(self.mesh_axes), devices=devices)
+        return create_mesh({"data": len(devices)}, devices=devices)
+
+
+@dataclass
+class PlacementPlan:
+    placements: Dict[str, PredictorPlacement] = field(default_factory=dict)
+
+    def for_predictor(self, name: str) -> Optional[PredictorPlacement]:
+        return self.placements.get(name)
+
+
+def plan_placement(dep: TpuDeployment, device_ids: Optional[List[int]] = None) -> PlacementPlan:
+    """Assign device groups to predictors.
+
+    Explicit ``deviceIds`` on a predictor are honoured (after checking
+    they exist and don't collide); others are packed round-robin.
+    A ``mesh_axes`` request sizes the group to the mesh volume.
+    """
+    if device_ids is None:
+        import jax
+
+        device_ids = [d.id for d in jax.devices()]
+    available = list(device_ids)
+    plan = PlacementPlan()
+
+    # explicit claims first
+    for p in dep.predictors:
+        if p.device_ids:
+            missing = [i for i in p.device_ids if i not in available]
+            if missing:
+                raise DeploymentSpecError(
+                    f"predictor {p.name!r} claims unavailable devices {missing}"
+                )
+            for i in p.device_ids:
+                available.remove(i)
+            plan.placements[p.name] = PredictorPlacement(p.name, list(p.device_ids), p.mesh_axes)
+
+    # size-derived assignment for the rest; wrap around (time-sliced
+    # sharing) when demand exceeds supply — chips multiplex predictors
+    cursor = 0
+    pool = available if available else list(device_ids)
+    for p in dep.predictors:
+        if p.name in plan.placements:
+            continue
+        want = math.prod(p.mesh_axes.values()) if p.mesh_axes else 1
+        if want > len(pool):
+            raise DeploymentSpecError(
+                f"predictor {p.name!r} wants {want} devices, only {len(pool)} available"
+            )
+        ids = [pool[(cursor + i) % len(pool)] for i in range(want)]
+        cursor = (cursor + want) % len(pool)
+        plan.placements[p.name] = PredictorPlacement(p.name, ids, p.mesh_axes)
+    return plan

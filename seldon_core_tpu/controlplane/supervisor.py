@@ -1,0 +1,162 @@
+"""Process supervisor for out-of-process graph nodes.
+
+Co-located nodes run in-process (the fast path), but cross-host nodes
+and isolation-needing components run as microservice processes — the
+role kubelet + Deployment controller play for the reference.  The
+supervisor provides the failure-detection / elastic-recovery loop
+(reference analogue: k8s restarts + readiness gating,
+reference: SURVEY §5.3):
+
+* spawn ``seldon-tpu-microservice`` processes with env-injected config
+  (the reference operator injects PREDICTIVE_UNIT_* env vars,
+  reference: microservice.py:20-22),
+* poll process liveness + HTTP readiness,
+* restart crashed processes with exponential backoff.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import subprocess
+import sys
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass
+class ProcessSpec:
+    name: str
+    component: str  # dotted module.Class
+    http_port: int
+    grpc_port: int
+    parameters_json: str = "[]"
+    api: str = "BOTH"
+    env: Dict[str, str] = field(default_factory=dict)
+    cwd: Optional[str] = None
+
+
+class SupervisedProcess:
+    def __init__(self, spec: ProcessSpec, max_restarts: int = 5):
+        self.spec = spec
+        self.max_restarts = max_restarts
+        self.restarts = 0
+        self.proc: Optional[subprocess.Popen] = None
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def _command(self) -> List[str]:
+        return [
+            sys.executable,
+            "-m",
+            "seldon_core_tpu.runtime.microservice",
+            self.spec.component,
+            "--api",
+            self.spec.api,
+            "--http-port",
+            str(self.spec.http_port),
+            "--grpc-port",
+            str(self.spec.grpc_port),
+            "--parameters",
+            self.spec.parameters_json,
+            "--unit-id",
+            self.spec.name,
+        ]
+
+    def _spawn(self) -> None:
+        env = dict(os.environ)
+        env.update(self.spec.env)
+        self.proc = subprocess.Popen(self._command(), env=env, cwd=self.spec.cwd)
+        logger.info("spawned node %s pid=%d", self.spec.name, self.proc.pid)
+
+    def start(self) -> None:
+        self._spawn()
+        self._thread = threading.Thread(target=self._watch, daemon=True, name=f"supervise-{self.spec.name}")
+        self._thread.start()
+
+    def _watch(self) -> None:
+        backoff = 0.5
+        while not self._stop.is_set():
+            code = self.proc.poll()
+            if code is not None:
+                if self._stop.is_set():
+                    return
+                if self.restarts >= self.max_restarts:
+                    logger.error("node %s exceeded restart budget (rc=%s)", self.spec.name, code)
+                    return
+                self.restarts += 1
+                logger.warning(
+                    "node %s exited rc=%s; restart %d/%d in %.1fs",
+                    self.spec.name, code, self.restarts, self.max_restarts, backoff,
+                )
+                time.sleep(backoff)
+                backoff = min(backoff * 2, 30.0)
+                self._spawn()
+            else:
+                self._stop.wait(0.2)
+
+    def alive(self) -> bool:
+        return self.proc is not None and self.proc.poll() is None
+
+    def ready(self, timeout_s: float = 1.0) -> bool:
+        """HTTP readiness probe against the node's /health/ping."""
+        import urllib.request
+
+        try:
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{self.spec.http_port}/health/ping", timeout=timeout_s
+            ) as resp:
+                return resp.status < 400
+        except Exception:
+            return False
+
+    def wait_ready(self, timeout_s: float = 30.0) -> bool:
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            if self.ready():
+                return True
+            if not self.alive() and self.restarts >= self.max_restarts:
+                return False
+            time.sleep(0.25)
+        return False
+
+    def stop(self, grace_s: float = 10.0) -> None:
+        self._stop.set()
+        if self.proc is not None and self.proc.poll() is None:
+            self.proc.terminate()
+            try:
+                self.proc.wait(timeout=grace_s)
+            except subprocess.TimeoutExpired:
+                self.proc.kill()
+                self.proc.wait()
+
+
+class Supervisor:
+    """Manages the full set of out-of-process nodes on this host."""
+
+    def __init__(self) -> None:
+        self.processes: Dict[str, SupervisedProcess] = {}
+
+    def add(self, spec: ProcessSpec, wait_ready_s: float = 30.0) -> SupervisedProcess:
+        sp = SupervisedProcess(spec)
+        sp.start()
+        if wait_ready_s and not sp.wait_ready(wait_ready_s):
+            sp.stop()
+            raise TimeoutError(f"node {spec.name!r} never became ready")
+        self.processes[spec.name] = sp
+        return sp
+
+    def stop_all(self) -> None:
+        for sp in self.processes.values():
+            sp.stop()
+        self.processes.clear()
+
+    def health(self) -> Dict[str, Dict]:
+        return {
+            name: {"alive": sp.alive(), "ready": sp.ready(), "restarts": sp.restarts}
+            for name, sp in self.processes.items()
+        }

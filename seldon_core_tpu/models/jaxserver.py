@@ -216,8 +216,9 @@ class JaxServer(TPUComponent):
             self._predict_jit = jax.jit(apply_fn)
 
         def device_call(batch: np.ndarray):
-            out = self._predict_jit(self.variables, jnp.asarray(batch))
-            return np.asarray(out)
+            # returns the device array: XLA dispatch is async, and the
+            # batcher pipeline overlaps readback with the next batch
+            return self._predict_jit(self.variables, jnp.asarray(batch))
 
         buckets = self.buckets or default_buckets(self.max_batch_size)
         self.batcher = DynamicBatcher(
@@ -233,7 +234,7 @@ class JaxServer(TPUComponent):
             # pre-compile every (bucket, dtype) pair so no request pays a trace
             for b in self.batcher.buckets:
                 for dt in self.warmup_dtypes:
-                    device_call(np.zeros((b, *self.input_shape), np.dtype(dt)))
+                    np.asarray(device_call(np.zeros((b, *self.input_shape), np.dtype(dt))))
         self._load_time_s = time.perf_counter() - t0
         self._loaded = True
         logger.info(
