@@ -1,0 +1,19 @@
+"""Reusable graph components: learned routers, outlier detectors.
+
+Importing registers them as declarative builtin implementations
+(reference analogue: components/ selected via image names; here via the
+implementation registry).
+"""
+
+from seldon_core_tpu.engine.units import register_implementation
+from seldon_core_tpu.components.routers import EpsilonGreedy, ThompsonSampling  # noqa: F401
+
+register_implementation("EPSILON_GREEDY", EpsilonGreedy)
+register_implementation("THOMPSON_SAMPLING", ThompsonSampling)
+
+try:  # detectors that need only numpy/jax register unconditionally
+    from seldon_core_tpu.components.outliers import MahalanobisDetector  # noqa: F401
+
+    register_implementation("OUTLIER_MAHALANOBIS", MahalanobisDetector)
+except ImportError:  # pragma: no cover
+    pass

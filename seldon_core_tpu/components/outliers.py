@@ -1,0 +1,122 @@
+"""Online outlier detection components.
+
+Dual-use components like the reference's detectors
+(reference: components/outlier-detection/mahalanobis/
+CoreMahalanobis.py:7-50): deployable as a MODEL (returns outlier
+scores) or as an input TRANSFORMER (passes data through unchanged while
+tagging outliers in ``meta.tags`` and counting them in custom metrics).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from seldon_core_tpu.runtime.component import TPUComponent, counter_metric, gauge_metric
+
+
+class MahalanobisDetector(TPUComponent):
+    """Online Mahalanobis-distance outlier scoring.
+
+    Maintains a running mean and covariance of the feature stream
+    (Welford-style updates) and scores each row by its Mahalanobis
+    distance to the current estimate.  Rows beyond ``threshold`` are
+    flagged.
+    """
+
+    def __init__(
+        self,
+        n_features: Optional[int] = None,
+        threshold: float = 25.0,
+        min_samples: int = 10,
+        regularisation: float = 1e-3,
+        **kwargs: Any,
+    ):
+        super().__init__(**kwargs)
+        self.threshold = float(threshold)
+        self.min_samples = int(min_samples)
+        self.reg = float(regularisation)
+        self._lock = threading.Lock()
+        self.n = 0
+        self.mean: Optional[np.ndarray] = None
+        self.m2: Optional[np.ndarray] = None  # sum of outer-product deviations
+        self._last_scores: np.ndarray = np.array([])
+        self._last_flags: np.ndarray = np.array([], dtype=bool)
+        self.total_outliers = 0
+        if n_features:
+            self._init_stats(int(n_features))
+
+    def _init_stats(self, d: int) -> None:
+        self.mean = np.zeros(d)
+        self.m2 = np.zeros((d, d))
+
+    def _update(self, X: np.ndarray) -> None:
+        for row in X:
+            self.n += 1
+            delta = row - self.mean
+            self.mean += delta / self.n
+            self.m2 += np.outer(delta, row - self.mean)
+
+    def score(self, X: np.ndarray) -> np.ndarray:
+        """Mahalanobis distance (squared) per row against current stats."""
+        X = np.atleast_2d(np.asarray(X, dtype=np.float64))
+        with self._lock:
+            if self.mean is None:
+                self._init_stats(X.shape[1])
+            if self.n < max(self.min_samples, 2):
+                scores = np.zeros(X.shape[0])
+            else:
+                cov = self.m2 / (self.n - 1) + self.reg * np.eye(X.shape[1])
+                inv = np.linalg.inv(cov)
+                diff = X - self.mean
+                scores = np.einsum("ij,jk,ik->i", diff, inv, diff)
+            self._update(X)
+            self._last_scores = scores
+            self._last_flags = scores > self.threshold
+            self.total_outliers += int(self._last_flags.sum())
+        return scores
+
+    # as a MODEL: return scores
+    def predict(self, X, names, meta=None):
+        return self.score(X).reshape(-1, 1)
+
+    # as an input TRANSFORMER: pass through, tag + count
+    def transform_input(self, X, names, meta=None):
+        self.score(X)
+        return X
+
+    def tags(self) -> Dict:
+        return {
+            "outlier": bool(self._last_flags.any()),
+            "outlier_count": int(self._last_flags.sum()),
+        }
+
+    def metrics(self) -> List[Dict]:
+        out = [gauge_metric("outlier_score_max", float(self._last_scores.max(initial=0.0)))]
+        flagged = int(self._last_flags.sum())
+        if flagged:
+            out.append(counter_metric("outliers_total", float(flagged)))
+        return out
+
+    def class_names(self):
+        return ["outlier_score"]
+
+    def checkpoint_state(self) -> Optional[Dict[str, Any]]:
+        with self._lock:
+            if self.mean is None:
+                return None
+            return {
+                "n": self.n,
+                "mean": self.mean.copy(),
+                "m2": self.m2.copy(),
+                "total_outliers": self.total_outliers,
+            }
+
+    def restore_state(self, state: Dict[str, Any]) -> None:
+        with self._lock:
+            self.n = int(state["n"])
+            self.mean = np.asarray(state["mean"], dtype=np.float64)
+            self.m2 = np.asarray(state["m2"], dtype=np.float64)
+            self.total_outliers = int(state.get("total_outliers", 0))

@@ -1,0 +1,197 @@
+"""MAB routers, outlier detectors, and the feedback learning loop
+end-to-end through the engine (reference: components/routers tests +
+the engine feedback call stack, SURVEY §3.3)."""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+import seldon_core_tpu.components  # noqa: F401 — registers implementations
+from seldon_core_tpu.components.outliers import MahalanobisDetector
+from seldon_core_tpu.components.routers import EpsilonGreedy, ThompsonSampling
+from seldon_core_tpu.engine import GraphExecutor, UnitSpec
+from seldon_core_tpu.runtime import InternalFeedback, InternalMessage, TPUComponent
+from seldon_core_tpu.utils.persistence import PersistenceManager
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def msg(arr):
+    return InternalMessage(payload=np.asarray(arr, dtype=np.float64), kind="tensor")
+
+
+class TestEpsilonGreedy:
+    def test_learns_best_branch(self):
+        mab = EpsilonGreedy(n_branches=3, epsilon=0.1, seed=0)
+        # branch 1 pays best
+        pay = [0.2, 0.9, 0.4]
+        rng = np.random.default_rng(0)
+        for _ in range(300):
+            b = mab.route(None, [])
+            reward = float(rng.random() < pay[b])
+            mab.send_feedback(None, [], reward, None, routing=b)
+        values = mab.branch_values()
+        assert int(np.argmax(values)) == 1
+        # exploit mode picks branch 1 overwhelmingly
+        picks = [mab.route(None, []) for _ in range(200)]
+        assert picks.count(1) > 150
+
+    def test_optimistic_exploration(self):
+        mab = EpsilonGreedy(n_branches=2, epsilon=0.0, seed=0)
+        first = mab.route(None, [])
+        mab.send_feedback(None, [], 1.0, None, routing=first)
+        # unexplored branch has infinite optimistic value -> tried next
+        assert mab.route(None, []) != first
+
+    def test_epsilon_decay(self):
+        mab = EpsilonGreedy(n_branches=2, epsilon=0.5, decay=0.5, seed=0)
+        mab.send_feedback(None, [], 1.0, None, routing=0)
+        mab.send_feedback(None, [], 1.0, None, routing=0)
+        assert mab.epsilon == pytest.approx(0.125)
+
+    def test_checkpoint_roundtrip(self, tmp_path):
+        mab = EpsilonGreedy(n_branches=2, seed=0)
+        for _ in range(10):
+            mab.send_feedback(None, [], 1.0, None, routing=1)
+        manager = PersistenceManager(str(tmp_path), "mab")
+        assert manager.save(mab)
+
+        fresh = EpsilonGreedy(n_branches=2, seed=0)
+        assert manager.restore(fresh)
+        np.testing.assert_array_equal(fresh.counts, mab.counts)
+        np.testing.assert_array_equal(fresh.reward_sums, mab.reward_sums)
+
+
+class TestThompsonSampling:
+    def test_converges_to_best(self):
+        ts = ThompsonSampling(n_branches=2, seed=1)
+        rng = np.random.default_rng(1)
+        pay = [0.3, 0.8]
+        for _ in range(400):
+            b = ts.route(None, [])
+            ts.send_feedback(None, [], float(rng.random() < pay[b]), None, routing=b)
+        picks = [ts.route(None, []) for _ in range(100)]
+        assert picks.count(1) > 80
+
+    def test_checkpoint_roundtrip(self):
+        ts = ThompsonSampling(n_branches=2, seed=0)
+        ts.send_feedback(None, [], 1.0, None, routing=0)
+        state = ts.checkpoint_state()
+        fresh = ThompsonSampling(n_branches=2, seed=0)
+        fresh.restore_state(state)
+        np.testing.assert_array_equal(fresh.alpha, ts.alpha)
+
+
+class TestMabThroughEngine:
+    def test_full_feedback_loop(self):
+        """MAB router in a live graph: predict -> feedback -> learn.
+        The reference's bandit demo (seldon-mab chart) as a unit test."""
+
+        class PayingModel(TPUComponent):
+            def __init__(self, value):
+                self.value = value
+
+            def predict(self, X, names, meta=None):
+                return np.array([[self.value]])
+
+        mab = EpsilonGreedy(n_branches=2, epsilon=0.2, seed=3)
+        g = UnitSpec(
+            name="mab",
+            type="ROUTER",
+            component=mab,
+            children=[
+                UnitSpec(name="bad", type="MODEL", component=PayingModel(0.1)),
+                UnitSpec(name="good", type="MODEL", component=PayingModel(0.9)),
+            ],
+        )
+        ex = GraphExecutor(g)
+
+        async def loop():
+            rng = np.random.default_rng(4)
+            for _ in range(150):
+                resp = await ex.predict(msg([[1.0]]))
+                value = float(np.asarray(resp.payload).ravel()[0])
+                reward = float(rng.random() < value)
+                fb = InternalFeedback(request=msg([[1.0]]), response=resp, reward=reward)
+                await ex.send_feedback(fb)
+            # after learning, most traffic goes to the good branch
+            routes = []
+            for _ in range(60):
+                resp = await ex.predict(msg([[1.0]]))
+                routes.append(resp.meta.routing["mab"])
+            return routes
+
+        routes = run(loop())
+        assert routes.count(1) > 40
+
+    def test_declarative_mab_graph(self):
+        g = UnitSpec.from_dict(
+            {
+                "name": "mab",
+                "type": "ROUTER",
+                "implementation": "EPSILON_GREEDY",
+                "parameters": [
+                    {"name": "n_branches", "value": "2", "type": "INT"},
+                    {"name": "epsilon", "value": "0.3", "type": "FLOAT"},
+                ],
+                "children": [
+                    {"name": "a", "type": "MODEL", "implementation": "SIMPLE_MODEL"},
+                    {"name": "b", "type": "MODEL", "implementation": "SIMPLE_MODEL"},
+                ],
+            }
+        )
+        ex = GraphExecutor(g)
+        out = run(ex.predict(msg([[1.0]])))
+        assert out.meta.routing["mab"] in (0, 1)
+
+
+class TestMahalanobis:
+    def test_scores_flag_outliers(self):
+        det = MahalanobisDetector(threshold=25.0, min_samples=20)
+        rng = np.random.default_rng(0)
+        normal = rng.normal(size=(200, 3))
+        det.score(normal)
+        outlier_scores = det.score(np.array([[50.0, 50.0, 50.0]]))
+        assert outlier_scores[0] > 25.0
+        assert det.tags()["outlier"] is True
+
+    def test_normal_data_not_flagged(self):
+        det = MahalanobisDetector(threshold=25.0, min_samples=20)
+        rng = np.random.default_rng(0)
+        det.score(rng.normal(size=(200, 3)))
+        det.score(rng.normal(size=(5, 3)))
+        assert det.tags()["outlier"] is False
+
+    def test_as_transformer_in_graph(self):
+        class Echo(TPUComponent):
+            def predict(self, X, names, meta=None):
+                return X
+
+        det = MahalanobisDetector(threshold=25.0, min_samples=5)
+        rng = np.random.default_rng(0)
+        det.score(rng.normal(size=(100, 2)))
+
+        g = UnitSpec(
+            name="od",
+            type="TRANSFORMER",
+            component=det,
+            children=[UnitSpec(name="m", type="MODEL", component=Echo())],
+        )
+        ex = GraphExecutor(g)
+        out = run(ex.predict(msg([[99.0, 99.0]])))
+        np.testing.assert_array_equal(out.payload, [[99.0, 99.0]])  # pass-through
+        assert out.meta.tags["outlier"] is True
+        assert any(m["key"] == "outliers_total" for m in out.meta.metrics)
+
+    def test_checkpoint_roundtrip(self):
+        det = MahalanobisDetector()
+        rng = np.random.default_rng(0)
+        det.score(rng.normal(size=(50, 2)))
+        state = det.checkpoint_state()
+        fresh = MahalanobisDetector()
+        fresh.restore_state(state)
+        assert fresh.n == det.n
+        np.testing.assert_allclose(fresh.mean, det.mean)
