@@ -158,8 +158,9 @@ class DynamicBatcher:
             t.join(timeout=5.0)
         self._finishers = []
 
-    def submit(self, x: np.ndarray, timeout_s: float = 30.0):
-        """Blocking submit of one request batch [rows, ...]; returns [rows, ...out]."""
+    def submit_future(self, x: np.ndarray) -> Future:
+        """Enqueue one request batch [rows, ...]; returns its Future
+        without blocking (async servers await it, no thread pinned)."""
         if not self._running:
             raise RuntimeError(f"batcher {self.name!r} not started")
         x = np.asarray(x)
@@ -167,7 +168,11 @@ class DynamicBatcher:
             raise ValueError("batcher input must have a leading batch dimension")
         item = _WorkItem(x=x, rows=x.shape[0], future=Future(), enqueued_at=time.perf_counter())
         self._queue.put(item)
-        return item.future.result(timeout=timeout_s)
+        return item.future
+
+    def submit(self, x: np.ndarray, timeout_s: float = 30.0):
+        """Blocking submit of one request batch [rows, ...]; returns [rows, ...out]."""
+        return self.submit_future(x).result(timeout=timeout_s)
 
     # ---------------------------------------------------------------- worker
 

@@ -70,7 +70,10 @@ def build_generation(spec: TpuDeployment, device_ids: Optional[List[int]] = None
     weighted: List[Tuple[PredictorService, float]] = []
     shadows: List[PredictorService] = []
     for p in spec.predictors:
-        svc = PredictorService(p.graph, name=p.name)
+        from seldon_core_tpu.utils.metrics import PrometheusObserver
+
+        observer = PrometheusObserver(deployment_name=spec.name, predictor_name=p.name)
+        svc = PredictorService(p.graph, name=p.name, observer=observer)
         if p.shadow:
             shadows.append(svc)
         else:

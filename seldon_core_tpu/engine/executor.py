@@ -129,6 +129,20 @@ class GraphExecutor:
         client = self.clients.get(name)
         return client.component if isinstance(client, LocalClient) else None
 
+    async def _timed(self, unit: UnitSpec, method: str, coro: Awaitable, puid: str):
+        """Time one node method call; emit a node_call event and a trace
+        span (the reference's engine->node client histograms + per-node
+        spans, reference: PredictiveUnitBean.java:77-78, analytics.md)."""
+        import time
+
+        from seldon_core_tpu.utils.tracing import maybe_span
+
+        start = time.perf_counter()
+        with maybe_span(f"node.{unit.name}.{method}", trace_id=puid, unit_type=unit.type):
+            result = await coro
+        self._emit("node_call", unit.name, (method, time.perf_counter() - start))
+        return result
+
     @staticmethod
     def _merge_meta(latest: InternalMessage, previous: List[InternalMessage], puid: str) -> None:
         """Reference mergeMeta: keep puid, union tags with latest-wins,
@@ -201,7 +215,7 @@ class GraphExecutor:
 
         # 1. input transform (a MODEL's predict)
         if unit.has_method(TRANSFORM_INPUT):
-            transformed = await client.transform_input(msg)
+            transformed = await self._timed(unit, "transform_input", client.transform_input(msg), puid)
             self._collect_metrics(transformed, unit, metrics)
             self._merge_meta(transformed, [msg], puid)
         else:
@@ -214,7 +228,7 @@ class GraphExecutor:
 
         # 3. routing
         if unit.has_method(ROUTE):
-            routing_msg = await client.route(transformed)
+            routing_msg = await self._timed(unit, "route", client.route(transformed), puid)
             self._collect_metrics(routing_msg, unit, metrics)
             branch = self._branch_index(routing_msg, unit)
         else:
@@ -234,7 +248,7 @@ class GraphExecutor:
 
         # 5. aggregation
         if unit.has_method(AGGREGATE):
-            aggregated = await client.aggregate(child_outputs)
+            aggregated = await self._timed(unit, "aggregate", client.aggregate(child_outputs), puid)
         else:
             if len(child_outputs) != 1:
                 raise MicroserviceError(
@@ -249,7 +263,7 @@ class GraphExecutor:
 
         # 6. output transform
         if unit.has_method(TRANSFORM_OUTPUT):
-            out = await client.transform_output(aggregated)
+            out = await self._timed(unit, "transform_output", client.transform_output(aggregated), puid)
             self._collect_metrics(out, unit, metrics)
             self._merge_meta(out, [aggregated], puid)
         else:

@@ -110,7 +110,10 @@ class PredictorService:
             self.stats["requests"] += 1
             if self.log_requests:
                 logger.info("request puid=%s payload_kind=%s", puid, request.kind)
-            response = await self.executor.predict(request)
+            from seldon_core_tpu.utils.tracing import maybe_span
+
+            with maybe_span("predictor.predict", trace_id=puid, predictor=self.name):
+                response = await self.executor.predict(request)
             if response.status is None:
                 response.status = {"status": "SUCCESS", "code": 200}
             if self.log_responses:
@@ -130,8 +133,7 @@ class PredictorService:
             if self._inflight == 0:
                 self._inflight_zero.set()
             elapsed = time.perf_counter() - start
-            if self.executor.observer:
-                self.executor.observer("predict_done", self.name, elapsed)
+            self.executor._emit("predict_done", self.name, elapsed)
 
     async def send_feedback(self, feedback: InternalFeedback) -> InternalMessage:
         try:

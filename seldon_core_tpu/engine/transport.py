@@ -73,13 +73,15 @@ class LocalClient(NodeClient):
         self.component = component
 
     async def _run(self, fn, *args):
-        return await asyncio.to_thread(fn, *args)
+        from seldon_core_tpu.runtime.executor_pool import run_dispatch
+
+        return await run_dispatch(fn, *args)
 
     async def transform_input(self, msg: InternalMessage) -> InternalMessage:
         # A MODEL node's input transform IS its predict
         # (reference: InternalPredictionService.java transformInput routing).
         if self.unit.type == MODEL:
-            return await self._run(dispatch.predict, self.component, msg)
+            return await dispatch.predict_async(self.component, msg)
         return await self._run(dispatch.transform_input, self.component, msg)
 
     async def transform_output(self, msg: InternalMessage) -> InternalMessage:

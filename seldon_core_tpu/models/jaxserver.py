@@ -252,7 +252,7 @@ class JaxServer(TPUComponent):
 
     # -------------------------------------------------------------- serving
 
-    def predict(self, X, names, meta=None):
+    def _prepare(self, X):
         if not self._loaded:
             self.load()
         arr = np.asarray(X)
@@ -270,7 +270,21 @@ class JaxServer(TPUComponent):
                 status_code=400,
                 reason="BAD_INPUT_SHAPE",
             )
+        return arr, squeeze
+
+    def predict(self, X, names, meta=None):
+        arr, squeeze = self._prepare(X)
         out = self.batcher.submit(arr)
+        return out[0] if squeeze else out
+
+    async def predict_async(self, X, names, meta=None):
+        """Async fast path: awaits the batch future without pinning a
+        dispatch thread — the engine's LocalClient prefers this, so an
+        arbitrary number of requests can ride the batcher concurrently."""
+        import asyncio
+
+        arr, squeeze = self._prepare(X)
+        out = await asyncio.wrap_future(self.batcher.submit_future(arr))
         return out[0] if squeeze else out
 
     def class_names(self):

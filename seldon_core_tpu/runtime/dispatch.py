@@ -80,6 +80,20 @@ def predict(user_model: Any, msg: InternalMessage) -> InternalMessage:
     return _construct_response(user_model, msg, result)
 
 
+async def predict_async(user_model: Any, msg: InternalMessage) -> InternalMessage:
+    """Async-native predict: awaits a component's ``predict_async`` if it
+    has one (e.g. JaxServer's batcher-backed path), else falls back to
+    the sync dispatch on the shared pool."""
+    fn = getattr(user_model, "predict_async", None)
+    if fn is None or hasattr(user_model, "predict_raw"):
+        from seldon_core_tpu.runtime.executor_pool import run_dispatch
+
+        return await run_dispatch(predict, user_model, msg)
+    features = _features_for(user_model, msg)
+    result = await fn(features, msg.names, meta=msg.meta.to_dict())
+    return _construct_response(user_model, msg, result)
+
+
 def transform_input(user_model: Any, msg: InternalMessage) -> InternalMessage:
     raw = _try_raw(user_model, "transform_input_raw", msg)
     if raw is not None:

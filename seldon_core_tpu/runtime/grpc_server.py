@@ -27,16 +27,21 @@ DEFAULT_MAX_MSG_BYTES = 512 * 1024 * 1024
 
 def _wrap_unary(user_model: Any, fn, unit_id: str = ""):
     async def handler(request, context):
+        from seldon_core_tpu.runtime.executor_pool import run_dispatch
+
         try:
             if isinstance(request, pb.Feedback):
                 arg = InternalFeedback.from_proto(request)
-                out = await asyncio.to_thread(fn, user_model, arg, unit_id)
+                out = await run_dispatch(fn, user_model, arg, unit_id)
             elif isinstance(request, pb.SeldonMessageList):
                 msgs = [InternalMessage.from_proto(m) for m in request.seldonMessages]
-                out = await asyncio.to_thread(fn, user_model, msgs)
+                out = await run_dispatch(fn, user_model, msgs)
             else:
                 msg = InternalMessage.from_proto(request)
-                out = await asyncio.to_thread(fn, user_model, msg)
+                if fn is dispatch.predict:  # async fast path for batched models
+                    out = await dispatch.predict_async(user_model, msg)
+                else:
+                    out = await run_dispatch(fn, user_model, msg)
             return out.to_proto()
         except MicroserviceError as e:
             resp = pb.SeldonMessage()

@@ -23,6 +23,7 @@ from aiohttp import web
 
 from seldon_core_tpu.runtime import dispatch
 from seldon_core_tpu.runtime.component import MicroserviceError
+from seldon_core_tpu.runtime.executor_pool import run_dispatch
 from seldon_core_tpu.runtime.message import InternalFeedback, InternalMessage
 
 logger = logging.getLogger(__name__)
@@ -66,7 +67,10 @@ def _message_endpoint(user_model: Any, fn: Callable) -> Callable:
         try:
             body = await _request_body(request)
             msg = InternalMessage.from_json(body)
-            out = await asyncio.to_thread(fn, user_model, msg)
+            if fn is dispatch.predict:  # async fast path for batched models
+                out = await dispatch.predict_async(user_model, msg)
+            else:
+                out = await run_dispatch(fn, user_model, msg)
             return web.json_response(out.to_json())
         except Exception as e:  # noqa: BLE001 — every error must map to a Status
             return _error_response(e)
@@ -86,7 +90,7 @@ def build_app(
             body = await _request_body(request)
             raw_list = body.get("seldonMessages", body if isinstance(body, list) else [])
             msgs = [InternalMessage.from_json(b) for b in raw_list]
-            out = await asyncio.to_thread(dispatch.aggregate, user_model, msgs)
+            out = await run_dispatch(dispatch.aggregate, user_model, msgs)
             return web.json_response(out.to_json())
         except Exception as e:  # noqa: BLE001
             return _error_response(e)
@@ -95,7 +99,7 @@ def build_app(
         try:
             body = await _request_body(request)
             fb = InternalFeedback.from_json(body)
-            out = await asyncio.to_thread(dispatch.send_feedback, user_model, fb, unit_id)
+            out = await run_dispatch(dispatch.send_feedback, user_model, fb, unit_id)
             return web.json_response(out.to_json())
         except Exception as e:  # noqa: BLE001
             return _error_response(e)
@@ -105,7 +109,7 @@ def build_app(
 
     async def status(_request: web.Request) -> web.Response:
         try:
-            out = await asyncio.to_thread(dispatch.health_check, user_model)
+            out = await run_dispatch(dispatch.health_check, user_model)
             return web.json_response(out.to_json())
         except Exception as e:  # noqa: BLE001
             return _error_response(e)

@@ -1,0 +1,152 @@
+"""Prometheus metrics with the reference's canonical names.
+
+The reference engine exposes micrometer histograms
+``seldon_api_engine_server_requests_duration_seconds`` /
+``..._client_requests_duration_seconds``, feedback counters
+``seldon_api_model_feedback(_reward)``, and re-registers node custom
+metrics with deployment/predictor/model tags
+(reference: doc/source/analytics/analytics.md:9-16,
+PredictiveUnitBean.java:323-357, metrics/CustomMetricsManager.java).
+Same names and tag semantics here on prometheus_client, so the
+reference's Grafana dashboards work against a TPU deployment unchanged.
+
+``PrometheusObserver`` plugs into the engine's observer hook; metric
+objects are created lazily and cached by (name, labelnames) since user
+metric tag sets are dynamic.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Any, Dict, Iterable, Optional, Tuple
+
+logger = logging.getLogger(__name__)
+
+_BUCKETS = (
+    0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+)
+
+
+class _MetricCache:
+    """Lazily-created prometheus metrics keyed by (kind, name, labels)."""
+
+    def __init__(self, registry=None):
+        import prometheus_client as prom
+
+        self._prom = prom
+        self.registry = registry if registry is not None else prom.REGISTRY
+        self._cache: Dict[Tuple[str, str, Tuple[str, ...]], Any] = {}
+        self._lock = threading.Lock()
+
+    def get(self, kind: str, name: str, labelnames: Tuple[str, ...], documentation: str = ""):
+        key = (kind, name, labelnames)
+        with self._lock:
+            metric = self._cache.get(key)
+            if metric is None:
+                cls = {
+                    "counter": self._prom.Counter,
+                    "gauge": self._prom.Gauge,
+                    "histogram": self._prom.Histogram,
+                }[kind]
+                kwargs = {"labelnames": labelnames, "registry": self.registry}
+                if kind == "histogram":
+                    kwargs["buckets"] = _BUCKETS
+                metric = cls(name, documentation or name, **kwargs)
+                self._cache[key] = metric
+        return metric
+
+
+class PrometheusObserver:
+    """Engine observer -> prometheus.
+
+    Handles the executor/service event stream:
+      * ``predict_done`` (payload: seconds) -> server request histogram
+      * ``node_metrics`` (payload: list of metric dicts) -> custom
+        counters/gauges/timers tagged deployment/predictor/model
+      * ``node_feedback`` (payload: reward) -> feedback counters
+    """
+
+    def __init__(
+        self,
+        deployment_name: str = "",
+        predictor_name: str = "",
+        registry=None,
+    ):
+        self.deployment_name = deployment_name
+        self.predictor_name = predictor_name
+        self._cache = _MetricCache(registry)
+
+    # ---- base tags --------------------------------------------------------
+
+    def _model_labels(self, unit: str) -> Dict[str, str]:
+        return {
+            "deployment_name": self.deployment_name,
+            "predictor_name": self.predictor_name,
+            "model_name": unit,
+        }
+
+    # ---- observer protocol -----------------------------------------------
+
+    def __call__(self, event: str, unit: str, payload: Any) -> None:
+        try:
+            if event == "predict_done":
+                self.observe_api("predictions", float(payload))
+            elif event == "node_call":
+                method, seconds = payload
+                self.observe_node_call(unit, method, float(seconds))
+            elif event == "node_metrics":
+                for metric in payload or []:
+                    self._apply_custom(unit, metric)
+            elif event == "node_feedback":
+                labels = self._model_labels(unit)
+                names = tuple(sorted(labels))
+                self._cache.get("counter", "seldon_api_model_feedback", names).labels(
+                    **labels
+                ).inc()
+                self._cache.get("counter", "seldon_api_model_feedback_reward", names).labels(
+                    **labels
+                ).inc(float(payload or 0.0))
+        except Exception:  # observers must never break the data plane
+            logger.exception("metrics observer failed for %s/%s", event, unit)
+
+    def observe_api(self, method: str, seconds: float, code: str = "200") -> None:
+        labels = {
+            "deployment_name": self.deployment_name,
+            "predictor_name": self.predictor_name,
+            "method": method,
+            "code": code,
+        }
+        hist = self._cache.get(
+            "histogram",
+            "seldon_api_engine_server_requests_duration_seconds",
+            tuple(sorted(labels)),
+            "external API request latency",
+        )
+        hist.labels(**labels).observe(seconds)
+
+    def observe_node_call(self, unit: str, method: str, seconds: float) -> None:
+        labels = dict(self._model_labels(unit), method=method)
+        hist = self._cache.get(
+            "histogram",
+            "seldon_api_engine_client_requests_duration_seconds",
+            tuple(sorted(labels)),
+            "engine->node call latency",
+        )
+        hist.labels(**labels).observe(seconds)
+
+    def _apply_custom(self, unit: str, metric: Dict[str, Any]) -> None:
+        key = metric.get("key")
+        if not key:
+            return
+        labels = self._model_labels(unit)
+        labels.update({str(k): str(v) for k, v in (metric.get("tags") or {}).items()})
+        names = tuple(sorted(labels))
+        value = float(metric.get("value", 0.0))
+        mtype = metric.get("type", "COUNTER")
+        if mtype == "COUNTER":
+            self._cache.get("counter", key, names).labels(**labels).inc(value)
+        elif mtype == "GAUGE":
+            self._cache.get("gauge", key, names).labels(**labels).set(value)
+        elif mtype == "TIMER":  # milliseconds, like the reference
+            self._cache.get("histogram", key, names).labels(**labels).observe(value / 1000.0)

@@ -1,0 +1,93 @@
+"""Request/response pair logging.
+
+The reference engine optionally POSTs each request/response pair with
+CloudEvents-style headers to a logging service which indexes them into
+Elasticsearch (reference: PredictionService.java:169-202
+sendMessagePairAsJson, seldon-request-logger/app/app.py:15-60).
+
+Here the pair sink is pluggable:
+
+* ``JsonlPairLogger`` — append one JSON object per pair to a local
+  file (rotatable, ship-anywhere);
+* ``HttpPairLogger`` — POST pairs with the same CloudEvents headers
+  (``CE-Type: seldon.message.pair``) to any collector, buffered and
+  fire-and-forget so the data plane never blocks on logging.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import queue
+import threading
+import time
+from typing import Any, Dict, Optional
+
+from seldon_core_tpu.runtime.message import InternalMessage
+
+logger = logging.getLogger(__name__)
+
+CE_HEADERS = {
+    "CE-SpecVersion": "0.2",
+    "CE-Source": "seldon-core-tpu",
+    "CE-Type": "seldon.message.pair",
+}
+
+
+def build_pair(request: InternalMessage, response: InternalMessage) -> Dict[str, Any]:
+    return {
+        "request": request.to_json(),
+        "response": response.to_json(),
+        "puid": response.meta.puid or request.meta.puid,
+        "time": time.time(),
+    }
+
+
+class JsonlPairLogger:
+    """Append pairs to a JSON-lines file (thread-safe)."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._lock = threading.Lock()
+
+    def __call__(self, request: InternalMessage, response: InternalMessage) -> None:
+        pair = build_pair(request, response)
+        with self._lock:
+            with open(self.path, "a") as f:
+                f.write(json.dumps(pair) + "\n")
+
+
+class HttpPairLogger:
+    """Buffered background POST of pairs (CloudEvents headers)."""
+
+    def __init__(self, url: str, capacity: int = 1024, timeout_s: float = 2.0):
+        self.url = url
+        self.timeout_s = timeout_s
+        self._queue: "queue.Queue[Optional[Dict]]" = queue.Queue(maxsize=capacity)
+        self._thread = threading.Thread(target=self._drain, daemon=True, name="seldon-tpu-reqlog")
+        self._thread.start()
+        self.dropped = 0
+
+    def __call__(self, request: InternalMessage, response: InternalMessage) -> None:
+        try:
+            self._queue.put_nowait(build_pair(request, response))
+        except queue.Full:  # never block the data plane on the logger
+            self.dropped += 1
+
+    def _drain(self) -> None:
+        import requests
+
+        while True:
+            pair = self._queue.get()
+            if pair is None:
+                return
+            try:
+                headers = dict(CE_HEADERS)
+                headers["CE-Time"] = str(pair["time"])
+                requests.post(self.url, json=pair, headers=headers, timeout=self.timeout_s)
+            except Exception as e:  # noqa: BLE001
+                logger.warning("request logger POST failed: %s", e)
+
+    def close(self) -> None:
+        self._queue.put(None)
+        self._thread.join(timeout=5.0)

@@ -1,0 +1,150 @@
+"""Observability tests: prometheus metric names/tags, tracing spans,
+request-pair logging (reference: analytics.md:9-16 metric contract,
+PredictionService.java:169-202 pair format)."""
+
+import asyncio
+import json
+
+import numpy as np
+import pytest
+from prometheus_client import CollectorRegistry
+
+from seldon_core_tpu.engine import PredictorService, UnitSpec
+from seldon_core_tpu.runtime import InternalFeedback, InternalMessage, TPUComponent
+from seldon_core_tpu.utils.metrics import PrometheusObserver
+from seldon_core_tpu.utils.reqlogger import JsonlPairLogger
+from seldon_core_tpu.utils import tracing
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def msg(arr):
+    return InternalMessage(payload=np.asarray(arr, dtype=np.float64), kind="tensor")
+
+
+class MetricModel(TPUComponent):
+    def predict(self, X, names, meta=None):
+        return np.asarray(X) * 2
+
+    def metrics(self):
+        return [
+            {"key": "my_counter", "type": "COUNTER", "value": 2.0},
+            {"key": "my_gauge", "type": "GAUGE", "value": 7.5, "tags": {"stage": "test"}},
+            {"key": "my_timer", "type": "TIMER", "value": 120.0},
+        ]
+
+    def send_feedback(self, features, names, reward, truth, routing=None):
+        return None
+
+
+def sample(registry, name, labels):
+    return registry.get_sample_value(name, labels)
+
+
+class TestPrometheus:
+    def test_reference_metric_names_and_tags(self):
+        registry = CollectorRegistry()
+        obs = PrometheusObserver("dep1", "pred1", registry=registry)
+        svc = PredictorService(
+            UnitSpec(name="m", type="MODEL", component=MetricModel()),
+            name="pred1",
+            observer=obs,
+        )
+        out = run(svc.predict(msg([[1.0]])))
+        assert out.status["status"] == "SUCCESS"
+
+        base = {"deployment_name": "dep1", "predictor_name": "pred1", "model_name": "m"}
+        # custom metrics with the reference's deployment/predictor/model tags
+        assert sample(registry, "my_counter_total", base) == 2.0
+        assert sample(registry, "my_gauge", dict(base, stage="test")) == 7.5
+        assert sample(registry, "my_timer_count", base) == 1.0
+        # engine server histogram
+        assert (
+            sample(
+                registry,
+                "seldon_api_engine_server_requests_duration_seconds_count",
+                {"deployment_name": "dep1", "predictor_name": "pred1", "method": "predictions", "code": "200"},
+            )
+            == 1.0
+        )
+        # engine->node client histogram
+        assert (
+            sample(
+                registry,
+                "seldon_api_engine_client_requests_duration_seconds_count",
+                dict(base, method="transform_input"),
+            )
+            == 1.0
+        )
+
+    def test_feedback_counters(self):
+        registry = CollectorRegistry()
+        obs = PrometheusObserver("dep1", "pred1", registry=registry)
+        svc = PredictorService(
+            UnitSpec(name="m", type="MODEL", component=MetricModel()),
+            observer=obs,
+        )
+        resp = run(svc.predict(msg([[1.0]])))
+        fb = InternalFeedback(request=msg([[1.0]]), response=resp, reward=0.8)
+        run(svc.send_feedback(fb))
+        base = {"deployment_name": "dep1", "predictor_name": "pred1", "model_name": "m"}
+        assert sample(registry, "seldon_api_model_feedback_total", base) == 1.0
+        assert sample(registry, "seldon_api_model_feedback_reward_total", base) == pytest.approx(0.8)
+
+    def test_observer_errors_never_break_data_plane(self):
+        def exploding_observer(event, unit, payload):
+            raise RuntimeError("observer bug")
+
+        svc = PredictorService(
+            UnitSpec(name="m", type="MODEL", component=MetricModel()),
+            observer=exploding_observer,
+        )
+        out = run(svc.predict(msg([[1.0]])))
+        assert out.status["status"] == "SUCCESS"
+
+
+class TestTracing:
+    def test_spans_per_request_and_node(self):
+        tracer = tracing.setup_tracing("test-svc")
+        try:
+            svc = PredictorService(UnitSpec(name="m", type="MODEL", component=MetricModel()))
+            out = run(svc.predict(msg([[1.0]])))
+            puid = out.meta.puid
+            spans = tracer.find(puid)
+            names = {s.name for s in spans}
+            assert "predictor.predict" in names
+            assert "node.m.transform_input" in names
+            for s in spans:
+                assert s.duration_s >= 0
+        finally:
+            tracing._tracer = None
+
+    def test_jsonl_export(self, tmp_path):
+        path = str(tmp_path / "spans.jsonl")
+        tracer = tracing.setup_tracing("test-svc", export_path=path)
+        try:
+            with tracer.span("op", trace_id="t1", foo="bar"):
+                pass
+            lines = [json.loads(l) for l in open(path)]
+            assert lines[0]["traceId"] == "t1"
+            assert lines[0]["tags"]["foo"] == "bar"
+        finally:
+            tracer.close()
+            tracing._tracer = None
+
+
+class TestRequestLogger:
+    def test_pair_logged(self, tmp_path):
+        path = str(tmp_path / "pairs.jsonl")
+        svc = PredictorService(
+            UnitSpec(name="m", type="MODEL", component=MetricModel()),
+            request_logger=JsonlPairLogger(path),
+        )
+        run(svc.predict(msg([[3.0]])))
+        pairs = [json.loads(l) for l in open(path)]
+        assert len(pairs) == 1
+        assert pairs[0]["request"]["data"]["tensor"]["values"] == [3.0]
+        assert pairs[0]["response"]["data"]["tensor"]["values"] == [6.0]
+        assert pairs[0]["puid"]
