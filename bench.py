@@ -79,7 +79,8 @@ def build_gateway():
     return Gateway([(svc, 1.0)]), server, shape
 
 
-def grpc_worker(port: int, shape, stop_at: float, latencies: list, errors: list):
+def grpc_worker(port: int, shape, stop_at: float, latencies: list, errors: list,
+                client_batch: int = 1):
     """One sync-client thread: tight request loop until the deadline."""
     import grpc
 
@@ -88,10 +89,10 @@ def grpc_worker(port: int, shape, stop_at: float, latencies: list, errors: list)
     channel = grpc.insecure_channel(f"127.0.0.1:{port}")
     predict = services.unary_callable(channel, "Seldon", "Predict")
     img = (np.random.default_rng(threading.get_ident() % 2**31).integers(
-        0, 255, size=(1, *shape), dtype=np.uint8))
+        0, 255, size=(client_batch, *shape), dtype=np.uint8))
     req = pb.SeldonMessage()
     req.data.rawTensor.dtype = "uint8"
-    req.data.rawTensor.shape.extend([1, *shape])
+    req.data.rawTensor.shape.extend([client_batch, *shape])
     req.data.rawTensor.data = img.tobytes()
     mine: list = []
     while time.perf_counter() < stop_at:
@@ -106,6 +107,23 @@ def grpc_worker(port: int, shape, stop_at: float, latencies: list, errors: list)
             errors.append(str(e))
     latencies.extend(mine)
     channel.close()
+
+
+async def measure_phase(port: int, shape, seconds: float, concurrency: int, client_batch: int = 1):
+    latencies: list = []
+    errors: list = []
+    stop_at = time.perf_counter() + seconds
+    loop = asyncio.get_running_loop()
+    with ThreadPoolExecutor(max_workers=concurrency) as pool:
+        tasks = [
+            loop.run_in_executor(
+                pool, grpc_worker, port, shape, stop_at, latencies, errors, client_batch
+            )
+            for _ in range(concurrency)
+        ]
+        await asyncio.gather(*tasks)
+    latencies.sort()
+    return latencies, errors
 
 
 async def stub_dataplane_qps(seconds: float = 2.0) -> float:
@@ -153,32 +171,26 @@ async def main() -> None:
     await grpc_server.start()
     setup_s = time.perf_counter() - t_setup
 
-    # ---- measured window -------------------------------------------------
-    latencies: list = []
-    errors: list = []
-    stop_at = time.perf_counter() + SECONDS
-    loop = asyncio.get_running_loop()
-    with ThreadPoolExecutor(max_workers=CONCURRENCY) as pool:
-        tasks = [
-            loop.run_in_executor(pool, grpc_worker, port, shape, stop_at, latencies, errors)
-            for _ in range(CONCURRENCY)
-        ]
-        await asyncio.gather(*tasks)
+    # ---- phase 1: latency (low concurrency, batch-1 requests) ------------
+    lat_conc = int(os.environ.get("BENCH_LAT_CONCURRENCY", "4"))
+    lat, lat_errors = await measure_phase(port, shape, SECONDS, lat_conc, client_batch=1)
+
+    # ---- phase 2: throughput (high concurrency, batched requests) --------
+    tput_batch = int(os.environ.get("BENCH_CLIENT_BATCH", "16"))
+    tput, tput_errors = await measure_phase(port, shape, SECONDS, CONCURRENCY, client_batch=tput_batch)
 
     await grpc_server.stop(grace=None)
 
     stub_qps = await stub_dataplane_qps(2.0)
     server.unload()
 
-    if not latencies:
+    if not lat:
         print(json.dumps({"metric": "resnet50_grpc_p50_ms", "value": None, "unit": "ms",
-                          "vs_baseline": 0.0, "extra": {"errors": errors[:5]}}))
+                          "vs_baseline": 0.0, "extra": {"errors": (lat_errors + tput_errors)[:5]}}))
         return
 
-    latencies.sort()
-    p50 = statistics.median(latencies)
-    p99 = latencies[min(len(latencies) - 1, int(len(latencies) * 0.99))]
-    qps = len(latencies) / SECONDS
+    p50 = statistics.median(lat)
+    images_per_s = len(tput) * tput_batch / SECONDS
     result = {
         "metric": "resnet50_grpc_p50_ms" if MODEL == "resnet50" else f"{MODEL}_grpc_p50_ms",
         "value": round(p50, 3),
@@ -187,13 +199,23 @@ async def main() -> None:
         "extra": {
             "model": MODEL,
             "device": str(jax.devices()[0]),
-            "qps": round(qps, 1),
-            "p90_ms": round(latencies[int(len(latencies) * 0.90)], 3),
-            "p99_ms": round(p99, 3),
-            "mean_ms": round(statistics.fmean(latencies), 3),
-            "requests": len(latencies),
-            "errors": len(errors),
-            "concurrency": CONCURRENCY,
+            "latency_phase": {
+                "concurrency": lat_conc,
+                "qps": round(len(lat) / SECONDS, 1),
+                "p50_ms": round(p50, 3),
+                "p90_ms": round(lat[int(len(lat) * 0.90)], 3),
+                "p99_ms": round(lat[min(len(lat) - 1, int(len(lat) * 0.99))], 3),
+                "mean_ms": round(statistics.fmean(lat), 3),
+                "errors": len(lat_errors),
+            },
+            "throughput_phase": {
+                "concurrency": CONCURRENCY,
+                "client_batch": tput_batch,
+                "images_per_s": round(images_per_s, 1),
+                "requests_per_s": round(len(tput) / SECONDS, 1),
+                "p50_ms": round(statistics.median(tput), 3) if tput else None,
+                "errors": len(tput_errors),
+            },
             "mean_batch_rows": round(server.batcher.stats.mean_batch_rows, 2),
             "device_batches": server.batcher.stats.batches,
             "stub_engine_qps": round(stub_qps, 1),

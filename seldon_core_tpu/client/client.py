@@ -1,0 +1,213 @@
+"""Client SDK.
+
+Equivalent of the reference's ``SeldonClient``
+(reference: python/seldon_core/seldon_client.py:147-795): one object
+that can talk to a deployment's gateway or directly to a node
+microservice, over REST or gRPC, with payload construction helpers and
+random-payload generation by shape for smoke tests.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+from seldon_core_tpu.runtime.message import InternalFeedback, InternalMessage
+
+
+@dataclasses.dataclass
+class ClientResponse:
+    success: bool
+    response: Optional[InternalMessage]
+    raw: Any = None  # dict (REST) or proto (gRPC)
+
+    @property
+    def data(self):
+        return self.response.payload if self.response is not None else None
+
+    @property
+    def meta(self):
+        return self.response.meta if self.response is not None else None
+
+
+def random_payload(shape: Sequence[int] = (1, 4), dtype: str = "float64") -> np.ndarray:
+    """Random request payload by shape (reference: seldon_client.py
+    random ndarray generation)."""
+    rng = np.random.default_rng()
+    if np.dtype(dtype).kind == "u" or np.dtype(dtype).kind == "i":
+        return rng.integers(0, 255, size=tuple(shape)).astype(dtype)
+    return rng.normal(size=tuple(shape)).astype(dtype)
+
+
+class SeldonTpuClient:
+    """Talk to a gateway (external API) or a node microservice."""
+
+    def __init__(
+        self,
+        host: str = "127.0.0.1",
+        http_port: int = 8000,
+        grpc_port: int = 5001,
+        transport: str = "rest",  # rest | grpc
+        timeout_s: float = 30.0,
+    ):
+        if transport not in ("rest", "grpc"):
+            raise ValueError("transport must be 'rest' or 'grpc'")
+        self.host = host
+        self.http_port = http_port
+        self.grpc_port = grpc_port
+        self.transport = transport
+        self.timeout_s = timeout_s
+        self._channel = None
+        self._session = None
+
+    # ------------------------------------------------------------- internals
+
+    def _grpc_call(self, service: str, method: str, request_proto):
+        import grpc
+
+        from seldon_core_tpu.proto import services
+
+        if self._channel is None:
+            self._channel = grpc.insecure_channel(f"{self.host}:{self.grpc_port}")
+        call = services.unary_callable(self._channel, service, method)
+        return call(request_proto, timeout=self.timeout_s)
+
+    def _rest_post(self, path: str, body: Dict[str, Any]) -> Tuple[int, Dict[str, Any]]:
+        import requests
+
+        if self._session is None:
+            self._session = requests.Session()
+        resp = self._session.post(
+            f"http://{self.host}:{self.http_port}{path}", json=body, timeout=self.timeout_s
+        )
+        try:
+            return resp.status_code, resp.json()
+        except ValueError:
+            return resp.status_code, {"status": {"status": "FAILURE", "info": resp.text}}
+
+    @staticmethod
+    def _build_message(
+        data: Any = None,
+        names: Optional[List[str]] = None,
+        payload_kind: Optional[str] = None,
+        meta: Optional[Dict[str, Any]] = None,
+    ) -> InternalMessage:
+        if isinstance(data, InternalMessage):
+            return data
+        if isinstance(data, bytes):
+            kind = "binData"
+        elif isinstance(data, str):
+            kind = "strData"
+        elif isinstance(data, dict):
+            kind = "jsonData"
+        else:
+            data = np.asarray(data if data is not None else random_payload())
+            kind = payload_kind or ("tensor" if data.dtype == np.float64 else "rawTensor")
+        msg = InternalMessage(payload=data, names=list(names or []), kind=kind)
+        if meta:
+            from seldon_core_tpu.runtime.message import MsgMeta
+
+            msg.meta = MsgMeta.from_dict(meta)
+        return msg
+
+    @staticmethod
+    def _success(resp_msg: InternalMessage) -> bool:
+        status = resp_msg.status or {}
+        return status.get("status", "SUCCESS") != "FAILURE"
+
+    # --------------------------------------------------------------- predict
+
+    def predict(
+        self,
+        data: Any = None,
+        names: Optional[List[str]] = None,
+        payload_kind: Optional[str] = None,
+        meta: Optional[Dict[str, Any]] = None,
+        predictor: Optional[str] = None,
+    ) -> ClientResponse:
+        msg = self._build_message(data, names, payload_kind, meta)
+        if self.transport == "grpc":
+            proto = self._grpc_call("Seldon", "Predict", msg.to_proto())
+            out = InternalMessage.from_proto(proto)
+            return ClientResponse(self._success(out), out, proto)
+        path = "/api/v0.1/predictions"
+        if predictor:
+            path += f"?predictor={predictor}"
+        code, body = self._rest_post(path, msg.to_json())
+        out = InternalMessage.from_json(body) if ("data" in body or "binData" in body or
+                                                  "strData" in body or "jsonData" in body) else \
+            InternalMessage(kind="jsonData", status=body.get("status"))
+        return ClientResponse(code < 400 and self._success(out), out, body)
+
+    def feedback(
+        self,
+        request: Optional[Union[InternalMessage, Any]] = None,
+        response: Optional[Union[InternalMessage, Any]] = None,
+        reward: float = 0.0,
+        truth: Any = None,
+    ) -> ClientResponse:
+        fb = InternalFeedback(
+            request=self._build_message(request) if request is not None else None,
+            response=response if isinstance(response, InternalMessage) else (
+                self._build_message(response) if response is not None else None
+            ),
+            reward=float(reward),
+            truth=self._build_message(truth) if truth is not None else None,
+        )
+        if self.transport == "grpc":
+            proto = self._grpc_call("Seldon", "SendFeedback", fb.to_proto())
+            out = InternalMessage.from_proto(proto)
+            return ClientResponse(self._success(out), out, proto)
+        code, body = self._rest_post("/api/v0.1/feedback", fb.to_json())
+        out = InternalMessage(kind="jsonData", status=body.get("status"))
+        return ClientResponse(code < 400, out, body)
+
+    # ------------------------------------------- direct node microservice API
+
+    def microservice(
+        self,
+        method: str = "predict",
+        data: Any = None,
+        names: Optional[List[str]] = None,
+        payload_kind: Optional[str] = None,
+    ) -> ClientResponse:
+        """Call a node microservice endpoint directly (the reference's
+        'microservice' gateway mode)."""
+        msg = self._build_message(data, names, payload_kind)
+        if self.transport == "grpc":
+            service, rpc = {
+                "predict": ("Model", "Predict"),
+                "transform-input": ("Transformer", "TransformInput"),
+                "transform-output": ("OutputTransformer", "TransformOutput"),
+                "route": ("Router", "Route"),
+            }[method]
+            proto = self._grpc_call(service, rpc, msg.to_proto())
+            out = InternalMessage.from_proto(proto)
+            return ClientResponse(self._success(out), out, proto)
+        code, body = self._rest_post(f"/{method}", msg.to_json())
+        out = InternalMessage.from_json(body) if code < 400 else InternalMessage(
+            kind="jsonData", status=body.get("status")
+        )
+        return ClientResponse(code < 400, out, body)
+
+    def explain(self, data: Any = None, names: Optional[List[str]] = None,
+                predictor: Optional[str] = None) -> ClientResponse:
+        msg = self._build_message(data, names)
+        path = "/api/v0.1/explanations"
+        if predictor:
+            path += f"?predictor={predictor}"
+        code, body = self._rest_post(path, msg.to_json())
+        out = InternalMessage(payload=body, kind="jsonData") if code < 400 else InternalMessage(
+            kind="jsonData", status=body.get("status")
+        )
+        return ClientResponse(code < 400, out, body)
+
+    def close(self) -> None:
+        if self._channel is not None:
+            self._channel.close()
+            self._channel = None
+        if self._session is not None:
+            self._session.close()
+            self._session = None

@@ -29,7 +29,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 from seldon_core_tpu.controlplane.defaulting import default_and_validate
 from seldon_core_tpu.controlplane.placement import PlacementPlan, plan_placement
-from seldon_core_tpu.controlplane.spec import TpuDeployment
+from seldon_core_tpu.controlplane.spec import DeploymentSpecError, TpuDeployment
 from seldon_core_tpu.engine.server import Gateway
 from seldon_core_tpu.engine.service import PredictorService
 
@@ -74,11 +74,35 @@ def build_generation(spec: TpuDeployment, device_ids: Optional[List[int]] = None
 
         observer = PrometheusObserver(deployment_name=spec.name, predictor_name=p.name)
         svc = PredictorService(p.graph, name=p.name, observer=observer)
+        if p.explainer:
+            _attach_explainer(svc, p.explainer)
         if p.shadow:
             shadows.append(svc)
         else:
             weighted.append((svc, p.traffic))
     return Generation(spec=spec, gateway=Gateway(weighted, shadows=shadows), plan=plan)
+
+
+def _attach_explainer(svc: PredictorService, config: Dict[str, Any]) -> None:
+    """Build the predictor's explainer and point it at the first local
+    MODEL component in the graph (reference analogue: a separate
+    explainer Deployment per predictor,
+    reference: seldondeployment_explainers.go:33-196 — here it shares
+    the predictor's process and HBM-resident weights)."""
+    from seldon_core_tpu.components.explainers import build_explainer
+    from seldon_core_tpu.engine.graph import MODEL
+
+    explainer = build_explainer(config)
+    for unit in svc.graph.walk():
+        if unit.type == MODEL:
+            component = svc.executor.component(unit.name)
+            if component is not None:
+                explainer.attach(component)
+                svc.explainer = explainer
+                return
+    raise DeploymentSpecError(
+        f"predictor {svc.name!r} has an explainer but no local MODEL component"
+    )
 
 
 class Deployer:

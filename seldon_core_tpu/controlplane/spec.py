@@ -54,6 +54,10 @@ class PredictorSpec:
     # TPU resourcing
     device_ids: List[int] = field(default_factory=list)
     mesh_axes: Optional[Dict[str, int]] = None
+    # explainer config, e.g. {"type": "integrated_gradients", "steps": 16}
+    # (reference analogue: the Explainer CRD message,
+    # proto/seldon_deployment.proto:45-51)
+    explainer: Optional[Dict[str, Any]] = None
 
     @classmethod
     def from_dict(cls, d: Dict[str, Any]) -> "PredictorSpec":
@@ -70,6 +74,7 @@ class PredictorSpec:
             labels=dict(d.get("labels", {})),
             device_ids=list(d.get("deviceIds", d.get("device_ids", []))),
             mesh_axes=d.get("meshAxes", d.get("mesh_axes")),
+            explainer=d.get("explainer"),
         )
 
     def to_dict(self) -> Dict[str, Any]:
@@ -87,6 +92,8 @@ class PredictorSpec:
             out["deviceIds"] = self.device_ids
         if self.mesh_axes:
             out["meshAxes"] = self.mesh_axes
+        if self.explainer:
+            out["explainer"] = self.explainer
         return out
 
 

@@ -122,6 +122,21 @@ def build_gateway_app(gateway: Gateway) -> web.Application:
         except Exception as e:  # noqa: BLE001
             return _error_response(e)
 
+    async def explanations(request: web.Request) -> web.Response:
+        try:
+            body = await _request_body(request)
+            msg = InternalMessage.from_json(body)
+            svc = gateway.by_name(request.query.get("predictor", "")) or gateway.pick()
+            out = await svc.explain(msg)
+            status_code = 200
+            if out.status and out.status.get("status") == "FAILURE":
+                status_code = int(out.status.get("code", 500))
+                if not (400 <= status_code < 600):
+                    status_code = 500
+            return web.json_response(out.to_json(), status=status_code)
+        except Exception as e:  # noqa: BLE001
+            return _error_response(e)
+
     async def feedback(request: web.Request) -> web.Response:
         try:
             body = await _request_body(request)
@@ -158,6 +173,7 @@ def build_gateway_app(gateway: Gateway) -> web.Application:
     app.router.add_get("/api/v0.1/predictions", predictions)
     app.router.add_post("/predict", predictions)  # convenience alias
     app.router.add_post("/api/v0.1/feedback", feedback)
+    app.router.add_post("/api/v0.1/explanations", explanations)
     app.router.add_get("/ping", ping)
     app.router.add_get("/live", live)
     app.router.add_get("/ready", ready)

@@ -66,6 +66,26 @@ class PredictorService:
         self.log_responses = log_responses
         self.request_logger = request_logger
         self.stats = {"requests": 0, "failures": 0, "feedback": 0}
+        self.explainer = None  # set by the control plane when configured
+
+    async def explain(self, request: InternalMessage) -> InternalMessage:
+        """Run the predictor's explainer (reference: the :explain URL of
+        a deployed alibi explainer; here in-process)."""
+        if self.explainer is None:
+            return failure_message(
+                MicroserviceError("predictor has no explainer configured", status_code=404,
+                                  reason="NO_EXPLAINER")
+            )
+        from seldon_core_tpu.runtime.executor_pool import run_dispatch
+
+        try:
+            result = await run_dispatch(self.explainer.explain, request.host_payload(), request.names)
+            out = InternalMessage(payload=result, kind="jsonData",
+                                  status={"status": "SUCCESS", "code": 200})
+            out.meta.puid = request.meta.puid or new_puid()
+            return out
+        except Exception as e:  # noqa: BLE001
+            return failure_message(e)
 
     # ------------------------------------------------------------- lifecycle
 

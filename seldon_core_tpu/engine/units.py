@@ -110,8 +110,23 @@ def register_implementation(name: str, factory: Callable[..., Any]) -> None:
     BUILTIN_IMPLEMENTATIONS[name.upper()] = factory
 
 
+def _load_registrations() -> None:
+    """Import the packages whose import side-effect registers the
+    prepackaged servers and reusable components."""
+    import importlib
+
+    for module in ("seldon_core_tpu.models", "seldon_core_tpu.components"):
+        try:
+            importlib.import_module(module)
+        except ImportError:  # pragma: no cover
+            pass
+
+
 def make_builtin(name: str, **kwargs: Any) -> Any:
     factory = BUILTIN_IMPLEMENTATIONS.get(name.upper())
+    if factory is None:
+        _load_registrations()
+        factory = BUILTIN_IMPLEMENTATIONS.get(name.upper())
     if factory is None:
         raise MicroserviceError(
             f"unknown builtin implementation {name!r}", status_code=400, reason="UNKNOWN_IMPLEMENTATION"

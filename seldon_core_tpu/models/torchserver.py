@@ -1,0 +1,58 @@
+"""TorchServer — serve PyTorch models (CPU) behind the component API.
+
+The torch analogue of the reference's prepackaged servers
+(reference: servers/sklearnserver/sklearnserver/SKLearnServer.py:15-44
+pattern): download a TorchScript archive or state_dict from
+``model_uri`` and serve ``predict``.  Registered as TORCH_SERVER.
+Useful for graph nodes that aren't worth porting to XLA (tiny
+preprocessors, legacy models) living alongside TPU-served jax nodes.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional
+
+import numpy as np
+
+import torch
+
+from seldon_core_tpu.runtime.component import MicroserviceError, TPUComponent
+
+
+class TorchServer(TPUComponent):
+    def __init__(
+        self,
+        model_uri: str = "",
+        class_names_list: Optional[List[str]] = None,
+        softmax_outputs: bool = False,
+        **kwargs: Any,
+    ):
+        super().__init__(**kwargs)
+        self.model_uri = model_uri
+        self._class_names = class_names_list
+        self.softmax_outputs = bool(softmax_outputs)
+        self.module: Optional[torch.nn.Module] = None
+
+    def load(self) -> None:
+        if self.module is not None:
+            return
+        if not self.model_uri:
+            raise MicroserviceError("TorchServer needs a model_uri", status_code=400, reason="MISSING_MODEL_URI")
+        from seldon_core_tpu.utils import storage
+
+        path = storage.download(self.model_uri)
+        self.module = torch.jit.load(path, map_location="cpu")
+        self.module.eval()
+
+    def predict(self, X, names, meta=None):
+        if self.module is None:
+            self.load()
+        with torch.no_grad():
+            t = torch.as_tensor(np.asarray(X, dtype=np.float32))
+            out = self.module(t)
+            if self.softmax_outputs:
+                out = torch.softmax(out, dim=-1)
+        return out.numpy()
+
+    def class_names(self):
+        return self._class_names or []

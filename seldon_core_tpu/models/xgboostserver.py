@@ -1,0 +1,41 @@
+"""XGBoostServer — serve xgboost models (gated on xgboost).
+
+Parity component for the reference's xgboostserver
+(reference: servers/xgboostserver/xgboostserver/XGBoostServer.py:10-26):
+load a saved Booster from ``model_uri`` and serve predictions.
+Registered as XGBOOST_SERVER when xgboost is importable.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import numpy as np
+
+import xgboost  # noqa: F401 — gate: ImportError skips registration
+
+from seldon_core_tpu.runtime.component import MicroserviceError, TPUComponent
+
+
+class XGBoostServer(TPUComponent):
+    def __init__(self, model_uri: str = "", **kwargs: Any):
+        super().__init__(**kwargs)
+        self.model_uri = model_uri
+        self.booster: Optional["xgboost.Booster"] = None
+
+    def load(self) -> None:
+        if self.booster is not None:
+            return
+        if not self.model_uri:
+            raise MicroserviceError("XGBoostServer needs a model_uri", status_code=400, reason="MISSING_MODEL_URI")
+        from seldon_core_tpu.utils import storage
+
+        path = storage.download(self.model_uri)
+        self.booster = xgboost.Booster()
+        self.booster.load_model(path)
+
+    def predict(self, X, names, meta=None):
+        if self.booster is None:
+            self.load()
+        dmat = xgboost.DMatrix(np.asarray(X, dtype=np.float32), feature_names=list(names) or None)
+        return self.booster.predict(dmat)

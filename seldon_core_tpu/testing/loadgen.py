@@ -1,0 +1,89 @@
+"""Load generator — the locust-equivalent harness.
+
+(reference: util/loadtester/scripts/predict_rest_locust.py,
+predict_grpc_locust.py): closed-loop concurrent workers firing a
+request callable for a fixed duration, reporting rate + latency
+percentiles.  Used by bench.py and usable standalone against any
+gateway.
+"""
+
+from __future__ import annotations
+
+import statistics
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+
+@dataclass
+class LoadResult:
+    duration_s: float
+    requests: int
+    errors: int
+    latencies_ms: List[float] = field(repr=False, default_factory=list)
+
+    @property
+    def qps(self) -> float:
+        return self.requests / self.duration_s if self.duration_s else 0.0
+
+    def percentile(self, q: float) -> float:
+        if not self.latencies_ms:
+            return float("nan")
+        idx = min(len(self.latencies_ms) - 1, int(len(self.latencies_ms) * q))
+        return sorted(self.latencies_ms)[idx]
+
+    def summary(self) -> Dict[str, Any]:
+        lat = sorted(self.latencies_ms)
+        return {
+            "qps": round(self.qps, 1),
+            "requests": self.requests,
+            "errors": self.errors,
+            "p50_ms": round(statistics.median(lat), 3) if lat else None,
+            "p90_ms": round(self.percentile(0.90), 3) if lat else None,
+            "p99_ms": round(self.percentile(0.99), 3) if lat else None,
+            "mean_ms": round(statistics.fmean(lat), 3) if lat else None,
+        }
+
+
+def run_load(
+    request_fn: Callable[[], bool],
+    duration_s: float = 10.0,
+    concurrency: int = 16,
+    warmup_s: float = 0.0,
+) -> LoadResult:
+    """Closed-loop load: `concurrency` workers call `request_fn`
+    (returns success) until the deadline."""
+    if warmup_s > 0:
+        stop_warm = time.perf_counter() + warmup_s
+        while time.perf_counter() < stop_warm:
+            request_fn()
+
+    latencies: List[float] = []
+    errors = [0]
+    lock = threading.Lock()
+    stop_at = time.perf_counter() + duration_s
+
+    def worker():
+        mine: List[float] = []
+        my_errors = 0
+        while time.perf_counter() < stop_at:
+            t0 = time.perf_counter()
+            try:
+                ok = request_fn()
+            except Exception:  # noqa: BLE001
+                ok = False
+            if ok:
+                mine.append((time.perf_counter() - t0) * 1000.0)
+            else:
+                my_errors += 1
+        with lock:
+            latencies.extend(mine)
+            errors[0] += my_errors
+
+    threads = [threading.Thread(target=worker, daemon=True) for _ in range(concurrency)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    return LoadResult(duration_s=duration_s, requests=len(latencies), errors=errors[0], latencies_ms=latencies)

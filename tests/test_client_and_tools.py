@@ -1,0 +1,224 @@
+"""Client SDK, contract tester, load generator, explainers, torchserver."""
+
+import asyncio
+import json
+import threading
+
+import numpy as np
+import pytest
+
+from seldon_core_tpu.client.client import SeldonTpuClient, random_payload
+from seldon_core_tpu.components.explainers import (
+    IntegratedGradientsExplainer,
+    PermutationExplainer,
+    build_explainer,
+)
+from seldon_core_tpu.engine import PredictorService, UnitSpec
+from seldon_core_tpu.engine.server import Gateway, build_gateway_app, add_seldon_service
+from seldon_core_tpu.runtime import TPUComponent
+from seldon_core_tpu.testing.contract import Contract, run_contract_test
+from seldon_core_tpu.testing.loadgen import run_load
+
+
+class Doubler(TPUComponent):
+    def predict(self, X, names, meta=None):
+        return np.asarray(X) * 2
+
+
+@pytest.fixture(scope="module")
+def live_gateway():
+    """A real gateway served on loopback REST + gRPC for client tests."""
+    import grpc
+
+    from seldon_core_tpu.runtime import rest
+
+    holder = {}
+    started = threading.Event()
+
+    async def serve():
+        gw = Gateway([(PredictorService(UnitSpec(name="m", type="MODEL", component=Doubler())), 1.0)])
+        app = build_gateway_app(gw)
+        from aiohttp.test_utils import TestServer
+
+        http_server = TestServer(app)
+        await http_server.start_server()
+        grpc_server = grpc.aio.server()
+        add_seldon_service(grpc_server, gw)
+        grpc_port = grpc_server.add_insecure_port("127.0.0.1:0")
+        await grpc_server.start()
+        holder["http_port"] = http_server.port
+        holder["grpc_port"] = grpc_port
+        holder["stop"] = asyncio.Event()
+        started.set()
+        await holder["stop"].wait()
+        await grpc_server.stop(grace=None)
+        await http_server.close()
+
+    def runner():
+        asyncio.run(serve())
+
+    t = threading.Thread(target=runner, daemon=True)
+    t.start()
+    assert started.wait(timeout=30)
+    yield holder
+    holder["loop_stop"] = True
+    # signal the event loop to stop
+    asyncio.run_coroutine_threadsafe  # noqa: B018 — loop shutdown via daemon thread
+
+
+class TestClientSdk:
+    def test_rest_predict(self, live_gateway):
+        client = SeldonTpuClient(http_port=live_gateway["http_port"], transport="rest")
+        resp = client.predict(np.array([[1.0, 2.0]]))
+        assert resp.success
+        np.testing.assert_array_equal(resp.data, [[2.0, 4.0]])
+        assert resp.meta.puid
+        client.close()
+
+    def test_grpc_predict(self, live_gateway):
+        client = SeldonTpuClient(grpc_port=live_gateway["grpc_port"], transport="grpc")
+        resp = client.predict(np.array([[3.0]]))
+        assert resp.success
+        np.testing.assert_array_equal(resp.data, [[6.0]])
+        client.close()
+
+    def test_raw_tensor_payload(self, live_gateway):
+        client = SeldonTpuClient(grpc_port=live_gateway["grpc_port"], transport="grpc")
+        resp = client.predict(np.ones((2, 3), np.float32))
+        assert resp.success
+        assert resp.response.kind == "rawTensor"
+        client.close()
+
+    def test_feedback(self, live_gateway):
+        client = SeldonTpuClient(http_port=live_gateway["http_port"], transport="rest")
+        pred = client.predict(np.array([[1.0]]))
+        fb = client.feedback(request=np.array([[1.0]]), response=pred.response, reward=1.0)
+        assert fb.success
+        client.close()
+
+    def test_random_payload_shapes(self):
+        assert random_payload((3, 7)).shape == (3, 7)
+        assert random_payload((2, 2), dtype="uint8").dtype == np.uint8
+
+
+class TestContractTester:
+    def test_generate_tabular(self, tmp_path):
+        contract = Contract(
+            features=[
+                {"name": "a", "dtype": "float64", "range": [0, 1]},
+                {"name": "b", "dtype": "int64", "range": [1, 5]},
+            ]
+        )
+        batch = contract.generate_batch(8, np.random.default_rng(0))
+        assert batch.shape == (8, 2)
+        assert (batch[:, 0] >= 0).all() and (batch[:, 0] <= 1).all()
+        assert (batch[:, 1] >= 1).all() and (batch[:, 1] <= 5).all()
+
+    def test_generate_image_shaped(self):
+        contract = Contract(
+            features=[{"name": "img", "dtype": "uint8", "range": [0, 255], "shape": [8, 8, 3]}]
+        )
+        batch = contract.generate_batch(2)
+        assert batch.shape == (2, 8, 8, 3)
+        assert batch.dtype == np.uint8
+
+    def test_end_to_end_against_gateway(self, live_gateway):
+        client = SeldonTpuClient(http_port=live_gateway["http_port"], transport="rest")
+        contract = Contract(features=[{"name": "x", "dtype": "float64", "range": [0, 1]}])
+        result = run_contract_test(contract, client, n_requests=5, seed=0)
+        assert result == {"requests": 5, "succeeded": 5, "failed": 0, "failures": []}
+        client.close()
+
+
+class TestLoadgen:
+    def test_percentiles_and_rate(self):
+        calls = []
+
+        def fake_request():
+            calls.append(1)
+            return True
+
+        result = run_load(fake_request, duration_s=0.2, concurrency=4)
+        assert result.requests > 0
+        assert result.errors == 0
+        summary = result.summary()
+        assert summary["p50_ms"] is not None
+        assert summary["qps"] > 0
+
+
+class TestExplainers:
+    def test_integrated_gradients_on_jaxserver(self):
+        from seldon_core_tpu.models.jaxserver import JaxServer
+
+        server = JaxServer(model="mlp", num_classes=3, input_shape=(4,), dtype="float32",
+                           max_batch_size=4, warmup=False, warmup_dtypes=("float32",))
+        server.load()
+        explainer = IntegratedGradientsExplainer(model=server, steps=8)
+        out = explainer.explain(np.ones((2, 4), np.float32), names=["a", "b", "c", "d"])
+        assert out["method"] == "integrated_gradients"
+        attrs = np.asarray(out["attributions"])
+        assert attrs.shape == (2, 4)
+        assert np.isfinite(attrs).all()
+        server.unload()
+
+    def test_ig_completeness_axiom(self):
+        """IG attributions sum ~ f(x) - f(baseline) for the target logit."""
+        from seldon_core_tpu.models.jaxserver import JaxServer
+
+        server = JaxServer(model="mlp", num_classes=3, input_shape=(4,), dtype="float32",
+                           max_batch_size=4, warmup=False, warmup_dtypes=("float32",))
+        server.load()
+        explainer = IntegratedGradientsExplainer(model=server, steps=256)
+        x = np.array([[0.5, -1.0, 2.0, 0.1]], np.float32)
+        out = explainer.explain(x)
+        target = out["targets"][0]
+
+        import jax.numpy as jnp
+
+        logits_x = server.module.apply(server.variables, jnp.asarray(x))[0]
+        logits_b = server.module.apply(server.variables, jnp.zeros((1, 4)))[0]
+        expected = float(logits_x[target] - logits_b[target])
+        assert np.asarray(out["attributions"]).sum() == pytest.approx(expected, rel=0.05)
+        server.unload()
+
+    def test_permutation_explainer(self):
+        class LinearModel(TPUComponent):
+            def predict(self, X, names, meta=None):
+                # only feature 1 matters
+                return np.asarray(X)[:, [1]] * 10
+
+        explainer = PermutationExplainer(model=LinearModel(), n_repeats=3, seed=0)
+        X = np.random.default_rng(0).normal(size=(32, 3))
+        out = explainer.explain(X, names=["a", "b", "c"])
+        imp = out["importances"]
+        assert np.argmax(imp) == 1
+
+    def test_build_explainer_registry(self):
+        e = build_explainer({"type": "permutation", "n_repeats": 2})
+        assert isinstance(e, PermutationExplainer)
+
+
+class TestTorchServer:
+    def test_torchscript_roundtrip(self, tmp_path):
+        import torch
+
+        from seldon_core_tpu.models.torchserver import TorchServer
+
+        model = torch.nn.Sequential(torch.nn.Linear(4, 3))
+        scripted = torch.jit.script(model)
+        path = tmp_path / "model.pt"
+        torch.jit.save(scripted, str(path))
+
+        server = TorchServer(model_uri=str(path))
+        server.load()
+        out = server.predict(np.ones((2, 4), np.float32), [])
+        assert out.shape == (2, 3)
+        with torch.no_grad():
+            expected = model(torch.ones(2, 4)).numpy()
+        np.testing.assert_allclose(out, expected, rtol=1e-5)
+
+    def test_registered_as_builtin(self):
+        import seldon_core_tpu.models  # noqa: F401
+        from seldon_core_tpu.engine.units import BUILTIN_IMPLEMENTATIONS
+
+        assert "TORCH_SERVER" in BUILTIN_IMPLEMENTATIONS

@@ -1,0 +1,102 @@
+"""Explainer through the control plane + gateway /explanations route."""
+
+import asyncio
+
+import numpy as np
+
+from seldon_core_tpu.controlplane import Deployer, TpuDeployment
+from seldon_core_tpu.engine.server import build_gateway_app
+from seldon_core_tpu.runtime.message import InternalMessage
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+SPEC = {
+    "name": "explained",
+    "predictors": [
+        {
+            "name": "main",
+            "explainer": {"type": "integrated_gradients", "steps": 8},
+            "graph": {
+                "name": "clf",
+                "type": "MODEL",
+                "implementation": "JAX_SERVER",
+                "parameters": [
+                    {"name": "model", "value": "mlp", "type": "STRING"},
+                    {"name": "num_classes", "value": "3", "type": "INT"},
+                    {"name": "input_shape", "value": "[4]", "type": "JSON"},
+                    {"name": "dtype", "value": "float32", "type": "STRING"},
+                    {"name": "warmup", "value": "false", "type": "BOOL"},
+                    {"name": "max_batch_size", "value": "4", "type": "INT"},
+                ],
+            },
+        }
+    ],
+}
+
+
+class TestExplainerDeployment:
+    def test_explain_via_service(self):
+        async def scenario():
+            deployer = Deployer(device_ids=[0])
+            managed = await deployer.apply(TpuDeployment.from_dict(SPEC))
+            svc = managed.gateway.predictors[0]
+            assert svc.explainer is not None
+            out = await svc.explain(
+                InternalMessage(payload=np.ones((1, 4), np.float32), kind="rawTensor",
+                                names=["a", "b", "c", "d"])
+            )
+            await deployer.delete("explained")
+            return out
+
+        out = run(scenario())
+        assert out.status["status"] == "SUCCESS"
+        assert out.payload["method"] == "integrated_gradients"
+        assert np.asarray(out.payload["attributions"]).shape == (1, 4)
+
+    def test_explanations_rest_route(self):
+        async def scenario():
+            from aiohttp.test_utils import TestClient, TestServer
+
+            deployer = Deployer(device_ids=[0])
+            managed = await deployer.apply(TpuDeployment.from_dict(SPEC))
+            app = build_gateway_app(managed.gateway)
+            client = TestClient(TestServer(app))
+            await client.start_server()
+            resp = await client.post(
+                "/api/v0.1/explanations",
+                json={"data": {"names": ["a", "b", "c", "d"], "ndarray": [[1.0, 1.0, 1.0, 1.0]]}},
+            )
+            body = await resp.json()
+            await client.close()
+            await deployer.delete("explained")
+            return resp.status, body
+
+        status, body = run(scenario())
+        assert status == 200
+        assert body["jsonData"]["method"] == "integrated_gradients"
+
+    def test_no_explainer_404(self):
+        async def scenario():
+            deployer = Deployer(device_ids=[0])
+            spec = TpuDeployment.from_dict(
+                {
+                    "name": "plain",
+                    "predictors": [
+                        {"name": "p", "graph": {"name": "m", "type": "MODEL",
+                                                "implementation": "SIMPLE_MODEL"}}
+                    ],
+                }
+            )
+            managed = await deployer.apply(spec)
+            out = await managed.gateway.predictors[0].explain(
+                InternalMessage(payload=np.ones((1, 2)), kind="tensor")
+            )
+            await deployer.delete("plain")
+            return out
+
+        out = run(scenario())
+        assert out.status["status"] == "FAILURE"
+        assert out.status["code"] == 404
