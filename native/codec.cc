@@ -1,0 +1,180 @@
+// seldon-core-tpu native data-plane core.
+//
+// The C++ counterpart of the reference's decision to keep its
+// per-request data plane out of Python (the Java engine,
+// reference: doc/source/graph/svcorch.md:1-8).  This library holds the
+// codec hot loops of the serving path — the operations profiling shows
+// dominate single-CPU Python request handling:
+//
+//   * base64 encode/decode (REST binData / rawTensor bodies)
+//   * JSON number-array parse + serialise (the "tensor"/"ndarray"
+//     payloads of the REST path; the reference pays this cost in
+//     Python per hop, reference: python/seldon_core/utils.py:558-631)
+//   * batch gather/pad: scatter request rows into a padded bucket
+//     buffer in one pass (feeds the dynamic batcher)
+//
+// Exposed with a plain C ABI and loaded via ctypes — no pybind11
+// dependency.  Every entry point is GIL-free pure compute; callers
+// pass raw pointers into numpy buffers.
+
+#include <cstdint>
+#include <cstring>
+#include <cstdio>
+#include <cmath>
+#include <cstdlib>
+
+extern "C" {
+
+// ---------------------------------------------------------------------------
+// base64
+// ---------------------------------------------------------------------------
+
+static const char B64_CHARS[] =
+    "ABCDEFGHIJKLMNOPQRSTUVWXYZabcdefghijklmnopqrstuvwxyz0123456789+/";
+
+// encoded length including padding (no newlines)
+int64_t b64_encoded_len(int64_t n) { return ((n + 2) / 3) * 4; }
+
+int64_t b64_encode(const uint8_t* src, int64_t n, char* dst) {
+  int64_t di = 0;
+  int64_t i = 0;
+  for (; i + 2 < n; i += 3) {
+    uint32_t v = (uint32_t(src[i]) << 16) | (uint32_t(src[i + 1]) << 8) | src[i + 2];
+    dst[di++] = B64_CHARS[(v >> 18) & 63];
+    dst[di++] = B64_CHARS[(v >> 12) & 63];
+    dst[di++] = B64_CHARS[(v >> 6) & 63];
+    dst[di++] = B64_CHARS[v & 63];
+  }
+  if (i < n) {
+    uint32_t v = uint32_t(src[i]) << 16;
+    bool two = (i + 1 < n);
+    if (two) v |= uint32_t(src[i + 1]) << 8;
+    dst[di++] = B64_CHARS[(v >> 18) & 63];
+    dst[di++] = B64_CHARS[(v >> 12) & 63];
+    dst[di++] = two ? B64_CHARS[(v >> 6) & 63] : '=';
+    dst[di++] = '=';
+  }
+  return di;
+}
+
+static inline int8_t b64_val(char c) {
+  if (c >= 'A' && c <= 'Z') return int8_t(c - 'A');
+  if (c >= 'a' && c <= 'z') return int8_t(c - 'a' + 26);
+  if (c >= '0' && c <= '9') return int8_t(c - '0' + 52);
+  if (c == '+') return 62;
+  if (c == '/') return 63;
+  return -1;
+}
+
+// returns decoded byte count, or -1 on malformed input
+int64_t b64_decode(const char* src, int64_t n, uint8_t* dst) {
+  while (n > 0 && (src[n - 1] == '=' || src[n - 1] == '\n')) n--;
+  int64_t di = 0;
+  uint32_t acc = 0;
+  int bits = 0;
+  for (int64_t i = 0; i < n; i++) {
+    char c = src[i];
+    if (c == '\n' || c == '\r') continue;
+    int8_t v = b64_val(c);
+    if (v < 0) return -1;
+    acc = (acc << 6) | uint32_t(v);
+    bits += 6;
+    if (bits >= 8) {
+      bits -= 8;
+      dst[di++] = uint8_t((acc >> bits) & 0xFF);
+    }
+  }
+  return di;
+}
+
+// ---------------------------------------------------------------------------
+// JSON float-array codec
+// ---------------------------------------------------------------------------
+
+// Parse a flat JSON array of numbers ("[1, 2.5e-3, -4]") into float64.
+// Handles nested arrays by ignoring brackets (row-major flatten), which
+// matches how the REST ndarray payload flattens.  Returns the number of
+// values written, or -1 on malformed input.
+int64_t json_parse_f64(const char* src, int64_t n, double* dst, int64_t cap) {
+  int64_t count = 0;
+  int64_t i = 0;
+  while (i < n) {
+    char c = src[i];
+    if (c == '[' || c == ']' || c == ',' || c == ' ' || c == '\n' || c == '\t' || c == '\r') {
+      i++;
+      continue;
+    }
+    if ((c >= '0' && c <= '9') || c == '-' || c == '+' || c == '.') {
+      char* end = nullptr;
+      double v = strtod(src + i, &end);
+      if (end == src + i) return -1;
+      if (count >= cap) return -1;
+      dst[count++] = v;
+      i = end - src;
+      continue;
+    }
+    // "null" -> NaN, to mirror the JSON ndarray semantics
+    if (c == 'n' && i + 4 <= n && memcmp(src + i, "null", 4) == 0) {
+      if (count >= cap) return -1;
+      dst[count++] = NAN;
+      i += 4;
+      continue;
+    }
+    return -1;
+  }
+  return count;
+}
+
+// Serialise float64 values as a flat JSON array into dst; returns the
+// number of chars written (dst must hold ~25 bytes per value + 2).
+int64_t json_serialize_f64(const double* src, int64_t n, char* dst) {
+  int64_t di = 0;
+  dst[di++] = '[';
+  for (int64_t i = 0; i < n; i++) {
+    if (i) dst[di++] = ',';
+    double v = src[i];
+    if (v == (int64_t)v && v > -1e15 && v < 1e15) {
+      di += snprintf(dst + di, 24, "%lld.0", (long long)v);
+    } else {
+      di += snprintf(dst + di, 25, "%.17g", v);
+    }
+  }
+  dst[di++] = ']';
+  return di;
+}
+
+// ---------------------------------------------------------------------------
+// batch gather / pad
+// ---------------------------------------------------------------------------
+
+// Gather `k` request buffers (srcs[i], rows[i] rows of row_bytes each)
+// into one contiguous batch of `bucket_rows` rows, zeroing the padding
+// tail.  One memcpy pass — replaces np.concatenate + np.pad.
+void batch_gather_pad(const uint8_t** srcs, const int64_t* rows, int64_t k,
+                      int64_t row_bytes, int64_t bucket_rows, uint8_t* dst) {
+  int64_t off = 0;
+  for (int64_t i = 0; i < k; i++) {
+    int64_t nb = rows[i] * row_bytes;
+    memcpy(dst + off, srcs[i], size_t(nb));
+    off += nb;
+  }
+  int64_t total = bucket_rows * row_bytes;
+  if (off < total) memset(dst + off, 0, size_t(total - off));
+}
+
+// uint8 NHWC image -> float32 with per-channel scale/shift
+// (fused normalisation preprocessing for image serving)
+void u8_to_f32_normalize(const uint8_t* src, int64_t n_pixels, int64_t channels,
+                         const float* scale, const float* shift, float* dst) {
+  for (int64_t p = 0; p < n_pixels; p++) {
+    const uint8_t* row = src + p * channels;
+    float* out = dst + p * channels;
+    for (int64_t c = 0; c < channels; c++) {
+      out[c] = float(row[c]) * scale[c] + shift[c];
+    }
+  }
+}
+
+int32_t native_abi_version() { return 1; }
+
+}  // extern "C"

@@ -202,14 +202,23 @@ class DynamicBatcher:
     def _launch_batch(self, items: List[_WorkItem]) -> None:
         """Stage 1 (collector thread): pad, launch, start async readback."""
         rows = sum(it.rows for it in items)
-        batch = items[0].x if len(items) == 1 else np.concatenate([it.x for it in items], axis=0)
         bucket = bucket_for(rows, self.buckets)
         if rows > bucket:  # oversized single request: honest full-size call
             bucket = rows
         padded = bucket - rows
-        if padded:
-            pad_width = [(0, padded)] + [(0, 0)] * (batch.ndim - 1)
-            batch = np.pad(batch, pad_width)
+        arrays = [it.x for it in items]
+        homogeneous = all(
+            a.dtype == arrays[0].dtype and a.shape[1:] == arrays[0].shape[1:] for a in arrays[1:]
+        )
+        if homogeneous and (len(arrays) > 1 or padded):
+            from seldon_core_tpu import native
+
+            batch = native.gather_pad(arrays, bucket)  # one-pass C++ gather+pad
+        else:
+            batch = arrays[0] if len(arrays) == 1 else np.concatenate(arrays, axis=0)
+            if padded:
+                pad_width = [(0, padded)] + [(0, 0)] * (batch.ndim - 1)
+                batch = np.pad(batch, pad_width)
         out = self.predict_fn(batch)  # async XLA dispatch: returns immediately
         if hasattr(out, "copy_to_host_async"):
             out.copy_to_host_async()  # overlap readback with later batches

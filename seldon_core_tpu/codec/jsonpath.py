@@ -58,8 +58,10 @@ def extract_json_payload(body: Dict[str, Any]) -> Tuple[Any, Optional[Dict], Opt
                 arr = arr.reshape(shape)
             return arr, meta, datadef, "tensor"
         if "rawTensor" in datadef:
+            from seldon_core_tpu import native
+
             r = datadef["rawTensor"]
-            raw = base64.b64decode(r["data"]) if isinstance(r.get("data"), str) else r.get("data", b"")
+            raw = native.b64decode(r["data"]) if isinstance(r.get("data"), str) else r.get("data", b"")
             arr = np.frombuffer(raw, dtype=np_dtype(r.get("dtype", "float32")))
             shape = r.get("shape")
             if shape:
@@ -103,11 +105,13 @@ def build_json_payload(
     if names:
         datadef["names"] = list(names)
     if data_kind == "rawTensor":
+        from seldon_core_tpu import native
+
         arr = np.ascontiguousarray(arr)
         datadef["rawTensor"] = {
             "shape": list(arr.shape),
             "dtype": arr.dtype.name,
-            "data": base64.b64encode(arr.tobytes()).decode("ascii"),
+            "data": native.b64encode(arr.tobytes()),
         }
     elif data_kind == "ndarray":
         datadef["ndarray"] = arr.tolist()

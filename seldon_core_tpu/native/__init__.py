@@ -1,0 +1,170 @@
+"""ctypes bindings for the native C++ data-plane core.
+
+Loads ``libseldon_tpu_native.so`` (built by ``make native``; also
+auto-built on first import when a toolchain is present) and exposes the
+codec hot loops.  Every function has a pure-Python fallback, so the
+framework runs unchanged without the library — native just makes the
+1-CPU REST path faster.
+"""
+
+from __future__ import annotations
+
+import base64 as _pyb64
+import ctypes
+import json as _pyjson
+import logging
+import os
+import subprocess
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+_LIB: Optional[ctypes.CDLL] = None
+_TRIED = False
+
+
+def _so_path() -> str:
+    return os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+                        "native", "libseldon_tpu_native.so")
+
+
+def _try_build(so: str) -> None:
+    makefile_dir = os.path.dirname(so)
+    if not os.path.exists(os.path.join(makefile_dir, "Makefile")):
+        return
+    try:
+        subprocess.run(["make", "-C", makefile_dir], check=True, capture_output=True, timeout=120)
+    except Exception as e:  # noqa: BLE001
+        logger.debug("native build failed: %s", e)
+
+
+def get_lib() -> Optional[ctypes.CDLL]:
+    global _LIB, _TRIED
+    if _LIB is not None or _TRIED:
+        return _LIB
+    _TRIED = True
+    so = _so_path()
+    if not os.path.exists(so):
+        _try_build(so)
+    if not os.path.exists(so):
+        return None
+    try:
+        lib = ctypes.CDLL(so)
+        lib.b64_encoded_len.restype = ctypes.c_int64
+        lib.b64_encoded_len.argtypes = [ctypes.c_int64]
+        lib.b64_encode.restype = ctypes.c_int64
+        lib.b64_encode.argtypes = [ctypes.c_char_p, ctypes.c_int64, ctypes.c_char_p]
+        lib.b64_decode.restype = ctypes.c_int64
+        lib.b64_decode.argtypes = [ctypes.c_char_p, ctypes.c_int64, ctypes.c_char_p]
+        lib.json_parse_f64.restype = ctypes.c_int64
+        lib.json_parse_f64.argtypes = [
+            ctypes.c_char_p, ctypes.c_int64, ctypes.POINTER(ctypes.c_double), ctypes.c_int64,
+        ]
+        lib.json_serialize_f64.restype = ctypes.c_int64
+        lib.json_serialize_f64.argtypes = [
+            ctypes.POINTER(ctypes.c_double), ctypes.c_int64, ctypes.c_char_p,
+        ]
+        lib.batch_gather_pad.restype = None
+        lib.batch_gather_pad.argtypes = [
+            ctypes.POINTER(ctypes.c_char_p), ctypes.POINTER(ctypes.c_int64), ctypes.c_int64,
+            ctypes.c_int64, ctypes.c_int64, ctypes.c_char_p,
+        ]
+        assert lib.native_abi_version() == 1
+        _LIB = lib
+        logger.info("native data-plane core loaded from %s", so)
+    except Exception as e:  # noqa: BLE001
+        logger.warning("failed to load native core: %s", e)
+        _LIB = None
+    return _LIB
+
+
+def available() -> bool:
+    return get_lib() is not None
+
+
+# ---------------------------------------------------------------------------
+# base64
+# ---------------------------------------------------------------------------
+
+def b64encode(data: bytes) -> str:
+    lib = get_lib()
+    if lib is None:
+        return _pyb64.b64encode(data).decode("ascii")
+    out = ctypes.create_string_buffer(int(lib.b64_encoded_len(len(data))))
+    n = lib.b64_encode(data, len(data), out)
+    return out.raw[:n].decode("ascii")
+
+
+def b64decode(text: str) -> bytes:
+    lib = get_lib()
+    if lib is None:
+        return _pyb64.b64decode(text)
+    raw = text.encode("ascii")
+    out = ctypes.create_string_buffer(len(raw))
+    n = lib.b64_decode(raw, len(raw), out)
+    if n < 0:
+        raise ValueError("malformed base64")
+    return out.raw[:n]
+
+
+# ---------------------------------------------------------------------------
+# JSON number arrays
+# ---------------------------------------------------------------------------
+
+def parse_f64_array(text: str) -> np.ndarray:
+    """Flat parse of a (possibly nested) JSON number array."""
+    lib = get_lib()
+    if lib is None:
+        return np.asarray(_pyjson.loads(text), dtype=np.float64).ravel()
+    raw = text.encode("ascii")
+    cap = max(1, raw.count(b",") + raw.count(b"[") + 2)
+    out = np.empty(cap, dtype=np.float64)
+    n = lib.json_parse_f64(raw, len(raw),
+                           out.ctypes.data_as(ctypes.POINTER(ctypes.c_double)), cap)
+    if n < 0:
+        raise ValueError("malformed JSON number array")
+    return out[:n].copy()
+
+
+def serialize_f64_array(arr: np.ndarray) -> str:
+    """Flat JSON serialisation of a float64 array."""
+    lib = get_lib()
+    flat = np.ascontiguousarray(arr, dtype=np.float64).ravel()
+    if lib is None:
+        return _pyjson.dumps(flat.tolist())
+    out = ctypes.create_string_buffer(int(flat.size) * 26 + 2)
+    n = lib.json_serialize_f64(flat.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+                               flat.size, out)
+    return out.raw[:n].decode("ascii")
+
+
+# ---------------------------------------------------------------------------
+# batch assembly
+# ---------------------------------------------------------------------------
+
+def gather_pad(arrays: Sequence[np.ndarray], bucket_rows: int) -> np.ndarray:
+    """Concatenate row batches and zero-pad to `bucket_rows` in one pass."""
+    arrays = [np.ascontiguousarray(a) for a in arrays]
+    first = arrays[0]
+    row_shape = first.shape[1:]
+    dtype = first.dtype
+    lib = get_lib()
+    if lib is None:
+        total = sum(a.shape[0] for a in arrays)
+        batch = np.concatenate(arrays, axis=0) if len(arrays) > 1 else first
+        if total < bucket_rows:
+            pad = [(0, bucket_rows - total)] + [(0, 0)] * (batch.ndim - 1)
+            batch = np.pad(batch, pad)
+        return batch
+    row_bytes = int(np.prod(row_shape)) * dtype.itemsize
+    out = np.empty((bucket_rows, *row_shape), dtype=dtype)
+    k = len(arrays)
+    srcs = (ctypes.c_char_p * k)(
+        *[ctypes.cast(ctypes.c_void_p(a.ctypes.data), ctypes.c_char_p) for a in arrays]
+    )
+    rows = (ctypes.c_int64 * k)(*[a.shape[0] for a in arrays])
+    lib.batch_gather_pad(srcs, rows, k, row_bytes, bucket_rows,
+                         out.ctypes.data_as(ctypes.c_char_p))
+    return out

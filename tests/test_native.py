@@ -1,0 +1,84 @@
+"""Native C++ data-plane core: correctness vs Python reference
+implementations (skipped gracefully when no toolchain built the lib,
+but in CI the Makefile builds it on first import)."""
+
+import base64
+import json
+
+import numpy as np
+import pytest
+
+from seldon_core_tpu import native
+
+
+class TestBase64:
+    @pytest.mark.parametrize("n", [0, 1, 2, 3, 4, 255, 1000])
+    def test_encode_matches_stdlib(self, n):
+        data = bytes(range(256))[:n] if n <= 256 else np.random.default_rng(0).bytes(n)
+        assert native.b64encode(data) == base64.b64encode(data).decode()
+
+    @pytest.mark.parametrize("n", [0, 1, 2, 3, 100, 999])
+    def test_decode_roundtrip(self, n):
+        data = np.random.default_rng(n).bytes(n)
+        assert native.b64decode(base64.b64encode(data).decode()) == data
+
+    @pytest.mark.skipif(not native.available(), reason="native lib not built")
+    def test_malformed_rejected(self):
+        with pytest.raises(ValueError):
+            native.b64decode("!!notbase64!!")
+
+
+class TestJsonArrays:
+    def test_parse_matches_json(self):
+        arr = np.random.default_rng(0).normal(size=100)
+        out = native.parse_f64_array(json.dumps(arr.tolist()))
+        np.testing.assert_array_equal(out, arr)
+
+    def test_parse_nested_flattens(self):
+        out = native.parse_f64_array("[[1, 2], [3, 4]]")
+        np.testing.assert_array_equal(out, [1, 2, 3, 4])
+
+    def test_parse_null_is_nan(self):
+        out = native.parse_f64_array("[1, null, 3]")
+        assert np.isnan(out[1])
+
+    def test_serialize_roundtrip_exact(self):
+        arr = np.array([0.0, 1.0, -2.5, 1e-17, 3.141592653589793, 1e300])
+        text = native.serialize_f64_array(arr)
+        np.testing.assert_array_equal(np.asarray(json.loads(text)), arr)
+
+    def test_integers_keep_float_form(self):
+        # "1.0" not "1" — json float round-trip must preserve floatness
+        text = native.serialize_f64_array(np.array([1.0, 2.0]))
+        assert json.loads(text) == [1.0, 2.0]
+
+
+class TestGatherPad:
+    def test_concat_and_pad(self):
+        a = np.arange(6, dtype=np.float32).reshape(2, 3)
+        b = np.arange(6, 9, dtype=np.float32).reshape(1, 3)
+        out = native.gather_pad([a, b], 8)
+        assert out.shape == (8, 3)
+        np.testing.assert_array_equal(out[:3], np.arange(9).reshape(3, 3))
+        assert out[3:].sum() == 0
+
+    def test_exact_fit_no_pad(self):
+        a = np.ones((4, 2), np.uint8)
+        out = native.gather_pad([a], 4)
+        np.testing.assert_array_equal(out, a)
+
+    def test_multidim_rows(self):
+        imgs = [np.full((1, 4, 4, 3), i, np.uint8) for i in range(3)]
+        out = native.gather_pad(imgs, 4)
+        assert out.shape == (4, 4, 4, 3)
+        assert out[1, 0, 0, 0] == 1 and out[3].sum() == 0
+
+    def test_batcher_uses_gather(self):
+        from seldon_core_tpu.batching import DynamicBatcher
+
+        def fn(batch):
+            return batch.sum(axis=tuple(range(1, batch.ndim)), keepdims=False)[:, None]
+
+        with DynamicBatcher(fn, max_batch_size=8, max_wait_ms=0.5) as b:
+            out = b.submit(np.ones((3, 5), np.float32))
+        np.testing.assert_array_equal(out, np.full((3, 1), 5.0))
