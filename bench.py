@@ -127,7 +127,7 @@ async def measure_phase(port: int, shape, seconds: float, concurrency: int, clie
 
 
 async def inprocess_images_per_s(gateway, shape, seconds: float = 5.0,
-                                 concurrency: int = 64, batch: int = 8) -> float:
+                                 concurrency: int = 32, batch: int = 32) -> float:
     """Serving throughput without the wire: gateway -> executor ->
     batcher -> XLA.  On this 1-CPU harness the loopback gRPC phases are
     bound by Python packet handling; this isolates the framework+device
