@@ -48,6 +48,8 @@ def _model_registry() -> Dict[str, Callable[..., Tuple[Any, Tuple[int, ...]]]]:
 
         return factory
 
+    from seldon_core_tpu.models import transformer
+
     img = resnet.IMAGENET_INPUT_SHAPE
     return {
         "resnet18": entry(resnet.ResNet18, img),
@@ -57,6 +59,12 @@ def _model_registry() -> Dict[str, Callable[..., Tuple[Any, Tuple[int, ...]]]]:
         "resnet152": entry(resnet.ResNet152, img),
         "resnet_tiny": entry(resnet.ResNetTiny, (32, 32, 3)),
         "mlp": entry(mlp.MLPClassifier, (4,)),
+        # long-context families: input is a token-id sequence (int32);
+        # input_shape must be given explicitly (the served context length)
+        "transformer_encoder": entry(transformer.TransformerEncoder, None),
+        "transformer_lm": entry(
+            lambda num_classes, dtype, **kw: transformer.TransformerLM(dtype=dtype, **kw), None
+        ),
     }
 
 
@@ -82,6 +90,7 @@ class JaxServer(TPUComponent):
         seed: int = 0,
         mesh: Optional[Any] = None,
         data_axis: str = "data",
+        model_kwargs: Optional[Dict[str, Any]] = None,
         **kwargs: Any,
     ):
         super().__init__(**kwargs)
@@ -104,6 +113,7 @@ class JaxServer(TPUComponent):
         self.seed = int(seed)
         self.mesh = mesh
         self.data_axis = data_axis
+        self.model_kwargs = dict(model_kwargs or {})
         self._loaded = False
         self.module = None
         self.variables = None
@@ -121,7 +131,9 @@ class JaxServer(TPUComponent):
         ]
         registry = _model_registry()
         if self.model_name in registry:
-            module, default_shape = registry[self.model_name](self.num_classes, dtype)
+            module, default_shape = registry[self.model_name](
+                self.num_classes, dtype, **self.model_kwargs
+            )
         else:
             # dotted path to a factory: returns module or (module, shape)
             import importlib

@@ -1,0 +1,119 @@
+"""Transformer family in flax — long-context serving models.
+
+Encoder (classification/embedding) and causal decoder (scoring/LM)
+with a pluggable attention function: the default is single-device
+attention; passing ``attn_fn=ring_attention(...)`` (partially applied
+with a mesh) serves sequences sharded across an ICI ring — the
+long-context path the reference has no counterpart for.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable, Optional
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from seldon_core_tpu.parallel.ring_attention import plain_attention
+
+AttnFn = Callable
+
+
+class TransformerBlock(nn.Module):
+    num_heads: int
+    mlp_ratio: int = 4
+    dtype: Any = jnp.bfloat16
+    attn_fn: AttnFn = staticmethod(plain_attention)
+    causal: bool = False
+
+    @nn.compact
+    def __call__(self, x):
+        d_model = x.shape[-1]
+        head_dim = d_model // self.num_heads
+        y = nn.LayerNorm(dtype=jnp.float32)(x)
+        qkv = nn.Dense(3 * d_model, dtype=self.dtype, name="qkv")(y)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        shape = (*y.shape[:-1], self.num_heads, head_dim)
+        attn_out = self.attn_fn(
+            q.reshape(shape), k.reshape(shape), v.reshape(shape), causal=self.causal
+        )
+        attn_out = attn_out.reshape(y.shape)
+        x = x + nn.Dense(d_model, dtype=self.dtype, name="attn_proj")(attn_out)
+        y = nn.LayerNorm(dtype=jnp.float32)(x)
+        y = nn.Dense(self.mlp_ratio * d_model, dtype=self.dtype, name="mlp_in")(y)
+        y = nn.gelu(y)
+        x = x + nn.Dense(d_model, dtype=self.dtype, name="mlp_out")(y)
+        return x
+
+
+class TransformerEncoder(nn.Module):
+    """Token classifier / sequence classifier over long inputs."""
+
+    num_classes: int = 2
+    vocab_size: int = 32_000
+    d_model: int = 256
+    num_layers: int = 4
+    num_heads: int = 8
+    max_len: int = 2048
+    dtype: Any = jnp.bfloat16
+    attn_fn: AttnFn = staticmethod(plain_attention)
+    pool: str = "mean"  # mean | none (per-token logits)
+
+    @nn.compact
+    def __call__(self, tokens, train: bool = False):
+        tokens = tokens.astype(jnp.int32)
+        x = nn.Embed(self.vocab_size, self.d_model, dtype=self.dtype, name="tok_embed")(tokens)
+        pos = nn.Embed(self.max_len, self.d_model, dtype=self.dtype, name="pos_embed")(
+            jnp.arange(tokens.shape[1])
+        )
+        x = x + pos[None]
+        for i in range(self.num_layers):
+            x = TransformerBlock(
+                num_heads=self.num_heads, dtype=self.dtype, attn_fn=self.attn_fn,
+                causal=False, name=f"block_{i}",
+            )(x)
+        x = nn.LayerNorm(dtype=jnp.float32)(x)
+        if self.pool == "mean":
+            x = x.mean(axis=1)
+        logits = nn.Dense(self.num_classes, dtype=self.dtype, name="head")(x)
+        return logits.astype(jnp.float32)
+
+
+class TransformerLM(nn.Module):
+    """Causal decoder: next-token logits (scoring / generation)."""
+
+    vocab_size: int = 32_000
+    d_model: int = 256
+    num_layers: int = 4
+    num_heads: int = 8
+    max_len: int = 2048
+    dtype: Any = jnp.bfloat16
+    attn_fn: AttnFn = staticmethod(plain_attention)
+
+    @nn.compact
+    def __call__(self, tokens, train: bool = False):
+        tokens = tokens.astype(jnp.int32)
+        x = nn.Embed(self.vocab_size, self.d_model, dtype=self.dtype, name="tok_embed")(tokens)
+        pos = nn.Embed(self.max_len, self.d_model, dtype=self.dtype, name="pos_embed")(
+            jnp.arange(tokens.shape[1])
+        )
+        x = x + pos[None]
+        for i in range(self.num_layers):
+            x = TransformerBlock(
+                num_heads=self.num_heads, dtype=self.dtype, attn_fn=self.attn_fn,
+                causal=True, name=f"block_{i}",
+            )(x)
+        x = nn.LayerNorm(dtype=jnp.float32)(x)
+        logits = nn.Dense(self.vocab_size, dtype=self.dtype, name="head")(x)
+        return logits.astype(jnp.float32)
+
+
+def ring_attn_fn(mesh, seq_axis: str = "seq") -> AttnFn:
+    """Attention function routing through the sequence-parallel ring."""
+    from seldon_core_tpu.parallel.ring_attention import ring_attention
+
+    def fn(q, k, v, causal: bool = False):
+        return ring_attention(q, k, v, mesh=mesh, seq_axis=seq_axis, causal=causal)
+
+    return fn
