@@ -169,6 +169,12 @@ def build_gateway_app(gateway: Gateway) -> web.Application:
 
         return web.Response(body=generate_latest(), content_type=CONTENT_TYPE_LATEST.split(";")[0])
 
+    async def openapi_endpoint(_r: web.Request) -> web.Response:
+        from seldon_core_tpu.runtime.openapi import gateway_openapi
+
+        return web.json_response(gateway_openapi())
+
+    app.router.add_get("/seldon.json", openapi_endpoint)
     app.router.add_post("/api/v0.1/predictions", predictions)
     app.router.add_get("/api/v0.1/predictions", predictions)
     app.router.add_post("/predict", predictions)  # convenience alias

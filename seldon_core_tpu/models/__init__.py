@@ -33,6 +33,15 @@ def _register_optional() -> None:
         register_implementation("TORCH_SERVER", TorchServer)
     except ImportError:
         pass
+    try:
+        from seldon_core_tpu.models.mlflowserver import MLFlowServer
+
+        register_implementation("MLFLOW_SERVER", MLFlowServer)
+    except ImportError:
+        pass
+    from seldon_core_tpu.models.proxyserver import RestProxyServer
+
+    register_implementation("REST_PROXY", RestProxyServer)
 
 
 _register_optional()

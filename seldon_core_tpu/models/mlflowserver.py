@@ -1,0 +1,39 @@
+"""MLFlowServer — serve MLflow pyfunc models (gated on mlflow).
+
+Parity component for the reference's mlflowserver
+(reference: servers/mlflowserver/mlflowserver/MLFlowServer.py):
+download an MLflow model directory from ``model_uri`` and serve its
+pyfunc predict.  Registered as MLFLOW_SERVER when mlflow is importable.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import numpy as np
+
+import mlflow.pyfunc  # noqa: F401 — gate: ImportError skips registration
+
+from seldon_core_tpu.runtime.component import MicroserviceError, TPUComponent
+
+
+class MLFlowServer(TPUComponent):
+    def __init__(self, model_uri: str = "", **kwargs: Any):
+        super().__init__(**kwargs)
+        self.model_uri = model_uri
+        self.model = None
+
+    def load(self) -> None:
+        if self.model is not None:
+            return
+        if not self.model_uri:
+            raise MicroserviceError("MLFlowServer needs a model_uri", status_code=400, reason="MISSING_MODEL_URI")
+        from seldon_core_tpu.utils import storage
+
+        path = storage.download(self.model_uri)
+        self.model = mlflow.pyfunc.load_model(path)
+
+    def predict(self, X, names, meta=None):
+        if self.model is None:
+            self.load()
+        return np.asarray(self.model.predict(np.asarray(X)))

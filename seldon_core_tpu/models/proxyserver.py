@@ -1,0 +1,86 @@
+"""Proxies to external inference servers.
+
+Parity for the reference's integration proxies — TFServing
+(reference: integrations/tfserving/TfServingProxy.py:20-126), the
+pre-Triton NVIDIA inference server
+(reference: integrations/nvidia-inference-server/TRTProxy.py:50-81) and
+SageMaker (reference: integrations/sagemaker/SagemakerProxy.py): a
+graph node that translates the SeldonMessage payload to an external
+server's HTTP API and back, so existing model servers join a TPU
+inference graph without rewrapping.
+
+* ``RestProxyServer`` — generic JSON-over-HTTP proxy with configurable
+  request/response field names; the defaults speak the TFServing /
+  KServe v1 dialect (``{"instances": [...]}`` -> ``{"predictions":
+  [...]}``).
+* ``OpenAIChatProxy`` shape intentionally omitted — out of the
+  reference's scope.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from seldon_core_tpu.runtime.component import MicroserviceError, TPUComponent
+
+
+class RestProxyServer(TPUComponent):
+    def __init__(
+        self,
+        url: str = "",
+        request_field: str = "instances",
+        response_field: str = "predictions",
+        timeout_s: float = 10.0,
+        retries: int = 2,
+        headers_json: str = "{}",
+        **kwargs: Any,
+    ):
+        super().__init__(**kwargs)
+        if not url:
+            raise MicroserviceError("RestProxyServer needs a url", status_code=400, reason="MISSING_URL")
+        self.url = url
+        self.request_field = request_field
+        self.response_field = response_field
+        self.timeout_s = float(timeout_s)
+        self.retries = int(retries)
+        self.headers = json.loads(headers_json) if isinstance(headers_json, str) else dict(headers_json)
+        self._session = None
+
+    def _post(self, body: Dict[str, Any]) -> Dict[str, Any]:
+        import requests
+
+        if self._session is None:
+            self._session = requests.Session()
+        last: Optional[Exception] = None
+        for _ in range(self.retries + 1):
+            try:
+                resp = self._session.post(self.url, json=body, headers=self.headers, timeout=self.timeout_s)
+                if resp.status_code >= 400:
+                    raise MicroserviceError(
+                        f"upstream {self.url} returned {resp.status_code}: {resp.text[:200]}",
+                        status_code=502,
+                        reason="UPSTREAM_ERROR",
+                    )
+                return resp.json()
+            except MicroserviceError:
+                raise
+            except Exception as e:  # noqa: BLE001
+                last = e
+        raise MicroserviceError(
+            f"upstream {self.url} unreachable: {last}", status_code=502, reason="UPSTREAM_UNREACHABLE"
+        )
+
+    def predict(self, X, names, meta=None):
+        payload = np.asarray(X).tolist() if not isinstance(X, (str, bytes, dict)) else X
+        out = self._post({self.request_field: payload})
+        if self.response_field not in out:
+            raise MicroserviceError(
+                f"upstream response missing {self.response_field!r}", status_code=502, reason="BAD_UPSTREAM_RESPONSE"
+            )
+        return np.asarray(out[self.response_field])
+
+    def health_status(self):
+        return {"proxy": self.url}

@@ -119,6 +119,13 @@ def build_app(
 
         return web.Response(body=generate_latest(), content_type=CONTENT_TYPE_LATEST.split(";")[0])
 
+    async def openapi_endpoint(_request: web.Request) -> web.Response:
+        from seldon_core_tpu.runtime.openapi import wrapper_openapi
+
+        return web.json_response(wrapper_openapi())
+
+    app.router.add_get("/seldon.json", openapi_endpoint)
+
     for path, fn in (
         ("/predict", dispatch.predict),
         ("/api/v0.1/predictions", dispatch.predict),  # engine-compatible alias

@@ -91,3 +91,30 @@ class HttpPairLogger:
     def close(self) -> None:
         self._queue.put(None)
         self._thread.join(timeout=5.0)
+
+
+class KafkaPairLogger:
+    """Stream pairs to a Kafka topic (reference analogue: the kafka/
+    integration for streaming request logging, reference: kafka/
+    kafka.json + zookeeper-k8s/).  Gated on a Kafka client package
+    being installed; raises a clear error otherwise."""
+
+    def __init__(self, bootstrap_servers: str, topic: str = "seldon-request-pairs"):
+        try:
+            from kafka import KafkaProducer  # type: ignore
+        except ImportError as e:
+            raise RuntimeError(
+                "KafkaPairLogger needs the kafka-python package installed"
+            ) from e
+        self.topic = topic
+        self._producer = KafkaProducer(
+            bootstrap_servers=bootstrap_servers,
+            value_serializer=lambda v: json.dumps(v).encode("utf-8"),
+        )
+
+    def __call__(self, request: InternalMessage, response: InternalMessage) -> None:
+        self._producer.send(self.topic, build_pair(request, response))
+
+    def close(self) -> None:
+        self._producer.flush()
+        self._producer.close()

@@ -1,0 +1,93 @@
+"""OpenAPI documents + external-server proxy tests."""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+from seldon_core_tpu.runtime import TPUComponent
+from seldon_core_tpu.runtime.openapi import gateway_openapi, wrapper_openapi
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+class TestOpenApi:
+    def test_wrapper_document_shape(self):
+        doc = wrapper_openapi()
+        assert doc["openapi"].startswith("3.")
+        assert "/predict" in doc["paths"]
+        assert "/aggregate" in doc["paths"]
+        assert "SeldonMessage" in doc["components"]["schemas"]
+        assert "RawTensor" in doc["components"]["schemas"]
+
+    def test_gateway_document_shape(self):
+        doc = gateway_openapi()
+        assert "/api/v0.1/predictions" in doc["paths"]
+        assert "/api/v0.1/explanations" in doc["paths"]
+
+    def test_served_at_seldon_json(self):
+        from aiohttp.test_utils import TestClient, TestServer
+
+        from seldon_core_tpu.runtime import rest
+
+        class M(TPUComponent):
+            def predict(self, X, names, meta=None):
+                return X
+
+        async def scenario():
+            client = TestClient(TestServer(rest.build_app(M())))
+            await client.start_server()
+            resp = await client.get("/seldon.json")
+            body = await resp.json()
+            await client.close()
+            return resp.status, body
+
+        status, body = run(scenario())
+        assert status == 200
+        assert body["info"]["title"].startswith("seldon-core-tpu")
+
+
+class TestRestProxy:
+    def test_proxies_to_external_server(self):
+        """Spin a fake TFServing-dialect upstream and proxy through it."""
+        from aiohttp import web
+        from aiohttp.test_utils import TestServer
+
+        from seldon_core_tpu.models.proxyserver import RestProxyServer
+
+        async def scenario():
+            async def upstream(request: web.Request) -> web.Response:
+                body = await request.json()
+                instances = np.asarray(body["instances"])
+                return web.json_response({"predictions": (instances * 3).tolist()})
+
+            app = web.Application()
+            app.router.add_post("/v1/models/m:predict", upstream)
+            server = TestServer(app)
+            await server.start_server()
+
+            proxy = RestProxyServer(
+                url=f"http://127.0.0.1:{server.port}/v1/models/m:predict", timeout_s=5
+            )
+            out = await asyncio.to_thread(proxy.predict, np.array([[1.0, 2.0]]), [])
+            await server.close()
+            return out
+
+        out = run(scenario())
+        np.testing.assert_array_equal(out, [[3.0, 6.0]])
+
+    def test_upstream_error_maps_to_microservice_error(self):
+        from seldon_core_tpu.models.proxyserver import RestProxyServer
+        from seldon_core_tpu.runtime import MicroserviceError
+
+        proxy = RestProxyServer(url="http://127.0.0.1:1/none", timeout_s=0.2, retries=0)
+        with pytest.raises(MicroserviceError):
+            proxy.predict(np.ones((1, 2)), [])
+
+    def test_registered(self):
+        import seldon_core_tpu.models  # noqa: F401
+        from seldon_core_tpu.engine.units import BUILTIN_IMPLEMENTATIONS
+
+        assert "REST_PROXY" in BUILTIN_IMPLEMENTATIONS

@@ -1,0 +1,99 @@
+#!/usr/bin/env python
+"""Data-plane profiling harness.
+
+Equivalent of the reference's engine profiling image
+(reference: testing/profiling/engine/) for the TPU data plane: drives
+the in-process predict path under load and reports where request time
+goes — cProfile for the Python orchestration layers and (optionally)
+a jax profiler trace for the device timeline.
+
+    python tools/profile_dataplane.py [--spec examples/single_model.yaml]
+        [--seconds 5] [--concurrency 16] [--jax-trace /tmp/jaxtrace]
+        [--top 30]
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import cProfile
+import io
+import os
+import pstats
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--spec", default=None, help="deployment spec yaml (default: stub model)")
+    parser.add_argument("--seconds", type=float, default=5.0)
+    parser.add_argument("--concurrency", type=int, default=16)
+    parser.add_argument("--batch", type=int, default=1)
+    parser.add_argument("--jax-trace", default=None, help="directory for a jax profiler trace")
+    parser.add_argument("--top", type=int, default=30)
+    args = parser.parse_args()
+
+    from seldon_core_tpu.controlplane import Deployer, TpuDeployment
+    from seldon_core_tpu.runtime.message import InternalMessage
+
+    if args.spec:
+        spec = TpuDeployment.load(args.spec)
+    else:
+        spec = TpuDeployment.from_dict(
+            {
+                "name": "profile-target",
+                "predictors": [
+                    {"name": "main", "graph": {"name": "stub", "type": "MODEL",
+                                               "implementation": "SIMPLE_MODEL"}}
+                ],
+            }
+        )
+
+    async def drive() -> int:
+        deployer = Deployer()
+        managed = await deployer.apply(spec)
+        payload = np.ones((args.batch, 4), np.float32)
+        done = 0
+        stop_at = time.perf_counter() + args.seconds
+
+        async def worker():
+            nonlocal done
+            while time.perf_counter() < stop_at:
+                msg = InternalMessage(payload=payload, kind="rawTensor")
+                await managed.gateway.predict(msg)
+                done += 1
+
+        await asyncio.gather(*(worker() for _ in range(args.concurrency)))
+        await deployer.delete(spec.name)
+        return done
+
+    if args.jax_trace:
+        import jax
+
+        jax.profiler.start_trace(args.jax_trace)
+
+    profiler = cProfile.Profile()
+    profiler.enable()
+    total = asyncio.run(drive())
+    profiler.disable()
+
+    if args.jax_trace:
+        import jax
+
+        jax.profiler.stop_trace()
+        print(f"jax trace written to {args.jax_trace}", file=sys.stderr)
+
+    out = io.StringIO()
+    stats = pstats.Stats(profiler, stream=out)
+    stats.sort_stats("cumulative").print_stats(args.top)
+    print(out.getvalue())
+    print(f"requests={total} qps={total / args.seconds:.1f}")
+
+
+if __name__ == "__main__":
+    main()
