@@ -12,8 +12,9 @@ register_implementation("EPSILON_GREEDY", EpsilonGreedy)
 register_implementation("THOMPSON_SAMPLING", ThompsonSampling)
 
 try:  # detectors that need only numpy/jax register unconditionally
-    from seldon_core_tpu.components.outliers import MahalanobisDetector  # noqa: F401
+    from seldon_core_tpu.components.outliers import MahalanobisDetector, VAEOutlierDetector  # noqa: F401
 
     register_implementation("OUTLIER_MAHALANOBIS", MahalanobisDetector)
+    register_implementation("OUTLIER_VAE", VAEOutlierDetector)
 except ImportError:  # pragma: no cover
     pass

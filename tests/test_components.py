@@ -195,3 +195,37 @@ class TestMahalanobis:
         fresh.restore_state(state)
         assert fresh.n == det.n
         np.testing.assert_allclose(fresh.mean, det.mean)
+
+
+class TestVAEOutlier:
+    def test_fit_and_detect(self, tmp_path):
+        from seldon_core_tpu.components.outliers import VAEOutlierDetector
+
+        rng = np.random.default_rng(0)
+        normal = rng.normal(size=(256, 4)).astype(np.float32) * 0.1
+        det = VAEOutlierDetector(latent_dim=2, hidden_dim=16, seed=0)
+        losses = det.fit(normal, epochs=100)
+        assert losses[-1] < losses[0]  # training converges
+
+        normal_scores = det.score(normal[:16])
+        outlier_scores = det.score(np.full((4, 4), 8.0, np.float32))
+        assert outlier_scores.mean() > normal_scores.mean() * 10
+        det.threshold = float(normal_scores.max() * 5)
+        det.score(np.full((1, 4), 8.0, np.float32))
+        assert det.tags()["outlier"] is True
+
+        # save -> reload -> same scores
+        path = str(tmp_path / "vae.msgpack")
+        det.save(path)
+        fresh = VAEOutlierDetector(n_features=4, latent_dim=2, hidden_dim=16,
+                                   model_uri=path, seed=0)
+        fresh.load()
+        np.testing.assert_allclose(
+            fresh.score(normal[:8]), det.score(normal[:8]), rtol=1e-5
+        )
+
+    def test_registered(self):
+        import seldon_core_tpu.components  # noqa: F401
+        from seldon_core_tpu.engine.units import BUILTIN_IMPLEMENTATIONS
+
+        assert "OUTLIER_VAE" in BUILTIN_IMPLEMENTATIONS
