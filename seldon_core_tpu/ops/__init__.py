@@ -1,0 +1,9 @@
+"""Pallas TPU kernels for serving hot ops."""
+
+from seldon_core_tpu.ops.kernels import (  # noqa: F401
+    Int8Dense,
+    fused_normalize,
+    imagenet_affine,
+    int8_matmul,
+    quantize_weights,
+)

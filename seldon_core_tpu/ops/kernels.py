@@ -1,0 +1,164 @@
+"""Pallas TPU kernels for serving hot ops.
+
+Two ops dominate image/tabular serving outside the model matmuls:
+
+* ``fused_normalize`` — uint8 NHWC batch -> normalised activation dtype
+  in one VMEM pass (cast + per-channel affine fused; otherwise XLA
+  runs a convert + broadcast-multiply + add chain over HBM before the
+  first conv).
+* ``int8_matmul`` — weight-quantised dense layer: int8 weights dequant
+  *inside* the matmul tile (per-output-channel scales), halving weight
+  HBM footprint and bandwidth.  ``Int8Dense`` wraps it as a flax module
+  and ``quantize_weights`` converts trained f32/bf16 kernels.
+
+Kernels run in interpret mode automatically off-TPU, so the test tier
+exercises them on the virtual CPU mesh; on TPU they compile to Mosaic.
+(reference has no counterpart — its data plane never touches the
+accelerator; this is part of the TPU-first redesign.)
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import numpy as np
+
+
+def _use_interpret() -> bool:
+    import jax
+
+    return jax.default_backend() != "tpu"
+
+
+# ---------------------------------------------------------------------------
+# fused uint8 -> normalised float
+# ---------------------------------------------------------------------------
+
+def _normalize_kernel(x_ref, scale_ref, shift_ref, o_ref):
+    import jax.numpy as jnp
+
+    x = x_ref[...].astype(jnp.float32)
+    o_ref[...] = (x * scale_ref[...] + shift_ref[...]).astype(o_ref.dtype)
+
+
+def fused_normalize(x, scale, shift, out_dtype=None):
+    """(batch, H, W, C) uint8 -> out_dtype, y = x * scale + shift per channel.
+
+    scale/shift: (C,) arrays; e.g. imagenet normalisation folded into
+    a = 1/(255*std), b = -mean/std.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    out_dtype = out_dtype or jnp.bfloat16
+    batch = x.shape[0]
+    img_shape = x.shape[1:]
+    c = img_shape[-1]
+    scale = jnp.asarray(scale, jnp.float32).reshape((1,) * (len(img_shape) - 1) + (c,))
+    shift = jnp.asarray(shift, jnp.float32).reshape((1,) * (len(img_shape) - 1) + (c,))
+
+    return pl.pallas_call(
+        _normalize_kernel,
+        grid=(batch,),
+        in_specs=[
+            pl.BlockSpec((1, *img_shape), lambda i: (i, *([0] * len(img_shape)))),
+            pl.BlockSpec(scale.shape, lambda i: (0,) * scale.ndim),
+            pl.BlockSpec(shift.shape, lambda i: (0,) * shift.ndim),
+        ],
+        out_specs=pl.BlockSpec((1, *img_shape), lambda i: (i, *([0] * len(img_shape)))),
+        out_shape=jax.ShapeDtypeStruct(x.shape, out_dtype),
+        interpret=_use_interpret(),
+    )(x, scale, shift)
+
+
+def imagenet_affine(mean=(0.485, 0.456, 0.406), std=(0.229, 0.224, 0.225)) -> Tuple[np.ndarray, np.ndarray]:
+    """Fold 'x/255 then standardise' into one per-channel affine."""
+    mean = np.asarray(mean, np.float32)
+    std = np.asarray(std, np.float32)
+    return 1.0 / (255.0 * std), -mean / std
+
+
+# ---------------------------------------------------------------------------
+# int8-weight matmul (dequant fused into the tile)
+# ---------------------------------------------------------------------------
+
+def _int8_matmul_kernel(x_ref, w_ref, scale_ref, o_ref):
+    import jax.numpy as jnp
+
+    x = x_ref[...].astype(jnp.float32)
+    w = w_ref[...].astype(jnp.float32)  # dequant happens in-register
+    acc = jnp.dot(x, w, preferred_element_type=jnp.float32)
+    o_ref[...] = (acc * scale_ref[...]).astype(o_ref.dtype)
+
+
+def int8_matmul(x, w_int8, scale, block_m: int = 128, block_n: int = 128, out_dtype=None):
+    """y = (x @ dequant(w)) with w stored int8, per-column scales.
+
+    x: (M, K) float; w_int8: (K, N) int8; scale: (N,) f32.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    out_dtype = out_dtype or x.dtype
+    m, k = x.shape
+    k2, n = w_int8.shape
+    assert k == k2, (x.shape, w_int8.shape)
+    bm = min(block_m, m)
+    bn = min(block_n, n)
+    # pad M/N up to block multiples; K stays whole (fits VMEM for serving widths)
+    m_pad = (-m) % bm
+    n_pad = (-n) % bn
+    if m_pad:
+        x = jnp.pad(x, ((0, m_pad), (0, 0)))
+    if n_pad:
+        w_int8 = jnp.pad(w_int8, ((0, 0), (0, n_pad)))
+        scale = jnp.pad(scale, (0, n_pad))
+    mp, np_ = x.shape[0], w_int8.shape[1]
+    scale2d = jnp.asarray(scale, jnp.float32)[None, :]
+
+    out = pl.pallas_call(
+        _int8_matmul_kernel,
+        grid=(mp // bm, np_ // bn),
+        in_specs=[
+            pl.BlockSpec((bm, k), lambda i, j: (i, 0)),
+            pl.BlockSpec((k, bn), lambda i, j: (0, j)),
+            pl.BlockSpec((1, bn), lambda i, j: (0, j)),
+        ],
+        out_specs=pl.BlockSpec((bm, bn), lambda i, j: (i, j)),
+        out_shape=jax.ShapeDtypeStruct((mp, np_), out_dtype),
+        interpret=_use_interpret(),
+    )(x, w_int8, scale2d)
+    return out[:m, :n]
+
+
+def quantize_weights(w) -> Tuple[np.ndarray, np.ndarray]:
+    """Symmetric per-output-channel int8 quantisation of a (K, N) kernel."""
+    w = np.asarray(w, np.float32)
+    max_abs = np.abs(w).max(axis=0)
+    scale = np.where(max_abs > 0, max_abs / 127.0, 1.0).astype(np.float32)
+    w_q = np.clip(np.round(w / scale[None, :]), -127, 127).astype(np.int8)
+    return w_q, scale
+
+
+class Int8Dense:
+    """A serving-time dense layer with int8 weights.
+
+    Built from a trained kernel/bias; callable on device arrays.  Used
+    to swap heavy projection layers of a served model for the
+    quantised kernel (half the HBM, same API).
+    """
+
+    def __init__(self, kernel, bias=None):
+        self.w_q, self.scale = quantize_weights(kernel)
+        self.bias = None if bias is None else np.asarray(bias, np.float32)
+
+    def __call__(self, x):
+        import jax.numpy as jnp
+
+        y = int8_matmul(x, jnp.asarray(self.w_q), jnp.asarray(self.scale))
+        if self.bias is not None:
+            y = y + jnp.asarray(self.bias, y.dtype)
+        return y

@@ -1,0 +1,88 @@
+"""Multi-host (DCN) support.
+
+The distributed story has two layers, mirroring how the reference
+splits in-cluster networking (pod network gRPC) from model compute:
+
+1. **Within a model**: a multi-host `jax.sharding.Mesh` spanning all
+   processes of a TPU pod slice.  jax's distributed runtime wires the
+   hosts; XLA routes collectives over ICI within a slice and DCN
+   across slices.  ``initialize`` + ``global_mesh`` below are the
+   entry points; every sharding helper in this package works unchanged
+   on a multi-host mesh because they only speak axis names.
+2. **Between graph nodes**: cross-host graph edges use the engine's
+   remote transports (gRPC/REST with channel caching, deadlines,
+   retries — engine/transport.py), exactly like the reference's
+   engine->microservice calls (reference:
+   InternalPredictionService.java:192-467).  The control plane places
+   co-located nodes in-process and emits endpoints for remote ones.
+
+Single-host processes can exercise layer 1 with the virtual-device
+fallback (``xla_force_host_platform_device_count``), which is how the
+test tier and the driver's dry-run validate the sharded programs.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Dict, Optional
+
+logger = logging.getLogger(__name__)
+
+
+def initialize(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> bool:
+    """Join the jax distributed runtime (idempotent).
+
+    Arguments default from the standard env vars
+    (JAX_COORDINATOR_ADDRESS / JAX_NUM_PROCESSES / JAX_PROCESS_ID or a
+    TPU-pod metadata-driven auto-config when all are absent).  Returns
+    True when running multi-process.
+    """
+    import jax
+
+    coordinator_address = coordinator_address or os.environ.get("JAX_COORDINATOR_ADDRESS")
+    num_processes = num_processes or _env_int("JAX_NUM_PROCESSES")
+    process_id = process_id if process_id is not None else _env_int("JAX_PROCESS_ID")
+
+    if coordinator_address is None and num_processes is None:
+        # single-host; TPU pod slices auto-configure via the plugin
+        return jax.process_count() > 1
+    try:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+    except RuntimeError as e:  # already initialised
+        logger.debug("jax.distributed.initialize: %s", e)
+    return jax.process_count() > 1
+
+
+def _env_int(name: str) -> Optional[int]:
+    raw = os.environ.get(name)
+    return int(raw) if raw else None
+
+
+def global_mesh(axes: Dict[str, int]):
+    """A mesh over every device of every process (call after
+    ``initialize``); axis sizes follow ``create_mesh`` semantics."""
+    import jax
+
+    from seldon_core_tpu.parallel.mesh import create_mesh
+
+    return create_mesh(axes, devices=jax.devices())
+
+
+def host_info() -> Dict[str, int]:
+    import jax
+
+    return {
+        "process_index": jax.process_index(),
+        "process_count": jax.process_count(),
+        "local_devices": len(jax.local_devices()),
+        "global_devices": len(jax.devices()),
+    }

@@ -1,0 +1,77 @@
+"""Pallas kernel tests (interpret mode on the CPU tier; Mosaic on TPU)."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from seldon_core_tpu.ops import (
+    Int8Dense,
+    fused_normalize,
+    imagenet_affine,
+    int8_matmul,
+    quantize_weights,
+)
+
+
+class TestFusedNormalize:
+    def test_matches_reference(self):
+        rng = np.random.default_rng(0)
+        x = rng.integers(0, 256, size=(2, 8, 8, 3), dtype=np.uint8)
+        scale, shift = imagenet_affine()
+        out = fused_normalize(jnp.asarray(x), scale, shift, out_dtype=jnp.float32)
+        expected = x.astype(np.float32) * scale.reshape(1, 1, 1, 3) + shift.reshape(1, 1, 1, 3)
+        np.testing.assert_allclose(np.asarray(out), expected, rtol=1e-5, atol=1e-5)
+
+    def test_bf16_output(self):
+        x = np.zeros((1, 4, 4, 3), np.uint8)
+        out = fused_normalize(jnp.asarray(x), *imagenet_affine())
+        assert str(out.dtype) == "bfloat16"
+
+    def test_imagenet_affine_folding(self):
+        scale, shift = imagenet_affine()
+        # pixel 255 with mean .5/std .25 -> (1.0 - mean)/std
+        manual = (255 / 255.0 - 0.485) / 0.229
+        assert 255 * scale[0] + shift[0] == pytest.approx(manual, rel=1e-5)
+
+
+class TestInt8Matmul:
+    def test_quantize_roundtrip_error_small(self):
+        rng = np.random.default_rng(0)
+        w = rng.normal(size=(64, 32)).astype(np.float32)
+        w_q, scale = quantize_weights(w)
+        assert w_q.dtype == np.int8
+        deq = w_q.astype(np.float32) * scale[None, :]
+        assert np.abs(deq - w).max() < np.abs(w).max() / 100  # <1% of range
+
+    def test_matmul_matches_dequant_reference(self):
+        rng = np.random.default_rng(1)
+        x = rng.normal(size=(16, 64)).astype(np.float32)
+        w = rng.normal(size=(64, 48)).astype(np.float32)
+        w_q, scale = quantize_weights(w)
+        out = int8_matmul(jnp.asarray(x), jnp.asarray(w_q), jnp.asarray(scale),
+                          block_m=8, block_n=16)
+        expected = x @ (w_q.astype(np.float32) * scale[None, :])
+        np.testing.assert_allclose(np.asarray(out), expected, rtol=1e-4, atol=1e-4)
+
+    def test_ragged_shapes_padded(self):
+        rng = np.random.default_rng(2)
+        x = rng.normal(size=(5, 16)).astype(np.float32)  # M=5 not a block multiple
+        w = rng.normal(size=(16, 10)).astype(np.float32)
+        w_q, scale = quantize_weights(w)
+        out = int8_matmul(jnp.asarray(x), jnp.asarray(w_q), jnp.asarray(scale),
+                          block_m=4, block_n=8)
+        expected = x @ (w_q.astype(np.float32) * scale[None, :])
+        assert out.shape == (5, 10)
+        np.testing.assert_allclose(np.asarray(out), expected, rtol=1e-4, atol=1e-4)
+
+    def test_int8_dense_layer(self):
+        rng = np.random.default_rng(3)
+        kernel = rng.normal(size=(32, 16)).astype(np.float32)
+        bias = rng.normal(size=(16,)).astype(np.float32)
+        layer = Int8Dense(kernel, bias)
+        x = rng.normal(size=(4, 32)).astype(np.float32)
+        out = np.asarray(layer(jnp.asarray(x)))
+        expected = x @ kernel + bias
+        # quantisation error bounded relative to activation scale
+        assert np.abs(out - expected).max() < 0.1 * np.abs(expected).max()
