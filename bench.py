@@ -183,17 +183,15 @@ async def main() -> None:
     t_setup = time.perf_counter()
     gateway, server, shape = build_gateway()
 
-    from seldon_core_tpu.engine.server import add_seldon_service
+    from seldon_core_tpu.engine.server import GrpcServerHandle
+    from seldon_core_tpu.engine.sync_server import build_sync_seldon_server
 
-    grpc_server = grpc.aio.server(
-        options=[
-            ("grpc.max_send_message_length", 64 * 1024 * 1024),
-            ("grpc.max_receive_message_length", 64 * 1024 * 1024),
-        ]
+    raw_server = build_sync_seldon_server(
+        gateway, asyncio.get_running_loop(), max_message_bytes=64 * 1024 * 1024
     )
-    add_seldon_service(grpc_server, gateway)
-    port = grpc_server.add_insecure_port("127.0.0.1:0")
-    await grpc_server.start()
+    port = raw_server.add_insecure_port("127.0.0.1:0")
+    raw_server.start()
+    grpc_server = GrpcServerHandle(raw_server, is_aio=False)
     setup_s = time.perf_counter() - t_setup
 
     # ---- phase 1: latency (low concurrency, batch-1 requests) ------------

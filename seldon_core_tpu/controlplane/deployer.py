@@ -73,7 +73,9 @@ def build_generation(spec: TpuDeployment, device_ids: Optional[List[int]] = None
         from seldon_core_tpu.utils.metrics import PrometheusObserver
 
         observer = PrometheusObserver(deployment_name=spec.name, predictor_name=p.name)
-        svc = PredictorService(p.graph, name=p.name, observer=observer)
+        svc = PredictorService(
+            p.graph, name=p.name, observer=observer, annotations=spec.annotations
+        )
         if p.explainer:
             _attach_explainer(svc, p.explainer)
         if p.shadow:
@@ -202,11 +204,7 @@ async def serve_deployment(
     ManagedDeployment on every request, so rolling swaps take effect
     without socket churn.
     """
-    import grpc
-    from aiohttp import web
-
     from seldon_core_tpu.engine import server as engine_server
-    from seldon_core_tpu.runtime import rest
 
     managed = deployer.deployments[name]
     spec = managed.current.spec
@@ -219,13 +217,9 @@ async def serve_deployment(
         def __getattr__(self, attr):
             return getattr(managed.gateway, attr)
 
-    proxy = _GatewayProxy()
-    app = engine_server.build_gateway_app(proxy)
-    runner = await rest.serve(app, host=host, port=http_port)
-    grpc_srv = grpc.aio.server()
-    engine_server.add_seldon_service(grpc_srv, proxy)
-    grpc_srv.add_insecure_port(f"{host}:{grpc_port}")
-    await grpc_srv.start()
+    runner, grpc_srv = await engine_server.serve_gateway(
+        _GatewayProxy(), host=host, http_port=http_port, grpc_port=grpc_port
+    )
     logger.info("deployment %s serving http=:%d grpc=:%d", name, http_port, grpc_port)
     return runner, grpc_srv
 

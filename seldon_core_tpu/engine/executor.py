@@ -74,8 +74,23 @@ def _instantiate_component(unit: UnitSpec) -> Any:
     return None
 
 
-def build_client(unit: UnitSpec) -> Optional[NodeClient]:
-    """Pick the transport for a unit: in-process beats remote."""
+def build_client(unit: UnitSpec, annotations: Optional[Dict[str, str]] = None) -> Optional[NodeClient]:
+    """Pick the transport for a unit: in-process beats remote.
+
+    `annotations` carries the deployment's cross-cutting knobs; the
+    remote transports honour the reference's timeout/retry annotations
+    (reference: InternalPredictionService.java:80-98):
+    seldon.io/rest-connection-timeout (ms), seldon.io/rest-read-timeout
+    (ms), seldon.io/rest-retries, seldon.io/grpc-read-timeout (ms).
+    """
+    ann = annotations or {}
+
+    def _ms(key: str, default_s: float) -> float:
+        try:
+            return float(ann[key]) / 1000.0
+        except (KeyError, ValueError):
+            return default_s
+
     component = _instantiate_component(unit)
     if component is not None:
         if hasattr(component, "load"):
@@ -83,8 +98,17 @@ def build_client(unit: UnitSpec) -> Optional[NodeClient]:
         return LocalClient(unit, component)
     if unit.endpoint is not None:
         if unit.endpoint.transport == REST:
-            return RestClient(unit)
-        return GrpcClient(unit)
+            try:
+                retries = int(ann.get("seldon.io/rest-retries", 3))
+            except ValueError:
+                retries = 3
+            return RestClient(
+                unit,
+                connect_timeout_s=_ms("seldon.io/rest-connection-timeout", 2.0),
+                read_timeout_s=_ms("seldon.io/rest-read-timeout", 5.0),
+                retries=retries,
+            )
+        return GrpcClient(unit, deadline_s=_ms("seldon.io/grpc-read-timeout", 5.0))
     return None
 
 
@@ -96,6 +120,7 @@ class GraphExecutor:
         root: UnitSpec,
         clients: Optional[Dict[str, NodeClient]] = None,
         observer: Optional[Observer] = None,
+        annotations: Optional[Dict[str, str]] = None,
     ):
         validate_graph(root)
         self.root = root
@@ -105,7 +130,7 @@ class GraphExecutor:
             if clients is not None and unit.name in clients:
                 self.clients[unit.name] = clients[unit.name]
             else:
-                client = build_client(unit)
+                client = build_client(unit, annotations)
                 if client is not None:
                     self.clients[unit.name] = client
         # fail fast on unexecutable nodes with methods

@@ -207,18 +207,43 @@ def add_seldon_service(server: grpc.aio.Server, gateway: Gateway) -> None:
     )
 
 
+class GrpcServerHandle:
+    """Uniform async facade over the sync and aio gRPC servers."""
+
+    def __init__(self, server, is_aio: bool):
+        self.server = server
+        self.is_aio = is_aio
+
+    async def stop(self, grace=None):
+        if self.is_aio:
+            await self.server.stop(grace)
+        else:
+            event = self.server.stop(grace)
+            await asyncio.get_running_loop().run_in_executor(None, event.wait)
+
+
 async def serve_gateway(
     gateway: Gateway,
     host: str = "0.0.0.0",
     http_port: int = 8000,
     grpc_port: int = 5001,
     max_message_bytes: int = 512 * 1024 * 1024,
+    grpc_mode: str = "sync",  # sync (fast path, default) | aio
 ):
-    """Start REST + gRPC front servers; returns (runner, grpc_server)."""
+    """Start REST + gRPC front servers; returns (runner, GrpcServerHandle)."""
     from seldon_core_tpu.runtime import rest
 
     app = build_gateway_app(gateway)
     runner = await rest.serve(app, host=host, port=http_port)
+    if grpc_mode == "sync":
+        from seldon_core_tpu.engine.sync_server import build_sync_seldon_server
+
+        server = build_sync_seldon_server(
+            gateway, asyncio.get_running_loop(), max_message_bytes=max_message_bytes
+        )
+        server.add_insecure_port(f"{host}:{grpc_port}")
+        server.start()
+        return runner, GrpcServerHandle(server, is_aio=False)
     server = grpc.aio.server(
         options=[
             ("grpc.max_send_message_length", max_message_bytes),
@@ -228,4 +253,4 @@ async def serve_gateway(
     add_seldon_service(server, gateway)
     server.add_insecure_port(f"{host}:{grpc_port}")
     await server.start()
-    return runner, server
+    return runner, GrpcServerHandle(server, is_aio=True)

@@ -10,6 +10,7 @@ graceful drain).
 from __future__ import annotations
 
 import asyncio
+import itertools
 import logging
 import secrets
 import time
@@ -23,9 +24,17 @@ from seldon_core_tpu.runtime.message import InternalFeedback, InternalMessage
 logger = logging.getLogger(__name__)
 
 
+_PUID_PREFIX = secrets.token_hex(6)
+_puid_counter = itertools.count()
+
+
 def new_puid() -> str:
-    """Unique request id (reference: PredictionService.java:72-78)."""
-    return secrets.token_hex(13)
+    """Unique request id (reference: PredictionService.java:72-78).
+
+    Random per-process prefix + atomic counter: collision-safe across
+    processes without an entropy syscall per request (urandom showed
+    up in the serving-path profile)."""
+    return f"{_PUID_PREFIX}{next(_puid_counter):012x}"
 
 
 def failure_message(error: Exception, puid: str = "") -> InternalMessage:
@@ -54,9 +63,10 @@ class PredictorService:
         log_requests: bool = False,
         log_responses: bool = False,
         request_logger: Optional[Callable[[InternalMessage, InternalMessage], None]] = None,
+        annotations: Optional[Dict[str, str]] = None,
     ):
         self.name = name
-        self.executor = GraphExecutor(graph, observer=observer)
+        self.executor = GraphExecutor(graph, observer=observer, annotations=annotations)
         self.graph = graph
         self._paused = False
         self._inflight = 0
@@ -154,6 +164,71 @@ class PredictorService:
                 self._inflight_zero.set()
             elapsed = time.perf_counter() - start
             self.executor._emit("predict_done", self.name, elapsed)
+
+    # ---- synchronous fast path -------------------------------------------
+
+    def single_local_model(self):
+        """(unit, component) when this predictor is one in-process MODEL
+        node — the shape eligible for the no-event-loop fast path."""
+        from seldon_core_tpu.engine.transport import LocalClient
+
+        unit = self.graph
+        if unit.children or unit.type != "MODEL":
+            return None
+        client = self.executor.clients.get(unit.name)
+        if not isinstance(client, LocalClient):
+            return None
+        return unit, client.component
+
+    def predict_sync(self, request: InternalMessage) -> InternalMessage:
+        """Synchronous predict for single-local-MODEL graphs.
+
+        Semantics identical to the async path (puid, requestPath,
+        metric collection, status, observer events) but runs entirely
+        on the caller's thread — used by the sync gRPC front server to
+        bypass asyncio scheduling on the hot path.
+        """
+        fast = self.single_local_model()
+        if fast is None:
+            raise MicroserviceError(
+                f"predictor {self.name!r} is not fast-path eligible", reason="NOT_FAST_PATH"
+            )
+        unit, component = fast
+        from seldon_core_tpu.runtime import dispatch
+
+        puid = request.meta.puid or new_puid()
+        request.meta.puid = puid
+        self._inflight += 1
+        self._inflight_zero.clear()
+        start = time.perf_counter()
+        try:
+            self.stats["requests"] += 1
+            t0 = time.perf_counter()
+            response = dispatch.predict(component, request)
+            self.executor._emit("node_call", unit.name, ("transform_input", time.perf_counter() - t0))
+            if response.meta.metrics:
+                self.executor._emit("node_metrics", unit.name, response.meta.metrics)
+            response.meta.request_path[unit.name] = (
+                unit.image or unit.implementation or unit.component_class or "local"
+            )
+            response.meta.puid = puid
+            if response.status is None:
+                response.status = {"status": "SUCCESS", "code": 200}
+            if self.request_logger is not None:
+                try:
+                    self.request_logger(request, response)
+                except Exception:
+                    logger.exception("request logger failed")
+            return response
+        except Exception as e:  # noqa: BLE001
+            self.stats["failures"] += 1
+            logger.exception("predict failed puid=%s", puid)
+            return failure_message(e, puid)
+        finally:
+            self._inflight -= 1
+            if self._inflight == 0:
+                self._inflight_zero.set()
+            self.executor._emit("predict_done", self.name, time.perf_counter() - start)
 
     async def send_feedback(self, feedback: InternalFeedback) -> InternalMessage:
         try:

@@ -119,9 +119,14 @@ class InternalMessage:
             payload = json_format.MessageToDict(msg.jsonData)
         status = None
         if msg.HasField("status"):
-            from google.protobuf import json_format
-
-            status = json_format.MessageToDict(msg.status)
+            s = msg.status
+            status = {"status": pb.Status.StatusFlag.Name(s.status)}
+            if s.code:
+                status["code"] = s.code
+            if s.info:
+                status["info"] = s.info
+            if s.reason:
+                status["reason"] = s.reason
         return cls(payload=payload, names=names, kind=kind or "tensor", meta=meta, status=status)
 
     @classmethod
@@ -167,9 +172,12 @@ class InternalMessage:
             for tk, tv in (md.get("tags") or {}).items():
                 metric.tags[tk] = str(tv)
         if self.status:
-            from google.protobuf import json_format
-
-            json_format.ParseDict(self.status, msg.status)
+            s = self.status
+            msg.status.code = int(s.get("code", 0))
+            msg.status.info = str(s.get("info", ""))
+            msg.status.reason = str(s.get("reason", ""))
+            if s.get("status") in ("SUCCESS", "FAILURE"):
+                msg.status.status = pb.Status.StatusFlag.Value(s["status"])
         payload = self.host_payload()
         if payload is None:
             return msg

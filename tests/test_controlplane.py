@@ -299,3 +299,31 @@ class TestSupervisor:
             assert sup.health()["stub"]["ready"]
         finally:
             sup.stop_all()
+
+
+class TestAnnotations:
+    def test_transport_knobs_from_annotations(self):
+        """Reference parity: seldon.io timeout/retry annotations reach
+        the remote transports (InternalPredictionService.java:80-98)."""
+        from seldon_core_tpu.engine.executor import build_client
+        from seldon_core_tpu.engine.graph import Endpoint, UnitSpec
+        from seldon_core_tpu.engine.transport import GrpcClient, RestClient
+
+        ann = {
+            "seldon.io/rest-connection-timeout": "1500",
+            "seldon.io/rest-read-timeout": "9000",
+            "seldon.io/rest-retries": "7",
+            "seldon.io/grpc-read-timeout": "2500",
+        }
+        rest = build_client(
+            UnitSpec(name="r", type="MODEL", endpoint=Endpoint(transport="REST")), ann
+        )
+        assert isinstance(rest, RestClient)
+        assert rest.connect_timeout_s == 1.5
+        assert rest.read_timeout_s == 9.0
+        assert rest.retries == 7
+        grpc_client = build_client(
+            UnitSpec(name="g", type="MODEL", endpoint=Endpoint(transport="GRPC")), ann
+        )
+        assert isinstance(grpc_client, GrpcClient)
+        assert grpc_client.deadline_s == 2.5

@@ -340,3 +340,95 @@ class TestRemoteGraphEdge:
 
         out = run(scenario())
         np.testing.assert_array_equal(out.payload, [[14.0]])
+
+
+class TestSyncFastPath:
+    """The sync gRPC front server: fast path for single-local-MODEL
+    predictors, loop bridge for multi-node graphs and feedback."""
+
+    def test_fast_path_parity(self):
+        async def scenario():
+            import grpc
+
+            from seldon_core_tpu.engine.sync_server import build_sync_seldon_server
+
+            gw = Gateway([(PredictorService(model_unit("m", Doubler()), name="main"), 1.0)])
+            server = build_sync_seldon_server(gw, asyncio.get_running_loop())
+            port = server.add_insecure_port("127.0.0.1:0")
+            server.start()
+            channel = grpc.aio.insecure_channel(f"127.0.0.1:{port}")
+            predict = services.unary_callable(channel, "Seldon", "Predict")
+            req = pb.SeldonMessage()
+            req.data.tensor.shape.extend([1, 2])
+            req.data.tensor.values.extend([1.0, 2.0])
+            resp = await predict(req, timeout=10)
+            await channel.close()
+            server.stop(None)
+            return resp
+
+        resp = run(scenario())
+        assert list(resp.data.tensor.values) == [2.0, 4.0]
+        assert resp.meta.puid
+        assert resp.meta.requestPath["m"] == "local"
+        assert resp.status.status == pb.Status.SUCCESS or resp.status.code in (0, 200)
+
+    def test_multi_node_graph_bridges_to_loop(self):
+        async def scenario():
+            import grpc
+
+            from seldon_core_tpu.engine.sync_server import build_sync_seldon_server
+
+            class TimesTwo(TPUComponent):
+                def transform_input(self, X, names, meta=None):
+                    return np.asarray(X) * 2
+
+            graph = UnitSpec(
+                name="t", type="TRANSFORMER", component=TimesTwo(),
+                children=[model_unit("m", Doubler())],
+            )
+            gw = Gateway([(PredictorService(graph, name="main"), 1.0)])
+            server = build_sync_seldon_server(gw, asyncio.get_running_loop())
+            port = server.add_insecure_port("127.0.0.1:0")
+            server.start()
+            channel = grpc.aio.insecure_channel(f"127.0.0.1:{port}")
+            predict = services.unary_callable(channel, "Seldon", "Predict")
+            req = pb.SeldonMessage()
+            req.data.tensor.shape.extend([1, 1])
+            req.data.tensor.values.extend([3.0])
+            resp = await predict(req, timeout=10)
+            await channel.close()
+            server.stop(None)
+            return resp
+
+        resp = run(scenario())
+        # (3 * 2) * 2 through transformer -> model
+        assert list(resp.data.tensor.values) == [12.0]
+        assert set(resp.meta.requestPath) == {"t", "m"}
+
+    def test_feedback_bridges(self):
+        seen = []
+
+        class FbModel(Doubler):
+            def send_feedback(self, features, names, reward, truth, routing=None):
+                seen.append(reward)
+
+        async def scenario():
+            import grpc
+
+            from seldon_core_tpu.engine.sync_server import build_sync_seldon_server
+
+            gw = Gateway([(PredictorService(model_unit("m", FbModel()), name="main"), 1.0)])
+            server = build_sync_seldon_server(gw, asyncio.get_running_loop())
+            port = server.add_insecure_port("127.0.0.1:0")
+            server.start()
+            channel = grpc.aio.insecure_channel(f"127.0.0.1:{port}")
+            feedback = services.unary_callable(channel, "Seldon", "SendFeedback")
+            fb = pb.Feedback(reward=0.5)
+            fb.request.data.tensor.shape.extend([1, 1])
+            fb.request.data.tensor.values.extend([1.0])
+            await feedback(fb, timeout=10)
+            await channel.close()
+            server.stop(None)
+
+        run(scenario())
+        assert seen == [0.5]
