@@ -85,6 +85,7 @@ class JaxServer(TPUComponent):
         input_shape: Optional[Sequence[int]] = None,
         class_names_list: Optional[List[str]] = None,
         softmax_outputs: bool = False,
+        top_k: int = 0,
         warmup: bool = True,
         warmup_dtypes: Sequence[str] = ("float32", "uint8"),
         seed: int = 0,
@@ -104,6 +105,12 @@ class JaxServer(TPUComponent):
         self.input_shape = tuple(input_shape) if input_shape else None
         self._class_names = class_names_list
         self.softmax_outputs = bool(softmax_outputs)
+        # top_k > 0: the served program ends in lax.top_k and returns
+        # [batch, 2, k] (row 0: class indices, row 1: scores).  The
+        # device->host readback and the response payload shrink from
+        # num_classes to 2k floats per example — fused on device, so
+        # the full logits never leave HBM.
+        self.top_k = int(top_k)
         self.warmup = bool(warmup)
         # XLA specialises on input dtype as well as shape: warm every
         # (bucket, dtype) pair clients may send, and canonicalise anything
@@ -216,6 +223,9 @@ class JaxServer(TPUComponent):
             y = self.module.apply(variables, x)
             if self.softmax_outputs:
                 y = jax.nn.softmax(y, axis=-1)
+            if self.top_k:
+                values, indices = jax.lax.top_k(y, self.top_k)
+                y = jnp.stack([indices.astype(jnp.float32), values], axis=-2)
             return y
 
         if self.mesh is not None:
@@ -300,6 +310,8 @@ class JaxServer(TPUComponent):
         return out[0] if squeeze else out
 
     def class_names(self):
+        if self.top_k:  # rows are (indices, scores), not per-class columns
+            return []
         if self._class_names:
             return self._class_names
         return [f"t:{i}" for i in range(self.num_classes)]

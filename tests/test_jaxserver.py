@@ -212,3 +212,28 @@ class TestModelZoo:
         n_params = sum(np.prod(x.shape) for x in jax.tree.leaves(variables["params"]))
         # canonical ResNet-50 has ~25.5M parameters
         assert 25_000_000 < n_params < 26_000_000
+
+
+class TestTopK:
+    def test_topk_output_layout(self):
+        from seldon_core_tpu.models.jaxserver import JaxServer
+
+        server = JaxServer(model="mlp", num_classes=10, input_shape=(4,), dtype="float32",
+                           softmax_outputs=True, top_k=3, max_batch_size=4,
+                           warmup=False, warmup_dtypes=("float32",))
+        server.load()
+        x = np.random.default_rng(0).normal(size=(2, 4)).astype(np.float32)
+        out = server.predict(x, [])
+        assert out.shape == (2, 2, 3)  # [batch, (indices, scores), k]
+        indices, scores = out[:, 0, :], out[:, 1, :]
+        # scores sorted descending, indices are valid classes
+        assert (np.diff(scores, axis=1) <= 1e-6).all()
+        assert ((indices >= 0) & (indices < 10)).all()
+        # parity with full logits top-k
+        full = JaxServer(model="mlp", num_classes=10, input_shape=(4,), dtype="float32",
+                         softmax_outputs=True, max_batch_size=4, warmup=False,
+                         warmup_dtypes=("float32",), seed=0)
+        full.load()
+        logits = full.predict(x, [])
+        np.testing.assert_allclose(np.sort(logits, axis=1)[:, -3:][:, ::-1], scores, rtol=1e-5)
+        server.unload(); full.unload()
