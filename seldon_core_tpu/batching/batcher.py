@@ -265,3 +265,124 @@ class DynamicBatcher:
 
     def __exit__(self, *exc) -> None:
         self.stop()
+
+
+class MultiSignatureBatcher:
+    """Per-(dtype, trailing-shape) dynamic batching for multi-signature models.
+
+    One served model may legitimately accept several input signatures —
+    a transformer served at multiple context-length buckets, or mixed
+    uint8/float32 image payloads.  XLA compiles one program per
+    signature regardless, so giving each signature its own queue adds
+    nothing to the compile cache while letting each signature coalesce
+    independently; mixing them in one queue would force a flush (and a
+    small-batch device call) on every signature change in the arrival
+    stream.
+
+    Signature groups are created lazily on first sight and capped at
+    ``max_signatures`` (each group owns a collector thread and a
+    finisher pool); an over-cap signature is rejected rather than
+    silently degrading into unbounded thread growth — mirroring how the
+    jit cache itself must be bounded on a serving host.
+    """
+
+    def __init__(
+        self,
+        predict_fn: Callable[[np.ndarray], Any],
+        max_batch_size: int = 64,
+        max_wait_ms: float = 2.0,
+        buckets: Optional[Sequence[int]] = None,
+        name: str = "batcher",
+        pipeline_depth: int = 8,
+        finisher_threads: int = 2,
+        max_signatures: int = 16,
+    ):
+        self.predict_fn = predict_fn
+        self.max_batch_size = max_batch_size
+        self.max_wait_ms = max_wait_ms
+        self.buckets = buckets
+        self.name = name
+        self.pipeline_depth = pipeline_depth
+        self.finisher_threads = finisher_threads
+        self.max_signatures = max_signatures
+        self._groups: dict[tuple, DynamicBatcher] = {}
+        self._lock = threading.Lock()
+        self._running = False
+
+    # ---------------------------------------------------------------- public
+
+    def start(self) -> None:
+        with self._lock:
+            self._running = True
+            for g in self._groups.values():
+                g.start()
+
+    def stop(self) -> None:
+        with self._lock:
+            self._running = False
+            groups = list(self._groups.values())
+        for g in groups:
+            g.stop()
+
+    def signature_of(self, x: np.ndarray) -> tuple:
+        return (x.dtype.str, tuple(x.shape[1:]))
+
+    def _group_for(self, x: np.ndarray) -> DynamicBatcher:
+        key = self.signature_of(x)
+        with self._lock:
+            if not self._running:
+                raise RuntimeError(f"batcher {self.name!r} not started")
+            group = self._groups.get(key)
+            if group is None:
+                if len(self._groups) >= self.max_signatures:
+                    raise ValueError(
+                        f"batcher {self.name!r}: signature {key} would exceed "
+                        f"max_signatures={self.max_signatures} "
+                        f"(seen: {sorted(self._groups)})"
+                    )
+                group = DynamicBatcher(
+                    self.predict_fn,
+                    max_batch_size=self.max_batch_size,
+                    max_wait_ms=self.max_wait_ms,
+                    buckets=self.buckets,
+                    name=f"{self.name}[{key[0]}{'x'.join(map(str, key[1]))}]",
+                    pipeline_depth=self.pipeline_depth,
+                    finisher_threads=self.finisher_threads,
+                )
+                group.start()
+                self._groups[key] = group
+            return group
+
+    def submit_future(self, x: np.ndarray) -> Future:
+        x = np.asarray(x)
+        if x.ndim < 1:
+            raise ValueError("batcher input must have a leading batch dimension")
+        return self._group_for(x).submit_future(x)
+
+    def submit(self, x: np.ndarray, timeout_s: float = 30.0):
+        return self.submit_future(x).result(timeout=timeout_s)
+
+    @property
+    def signatures(self) -> List[tuple]:
+        with self._lock:
+            return sorted(self._groups)
+
+    @property
+    def stats(self) -> BatcherStats:
+        """Aggregate stats over all signature groups."""
+        agg = BatcherStats()
+        with self._lock:
+            groups = list(self._groups.values())
+        for g in groups:
+            agg.requests += g.stats.requests
+            agg.batches += g.stats.batches
+            agg.rows += g.stats.rows
+            agg.padded_rows += g.stats.padded_rows
+        return agg
+
+    def __enter__(self) -> "MultiSignatureBatcher":
+        self.start()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.stop()

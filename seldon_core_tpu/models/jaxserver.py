@@ -32,7 +32,11 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from seldon_core_tpu.batching.batcher import DynamicBatcher, default_buckets
+from seldon_core_tpu.batching.batcher import (
+    DynamicBatcher,
+    MultiSignatureBatcher,
+    default_buckets,
+)
 from seldon_core_tpu.runtime.component import MicroserviceError, TPUComponent, gauge_metric
 
 logger = logging.getLogger(__name__)
@@ -83,6 +87,7 @@ class JaxServer(TPUComponent):
         max_wait_ms: float = 1.0,
         buckets: Optional[Sequence[int]] = None,
         input_shape: Optional[Sequence[int]] = None,
+        extra_input_shapes: Optional[Sequence[Sequence[int]]] = None,
         class_names_list: Optional[List[str]] = None,
         softmax_outputs: bool = False,
         top_k: int = 0,
@@ -103,6 +108,10 @@ class JaxServer(TPUComponent):
         self.max_wait_ms = float(max_wait_ms)
         self.buckets = list(buckets) if buckets else None
         self.input_shape = tuple(input_shape) if input_shape else None
+        # extra accepted signatures (e.g. several context-length buckets
+        # for a served transformer); each gets its own batcher queue and
+        # compiled program — see MultiSignatureBatcher
+        self.extra_input_shapes = [tuple(s) for s in (extra_input_shapes or [])]
         self._class_names = class_names_list
         self.softmax_outputs = bool(softmax_outputs)
         # top_k > 0: the served program ends in lax.top_k and returns
@@ -243,7 +252,8 @@ class JaxServer(TPUComponent):
             return self._predict_jit(self.variables, jnp.asarray(batch))
 
         buckets = self.buckets or default_buckets(self.max_batch_size)
-        self.batcher = DynamicBatcher(
+        batcher_cls = MultiSignatureBatcher if self.extra_input_shapes else DynamicBatcher
+        self.batcher = batcher_cls(
             device_call,
             max_batch_size=self.max_batch_size,
             max_wait_ms=self.max_wait_ms,
@@ -253,10 +263,12 @@ class JaxServer(TPUComponent):
         self.batcher.start()
 
         if self.warmup:
-            # pre-compile every (bucket, dtype) pair so no request pays a trace
-            for b in self.batcher.buckets:
-                for dt in self.warmup_dtypes:
-                    np.asarray(device_call(np.zeros((b, *self.input_shape), np.dtype(dt))))
+            # pre-compile every (shape, bucket, dtype) triple so no
+            # request pays a trace
+            for shape in self.accepted_shapes():
+                for b in buckets:
+                    for dt in self.warmup_dtypes:
+                        np.asarray(device_call(np.zeros((b, *shape), np.dtype(dt))))
         self._load_time_s = time.perf_counter() - t0
         self._loaded = True
         logger.info(
@@ -274,21 +286,25 @@ class JaxServer(TPUComponent):
 
     # -------------------------------------------------------------- serving
 
+    def accepted_shapes(self) -> List[Tuple[int, ...]]:
+        """Input signatures (without batch dim) this server accepts."""
+        return [tuple(self.input_shape), *self.extra_input_shapes]
+
     def _prepare(self, X):
         if not self._loaded:
             self.load()
         arr = np.asarray(X)
         if arr.dtype.name not in self.warmup_dtypes:
             arr = arr.astype(np.dtype(self.warmup_dtypes[0]))
+        accepted = self.accepted_shapes()
         squeeze = False
-        if arr.ndim == len(self.input_shape):  # single example without batch dim
-            arr = arr[None]
+        if tuple(arr.shape[1:]) not in accepted and tuple(arr.shape) in accepted:
+            arr = arr[None]  # single example without batch dim
             squeeze = True
-        expected = arr.shape[1:]
-        if tuple(expected) != tuple(self.input_shape):
+        if tuple(arr.shape[1:]) not in accepted:
+            shapes = " | ".join("(batch, " + ", ".join(map(str, s)) + ")" for s in accepted)
             raise MicroserviceError(
-                f"input shape {tuple(arr.shape)} does not match model input "
-                f"(batch, {', '.join(map(str, self.input_shape))})",
+                f"input shape {tuple(arr.shape)} does not match model input {shapes}",
                 status_code=400,
                 reason="BAD_INPUT_SHAPE",
             )
@@ -330,6 +346,7 @@ class JaxServer(TPUComponent):
             "loaded": self._loaded,
             "load_time_s": self._load_time_s,
             "buckets": list(self.batcher.buckets) if self.batcher else [],
+            "signatures": [list(s) for s in self.accepted_shapes()] if self._loaded else [],
         }
 
 

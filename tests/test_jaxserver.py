@@ -6,7 +6,12 @@ import time
 import numpy as np
 import pytest
 
-from seldon_core_tpu.batching import DynamicBatcher, bucket_for, default_buckets
+from seldon_core_tpu.batching import (
+    DynamicBatcher,
+    MultiSignatureBatcher,
+    bucket_for,
+    default_buckets,
+)
 from seldon_core_tpu.runtime import InternalMessage, MicroserviceError
 from seldon_core_tpu.runtime import dispatch
 
@@ -107,6 +112,74 @@ class TestDynamicBatcher:
         assert shapes == [10]
 
 
+class TestMultiSignatureBatcher:
+    def test_routes_by_trailing_shape(self):
+        shapes = []
+
+        def fn(batch):
+            shapes.append(batch.shape)
+            return batch.sum(axis=tuple(range(1, batch.ndim)), keepdims=False)[:, None]
+
+        with MultiSignatureBatcher(fn, max_batch_size=8, max_wait_ms=0.5) as b:
+            out_a = b.submit(np.ones((3, 4)))
+            out_b = b.submit(np.ones((2, 6)))
+        np.testing.assert_array_equal(out_a, np.full((3, 1), 4.0))
+        np.testing.assert_array_equal(out_b, np.full((2, 1), 6.0))
+        assert sorted(b.signatures) == [("<f8", (4,)), ("<f8", (6,))]
+        # each signature got its own padded device call
+        assert sorted(shapes) == [(4, 4), (2, 6)] or sorted(shapes) == [(2, 6), (4, 4)]
+
+    def test_routes_by_dtype(self):
+        dtypes = []
+
+        def fn(batch):
+            dtypes.append(batch.dtype.name)
+            return batch
+
+        with MultiSignatureBatcher(fn, max_batch_size=4, max_wait_ms=0.5) as b:
+            b.submit(np.ones((1, 2), np.float32))
+            b.submit(np.ones((1, 2), np.uint8))
+        assert sorted(dtypes) == ["float32", "uint8"]
+
+    def test_concurrent_mixed_shapes(self):
+        def fn(batch):
+            return batch * 2
+
+        b = MultiSignatureBatcher(fn, max_batch_size=16, max_wait_ms=5.0)
+        b.start()
+        results = {}
+        release = threading.Event()
+
+        def worker(i):
+            release.wait()
+            width = 3 if i % 2 else 5
+            results[i] = b.submit(np.full((1, width), float(i)))
+
+        threads = [threading.Thread(target=worker, args=(i,)) for i in range(8)]
+        for t in threads:
+            t.start()
+        release.set()
+        for t in threads:
+            t.join()
+        b.stop()
+        for i in range(8):
+            width = 3 if i % 2 else 5
+            np.testing.assert_array_equal(results[i], np.full((1, width), 2.0 * i))
+        assert b.stats.requests == 8
+
+    def test_signature_cap(self):
+        with MultiSignatureBatcher(lambda b: b, max_wait_ms=0.1, max_signatures=2) as b:
+            b.submit(np.ones((1, 1)))
+            b.submit(np.ones((1, 2)))
+            with pytest.raises(ValueError, match="max_signatures"):
+                b.submit(np.ones((1, 3)))
+
+    def test_not_started_rejects(self):
+        b = MultiSignatureBatcher(lambda x: x)
+        with pytest.raises(RuntimeError, match="not started"):
+            b.submit(np.ones((1, 2)))
+
+
 @pytest.fixture(scope="module")
 def mlp_server():
     from seldon_core_tpu.models.jaxserver import JaxServer
@@ -186,6 +259,37 @@ class TestJaxServer:
         from seldon_core_tpu.engine.units import BUILTIN_IMPLEMENTATIONS
 
         assert "JAX_SERVER" in BUILTIN_IMPLEMENTATIONS
+
+
+class TestMultiSignatureServing:
+    def test_transformer_two_context_lengths(self):
+        """One server, two context-length signatures, one weight set."""
+        from seldon_core_tpu.models.jaxserver import JaxServer
+
+        server = JaxServer(
+            model="transformer_encoder", num_classes=3, dtype="float32",
+            input_shape=(16,), extra_input_shapes=[(32,)],
+            max_batch_size=4, max_wait_ms=0.5, warmup=False,
+            warmup_dtypes=("int32",),
+            model_kwargs={"vocab_size": 64, "d_model": 32, "num_layers": 1,
+                          "num_heads": 2, "max_len": 64},
+        )
+        server.load()
+        rng = np.random.default_rng(0)
+        short = rng.integers(0, 64, size=(2, 16)).astype(np.int32)
+        long = rng.integers(0, 64, size=(2, 32)).astype(np.int32)
+        out_short = server.predict(short, [])
+        out_long = server.predict(long, [])
+        assert out_short.shape == (2, 3) and out_long.shape == (2, 3)
+        assert sorted(server.batcher.signatures) == [("<i4", (16,)), ("<i4", (32,))]
+        # parity with a direct module apply at the longer signature
+        direct = np.asarray(server.module.apply(server.variables, long))
+        np.testing.assert_allclose(out_long, direct, rtol=2e-4, atol=2e-4)
+        # a length outside the served signatures is rejected, not retraced
+        with pytest.raises(MicroserviceError):
+            server.predict(rng.integers(0, 64, size=(2, 24)).astype(np.int32), [])
+        assert server.health_status()["signatures"] == [[16], [32]]
+        server.unload()
 
 
 class TestModelZoo:
