@@ -6,4 +6,5 @@ from seldon_core_tpu.batching.batcher import (  # noqa: F401
     MultiSignatureBatcher,
     bucket_for,
     default_buckets,
+    normalize_buckets,
 )

@@ -61,6 +61,20 @@ def default_buckets(max_batch_size: int) -> List[int]:
     return sorted(set(buckets))
 
 
+def normalize_buckets(buckets: Optional[Sequence[int]], max_batch_size: int) -> List[int]:
+    """Canonical bucket list: sorted, deduped, capped at and always
+    ending with ``max_batch_size``.  Both batchers and the jaxserver
+    warmup must agree on this list — warming the raw user-supplied
+    buckets would leave the forced final bucket uncompiled and the
+    first full batch would pay an XLA trace mid-traffic."""
+    if max_batch_size < 1:
+        raise ValueError("max_batch_size must be >= 1")
+    out = sorted(set(buckets)) if buckets else default_buckets(max_batch_size)
+    if out[-1] != max_batch_size:
+        out = [b for b in out if b < max_batch_size] + [max_batch_size]
+    return out
+
+
 def bucket_for(n: int, buckets: Sequence[int]) -> int:
     for b in buckets:
         if n <= b:
@@ -111,14 +125,10 @@ class DynamicBatcher:
         pipeline_depth: int = 8,
         finisher_threads: int = 4,
     ):
-        if max_batch_size < 1:
-            raise ValueError("max_batch_size must be >= 1")
         self.predict_fn = predict_fn
         self.max_batch_size = max_batch_size
         self.max_wait_s = max_wait_ms / 1000.0
-        self.buckets = sorted(set(buckets)) if buckets else default_buckets(max_batch_size)
-        if self.buckets[-1] != max_batch_size:
-            self.buckets = [b for b in self.buckets if b < max_batch_size] + [max_batch_size]
+        self.buckets = normalize_buckets(buckets, max_batch_size)
         self.name = name
         self.stats = BatcherStats()
         self._queue: "queue.Queue[Optional[_WorkItem]]" = queue.Queue()
@@ -300,7 +310,9 @@ class MultiSignatureBatcher:
         self.predict_fn = predict_fn
         self.max_batch_size = max_batch_size
         self.max_wait_ms = max_wait_ms
-        self.buckets = buckets
+        # normalize eagerly so construction fails fast on a bad
+        # max_batch_size and callers (warmup) see the canonical list
+        self.buckets = normalize_buckets(buckets, max_batch_size)
         self.name = name
         self.pipeline_depth = pipeline_depth
         self.finisher_threads = finisher_threads
@@ -327,8 +339,14 @@ class MultiSignatureBatcher:
     def signature_of(self, x: np.ndarray) -> tuple:
         return (x.dtype.str, tuple(x.shape[1:]))
 
-    def _group_for(self, x: np.ndarray) -> DynamicBatcher:
+    def submit_future(self, x: np.ndarray) -> Future:
+        x = np.asarray(x)
+        if x.ndim < 1:
+            raise ValueError("batcher input must have a leading batch dimension")
         key = self.signature_of(x)
+        # resolve the group AND submit under one lock: a concurrent
+        # stop() between the two would otherwise surface as the inner
+        # group's RuntimeError instead of this batcher's rejection
         with self._lock:
             if not self._running:
                 raise RuntimeError(f"batcher {self.name!r} not started")
@@ -351,13 +369,7 @@ class MultiSignatureBatcher:
                 )
                 group.start()
                 self._groups[key] = group
-            return group
-
-    def submit_future(self, x: np.ndarray) -> Future:
-        x = np.asarray(x)
-        if x.ndim < 1:
-            raise ValueError("batcher input must have a leading batch dimension")
-        return self._group_for(x).submit_future(x)
+            return group.submit_future(x)
 
     def submit(self, x: np.ndarray, timeout_s: float = 30.0):
         return self.submit_future(x).result(timeout=timeout_s)

@@ -21,6 +21,8 @@ from __future__ import annotations
 import asyncio
 import logging
 import random
+import threading
+from collections import OrderedDict
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import grpc
@@ -54,6 +56,55 @@ class Gateway:
         self._weights = [w / total for _, w in self.entries]
         self.shadows = list(shadows)
         self._rng = random.Random(seed)
+        # puid -> serving predictor name, so feedback can be routed to
+        # the predictor that actually served the request (reference
+        # semantics: PredictiveUnitBean.java:206-246 follows the
+        # recorded routing; broadcasting would teach every predictor's
+        # MAB from traffic it never saw).  Bounded FIFO eviction.
+        self._served: "OrderedDict[str, str]" = OrderedDict()
+        self._served_cap = 65536
+        self._served_lock = threading.Lock()
+
+    def _record_served(self, puid: str, predictor: str) -> None:
+        if not puid:
+            return
+        with self._served_lock:
+            self._served[puid] = predictor
+            while len(self._served) > self._served_cap:
+                self._served.popitem(last=False)
+
+    def finalize_response(self, response: InternalMessage, request: InternalMessage,
+                          svc: PredictorService) -> InternalMessage:
+        """Stamp the serving predictor on the response and record the
+        puid→predictor mapping — single helper shared by the async and
+        sync ingress paths so they cannot drift.  The tag is assigned
+        unconditionally: a request may arrive with a stale client-echoed
+        `predictor` tag that would otherwise misroute feedback."""
+        response.meta.tags["predictor"] = svc.name
+        self._record_served(response.meta.puid or request.meta.puid, svc.name)
+        return response
+
+    def _feedback_target(self, feedback: InternalFeedback) -> Optional[PredictorService]:
+        """The predictor that served the request, if identifiable: by
+        the `predictor` response tag, else by the recorded puid.  An
+        unresolvable tag (renamed/removed predictor, garbage client
+        tag) falls through to the puid lookup rather than giving up."""
+        for msg in (feedback.response, feedback.request):
+            if msg is None:
+                continue
+            name = msg.meta.tags.get("predictor")
+            if name:
+                svc = self.by_name(str(name))
+                if svc is not None:
+                    return svc
+            if msg.meta.puid:
+                with self._served_lock:
+                    name = self._served.get(msg.meta.puid)
+                if name:
+                    svc = self.by_name(name)
+                    if svc is not None:
+                        return svc
+        return None
 
     @property
     def predictors(self) -> List[PredictorService]:
@@ -78,14 +129,20 @@ class Gateway:
         svc = self.by_name(predictor) if predictor else None
         if svc is None:
             svc = self.pick()
-        # shadow traffic: fire-and-forget copies, responses dropped
+        # shadow traffic: fire-and-forget isolated copies, responses
+        # dropped — the primary and shadows each mutate their own meta
+        # (puid assignment), never a shared one
         for shadow in self.shadows:
-            asyncio.ensure_future(shadow.predict(request))
-        return await svc.predict(request)
+            asyncio.ensure_future(shadow.predict(request.copy()))
+        response = await svc.predict(request)
+        return self.finalize_response(response, request, svc)
 
     async def send_feedback(self, feedback: InternalFeedback) -> InternalMessage:
         # feedback goes to the predictor that served the request when
-        # identifiable, else to all
+        # identifiable (predictor tag or recorded puid), else to all
+        target = self._feedback_target(feedback)
+        if target is not None:
+            return await target.send_feedback(feedback)
         results = await asyncio.gather(*(p.send_feedback(feedback) for p in self.predictors))
         return results[0]
 

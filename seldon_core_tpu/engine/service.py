@@ -13,6 +13,7 @@ import asyncio
 import itertools
 import logging
 import secrets
+import threading
 import time
 from typing import Any, Callable, Dict, List, Optional
 
@@ -69,14 +70,33 @@ class PredictorService:
         self.executor = GraphExecutor(graph, observer=observer, annotations=annotations)
         self.graph = graph
         self._paused = False
+        # threading (not asyncio) primitives: predict_sync runs on gRPC
+        # thread-pool threads concurrently with the event loop, so the
+        # in-flight count and stats need a real lock or drain() can
+        # hang / return early under load
         self._inflight = 0
-        self._inflight_zero = asyncio.Event()
+        self._stats_lock = threading.Lock()
+        self._inflight_zero = threading.Event()
         self._inflight_zero.set()
         self.log_requests = log_requests
         self.log_responses = log_responses
         self.request_logger = request_logger
         self.stats = {"requests": 0, "failures": 0, "feedback": 0}
         self.explainer = None  # set by the control plane when configured
+
+    def _enter_request(self) -> None:
+        with self._stats_lock:
+            self._inflight += 1
+            self._inflight_zero.clear()
+            self.stats["requests"] += 1
+
+    def _exit_request(self, failed: bool = False) -> None:
+        with self._stats_lock:
+            self._inflight -= 1
+            if failed:
+                self.stats["failures"] += 1
+            if self._inflight == 0:
+                self._inflight_zero.set()
 
     async def explain(self, request: InternalMessage) -> InternalMessage:
         """Run the predictor's explainer (reference: the :explain URL of
@@ -122,22 +142,18 @@ class PredictorService:
         """Pause and wait for in-flight requests
         (reference: App.java:60-97 Tomcat drain)."""
         self.pause()
-        try:
-            await asyncio.wait_for(self._inflight_zero.wait(), timeout=timeout_s)
-            return True
-        except asyncio.TimeoutError:
-            return False
+        loop = asyncio.get_running_loop()
+        return await loop.run_in_executor(None, self._inflight_zero.wait, timeout_s)
 
     # --------------------------------------------------------------- serving
 
     async def predict(self, request: InternalMessage) -> InternalMessage:
         puid = request.meta.puid or new_puid()
         request.meta.puid = puid
-        self._inflight += 1
-        self._inflight_zero.clear()
+        self._enter_request()
+        failed = False
         start = time.perf_counter()
         try:
-            self.stats["requests"] += 1
             if self.log_requests:
                 logger.info("request puid=%s payload_kind=%s", puid, request.kind)
             from seldon_core_tpu.utils.tracing import maybe_span
@@ -155,13 +171,11 @@ class PredictorService:
                     logger.exception("request logger failed")
             return response
         except Exception as e:
-            self.stats["failures"] += 1
+            failed = True
             logger.exception("predict failed puid=%s", puid)
             return failure_message(e, puid)
         finally:
-            self._inflight -= 1
-            if self._inflight == 0:
-                self._inflight_zero.set()
+            self._exit_request(failed)
             elapsed = time.perf_counter() - start
             self.executor._emit("predict_done", self.name, elapsed)
 
@@ -198,11 +212,10 @@ class PredictorService:
 
         puid = request.meta.puid or new_puid()
         request.meta.puid = puid
-        self._inflight += 1
-        self._inflight_zero.clear()
+        self._enter_request()
+        failed = False
         start = time.perf_counter()
         try:
-            self.stats["requests"] += 1
             t0 = time.perf_counter()
             response = dispatch.predict(component, request)
             self.executor._emit("node_call", unit.name, ("transform_input", time.perf_counter() - t0))
@@ -221,18 +234,17 @@ class PredictorService:
                     logger.exception("request logger failed")
             return response
         except Exception as e:  # noqa: BLE001
-            self.stats["failures"] += 1
+            failed = True
             logger.exception("predict failed puid=%s", puid)
             return failure_message(e, puid)
         finally:
-            self._inflight -= 1
-            if self._inflight == 0:
-                self._inflight_zero.set()
+            self._exit_request(failed)
             self.executor._emit("predict_done", self.name, time.perf_counter() - start)
 
     async def send_feedback(self, feedback: InternalFeedback) -> InternalMessage:
         try:
-            self.stats["feedback"] += 1
+            with self._stats_lock:
+                self.stats["feedback"] += 1
             await self.executor.send_feedback(feedback)
             out = InternalMessage(payload=None, kind="jsonData", status={"status": "SUCCESS", "code": 200})
             return out

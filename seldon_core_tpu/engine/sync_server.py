@@ -46,12 +46,13 @@ class SyncSeldonService:
         msg = InternalMessage.from_proto(request)
         svc = self.gateway.pick()
         for shadow in self.gateway.shadows:
-            asyncio.run_coroutine_threadsafe(shadow.predict(msg), self.loop)
+            # isolated copy: primary and shadow both mutate meta
+            asyncio.run_coroutine_threadsafe(shadow.predict(msg.copy()), self.loop)
         if svc.single_local_model() is not None:
             out = svc.predict_sync(msg)
         else:
             out = self._bridge(svc.predict(msg))
-        return out.to_proto()
+        return self.gateway.finalize_response(out, msg, svc).to_proto()
 
     def send_feedback(self, request: pb.Feedback, context) -> pb.SeldonMessage:
         fb = InternalFeedback.from_proto(request)

@@ -32,11 +32,7 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from seldon_core_tpu.batching.batcher import (
-    DynamicBatcher,
-    MultiSignatureBatcher,
-    default_buckets,
-)
+from seldon_core_tpu.batching.batcher import DynamicBatcher, MultiSignatureBatcher
 from seldon_core_tpu.runtime.component import MicroserviceError, TPUComponent, gauge_metric
 
 logger = logging.getLogger(__name__)
@@ -251,22 +247,23 @@ class JaxServer(TPUComponent):
             # batcher pipeline overlaps readback with the next batch
             return self._predict_jit(self.variables, jnp.asarray(batch))
 
-        buckets = self.buckets or default_buckets(self.max_batch_size)
         batcher_cls = MultiSignatureBatcher if self.extra_input_shapes else DynamicBatcher
         self.batcher = batcher_cls(
             device_call,
             max_batch_size=self.max_batch_size,
             max_wait_ms=self.max_wait_ms,
-            buckets=buckets,
+            buckets=self.buckets,
             name=f"jaxserver-{self.model_name}",
         )
         self.batcher.start()
 
         if self.warmup:
             # pre-compile every (shape, bucket, dtype) triple so no
-            # request pays a trace
+            # request pays a trace — over the batcher's NORMALIZED
+            # bucket list (it force-appends max_batch_size), not the
+            # raw user-supplied one
             for shape in self.accepted_shapes():
-                for b in buckets:
+                for b in self.batcher.buckets:
                     for dt in self.warmup_dtypes:
                         np.asarray(device_call(np.zeros((b, *shape), np.dtype(dt))))
         self._load_time_s = time.perf_counter() - t0
@@ -291,6 +288,16 @@ class JaxServer(TPUComponent):
         return [tuple(self.input_shape), *self.extra_input_shapes]
 
     def _prepare(self, X):
+        """Canonicalise dtype and shape.
+
+        Shape precedence: the batch interpretation always wins — an
+        array whose *trailing* dims match an accepted signature is
+        treated as [batch, *sig] even if its full shape also matches
+        another signature (e.g. with signatures (16,) and (16, 16), a
+        (16, 16) array is a batch of 16 vectors, never a single
+        16x16 example).  Send an explicit leading batch dim of 1 to
+        force the single-example reading.
+        """
         if not self._loaded:
             self.load()
         arr = np.asarray(X)

@@ -141,6 +141,19 @@ class InternalMessage:
             status=body.get("status"),
         )
 
+    def copy(self) -> "InternalMessage":
+        """Isolated copy for concurrent execution paths (shadow traffic):
+        meta is deep-copied — every path mutates it (puid assignment,
+        requestPath, metrics) — while the payload is shared, since the
+        data plane treats payloads as immutable."""
+        return InternalMessage(
+            payload=self.payload,
+            names=list(self.names),
+            kind=self.kind,
+            meta=self.meta.copy(),
+            status=dict(self.status) if self.status else None,
+        )
+
     # ---- exporters --------------------------------------------------------
 
     def host_payload(self) -> Any:

@@ -74,6 +74,12 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
     parser.add_argument("--persistence-period-s", type=float, default=60.0)
     parser.add_argument("--tracing", action="store_true", default=bool(int(os.environ.get("TRACING", "0"))))
     parser.add_argument("--log-level", default=os.environ.get("SELDON_LOG_LEVEL", "INFO"))
+    parser.add_argument(
+        "--platform", default=os.environ.get("SELDON_TPU_PLATFORM", ""),
+        help="force the jax platform (cpu|tpu|...). Needed because some "
+        "environments pre-import jax before env vars like JAX_PLATFORMS "
+        "can take effect; applied through jax.config before backend init",
+    )
     return parser.parse_args(argv)
 
 
@@ -118,6 +124,11 @@ async def run_servers(
 def main(argv: Optional[List[str]] = None) -> None:
     args = parse_args(argv)
     logging.basicConfig(level=args.log_level.upper(), format="%(asctime)s %(name)s %(levelname)s %(message)s")
+
+    if args.platform:
+        import jax
+
+        jax.config.update("jax_platforms", args.platform)
 
     kwargs = parse_parameters(json.loads(args.parameters))
     user_model = import_component(args.component, **kwargs)

@@ -29,6 +29,25 @@ class TestBuckets:
         assert bucket_for(8, buckets) == 8
         assert bucket_for(100, buckets) == 8
 
+    def test_normalize_buckets(self):
+        from seldon_core_tpu.batching import normalize_buckets
+
+        # force-appends max_batch_size when the user list stops short
+        assert normalize_buckets([1, 4, 16], 32) == [1, 4, 16, 32]
+        # caps over-max buckets
+        assert normalize_buckets([1, 4, 64], 32) == [1, 4, 32]
+        assert normalize_buckets(None, 4) == [1, 2, 4]
+        with pytest.raises(ValueError):
+            normalize_buckets([1], 0)
+
+    def test_multi_signature_batcher_normalizes_and_validates(self):
+        from seldon_core_tpu.batching import MultiSignatureBatcher
+
+        b = MultiSignatureBatcher(lambda x: x, max_batch_size=32, buckets=[1, 4, 16])
+        assert b.buckets == [1, 4, 16, 32]
+        with pytest.raises(ValueError):
+            MultiSignatureBatcher(lambda x: x, max_batch_size=0)
+
 
 class TestDynamicBatcher:
     def test_single_request(self):
@@ -127,7 +146,7 @@ class TestMultiSignatureBatcher:
         np.testing.assert_array_equal(out_b, np.full((2, 1), 6.0))
         assert sorted(b.signatures) == [("<f8", (4,)), ("<f8", (6,))]
         # each signature got its own padded device call
-        assert sorted(shapes) == [(4, 4), (2, 6)] or sorted(shapes) == [(2, 6), (4, 4)]
+        assert sorted(shapes) == [(2, 6), (4, 4)]
 
     def test_routes_by_dtype(self):
         dtypes = []
@@ -253,6 +272,26 @@ class TestJaxServer:
         expected = module.apply(variables, x)
         np.testing.assert_allclose(server.predict(x, []), np.asarray(expected), rtol=1e-5)
         server.unload()
+
+    def test_warmup_covers_normalized_buckets(self):
+        """ADVICE r1: user buckets not ending at max_batch_size must
+        still pre-compile the forced final bucket — no request pays a
+        trace mid-traffic."""
+        from seldon_core_tpu.models.jaxserver import JaxServer
+
+        server = JaxServer(
+            model="mlp", num_classes=3, input_shape=(4,), dtype="float32",
+            max_batch_size=8, buckets=[1, 2], warmup_dtypes=("float32",),
+        )
+        server.load()
+        try:
+            assert server.batcher.buckets == [1, 2, 8]
+            # warmup compiled exactly one program per (bucket, dtype)
+            assert server._predict_jit._cache_size() == 3
+            server.predict(np.ones((8, 4), np.float32), [])
+            assert server._predict_jit._cache_size() == 3  # no new trace
+        finally:
+            server.unload()
 
     def test_builtin_registration(self):
         import seldon_core_tpu.models  # noqa: F401 — triggers registration

@@ -262,6 +262,109 @@ class TestGateway:
 
         assert run(scenario()) == (200, 503, 200)
 
+    def test_feedback_routes_to_serving_predictor(self):
+        """Under a traffic split, feedback must reach only the
+        predictor that served the request (reference semantics:
+        PredictiveUnitBean.java:206-246 follows the recorded path) —
+        broadcast would teach every MAB from traffic it never saw."""
+
+        class FbCounter(Doubler):
+            def __init__(self):
+                self.feedback_count = 0
+
+            def send_feedback(self, features, feature_names, reward, truth, routing=None):
+                self.feedback_count += 1
+
+        async def scenario():
+            from seldon_core_tpu.runtime.message import InternalFeedback
+
+            ma, mb = FbCounter(), FbCounter()
+            a = PredictorService(model_unit("m", ma), name="a")
+            b = PredictorService(model_unit("m", mb), name="b")
+            gw = Gateway([(a, 50.0), (b, 50.0)], seed=3)
+            served = {"a": 0, "b": 0}
+            for _ in range(20):
+                req = InternalMessage(payload=np.ones((1, 2)), kind="ndarray")
+                resp = await gw.predict(req)
+                name = resp.meta.tags["predictor"]
+                served[name] += 1
+                await gw.send_feedback(InternalFeedback(response=resp, reward=1.0))
+            # unidentifiable feedback still broadcasts
+            await gw.send_feedback(InternalFeedback(reward=0.0))
+            return served, ma.feedback_count, mb.feedback_count
+
+        served, fa, fb = run(scenario())
+        assert served["a"] > 0 and served["b"] > 0
+        assert fa == served["a"] + 1  # own traffic + 1 broadcast
+        assert fb == served["b"] + 1
+
+    def test_feedback_routed_by_puid_when_tag_stripped(self):
+        class FbCounter(Doubler):
+            def __init__(self):
+                self.feedback_count = 0
+
+            def send_feedback(self, features, feature_names, reward, truth, routing=None):
+                self.feedback_count += 1
+
+        async def scenario():
+            from seldon_core_tpu.runtime.message import InternalFeedback
+
+            ma, mb = FbCounter(), FbCounter()
+            a = PredictorService(model_unit("m", ma), name="a")
+            b = PredictorService(model_unit("m", mb), name="b")
+            gw = Gateway([(a, 50.0), (b, 50.0)], seed=3)
+            resp = await gw.predict(InternalMessage(payload=np.ones((1, 2)), kind="ndarray"))
+            name = resp.meta.tags.pop("predictor")  # client stripped the tag
+            resp.meta.tags.clear()
+            await gw.send_feedback(InternalFeedback(response=resp, reward=1.0))
+            return name, ma.feedback_count, mb.feedback_count
+
+        name, fa, fb = run(scenario())
+        assert (fa, fb) == ((1, 0) if name == "a" else (0, 1))
+
+    def test_stale_client_predictor_tag_overwritten(self):
+        """A request echoing a previous response's `predictor` tag must
+        not misroute feedback: the gateway stamps the actual server."""
+
+        async def scenario():
+            a = PredictorService(model_unit("m", FixedModel([1])), name="a")
+            gw = Gateway([(a, 1.0)])
+            req = InternalMessage(payload=np.ones((1, 2)), kind="ndarray")
+            req.meta.tags["predictor"] = "phantom"
+            resp = await gw.predict(req)
+            return resp.meta.tags["predictor"]
+
+        assert run(scenario()) == "a"
+
+    def test_shadow_gets_isolated_copy(self):
+        seen_meta = []
+
+        class Spy(Doubler):
+            def predict(self, X, names, meta=None):
+                return np.asarray(X)
+
+        async def scenario():
+            primary = PredictorService(model_unit("m", Doubler()), name="primary")
+            shadow_svc = PredictorService(model_unit("m", Spy()), name="shadow")
+
+            orig_predict = shadow_svc.predict
+
+            async def spy_predict(req):
+                seen_meta.append(req.meta)
+                return await orig_predict(req)
+
+            shadow_svc.predict = spy_predict
+            gw = Gateway([(primary, 1.0)], shadows=[shadow_svc])
+            req = InternalMessage(payload=np.ones((1, 2)), kind="ndarray")
+            resp = await gw.predict(req)
+            await asyncio.sleep(0.1)  # let the fire-and-forget shadow finish
+            return req, resp
+
+        req, resp = run(scenario())
+        assert len(seen_meta) == 1
+        assert seen_meta[0] is not req.meta  # no shared mutable meta
+        assert resp.meta.tags["predictor"] == "primary"
+
     def test_grpc_seldon_service(self):
         async def scenario():
             import grpc
