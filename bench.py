@@ -18,35 +18,32 @@ efficiency, and a stub-model data-plane QPS comparable to the
 reference's published engine benchmark
 (reference: doc/source/reference/benchmarking.md:54-58, 28,256 req/s).
 
+Robustness (the TPU relay in this harness can hang or return
+UNAVAILABLE, and a wedged in-process TPU client cannot be recovered):
+
+* the default entrypoint is a **supervisor** that runs the actual bench
+  in a child process with a hard timeout, retries transient failures
+  with backoff, and ALWAYS prints the one JSON line — with diagnostics
+  and any partial phase results if every attempt failed;
+* the child probes the device with a tiny matmul (with in-child
+  retry/backoff on UNAVAILABLE) before committing to model compiles;
+* the warmup matrix is minimal: only the dtype the bench sends (uint8)
+  and three buckets, under the persistent XLA compile cache, so a
+  retried attempt re-uses every compiled program.
+
 Env knobs: BENCH_MODEL (resnet50|resnet_tiny), BENCH_SECONDS,
-BENCH_CONCURRENCY, BENCH_MAX_BATCH, BENCH_QUICK=1 (tiny model, short).
+BENCH_CONCURRENCY, BENCH_MAX_BATCH, BENCH_QUICK=1 (tiny model, short),
+BENCH_ATTEMPTS, BENCH_ATTEMPT_TIMEOUT_S, BENCH_PLATFORM (cpu for local
+smoke runs), BENCH_INT8=1 (add an int8 quantized comparison phase).
 """
 
 from __future__ import annotations
 
-import asyncio
 import json
 import os
 import statistics
-import threading
+import sys
 import time
-from concurrent.futures import ThreadPoolExecutor
-
-import numpy as np
-
-import jax
-
-# persistent XLA compilation cache: later rounds skip recompiles.
-# (set through jax.config — this environment pre-imports jax from
-# sitecustomize, so env vars are read too early to matter)
-jax.config.update(
-    "jax_compilation_cache_dir",
-    os.environ.get("JAX_COMPILATION_CACHE_DIR", os.path.join(os.path.dirname(__file__), ".jax_cache")),
-)
-jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
-
-if os.environ.get("BENCH_PLATFORM"):  # e.g. cpu for local smoke runs
-    jax.config.update("jax_platforms", os.environ["BENCH_PLATFORM"])
 
 QUICK = os.environ.get("BENCH_QUICK", "0") == "1"
 MODEL = os.environ.get("BENCH_MODEL", "resnet_tiny" if QUICK else "resnet50")
@@ -56,6 +53,187 @@ MAX_BATCH = int(os.environ.get("BENCH_MAX_BATCH", "32"))
 MAX_WAIT_MS = float(os.environ.get("BENCH_MAX_WAIT_MS", "1.0"))
 P50_TARGET_MS = 10.0  # BASELINE.md north star
 REFERENCE_GRPC_QPS = 28_256.39  # reference engine stub benchmark
+STATUS_FILE = os.environ.get(
+    "BENCH_STATUS_FILE", os.path.join(os.path.dirname(os.path.abspath(__file__)), ".bench_status.json")
+)
+METRIC_NAME = f"{MODEL}_grpc_p50_ms"
+
+
+# --------------------------------------------------------------------------
+# supervisor: run the child with retry/backoff, always emit the JSON line
+# --------------------------------------------------------------------------
+
+
+def _read_status() -> dict:
+    try:
+        with open(STATUS_FILE) as f:
+            return json.load(f)
+    except Exception:  # noqa: BLE001
+        return {}
+
+
+def _emit(result: dict) -> None:
+    print(json.dumps(result), flush=True)
+
+
+def _result_from_partial(status: dict, diagnostics: dict) -> dict:
+    """Best result constructible from the phases a failed child finished."""
+    extra = dict(status.get("extra", {}))
+    extra["partial"] = True
+    extra.update(diagnostics)
+    lat = status.get("latency_phase")
+    if lat and lat.get("p50_ms") is not None:
+        extra["latency_phase"] = lat
+        if status.get("throughput_phase"):
+            extra["throughput_phase"] = status["throughput_phase"]
+        p50 = lat["p50_ms"]
+        return {
+            "metric": METRIC_NAME,
+            "value": p50,
+            "unit": "ms",
+            "vs_baseline": round(P50_TARGET_MS / p50, 3),
+            "extra": extra,
+        }
+    return {"metric": METRIC_NAME, "value": None, "unit": "ms", "vs_baseline": 0.0, "extra": extra}
+
+
+def _phase_rank(status: dict) -> int:
+    order = {"probed": 1, "loaded": 2, "latency_done": 3, "throughput_done": 4}
+    return order.get(status.get("phase", ""), 0)
+
+
+def supervise() -> None:
+    import signal
+    import subprocess
+
+    attempts = int(os.environ.get("BENCH_ATTEMPTS", "3"))
+    timeout_s = float(os.environ.get("BENCH_ATTEMPT_TIMEOUT_S", "180" if QUICK else "420"))
+    backoffs = [10.0, 30.0, 60.0]
+    failures: list = []
+    best_status: dict = {}  # most-complete partial across ALL attempts
+    current_proc: list = [None]
+
+    def on_term(signum, frame):  # noqa: ARG001
+        # the driver is killing us: kill the (possibly wedged) child so
+        # it can't keep holding the device, then emit the best partial
+        # result so the round still records a JSON line
+        proc = current_proc[0]
+        if proc is not None and proc.poll() is None:
+            proc.kill()
+        status = max(best_status, _read_status(), key=_phase_rank)
+        _emit(_result_from_partial(status, {"failed_attempts": failures, "killed": True}))
+        os._exit(0)
+
+    signal.signal(signal.SIGTERM, on_term)
+    signal.signal(signal.SIGINT, on_term)
+
+    for attempt in range(attempts):
+        try:
+            os.remove(STATUS_FILE)
+        except OSError:
+            pass
+        env = dict(os.environ, BENCH_CHILD="1")
+        t0 = time.time()
+        proc = subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__)],
+            env=env,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE,
+            text=True,
+        )
+        current_proc[0] = proc
+        try:
+            stdout, stderr = proc.communicate(timeout=timeout_s)
+            for ln in reversed([ln for ln in stdout.splitlines() if ln.strip()]):
+                try:
+                    parsed = json.loads(ln)
+                except ValueError:
+                    continue
+                if isinstance(parsed, dict) and parsed.get("metric") and parsed.get("value") is not None:
+                    _emit(parsed)
+                    return
+            failures.append(
+                {
+                    "attempt": attempt + 1,
+                    "rc": proc.returncode,
+                    "elapsed_s": round(time.time() - t0, 1),
+                    "tail": (stderr or stdout or "")[-600:],
+                }
+            )
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.communicate()
+            failures.append(
+                {
+                    "attempt": attempt + 1,
+                    "rc": "timeout",
+                    "elapsed_s": round(time.time() - t0, 1),
+                    "tail": "attempt hit hard timeout (relay hang?)",
+                }
+            )
+        finally:
+            current_proc[0] = None
+        best_status = max(best_status, _read_status(), key=_phase_rank)
+        if attempt < attempts - 1:
+            time.sleep(backoffs[min(attempt, len(backoffs) - 1)])
+
+    # every attempt failed: salvage the most-complete partial seen
+    _emit(_result_from_partial(best_status, {"failed_attempts": failures}))
+
+
+# --------------------------------------------------------------------------
+# child: the actual benchmark
+# --------------------------------------------------------------------------
+
+
+def _checkpoint(status: dict) -> None:
+    """Phase-by-phase progress file so the supervisor can salvage
+    partial results if a later phase wedges."""
+    tmp = STATUS_FILE + ".tmp"
+    try:
+        with open(tmp, "w") as f:
+            json.dump(status, f)
+        os.replace(tmp, STATUS_FILE)
+    except OSError:
+        pass
+
+
+def _configure_jax():
+    import jax
+
+    # persistent XLA compilation cache: retried attempts and later
+    # rounds skip recompiles.  (set through jax.config — this
+    # environment pre-imports jax from sitecustomize, so env vars are
+    # read too early to matter)
+    jax.config.update(
+        "jax_compilation_cache_dir",
+        os.environ.get("JAX_COMPILATION_CACHE_DIR",
+                       os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache")),
+    )
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    if os.environ.get("BENCH_PLATFORM"):  # e.g. cpu for local smoke runs
+        jax.config.update("jax_platforms", os.environ["BENCH_PLATFORM"])
+    return jax
+
+
+def probe_device(jax, attempts: int = 3) -> str:
+    """Tiny matmul with in-child retry on transient UNAVAILABLE: proves
+    the device answers before we commit to multi-minute model compiles."""
+    import jax.numpy as jnp
+
+    last: Exception | None = None
+    for i in range(attempts):
+        try:
+            x = jnp.ones((8, 8), jnp.float32)
+            jnp.dot(x, x).block_until_ready()
+            return str(jax.devices()[0])
+        except Exception as e:  # noqa: BLE001 — jaxlib runtime error types vary
+            last = e
+            if "UNAVAILABLE" not in str(e) and "unavailable" not in str(e).lower():
+                raise
+            if i < attempts - 1:  # no pointless backoff after the last try
+                time.sleep([2.0, 8.0, 20.0][min(i, 2)])
+    raise RuntimeError(f"device probe failed after {attempts} attempts: {last}")
 
 
 def build_gateway():
@@ -72,7 +250,12 @@ def build_gateway():
         dtype="bfloat16",
         max_batch_size=MAX_BATCH,
         max_wait_ms=MAX_WAIT_MS,
-        buckets=[1, 4, 16, MAX_BATCH] if MAX_BATCH > 16 else None,
+        # three buckets keep the compile count (and relay exposure)
+        # minimal: 1 for the latency phase, mid + max for throughput
+        buckets=[1, 4, MAX_BATCH] if MAX_BATCH > 4 else None,
+        # the bench sends uint8 images and the server canonicalises
+        # everything else host-side — warm ONLY that dtype
+        warmup_dtypes=("uint8",),
     )
     unit = UnitSpec(name=MODEL, type="MODEL", component=server)
     svc = PredictorService(unit, name="bench")
@@ -84,10 +267,14 @@ def grpc_worker(port: int, shape, stop_at: float, latencies: list, errors: list,
     """One sync-client thread: tight request loop until the deadline."""
     import grpc
 
+    import numpy as np
+
     from seldon_core_tpu.proto import pb, services
 
     channel = grpc.insecure_channel(f"127.0.0.1:{port}")
     predict = services.unary_callable(channel, "Seldon", "Predict")
+    import threading
+
     img = (np.random.default_rng(threading.get_ident() % 2**31).integers(
         0, 255, size=(client_batch, *shape), dtype=np.uint8))
     req = pb.SeldonMessage()
@@ -110,6 +297,9 @@ def grpc_worker(port: int, shape, stop_at: float, latencies: list, errors: list,
 
 
 async def measure_phase(port: int, shape, seconds: float, concurrency: int, client_batch: int = 1):
+    import asyncio
+    from concurrent.futures import ThreadPoolExecutor
+
     latencies: list = []
     errors: list = []
     stop_at = time.perf_counter() + seconds
@@ -132,6 +322,10 @@ async def inprocess_images_per_s(gateway, shape, seconds: float = 5.0,
     batcher -> XLA.  On this 1-CPU harness the loopback gRPC phases are
     bound by Python packet handling; this isolates the framework+device
     capacity that a native front server would expose."""
+    import asyncio
+
+    import numpy as np
+
     from seldon_core_tpu.runtime.message import InternalMessage
 
     img = np.zeros((batch, *shape), np.uint8)
@@ -154,6 +348,10 @@ async def inprocess_images_per_s(gateway, shape, seconds: float = 5.0,
 async def stub_dataplane_qps(seconds: float = 2.0) -> float:
     """In-process stub-model executor throughput (reference-comparable
     data-plane number, no model compute, no wire)."""
+    import asyncio
+
+    import numpy as np
+
     from seldon_core_tpu.engine import PredictorService, UnitSpec
     from seldon_core_tpu.runtime.message import InternalMessage
 
@@ -175,13 +373,20 @@ async def stub_dataplane_qps(seconds: float = 2.0) -> float:
     return count / seconds
 
 
-async def main() -> None:
-    import grpc
+async def child_main() -> None:
+    jax = _configure_jax()
+    status: dict = {"model": MODEL, "extra": {}}
 
-    import jax
+    device = probe_device(jax)
+    status["extra"]["device"] = device
+    status["phase"] = "probed"
+    _checkpoint(status)
 
     t_setup = time.perf_counter()
     gateway, server, shape = build_gateway()
+    server.load()  # compiles + warms the three (bucket, uint8) programs
+
+    import asyncio
 
     from seldon_core_tpu.engine.server import GrpcServerHandle
     from seldon_core_tpu.engine.sync_server import build_sync_seldon_server
@@ -193,63 +398,125 @@ async def main() -> None:
     raw_server.start()
     grpc_server = GrpcServerHandle(raw_server, is_aio=False)
     setup_s = time.perf_counter() - t_setup
+    status["extra"]["setup_s"] = round(setup_s, 1)
+    status["phase"] = "loaded"
+    _checkpoint(status)
 
     # ---- phase 1: latency (low concurrency, batch-1 requests) ------------
     lat_conc = int(os.environ.get("BENCH_LAT_CONCURRENCY", "4"))
     lat, lat_errors = await measure_phase(port, shape, SECONDS, lat_conc, client_batch=1)
+    if lat:
+        p50 = statistics.median(lat)
+        status["latency_phase"] = {
+            "concurrency": lat_conc,
+            "qps": round(len(lat) / SECONDS, 1),
+            "p50_ms": round(p50, 3),
+            "p90_ms": round(lat[int(len(lat) * 0.90)], 3),
+            "p99_ms": round(lat[min(len(lat) - 1, int(len(lat) * 0.99))], 3),
+            "mean_ms": round(statistics.fmean(lat), 3),
+            "errors": len(lat_errors),
+        }
+        status["phase"] = "latency_done"
+        _checkpoint(status)
 
     # ---- phase 2: throughput (high concurrency, batched requests) --------
     tput_batch = int(os.environ.get("BENCH_CLIENT_BATCH", "16"))
     tput, tput_errors = await measure_phase(port, shape, SECONDS, CONCURRENCY, client_batch=tput_batch)
-
     await grpc_server.stop(grace=None)
+    if tput:
+        status["throughput_phase"] = {
+            "concurrency": CONCURRENCY,
+            "client_batch": tput_batch,
+            "images_per_s": round(len(tput) * tput_batch / SECONDS, 1),
+            "requests_per_s": round(len(tput) / SECONDS, 1),
+            "p50_ms": round(statistics.median(tput), 3),
+            "errors": len(tput_errors),
+        }
+        status["phase"] = "throughput_done"
+        _checkpoint(status)
 
-    inproc_ips = await inprocess_images_per_s(gateway, shape, seconds=min(SECONDS, 5.0))
-    stub_qps = await stub_dataplane_qps(2.0)
+    # ---- auxiliary phases (never block the headline number) --------------
+    try:
+        inproc_ips = await inprocess_images_per_s(gateway, shape, seconds=min(SECONDS, 5.0))
+        status["extra"]["inprocess_images_per_s"] = round(inproc_ips, 1)
+    except Exception as e:  # noqa: BLE001
+        status["extra"]["inprocess_error"] = str(e)[:200]
+    try:
+        stub_qps = await stub_dataplane_qps(2.0)
+        status["extra"]["stub_engine_qps"] = round(stub_qps, 1)
+        status["extra"]["stub_vs_reference_grpc"] = round(stub_qps / REFERENCE_GRPC_QPS, 3)
+    except Exception as e:  # noqa: BLE001
+        status["extra"]["stub_error"] = str(e)[:200]
+
+    if os.environ.get("BENCH_INT8", "0") == "1":
+        try:
+            status["extra"]["int8"] = await int8_phase(shape)
+        except Exception as e:  # noqa: BLE001
+            status["extra"]["int8_error"] = str(e)[:200]
+
+    status["extra"]["mean_batch_rows"] = round(server.batcher.stats.mean_batch_rows, 2)
+    status["extra"]["device_batches"] = server.batcher.stats.batches
     server.unload()
+    _checkpoint(status)
 
     if not lat:
-        print(json.dumps({"metric": "resnet50_grpc_p50_ms", "value": None, "unit": "ms",
-                          "vs_baseline": 0.0, "extra": {"errors": (lat_errors + tput_errors)[:5]}}))
+        _emit({"metric": METRIC_NAME, "value": None, "unit": "ms", "vs_baseline": 0.0,
+               "extra": {**status["extra"], "errors": (lat_errors + tput_errors)[:5]}})
         return
 
     p50 = statistics.median(lat)
-    images_per_s = len(tput) * tput_batch / SECONDS
-    result = {
-        "metric": "resnet50_grpc_p50_ms" if MODEL == "resnet50" else f"{MODEL}_grpc_p50_ms",
+    extra = dict(status["extra"])
+    extra["latency_phase"] = status["latency_phase"]
+    if "throughput_phase" in status:
+        extra["throughput_phase"] = status["throughput_phase"]
+    _emit({
+        "metric": METRIC_NAME,
         "value": round(p50, 3),
         "unit": "ms",
         "vs_baseline": round(P50_TARGET_MS / p50, 3),
-        "extra": {
-            "model": MODEL,
-            "device": str(jax.devices()[0]),
-            "latency_phase": {
-                "concurrency": lat_conc,
-                "qps": round(len(lat) / SECONDS, 1),
-                "p50_ms": round(p50, 3),
-                "p90_ms": round(lat[int(len(lat) * 0.90)], 3),
-                "p99_ms": round(lat[min(len(lat) - 1, int(len(lat) * 0.99))], 3),
-                "mean_ms": round(statistics.fmean(lat), 3),
-                "errors": len(lat_errors),
-            },
-            "throughput_phase": {
-                "concurrency": CONCURRENCY,
-                "client_batch": tput_batch,
-                "images_per_s": round(images_per_s, 1),
-                "requests_per_s": round(len(tput) / SECONDS, 1),
-                "p50_ms": round(statistics.median(tput), 3) if tput else None,
-                "errors": len(tput_errors),
-            },
-            "inprocess_images_per_s": round(inproc_ips, 1),
-            "mean_batch_rows": round(server.batcher.stats.mean_batch_rows, 2),
-            "device_batches": server.batcher.stats.batches,
-            "stub_engine_qps": round(stub_qps, 1),
-            "stub_vs_reference_grpc": round(stub_qps / REFERENCE_GRPC_QPS, 3),
-            "setup_s": round(setup_s, 1),
-        },
-    }
-    print(json.dumps(result))
+        "extra": extra,
+    })
+
+
+async def int8_phase(shape) -> dict:
+    """fp-vs-int8 served throughput on the same model family."""
+    import inspect
+
+    import numpy as np
+
+    from seldon_core_tpu.models.jaxserver import JaxServer
+
+    if "quantize" not in inspect.signature(JaxServer.__init__).parameters:
+        raise RuntimeError("JaxServer has no quantize support; int8 phase would silently measure fp")
+    out: dict = {}
+    for tag, kwargs in (("fp", {}), ("int8", {"quantize": "int8"})):
+        server = JaxServer(
+            model=MODEL,
+            num_classes=1000 if MODEL == "resnet50" else 10,
+            input_shape=shape,
+            dtype="bfloat16",
+            max_batch_size=MAX_BATCH,
+            max_wait_ms=MAX_WAIT_MS,
+            buckets=[MAX_BATCH],
+            warmup_dtypes=("uint8",),
+            **kwargs,
+        )
+        server.load()
+        img = np.zeros((MAX_BATCH, *shape), np.uint8)
+        t0 = time.perf_counter()
+        n = 0
+        while time.perf_counter() - t0 < 3.0:
+            server.predict(img, [])
+            n += MAX_BATCH
+        out[f"{tag}_images_per_s"] = round(n / (time.perf_counter() - t0), 1)
+        server.unload()
+    return out
 
 
 if __name__ == "__main__":
-    asyncio.run(main())
+    if os.environ.get("BENCH_CHILD") == "1":
+        import asyncio
+
+        asyncio.run(child_main())
+    else:
+        supervise()
