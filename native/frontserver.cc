@@ -1,0 +1,1247 @@
+// seldon-core-tpu native front server — the C++ data-plane ingress.
+//
+// The reference keeps its per-request serving path out of Python on
+// purpose (the Java engine; reference: doc/source/graph/svcorch.md:1-8,
+// engine/src/main/java/io/seldon/engine/api/rest/RestClientController.java:127-235).
+// This is the TPU build's equivalent: an epoll HTTP/1.1 server that
+// owns the request hot path end to end —
+//
+//   accept/read -> HTTP parse -> payload decode (JSON tensor/ndarray or
+//   binary raw-tensor frames) -> native dynamic batching (coalesce +
+//   pad to bucket) -> ONE Python callback per *batch* (or an in-C++
+//   stub model for data-plane benchmarking, mirroring the reference's
+//   SIMPLE_MODEL methodology, reference:
+//   doc/source/reference/benchmarking.md:19-36) -> native response
+//   serialisation -> write.
+//
+// Per-request Python cost is zero on the fast lane; the GIL is taken
+// once per coalesced batch.  Requests the fast lane cannot express
+// (strData/jsonData/binData payloads, feedback, multi-node graphs)
+// fall through to a registered *raw* Python handler that speaks the
+// full engine semantics — slower but complete, never wrong.
+//
+// Exposed with a plain C ABI and driven from Python via ctypes
+// (no pybind11 in this environment).  Single-file, standard library +
+// POSIX only: no grpc++/libevent dependency to build in a zero-egress
+// environment.
+//
+// Threading model (sized for small hosts): 1 IO thread (epoll: accept,
+// read, parse, decode, write), 1 batcher thread (coalesce, model call,
+// serialise), N raw-worker threads (Python fallback).  Completed
+// responses return to the IO thread through an eventfd-signalled queue.
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/epoll.h>
+#include <sys/eventfd.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <algorithm>
+#include <atomic>
+#include <cerrno>
+#include <chrono>
+#include <condition_variable>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <deque>
+#include <map>
+#include <mutex>
+#include <random>
+#include <string>
+#include <thread>
+#include <unordered_map>
+#include <vector>
+
+// from codec.cc (same shared object)
+extern "C" {
+int64_t json_parse_f64(const char* src, int64_t n, double* dst, int64_t cap);
+int64_t json_serialize_f64(const double* src, int64_t n, char* dst);
+}
+
+namespace {
+
+using Clock = std::chrono::steady_clock;
+
+// ---------------------------------------------------------------------------
+// C ABI types
+// ---------------------------------------------------------------------------
+
+extern "C" {
+
+// One Python call per coalesced batch: in = [rows, cols] float32
+// (padded to the bucket), out = [rows, out_cols] float32 to fill.
+// Return 0 on success.
+typedef int32_t (*fs_batch_cb)(void* ctx, const float* in, int64_t rows,
+                               int64_t cols, float* out, int64_t out_cols);
+
+// Fallback lane: full request handed to Python, response returned as a
+// buffer obtained from fs_alloc (freed by the server after writing).
+// Return 0 on success (any other value -> 500).
+typedef int32_t (*fs_raw_cb)(void* ctx, const char* method, const char* path,
+                             const uint8_t* body, int64_t body_len,
+                             uint8_t** out_buf, int64_t* out_len,
+                             int32_t* http_status, char* content_type64);
+
+typedef struct {
+  int32_t port;            // 0 = ephemeral
+  int32_t max_batch;       // fast-lane coalescing cap (rows)
+  int32_t max_wait_us;     // fast-lane batching window
+  int32_t feature_dim;     // fast lane accepts [rows, feature_dim] f32
+  int32_t out_dim;         // model output columns
+  int32_t stub_mode;       // 1: in-C++ fixed-output model (no Python)
+  int32_t raw_workers;     // fallback worker threads
+  int32_t backlog;
+  int32_t eager_when_idle; // 1: dispatch immediately when the queue is
+                           // empty — the in-flight model call is the
+                           // accumulation window; max_wait only bounds
+                           // collection when requests are already queued
+  const char* model_name;  // for requestPath / names in responses
+  const char* names_csv;   // response names ("" -> t:0..out_dim-1)
+  const char* buckets_csv; // padding ladder ("" -> powers of two); MUST
+                           // match the Python-side normalize_buckets
+                           // list or padded shapes were never warmed
+} FsConfig;
+
+typedef struct {
+  int64_t requests;        // total HTTP requests handled
+  int64_t fast_requests;   // served by the native fast lane
+  int64_t raw_requests;    // served by the Python fallback lane
+  int64_t batches;         // fast-lane device/model calls
+  int64_t rows;            // fast-lane rows served
+  int64_t padded_rows;     // padding rows added to reach buckets
+  int64_t failures;        // 4xx/5xx responses
+  int64_t connections;     // accepted connections
+} FsStats;
+
+}  // extern "C"
+
+// ---------------------------------------------------------------------------
+// helpers
+// ---------------------------------------------------------------------------
+
+struct HttpReq {
+  std::string method;
+  std::string path;
+  int64_t content_length = -1;
+  bool keep_alive = true;
+  bool is_raw_tensor = false;  // content-type: application/x-seldon-raw
+  size_t header_bytes = 0;     // offset where the body starts
+};
+
+bool iequal(const char* a, const char* b, size_t n) {
+  for (size_t i = 0; i < n; i++) {
+    if (tolower((unsigned char)a[i]) != tolower((unsigned char)b[i])) return false;
+  }
+  return true;
+}
+
+// Parse status line + the few headers we need.  Returns false when the
+// header block is malformed.
+bool parse_http(const char* buf, size_t header_end, HttpReq* out) {
+  const char* p = buf;
+  const char* end = buf + header_end;
+  const char* sp1 = (const char*)memchr(p, ' ', end - p);
+  if (!sp1) return false;
+  out->method.assign(p, sp1 - p);
+  const char* sp2 = (const char*)memchr(sp1 + 1, ' ', end - sp1 - 1);
+  if (!sp2) return false;
+  out->path.assign(sp1 + 1, sp2 - sp1 - 1);
+  // strip query string for routing (kept out of the fast lane)
+  size_t q = out->path.find('?');
+  if (q != std::string::npos) out->path.resize(q);
+  const char* line = (const char*)memchr(sp2, '\n', end - sp2);
+  if (!line) return false;
+  line++;
+  while (line < end) {
+    // the final header line has no trailing '\n' inside [buf, end):
+    // header_end points at the terminating "\r\n\r\n"
+    const char* eol = (const char*)memchr(line, '\n', end - line);
+    const char* line_end = eol ? eol : end;
+    size_t len = line_end - line;
+    if (len && line[len - 1] == '\r') len--;
+    if (len == 0) break;
+    const char* colon = (const char*)memchr(line, ':', len);
+    if (colon) {
+      size_t klen = colon - line;
+      const char* v = colon + 1;
+      while (v < line + len && *v == ' ') v++;
+      size_t vlen = line + len - v;
+      if (klen == 14 && iequal(line, "content-length", 14)) {
+        out->content_length = strtoll(std::string(v, vlen).c_str(), nullptr, 10);
+      } else if (klen == 10 && iequal(line, "connection", 10)) {
+        out->keep_alive = !(vlen >= 5 && iequal(v, "close", 5));
+      } else if (klen == 12 && iequal(line, "content-type", 12)) {
+        out->is_raw_tensor =
+            (vlen >= 20 && iequal(v, "application/x-seldon", 20));
+      }
+    }
+    if (!eol) break;
+    line = eol + 1;
+  }
+  return true;
+}
+
+// locate `"key"` at any nesting depth; returns offset after the closing
+// quote of the key, or npos
+size_t find_key(const std::string& s, const char* key, size_t from = 0) {
+  std::string pat = std::string("\"") + key + "\"";
+  size_t pos = s.find(pat, from);
+  return pos == std::string::npos ? std::string::npos : pos + pat.size();
+}
+
+// scan past whitespace and an expected ':'
+bool skip_colon(const std::string& s, size_t* pos) {
+  size_t i = *pos;
+  while (i < s.size() && (s[i] == ' ' || s[i] == '\t' || s[i] == '\n' || s[i] == '\r')) i++;
+  if (i >= s.size() || s[i] != ':') return false;
+  i++;
+  while (i < s.size() && (s[i] == ' ' || s[i] == '\t' || s[i] == '\n' || s[i] == '\r')) i++;
+  *pos = i;
+  return true;
+}
+
+// bracket-matched span of a JSON array starting at s[start]=='['
+bool array_span(const std::string& s, size_t start, size_t* end_out) {
+  if (start >= s.size() || s[start] != '[') return false;
+  int depth = 0;
+  bool in_str = false;
+  for (size_t i = start; i < s.size(); i++) {
+    char c = s[i];
+    if (in_str) {
+      if (c == '\\') i++;
+      else if (c == '"') in_str = false;
+      continue;
+    }
+    if (c == '"') in_str = true;
+    else if (c == '[') depth++;
+    else if (c == ']') {
+      depth--;
+      if (depth == 0) { *end_out = i + 1; return true; }
+    }
+  }
+  return false;
+}
+
+// extract a JSON string value for `key` ("" when absent)
+std::string find_string_value(const std::string& s, const char* key) {
+  size_t pos = find_key(s, key);
+  if (pos == std::string::npos) return "";
+  if (!skip_colon(s, &pos)) return "";
+  if (pos >= s.size() || s[pos] != '"') return "";
+  std::string out;
+  for (size_t i = pos + 1; i < s.size(); i++) {
+    char c = s[i];
+    if (c == '\\' && i + 1 < s.size()) { out.push_back(s[i + 1]); i++; continue; }
+    if (c == '"') return out;
+    out.push_back(c);
+  }
+  return "";
+}
+
+// ---------------------------------------------------------------------------
+// raw binary tensor frames (the HTTP/2-free RawTensor fast path)
+// ---------------------------------------------------------------------------
+//
+// frame := magic u32 'S''R''T''1' | dtype u8 | ndim u8 | flags u16 |
+//          shape i64[ndim] | payload bytes (little-endian, C order)
+// dtype:  0=float32 1=uint8 2=int32 3=float64
+
+constexpr uint32_t kRawMagic = 0x31545253;  // "SRT1" little-endian
+
+struct RawFrame {
+  int dtype = -1;
+  std::vector<int64_t> shape;
+  const uint8_t* data = nullptr;
+  int64_t data_len = 0;
+};
+
+bool parse_raw_frame(const uint8_t* body, int64_t len, RawFrame* out) {
+  if (len < 8) return false;
+  uint32_t magic;
+  memcpy(&magic, body, 4);
+  if (magic != kRawMagic) return false;
+  out->dtype = body[4];
+  int ndim = body[5];
+  if (ndim < 1 || ndim > 8) return false;
+  int64_t off = 8;
+  if (len < off + 8 * ndim) return false;
+  out->shape.resize(ndim);
+  memcpy(out->shape.data(), body + off, 8 * ndim);
+  off += 8 * ndim;
+  out->data = body + off;
+  out->data_len = len - off;
+  static const int64_t kItem[4] = {4, 1, 4, 8};
+  if (out->dtype < 0 || out->dtype > 3) return false;
+  // overflow-safe element count: attacker-controlled dims must not wrap
+  constexpr uint64_t kMaxElems = 1ull << 31;
+  uint64_t n = 1;
+  for (int64_t d : out->shape) {
+    if (d < 0 || (uint64_t)d > kMaxElems) return false;
+    n *= (uint64_t)d;
+    if (n > kMaxElems) return false;
+  }
+  return (uint64_t)out->data_len == n * (uint64_t)kItem[out->dtype];
+}
+
+// ---------------------------------------------------------------------------
+// request / response plumbing
+// ---------------------------------------------------------------------------
+
+enum class Lane { FAST_JSON, FAST_RAW, RAW };
+
+struct PendingReq {
+  uint64_t conn_id;
+  uint64_t seq;
+  Lane lane;
+  bool keep_alive;
+  // fast lane
+  std::vector<float> features;  // [rows * cols]
+  int64_t rows = 0;
+  std::string puid;             // echoed if the client sent one
+  // raw lane
+  std::string method;
+  std::string path;
+  std::vector<uint8_t> body;
+};
+
+struct DoneResp {
+  uint64_t conn_id;
+  uint64_t seq;
+  bool keep_alive;
+  std::string bytes;  // full HTTP response
+};
+
+struct Conn {
+  int fd = -1;
+  std::string in;
+  std::string out;
+  size_t out_off = 0;
+  uint64_t next_assign = 0;   // next request sequence on this connection
+  uint64_t next_write = 0;    // next sequence to write (ordering)
+  std::map<uint64_t, DoneResp> ready;  // out-of-order completions
+  uint64_t inflight = 0;
+  bool closing = false;
+};
+
+std::string http_response(int status, const char* content_type,
+                          const std::string& body, bool keep_alive) {
+  const char* reason = "OK";
+  switch (status) {
+    case 200: reason = "OK"; break;
+    case 400: reason = "Bad Request"; break;
+    case 404: reason = "Not Found"; break;
+    case 405: reason = "Method Not Allowed"; break;
+    case 411: reason = "Length Required"; break;
+    case 500: reason = "Internal Server Error"; break;
+    case 503: reason = "Service Unavailable"; break;
+    default: reason = "Status"; break;
+  }
+  char head[256];
+  int n = snprintf(head, sizeof(head),
+                   "HTTP/1.1 %d %s\r\n"
+                   "Content-Type: %s\r\n"
+                   "Content-Length: %zu\r\n"
+                   "Connection: %s\r\n\r\n",
+                   status, reason, content_type, body.size(),
+                   keep_alive ? "keep-alive" : "close");
+  std::string out;
+  out.reserve(n + body.size());
+  out.append(head, n);
+  out.append(body);
+  return out;
+}
+
+std::string seldon_error_json(int code, const std::string& info, const char* reason) {
+  std::string body = "{\"status\":{\"status\":\"FAILURE\",\"code\":";
+  body += std::to_string(code);
+  body += ",\"info\":\"";
+  for (char c : info) {
+    if (c == '"' || c == '\\') body.push_back('\\');
+    if ((unsigned char)c >= 0x20) body.push_back(c);
+  }
+  body += "\",\"reason\":\"";
+  body += reason;
+  body += "\"}}";
+  return body;
+}
+
+// ---------------------------------------------------------------------------
+// server
+// ---------------------------------------------------------------------------
+
+class FrontServer {
+ public:
+  explicit FrontServer(const FsConfig& cfg)
+      : cfg_(cfg),
+        model_name_(cfg.model_name ? cfg.model_name : "model"),
+        names_csv_(cfg.names_csv ? cfg.names_csv : "") {
+    if (cfg_.max_batch < 1) cfg_.max_batch = 64;
+    if (cfg_.max_wait_us < 0) cfg_.max_wait_us = 1000;
+    if (cfg_.out_dim < 1) cfg_.out_dim = 3;
+    if (cfg_.raw_workers < 1) cfg_.raw_workers = 2;
+    if (cfg_.backlog < 1) cfg_.backlog = 512;
+    // bucket ladder: explicit list from the caller (the Python side's
+    // normalize_buckets output, so warmup covers exactly the shapes
+    // this server emits) or powers of two up to max_batch
+    if (cfg.buckets_csv && cfg.buckets_csv[0]) {
+      const char* s = cfg.buckets_csv;
+      while (*s) {
+        char* end = nullptr;
+        long v = strtol(s, &end, 10);
+        if (end == s) break;
+        if (v >= 1) buckets_.push_back((int)v);
+        s = (*end == ',') ? end + 1 : end;
+      }
+      std::sort(buckets_.begin(), buckets_.end());
+      buckets_.erase(std::unique(buckets_.begin(), buckets_.end()), buckets_.end());
+    }
+    if (buckets_.empty()) {
+      for (int b = 1; b < cfg_.max_batch; b *= 2) buckets_.push_back(b);
+      buckets_.push_back(cfg_.max_batch);
+    }
+    if (buckets_.back() < cfg_.max_batch) buckets_.push_back(cfg_.max_batch);
+    // response names prefix
+    if (!names_csv_.empty()) {
+      size_t start = 0;
+      while (start <= names_csv_.size()) {
+        size_t comma = names_csv_.find(',', start);
+        if (comma == std::string::npos) {
+          names_.push_back(names_csv_.substr(start));
+          break;
+        }
+        names_.push_back(names_csv_.substr(start, comma - start));
+        start = comma + 1;
+      }
+    }
+    std::random_device rd;
+    char prefix[32];
+    snprintf(prefix, sizeof(prefix), "%08x%04x", rd(), (unsigned)(rd() & 0xffff));
+    puid_prefix_ = prefix;
+  }
+
+  ~FrontServer() { stop(); }
+
+  void set_batch_handler(fs_batch_cb cb, void* ctx) {
+    batch_cb_ = cb;
+    batch_ctx_ = ctx;
+  }
+  void set_raw_handler(fs_raw_cb cb, void* ctx) {
+    raw_cb_ = cb;
+    raw_ctx_ = ctx;
+  }
+  void set_ready(bool r) { ready_.store(r); }
+
+  int start() {
+    listen_fd_ = socket(AF_INET, SOCK_STREAM | SOCK_NONBLOCK, 0);
+    if (listen_fd_ < 0) return -errno;
+    int one = 1;
+    setsockopt(listen_fd_, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_addr.s_addr = htonl(INADDR_ANY);
+    addr.sin_port = htons((uint16_t)cfg_.port);
+    if (bind(listen_fd_, (sockaddr*)&addr, sizeof(addr)) < 0 ||
+        listen(listen_fd_, cfg_.backlog) < 0) {
+      int err = errno;
+      close(listen_fd_);
+      listen_fd_ = -1;
+      return -err;
+    }
+    socklen_t alen = sizeof(addr);
+    getsockname(listen_fd_, (sockaddr*)&addr, &alen);
+    port_ = ntohs(addr.sin_port);
+
+    epoll_fd_ = epoll_create1(0);
+    wake_fd_ = eventfd(0, EFD_NONBLOCK);
+    epoll_event ev{};
+    ev.events = EPOLLIN;
+    ev.data.u64 = kListenTag;
+    epoll_ctl(epoll_fd_, EPOLL_CTL_ADD, listen_fd_, &ev);
+    ev.events = EPOLLIN;
+    ev.data.u64 = kWakeTag;
+    epoll_ctl(epoll_fd_, EPOLL_CTL_ADD, wake_fd_, &ev);
+
+    running_.store(true);
+    io_thread_ = std::thread([this] { io_loop(); });
+    batch_thread_ = std::thread([this] { batch_loop(); });
+    for (int i = 0; i < cfg_.raw_workers; i++) {
+      raw_threads_.emplace_back([this] { raw_loop(); });
+    }
+    return port_;
+  }
+
+  void stop() {
+    if (!running_.exchange(false)) return;
+    wake();
+    {
+      std::lock_guard<std::mutex> lk(batch_mu_);
+      batch_cv_.notify_all();
+    }
+    {
+      std::lock_guard<std::mutex> lk(raw_mu_);
+      raw_cv_.notify_all();
+    }
+    if (io_thread_.joinable()) io_thread_.join();
+    if (batch_thread_.joinable()) batch_thread_.join();
+    for (auto& t : raw_threads_)
+      if (t.joinable()) t.join();
+    raw_threads_.clear();
+    if (listen_fd_ >= 0) close(listen_fd_);
+    if (epoll_fd_ >= 0) close(epoll_fd_);
+    if (wake_fd_ >= 0) close(wake_fd_);
+    listen_fd_ = epoll_fd_ = wake_fd_ = -1;
+    for (auto& kv : conns_) close(kv.second.fd);
+    conns_.clear();
+  }
+
+  int port() const { return port_; }
+
+  void get_stats(FsStats* s) const {
+    s->requests = requests_.load();
+    s->fast_requests = fast_requests_.load();
+    s->raw_requests = raw_requests_.load();
+    s->batches = batches_.load();
+    s->rows = rows_.load();
+    s->padded_rows = padded_rows_.load();
+    s->failures = failures_.load();
+    s->connections = connections_.load();
+  }
+
+ private:
+  static constexpr uint64_t kListenTag = ~0ull;
+  static constexpr uint64_t kWakeTag = ~0ull - 1;
+
+  // ------------------------------------------------------------------ IO
+
+  void wake() {
+    uint64_t v = 1;
+    ssize_t r = write(wake_fd_, &v, 8);
+    (void)r;
+  }
+
+  void io_loop() {
+    epoll_event events[128];
+    while (running_.load()) {
+      int n = epoll_wait(epoll_fd_, events, 128, 100);
+      for (int i = 0; i < n; i++) {
+        uint64_t tag = events[i].data.u64;
+        if (tag == kListenTag) {
+          accept_all();
+        } else if (tag == kWakeTag) {
+          uint64_t v;
+          while (read(wake_fd_, &v, 8) == 8) {
+          }
+          drain_done();
+        } else {
+          handle_conn_event(tag, events[i].events);
+        }
+      }
+      drain_done();
+    }
+  }
+
+  void accept_all() {
+    for (;;) {
+      int fd = accept4(listen_fd_, nullptr, nullptr, SOCK_NONBLOCK);
+      if (fd < 0) break;
+      int one = 1;
+      setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+      uint64_t id = next_conn_id_++;
+      Conn c;
+      c.fd = fd;
+      conns_.emplace(id, std::move(c));
+      connections_.fetch_add(1);
+      epoll_event ev{};
+      ev.events = EPOLLIN;
+      ev.data.u64 = id;
+      epoll_ctl(epoll_fd_, EPOLL_CTL_ADD, fd, &ev);
+    }
+  }
+
+  void close_conn(uint64_t id) {
+    auto it = conns_.find(id);
+    if (it == conns_.end()) return;
+    epoll_ctl(epoll_fd_, EPOLL_CTL_DEL, it->second.fd, nullptr);
+    close(it->second.fd);
+    conns_.erase(it);
+  }
+
+  void handle_conn_event(uint64_t id, uint32_t evmask) {
+    auto it = conns_.find(id);
+    if (it == conns_.end()) return;
+    Conn& c = it->second;
+    if (evmask & (EPOLLHUP | EPOLLERR)) {
+      close_conn(id);
+      return;
+    }
+    if (evmask & EPOLLIN) {
+      char buf[65536];
+      for (;;) {
+        ssize_t r = recv(c.fd, buf, sizeof(buf), 0);
+        if (r > 0) {
+          c.in.append(buf, r);
+          if (c.in.size() > (512u << 20)) {  // 512 MB guard
+            close_conn(id);
+            return;
+          }
+          continue;
+        }
+        if (r == 0) {  // peer FIN: legal half-close — process what we
+                       // have buffered, answer it, then close
+          c.closing = true;
+          process_input(id);
+          if (conns_.count(id)) {
+            Conn& c2 = conns_.find(id)->second;
+            if (c2.inflight == 0 && c2.out.size() == c2.out_off) close_conn(id);
+            else flush_out(id);
+          }
+          return;
+        }
+        if (errno == EAGAIN || errno == EWOULDBLOCK) break;
+        close_conn(id);
+        return;
+      }
+      process_input(id);
+      if (conns_.count(id)) flush_out(id);
+    }
+    if (evmask & EPOLLOUT) flush_out(id);
+  }
+
+  void process_input(uint64_t id) {
+    auto it = conns_.find(id);
+    while (it != conns_.end()) {
+      Conn& c = it->second;
+      size_t header_end = c.in.find("\r\n\r\n");
+      if (header_end == std::string::npos) {
+        if (c.in.size() > 64 * 1024) close_conn(id);  // header bomb
+        return;
+      }
+      HttpReq req;
+      if (!parse_http(c.in.data(), header_end, &req)) {
+        queue_inline_response(c, 400, seldon_error_json(400, "malformed HTTP request", "BAD_REQUEST"),
+                              true, false);
+        c.in.clear();
+        c.closing = true;
+        return;
+      }
+      req.header_bytes = header_end + 4;
+      size_t body_len = req.content_length > 0 ? (size_t)req.content_length : 0;
+      if (c.in.size() < req.header_bytes + body_len) return;  // need more
+      std::string body = c.in.substr(req.header_bytes, body_len);
+      c.in.erase(0, req.header_bytes + body_len);
+      try {
+        route(id, req, std::move(body));
+      } catch (const std::exception&) {
+        // never let an alloc failure on one request kill the process
+        auto cit = conns_.find(id);
+        if (cit != conns_.end())
+          queue_inline_response(cit->second, 500,
+                                seldon_error_json(500, "request processing failed", "ENGINE_ERROR"),
+                                true, false);
+      }
+      it = conns_.find(id);  // route may close the connection
+    }
+  }
+
+  // queue a response computed inline on the IO thread (control endpoints
+  // and parse errors).  When async requests are pending on the
+  // connection, the response joins the seq queue so a pipelining client
+  // never sees reordered responses.
+  void queue_inline_response(Conn& c, int status, const std::string& body,
+                             bool json, bool keep_alive = true) {
+    requests_.fetch_add(1);
+    if (status >= 400) failures_.fetch_add(1);
+    std::string resp =
+        http_response(status, json ? "application/json" : "text/plain", body, keep_alive);
+    if (c.inflight == 0 && c.ready.empty()) {
+      c.out += resp;
+      if (!keep_alive) c.closing = true;
+      return;
+    }
+    DoneResp d;
+    d.conn_id = 0;
+    d.seq = c.next_assign++;
+    d.keep_alive = keep_alive;
+    d.bytes = std::move(resp);
+    c.ready.emplace(d.seq, std::move(d));
+    try_write_ready(c);
+  }
+
+  void route(uint64_t id, const HttpReq& req, std::string body) {
+    auto it = conns_.find(id);
+    if (it == conns_.end()) return;
+    Conn& c = it->second;
+
+    // control endpoints: answered inline unless async work is pending
+    if (req.method == "GET") {
+      std::string payload;
+      int status = 200;
+      bool handled = true;
+      if (req.path == "/ping") payload = "pong";
+      else if (req.path == "/live") payload = "live";
+      else if (req.path == "/ready") {
+        bool ok = ready_.load();
+        payload = ok ? "ready" : "not ready";
+        status = ok ? 200 : 503;
+      } else if (req.path == "/stats") {
+        char buf[512];
+        snprintf(buf, sizeof(buf),
+                 "{\"requests\":%lld,\"fast\":%lld,\"raw\":%lld,\"batches\":%lld,"
+                 "\"rows\":%lld,\"padded_rows\":%lld,\"failures\":%lld,"
+                 "\"connections\":%lld}",
+                 (long long)requests_.load(), (long long)fast_requests_.load(),
+                 (long long)raw_requests_.load(), (long long)batches_.load(),
+                 (long long)rows_.load(), (long long)padded_rows_.load(),
+                 (long long)failures_.load(), (long long)connections_.load());
+        payload = buf;
+      } else handled = false;
+      if (handled) {
+        queue_inline_response(c, status, payload, req.path == "/stats", req.keep_alive);
+        return;
+      }
+    }
+
+    bool is_predict = (req.path == "/api/v0.1/predictions" ||
+                       req.path == "/api/v1.0/predictions" || req.path == "/predict");
+
+    if (is_predict && req.method == "POST") {
+      if (req.content_length < 0) {
+        queue_inline_response(c, 411, seldon_error_json(411, "length required", "BAD_REQUEST"), true, req.keep_alive);
+        return;
+      }
+      PendingReq p;
+      p.conn_id = id;
+      p.keep_alive = req.keep_alive;
+      if (req.is_raw_tensor) {
+        RawFrame f;
+        if (parse_raw_frame((const uint8_t*)body.data(), (int64_t)body.size(), &f) &&
+            f.dtype == 0 && f.shape.size() == 2 &&
+            (cfg_.feature_dim <= 0 || f.shape[1] == cfg_.feature_dim)) {
+          p.lane = Lane::FAST_RAW;
+          p.rows = f.shape[0];
+          p.features.resize((size_t)(f.shape[0] * f.shape[1]));
+          memcpy(p.features.data(), f.data, f.data_len);
+          p.seq = c.next_assign++;
+          c.inflight++;
+          enqueue_fast(std::move(p));
+          return;
+        }
+        // unsupported raw frame -> Python fallback
+      } else if (try_parse_fast_json(body, &p)) {
+        p.lane = Lane::FAST_JSON;
+        p.seq = c.next_assign++;
+        c.inflight++;
+        enqueue_fast(std::move(p));
+        return;
+      }
+      // fall through to raw lane
+    }
+
+    // everything else: Python raw handler
+    if (raw_cb_ == nullptr) {
+      queue_inline_response(
+          c, 404, seldon_error_json(404, "no handler for " + req.path, "NOT_IMPLEMENTED"),
+          true, req.keep_alive);
+      return;
+    }
+    PendingReq p;
+    p.conn_id = id;
+    p.seq = c.next_assign++;
+    p.lane = Lane::RAW;
+    p.keep_alive = req.keep_alive;
+    p.method = req.method;
+    p.path = req.path;
+    p.body.assign(body.begin(), body.end());
+    c.inflight++;
+    {
+      std::lock_guard<std::mutex> lk(raw_mu_);
+      raw_q_.push_back(std::move(p));
+    }
+    raw_cv_.notify_one();
+  }
+
+  // fast-lane JSON: {"data": {"tensor": {"shape": [r,c], "values": [...]}}}
+  // or {"data": {"ndarray": [[...], ...]}}.  Bodies carrying any other
+  // payload kind (or no recognisable one) return false -> raw lane.
+  bool try_parse_fast_json(const std::string& body, PendingReq* p) {
+    if (batch_cb_ == nullptr && !cfg_.stub_mode) return false;
+    if (find_key(body, "binData") != std::string::npos ||
+        find_key(body, "strData") != std::string::npos ||
+        find_key(body, "jsonData") != std::string::npos ||
+        find_key(body, "rawTensor") != std::string::npos)
+      return false;
+    p->puid = find_string_value(body, "puid");
+    size_t dpos = find_key(body, "data");
+    if (dpos == std::string::npos) return false;
+
+    size_t tpos = find_key(body, "tensor", dpos);
+    if (tpos != std::string::npos) {
+      // shape
+      size_t spos = find_key(body, "shape", tpos);
+      if (spos == std::string::npos || !skip_colon(body, &spos)) return false;
+      size_t send;
+      if (!array_span(body, spos, &send)) return false;
+      double shape_vals[8];
+      int64_t ndim = json_parse_f64(body.data() + spos, send - spos, shape_vals, 8);
+      if (ndim != 2) return false;  // fast lane is [rows, cols] only
+      int64_t rows = (int64_t)shape_vals[0], cols = (int64_t)shape_vals[1];
+      if (rows < 1 || cols < 1 || (cfg_.feature_dim > 0 && cols != cfg_.feature_dim))
+        return false;
+      size_t vpos = find_key(body, "values", tpos);
+      if (vpos == std::string::npos || !skip_colon(body, &vpos)) return false;
+      size_t vend;
+      if (!array_span(body, vpos, &vend)) return false;
+      // allocation guard BEFORE sizing anything from the attacker-
+      // controlled shape: overflow-safe product, absolute cap, and the
+      // declared element count must be plausible for the bytes that
+      // carry it (each JSON value needs >= 2 chars incl. separator) —
+      // otherwise a tiny body declaring a petabyte shape would OOM the
+      // process before value-count validation
+      constexpr int64_t kMaxElems = 1ll << 31;
+      if (rows > kMaxElems / cols) return false;
+      int64_t elems = rows * cols;
+      if (elems > (int64_t)(vend - vpos)) return false;
+      std::vector<double> vals((size_t)elems);
+      int64_t n = json_parse_f64(body.data() + vpos, vend - vpos, vals.data(), elems);
+      if (n != elems) return false;
+      p->rows = rows;
+      p->features.assign(vals.begin(), vals.end());  // f64 -> f32
+      return true;
+    }
+
+    size_t apos = find_key(body, "ndarray", dpos);
+    if (apos != std::string::npos) {
+      if (!skip_colon(body, &apos)) return false;
+      size_t aend;
+      if (!array_span(body, apos, &aend)) return false;
+      // no strings inside the fast lane
+      for (size_t i = apos; i < aend; i++)
+        if (body[i] == '"') return false;
+      // row count = number of depth-2 sub-arrays; value cap = commas+1.
+      // Rows must be rectangular: a ragged ndarray silently reshaped
+      // would leak values across logical rows — reject to the fallback
+      // lane, which raises a proper 400.
+      int depth = 0, rows = 0, maxdepth = 0;
+      int64_t commas = 0, row_commas = 0, first_row_commas = -1;
+      bool ragged = false;
+      for (size_t i = apos; i < aend; i++) {
+        char ch = body[i];
+        if (ch == '[') {
+          depth++;
+          if (depth == 2) { rows++; row_commas = 0; }
+          if (depth > maxdepth) maxdepth = depth;
+        } else if (ch == ']') {
+          if (depth == 2) {
+            if (first_row_commas < 0) first_row_commas = row_commas;
+            else if (row_commas != first_row_commas) ragged = true;
+          }
+          depth--;
+        } else if (ch == ',') {
+          commas++;
+          if (depth == 2) row_commas++;
+        }
+      }
+      if (maxdepth != 2 || rows < 1 || ragged) return false;
+      std::vector<double> vals((size_t)(commas + 2));
+      int64_t n = json_parse_f64(body.data() + apos, aend - apos, vals.data(), vals.size());
+      if (n < 1 || n != rows * (first_row_commas + 1)) return false;
+      int64_t cols = n / rows;
+      if (cfg_.feature_dim > 0 && cols != cfg_.feature_dim) return false;
+      p->rows = rows;
+      p->features.assign(vals.begin(), vals.begin() + n);
+      return true;
+    }
+    return false;
+  }
+
+  void enqueue_fast(PendingReq p) {
+    {
+      std::lock_guard<std::mutex> lk(batch_mu_);
+      batch_q_.push_back(std::move(p));
+    }
+    batch_cv_.notify_one();
+  }
+
+  // -------------------------------------------------------------- batcher
+
+  void batch_loop() {
+    while (running_.load()) {
+      std::vector<PendingReq> items;
+      {
+        std::unique_lock<std::mutex> lk(batch_mu_);
+        batch_cv_.wait(lk, [this] { return !batch_q_.empty() || !running_.load(); });
+        if (!running_.load()) return;
+        items.push_back(std::move(batch_q_.front()));
+        batch_q_.pop_front();
+        int64_t rows = items[0].rows;
+        // greedy drain of whatever is already queued; never exceed
+        // max_batch by coalescing (a single oversized request may —
+        // it gets an honest full-size call on its own)
+        while (!batch_q_.empty() && rows + batch_q_.front().rows <= cfg_.max_batch) {
+          items.push_back(std::move(batch_q_.front()));
+          batch_q_.pop_front();
+          rows += items.back().rows;
+        }
+        if (!cfg_.eager_when_idle && rows < cfg_.max_batch) {
+          auto deadline = Clock::now() + std::chrono::microseconds(cfg_.max_wait_us);
+          for (;;) {
+            if (batch_q_.empty()) {
+              if (batch_cv_.wait_until(lk, deadline) == std::cv_status::timeout) break;
+              if (!running_.load()) return;
+              if (batch_q_.empty()) continue;
+            }
+            if (batch_q_.front().rows + rows > cfg_.max_batch) break;
+            items.push_back(std::move(batch_q_.front()));
+            batch_q_.pop_front();
+            rows += items.back().rows;
+            if (rows >= cfg_.max_batch) break;
+          }
+        }
+      }
+      try {
+        run_batch(items);
+      } catch (const std::exception&) {
+        for (auto& it2 : items) {
+          DoneResp d;
+          d.conn_id = it2.conn_id;
+          d.seq = it2.seq;
+          d.keep_alive = it2.keep_alive;
+          d.bytes = http_response(500, "application/json",
+                                  seldon_error_json(500, "batch failed", "ENGINE_ERROR"),
+                                  it2.keep_alive);
+          failures_.fetch_add(1);
+          requests_.fetch_add(1);
+          complete(std::move(d));
+        }
+      }
+    }
+  }
+
+  int64_t bucket_for(int64_t rows) const {
+    for (int b : buckets_)
+      if (rows <= b) return b;
+    return rows;  // oversized single request: honest full-size call
+  }
+
+  void run_batch(std::vector<PendingReq>& all_items) {
+    // group by feature width: with feature_dim configured all requests
+    // share it, but the unconstrained mode must not concatenate rows of
+    // different widths into one buffer
+    std::map<int64_t, std::vector<PendingReq*>> groups;
+    for (auto& it : all_items) {
+      int64_t c = it.rows > 0 ? (int64_t)it.features.size() / it.rows : 0;
+      groups[c].push_back(&it);
+    }
+    for (auto& kv : groups) run_batch_group(kv.second, kv.first);
+  }
+
+  void run_batch_group(std::vector<PendingReq*>& items, int64_t cols) {
+    int64_t rows = 0;
+    for (auto* it : items) rows += it->rows;
+    int64_t bucket = bucket_for(rows);
+    std::vector<float> batch((size_t)(bucket * cols), 0.0f);
+    int64_t off = 0;
+    for (auto* it : items) {
+      memcpy(batch.data() + off * cols, it->features.data(),
+             it->features.size() * sizeof(float));
+      off += it->rows;
+    }
+    int64_t out_cols = cfg_.out_dim;
+    std::vector<float> out((size_t)(bucket * out_cols), 0.0f);
+    int rc = 0;
+    if (batch_cb_ != nullptr) {
+      rc = batch_cb_(batch_ctx_, batch.data(), bucket, cols, out.data(), out_cols);
+    } else if (cfg_.stub_mode) {
+      // in-C++ stub model: fixed per-class scores, the reference's
+      // SIMPLE_MODEL benchmarking methodology (engine measured, model
+      // constant; reference: SimpleModelUnit.java:29-72)
+      for (int64_t r = 0; r < bucket; r++) {
+        for (int64_t j = 0; j < out_cols; j++)
+          out[r * out_cols + j] = j == 0 ? 0.9f : 0.1f / (float)(out_cols > 1 ? out_cols - 1 : 1);
+      }
+    } else {
+      rc = -1;
+    }
+    batches_.fetch_add(1);
+    rows_.fetch_add(rows);
+    padded_rows_.fetch_add(bucket - rows);
+
+    // per-request responses
+    int64_t row_off = 0;
+    for (auto* it : items) {
+      DoneResp d;
+      d.conn_id = it->conn_id;
+      d.seq = it->seq;
+      d.keep_alive = it->keep_alive;
+      if (rc != 0) {
+        failures_.fetch_add(1);
+        d.bytes = http_response(500, "application/json",
+                                seldon_error_json(500, "model call failed", "ENGINE_ERROR"),
+                                it->keep_alive);
+      } else if (it->lane == Lane::FAST_RAW) {
+        d.bytes = build_raw_response(out.data() + row_off * out_cols, it->rows, out_cols,
+                                     it->keep_alive);
+      } else {
+        d.bytes = build_json_response(out.data() + row_off * out_cols, it->rows, out_cols,
+                                      it->puid, it->keep_alive);
+      }
+      row_off += it->rows;
+      fast_requests_.fetch_add(1);
+      requests_.fetch_add(1);
+      complete(std::move(d));
+    }
+  }
+
+  std::string build_json_response(const float* out, int64_t rows, int64_t cols,
+                                  const std::string& puid, bool keep_alive) {
+    std::string body;
+    body.reserve((size_t)(rows * cols * 16 + 256));
+    body += "{\"meta\":{\"puid\":\"";
+    body += puid.empty() ? next_puid() : puid;
+    body += "\",\"requestPath\":{\"";
+    body += model_name_;
+    body += "\":\"native\"}},\"data\":{\"names\":[";
+    for (int64_t j = 0; j < cols; j++) {
+      if (j) body += ',';
+      body += '"';
+      if (j < (int64_t)names_.size()) body += names_[j];
+      else {
+        body += "t:";
+        body += std::to_string(j);
+      }
+      body += '"';
+    }
+    body += "],\"tensor\":{\"shape\":[";
+    body += std::to_string(rows);
+    body += ',';
+    body += std::to_string(cols);
+    body += "],\"values\":";
+    std::vector<double> vals((size_t)(rows * cols));
+    for (int64_t i = 0; i < rows * cols; i++) vals[i] = out[i];
+    std::vector<char> num((size_t)(rows * cols) * 26 + 2);
+    int64_t n = json_serialize_f64(vals.data(), rows * cols, num.data());
+    body.append(num.data(), n);
+    body += "}}}";
+    return http_response(200, "application/json", body, keep_alive);
+  }
+
+  std::string build_raw_response(const float* out, int64_t rows, int64_t cols,
+                                 bool keep_alive) {
+    std::string body;
+    body.resize(8 + 16 + (size_t)(rows * cols * 4));
+    uint8_t* b = (uint8_t*)body.data();
+    memcpy(b, &kRawMagic, 4);
+    b[4] = 0;  // f32
+    b[5] = 2;  // ndim
+    b[6] = b[7] = 0;
+    int64_t shape[2] = {rows, cols};
+    memcpy(b + 8, shape, 16);
+    memcpy(b + 24, out, (size_t)(rows * cols * 4));
+    return http_response(200, "application/x-seldon-raw", body, keep_alive);
+  }
+
+  std::string next_puid() {
+    char buf[40];
+    snprintf(buf, sizeof(buf), "%s%012llx", puid_prefix_.c_str(),
+             (unsigned long long)puid_counter_.fetch_add(1));
+    return buf;
+  }
+
+  // ------------------------------------------------------------ raw lane
+
+  void raw_loop() {
+    while (running_.load()) {
+      PendingReq p;
+      {
+        std::unique_lock<std::mutex> lk(raw_mu_);
+        raw_cv_.wait(lk, [this] { return !raw_q_.empty() || !running_.load(); });
+        if (!running_.load()) return;
+        p = std::move(raw_q_.front());
+        raw_q_.pop_front();
+      }
+      uint8_t* out_buf = nullptr;
+      int64_t out_len = 0;
+      int32_t status = 200;
+      char ctype[64] = "application/json";
+      int rc = raw_cb_(raw_ctx_, p.method.c_str(), p.path.c_str(), p.body.data(),
+                       (int64_t)p.body.size(), &out_buf, &out_len, &status, ctype);
+      DoneResp d;
+      d.conn_id = p.conn_id;
+      d.seq = p.seq;
+      d.keep_alive = p.keep_alive;
+      requests_.fetch_add(1);
+      raw_requests_.fetch_add(1);
+      if (rc != 0 || out_buf == nullptr) {
+        failures_.fetch_add(1);
+        d.bytes = http_response(500, "application/json",
+                                seldon_error_json(500, "handler failed", "ENGINE_ERROR"),
+                                p.keep_alive);
+      } else {
+        if (status >= 400) failures_.fetch_add(1);
+        ctype[63] = 0;
+        d.bytes = http_response(status, ctype,
+                                std::string((char*)out_buf, (size_t)out_len), p.keep_alive);
+      }
+      if (out_buf) free(out_buf);
+      complete(std::move(d));
+    }
+  }
+
+  // --------------------------------------------------------- completion
+
+  void complete(DoneResp d) {
+    {
+      std::lock_guard<std::mutex> lk(done_mu_);
+      done_q_.push_back(std::move(d));
+    }
+    wake();
+  }
+
+  void drain_done() {
+    std::deque<DoneResp> batch;
+    {
+      std::lock_guard<std::mutex> lk(done_mu_);
+      batch.swap(done_q_);
+    }
+    for (auto& d : batch) {
+      uint64_t conn_id = d.conn_id;
+      uint64_t seq = d.seq;
+      auto it = conns_.find(conn_id);
+      if (it == conns_.end()) continue;  // connection died meanwhile
+      Conn& c = it->second;
+      c.inflight--;
+      c.ready.emplace(seq, std::move(d));
+      try_write_ready(c);
+      flush_out(conn_id);
+    }
+  }
+
+  void try_write_ready(Conn& c) {
+    // strict per-connection response ordering (HTTP/1.1 pipelining)
+    for (;;) {
+      auto rit = c.ready.find(c.next_write);
+      if (rit == c.ready.end()) break;
+      c.out += rit->second.bytes;
+      if (!rit->second.keep_alive) c.closing = true;
+      c.ready.erase(rit);
+      c.next_write++;
+    }
+  }
+
+  void flush_out(uint64_t id) {
+    auto it = conns_.find(id);
+    if (it == conns_.end()) return;
+    Conn& c = it->second;
+    // output backpressure: a client that pipelines requests but never
+    // reads responses must not grow c.out without bound (mirror of the
+    // input-side guard)
+    if (c.out.size() - c.out_off > (256u << 20)) {
+      close_conn(id);
+      return;
+    }
+    while (c.out_off < c.out.size()) {
+      ssize_t r = send(c.fd, c.out.data() + c.out_off, c.out.size() - c.out_off, MSG_NOSIGNAL);
+      if (r > 0) {
+        c.out_off += r;
+        continue;
+      }
+      if (r < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) {
+        epoll_event ev{};
+        ev.events = EPOLLIN | EPOLLOUT;
+        ev.data.u64 = id;
+        epoll_ctl(epoll_fd_, EPOLL_CTL_MOD, c.fd, &ev);
+        return;
+      }
+      close_conn(id);
+      return;
+    }
+    if (c.out_off == c.out.size() && c.out_off > 0) {
+      c.out.clear();
+      c.out_off = 0;
+      epoll_event ev{};
+      ev.events = EPOLLIN;
+      ev.data.u64 = id;
+      epoll_ctl(epoll_fd_, EPOLL_CTL_MOD, c.fd, &ev);
+    }
+    if (c.closing && c.inflight == 0 && c.out.empty()) close_conn(id);
+  }
+
+  // ------------------------------------------------------------- members
+
+  FsConfig cfg_;
+  std::string model_name_;
+  std::string names_csv_;
+  std::vector<std::string> names_;
+  std::vector<int> buckets_;
+  std::string puid_prefix_;
+  std::atomic<uint64_t> puid_counter_{0};
+
+  int listen_fd_ = -1, epoll_fd_ = -1, wake_fd_ = -1;
+  int port_ = 0;
+  std::atomic<bool> running_{false};
+  std::atomic<bool> ready_{true};
+
+  fs_batch_cb batch_cb_ = nullptr;
+  void* batch_ctx_ = nullptr;
+  fs_raw_cb raw_cb_ = nullptr;
+  void* raw_ctx_ = nullptr;
+
+  std::thread io_thread_, batch_thread_;
+  std::vector<std::thread> raw_threads_;
+
+  std::unordered_map<uint64_t, Conn> conns_;
+  uint64_t next_conn_id_ = 1;
+
+  std::mutex batch_mu_;
+  std::condition_variable batch_cv_;
+  std::deque<PendingReq> batch_q_;
+
+  std::mutex raw_mu_;
+  std::condition_variable raw_cv_;
+  std::deque<PendingReq> raw_q_;
+
+  std::mutex done_mu_;
+  std::deque<DoneResp> done_q_;
+
+  std::atomic<int64_t> requests_{0}, fast_requests_{0}, raw_requests_{0},
+      batches_{0}, rows_{0}, padded_rows_{0}, failures_{0}, connections_{0};
+};
+
+}  // namespace
+
+// ---------------------------------------------------------------------------
+// C ABI
+// ---------------------------------------------------------------------------
+
+extern "C" {
+
+void* fs_create(const FsConfig* cfg) { return new FrontServer(*cfg); }
+
+void fs_destroy(void* h) { delete (FrontServer*)h; }
+
+void fs_set_batch_handler(void* h, fs_batch_cb cb, void* ctx) {
+  ((FrontServer*)h)->set_batch_handler(cb, ctx);
+}
+
+void fs_set_raw_handler(void* h, fs_raw_cb cb, void* ctx) {
+  ((FrontServer*)h)->set_raw_handler(cb, ctx);
+}
+
+int32_t fs_start(void* h) { return ((FrontServer*)h)->start(); }
+
+void fs_stop(void* h) { ((FrontServer*)h)->stop(); }
+
+int32_t fs_port(void* h) { return ((FrontServer*)h)->port(); }
+
+void fs_set_ready(void* h, int32_t r) { ((FrontServer*)h)->set_ready(r != 0); }
+
+void fs_get_stats(void* h, FsStats* s) { ((FrontServer*)h)->get_stats(s); }
+
+// buffer allocator for raw-handler responses (freed by the server)
+uint8_t* fs_alloc(int64_t n) { return (uint8_t*)malloc((size_t)n); }
+
+}  // extern "C"

@@ -197,12 +197,19 @@ async def serve_deployment(
     host: str = "0.0.0.0",
     http_port: Optional[int] = None,
     grpc_port: Optional[int] = None,
+    frontend: Optional[str] = None,  # "python" | "native" | None -> annotation
 ):
     """Expose a managed deployment on its spec ports.
 
     The HTTP app and gRPC service resolve the gateway through the
     ManagedDeployment on every request, so rolling swaps take effect
     without socket churn.
+
+    ``frontend="native"`` (or annotation ``seldon.io/frontend: native``)
+    puts the C++ front server on the HTTP port: single-local-MODEL
+    predictors get the zero-Python fast lane, everything else bridges
+    into the engine with full semantics.  Falls back to the Python app
+    when the native library is unavailable.
     """
     from seldon_core_tpu.engine import server as engine_server
 
@@ -210,6 +217,8 @@ async def serve_deployment(
     spec = managed.current.spec
     http_port = http_port if http_port is not None else spec.http_port
     grpc_port = grpc_port if grpc_port is not None else spec.grpc_port
+    if frontend is None:
+        frontend = str(spec.annotations.get("seldon.io/frontend", "python")).lower()
 
     class _GatewayProxy:
         """Delegates to the live generation's gateway."""
@@ -217,8 +226,32 @@ async def serve_deployment(
         def __getattr__(self, attr):
             return getattr(managed.gateway, attr)
 
+    proxy = _GatewayProxy()
+    if frontend == "native":
+        from seldon_core_tpu.engine.native_ingress import serve_native_ingress
+
+        http_handle = None
+        try:
+            http_handle = await serve_native_ingress(proxy, host=host, http_port=http_port)
+            from seldon_core_tpu.engine.sync_server import build_sync_seldon_server
+
+            grpc_srv = build_sync_seldon_server(proxy, asyncio.get_running_loop())
+            grpc_srv.add_insecure_port(f"{host}:{grpc_port}")
+            grpc_srv.start()
+            grpc_handle = engine_server.GrpcServerHandle(grpc_srv, is_aio=False)
+            logger.info(
+                "deployment %s serving http=:%d (native) grpc=:%d", name, http_port, grpc_port
+            )
+            return http_handle, grpc_handle
+        except Exception as e:  # noqa: BLE001 — degraded but serving
+            logger.warning("native frontend unavailable (%s); using python app", e)
+            if http_handle is not None:
+                # release http_port (and the ready-refresh task) before
+                # the fallback app binds it
+                await http_handle.stop()
+
     runner, grpc_srv = await engine_server.serve_gateway(
-        _GatewayProxy(), host=host, http_port=http_port, grpc_port=grpc_port
+        proxy, host=host, http_port=http_port, grpc_port=grpc_port
     )
     logger.info("deployment %s serving http=:%d grpc=:%d", name, http_port, grpc_port)
     return runner, grpc_srv

@@ -1,0 +1,203 @@
+"""Native ingress wiring: put the C++ front server in front of a Gateway.
+
+The reference fronts every predictor with its Java engine; this module
+fronts a deployment's ``Gateway`` with the C++ epoll server
+(``native/frontserver.cc``) instead of the Python aiohttp app
+(reference: doc/source/graph/svcorch.md:1-8 — the data plane does not
+run in the model language).
+
+Lane assignment:
+
+* **fast lane** (zero per-request Python) — available when the
+  deployment is a single primary predictor whose graph is one
+  in-process MODEL exposing ``raw_batch_call`` (JaxServer does);
+  request tensors are decoded, coalesced, and batched in C++ and the
+  jitted XLA program is invoked once per batch.
+* **fallback lane** — everything else (multi-node graphs, traffic
+  splits, shadows, exotic payloads, feedback, explanations) bridges
+  into the running asyncio engine via ``GatewayRawHandler`` with full
+  semantics.
+
+Readiness: the C++ server answers ``/ready`` from a flag that a
+background task refreshes from ``gateway.ready()`` (the graph walk).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+from typing import Optional, Tuple
+
+logger = logging.getLogger(__name__)
+
+
+def fast_lane_for(gateway) -> Optional[dict]:
+    """Fast-lane configuration for a gateway, or None when ineligible.
+
+    Eligibility mirrors ``PredictorService.single_local_model`` plus
+    gateway-level constraints: one primary predictor (a traffic split
+    must run the weighted pick per request) and no shadows (the fast
+    lane would bypass them).
+    """
+    if len(gateway.entries) != 1 or gateway.shadows:
+        return None
+    svc = gateway.entries[0][0]
+    fast = svc.single_local_model()
+    if fast is None:
+        return None
+    unit, component = fast
+    raw_call = getattr(component, "raw_batch_call", None)
+    if raw_call is None:
+        return None
+    try:
+        feature_dim = int(component.flat_feature_dim())
+        out_dim = int(component.flat_out_dim())
+    except Exception:  # noqa: BLE001 — component without flat-shape support
+        return None
+    names = None
+    try:
+        cn = component.class_names()
+        if cn and len(cn) == out_dim:
+            names = [str(n) for n in cn]
+    except Exception:  # noqa: BLE001
+        pass
+    buckets = None
+    batcher = getattr(component, "batcher", None)
+    if batcher is not None and getattr(batcher, "buckets", None):
+        buckets = list(batcher.buckets)
+    return {
+        "feature_dim": feature_dim,
+        "out_dim": out_dim,
+        "names": names,
+        "model_name": unit.name,
+        "max_batch": getattr(component, "max_batch_size", 64),
+        "buckets": buckets,
+    }
+
+
+def _live_model_fn(gateway, feature_dim: int, out_dim: int):
+    """Batch callback that re-resolves the component through the
+    gateway on every call, so a rolling swap serves the NEW generation
+    on the fast lane too (capturing raw_batch_call at startup would pin
+    the old weights forever).  A swap that changes the model's flat
+    shapes makes the fast lane error loudly rather than serve wrong
+    tensors — re-serve the deployment to renegotiate dims."""
+
+    def model_fn(batch):
+        lane_svc = gateway.entries[0][0] if len(gateway.entries) == 1 else None
+        fast = lane_svc.single_local_model() if lane_svc is not None else None
+        if fast is None:
+            raise RuntimeError("fast lane no longer eligible after rolling update")
+        component = fast[1]
+        if (int(component.flat_feature_dim()) != feature_dim
+                or int(component.flat_out_dim()) != out_dim):
+            raise RuntimeError(
+                "model shape changed across rolling update; re-serve the deployment"
+            )
+        return component.raw_batch_call(batch)
+
+    return model_fn
+
+
+class NativeIngressHandle:
+    def __init__(self, server, ready_task):
+        self.server = server
+        self._ready_task = ready_task
+        self.port = server.port
+
+    def stats(self) -> dict:
+        return self.server.stats()
+
+    async def stop(self) -> None:
+        if self._ready_task is not None:
+            self._ready_task.cancel()
+            try:
+                await self._ready_task
+            except asyncio.CancelledError:
+                pass
+            self._ready_task = None
+        # off-loop: server.stop() joins worker threads that may be
+        # blocked on run_coroutine_threadsafe into THIS loop — joining
+        # on the loop thread would deadlock until their timeout
+        await asyncio.to_thread(self.server.stop)
+
+    async def cleanup(self) -> None:
+        """aiohttp-runner-compatible shutdown, so callers that do
+        ``await runner.cleanup()`` work unchanged with frontend=native."""
+        await self.stop()
+
+
+class _DeploymentRawHandler:
+    """GatewayRawHandler plus the non-engine GET endpoints the Python
+    app serves (/metrics, /seldon.json) so the native ingress is a
+    drop-in replacement on the HTTP port."""
+
+    def __init__(self, gateway, loop):
+        from seldon_core_tpu.native.frontserver import GatewayRawHandler
+
+        self._inner = GatewayRawHandler(gateway, loop)
+
+    def __call__(self, method: str, path: str, body: bytes) -> Tuple[int, str, bytes]:
+        if method == "GET" and path == "/metrics":
+            try:
+                from prometheus_client import CONTENT_TYPE_LATEST, generate_latest
+
+                return 200, CONTENT_TYPE_LATEST.split(";")[0], generate_latest()
+            except Exception as e:  # noqa: BLE001
+                return 500, "text/plain", str(e).encode()
+        if method == "GET" and path == "/seldon.json":
+            from seldon_core_tpu.runtime.openapi import gateway_openapi
+
+            return 200, "application/json", json.dumps(gateway_openapi()).encode()
+        return self._inner(method, path, body)
+
+
+async def serve_native_ingress(
+    gateway,
+    host: str = "0.0.0.0",  # noqa: ARG001 — native server binds INADDR_ANY
+    http_port: int = 8000,
+    max_batch: Optional[int] = None,
+    max_wait_ms: float = 1.0,
+) -> NativeIngressHandle:
+    """Start the C++ front server on ``http_port`` for ``gateway``.
+
+    Raises RuntimeError when the native library is unavailable —
+    callers fall back to the Python app.
+    """
+    from seldon_core_tpu.native.frontserver import NativeFrontServer
+
+    loop = asyncio.get_running_loop()
+    handler = _DeploymentRawHandler(gateway, loop)
+    lane = fast_lane_for(gateway)
+    kwargs = dict(port=http_port, raw_handler=handler, max_wait_ms=max_wait_ms)
+    if lane is not None:
+        kwargs.update(
+            model_fn=_live_model_fn(gateway, lane["feature_dim"], lane["out_dim"]),
+            feature_dim=lane["feature_dim"],
+            out_dim=lane["out_dim"],
+            names=lane["names"],
+            model_name=lane["model_name"],
+            max_batch=max_batch or lane["max_batch"],
+            buckets=lane["buckets"],
+        )
+        logger.info(
+            "native ingress fast lane: model=%s feature_dim=%d out_dim=%d",
+            lane["model_name"], lane["feature_dim"], lane["out_dim"],
+        )
+    else:
+        logger.info("native ingress: fallback lane only (graph not fast-lane eligible)")
+    server = NativeFrontServer(**kwargs)
+    server.start()
+
+    async def _refresh_ready():
+        while True:
+            try:
+                ok = await gateway.ready()
+                server.set_ready(bool(ok))
+            except Exception:  # noqa: BLE001
+                server.set_ready(False)
+            await asyncio.sleep(0.5)
+
+    task = asyncio.ensure_future(_refresh_ready())
+    return NativeIngressHandle(server, task)

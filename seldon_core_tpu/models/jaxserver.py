@@ -332,6 +332,39 @@ class JaxServer(TPUComponent):
         out = await asyncio.wrap_future(self.batcher.submit_future(arr))
         return out[0] if squeeze else out
 
+    # ---- native fast lane -------------------------------------------------
+
+    def flat_feature_dim(self) -> int:
+        """Row width of the flattened input the native ingress sends."""
+        if self.input_shape is None:
+            self.load()
+        return int(np.prod(self.input_shape))
+
+    def flat_out_dim(self) -> int:
+        """Row width of the flattened output (2k for fused top-k)."""
+        return 2 * self.top_k if self.top_k else self.num_classes
+
+    def raw_batch_call(self, batch2d: np.ndarray) -> np.ndarray:
+        """One device call for a C++-coalesced batch.
+
+        The native front server owns batching (decode, coalesce, pad to
+        bucket); this bypasses the Python DynamicBatcher and invokes
+        the jitted program directly: [rows, flat] f32 -> [rows, out] f32.
+        The bucket ladder on the C++ side matches normalize_buckets, so
+        every arriving shape was pre-compiled at warmup.
+        """
+        import jax.numpy as jnp
+
+        if not self._loaded:
+            self.load()
+        arr = np.asarray(batch2d, np.float32).reshape((-1, *self.input_shape))
+        # same dtype canonicalisation as _prepare: only warmed dtypes
+        # may reach the device, or the call would trace mid-traffic
+        if arr.dtype.name not in self.warmup_dtypes:
+            arr = arr.astype(np.dtype(self.warmup_dtypes[0]))
+        out = np.asarray(self._predict_jit(self.variables, jnp.asarray(arr)))
+        return out.reshape(out.shape[0], -1)
+
     def class_names(self):
         if self.top_k:  # rows are (indices, scores), not per-class columns
             return []

@@ -26,6 +26,11 @@ _TRIED = False
 
 
 def _so_path() -> str:
+    # SELDON_TPU_NATIVE_SO overrides the artifact (e.g. the TSan/ASan
+    # builds from `make -C native tsan`)
+    override = os.environ.get("SELDON_TPU_NATIVE_SO")
+    if override:
+        return override
     return os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
                         "native", "libseldon_tpu_native.so")
 

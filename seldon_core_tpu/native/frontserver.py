@@ -1,0 +1,332 @@
+"""ctypes bindings for the native C++ front server.
+
+The C++ ingress (``native/frontserver.cc``) owns the HTTP hot path —
+accept, parse, payload decode, dynamic batching, response serialisation
+— and calls back into Python exactly once per coalesced *batch* (the
+model call), or per request on the fallback lane (full engine
+semantics for payloads the fast lane cannot express).  This mirrors the
+reference's decision to keep the request path out of the model-language
+runtime (the Java engine; reference: doc/source/graph/svcorch.md:1-8).
+
+Two callback surfaces:
+
+* ``model_fn(batch[rows, cols] f32) -> [rows, out_dim]`` — the fast
+  lane.  For a JaxServer this is the jit-compiled apply; the GIL is
+  taken once per batch and released during XLA execution.
+* ``raw_handler(method, path, body) -> (status, content_type, body)``
+  — the fallback lane, typically ``GatewayRawHandler`` bridging into
+  the deployment's asyncio engine.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import json
+import logging
+from typing import Callable, Optional, Sequence, Tuple
+
+import numpy as np
+
+from seldon_core_tpu.native import get_lib
+
+logger = logging.getLogger(__name__)
+
+_BATCH_CB = ctypes.CFUNCTYPE(
+    ctypes.c_int32,
+    ctypes.c_void_p,                  # ctx
+    ctypes.POINTER(ctypes.c_float),   # in
+    ctypes.c_int64,                   # rows
+    ctypes.c_int64,                   # cols
+    ctypes.POINTER(ctypes.c_float),   # out
+    ctypes.c_int64,                   # out_cols
+)
+
+_RAW_CB = ctypes.CFUNCTYPE(
+    ctypes.c_int32,
+    ctypes.c_void_p,                          # ctx
+    ctypes.c_char_p,                          # method
+    ctypes.c_char_p,                          # path
+    ctypes.POINTER(ctypes.c_uint8),           # body
+    ctypes.c_int64,                           # body_len
+    ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8)),  # out_buf
+    ctypes.POINTER(ctypes.c_int64),           # out_len
+    ctypes.POINTER(ctypes.c_int32),           # http_status
+    ctypes.POINTER(ctypes.c_char),            # content_type[64] — must be
+    # a writable pointer: c_char_p would hand the callback an immutable
+    # bytes copy and the C buffer would never see the write
+)
+
+
+class _FsConfig(ctypes.Structure):
+    _fields_ = [
+        ("port", ctypes.c_int32),
+        ("max_batch", ctypes.c_int32),
+        ("max_wait_us", ctypes.c_int32),
+        ("feature_dim", ctypes.c_int32),
+        ("out_dim", ctypes.c_int32),
+        ("stub_mode", ctypes.c_int32),
+        ("raw_workers", ctypes.c_int32),
+        ("backlog", ctypes.c_int32),
+        ("eager_when_idle", ctypes.c_int32),
+        ("model_name", ctypes.c_char_p),
+        ("names_csv", ctypes.c_char_p),
+        ("buckets_csv", ctypes.c_char_p),
+    ]
+
+
+class _FsStats(ctypes.Structure):
+    _fields_ = [
+        ("requests", ctypes.c_int64),
+        ("fast_requests", ctypes.c_int64),
+        ("raw_requests", ctypes.c_int64),
+        ("batches", ctypes.c_int64),
+        ("rows", ctypes.c_int64),
+        ("padded_rows", ctypes.c_int64),
+        ("failures", ctypes.c_int64),
+        ("connections", ctypes.c_int64),
+    ]
+
+
+_FS_BOUND = False
+
+
+def _bind(lib) -> None:
+    global _FS_BOUND
+    if _FS_BOUND:
+        return
+    lib.fs_create.restype = ctypes.c_void_p
+    lib.fs_create.argtypes = [ctypes.POINTER(_FsConfig)]
+    lib.fs_destroy.argtypes = [ctypes.c_void_p]
+    lib.fs_set_batch_handler.argtypes = [ctypes.c_void_p, _BATCH_CB, ctypes.c_void_p]
+    lib.fs_set_raw_handler.argtypes = [ctypes.c_void_p, _RAW_CB, ctypes.c_void_p]
+    lib.fs_start.restype = ctypes.c_int32
+    lib.fs_start.argtypes = [ctypes.c_void_p]
+    lib.fs_stop.argtypes = [ctypes.c_void_p]
+    lib.fs_port.restype = ctypes.c_int32
+    lib.fs_port.argtypes = [ctypes.c_void_p]
+    lib.fs_set_ready.argtypes = [ctypes.c_void_p, ctypes.c_int32]
+    lib.fs_get_stats.argtypes = [ctypes.c_void_p, ctypes.POINTER(_FsStats)]
+    lib.fs_alloc.restype = ctypes.POINTER(ctypes.c_uint8)
+    lib.fs_alloc.argtypes = [ctypes.c_int64]
+    _FS_BOUND = True
+
+
+def available() -> bool:
+    lib = get_lib()
+    return lib is not None and hasattr(lib, "fs_create")
+
+
+RawHandler = Callable[[str, str, bytes], Tuple[int, str, bytes]]
+
+
+class NativeFrontServer:
+    """The C++ data-plane ingress, driven from Python.
+
+    stub mode (``model_fn=None, stub=True``) serves a fixed-output
+    model entirely in C++ — the reference's SIMPLE_MODEL benchmarking
+    methodology (reference: doc/source/reference/benchmarking.md:19-36)
+    for measuring the serving plane itself.
+    """
+
+    def __init__(
+        self,
+        port: int = 0,
+        model_fn: Optional[Callable[[np.ndarray], np.ndarray]] = None,
+        feature_dim: int = 0,
+        out_dim: int = 3,
+        stub: bool = False,
+        max_batch: int = 64,
+        max_wait_ms: float = 1.0,
+        model_name: str = "model",
+        names: Optional[Sequence[str]] = None,
+        raw_handler: Optional[RawHandler] = None,
+        raw_workers: int = 2,
+        eager_when_idle: bool = True,
+        buckets: Optional[Sequence[int]] = None,
+    ):
+        lib = get_lib()
+        if lib is None or not hasattr(lib, "fs_create"):
+            raise RuntimeError("native front server library unavailable (make -C native)")
+        _bind(lib)
+        self._lib = lib
+        self.model_fn = model_fn
+        self.raw_handler = raw_handler
+        cfg = _FsConfig(
+            port=port,
+            max_batch=max_batch,
+            max_wait_us=int(max_wait_ms * 1000),
+            feature_dim=feature_dim,
+            out_dim=out_dim,
+            stub_mode=1 if (stub and model_fn is None) else 0,
+            raw_workers=raw_workers,
+            backlog=512,
+            eager_when_idle=1 if eager_when_idle else 0,
+            model_name=model_name.encode(),
+            names_csv=",".join(names).encode() if names else b"",
+            buckets_csv=",".join(str(int(b)) for b in buckets).encode() if buckets else b"",
+        )
+        self._cfg = cfg  # keep the char pointers alive
+        self._handle = lib.fs_create(ctypes.byref(cfg))
+        self._batch_cb = None
+        self._raw_cb = None
+        if model_fn is not None:
+            self._batch_cb = _BATCH_CB(self._on_batch)
+            lib.fs_set_batch_handler(self._handle, self._batch_cb, None)
+        if raw_handler is not None:
+            self._raw_cb = _RAW_CB(self._on_raw)
+            lib.fs_set_raw_handler(self._handle, self._raw_cb, None)
+        self.port = 0
+        self._started = False
+
+    # ------------------------------------------------------------ callbacks
+
+    def _on_batch(self, _ctx, in_ptr, rows, cols, out_ptr, out_cols) -> int:
+        try:
+            batch = np.ctypeslib.as_array(in_ptr, shape=(rows, cols))
+            result = np.asarray(self.model_fn(batch), dtype=np.float32)
+            if result.ndim == 1:
+                result = result[:, None]
+            out = np.ctypeslib.as_array(out_ptr, shape=(rows, out_cols))
+            out[:] = result.reshape(rows, out_cols)
+            return 0
+        except Exception:
+            logger.exception("native front server batch callback failed")
+            return 1
+
+    def _on_raw(self, _ctx, method, path, body_ptr, body_len, out_buf, out_len,
+                status_ptr, ctype_buf) -> int:
+        try:
+            body = ctypes.string_at(body_ptr, body_len) if body_len else b""
+            status, content_type, payload = self.raw_handler(
+                method.decode(), path.decode(), body
+            )
+            buf = self._lib.fs_alloc(len(payload))
+            if payload:
+                ctypes.memmove(buf, payload, len(payload))
+            out_buf[0] = buf
+            out_len[0] = len(payload)
+            status_ptr[0] = int(status)
+            ct = content_type.encode()[:63]
+            ctypes.memmove(ctype_buf, ct + b"\x00", len(ct) + 1)
+            return 0
+        except Exception:
+            logger.exception("native front server raw callback failed")
+            return 1
+
+    # ------------------------------------------------------------ lifecycle
+
+    def start(self) -> int:
+        rc = self._lib.fs_start(self._handle)
+        if rc < 0:
+            raise OSError(-rc, "front server failed to start")
+        self.port = rc
+        self._started = True
+        return self.port
+
+    def stop(self) -> None:
+        # null the handle FIRST so a racing set_ready/stats no-ops
+        # instead of dereferencing the freed FrontServer
+        handle, self._handle = self._handle, None
+        if handle:
+            self._lib.fs_stop(handle)
+            self._lib.fs_destroy(handle)
+        self._started = False
+
+    def set_ready(self, ready: bool) -> None:
+        handle = self._handle
+        if handle:
+            self._lib.fs_set_ready(handle, 1 if ready else 0)
+
+    def stats(self) -> dict:
+        s = _FsStats()
+        handle = self._handle
+        if handle:
+            self._lib.fs_get_stats(handle, ctypes.byref(s))
+        return {name: getattr(s, name) for name, _ in _FsStats._fields_}
+
+    def __enter__(self) -> "NativeFrontServer":
+        self.start()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+
+class GatewayRawHandler:
+    """Fallback-lane handler speaking full engine semantics.
+
+    Bridges the C++ server's raw lane into a running Gateway's asyncio
+    loop: predictions with exotic payloads, feedback, explanations.
+    """
+
+    def __init__(self, gateway, loop):
+        self.gateway = gateway
+        self.loop = loop
+
+    def __call__(self, method: str, path: str, body: bytes) -> Tuple[int, str, bytes]:
+        import asyncio
+
+        from seldon_core_tpu.runtime.message import InternalFeedback, InternalMessage
+
+        try:
+            if path in ("/api/v0.1/predictions", "/api/v1.0/predictions", "/predict"):
+                msg = InternalMessage.from_json(json.loads(body))
+                out = asyncio.run_coroutine_threadsafe(
+                    self.gateway.predict(msg), self.loop
+                ).result(timeout=60)
+            elif path == "/api/v0.1/feedback":
+                fb = InternalFeedback.from_json(json.loads(body))
+                out = asyncio.run_coroutine_threadsafe(
+                    self.gateway.send_feedback(fb), self.loop
+                ).result(timeout=60)
+            elif path == "/api/v0.1/explanations":
+                msg = InternalMessage.from_json(json.loads(body))
+                svc = self.gateway.pick()
+                out = asyncio.run_coroutine_threadsafe(
+                    svc.explain(msg), self.loop
+                ).result(timeout=60)
+            else:
+                return 404, "application/json", json.dumps(
+                    {"status": {"status": "FAILURE", "code": 404,
+                                "info": f"no route {path}", "reason": "NOT_FOUND"}}
+                ).encode()
+            status = 200
+            if out.status and out.status.get("status") == "FAILURE":
+                status = int(out.status.get("code", 500))
+                if not 400 <= status < 600:
+                    status = 500
+            return status, "application/json", json.dumps(out.to_json()).encode()
+        except Exception as e:  # noqa: BLE001 — wire errors as seldon status
+            logger.exception("gateway raw handler failed")
+            return 500, "application/json", json.dumps(
+                {"status": {"status": "FAILURE", "code": 500, "info": str(e),
+                            "reason": "ENGINE_ERROR"}}
+            ).encode()
+
+
+def pack_raw_frame(arr: np.ndarray) -> bytes:
+    """Encode an array as the binary raw-tensor frame (SRT1)."""
+    dtype_codes = {np.dtype(np.float32): 0, np.dtype(np.uint8): 1,
+                   np.dtype(np.int32): 2, np.dtype(np.float64): 3}
+    arr = np.ascontiguousarray(arr)
+    code = dtype_codes.get(arr.dtype)
+    if code is None:
+        raise ValueError(f"raw frame does not support dtype {arr.dtype}")
+    import struct
+
+    head = struct.pack("<IBBH", 0x31545253, code, arr.ndim, 0)
+    shape = struct.pack(f"<{arr.ndim}q", *arr.shape)
+    return head + shape + arr.tobytes()
+
+
+def unpack_raw_frame(data: bytes) -> np.ndarray:
+    """Decode a binary raw-tensor frame (SRT1) into an array."""
+    import struct
+
+    magic, code, ndim, _ = struct.unpack_from("<IBBH", data, 0)
+    if magic != 0x31545253:
+        raise ValueError("bad raw frame magic")
+    dtypes = [np.float32, np.uint8, np.int32, np.float64]
+    shape = struct.unpack_from(f"<{ndim}q", data, 8)
+    off = 8 + 8 * ndim
+    return np.frombuffer(data, dtype=dtypes[code], offset=off).reshape(shape)
