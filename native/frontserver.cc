@@ -104,6 +104,7 @@ typedef struct {
   const char* buckets_csv; // padding ladder ("" -> powers of two); MUST
                            // match the Python-side normalize_buckets
                            // list or padded shapes were never warmed
+  const char* bind_host;   // dotted-quad listen address ("" -> 0.0.0.0)
 } FsConfig;
 
 typedef struct {
@@ -126,9 +127,11 @@ typedef struct {
 struct HttpReq {
   std::string method;
   std::string path;
+  std::string query;  // raw query string (no leading '?'); forwarded to the raw lane
   int64_t content_length = -1;
   bool keep_alive = true;
   bool is_raw_tensor = false;  // content-type: application/x-seldon-raw
+  bool chunked = false;        // transfer-encoding: chunked (rejected: 411)
   size_t header_bytes = 0;     // offset where the body starts
 };
 
@@ -150,9 +153,13 @@ bool parse_http(const char* buf, size_t header_end, HttpReq* out) {
   const char* sp2 = (const char*)memchr(sp1 + 1, ' ', end - sp1 - 1);
   if (!sp2) return false;
   out->path.assign(sp1 + 1, sp2 - sp1 - 1);
-  // strip query string for routing (kept out of the fast lane)
+  // split query string: routing matches on the bare path, the raw lane
+  // gets the full target so '?predictor=' & co. survive the C++ hop
   size_t q = out->path.find('?');
-  if (q != std::string::npos) out->path.resize(q);
+  if (q != std::string::npos) {
+    out->query.assign(out->path, q + 1, std::string::npos);
+    out->path.resize(q);
+  }
   const char* line = (const char*)memchr(sp2, '\n', end - sp2);
   if (!line) return false;
   line++;
@@ -177,6 +184,9 @@ bool parse_http(const char* buf, size_t header_end, HttpReq* out) {
       } else if (klen == 12 && iequal(line, "content-type", 12)) {
         out->is_raw_tensor =
             (vlen >= 20 && iequal(v, "application/x-seldon", 20));
+      } else if (klen == 17 && iequal(line, "transfer-encoding", 17)) {
+        // any transfer-encoding means no usable Content-Length
+        out->chunked = true;
       }
     }
     if (!eol) break;
@@ -355,14 +365,19 @@ std::string http_response(int status, const char* content_type,
   return out;
 }
 
+// minimal JSON string escaping (quotes, backslashes; control chars dropped)
+void json_append_escaped(std::string* out, const std::string& s) {
+  for (char c : s) {
+    if (c == '"' || c == '\\') out->push_back('\\');
+    if ((unsigned char)c >= 0x20) out->push_back(c);
+  }
+}
+
 std::string seldon_error_json(int code, const std::string& info, const char* reason) {
   std::string body = "{\"status\":{\"status\":\"FAILURE\",\"code\":";
   body += std::to_string(code);
   body += ",\"info\":\"";
-  for (char c : info) {
-    if (c == '"' || c == '\\') body.push_back('\\');
-    if ((unsigned char)c >= 0x20) body.push_back(c);
-  }
+  json_append_escaped(&body, info);
   body += "\",\"reason\":\"";
   body += reason;
   body += "\"}}";
@@ -378,7 +393,8 @@ class FrontServer {
   explicit FrontServer(const FsConfig& cfg)
       : cfg_(cfg),
         model_name_(cfg.model_name ? cfg.model_name : "model"),
-        names_csv_(cfg.names_csv ? cfg.names_csv : "") {
+        names_csv_(cfg.names_csv ? cfg.names_csv : ""),
+        bind_host_(cfg.bind_host ? cfg.bind_host : "") {
     if (cfg_.max_batch < 1) cfg_.max_batch = 64;
     if (cfg_.max_wait_us < 0) cfg_.max_wait_us = 1000;
     if (cfg_.out_dim < 1) cfg_.out_dim = 3;
@@ -443,6 +459,13 @@ class FrontServer {
     sockaddr_in addr{};
     addr.sin_family = AF_INET;
     addr.sin_addr.s_addr = htonl(INADDR_ANY);
+    if (!bind_host_.empty() && bind_host_ != "0.0.0.0") {
+      if (inet_pton(AF_INET, bind_host_.c_str(), &addr.sin_addr) != 1) {
+        close(listen_fd_);
+        listen_fd_ = -1;
+        return -EINVAL;  // honour the operator's bind address or fail loudly
+      }
+    }
     addr.sin_port = htons((uint16_t)cfg_.port);
     if (bind(listen_fd_, (sockaddr*)&addr, sizeof(addr)) < 0 ||
         listen(listen_fd_, cfg_.backlog) < 0) {
@@ -629,6 +652,16 @@ class FrontServer {
         return;
       }
       req.header_bytes = header_end + 4;
+      if (req.chunked) {
+        // no chunked decoder: answering with 411 and closing keeps the
+        // chunk stream from being misparsed as pipelined requests
+        queue_inline_response(c, 411,
+                              seldon_error_json(411, "chunked transfer-encoding not supported; send Content-Length", "BAD_REQUEST"),
+                              true, false);
+        c.in.clear();
+        c.closing = true;
+        return;
+      }
       size_t body_len = req.content_length > 0 ? (size_t)req.content_length : 0;
       if (c.in.size() < req.header_bytes + body_len) return;  // need more
       std::string body = c.in.substr(req.header_bytes, body_len);
@@ -720,6 +753,7 @@ class FrontServer {
         RawFrame f;
         if (parse_raw_frame((const uint8_t*)body.data(), (int64_t)body.size(), &f) &&
             f.dtype == 0 && f.shape.size() == 2 &&
+            f.shape[0] >= 1 && f.shape[1] >= 1 &&  // mirror the JSON lane: no empty batches
             (cfg_.feature_dim <= 0 || f.shape[1] == cfg_.feature_dim)) {
           p.lane = Lane::FAST_RAW;
           p.rows = f.shape[0];
@@ -754,7 +788,7 @@ class FrontServer {
     p.lane = Lane::RAW;
     p.keep_alive = req.keep_alive;
     p.method = req.method;
-    p.path = req.path;
+    p.path = req.query.empty() ? req.path : req.path + "?" + req.query;
     p.body.assign(body.begin(), body.end());
     c.inflight++;
     {
@@ -1001,14 +1035,15 @@ class FrontServer {
     std::string body;
     body.reserve((size_t)(rows * cols * 16 + 256));
     body += "{\"meta\":{\"puid\":\"";
-    body += puid.empty() ? next_puid() : puid;
+    // puid comes off the wire: escape it or the response JSON breaks
+    json_append_escaped(&body, puid.empty() ? next_puid() : puid);
     body += "\",\"requestPath\":{\"";
-    body += model_name_;
+    json_append_escaped(&body, model_name_);
     body += "\":\"native\"}},\"data\":{\"names\":[";
     for (int64_t j = 0; j < cols; j++) {
       if (j) body += ',';
       body += '"';
-      if (j < (int64_t)names_.size()) body += names_[j];
+      if (j < (int64_t)names_.size()) json_append_escaped(&body, names_[j]);
       else {
         body += "t:";
         body += std::to_string(j);
@@ -1175,6 +1210,7 @@ class FrontServer {
   FsConfig cfg_;
   std::string model_name_;
   std::string names_csv_;
+  std::string bind_host_;
   std::vector<std::string> names_;
   std::vector<int> buckets_;
   std::string puid_prefix_;

@@ -155,7 +155,7 @@ class _DeploymentRawHandler:
 
 async def serve_native_ingress(
     gateway,
-    host: str = "0.0.0.0",  # noqa: ARG001 — native server binds INADDR_ANY
+    host: str = "0.0.0.0",
     http_port: int = 8000,
     max_batch: Optional[int] = None,
     max_wait_ms: float = 1.0,
@@ -170,7 +170,7 @@ async def serve_native_ingress(
     loop = asyncio.get_running_loop()
     handler = _DeploymentRawHandler(gateway, loop)
     lane = fast_lane_for(gateway)
-    kwargs = dict(port=http_port, raw_handler=handler, max_wait_ms=max_wait_ms)
+    kwargs = dict(port=http_port, raw_handler=handler, max_wait_ms=max_wait_ms, host=host)
     if lane is not None:
         kwargs.update(
             model_fn=_live_model_fn(gateway, lane["feature_dim"], lane["out_dim"]),

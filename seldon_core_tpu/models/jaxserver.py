@@ -38,6 +38,19 @@ from seldon_core_tpu.runtime.component import MicroserviceError, TPUComponent, g
 logger = logging.getLogger(__name__)
 
 
+def _compute_dtype(name: str):
+    import jax.numpy as jnp
+
+    try:
+        return {"bfloat16": jnp.bfloat16, "float32": jnp.float32, "float16": jnp.float16}[name]
+    except KeyError:
+        raise MicroserviceError(
+            f"unknown dtype {name!r} (supported: bfloat16, float32, float16)",
+            status_code=400,
+            reason="BAD_DTYPE",
+        ) from None
+
+
 def _model_registry() -> Dict[str, Callable[..., Tuple[Any, Tuple[int, ...]]]]:
     """name -> factory(num_classes, dtype) -> (module, example_input_shape)."""
     from seldon_core_tpu.models import mlp, resnet
@@ -89,6 +102,10 @@ class JaxServer(TPUComponent):
         top_k: int = 0,
         warmup: bool = True,
         warmup_dtypes: Sequence[str] = ("float32", "uint8"),
+        quantize: str = "",
+        normalize: bool = False,
+        normalize_mean: Optional[Sequence[float]] = None,
+        normalize_std: Optional[Sequence[float]] = None,
         seed: int = 0,
         mesh: Optional[Any] = None,
         data_axis: str = "data",
@@ -122,6 +139,23 @@ class JaxServer(TPUComponent):
         # else host-side so a stray float64 tensor payload can never
         # trigger a mid-traffic recompile
         self.warmup_dtypes = tuple(warmup_dtypes)
+        # quantize="int8": weight-only quantisation of the loaded
+        # checkpoint (ops/surgery.py) — kernels live in HBM as int8,
+        # dequant fuses into the consuming matmul/conv inside the jit
+        if quantize not in ("", "int8"):
+            raise MicroserviceError(
+                f"unknown quantize mode {quantize!r} (supported: 'int8')",
+                status_code=400,
+                reason="BAD_QUANTIZE",
+            )
+        self.quantize = quantize
+        self.quantize_manifest: List[Dict[str, Any]] = []
+        # normalize=True: uint8 image batches go through the fused
+        # pallas cast+affine kernel (ops.fused_normalize) before the
+        # model — one VMEM pass instead of an HBM convert/mul/add chain
+        self.normalize = bool(normalize)
+        self._norm_mean = tuple(normalize_mean) if normalize_mean else None
+        self._norm_std = tuple(normalize_std) if normalize_std else None
         self.seed = int(seed)
         self.mesh = mesh
         self.data_axis = data_axis
@@ -138,9 +172,7 @@ class JaxServer(TPUComponent):
     def _build_module(self):
         import jax.numpy as jnp
 
-        dtype = {"bfloat16": jnp.bfloat16, "float32": jnp.float32, "float16": jnp.float16}[
-            self.dtype_name
-        ]
+        dtype = _compute_dtype(self.dtype_name)
         registry = _model_registry()
         if self.model_name in registry:
             module, default_shape = registry[self.model_name](
@@ -221,10 +253,41 @@ class JaxServer(TPUComponent):
         import jax.numpy as jnp
 
         t0 = time.perf_counter()
+        compute_dtype = _compute_dtype(self.dtype_name)
         self.module = self._build_module()
-        self.variables = self._pin_params(self._init_or_load_params())
+        variables = self._init_or_load_params()
+        if self.quantize == "int8":
+            from seldon_core_tpu.ops.surgery import quantize_params, tree_hbm_bytes
+
+            bytes_fp = tree_hbm_bytes(variables)
+            variables, self.quantize_manifest = quantize_params(variables)
+            logger.info(
+                "int8 surgery: %d kernels quantized, params %.1f MB -> %.1f MB",
+                len(self.quantize_manifest),
+                bytes_fp / 1e6,
+                tree_hbm_bytes(variables) / 1e6,
+            )
+        self.variables = self._pin_params(variables)
+
+        if self.normalize:
+            from seldon_core_tpu.ops.kernels import imagenet_affine
+
+            if self._norm_mean is not None or self._norm_std is not None:
+                mean = np.asarray(self._norm_mean or (0.0,), np.float32)
+                std = np.asarray(self._norm_std or (1.0,), np.float32)
+                norm_scale, norm_shift = 1.0 / (255.0 * std), -mean / std
+            else:
+                norm_scale, norm_shift = imagenet_affine()
 
         def apply_fn(variables, x):
+            if self.quantize == "int8":
+                from seldon_core_tpu.ops.surgery import dequantize_params
+
+                variables = dequantize_params(variables, compute_dtype)
+            if self.normalize and x.dtype == jnp.uint8:
+                from seldon_core_tpu.ops.kernels import fused_normalize
+
+                x = fused_normalize(x, norm_scale, norm_shift, out_dtype=compute_dtype)
             y = self.module.apply(variables, x)
             if self.softmax_outputs:
                 y = jax.nn.softmax(y, axis=-1)

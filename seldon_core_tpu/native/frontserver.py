@@ -23,6 +23,7 @@ from __future__ import annotations
 import ctypes
 import json
 import logging
+import threading
 from typing import Callable, Optional, Sequence, Tuple
 
 import numpy as np
@@ -71,6 +72,7 @@ class _FsConfig(ctypes.Structure):
         ("model_name", ctypes.c_char_p),
         ("names_csv", ctypes.c_char_p),
         ("buckets_csv", ctypes.c_char_p),
+        ("bind_host", ctypes.c_char_p),
     ]
 
 
@@ -143,6 +145,7 @@ class NativeFrontServer:
         raw_workers: int = 2,
         eager_when_idle: bool = True,
         buckets: Optional[Sequence[int]] = None,
+        host: str = "0.0.0.0",
     ):
         lib = get_lib()
         if lib is None or not hasattr(lib, "fs_create"):
@@ -164,6 +167,7 @@ class NativeFrontServer:
             model_name=model_name.encode(),
             names_csv=",".join(names).encode() if names else b"",
             buckets_csv=",".join(str(int(b)) for b in buckets).encode() if buckets else b"",
+            bind_host=host.encode(),
         )
         self._cfg = cfg  # keep the char pointers alive
         self._handle = lib.fs_create(ctypes.byref(cfg))
@@ -177,6 +181,9 @@ class NativeFrontServer:
             lib.fs_set_raw_handler(self._handle, self._raw_cb, None)
         self.port = 0
         self._started = False
+        # serialises stop() against set_ready()/stats(): the C++ object
+        # must not be destroyed while another thread is inside a call
+        self._handle_lock = threading.Lock()
 
     # ------------------------------------------------------------ callbacks
 
@@ -224,24 +231,23 @@ class NativeFrontServer:
         return self.port
 
     def stop(self) -> None:
-        # null the handle FIRST so a racing set_ready/stats no-ops
-        # instead of dereferencing the freed FrontServer
-        handle, self._handle = self._handle, None
-        if handle:
-            self._lib.fs_stop(handle)
-            self._lib.fs_destroy(handle)
-        self._started = False
+        with self._handle_lock:
+            handle, self._handle = self._handle, None
+            if handle:
+                self._lib.fs_stop(handle)
+                self._lib.fs_destroy(handle)
+            self._started = False
 
     def set_ready(self, ready: bool) -> None:
-        handle = self._handle
-        if handle:
-            self._lib.fs_set_ready(handle, 1 if ready else 0)
+        with self._handle_lock:
+            if self._handle:
+                self._lib.fs_set_ready(self._handle, 1 if ready else 0)
 
     def stats(self) -> dict:
         s = _FsStats()
-        handle = self._handle
-        if handle:
-            self._lib.fs_get_stats(handle, ctypes.byref(s))
+        with self._handle_lock:
+            if self._handle:
+                self._lib.fs_get_stats(self._handle, ctypes.byref(s))
         return {name: getattr(s, name) for name, _ in _FsStats._fields_}
 
     def __enter__(self) -> "NativeFrontServer":
@@ -263,25 +269,61 @@ class GatewayRawHandler:
         self.gateway = gateway
         self.loop = loop
 
+    @staticmethod
+    def _payload(body: bytes, query: dict) -> dict:
+        """JSON body, form-encoded ``json`` field, or ``?json=`` query —
+        the Python app's _request_body semantics (runtime/rest.py)."""
+        if body:
+            try:
+                return json.loads(body)
+            except (json.JSONDecodeError, UnicodeDecodeError):
+                from urllib.parse import parse_qs as _pq
+
+                try:
+                    form = _pq(body.decode(), strict_parsing=True)
+                except (UnicodeDecodeError, ValueError):
+                    form = {}
+                if "json" in form:
+                    return json.loads(form["json"][0])
+                raise ValueError("invalid JSON body")
+        if "json" in query:
+            return json.loads(query["json"][0])
+        raise ValueError("empty request body")
+
     def __call__(self, method: str, path: str, body: bytes) -> Tuple[int, str, bytes]:
         import asyncio
+        from urllib.parse import parse_qs, urlsplit
 
         from seldon_core_tpu.runtime.message import InternalFeedback, InternalMessage
 
         try:
+            # the C++ lane forwards the full target; split off the query
+            # so '?predictor=NAME' routing matches the Python app
+            split = urlsplit(path)
+            path = split.path
+            query = parse_qs(split.query)
+            predictor = (query.get("predictor") or [None])[0]
+            if path in ("/pause", "/unpause") and method in ("POST", "PUT"):
+                asyncio.run_coroutine_threadsafe(
+                    asyncio.to_thread(
+                        self.gateway.pause if path == "/pause" else self.gateway.unpause
+                    ),
+                    self.loop,
+                ).result(timeout=60)
+                return 200, "text/plain", (path[1:] + "d").encode()
             if path in ("/api/v0.1/predictions", "/api/v1.0/predictions", "/predict"):
-                msg = InternalMessage.from_json(json.loads(body))
+                msg = InternalMessage.from_json(self._payload(body, query))
                 out = asyncio.run_coroutine_threadsafe(
-                    self.gateway.predict(msg), self.loop
+                    self.gateway.predict(msg, predictor=predictor), self.loop
                 ).result(timeout=60)
             elif path == "/api/v0.1/feedback":
-                fb = InternalFeedback.from_json(json.loads(body))
+                fb = InternalFeedback.from_json(self._payload(body, query))
                 out = asyncio.run_coroutine_threadsafe(
                     self.gateway.send_feedback(fb), self.loop
                 ).result(timeout=60)
             elif path == "/api/v0.1/explanations":
-                msg = InternalMessage.from_json(json.loads(body))
-                svc = self.gateway.pick()
+                msg = InternalMessage.from_json(self._payload(body, query))
+                svc = (self.gateway.by_name(predictor) if predictor else None) or self.gateway.pick()
                 out = asyncio.run_coroutine_threadsafe(
                     svc.explain(msg), self.loop
                 ).result(timeout=60)
@@ -296,6 +338,12 @@ class GatewayRawHandler:
                 if not 400 <= status < 600:
                     status = 500
             return status, "application/json", json.dumps(out.to_json()).encode()
+        except (ValueError, KeyError, TypeError) as e:
+            # bad payloads are the client's fault: 400, matching the app
+            return 400, "application/json", json.dumps(
+                {"status": {"status": "FAILURE", "code": 400, "info": str(e),
+                            "reason": "BAD_REQUEST"}}
+            ).encode()
         except Exception as e:  # noqa: BLE001 — wire errors as seldon status
             logger.exception("gateway raw handler failed")
             return 500, "application/json", json.dumps(

@@ -38,7 +38,8 @@ def _use_interpret() -> bool:
 def _normalize_kernel(x_ref, scale_ref, shift_ref, o_ref):
     import jax.numpy as jnp
 
-    x = x_ref[...].astype(jnp.float32)
+    # Mosaic has no direct uint8->float cast; hop through int32
+    x = x_ref[...].astype(jnp.int32).astype(jnp.float32)
     o_ref[...] = (x * scale_ref[...] + shift_ref[...]).astype(o_ref.dtype)
 
 

@@ -584,3 +584,168 @@ class TestProtocolEdges:
             assert not errs
             qps = n * nthreads / dt
             assert qps > 2000, f"native ingress too slow: {qps:.0f} req/s"
+
+
+class TestHardeningRound2:
+    """Regressions for the round-2 review findings."""
+
+    def test_chunked_transfer_rejected_411(self):
+        with NativeFrontServer(stub=True, feature_dim=4) as srv:
+            s = socket.create_connection(("127.0.0.1", srv.port), timeout=5)
+            s.sendall(
+                b"POST /api/v0.1/predictions HTTP/1.1\r\n"
+                b"Host: x\r\nTransfer-Encoding: chunked\r\n"
+                b"Content-Type: application/json\r\n\r\n"
+                b"5\r\nhello\r\n0\r\n\r\n"
+            )
+            s.settimeout(5)
+            data = b""
+            while b"\r\n\r\n" not in data:
+                chunk = s.recv(4096)
+                if not chunk:
+                    break
+                data += chunk
+            s.close()
+            assert b"411" in data.split(b"\r\n", 1)[0]
+            # connection is closed, chunk stream never parsed as requests
+            assert data.count(b"HTTP/1.1") == 1
+
+    def test_query_string_forwarded_to_raw_lane(self):
+        seen = {}
+
+        def handler(method, path, body):
+            seen["path"] = path
+            return 200, "application/json", b"{}"
+
+        with NativeFrontServer(stub=True, feature_dim=4, raw_handler=handler) as srv:
+            status, _ = post(srv.port, "/api/v0.1/feedback?predictor=canary&x=1", b"{}")
+            assert status == 200
+            assert seen["path"] == "/api/v0.1/feedback?predictor=canary&x=1"
+
+    def test_zero_row_raw_frame_not_fast_laned(self):
+        with NativeFrontServer(stub=True, feature_dim=4) as srv:
+            frame = pack_raw_frame(np.zeros((0, 4), np.float32))
+            status, data = post(srv.port, "/api/v0.1/predictions", frame,
+                                content_type="application/x-seldon-raw")
+            # no raw handler: empty batch rejected off the fast lane -> 404
+            assert status == 404
+
+    def test_puid_with_quote_escaped_in_response(self):
+        with NativeFrontServer(stub=True, out_dim=3, feature_dim=4) as srv:
+            status, data = post(srv.port, "/api/v0.1/predictions",
+                                tensor_body([[1, 2, 3, 4]], puid='a"b\\c'))
+            assert status == 200
+            out = json.loads(data)  # must parse: puid escaped
+            assert out["meta"]["puid"] == 'a"b\\c'
+
+
+class TestRawHandlerSemantics:
+    """GatewayRawHandler parity with the Python app's request handling."""
+
+    def _handler_with_dummy_gateway(self):
+        import asyncio
+
+        calls = {}
+
+        class DummyOut:
+            status = None
+
+            def to_json(self):
+                return {"data": {"ndarray": [[1.0]]}}
+
+        class DummyGateway:
+            def by_name(self, name):
+                calls["by_name"] = name
+                return self if name == "canary" else None
+
+            def pick(self):
+                calls["pick"] = True
+                return self
+
+            async def predict(self, msg, predictor=None):
+                calls["predictor"] = predictor
+                return DummyOut()
+
+            async def explain(self, msg):
+                calls["explained"] = True
+                return DummyOut()
+
+            def pause(self):
+                calls["paused"] = True
+
+            def unpause(self):
+                calls["unpaused"] = True
+
+        loop = asyncio.new_event_loop()
+        t = threading.Thread(target=loop.run_forever, daemon=True)
+        t.start()
+        return GatewayRawHandler(DummyGateway(), loop), calls, loop
+
+    def test_get_predictions_with_json_query(self):
+        h, calls, loop = self._handler_with_dummy_gateway()
+        try:
+            import urllib.parse
+
+            payload = urllib.parse.quote(json.dumps({"data": {"ndarray": [[1, 2]]}}))
+            status, _, body = h("GET", f"/api/v0.1/predictions?json={payload}", b"")
+            assert status == 200
+            assert json.loads(body)["data"]["ndarray"] == [[1.0]]
+        finally:
+            loop.call_soon_threadsafe(loop.stop)
+
+    def test_form_encoded_json_field(self):
+        h, calls, loop = self._handler_with_dummy_gateway()
+        try:
+            import urllib.parse
+
+            body = urllib.parse.urlencode({"json": json.dumps({"data": {"ndarray": [[1]]}})}).encode()
+            status, _, _ = h("POST", "/api/v0.1/predictions", body)
+            assert status == 200
+        finally:
+            loop.call_soon_threadsafe(loop.stop)
+
+    def test_empty_body_is_400_not_500(self):
+        h, calls, loop = self._handler_with_dummy_gateway()
+        try:
+            status, _, body = h("POST", "/api/v0.1/predictions", b"")
+            assert status == 400
+            assert json.loads(body)["status"]["reason"] == "BAD_REQUEST"
+        finally:
+            loop.call_soon_threadsafe(loop.stop)
+
+    def test_explanations_honour_predictor_query(self):
+        h, calls, loop = self._handler_with_dummy_gateway()
+        try:
+            status, _, _ = h(
+                "POST", "/api/v0.1/explanations?predictor=canary",
+                json.dumps({"data": {"ndarray": [[1]]}}).encode(),
+            )
+            assert status == 200
+            assert calls["by_name"] == "canary"
+            assert calls.get("explained")
+            assert "pick" not in calls
+        finally:
+            loop.call_soon_threadsafe(loop.stop)
+
+    def test_pause_unpause_routes(self):
+        h, calls, loop = self._handler_with_dummy_gateway()
+        try:
+            status, _, body = h("POST", "/pause", b"")
+            assert (status, body) == (200, b"paused")
+            assert calls.get("paused")
+            status, _, body = h("PUT", "/unpause", b"")
+            assert (status, body) == (200, b"unpaused")
+            assert calls.get("unpaused")
+        finally:
+            loop.call_soon_threadsafe(loop.stop)
+
+
+class TestHostBinding:
+    def test_binds_loopback_only(self):
+        with NativeFrontServer(stub=True, feature_dim=4, host="127.0.0.1") as srv:
+            status, _ = get(srv.port, "/ping")
+            assert status == 200
+
+    def test_invalid_host_fails_loudly(self):
+        with pytest.raises(OSError):
+            NativeFrontServer(stub=True, feature_dim=4, host="not-an-ip").start()

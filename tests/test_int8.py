@@ -1,0 +1,215 @@
+"""int8 serving path: checkpoint surgery + quantized jaxserver.
+
+The TPU-first counterpart of the reference's optimised-backend proxy
+mandate (reference: integrations/nvidia-inference-server/TRTProxy.py:
+50-81): the quantised variant is produced in-process by pytree surgery
+and served through the same jit/batcher path as fp.
+"""
+
+import numpy as np
+import pytest
+
+from seldon_core_tpu.ops.surgery import (
+    QuantizedKernel,
+    dequantize_params,
+    quantize_kernel,
+    quantize_params,
+    tree_hbm_bytes,
+)
+
+
+class TestSurgery:
+    def test_quantize_kernel_roundtrip_error(self, rng):
+        w = rng.normal(size=(64, 128)).astype(np.float32)
+        qk = quantize_kernel(w)
+        assert qk.q.dtype == np.int8
+        assert qk.q.shape == w.shape
+        assert qk.scale.shape == (128,)
+        back = qk.q.astype(np.float32) * qk.scale
+        # symmetric per-channel int8: max error is half a step per channel
+        step = np.abs(w).max(axis=0) / 127.0
+        assert np.all(np.abs(back - w) <= step[None, :] * 0.5 + 1e-7)
+
+    def test_quantize_kernel_zero_channel(self):
+        w = np.zeros((8, 4), np.float32)
+        w[:, 0] = 1.0
+        qk = quantize_kernel(w)
+        # all-zero channels keep scale 1.0 and quantise to zero
+        assert np.all(qk.q[:, 1:] == 0)
+        assert qk.scale[1] == 1.0
+
+    def test_conv_kernel_last_dim_channels(self, rng):
+        w = rng.normal(size=(3, 3, 16, 32)).astype(np.float32)
+        qk = quantize_kernel(w)
+        assert qk.q.shape == w.shape
+        assert qk.scale.shape == (32,)
+
+    def test_quantize_params_selects_large_kernels_only(self, rng):
+        tree = {
+            "params": {
+                "dense": {
+                    "kernel": rng.normal(size=(128, 64)).astype(np.float32),
+                    "bias": np.zeros(64, np.float32),
+                },
+                "small": {"kernel": rng.normal(size=(2, 4)).astype(np.float32)},
+                "bn": {"scale": np.ones(64, np.float32)},
+            }
+        }
+        qtree, manifest = quantize_params(tree, min_elems=1024)
+        assert isinstance(qtree["params"]["dense"]["kernel"], QuantizedKernel)
+        # bias, small kernel, bn scale untouched
+        assert isinstance(qtree["params"]["small"]["kernel"], np.ndarray)
+        assert isinstance(qtree["params"]["bn"]["scale"], np.ndarray)
+        assert len(manifest) == 1
+        assert manifest[0]["path"].endswith("dense/kernel")
+        assert manifest[0]["bytes_q"] < manifest[0]["bytes_fp"]
+        # resident bytes shrink
+        assert tree_hbm_bytes(qtree) < tree_hbm_bytes(tree)
+
+    def test_dequantize_inside_jit(self, rng):
+        import jax
+        import jax.numpy as jnp
+
+        w = rng.normal(size=(64, 64)).astype(np.float32)
+        tree = {"params": {"d": {"kernel": w}}}
+        qtree, _ = quantize_params(tree, min_elems=1)
+        qtree = jax.device_put(qtree)  # pytree node flows through device_put
+
+        @jax.jit
+        def apply(qt, x):
+            vt = dequantize_params(qt, jnp.float32)
+            return x @ vt["params"]["d"]["kernel"]
+
+        x = rng.normal(size=(4, 64)).astype(np.float32)
+        got = np.asarray(apply(qtree, x))
+        want = x @ (qtree["params"]["d"]["kernel"].q.astype(np.float32)
+                    * np.asarray(qtree["params"]["d"]["kernel"].scale))
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+
+class TestQuantizedJaxServer:
+    def _server(self, **kw):
+        from seldon_core_tpu.models.jaxserver import JaxServer
+
+        defaults = dict(
+            model="mlp",
+            num_classes=8,
+            dtype="float32",
+            max_batch_size=8,
+            max_wait_ms=0.5,
+            warmup=False,
+            model_kwargs={"hidden_sizes": (128, 128)},
+        )
+        defaults.update(kw)
+        return JaxServer(**defaults)
+
+    def test_int8_accuracy_parity(self, rng):
+        fp = self._server()
+        q = self._server(quantize="int8")
+        fp.load()
+        q.load()
+        try:
+            assert q.quantize_manifest, "surgery found no kernels to quantise"
+            x = rng.normal(size=(16, 4)).astype(np.float32)
+            y_fp = np.asarray(fp.predict(x, names=[]))
+            y_q = np.asarray(q.predict(x, names=[]))
+            assert y_fp.shape == y_q.shape
+            # weight-only int8: logits close, argmax agrees
+            np.testing.assert_allclose(y_q, y_fp, rtol=0.1, atol=0.05)
+            agree = (y_fp.argmax(-1) == y_q.argmax(-1)).mean()
+            assert agree >= 0.9
+        finally:
+            fp.unload()
+            q.unload()
+
+    def test_int8_shrinks_params(self):
+        q = self._server(quantize="int8")
+        q.load()
+        try:
+            saved = sum(r["bytes_fp"] - r["bytes_q"] for r in q.quantize_manifest)
+            assert saved > 0
+        finally:
+            q.unload()
+
+    def test_bad_quantize_mode_rejected(self):
+        from seldon_core_tpu.runtime import MicroserviceError
+
+        with pytest.raises(MicroserviceError):
+            self._server(quantize="fp4")
+
+    def test_resnet_tiny_int8_e2e(self, rng):
+        from seldon_core_tpu.models.jaxserver import JaxServer
+
+        s = JaxServer(
+            model="resnet_tiny",
+            num_classes=10,
+            dtype="float32",
+            max_batch_size=4,
+            warmup=False,
+            quantize="int8",
+        )
+        s.load()
+        try:
+            assert s.quantize_manifest
+            x = rng.integers(0, 255, size=(2, 32, 32, 3)).astype(np.uint8)
+            y = np.asarray(s.predict(x, names=[]))
+            assert y.shape == (2, 10)
+            assert np.all(np.isfinite(y))
+        finally:
+            s.unload()
+
+
+class TestFusedNormalizeServing:
+    def test_uint8_path_matches_manual_affine(self, rng):
+        from seldon_core_tpu.models.jaxserver import JaxServer
+
+        mean, std = (0.5, 0.4, 0.3), (0.2, 0.25, 0.3)
+        common = dict(
+            model="resnet_tiny",
+            num_classes=10,
+            dtype="float32",
+            max_batch_size=4,
+            warmup=False,
+            seed=3,
+        )
+        s_norm = JaxServer(normalize=True, normalize_mean=mean, normalize_std=std, **common)
+        s_plain = JaxServer(**common)
+        s_norm.load()
+        s_plain.load()
+        try:
+            img = rng.integers(0, 255, size=(2, 32, 32, 3)).astype(np.uint8)
+            manual = (img.astype(np.float32) / 255.0 - np.asarray(mean, np.float32)) / np.asarray(
+                std, np.float32
+            )
+            y_norm = np.asarray(s_norm.predict(img, names=[]))
+            y_manual = np.asarray(s_plain.predict(manual.astype(np.float32), names=[]))
+            np.testing.assert_allclose(y_norm, y_manual, rtol=2e-2, atol=2e-2)
+        finally:
+            s_norm.unload()
+            s_plain.unload()
+
+    def test_float_input_skips_normalize(self, rng):
+        from seldon_core_tpu.models.jaxserver import JaxServer
+
+        common = dict(
+            model="mlp",
+            num_classes=8,
+            dtype="float32",
+            max_batch_size=8,
+            warmup=False,
+            model_kwargs={"hidden_sizes": (64,)},
+        )
+        s = JaxServer(normalize=True, **common)
+        s_plain = JaxServer(**common)
+        s.load()
+        s_plain.load()
+        try:
+            x = rng.normal(size=(4, 4)).astype(np.float32)
+            np.testing.assert_allclose(
+                np.asarray(s.predict(x, names=[])),
+                np.asarray(s_plain.predict(x, names=[])),
+                rtol=1e-6,
+            )
+        finally:
+            s.unload()
+            s_plain.unload()
