@@ -3,7 +3,14 @@ PROTOC ?= protoc
 .PHONY: proto test native bench clean
 
 proto:
-	$(PROTOC) -Iseldon_core_tpu/proto --python_out=seldon_core_tpu/proto seldon_core_tpu/proto/seldon.proto
+	$(PROTOC) -Iseldon_core_tpu/proto --python_out=seldon_core_tpu/proto \
+		seldon_core_tpu/proto/tf_compat.proto \
+		seldon_core_tpu/proto/tfserving_compat.proto \
+		seldon_core_tpu/proto/seldon.proto
+	# protoc emits flat top-level imports; rewrite to package-relative
+	sed -i 's/^import \(tf_compat_pb2\|tfserving_compat_pb2\)/from seldon_core_tpu.proto import \1/' \
+		seldon_core_tpu/proto/seldon_pb2.py \
+		seldon_core_tpu/proto/tfserving_compat_pb2.py
 
 native:
 	$(MAKE) -C native

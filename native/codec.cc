@@ -175,6 +175,8 @@ void u8_to_f32_normalize(const uint8_t* src, int64_t n_pixels, int64_t channels,
   }
 }
 
-int32_t native_abi_version() { return 1; }
+// v2: FsConfig gained bind_host (frontserver.cc); a stale .so built
+// before that field would silently ignore the requested bind address.
+int32_t native_abi_version() { return 2; }
 
 }  // extern "C"

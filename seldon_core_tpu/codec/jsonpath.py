@@ -21,6 +21,15 @@ from seldon_core_tpu.codec.tensor import PayloadError, np_dtype
 from seldon_core_tpu.proto import pb
 
 
+def _bytes_to_str(x: Any) -> Any:
+    """Recursively decode bytes elements for JSON serialization."""
+    if isinstance(x, bytes):
+        return x.decode("utf-8", errors="replace")
+    if isinstance(x, list):
+        return [_bytes_to_str(v) for v in x]
+    return x
+
+
 def json_to_proto(body: Dict[str, Any]) -> pb.SeldonMessage:
     msg = pb.SeldonMessage()
     json_format.ParseDict(body, msg, ignore_unknown_fields=True)
@@ -114,7 +123,10 @@ def build_json_payload(
             "data": native.b64encode(arr.tobytes()),
         }
     elif data_kind == "ndarray":
-        datadef["ndarray"] = arr.tolist()
+        lst = arr.tolist()
+        if arr.dtype.kind in "SO":  # bytes elements are not JSON-serializable
+            lst = _bytes_to_str(lst)
+        datadef["ndarray"] = lst
     else:  # tensor (default, also used when request was binData/strData/json)
         arr = np.asarray(arr, dtype=np.float64)
         datadef["tensor"] = {"shape": list(arr.shape), "values": arr.ravel().tolist()}

@@ -87,6 +87,10 @@ def datadef_to_array(datadef: pb.DefaultData) -> np.ndarray:
         return raw_tensor_to_array(datadef.rawTensor)
     if kind == "ndarray":
         return ndarray_to_array(datadef.ndarray)
+    if kind == "tftensor":
+        from seldon_core_tpu.codec.tftensor import tftensor_to_array
+
+        return tftensor_to_array(datadef.tftensor)
     raise PayloadError(f"DefaultData has no decodable payload (kind={kind})")
 
 
@@ -144,6 +148,10 @@ def array_to_datadef(
         datadef.rawTensor.CopyFrom(array_to_raw_tensor(arr))
     elif data_type == "ndarray":
         datadef.ndarray.CopyFrom(array_to_ndarray(arr))
+    elif data_type == "tftensor":
+        from seldon_core_tpu.codec.tftensor import array_to_tftensor
+
+        array_to_tftensor(arr, out=datadef.tftensor)
     else:
         raise PayloadError(f"unknown data_type {data_type!r}")
     return datadef

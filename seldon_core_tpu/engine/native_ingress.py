@@ -139,6 +139,7 @@ class _DeploymentRawHandler:
         self._inner = GatewayRawHandler(gateway, loop)
 
     def __call__(self, method: str, path: str, body: bytes) -> Tuple[int, str, bytes]:
+        path = path.split("?", 1)[0]  # C++ lane forwards the query string
         if method == "GET" and path == "/metrics":
             try:
                 from prometheus_client import CONTENT_TYPE_LATEST, generate_latest

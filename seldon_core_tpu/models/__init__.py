@@ -39,9 +39,12 @@ def _register_optional() -> None:
         register_implementation("MLFLOW_SERVER", MLFlowServer)
     except ImportError:
         pass
-    from seldon_core_tpu.models.proxyserver import RestProxyServer
+    from seldon_core_tpu.models.proxyserver import RestProxyServer, TFServingGrpcProxy
 
     register_implementation("REST_PROXY", RestProxyServer)
+    # Reference's TENSORFLOW_SERVER prepackaged proxy
+    # (operator/controllers/seldondeployment_prepackaged_servers.go:109)
+    register_implementation("TENSORFLOW_SERVER", TFServingGrpcProxy)
 
 
 _register_optional()

@@ -275,6 +275,10 @@ class JaxServer(TPUComponent):
             if self._norm_mean is not None or self._norm_std is not None:
                 mean = np.asarray(self._norm_mean or (0.0,), np.float32)
                 std = np.asarray(self._norm_std or (1.0,), np.float32)
+                # mean/std broadcast together to the channel count so that
+                # supplying only one of them still yields per-channel
+                # scale/shift (fused_normalize reshapes both to (1,..,C))
+                mean, std = np.broadcast_arrays(mean, std)
                 norm_scale, norm_shift = 1.0 / (255.0 * std), -mean / std
             else:
                 norm_scale, norm_shift = imagenet_affine()

@@ -13,6 +13,13 @@ inference graph without rewrapping.
   request/response field names; the defaults speak the TFServing /
   KServe v1 dialect (``{"instances": [...]}`` -> ``{"predictions":
   [...]}``).
+* ``TFServingGrpcProxy`` — gRPC proxy speaking
+  ``/tensorflow.serving.PredictionService/Predict`` without a
+  TensorFlow dependency (tf_compat protos).  A ``tftensor``-bearing
+  SeldonMessage is passed through at the proto level — no decode — the
+  reference's fast path (reference:
+  integrations/tfserving/TfServingProxy.py:72-78); any other payload
+  kind is converted to a TensorProto first.
 * ``OpenAIChatProxy`` shape intentionally omitted — out of the
   reference's scope.
 """
@@ -84,3 +91,117 @@ class RestProxyServer(TPUComponent):
 
     def health_status(self):
         return {"proxy": self.url}
+
+
+TFSERVING_PREDICT_METHOD = "/tensorflow.serving.PredictionService/Predict"
+
+
+class TFServingGrpcProxy(TPUComponent):
+    """Graph node proxying to a TFServing gRPC endpoint.
+
+    Implements the reference's gRPC lane (reference:
+    integrations/tfserving/TfServingProxy.py:54-90) TensorFlow-free: the
+    PredictRequest/PredictResponse wire messages are the re-declared
+    tf_compat protos and the stub is a bare ``channel.unary_unary`` on
+    the TFServing method path.
+    """
+
+    def __init__(
+        self,
+        grpc_endpoint: str = "",
+        model_name: str = "",
+        signature_name: str = "serving_default",
+        model_input: str = "inputs",
+        model_output: str = "",
+        timeout_s: float = 10.0,
+        max_message_mb: int = 512,
+        **kwargs: Any,
+    ):
+        super().__init__(**kwargs)
+        if not grpc_endpoint or not model_name:
+            raise MicroserviceError(
+                "TFServingGrpcProxy needs grpc_endpoint and model_name",
+                status_code=400,
+                reason="MISSING_ENDPOINT",
+            )
+        self.grpc_endpoint = grpc_endpoint
+        self.model_name = model_name
+        self.signature_name = signature_name
+        self.model_input = model_input
+        self.model_output = model_output
+        self.timeout_s = float(timeout_s)
+        self.max_message_bytes = int(max_message_mb) * 1024 * 1024
+        self._predict_rpc = None
+
+    def _rpc(self):
+        if self._predict_rpc is None:
+            import grpc
+
+            from seldon_core_tpu.proto import tfserving_compat_pb2 as tfs
+
+            options = [
+                ("grpc.max_send_message_length", self.max_message_bytes),
+                ("grpc.max_receive_message_length", self.max_message_bytes),
+            ]
+            channel = grpc.insecure_channel(self.grpc_endpoint, options)
+            self._predict_rpc = channel.unary_unary(
+                TFSERVING_PREDICT_METHOD,
+                request_serializer=tfs.PredictRequest.SerializeToString,
+                response_deserializer=tfs.PredictResponse.FromString,
+            )
+        return self._predict_rpc
+
+    def predict_raw(self, msg):
+        """Proto-level predict: tftensor passthrough, else convert."""
+        from seldon_core_tpu.codec import tensor as tensor_codec
+        from seldon_core_tpu.codec.tftensor import array_to_tftensor
+        from seldon_core_tpu.proto import pb
+        from seldon_core_tpu.proto import tfserving_compat_pb2 as tfs
+
+        req = tfs.PredictRequest()
+        req.model_spec.name = self.model_name
+        req.model_spec.signature_name = self.signature_name
+        kind = msg.WhichOneof("data_oneof")
+        if kind != "data":
+            raise MicroserviceError(
+                "TFServingGrpcProxy supports DefaultData payloads only",
+                status_code=400,
+                reason="UNSUPPORTED_PAYLOAD",
+            )
+        if msg.data.WhichOneof("data_oneof") == "tftensor":
+            req.inputs[self.model_input].CopyFrom(msg.data.tftensor)
+        else:
+            array_to_tftensor(
+                tensor_codec.datadef_to_array(msg.data), out=req.inputs[self.model_input]
+            )
+        try:
+            result = self._rpc()(req, timeout=self.timeout_s)
+        except Exception as e:  # noqa: BLE001 — grpc.RpcError and channel setup
+            raise MicroserviceError(
+                f"TFServing upstream {self.grpc_endpoint} failed: {e}",
+                status_code=502,
+                reason="UPSTREAM_ERROR",
+            )
+        if self.model_output:
+            if self.model_output not in result.outputs:
+                raise MicroserviceError(
+                    f"TFServing response missing output {self.model_output!r}",
+                    status_code=502,
+                    reason="BAD_UPSTREAM_RESPONSE",
+                )
+            out_tensor = result.outputs[self.model_output]
+        elif len(result.outputs) == 1:
+            out_tensor = next(iter(result.outputs.values()))
+        else:
+            raise MicroserviceError(
+                f"TFServing returned {len(result.outputs)} outputs; set model_output",
+                status_code=502,
+                reason="BAD_UPSTREAM_RESPONSE",
+            )
+        reply = pb.SeldonMessage()
+        reply.meta.CopyFrom(msg.meta)
+        reply.data.tftensor.CopyFrom(out_tensor)
+        return reply
+
+    def health_status(self):
+        return {"proxy": self.grpc_endpoint, "model": self.model_name}

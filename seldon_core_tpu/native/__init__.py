@@ -51,8 +51,10 @@ def get_lib() -> Optional[ctypes.CDLL]:
         return _LIB
     _TRIED = True
     so = _so_path()
-    if not os.path.exists(so):
-        _try_build(so)
+    # Always invoke make (a no-op when up to date): a .so older than the
+    # sources would otherwise load with a stale ABI — e.g. an FsConfig
+    # missing bind_host — and misread every struct field after it.
+    _try_build(so)
     if not os.path.exists(so):
         return None
     try:
@@ -76,7 +78,7 @@ def get_lib() -> Optional[ctypes.CDLL]:
             ctypes.POINTER(ctypes.c_char_p), ctypes.POINTER(ctypes.c_int64), ctypes.c_int64,
             ctypes.c_int64, ctypes.c_int64, ctypes.c_char_p,
         ]
-        assert lib.native_abi_version() == 1
+        assert lib.native_abi_version() == 2, "stale libseldon_tpu_native.so: rebuild with `make -C native`"
         _LIB = lib
         logger.info("native data-plane core loaded from %s", so)
     except Exception as e:  # noqa: BLE001

@@ -304,12 +304,9 @@ class GatewayRawHandler:
             query = parse_qs(split.query)
             predictor = (query.get("predictor") or [None])[0]
             if path in ("/pause", "/unpause") and method in ("POST", "PUT"):
-                asyncio.run_coroutine_threadsafe(
-                    asyncio.to_thread(
-                        self.gateway.pause if path == "/pause" else self.gateway.unpause
-                    ),
-                    self.loop,
-                ).result(timeout=60)
+                # synchronous flag flips; we are already off the loop on a
+                # C++ raw-worker thread, so call directly
+                (self.gateway.pause if path == "/pause" else self.gateway.unpause)()
                 return 200, "text/plain", (path[1:] + "d").encode()
             if path in ("/api/v0.1/predictions", "/api/v1.0/predictions", "/predict"):
                 msg = InternalMessage.from_json(self._payload(body, query))

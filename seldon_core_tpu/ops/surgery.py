@@ -72,14 +72,17 @@ except ValueError:  # pragma: no cover
 
 
 def quantize_kernel(w) -> QuantizedKernel:
-    """Symmetric per-output-channel int8 quantisation of (..., N)."""
+    """Symmetric per-output-channel int8 quantisation of (..., N).
+
+    The numerics live in ops.kernels.quantize_weights (the 2-D case);
+    here leading dims are flattened so conv kernels quantise the same way.
+    """
+    from seldon_core_tpu.ops.kernels import quantize_weights
+
     w = np.asarray(w).astype(np.float32, copy=False)
     n = w.shape[-1]
-    flat = w.reshape(-1, n)
-    max_abs = np.abs(flat).max(axis=0)
-    scale = np.where(max_abs > 0, max_abs / 127.0, 1.0).astype(np.float32)
-    q = np.clip(np.round(w / scale), -127, 127).astype(np.int8)
-    return QuantizedKernel(q, scale)
+    q2d, scale = quantize_weights(w.reshape(-1, n))
+    return QuantizedKernel(q2d.reshape(w.shape), scale)
 
 
 _FLOAT_KINDS = ("f", "V")  # 'V': ml_dtypes extended floats (bfloat16)
@@ -172,5 +175,9 @@ def tree_hbm_bytes(variables: Any) -> int:
 
     total = 0
     for leaf in jax.tree_util.tree_leaves(variables):
-        total += int(np.asarray(leaf).nbytes)
+        # metadata only: np.asarray would fetch device arrays to host
+        nbytes = getattr(leaf, "nbytes", None)
+        if nbytes is None:
+            nbytes = np.asarray(leaf).nbytes
+        total += int(nbytes)
     return total

@@ -25,7 +25,10 @@ import numpy as np
 from seldon_core_tpu import codec
 from seldon_core_tpu.proto import pb
 
-ARRAY_KINDS = ("tensor", "ndarray", "rawTensor")
+ARRAY_KINDS = ("tensor", "ndarray", "rawTensor", "tftensor")
+# tftensor has no REST/JSON representation (TF clients speak gRPC binary);
+# JSON responses for tftensor-kind messages fall back to "tensor".
+JSON_ARRAY_KINDS = ("tensor", "ndarray", "rawTensor")
 
 
 @dataclass
@@ -205,8 +208,8 @@ class InternalMessage:
         else:
             arr = np.asarray(payload)
             kind = self.kind if self.kind in ARRAY_KINDS else "tensor"
-            if arr.dtype.kind in "US":
-                kind = "ndarray"
+            if arr.dtype.kind in "US" and kind != "tftensor":
+                kind = "ndarray"  # tftensor carries strings natively (string_val)
             msg.data.CopyFrom(codec.array_to_datadef(arr, self.names, kind))
         return msg
 
@@ -220,8 +223,10 @@ class InternalMessage:
         payload = self.host_payload()
         if payload is None:
             return body
-        kind = self.kind if self.kind in ARRAY_KINDS else "tensor"
-        if isinstance(payload, np.ndarray) and payload.dtype.kind in "US":
+        kind = self.kind if self.kind in JSON_ARRAY_KINDS else "tensor"
+        if isinstance(payload, np.ndarray) and payload.dtype.kind in "USO":
+            # strings (incl. DT_STRING tftensor decodes: object arrays of
+            # bytes) can only travel as ndarray in the JSON dialect
             kind = "ndarray"
         data_body = codec.build_json_payload(
             payload,
