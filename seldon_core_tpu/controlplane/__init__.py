@@ -22,3 +22,9 @@ from seldon_core_tpu.controlplane.supervisor import (  # noqa: F401
     SupervisedProcess,
     Supervisor,
 )
+from seldon_core_tpu.controlplane.autoscaler import (  # noqa: F401
+    Autoscaler,
+    CounterRateSampler,
+    HpaSpec,
+    ReplicaSet,
+)

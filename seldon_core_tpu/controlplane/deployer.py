@@ -45,6 +45,16 @@ class Generation:
     plan: PlacementPlan
     created_at: float = field(default_factory=time.time)
     generation: int = 0
+    # hpa predictors: autoscaler loops + their replica sets, stopped
+    # when the generation is drained/deleted
+    autoscalers: List[Any] = field(default_factory=list)
+    replicasets: List[Any] = field(default_factory=list)
+
+    def stop_scaling(self) -> None:
+        for asc in self.autoscalers:
+            asc.stop()
+        for rs in self.replicasets:
+            rs.stop_all()
 
 
 class ManagedDeployment:
@@ -69,20 +79,120 @@ def build_generation(spec: TpuDeployment, device_ids: Optional[List[int]] = None
     plan = plan_placement(spec, device_ids=device_ids)
     weighted: List[Tuple[PredictorService, float]] = []
     shadows: List[PredictorService] = []
-    for p in spec.predictors:
-        from seldon_core_tpu.utils.metrics import PrometheusObserver
+    autoscalers: List[Any] = []
+    replicasets: List[Any] = []
+    try:
+        for p in spec.predictors:
+            from seldon_core_tpu.utils.metrics import PrometheusObserver
 
-        observer = PrometheusObserver(deployment_name=spec.name, predictor_name=p.name)
-        svc = PredictorService(
-            p.graph, name=p.name, observer=observer, annotations=spec.annotations
+            observer = PrometheusObserver(deployment_name=spec.name, predictor_name=p.name)
+            clients = None
+            scaled = None
+            if p.hpa:
+                scaled = _build_autoscaled_root(p, spec.annotations)
+                clients = {p.graph.name: scaled[0]}
+            svc = PredictorService(
+                p.graph, name=p.name, observer=observer, annotations=spec.annotations,
+                clients=clients,
+            )
+            if scaled is not None:
+                balanced, rs, make_autoscaler = scaled
+                asc = make_autoscaler(svc)
+                asc.start()  # spawns min_replicas synchronously, then loops
+                autoscalers.append(asc)
+                replicasets.append(rs)
+            if p.explainer:
+                _attach_explainer(svc, p.explainer)
+            if p.shadow:
+                shadows.append(svc)
+            else:
+                weighted.append((svc, p.traffic))
+    except BaseException:
+        # a later predictor failing must not leak earlier predictors'
+        # autoscaler threads / replica subprocesses
+        for asc in autoscalers:
+            asc.stop()
+        for rs in replicasets:
+            rs.stop_all()
+        raise
+    return Generation(
+        spec=spec,
+        gateway=Gateway(weighted, shadows=shadows),
+        plan=plan,
+        autoscalers=autoscalers,
+        replicasets=replicasets,
+    )
+
+
+def _build_autoscaled_root(p, annotations) -> Tuple[Any, Any, Any]:
+    """ReplicaSet + BalancedClient wiring for an hpa predictor.
+
+    The graph root runs as supervised out-of-process replicas behind a
+    BalancedClient (children still execute in this process's executor);
+    the returned factory builds the Autoscaler once the PredictorService
+    exists, sampling that predictor's own request counter as QPS — the
+    in-framework equivalent of the reference's HPA-on-pod-metrics
+    (reference: seldondeployment_controller.go:92-114).
+    """
+    import json
+
+    from seldon_core_tpu.controlplane.autoscaler import (
+        Autoscaler,
+        CounterRateSampler,
+        HpaSpec,
+        ReplicaSet,
+    )
+    from seldon_core_tpu.controlplane.supervisor import ProcessSpec
+    from seldon_core_tpu.engine.executor import build_client
+    from seldon_core_tpu.engine.graph import GRPC, Endpoint, UnitSpec
+    from seldon_core_tpu.engine.transport import BalancedClient
+    from seldon_core_tpu.engine.units import implementation_path
+
+    unit = p.graph
+    if unit.component_class:
+        component = unit.component_class
+    elif unit.implementation:
+        component = implementation_path(unit.implementation)
+    else:
+        raise DeploymentSpecError(
+            f"predictor {p.name!r} has hpa but its graph root has no "
+            "implementation/component_class to run out-of-process"
         )
-        if p.explainer:
-            _attach_explainer(svc, p.explainer)
-        if p.shadow:
-            shadows.append(svc)
-        else:
-            weighted.append((svc, p.traffic))
-    return Generation(spec=spec, gateway=Gateway(weighted, shadows=shadows), plan=plan)
+    try:
+        hpa = HpaSpec.from_dict(p.hpa)
+    except (ValueError, TypeError) as e:
+        raise DeploymentSpecError(f"predictor {p.name!r} hpa block invalid: {e}")
+
+    balanced = BalancedClient()
+
+    def on_change(specs):
+        clients = []
+        for s in specs:
+            remote = UnitSpec(
+                name=unit.name,
+                type=unit.type,
+                endpoint=Endpoint(host="127.0.0.1", port=s.grpc_port, transport=GRPC),
+            )
+            clients.append(build_client(remote, annotations))
+        balanced.set_clients(clients)
+
+    rs = ReplicaSet(
+        ProcessSpec(
+            name=f"{p.name}-{unit.name}",
+            component=component,
+            http_port=0,  # ReplicaSet assigns fresh ports per replica
+            grpc_port=0,
+            parameters_json=json.dumps(unit.parameters or []),
+            api="BOTH",
+        ),
+        on_change=on_change,
+    )
+
+    def make_autoscaler(svc: PredictorService) -> Autoscaler:
+        qps = CounterRateSampler(lambda: svc.stats.get("requests", 0))
+        return Autoscaler(rs, hpa, metric_fn=qps)
+
+    return balanced, rs, make_autoscaler
 
 
 def _attach_explainer(svc: PredictorService, config: Dict[str, Any]) -> None:
@@ -121,7 +231,9 @@ class Deployer:
         if fresh:
             managed = ManagedDeployment(spec.name)
 
-        new_gen = build_generation(spec, device_ids=self.device_ids)
+        # off the event loop: model loads and hpa replica spawns
+        # (ReplicaSet.wait_ready) can block for tens of seconds
+        new_gen = await asyncio.to_thread(build_generation, spec, self.device_ids)
         new_gen.generation = (managed.current.generation + 1) if managed.current else 1
 
         # readiness gate before any traffic shifts (reference: engine
@@ -130,6 +242,7 @@ class Deployer:
         while not await new_gen.gateway.ready():
             if time.monotonic() > deadline:
                 await new_gen.gateway.close()
+                await asyncio.to_thread(new_gen.stop_scaling)
                 raise TimeoutError(f"new generation of {spec.name!r} never became ready")
             await asyncio.sleep(0.1)
 
@@ -142,6 +255,7 @@ class Deployer:
                 for svc in gen.gateway.predictors:
                     await svc.drain(timeout_s=20.0)
                 await gen.gateway.close()
+                await asyncio.to_thread(gen.stop_scaling)
 
             asyncio.ensure_future(_drain(old))
         self.deployments[spec.name] = managed
@@ -158,6 +272,8 @@ class Deployer:
         if managed is None or managed.current is None:
             return False
         managed.current.gateway.pause()
+        # stop scaling before draining so the loop can't respawn replicas
+        await asyncio.to_thread(managed.current.stop_scaling)
         for svc in managed.current.gateway.predictors:
             await svc.drain(timeout_s=20.0)
         await managed.current.gateway.close()

@@ -58,6 +58,10 @@ class PredictorSpec:
     # (reference analogue: the Explainer CRD message,
     # proto/seldon_deployment.proto:45-51)
     explainer: Optional[Dict[str, Any]] = None
+    # autoscaling policy consumed by controlplane.autoscaler.HpaSpec
+    # (reference analogue: hpaSpec -> HorizontalPodAutoscaler,
+    # operator/controllers/seldondeployment_controller.go:92-114)
+    hpa: Optional[Dict[str, Any]] = None
 
     @classmethod
     def from_dict(cls, d: Dict[str, Any]) -> "PredictorSpec":
@@ -75,6 +79,7 @@ class PredictorSpec:
             device_ids=list(d.get("deviceIds", d.get("device_ids", []))),
             mesh_axes=d.get("meshAxes", d.get("mesh_axes")),
             explainer=d.get("explainer"),
+            hpa=d.get("hpa", d.get("hpaSpec")),
         )
 
     def to_dict(self) -> Dict[str, Any]:
@@ -94,6 +99,8 @@ class PredictorSpec:
             out["meshAxes"] = self.mesh_axes
         if self.explainer:
             out["explainer"] = self.explainer
+        if self.hpa:
+            out["hpa"] = self.hpa
         return out
 
 

@@ -139,15 +139,18 @@ class _DeploymentRawHandler:
         self._inner = GatewayRawHandler(gateway, loop)
 
     def __call__(self, method: str, path: str, body: bytes) -> Tuple[int, str, bytes]:
-        path = path.split("?", 1)[0]  # C++ lane forwards the query string
-        if method == "GET" and path == "/metrics":
+        # the C++ lane forwards the full target; match our GET endpoints
+        # on a stripped copy but pass the original through (the inner
+        # gateway handler reads ?predictor= / ?json= from the query)
+        bare = path.split("?", 1)[0]
+        if method == "GET" and bare == "/metrics":
             try:
                 from prometheus_client import CONTENT_TYPE_LATEST, generate_latest
 
                 return 200, CONTENT_TYPE_LATEST.split(";")[0], generate_latest()
             except Exception as e:  # noqa: BLE001
                 return 500, "text/plain", str(e).encode()
-        if method == "GET" and path == "/seldon.json":
+        if method == "GET" and bare == "/seldon.json":
             from seldon_core_tpu.runtime.openapi import gateway_openapi
 
             return 200, "application/json", json.dumps(gateway_openapi()).encode()

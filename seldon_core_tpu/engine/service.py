@@ -65,9 +65,12 @@ class PredictorService:
         log_responses: bool = False,
         request_logger: Optional[Callable[[InternalMessage, InternalMessage], None]] = None,
         annotations: Optional[Dict[str, str]] = None,
+        clients: Optional[Dict[str, Any]] = None,
     ):
         self.name = name
-        self.executor = GraphExecutor(graph, observer=observer, annotations=annotations)
+        self.executor = GraphExecutor(
+            graph, observer=observer, annotations=annotations, clients=clients
+        )
         self.graph = graph
         self._paused = False
         # threading (not asyncio) primitives: predict_sync runs on gRPC

@@ -181,6 +181,14 @@ class GrpcClient(NodeClient):
         except Exception:
             return False
 
+    async def close(self) -> None:
+        """Close and evict this endpoint's cached channel (replica
+        retirement: the address is never reused, so the cache entry
+        would otherwise leak forever)."""
+        chan = GrpcClient._channels.pop(self.addr, None)
+        if chan is not None:
+            await chan.close()
+
     @classmethod
     async def close_all(cls) -> None:
         for chan in cls._channels.values():
@@ -271,3 +279,111 @@ class RestClient(NodeClient):
     async def close(self) -> None:
         if self._session is not None and not self._session.closed:
             await self._session.close()
+
+
+class BalancedClient(NodeClient):
+    """Round-robin load balancer over replica clients of one node.
+
+    The role a k8s Service plays in front of an HPA-scaled Deployment in
+    the reference (reference:
+    operator/controllers/seldondeployment_controller.go:894-930): graph
+    edges hold one NodeClient while the replica set behind it grows and
+    shrinks.  ``set_clients`` swaps the replica list atomically (the
+    autoscaler calls it on every scale event); each call starts at the
+    next rotation slot and fails over to the remaining replicas before
+    surfacing the last error.
+    """
+
+    def __init__(self, clients: Optional[List[NodeClient]] = None):
+        import threading
+
+        self._clients: List[NodeClient] = list(clients or [])
+        self._retired: List[NodeClient] = []
+        self._rr = 0
+        self._lock = threading.Lock()
+
+    def set_clients(self, clients: List[NodeClient]) -> None:
+        """Swap the replica list; replaced clients are parked and closed
+        on the serving loop at the next call (their grpc.aio channels
+        are loop-bound, and this method runs on the autoscaler thread)."""
+        fresh = list(clients)
+        with self._lock:
+            keep = set(map(id, fresh))
+            self._retired.extend(c for c in self._clients if id(c) not in keep)
+            self._clients = fresh
+
+    async def _drain_retired(self) -> None:
+        with self._lock:
+            retired, self._retired = self._retired, []
+        for client in retired:
+            try:
+                await client.close()
+            except Exception as e:  # noqa: BLE001
+                logger.debug("closing retired replica client failed: %s", e)
+
+    @property
+    def replica_count(self) -> int:
+        with self._lock:
+            return len(self._clients)
+
+    def _rotation(self) -> List[NodeClient]:
+        with self._lock:
+            if not self._clients:
+                return []
+            start = self._rr % len(self._clients)
+            self._rr += 1
+            return self._clients[start:] + self._clients[:start]
+
+    async def _call(self, method: str, *args, failover: bool = True):
+        await self._drain_retired()
+        rotation = self._rotation()
+        if not rotation:
+            raise MicroserviceError(
+                "no replicas available", status_code=503, reason="NO_REPLICAS"
+            )
+        last: Optional[Exception] = None
+        for client in rotation:
+            try:
+                return await getattr(client, method)(*args)
+            except MicroserviceError as e:
+                # deterministic client errors (4xx) would fail identically
+                # on every replica — surface immediately
+                if e.status_code is not None and 400 <= e.status_code < 500:
+                    raise
+                last = e
+                if not failover:
+                    raise
+                logger.warning("replica call %s failed, failing over: %s", method, e)
+            except Exception as e:  # noqa: BLE001 — fail over to next replica
+                last = e
+                if not failover:
+                    raise
+                logger.warning("replica call %s failed, failing over: %s", method, e)
+        raise last  # type: ignore[misc]
+
+    async def transform_input(self, msg: InternalMessage) -> InternalMessage:
+        return await self._call("transform_input", msg)
+
+    async def transform_output(self, msg: InternalMessage) -> InternalMessage:
+        return await self._call("transform_output", msg)
+
+    async def route(self, msg: InternalMessage) -> InternalMessage:
+        return await self._call("route", msg)
+
+    async def aggregate(self, msgs: List[InternalMessage]) -> InternalMessage:
+        return await self._call("aggregate", msgs)
+
+    async def send_feedback(self, feedback: InternalFeedback) -> InternalMessage:
+        # not idempotent: a timeout after the reward was applied must not
+        # replay the same feedback on another replica (double-counting)
+        return await self._call("send_feedback", feedback, failover=False)
+
+    async def ready(self) -> bool:
+        for client in self._rotation():
+            if await client.ready():
+                return True
+        return False
+
+    async def close(self) -> None:
+        for client in self._rotation():
+            await client.close()

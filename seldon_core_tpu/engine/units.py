@@ -122,7 +122,7 @@ def _load_registrations() -> None:
             pass
 
 
-def make_builtin(name: str, **kwargs: Any) -> Any:
+def _lookup_builtin(name: str) -> Callable[..., Any]:
     factory = BUILTIN_IMPLEMENTATIONS.get(name.upper())
     if factory is None:
         _load_registrations()
@@ -131,4 +131,16 @@ def make_builtin(name: str, **kwargs: Any) -> Any:
         raise MicroserviceError(
             f"unknown builtin implementation {name!r}", status_code=400, reason="UNKNOWN_IMPLEMENTATION"
         )
-    return factory(**kwargs)
+    return factory
+
+
+def make_builtin(name: str, **kwargs: Any) -> Any:
+    return _lookup_builtin(name)(**kwargs)
+
+
+def implementation_path(name: str) -> str:
+    """Dotted module.Class path of a registered implementation — the
+    form the microservice CLI loads (used when an autoscaled node runs
+    its implementation out-of-process)."""
+    factory = _lookup_builtin(name)
+    return f"{factory.__module__}.{factory.__qualname__}"

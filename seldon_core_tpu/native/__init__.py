@@ -35,12 +35,36 @@ def _so_path() -> str:
                         "native", "libseldon_tpu_native.so")
 
 
+def _is_stale(so: str) -> bool:
+    """True when the .so is missing or older than any native source —
+    a stale artifact would load with a mismatched struct ABI."""
+    if not os.path.exists(so):
+        return True
+    so_mtime = os.path.getmtime(so)
+    src_dir = os.path.dirname(so)
+    for name in os.listdir(src_dir):
+        if name.endswith((".cc", ".h")) or name == "Makefile":
+            if os.path.getmtime(os.path.join(src_dir, name)) > so_mtime:
+                return True
+    return False
+
+
 def _try_build(so: str) -> None:
     makefile_dir = os.path.dirname(so)
     if not os.path.exists(os.path.join(makefile_dir, "Makefile")):
         return
+    # many microservice processes can start at once (ReplicaSet scale-up);
+    # serialize the build so nobody dlopens a half-written .so
+    lock_path = os.path.join(makefile_dir, ".build.lock")
     try:
-        subprocess.run(["make", "-C", makefile_dir], check=True, capture_output=True, timeout=120)
+        import fcntl
+
+        with open(lock_path, "w") as lock:
+            fcntl.flock(lock, fcntl.LOCK_EX)
+            if _is_stale(so):
+                subprocess.run(
+                    ["make", "-C", makefile_dir], check=True, capture_output=True, timeout=120
+                )
     except Exception as e:  # noqa: BLE001
         logger.debug("native build failed: %s", e)
 
@@ -51,10 +75,8 @@ def get_lib() -> Optional[ctypes.CDLL]:
         return _LIB
     _TRIED = True
     so = _so_path()
-    # Always invoke make (a no-op when up to date): a .so older than the
-    # sources would otherwise load with a stale ABI — e.g. an FsConfig
-    # missing bind_host — and misread every struct field after it.
-    _try_build(so)
+    if _is_stale(so):
+        _try_build(so)
     if not os.path.exists(so):
         return None
     try:
@@ -78,7 +100,10 @@ def get_lib() -> Optional[ctypes.CDLL]:
             ctypes.POINTER(ctypes.c_char_p), ctypes.POINTER(ctypes.c_int64), ctypes.c_int64,
             ctypes.c_int64, ctypes.c_int64, ctypes.c_char_p,
         ]
-        assert lib.native_abi_version() == 2, "stale libseldon_tpu_native.so: rebuild with `make -C native`"
+        if lib.native_abi_version() != 2:  # not assert: must survive python -O
+            raise RuntimeError(
+                "stale libseldon_tpu_native.so (ABI mismatch): rebuild with `make -C native`"
+            )
         _LIB = lib
         logger.info("native data-plane core loaded from %s", so)
     except Exception as e:  # noqa: BLE001

@@ -30,3 +30,7 @@ def rng():
     import numpy as np
 
     return np.random.default_rng(0)
+
+
+def pytest_configure(config):
+    config.addinivalue_line("markers", "e2e: full-stack tests spawning real processes/ports")

@@ -1,0 +1,295 @@
+"""Autoscaler: HPA semantics + replica-set e2e.
+
+Reference analogue: the operator's HPA creation/reconciliation
+(reference: operator/controllers/seldondeployment_controller.go:92-114,
+894-930) and k8s autoscaling/v2 algorithm behaviour (tolerance
+dead-band, ceil(current * ratio), scale-down stabilization window).
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from seldon_core_tpu.controlplane.autoscaler import (
+    Autoscaler,
+    CounterRateSampler,
+    HpaSpec,
+    ReplicaSet,
+    gateway_request_count,
+)
+
+
+class FakeReplicaSet:
+    def __init__(self, n=1):
+        self.replica_count = n
+        self.calls = []
+
+    def scale(self, n):
+        self.calls.append(n)
+        self.replica_count = n
+        return n
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 1000.0
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+def make(metric_value, *, current=1, clock=None, **hpa_kwargs):
+    hpa_kwargs.setdefault("target_qps_per_replica", 10.0)
+    hpa_kwargs.setdefault("scale_down_stabilization_s", 30.0)
+    rs = FakeReplicaSet(current)
+    metric = {"v": metric_value}
+    asc = Autoscaler(
+        rs,
+        HpaSpec(**hpa_kwargs),
+        metric_fn=lambda: metric["v"],
+        clock=clock or FakeClock(),
+    )
+    return asc, rs, metric
+
+
+class TestHpaAlgorithm:
+    def test_spec_validation(self):
+        with pytest.raises(ValueError):
+            HpaSpec(min_replicas=3, max_replicas=2, target_qps_per_replica=1)
+        with pytest.raises(ValueError):
+            HpaSpec()  # no target set
+        with pytest.raises(ValueError):
+            HpaSpec(target_qps_per_replica=1, target_inflight_per_replica=1)
+
+    def test_from_dict_accepts_reference_camelcase(self):
+        hpa = HpaSpec.from_dict(
+            {"minReplicas": 2, "maxReplicas": 8, "targetQps": 50.0}
+        )
+        assert (hpa.min_replicas, hpa.max_replicas, hpa.target) == (2, 8, 50.0)
+
+    def test_scale_up_is_immediate(self):
+        asc, rs, _ = make(35.0, current=1)  # 35 qps / 10 target -> 4
+        assert asc.evaluate_once() == 4
+        assert rs.calls == [4]
+
+    def test_tolerance_dead_band_holds_steady(self):
+        asc, rs, _ = make(21.0, current=2)  # ratio 1.05, within 10%
+        assert asc.evaluate_once() == 2
+        assert rs.calls == []
+
+    def test_max_clamp(self):
+        asc, rs, _ = make(1000.0, current=1, max_replicas=4)
+        assert asc.evaluate_once() == 4
+
+    def test_min_clamp_and_stabilized_scale_down(self):
+        clock = FakeClock()
+        asc, rs, metric = make(35.0, current=1, clock=clock)
+        asc.evaluate_once()
+        assert rs.replica_count == 4
+        # load vanishes: desired drops to min, but the window still
+        # remembers the high recommendation -> no immediate drain
+        metric["v"] = 0.0
+        clock.advance(5)
+        assert asc.evaluate_once() == 4
+        # window expires -> drains to min
+        clock.advance(31)
+        assert asc.evaluate_once() == 1
+        assert rs.calls == [4, 1]
+
+    def test_dip_does_not_drain_warm_replicas(self):
+        clock = FakeClock()
+        asc, rs, metric = make(35.0, current=1, clock=clock)
+        asc.evaluate_once()
+        metric["v"] = 2.0
+        clock.advance(5)
+        asc.evaluate_once()  # dip inside window: held at 4
+        metric["v"] = 38.0
+        clock.advance(5)
+        assert asc.evaluate_once() == 4  # recovered; never drained
+
+    def test_counter_rate_sampler(self):
+        clock = FakeClock()
+        count = {"v": 0}
+        rate = CounterRateSampler(lambda: count["v"], clock=clock)
+        assert rate() == 0.0  # first sample primes
+        count["v"] = 50
+        clock.advance(5)
+        assert rate() == pytest.approx(10.0)
+        clock.advance(5)
+        assert rate() == 0.0  # no new requests
+
+    def test_gateway_request_count_sums_predictors(self):
+        class Svc:
+            def __init__(self, n):
+                self.stats = {"requests": n}
+
+        class Gw:
+            predictors = [Svc(3), Svc(4)]
+
+        assert gateway_request_count(Gw())() == 7.0
+
+
+class TestBalancedClient:
+    def test_round_robin_and_failover(self):
+        import asyncio
+
+        from seldon_core_tpu.engine.transport import BalancedClient, NodeClient
+        from seldon_core_tpu.runtime.message import InternalMessage
+
+        class Ok(NodeClient):
+            def __init__(self, tag):
+                self.tag = tag
+                self.calls = 0
+
+            async def transform_input(self, msg):
+                self.calls += 1
+                return msg.with_payload(np.asarray([self.tag]))
+
+        class Broken(NodeClient):
+            async def transform_input(self, msg):
+                raise RuntimeError("replica down")
+
+        a, b = Ok(1), Ok(2)
+        bc = BalancedClient([a, Broken(), b])
+        msg = InternalMessage(payload=np.zeros(1))
+
+        async def drive():
+            return [float((await bc.transform_input(msg)).payload[0]) for _ in range(6)]
+
+        tags = asyncio.run(drive())
+        # every call lands on a healthy replica; both healthy ones serve
+        assert set(tags) == {1.0, 2.0}
+        assert a.calls + b.calls == 6
+
+    def test_empty_set_rejects(self):
+        import asyncio
+
+        from seldon_core_tpu.engine.transport import BalancedClient
+        from seldon_core_tpu.runtime.component import MicroserviceError
+        from seldon_core_tpu.runtime.message import InternalMessage
+
+        bc = BalancedClient([])
+        with pytest.raises(MicroserviceError):
+            asyncio.run(bc.transform_input(InternalMessage(payload=np.zeros(1))))
+
+
+@pytest.mark.e2e
+class TestReplicaSetE2E:
+    def test_load_ramp_scales_up_then_drains(self):
+        """Real processes: load ramp -> replicas rise -> idle -> drain
+        (the VERDICT round-2 acceptance scenario)."""
+        import urllib.request
+
+        from seldon_core_tpu.controlplane.supervisor import ProcessSpec
+
+        endpoints = []
+        rs = ReplicaSet(
+            ProcessSpec(
+                name="stub",
+                component="seldon_core_tpu.engine.units.StubModel",
+                http_port=0,
+                grpc_port=0,
+                api="REST",
+            ),
+            wait_ready_s=90.0,
+            on_change=lambda specs: endpoints.append([s.http_port for s in specs]),
+        )
+        load = {"v": 0.0}
+        hpa = HpaSpec(
+            min_replicas=1,
+            max_replicas=2,
+            target_qps_per_replica=10.0,
+            scale_down_stabilization_s=0.0,
+            poll_interval_s=0.1,
+        )
+        asc = Autoscaler(rs, hpa, metric_fn=lambda: load["v"])
+        try:
+            assert rs.scale(1) == 1
+            # ramp: 25 qps against a 10/replica target -> desired 2 (clamped)
+            load["v"] = 25.0
+            assert asc.evaluate_once() == 2
+            ports = [s.http_port for s in rs.specs]
+            assert len(ports) == 2
+            # both replicas actually serve traffic
+            for port in ports:
+                with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/health/ping", timeout=5
+                ) as resp:
+                    assert resp.status == 200
+            # idle -> drains back to min (stabilization window is 0)
+            load["v"] = 0.0
+            assert asc.evaluate_once() == 1
+            assert rs.replica_count == 1
+            assert endpoints[-1] and len(endpoints[-1]) == 1
+        finally:
+            asc.stop()
+            rs.stop_all()
+
+
+@pytest.mark.e2e
+class TestDeployerHpaIntegration:
+    def test_hpa_predictor_serves_via_replicas_and_cleans_up(self):
+        """A spec with an hpa block deploys the graph root as supervised
+        replica processes behind a BalancedClient; requests flow through
+        the remote replica; delete() stops the replica processes."""
+        import asyncio
+
+        from seldon_core_tpu.controlplane import Deployer, TpuDeployment
+        from seldon_core_tpu.runtime.message import InternalMessage
+
+        spec = TpuDeployment.from_dict(
+            {
+                "name": "hpa-e2e",
+                "predictors": [
+                    {
+                        "name": "main",
+                        "traffic": 100,
+                        "hpa": {
+                            "min_replicas": 1,
+                            "max_replicas": 2,
+                            "target_qps_per_replica": 1e9,  # never scales up
+                            "poll_interval_s": 30.0,
+                        },
+                        "graph": {
+                            "name": "stub",
+                            "type": "MODEL",
+                            "implementation": "SIMPLE_MODEL",
+                        },
+                    }
+                ],
+            }
+        )
+
+        async def scenario():
+            deployer = Deployer()
+            managed = await deployer.apply(spec, ready_timeout_s=90.0)
+            gen = managed.current
+            assert len(gen.replicasets) == 1 and len(gen.autoscalers) == 1
+            assert gen.replicasets[0].replica_count == 1
+            pids = [r.proc.pid for r in gen.replicasets[0]._replicas]
+            out = await managed.gateway.predict(
+                InternalMessage(payload=np.ones((1, 2)))
+            )
+            assert out.status is None or out.status.get("status") != "FAILURE"
+            assert out.payload is not None
+            await deployer.delete("hpa-e2e")
+            return pids
+
+        pids = asyncio.run(scenario())
+        # replica process must be gone after delete
+        import os
+        import time as _time
+
+        for pid in pids:
+            for _ in range(50):
+                try:
+                    os.kill(pid, 0)
+                except OSError:
+                    break
+                _time.sleep(0.1)
+            else:
+                raise AssertionError(f"replica pid {pid} still alive after delete")
