@@ -113,6 +113,10 @@ class ReplicaSet:
         self.on_change = on_change
         self._replicas: List[SupervisedProcess] = []
         self._lock = threading.Lock()
+        # serializes on_change deliveries; each delivery re-snapshots the
+        # replica list, so interleaved scale()/stop_all() calls can never
+        # leave the load balancer holding a stale (e.g. terminated) set
+        self._notify_lock = threading.Lock()
         self._serial = 0
 
     @property
@@ -170,8 +174,8 @@ class ReplicaSet:
             current = list(self._replicas)
         for sp in stopped:  # SIGTERM -> microservice drains in-flight work
             sp.stop()
-        if (started or stopped) and self.on_change:
-            self.on_change([r.spec for r in current])
+        if started or stopped:
+            self._notify()
         if started or stopped:
             logger.info(
                 "replicaset %s scaled to %d (+%d/-%d)",
@@ -180,6 +184,14 @@ class ReplicaSet:
         if spawn_error is not None:
             raise spawn_error
         return len(current)
+
+    def _notify(self) -> None:
+        if self.on_change is None:
+            return
+        with self._notify_lock:
+            with self._lock:
+                specs = [r.spec for r in self._replicas]
+            self.on_change(specs)
 
     def stop_all(self) -> None:
         self.scale(0)
@@ -247,7 +259,9 @@ class Autoscaler:
         self.hpa = hpa
         self.metric_fn = metric_fn
         self.clock = clock
-        self.history: List[ScaleDecision] = []
+        # bounded: one decision lands every poll interval for the life
+        # of the deployment
+        self.history: Any = __import__("collections").deque(maxlen=512)
         # (time, desired) recommendations inside the stabilization window
         self._recommendations: List[Tuple[float, int]] = []
         self._stop = threading.Event()

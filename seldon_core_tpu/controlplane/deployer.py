@@ -49,12 +49,26 @@ class Generation:
     # when the generation is drained/deleted
     autoscalers: List[Any] = field(default_factory=list)
     replicasets: List[Any] = field(default_factory=list)
+    # supervisor for `remote: true` graph nodes (DCN-edge workers)
+    supervisor: Optional[Any] = None
 
-    def stop_scaling(self) -> None:
+    def stop_loops(self) -> None:
+        """Stop the autoscaler reconcile loops only — call before a
+        drain so nothing respawns replicas, while the replica/worker
+        processes keep serving the in-flight requests being drained."""
         for asc in self.autoscalers:
             asc.stop()
+
+    def stop_processes(self) -> None:
+        """Tear down replica and DCN-worker processes — after drain."""
         for rs in self.replicasets:
             rs.stop_all()
+        if self.supervisor is not None:
+            self.supervisor.stop_all()
+
+    def stop_scaling(self) -> None:
+        self.stop_loops()
+        self.stop_processes()
 
 
 class ManagedDeployment:
@@ -75,13 +89,23 @@ class ManagedDeployment:
 
 def build_generation(spec: TpuDeployment, device_ids: Optional[List[int]] = None) -> Generation:
     """Webhook + placement + executor construction for one spec."""
+    import dataclasses
+
+    # per-generation copy: defaulting and remote-worker endpoint fills
+    # must not leak into the caller's spec object (rolling re-apply)
+    spec = dataclasses.replace(
+        spec,
+        predictors=[dataclasses.replace(p, graph=p.graph.clone()) for p in spec.predictors],
+    )
     spec = default_and_validate(spec)
     plan = plan_placement(spec, device_ids=device_ids)
     weighted: List[Tuple[PredictorService, float]] = []
     shadows: List[PredictorService] = []
     autoscalers: List[Any] = []
     replicasets: List[Any] = []
+    supervisor = None
     try:
+        supervisor = _spawn_remote_workers(spec)
         for p in spec.predictors:
             from seldon_core_tpu.utils.metrics import PrometheusObserver
 
@@ -97,10 +121,12 @@ def build_generation(spec: TpuDeployment, device_ids: Optional[List[int]] = None
             )
             if scaled is not None:
                 balanced, rs, make_autoscaler = scaled
+                # register the replica set before start(): a partial
+                # spawn failure must reach the cleanup handler below
+                replicasets.append(rs)
                 asc = make_autoscaler(svc)
                 asc.start()  # spawns min_replicas synchronously, then loops
                 autoscalers.append(asc)
-                replicasets.append(rs)
             if p.explainer:
                 _attach_explainer(svc, p.explainer)
             if p.shadow:
@@ -109,11 +135,13 @@ def build_generation(spec: TpuDeployment, device_ids: Optional[List[int]] = None
                 weighted.append((svc, p.traffic))
     except BaseException:
         # a later predictor failing must not leak earlier predictors'
-        # autoscaler threads / replica subprocesses
+        # autoscaler threads / replica or worker subprocesses
         for asc in autoscalers:
             asc.stop()
         for rs in replicasets:
             rs.stop_all()
+        if supervisor is not None:
+            supervisor.stop_all()
         raise
     return Generation(
         spec=spec,
@@ -121,7 +149,64 @@ def build_generation(spec: TpuDeployment, device_ids: Optional[List[int]] = None
         plan=plan,
         autoscalers=autoscalers,
         replicasets=replicasets,
+        supervisor=supervisor,
     )
+
+
+def _spawn_remote_workers(spec: TpuDeployment):
+    """Spawn a supervised microservice worker for every ``remote: true``
+    graph node and fill in its endpoint — process placement emitting
+    DCN edges (the reference analogue: the operator creating one
+    Deployment+Service per graph container and stitching the engine to
+    them over the pod network, seldondeployment_controller.go:268-494).
+
+    Returns the Supervisor owning the workers, or None if the spec has
+    no remote nodes.
+    """
+    import json
+
+    from seldon_core_tpu.controlplane.autoscaler import _free_port as free_port
+    from seldon_core_tpu.controlplane.supervisor import ProcessSpec, Supervisor
+    from seldon_core_tpu.engine.graph import GRPC, Endpoint
+    from seldon_core_tpu.engine.units import implementation_path
+
+    remote_units = [
+        (p, unit)
+        for p in spec.predictors
+        for unit in p.graph.walk()
+        if unit.remote and unit.endpoint is None
+    ]
+    if not remote_units:
+        return None
+
+    supervisor = Supervisor()
+    try:
+        for p, unit in remote_units:
+            if unit.component_class:
+                component = unit.component_class
+            elif unit.implementation:
+                component = implementation_path(unit.implementation)
+            else:
+                raise DeploymentSpecError(
+                    f"remote node {unit.name!r} has no implementation/"
+                    "component_class to run out-of-process"
+                )
+            grpc_port = free_port()
+            supervisor.add(
+                ProcessSpec(
+                    name=f"{spec.name}-{p.name}-{unit.name}",
+                    component=component,
+                    http_port=free_port(),
+                    grpc_port=grpc_port,
+                    parameters_json=json.dumps(unit.parameters or []),
+                    api="BOTH",
+                )
+            )
+            unit.endpoint = Endpoint(host="127.0.0.1", port=grpc_port, transport=GRPC)
+    except BaseException:
+        supervisor.stop_all()
+        raise
+    return supervisor
 
 
 def _build_autoscaled_root(p, annotations) -> Tuple[Any, Any, Any]:
@@ -252,10 +337,11 @@ class Deployer:
         if old is not None:
             # drain the old generation in the background
             async def _drain(gen: Generation):
+                await asyncio.to_thread(gen.stop_loops)
                 for svc in gen.gateway.predictors:
                     await svc.drain(timeout_s=20.0)
                 await gen.gateway.close()
-                await asyncio.to_thread(gen.stop_scaling)
+                await asyncio.to_thread(gen.stop_processes)
 
             asyncio.ensure_future(_drain(old))
         self.deployments[spec.name] = managed
@@ -272,11 +358,13 @@ class Deployer:
         if managed is None or managed.current is None:
             return False
         managed.current.gateway.pause()
-        # stop scaling before draining so the loop can't respawn replicas
-        await asyncio.to_thread(managed.current.stop_scaling)
+        # loops first (nothing respawns), processes only after the drain
+        # — killing workers before drain would fail every in-flight call
+        await asyncio.to_thread(managed.current.stop_loops)
         for svc in managed.current.gateway.predictors:
             await svc.drain(timeout_s=20.0)
         await managed.current.gateway.close()
+        await asyncio.to_thread(managed.current.stop_processes)
         managed.current = None
         return True
 
@@ -394,12 +482,22 @@ def main(argv: Optional[List[str]] = None) -> None:
         return
 
     async def _run():
+        import signal
+
         deployer = Deployer()
         await deployer.apply(spec)
         await serve_deployment(
             deployer, spec.name, host=args.host, http_port=args.http_port, grpc_port=args.grpc_port
         )
-        await asyncio.Event().wait()  # serve forever
+        # SIGTERM/SIGINT must tear the deployment down — supervised
+        # worker/replica processes are not children that die with us
+        stop = asyncio.Event()
+        loop = asyncio.get_running_loop()
+        for sig in (signal.SIGTERM, signal.SIGINT):
+            loop.add_signal_handler(sig, stop.set)
+        await stop.wait()
+        logger.info("shutting down deployment %s", spec.name)
+        await deployer.delete(spec.name)
 
     asyncio.run(_run())
 

@@ -91,11 +91,22 @@ def build_client(unit: UnitSpec, annotations: Optional[Dict[str, str]] = None) -
         except (KeyError, ValueError):
             return default_s
 
-    component = _instantiate_component(unit)
-    if component is not None:
-        if hasattr(component, "load"):
-            component.load()
-        return LocalClient(unit, component)
+    if not unit.remote:
+        # in-process beats remote — unless the node is declared remote,
+        # in which case implementation/component_class describe what the
+        # *worker process* runs, not something to instantiate here
+        component = _instantiate_component(unit)
+        if component is not None:
+            if hasattr(component, "load"):
+                component.load()
+            return LocalClient(unit, component)
+    elif unit.endpoint is None:
+        raise MicroserviceError(
+            f"node {unit.name!r} is remote but has no endpoint — deploy "
+            "through the control plane (it spawns the worker) or set one",
+            status_code=500,
+            reason="BAD_GRAPH",
+        )
     if unit.endpoint is not None:
         if unit.endpoint.transport == REST:
             try:

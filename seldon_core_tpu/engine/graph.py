@@ -80,6 +80,10 @@ class UnitSpec:
     # TPU placement hints consumed by the control plane
     device_ids: List[int] = field(default_factory=list)
     sharding: Optional[Dict[str, Any]] = None
+    # run this node out-of-process: the deployer spawns a supervised
+    # microservice worker and fills in `endpoint` (the DCN edge — the
+    # reference's engine->microservice pod-network hop)
+    remote: bool = False
 
     def node_methods(self) -> List[str]:
         if self.type == UNKNOWN_TYPE:
@@ -93,6 +97,26 @@ class UnitSpec:
         yield self
         for child in self.children:
             yield from child.walk()
+
+    def clone(self) -> "UnitSpec":
+        """Structural copy: fresh UnitSpec nodes, shared leaf values.
+
+        In-process ``component`` objects are shared by reference (they
+        may hold live device buffers); everything the control plane
+        mutates per generation (``endpoint`` fills for remote workers)
+        lands on the copy, so re-applying one spec object never bleeds
+        endpoints between generations.
+        """
+        import dataclasses
+
+        return dataclasses.replace(
+            self,
+            children=[c.clone() for c in self.children],
+            parameters=list(self.parameters),
+            device_ids=list(self.device_ids),
+            # endpoints are mutated by defaulting (port fill) — copy them
+            endpoint=dataclasses.replace(self.endpoint) if self.endpoint else None,
+        )
 
     @classmethod
     def from_dict(cls, d: Dict[str, Any]) -> "UnitSpec":
@@ -123,6 +147,7 @@ class UnitSpec:
             image=d.get("image", ""),
             device_ids=list(d.get("deviceIds", d.get("device_ids", []))),
             sharding=d.get("sharding"),
+            remote=bool(d.get("remote", False)),
         )
 
     def to_dict(self) -> Dict[str, Any]:
@@ -145,6 +170,8 @@ class UnitSpec:
             out["modelUri"] = self.model_uri
         if self.image:
             out["image"] = self.image
+        if self.remote:
+            out["remote"] = True
         if self.children:
             out["children"] = [c.to_dict() for c in self.children]
         return out

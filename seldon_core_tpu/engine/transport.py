@@ -385,5 +385,6 @@ class BalancedClient(NodeClient):
         return False
 
     async def close(self) -> None:
+        await self._drain_retired()
         for client in self._rotation():
             await client.close()

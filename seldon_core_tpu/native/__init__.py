@@ -49,24 +49,47 @@ def _is_stale(so: str) -> bool:
     return False
 
 
-def _try_build(so: str) -> None:
+def _build_if_stale(so: str) -> None:
+    """Must be called with the build lock held."""
+    if not _is_stale(so):
+        return
     makefile_dir = os.path.dirname(so)
     if not os.path.exists(os.path.join(makefile_dir, "Makefile")):
         return
-    # many microservice processes can start at once (ReplicaSet scale-up);
-    # serialize the build so nobody dlopens a half-written .so
-    lock_path = os.path.join(makefile_dir, ".build.lock")
     try:
-        import fcntl
-
-        with open(lock_path, "w") as lock:
-            fcntl.flock(lock, fcntl.LOCK_EX)
-            if _is_stale(so):
-                subprocess.run(
-                    ["make", "-C", makefile_dir], check=True, capture_output=True, timeout=120
-                )
+        subprocess.run(
+            ["make", "-C", makefile_dir], check=True, capture_output=True, timeout=120
+        )
     except Exception as e:  # noqa: BLE001
         logger.debug("native build failed: %s", e)
+
+
+class _BuildLock:
+    """flock serializing build AND load: many microservice processes can
+    start at once (ReplicaSet scale-up); an unlocked staleness fast-path
+    could see a half-linked .so with a fresh mtime and dlopen garbage,
+    so dlopen also happens under the lock."""
+
+    def __init__(self, so: str):
+        self._dir = os.path.dirname(so)
+        self._fh = None
+
+    def __enter__(self):
+        try:
+            import fcntl
+
+            self._fh = open(os.path.join(self._dir, ".build.lock"), "w")
+            fcntl.flock(self._fh, fcntl.LOCK_EX)
+        except Exception as e:  # noqa: BLE001 — e.g. read-only install dir
+            logger.debug("native build lock unavailable: %s", e)
+            self._fh = None
+        return self
+
+    def __exit__(self, *exc):
+        if self._fh is not None:
+            self._fh.close()  # releases the flock
+            self._fh = None
+        return False
 
 
 def get_lib() -> Optional[ctypes.CDLL]:
@@ -75,8 +98,13 @@ def get_lib() -> Optional[ctypes.CDLL]:
         return _LIB
     _TRIED = True
     so = _so_path()
-    if _is_stale(so):
-        _try_build(so)
+    with _BuildLock(so):
+        _LIB = _load(so)
+    return _LIB
+
+
+def _load(so: str) -> Optional[ctypes.CDLL]:
+    _build_if_stale(so)
     if not os.path.exists(so):
         return None
     try:
@@ -104,12 +132,11 @@ def get_lib() -> Optional[ctypes.CDLL]:
             raise RuntimeError(
                 "stale libseldon_tpu_native.so (ABI mismatch): rebuild with `make -C native`"
             )
-        _LIB = lib
         logger.info("native data-plane core loaded from %s", so)
+        return lib
     except Exception as e:  # noqa: BLE001
         logger.warning("failed to load native core: %s", e)
-        _LIB = None
-    return _LIB
+        return None
 
 
 def available() -> bool:
