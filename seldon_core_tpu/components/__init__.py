@@ -12,9 +12,16 @@ register_implementation("EPSILON_GREEDY", EpsilonGreedy)
 register_implementation("THOMPSON_SAMPLING", ThompsonSampling)
 
 try:  # detectors that need only numpy/jax register unconditionally
-    from seldon_core_tpu.components.outliers import MahalanobisDetector, VAEOutlierDetector  # noqa: F401
+    from seldon_core_tpu.components.outliers import (  # noqa: F401
+        IsolationForestDetector,
+        MahalanobisDetector,
+        Seq2SeqOutlierDetector,
+        VAEOutlierDetector,
+    )
 
     register_implementation("OUTLIER_MAHALANOBIS", MahalanobisDetector)
     register_implementation("OUTLIER_VAE", VAEOutlierDetector)
+    register_implementation("OUTLIER_ISOLATION_FOREST", IsolationForestDetector)
+    register_implementation("OUTLIER_SEQ2SEQ", Seq2SeqOutlierDetector)
 except ImportError:  # pragma: no cover
     pass

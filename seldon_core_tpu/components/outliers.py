@@ -127,12 +127,24 @@ class VAEOutlierDetector(TPUComponent):
             return optax.apply_updates(params, updates), opt_state, loss
 
         rng = jax.random.key(self.seed)
+        perm_rng = np.random.default_rng(self.seed)
         losses = []
         for epoch in range(epochs):
-            rng, step_rng = jax.random.split(rng)
-            batch = X[:batch_size]
-            self.params, opt_state, loss = train_step(self.params, opt_state, batch, step_rng)
-            losses.append(float(loss))
+            # full pass in minibatches — training must see every sequence,
+            # not just the first batch_size rows
+            order = perm_rng.permutation(len(X))
+            # full batches only: a ragged tail batch would retrace the
+            # jitted step with a new shape every epoch
+            bs = min(batch_size, len(X))
+            epoch_loss = 0.0
+            n_batches = 0
+            for start in range(0, len(X) - bs + 1, bs):
+                rng, step_rng = jax.random.split(rng)
+                batch = X[order[start:start + bs]]
+                self.params, opt_state, loss = train_step(self.params, opt_state, batch, step_rng)
+                epoch_loss += float(loss)
+                n_batches += 1
+            losses.append(epoch_loss / max(n_batches, 1))
         return losses
 
     def score(self, X) -> np.ndarray:
@@ -277,3 +289,376 @@ class MahalanobisDetector(TPUComponent):
             self.mean = np.asarray(state["mean"], dtype=np.float64)
             self.m2 = np.asarray(state["m2"], dtype=np.float64)
             self.total_outliers = int(state.get("total_outliers", 0))
+
+
+class IsolationForestDetector(TPUComponent):
+    """Isolation-forest outlier scoring (reference analogue:
+    components/outlier-detection/isolation-forest/CoreIsolationForest.py:8-120,
+    a pickled sklearn model).
+
+    Re-designed TPU-first instead of wrapping sklearn: ``fit`` builds
+    the random trees on host (tree construction is inherently
+    sequential) but packs every tree into flat arrays
+    (feature/threshold/child/size per node), so scoring is one jitted
+    level-synchronous traversal — rows x trees advance together through
+    ``lax.fori_loop`` with no Python recursion and a single device
+    launch per batch.
+
+    Score: the standard iForest anomaly score ``2^(-E[h(x)]/c(n))`` in
+    (0, 1]; rows with score > ``threshold`` flag as outliers (0.5 is
+    the classic "no structure" midpoint).
+    """
+
+    def __init__(
+        self,
+        n_trees: int = 100,
+        subsample: int = 256,
+        threshold: float = 0.6,
+        seed: int = 0,
+        **kwargs: Any,
+    ):
+        super().__init__(**kwargs)
+        self.n_trees = int(n_trees)
+        self.subsample = int(subsample)
+        self.threshold = float(threshold)
+        self.seed = int(seed)
+        # packed forest: (n_trees, max_nodes) arrays
+        self.features = None
+        self.thresholds = None
+        self.left = None
+        self.right = None
+        self.node_size = None
+        self.sample_size = 0
+        self._score_jit = None
+        self._last_scores = np.array([])
+        self._last_flags = np.array([], dtype=bool)
+        self._lock = threading.Lock()
+
+    # ---- training (host) --------------------------------------------------
+
+    def fit(self, X: np.ndarray) -> "IsolationForestDetector":
+        X = np.atleast_2d(np.asarray(X, dtype=np.float32))
+        n, d = X.shape
+        rng = np.random.default_rng(self.seed)
+        psi = min(self.subsample, n)
+        max_depth = int(np.ceil(np.log2(max(psi, 2))))
+        max_nodes = 2 ** (max_depth + 1) - 1
+
+        feats = np.zeros((self.n_trees, max_nodes), np.int32)
+        thresh = np.zeros((self.n_trees, max_nodes), np.float32)
+        left = np.full((self.n_trees, max_nodes), -1, np.int32)
+        right = np.full((self.n_trees, max_nodes), -1, np.int32)
+        sizes = np.zeros((self.n_trees, max_nodes), np.float32)
+
+        for t in range(self.n_trees):
+            sample = X[rng.choice(n, size=psi, replace=False)]
+            # iterative build: (node_index, rows, depth)
+            next_free = [1]  # node 0 is the root
+            stack = [(0, sample, 0)]
+            while stack:
+                node, rows, depth = stack.pop()
+                sizes[t, node] = len(rows)
+                spread = rows.max(axis=0) - rows.min(axis=0) if len(rows) else 0
+                if depth >= max_depth or len(rows) <= 1 or np.all(spread == 0):
+                    continue  # leaf: children stay -1
+                f = int(rng.integers(0, d))
+                lo, hi = rows[:, f].min(), rows[:, f].max()
+                if lo == hi:  # degenerate split axis; try the widest
+                    f = int(np.argmax(spread))
+                    lo, hi = rows[:, f].min(), rows[:, f].max()
+                s = float(rng.uniform(lo, hi))
+                mask = rows[:, f] < s
+                li, ri = next_free[0], next_free[0] + 1
+                next_free[0] += 2
+                feats[t, node], thresh[t, node] = f, s
+                left[t, node], right[t, node] = li, ri
+                stack.append((li, rows[mask], depth + 1))
+                stack.append((ri, rows[~mask], depth + 1))
+
+        with self._lock:
+            self.features, self.thresholds = feats, thresh
+            self.left, self.right, self.node_size = left, right, sizes
+            self.sample_size = psi
+            self._score_jit = None  # rebuilt lazily against new arrays
+        return self
+
+    # ---- scoring (device) -------------------------------------------------
+
+    @staticmethod
+    def _avg_path(n):
+        """c(n): average unsuccessful-search path length in a BST."""
+        import jax.numpy as jnp
+
+        n = jnp.maximum(n, 2.0)
+        harmonic = jnp.log(n - 1.0) + 0.5772156649
+        return 2.0 * harmonic - 2.0 * (n - 1.0) / n
+
+    def _build_score(self):
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+
+        feats = jnp.asarray(self.features)
+        thresh = jnp.asarray(self.thresholds)
+        left = jnp.asarray(self.left)
+        right = jnp.asarray(self.right)
+        sizes = jnp.asarray(self.node_size)
+        max_depth = int(np.ceil(np.log2(max(self.sample_size, 2))))
+        c_psi = float(np.asarray(self._avg_path(jnp.asarray(float(self.sample_size)))))
+
+        def score(X):
+            n_rows = X.shape[0]
+            n_trees = feats.shape[0]
+            # level-synchronous traversal: every (row, tree) pair walks
+            # one level per iteration — a fixed-trip-count loop XLA maps
+            # to pure gathers, no data-dependent control flow
+            node = jnp.zeros((n_rows, n_trees), jnp.int32)
+            depth = jnp.zeros((n_rows, n_trees), jnp.float32)
+
+            def step(_, carry):
+                node, depth = carry
+                f = jnp.take_along_axis(feats[None, :, :], node[:, :, None], axis=2)[:, :, 0]
+                s = jnp.take_along_axis(thresh[None, :, :], node[:, :, None], axis=2)[:, :, 0]
+                l = jnp.take_along_axis(left[None, :, :], node[:, :, None], axis=2)[:, :, 0]
+                r = jnp.take_along_axis(right[None, :, :], node[:, :, None], axis=2)[:, :, 0]
+                x_f = jnp.take_along_axis(X[:, None, :].repeat(n_trees, 1), f[:, :, None], axis=2)[:, :, 0]
+                is_leaf = l < 0
+                nxt = jnp.where(x_f < s, l, r)
+                node = jnp.where(is_leaf, node, nxt)
+                depth = jnp.where(is_leaf, depth, depth + 1.0)
+                return node, depth
+
+            node, depth = lax.fori_loop(0, max_depth + 1, step, (node, depth))
+            leaf_n = jnp.take_along_axis(sizes[None, :, :], node[:, :, None], axis=2)[:, :, 0]
+            # unresolved subtrees contribute the BST average path length
+            h = depth + jnp.where(leaf_n > 1.0, self._avg_path(leaf_n), 0.0)
+            return jnp.power(2.0, -jnp.mean(h, axis=1) / c_psi)
+
+        self._score_jit = jax.jit(score)
+
+    def score(self, X) -> np.ndarray:
+        X = np.atleast_2d(np.asarray(X, dtype=np.float32))
+        with self._lock:
+            if self.features is None:
+                raise RuntimeError("IsolationForestDetector.fit was never called")
+            if self._score_jit is None:
+                self._build_score()
+            score_jit = self._score_jit
+        scores = np.asarray(score_jit(X))
+        self._last_scores = scores
+        self._last_flags = scores > self.threshold
+        return scores
+
+    # ---- node-role surface ------------------------------------------------
+
+    def predict(self, X, names, meta=None):
+        return self.score(X).reshape(-1, 1)
+
+    def transform_input(self, X, names, meta=None):
+        self.score(X)
+        return X
+
+    def tags(self) -> Dict:
+        return {
+            "outlier": bool(self._last_flags.any()),
+            "outlier_count": int(self._last_flags.sum()),
+        }
+
+    def metrics(self) -> List[Dict]:
+        out = [gauge_metric("outlier_score_max", float(self._last_scores.max(initial=0.0)))]
+        flagged = int(self._last_flags.sum())
+        if flagged:
+            out.append(counter_metric("outliers_total", float(flagged)))
+        return out
+
+    def class_names(self):
+        return ["anomaly_score"]
+
+    # ---- persistence (explicit state, pickle-free) ------------------------
+
+    def checkpoint_state(self) -> Optional[Dict[str, Any]]:
+        with self._lock:
+            if self.features is None:
+                return None
+            return {
+                "features": self.features.copy(),
+                "thresholds": self.thresholds.copy(),
+                "left": self.left.copy(),
+                "right": self.right.copy(),
+                "node_size": self.node_size.copy(),
+                "sample_size": self.sample_size,
+            }
+
+    def restore_state(self, state: Dict[str, Any]) -> None:
+        with self._lock:
+            self.features = np.asarray(state["features"], np.int32)
+            self.thresholds = np.asarray(state["thresholds"], np.float32)
+            self.left = np.asarray(state["left"], np.int32)
+            self.right = np.asarray(state["right"], np.int32)
+            self.node_size = np.asarray(state["node_size"], np.float32)
+            self.sample_size = int(state["sample_size"])
+            self._score_jit = None
+
+
+class Seq2SeqOutlierDetector(TPUComponent):
+    """Sequence outlier detection via LSTM encoder-decoder
+    reconstruction (reference analogue:
+    components/outlier-detection/seq2seq-lstm/model.py:6-100 +
+    CoreSeq2SeqLSTM.py:10-200, a Keras bidirectional seq2seq decoded
+    step-by-step in Python).
+
+    TPU re-design: a flax ``nn.RNN``/LSTM encoder whose final carry
+    seeds the decoder, reconstructing the (teacher-forced, one-step
+    shifted) sequence in a single ``lax.scan`` — the whole score is one
+    XLA program, no per-timestep Python loop.  Score: per-sequence mean
+    squared reconstruction error; sequences above ``threshold`` flag as
+    outliers (the reference thresholds the same MSE, default 0.003).
+    """
+
+    def __init__(
+        self,
+        n_features: int = 0,
+        hidden_dim: int = 32,
+        threshold: float = 0.003,
+        model_uri: str = "",
+        seed: int = 0,
+        **kwargs: Any,
+    ):
+        super().__init__(**kwargs)
+        self.n_features = int(n_features)
+        self.hidden_dim = int(hidden_dim)
+        self.threshold = float(threshold)
+        self.model_uri = model_uri
+        self.seed = int(seed)
+        self.module = None
+        self.params = None
+        self._score_jit = None
+        self._last_scores = np.array([])
+        self._last_flags = np.array([], dtype=bool)
+
+    def _build(self, n_features: int):
+        import flax.linen as nn
+        import jax
+        import jax.numpy as jnp
+
+        hidden = self.hidden_dim
+
+        class Seq2Seq(nn.Module):
+            @nn.compact
+            def __call__(self, x):  # x: (batch, time, features)
+                enc = nn.RNN(nn.OptimizedLSTMCell(hidden), return_carry=True, name="encoder")
+                carry, _ = enc(x)
+                # teacher forcing: decoder sees the sequence shifted one
+                # step right (first input is zeros), seeded with the
+                # encoder's final state — reconstruction must come from
+                # the learned dynamics, not identity copying
+                shifted = jnp.concatenate([jnp.zeros_like(x[:, :1]), x[:, :-1]], axis=1)
+                dec = nn.RNN(nn.OptimizedLSTMCell(hidden), name="decoder")
+                hidden_seq = dec(shifted, initial_carry=carry)
+                return nn.Dense(n_features, name="out")(hidden_seq)
+
+        self.n_features = n_features
+        self.module = Seq2Seq()
+        self.params = self.module.init(
+            jax.random.key(self.seed), jnp.zeros((1, 2, n_features))
+        )
+
+        def score_fn(params, x):
+            recon = self.module.apply(params, x)
+            return jnp.mean((x - recon) ** 2, axis=(1, 2))
+
+        self._score_jit = jax.jit(score_fn)
+
+    def load(self) -> None:
+        if self.model_uri:
+            from flax import serialization
+
+            from seldon_core_tpu.utils import storage
+
+            if self.module is None:
+                if not self.n_features:
+                    raise ValueError("Seq2SeqOutlierDetector needs n_features with model_uri")
+                self._build(self.n_features)
+            path = storage.download(self.model_uri)
+            with open(path, "rb") as f:
+                self.params = serialization.from_bytes(self.params, f.read())
+
+    def fit(self, X: np.ndarray, epochs: int = 50, learning_rate: float = 1e-2,
+            batch_size: int = 64) -> List[float]:
+        """Train on normal sequences (n, time, features); returns losses."""
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        X = np.asarray(X, dtype=np.float32)
+        if X.ndim == 2:  # single-feature sequences (n, time)
+            X = X[:, :, None]
+        if self.module is None:
+            self._build(X.shape[2])
+        tx = optax.adam(learning_rate)
+        opt_state = tx.init(self.params)
+
+        @jax.jit
+        def train_step(params, opt_state, batch):
+            def loss_fn(p):
+                recon = self.module.apply(p, batch)
+                return jnp.mean((batch - recon) ** 2)
+
+            loss, grads = jax.value_and_grad(loss_fn)(params)
+            updates, opt_state2 = tx.update(grads, opt_state)
+            return optax.apply_updates(params, updates), opt_state2, loss
+
+        perm_rng = np.random.default_rng(self.seed)
+        losses = []
+        for _ in range(epochs):
+            order = perm_rng.permutation(len(X))
+            bs = min(batch_size, len(X))  # full batches only (no retrace)
+            epoch_loss, n_batches = 0.0, 0
+            for start in range(0, len(X) - bs + 1, bs):
+                self.params, opt_state, loss = train_step(
+                    self.params, opt_state, X[order[start:start + bs]]
+                )
+                epoch_loss += float(loss)
+                n_batches += 1
+            losses.append(epoch_loss / max(n_batches, 1))
+        return losses
+
+    def score(self, X) -> np.ndarray:
+        X = np.asarray(X, dtype=np.float32)
+        if X.ndim == 2:
+            X = X[:, :, None]
+        if self.module is None:
+            raise RuntimeError("Seq2SeqOutlierDetector needs fit() or model_uri before scoring")
+        scores = np.asarray(self._score_jit(self.params, X))
+        self._last_scores = scores
+        self._last_flags = scores > self.threshold
+        return scores
+
+    def predict(self, X, names, meta=None):
+        return self.score(X).reshape(-1, 1)
+
+    def transform_input(self, X, names, meta=None):
+        self.score(X)
+        return X
+
+    def tags(self) -> Dict:
+        return {
+            "outlier": bool(self._last_flags.any()),
+            "outlier_count": int(self._last_flags.sum()),
+        }
+
+    def metrics(self) -> List[Dict]:
+        out = [gauge_metric("outlier_score_max", float(self._last_scores.max(initial=0.0)))]
+        flagged = int(self._last_flags.sum())
+        if flagged:
+            out.append(counter_metric("outliers_total", float(flagged)))
+        return out
+
+    def class_names(self):
+        return ["reconstruction_error"]
+
+    def save(self, path: str) -> None:
+        from flax import serialization
+
+        with open(path, "wb") as f:
+            f.write(serialization.to_bytes(self.params))

@@ -229,3 +229,104 @@ class TestVAEOutlier:
         from seldon_core_tpu.engine.units import BUILTIN_IMPLEMENTATIONS
 
         assert "OUTLIER_VAE" in BUILTIN_IMPLEMENTATIONS
+
+
+class TestIsolationForest:
+    """Reference parity: isolation-forest detector
+    (components/outlier-detection/isolation-forest/CoreIsolationForest.py),
+    re-designed with packed trees + jitted level-synchronous traversal."""
+
+    def _fitted(self, threshold=0.6):
+        from seldon_core_tpu.components.outliers import IsolationForestDetector
+
+        rng = np.random.default_rng(0)
+        normal = rng.normal(size=(512, 3)).astype(np.float32)
+        det = IsolationForestDetector(n_trees=50, subsample=128, threshold=threshold, seed=1)
+        det.fit(normal)
+        return det, normal
+
+    def test_outliers_score_higher_and_flag(self):
+        det, normal = self._fitted()
+        inlier = det.score(normal[:32])
+        outlier = det.score(np.full((4, 3), 12.0, np.float32))
+        assert outlier.min() > inlier.mean() + 0.15
+        assert det.tags()["outlier"] is True
+        assert det.tags()["outlier_count"] == 4
+
+    def test_normal_data_not_flagged(self):
+        det, normal = self._fitted()
+        scores = det.score(normal[:64])
+        assert (scores < 0.6).mean() > 0.9
+        assert det.tags()["outlier_count"] <= 3
+
+    def test_dual_use_transformer(self):
+        det, normal = self._fitted()
+        X = normal[:8]
+        out = det.transform_input(X, [])
+        np.testing.assert_array_equal(out, X)
+        assert any(m["key"] == "outlier_score_max" for m in det.metrics())
+
+    def test_explicit_state_roundtrip(self):
+        from seldon_core_tpu.components.outliers import IsolationForestDetector
+
+        det, normal = self._fitted()
+        state = det.checkpoint_state()
+        assert state is not None and "features" in state  # pickle-free
+        clone = IsolationForestDetector()
+        clone.restore_state(state)
+        probe = np.concatenate([normal[:8], np.full((2, 3), 9.0, np.float32)])
+        np.testing.assert_allclose(clone.score(probe), det.score(probe), rtol=1e-5)
+
+    def test_unfitted_rejects(self):
+        from seldon_core_tpu.components.outliers import IsolationForestDetector
+
+        with pytest.raises(RuntimeError):
+            IsolationForestDetector().score(np.zeros((1, 2)))
+
+
+class TestSeq2SeqOutlier:
+    """Reference parity: seq2seq-LSTM detector
+    (components/outlier-detection/seq2seq-lstm/CoreSeq2SeqLSTM.py), as a
+    flax LSTM encoder-decoder scored in one XLA program."""
+
+    def _waves(self, n, t=24, rng=None):
+        rng = rng or np.random.default_rng(0)
+        phase = rng.uniform(0, 2 * np.pi, size=(n, 1))
+        steps = np.linspace(0, 4 * np.pi, t)[None, :]
+        return (np.sin(steps + phase) * 0.5 + 0.5).astype(np.float32)
+
+    def test_fit_and_detect_anomalous_sequences(self, tmp_path):
+        from seldon_core_tpu.components.outliers import Seq2SeqOutlierDetector
+
+        det = Seq2SeqOutlierDetector(hidden_dim=16, seed=0)
+        losses = det.fit(self._waves(64), epochs=200, learning_rate=5e-3)
+        assert losses[-1] < losses[0]
+
+        normal_scores = det.score(self._waves(8, rng=np.random.default_rng(7)))
+        noise = np.random.default_rng(3).uniform(size=(8, 24)).astype(np.float32)
+        noise_scores = det.score(noise)
+        assert noise_scores.mean() > normal_scores.mean() * 2
+
+        # threshold between the two -> flags exactly the anomalies
+        det.threshold = float((normal_scores.mean() + noise_scores.mean()) / 2)
+        det.score(noise)
+        assert det.tags()["outlier"] is True
+        det.score(self._waves(8, rng=np.random.default_rng(11)))
+        assert det.tags()["outlier_count"] <= 1
+
+        # params round-trip through flax serialization + model_uri
+        path = tmp_path / "seq2seq.msgpack"
+        det.save(str(path))
+        clone = Seq2SeqOutlierDetector(n_features=1, hidden_dim=16, model_uri=str(path))
+        clone.load()
+        np.testing.assert_allclose(clone.score(noise), noise_scores, rtol=1e-5)
+
+    def test_multifeature_and_3d_input(self):
+        from seldon_core_tpu.components.outliers import Seq2SeqOutlierDetector
+
+        rng = np.random.default_rng(0)
+        seqs = rng.normal(size=(16, 10, 3)).astype(np.float32) * 0.1
+        det = Seq2SeqOutlierDetector(hidden_dim=8, seed=0)
+        det.fit(seqs, epochs=3)
+        scores = det.predict(seqs[:4], [])
+        assert scores.shape == (4, 1)
