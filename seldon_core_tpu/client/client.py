@@ -51,6 +51,8 @@ class SeldonTpuClient:
         grpc_port: int = 5001,
         transport: str = "rest",  # rest | grpc
         timeout_s: float = 30.0,
+        channel_credentials=None,  # utils.tls.ChannelCredentials -> TLS
+        call_credentials=None,  # utils.tls.CallCredentials -> auth token
     ):
         if transport not in ("rest", "grpc"):
             raise ValueError("transport must be 'rest' or 'grpc'")
@@ -59,6 +61,8 @@ class SeldonTpuClient:
         self.grpc_port = grpc_port
         self.transport = transport
         self.timeout_s = timeout_s
+        self.channel_credentials = channel_credentials
+        self.call_credentials = call_credentials
         self._channel = None
         self._session = None
 
@@ -70,17 +74,42 @@ class SeldonTpuClient:
         from seldon_core_tpu.proto import services
 
         if self._channel is None:
-            self._channel = grpc.insecure_channel(f"{self.host}:{self.grpc_port}")
+            addr = f"{self.host}:{self.grpc_port}"
+            if self.channel_credentials is not None:
+                from seldon_core_tpu.utils.tls import grpc_channel_credentials
+
+                self._channel = grpc.secure_channel(
+                    addr, grpc_channel_credentials(self.channel_credentials)
+                )
+            else:
+                self._channel = grpc.insecure_channel(addr)
         call = services.unary_callable(self._channel, service, method)
-        return call(request_proto, timeout=self.timeout_s)
+        metadata = []
+        if self.call_credentials is not None and self.call_credentials.token:
+            metadata.append(("x-auth-token", self.call_credentials.token))
+        return call(request_proto, timeout=self.timeout_s, metadata=metadata or None)
 
     def _rest_post(self, path: str, body: Dict[str, Any]) -> Tuple[int, Dict[str, Any]]:
         import requests
 
         if self._session is None:
             self._session = requests.Session()
+        scheme = "http"
+        kwargs: Dict[str, Any] = {}
+        if self.channel_credentials is not None:
+            from seldon_core_tpu.utils.tls import requests_tls_kwargs
+
+            scheme = "https"
+            kwargs = requests_tls_kwargs(self.channel_credentials)
+        headers = {}
+        if self.call_credentials is not None and self.call_credentials.token:
+            headers["X-Auth-Token"] = self.call_credentials.token
         resp = self._session.post(
-            f"http://{self.host}:{self.http_port}{path}", json=body, timeout=self.timeout_s
+            f"{scheme}://{self.host}:{self.http_port}{path}",
+            json=body,
+            timeout=self.timeout_s,
+            headers=headers or None,
+            **kwargs,
         )
         try:
             return resp.status_code, resp.json()

@@ -30,8 +30,9 @@ import math
 import socket
 import threading
 import time
+from collections import deque
 from dataclasses import dataclass, field
-from typing import Any, Callable, Dict, List, Optional, Tuple
+from typing import Any, Callable, Deque, Dict, List, Optional, Tuple
 
 from seldon_core_tpu.controlplane.supervisor import ProcessSpec, SupervisedProcess
 
@@ -261,7 +262,7 @@ class Autoscaler:
         self.clock = clock
         # bounded: one decision lands every poll interval for the life
         # of the deployment
-        self.history: Any = __import__("collections").deque(maxlen=512)
+        self.history: Deque[ScaleDecision] = deque(maxlen=512)
         # (time, desired) recommendations inside the stabilization window
         self._recommendations: List[Tuple[float, int]] = []
         self._stop = threading.Event()

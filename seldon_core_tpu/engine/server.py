@@ -286,19 +286,21 @@ async def serve_gateway(
     grpc_port: int = 5001,
     max_message_bytes: int = 512 * 1024 * 1024,
     grpc_mode: str = "sync",  # sync (fast path, default) | aio
+    tls=None,  # utils.tls.TlsConfig — terminates TLS on both listeners
 ):
     """Start REST + gRPC front servers; returns (runner, GrpcServerHandle)."""
     from seldon_core_tpu.runtime import rest
+    from seldon_core_tpu.utils.tls import add_grpc_port
 
     app = build_gateway_app(gateway)
-    runner = await rest.serve(app, host=host, port=http_port)
+    runner = await rest.serve(app, host=host, port=http_port, tls=tls)
     if grpc_mode == "sync":
         from seldon_core_tpu.engine.sync_server import build_sync_seldon_server
 
         server = build_sync_seldon_server(
             gateway, asyncio.get_running_loop(), max_message_bytes=max_message_bytes
         )
-        server.add_insecure_port(f"{host}:{grpc_port}")
+        add_grpc_port(server, f"{host}:{grpc_port}", tls)
         server.start()
         return runner, GrpcServerHandle(server, is_aio=False)
     server = grpc.aio.server(
@@ -308,6 +310,6 @@ async def serve_gateway(
         ]
     )
     add_seldon_service(server, gateway)
-    server.add_insecure_port(f"{host}:{grpc_port}")
+    add_grpc_port(server, f"{host}:{grpc_port}", tls)
     await server.start()
     return runner, GrpcServerHandle(server, is_aio=True)

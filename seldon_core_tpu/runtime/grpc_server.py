@@ -108,8 +108,11 @@ async def serve(
     host: str = "0.0.0.0",
     unit_id: str = "",
     max_message_bytes: int = DEFAULT_MAX_MSG_BYTES,
+    tls=None,
 ) -> grpc.aio.Server:
+    from seldon_core_tpu.utils.tls import add_grpc_port
+
     server = build_server(user_model, unit_id, max_message_bytes)
-    server.add_insecure_port(f"{host}:{port}")
+    add_grpc_port(server, f"{host}:{port}", tls)
     await server.start()
     return server

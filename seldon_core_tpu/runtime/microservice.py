@@ -72,6 +72,13 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
     parser.add_argument("--persistence", action="store_true", help="periodically checkpoint component state")
     parser.add_argument("--persistence-dir", default=os.environ.get("PERSISTENCE_DIR", "/tmp/seldon-tpu-state"))
     parser.add_argument("--persistence-period-s", type=float, default=60.0)
+    parser.add_argument("--ssl-cert", default=os.environ.get("SELDON_TLS_CERT", ""),
+                        help="PEM certificate; enables TLS on REST and gRPC")
+    parser.add_argument("--ssl-key", default=os.environ.get("SELDON_TLS_KEY", ""))
+    parser.add_argument("--ssl-ca", default=os.environ.get("SELDON_TLS_CA", ""),
+                        help="peer-verification CA (with --ssl-require-client-auth: mTLS)")
+    parser.add_argument("--ssl-require-client-auth", action="store_true",
+                        default=os.environ.get("SELDON_TLS_REQUIRE_CLIENT_AUTH", "0") == "1")
     parser.add_argument("--tracing", action="store_true", default=bool(int(os.environ.get("TRACING", "0"))))
     parser.add_argument("--log-level", default=os.environ.get("SELDON_LOG_LEVEL", "INFO"))
     parser.add_argument(
@@ -91,19 +98,23 @@ async def run_servers(
     grpc_port: int = 5000,
     unit_id: str = "",
     shutdown_event: Optional[asyncio.Event] = None,
+    tls=None,
 ) -> None:
     """Serve until `shutdown_event` (or forever)."""
     from seldon_core_tpu.runtime import grpc_server, rest
 
     runner = None
     server = None
+    secure = " (TLS)" if tls is not None and tls.enabled else ""
     if api in ("REST", "BOTH"):
         app = rest.build_app(user_model, unit_id=unit_id)
-        runner = await rest.serve(app, host=host, port=http_port)
-        logger.info("REST serving on %s:%d", host, http_port)
+        runner = await rest.serve(app, host=host, port=http_port, tls=tls)
+        logger.info("REST serving on %s:%d%s", host, http_port, secure)
     if api in ("GRPC", "BOTH"):
-        server = await grpc_server.serve(user_model, port=grpc_port, host=host, unit_id=unit_id)
-        logger.info("gRPC serving on %s:%d", host, grpc_port)
+        server = await grpc_server.serve(
+            user_model, port=grpc_port, host=host, unit_id=unit_id, tls=tls
+        )
+        logger.info("gRPC serving on %s:%d%s", host, grpc_port, secure)
 
     if shutdown_event is None:
         shutdown_event = asyncio.Event()
@@ -149,6 +160,17 @@ def main(argv: Optional[List[str]] = None) -> None:
     if hasattr(user_model, "load"):
         user_model.load()
 
+    tls = None
+    if args.ssl_cert:
+        from seldon_core_tpu.utils.tls import TlsConfig
+
+        tls = TlsConfig(
+            cert_file=args.ssl_cert,
+            key_file=args.ssl_key,
+            ca_file=args.ssl_ca,
+            require_client_auth=args.ssl_require_client_auth,
+        )
+
     try:
         asyncio.run(
             run_servers(
@@ -158,6 +180,7 @@ def main(argv: Optional[List[str]] = None) -> None:
                 http_port=args.http_port,
                 grpc_port=args.grpc_port,
                 unit_id=args.unit_id,
+                tls=tls,
             )
         )
     finally:

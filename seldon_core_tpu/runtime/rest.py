@@ -150,10 +150,18 @@ def build_app(
     return app
 
 
-async def serve(app: web.Application, host: str = "0.0.0.0", port: int = 9000):
-    """Run an app until cancelled; returns the runner for cleanup."""
+async def serve(app: web.Application, host: str = "0.0.0.0", port: int = 9000, tls=None):
+    """Run an app until cancelled; returns the runner for cleanup.
+
+    ``tls`` is a utils.tls.TlsConfig; when set the listener terminates
+    HTTPS (same files as the gRPC lane)."""
     runner = web.AppRunner(app, access_log=None)
     await runner.setup()
-    site = web.TCPSite(runner, host, port)
+    ssl_context = None
+    if tls is not None and tls.enabled:
+        from seldon_core_tpu.utils.tls import server_ssl_context
+
+        ssl_context = server_ssl_context(tls)
+    site = web.TCPSite(runner, host, port, ssl_context=ssl_context)
     await site.start()
     return runner

@@ -27,6 +27,8 @@ import pytest
 from seldon_core_tpu.controlplane import Deployer, TpuDeployment
 from seldon_core_tpu.runtime.message import InternalMessage
 
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
 
 def _remote_child_spec(name: str) -> TpuDeployment:
     return TpuDeployment.from_dict(
@@ -197,7 +199,7 @@ class TestMultihostJaxDistributed:
                     "XLA_FLAGS": "--xla_force_host_platform_device_count=4",
                     # the worker runs from a tmp script path; the repo
                     # root is not implicitly importable there
-                    "PYTHONPATH": "/root/repo" + os.pathsep + env.get("PYTHONPATH", ""),
+                    "PYTHONPATH": REPO_ROOT + os.pathsep + env.get("PYTHONPATH", ""),
                 }
             )
             procs.append(
@@ -207,7 +209,7 @@ class TestMultihostJaxDistributed:
                     stdout=subprocess.PIPE,
                     stderr=subprocess.STDOUT,
                     text=True,
-                    cwd="/root/repo",
+                    cwd=REPO_ROOT,
                 )
             )
         outputs = []

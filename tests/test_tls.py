@@ -1,0 +1,250 @@
+"""TLS on servers and client SDK.
+
+Reference parity: SeldonChannelCredentials / SeldonCallCredentials
+(reference: python/seldon_core/seldon_client.py:34-67) and the
+operator-mounted cert secrets terminating TLS in engine/wrapper pods.
+A self-signed CA + server cert is minted per test run; the same files
+drive the REST (HTTPS) and gRPC (ssl_server_credentials) lanes.
+"""
+
+import asyncio
+import datetime
+import socket
+import threading
+
+import numpy as np
+import pytest
+
+from seldon_core_tpu.utils.tls import (
+    CallCredentials,
+    ChannelCredentials,
+    TlsConfig,
+)
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+@pytest.fixture(scope="module")
+def certs(tmp_path_factory):
+    """Self-signed CA -> server cert for CN=localhost (SAN 127.0.0.1)."""
+    from cryptography import x509
+    from cryptography.hazmat.primitives import hashes, serialization
+    from cryptography.hazmat.primitives.asymmetric import rsa
+    from cryptography.x509.oid import NameOID
+
+    d = tmp_path_factory.mktemp("certs")
+    now = datetime.datetime.now(datetime.timezone.utc)
+
+    def make_key():
+        return rsa.generate_private_key(public_exponent=65537, key_size=2048)
+
+    def write_key(key, path):
+        path.write_bytes(
+            key.private_bytes(
+                serialization.Encoding.PEM,
+                serialization.PrivateFormat.TraditionalOpenSSL,
+                serialization.NoEncryption(),
+            )
+        )
+
+    ca_key = make_key()
+    ca_name = x509.Name([x509.NameAttribute(NameOID.COMMON_NAME, "seldon-tpu-test-ca")])
+    ca_cert = (
+        x509.CertificateBuilder()
+        .subject_name(ca_name)
+        .issuer_name(ca_name)
+        .public_key(ca_key.public_key())
+        .serial_number(x509.random_serial_number())
+        .not_valid_before(now)
+        .not_valid_after(now + datetime.timedelta(days=1))
+        .add_extension(x509.BasicConstraints(ca=True, path_length=None), critical=True)
+        .sign(ca_key, hashes.SHA256())
+    )
+
+    def issue(cn, path_prefix):
+        key = make_key()
+        cert = (
+            x509.CertificateBuilder()
+            .subject_name(x509.Name([x509.NameAttribute(NameOID.COMMON_NAME, cn)]))
+            .issuer_name(ca_name)
+            .public_key(key.public_key())
+            .serial_number(x509.random_serial_number())
+            .not_valid_before(now)
+            .not_valid_after(now + datetime.timedelta(days=1))
+            .add_extension(
+                x509.SubjectAlternativeName(
+                    [x509.DNSName("localhost"), x509.IPAddress(__import__("ipaddress").ip_address("127.0.0.1"))]
+                ),
+                critical=False,
+            )
+            .sign(ca_key, hashes.SHA256())
+        )
+        (d / f"{path_prefix}.crt").write_bytes(cert.public_bytes(serialization.Encoding.PEM))
+        write_key(key, d / f"{path_prefix}.key")
+
+    (d / "ca.crt").write_bytes(ca_cert.public_bytes(serialization.Encoding.PEM))
+    issue("localhost", "server")
+    issue("seldon-client", "client")
+    return d
+
+
+class TestTlsConfig:
+    def test_cert_without_key_rejected(self, certs):
+        with pytest.raises(ValueError):
+            TlsConfig(cert_file=str(certs / "server.crt"))
+
+    def test_missing_file_rejected(self, certs):
+        with pytest.raises(FileNotFoundError):
+            TlsConfig(cert_file="/nope.crt", key_file=str(certs / "server.key"))
+
+    def test_from_env(self, certs):
+        env = {
+            "SELDON_TLS_CERT": str(certs / "server.crt"),
+            "SELDON_TLS_KEY": str(certs / "server.key"),
+            "SELDON_TLS_CA": str(certs / "ca.crt"),
+            "SELDON_TLS_REQUIRE_CLIENT_AUTH": "1",
+        }
+        cfg = TlsConfig.from_env(env)
+        assert cfg.enabled and cfg.require_client_auth
+        assert TlsConfig.from_env({}) is None
+
+
+@pytest.mark.e2e
+class TestTlsServing:
+    def _serve(self, tls, api="BOTH"):
+        """Run the microservice servers with TLS in a thread-backed loop."""
+        from seldon_core_tpu.engine.units import StubModel
+        from seldon_core_tpu.runtime.microservice import run_servers
+
+        http_port, grpc_port = _free_port(), _free_port()
+        loop = asyncio.new_event_loop()
+        stop = None
+        ready = threading.Event()
+        box = {}
+
+        def runner():
+            asyncio.set_event_loop(loop)
+
+            async def main():
+                box["stop"] = asyncio.Event()
+                ready.set()
+                await run_servers(
+                    StubModel(),
+                    api=api,
+                    host="127.0.0.1",
+                    http_port=http_port,
+                    grpc_port=grpc_port,
+                    shutdown_event=box["stop"],
+                    tls=tls,
+                )
+
+            loop.run_until_complete(main())
+
+        t = threading.Thread(target=runner, daemon=True)
+        t.start()
+        ready.wait(10)
+        # wait for the TLS port to accept
+        import time
+
+        for _ in range(100):
+            try:
+                with socket.create_connection(("127.0.0.1", http_port), timeout=0.5):
+                    break
+            except OSError:
+                time.sleep(0.1)
+
+        def shutdown():
+            loop.call_soon_threadsafe(box["stop"].set)
+            t.join(timeout=10)
+
+        return http_port, grpc_port, shutdown
+
+    def test_rest_and_grpc_over_tls(self, certs):
+        from seldon_core_tpu.client.client import SeldonTpuClient
+
+        tls = TlsConfig(cert_file=str(certs / "server.crt"), key_file=str(certs / "server.key"))
+        http_port, grpc_port, shutdown = self._serve(tls)
+        try:
+            creds = ChannelCredentials(root_certificates_file=str(certs / "ca.crt"))
+            rest = SeldonTpuClient(
+                host="localhost", http_port=http_port, transport="rest",
+                channel_credentials=creds,
+            )
+            out = rest.microservice("predict", np.ones((1, 2)))
+            assert out.success
+            np.testing.assert_allclose(np.asarray(out.data), [[0.9, 0.05, 0.05]])
+
+            grpc_client = SeldonTpuClient(
+                host="localhost", grpc_port=grpc_port, transport="grpc",
+                channel_credentials=creds,
+                call_credentials=CallCredentials(token="secret"),
+            )
+            out = grpc_client.microservice("predict", np.ones((1, 2)))
+            assert out.success
+            grpc_client.close()
+            rest.close()
+        finally:
+            shutdown()
+
+    def test_plaintext_client_rejected_by_tls_server(self, certs):
+        import requests
+
+        tls = TlsConfig(cert_file=str(certs / "server.crt"), key_file=str(certs / "server.key"))
+        http_port, _, shutdown = self._serve(tls, api="REST")
+        try:
+            with pytest.raises(requests.exceptions.ConnectionError):
+                requests.post(
+                    f"http://127.0.0.1:{http_port}/predict",
+                    json={"data": {"ndarray": [[1.0, 2.0]]}},
+                    timeout=5,
+                )
+        finally:
+            shutdown()
+
+    def test_mtls_requires_client_cert(self, certs):
+        from seldon_core_tpu.client.client import SeldonTpuClient
+
+        tls = TlsConfig(
+            cert_file=str(certs / "server.crt"),
+            key_file=str(certs / "server.key"),
+            ca_file=str(certs / "ca.crt"),
+            require_client_auth=True,
+        )
+        http_port, _, shutdown = self._serve(tls, api="REST")
+        try:
+            without_cert = SeldonTpuClient(
+                host="localhost", http_port=http_port, transport="rest",
+                channel_credentials=ChannelCredentials(
+                    root_certificates_file=str(certs / "ca.crt")
+                ),
+                timeout_s=5,
+            )
+            import requests
+
+            # TLS 1.3 reports the missing client cert post-handshake, so
+            # it can surface as SSLError or as an aborted connection
+            with pytest.raises(
+                (requests.exceptions.SSLError, requests.exceptions.ConnectionError)
+            ):
+                without_cert.microservice("predict", np.ones((1, 2)))
+
+            with_cert = SeldonTpuClient(
+                host="localhost", http_port=http_port, transport="rest",
+                channel_credentials=ChannelCredentials(
+                    root_certificates_file=str(certs / "ca.crt"),
+                    certificate_chain_file=str(certs / "client.crt"),
+                    private_key_file=str(certs / "client.key"),
+                ),
+            )
+            out = with_cert.microservice("predict", np.ones((1, 2)))
+            assert out.success
+            with_cert.close()
+            without_cert.close()
+        finally:
+            shutdown()
