@@ -1,0 +1,214 @@
+// Minimal non-Python graph node: wire-conformance proof.
+//
+// The reference demonstrates language-neutral wrappers with a Go model
+// server speaking the SeldonMessage contract
+// (reference: examples/wrappers/go/server.go:1-165).  This is the same
+// demonstration for the TPU framework in dependency-free C++: a tiny
+// HTTP/1.1 server implementing the REST node dialect —
+//
+//   POST /predict, /transform-input : JSON SeldonMessage in/out
+//   GET  /health/ping               : readiness probe
+//
+// The model doubles every value of the ndarray payload and names the
+// response, so a test can prove the bytes really travelled through this
+// process.  Build: `make -C native remote_node`; run:
+// `./remote_node <port>`; join a graph with
+//   {"name": "cpp", "type": "MODEL",
+//    "endpoint": {"host": "127.0.0.1", "port": N, "transport": "REST"}}
+//
+// Single-threaded blocking loop on purpose — this is a conformance
+// fixture, not a production server (that is frontserver.cc's job).
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <cctype>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <vector>
+
+namespace {
+
+// ---- micro JSON: extract the "ndarray" nested array & double it -----------
+
+// Parses a JSON value starting at s[i], appending the doubled rendering
+// to out.  Numbers are doubled; arrays/nesting preserved.  Anything
+// else (strings, null, bool) is copied through verbatim.
+bool double_value(const std::string& s, size_t& i, std::string& out);
+
+void skip_ws(const std::string& s, size_t& i) {
+  while (i < s.size() && std::isspace(static_cast<unsigned char>(s[i]))) i++;
+}
+
+bool double_number(const std::string& s, size_t& i, std::string& out) {
+  size_t start = i;
+  if (i < s.size() && (s[i] == '-' || s[i] == '+')) i++;
+  while (i < s.size() &&
+         (std::isdigit(static_cast<unsigned char>(s[i])) || s[i] == '.' ||
+          s[i] == 'e' || s[i] == 'E' || s[i] == '-' || s[i] == '+'))
+    i++;
+  if (i == start) return false;
+  double v = std::strtod(s.c_str() + start, nullptr);
+  char buf[64];
+  std::snprintf(buf, sizeof(buf), "%.12g", v * 2.0);
+  out += buf;
+  return true;
+}
+
+bool double_value(const std::string& s, size_t& i, std::string& out) {
+  skip_ws(s, i);
+  if (i >= s.size()) return false;
+  if (s[i] == '[') {
+    out += '[';
+    i++;
+    skip_ws(s, i);
+    if (i < s.size() && s[i] == ']') {
+      out += ']';
+      i++;
+      return true;
+    }
+    while (i < s.size()) {
+      if (!double_value(s, i, out)) return false;
+      skip_ws(s, i);
+      if (i < s.size() && s[i] == ',') {
+        out += ',';
+        i++;
+        continue;
+      }
+      if (i < s.size() && s[i] == ']') {
+        out += ']';
+        i++;
+        return true;
+      }
+      return false;
+    }
+    return false;
+  }
+  return double_number(s, i, out);
+}
+
+// Finds "ndarray" in the request body; returns the doubled array JSON
+// or empty on failure.
+std::string doubled_ndarray(const std::string& body) {
+  size_t key = body.find("\"ndarray\"");
+  if (key == std::string::npos) return "";
+  size_t i = body.find(':', key);
+  if (i == std::string::npos) return "";
+  i++;
+  std::string out;
+  if (!double_value(body, i, out)) return "";
+  return out;
+}
+
+// Extracts meta.puid (flat scan for "puid":"...") so the engine's
+// request id survives the hop.
+std::string extract_puid(const std::string& body) {
+  size_t key = body.find("\"puid\"");
+  if (key == std::string::npos) return "";
+  size_t q1 = body.find('"', body.find(':', key) + 1);
+  if (q1 == std::string::npos) return "";
+  size_t q2 = body.find('"', q1 + 1);
+  if (q2 == std::string::npos) return "";
+  return body.substr(q1 + 1, q2 - q1 - 1);
+}
+
+// ---- HTTP plumbing ---------------------------------------------------------
+
+void respond(int fd, int code, const char* status, const std::string& body) {
+  char head[256];
+  int n = std::snprintf(head, sizeof(head),
+                        "HTTP/1.1 %d %s\r\nContent-Type: application/json\r\n"
+                        "Content-Length: %zu\r\nConnection: close\r\n\r\n",
+                        code, status, body.size());
+  (void)!write(fd, head, n);
+  (void)!write(fd, body.data(), body.size());
+}
+
+void handle(int fd) {
+  std::string req;
+  char buf[4096];
+  size_t content_len = 0;
+  size_t header_end = std::string::npos;
+  for (;;) {
+    ssize_t n = read(fd, buf, sizeof(buf));
+    if (n <= 0) break;
+    req.append(buf, n);
+    if (header_end == std::string::npos) {
+      header_end = req.find("\r\n\r\n");
+      if (header_end != std::string::npos) {
+        size_t cl = req.find("Content-Length:");
+        if (cl == std::string::npos) cl = req.find("content-length:");
+        if (cl != std::string::npos && cl < header_end)
+          content_len = std::strtoul(req.c_str() + cl + 15, nullptr, 10);
+      }
+    }
+    if (header_end != std::string::npos &&
+        req.size() >= header_end + 4 + content_len)
+      break;
+  }
+  if (header_end == std::string::npos) {
+    close(fd);
+    return;
+  }
+  bool is_ping = req.compare(0, 4, "GET ") == 0 &&
+                 req.find("/health/ping") != std::string::npos;
+  bool is_predict =
+      req.compare(0, 5, "POST ") == 0 &&
+      (req.compare(5, 8, "/predict") == 0 ||
+       req.compare(5, 16, "/transform-input") == 0);
+  if (is_ping) {
+    respond(fd, 200, "OK", "{\"status\":\"ok\"}");
+  } else if (is_predict) {
+    std::string body = req.substr(header_end + 4);
+    std::string arr = doubled_ndarray(body);
+    if (arr.empty()) {
+      respond(fd, 400, "Bad Request",
+              "{\"status\":{\"status\":\"FAILURE\",\"code\":400,"
+              "\"reason\":\"NO_NDARRAY\",\"info\":\"cpp node needs data.ndarray\"}}");
+    } else {
+      std::string puid = extract_puid(body);
+      std::string out = "{\"meta\":{\"puid\":\"" + puid +
+                        "\",\"tags\":{\"wrapper\":\"cpp\"}},"
+                        "\"data\":{\"names\":[\"doubled\"],\"ndarray\":" +
+                        arr + "}}";
+      respond(fd, 200, "OK", out);
+    }
+  } else {
+    respond(fd, 404, "Not Found", "{\"status\":{\"status\":\"FAILURE\",\"code\":404}}");
+  }
+  close(fd);
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  int port = argc > 1 ? std::atoi(argv[1]) : 10000;
+  int srv = socket(AF_INET, SOCK_STREAM, 0);
+  int one = 1;
+  setsockopt(srv, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (bind(srv, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0) {
+    std::perror("bind");
+    return 1;
+  }
+  if (listen(srv, 16) != 0) {
+    std::perror("listen");
+    return 1;
+  }
+  socklen_t len = sizeof(addr);
+  getsockname(srv, reinterpret_cast<sockaddr*>(&addr), &len);
+  std::printf("cpp remote node listening on %d\n", ntohs(addr.sin_port));
+  std::fflush(stdout);
+  for (;;) {
+    int fd = accept(srv, nullptr, nullptr);
+    if (fd < 0) continue;
+    handle(fd);
+  }
+}

@@ -35,17 +35,22 @@ def _so_path() -> str:
                         "native", "libseldon_tpu_native.so")
 
 
+# the shared library's inputs (keep in sync with SRCS in native/Makefile;
+# other .cc files there — e.g. remote_node.cc — build separate binaries)
+_LIB_SOURCES = ("codec.cc", "frontserver.cc", "Makefile")
+
+
 def _is_stale(so: str) -> bool:
-    """True when the .so is missing or older than any native source —
+    """True when the .so is missing or older than one of its sources —
     a stale artifact would load with a mismatched struct ABI."""
     if not os.path.exists(so):
         return True
     so_mtime = os.path.getmtime(so)
     src_dir = os.path.dirname(so)
-    for name in os.listdir(src_dir):
-        if name.endswith((".cc", ".h")) or name == "Makefile":
-            if os.path.getmtime(os.path.join(src_dir, name)) > so_mtime:
-                return True
+    for name in _LIB_SOURCES:
+        path = os.path.join(src_dir, name)
+        if os.path.exists(path) and os.path.getmtime(path) > so_mtime:
+            return True
     return False
 
 

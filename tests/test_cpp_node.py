@@ -1,0 +1,125 @@
+"""Wire conformance: a non-Python node joins an inference graph.
+
+The reference proves its wrappers are language-neutral with a Go model
+server speaking the SeldonMessage contract
+(reference: examples/wrappers/go/server.go:1-165, wrappers/s2i/nodejs/
+microservice.js:1-50).  Here the same proof for the TPU framework: the
+dependency-free C++ node in native/remote_node.cc serves the REST node
+dialect and a deployment's graph calls it through the ordinary
+RestClient edge — the engine cannot tell it isn't Python.
+"""
+
+import asyncio
+import os
+import re
+import shutil
+import subprocess
+import time
+
+import numpy as np
+import pytest
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+NATIVE_DIR = os.path.join(REPO_ROOT, "native")
+BINARY = os.path.join(NATIVE_DIR, "remote_node")
+
+
+@pytest.fixture(scope="module")
+def cpp_node():
+    if shutil.which("g++") is None and not os.path.exists(BINARY):
+        pytest.skip("no g++ toolchain and no prebuilt remote_node")
+    subprocess.run(["make", "-C", NATIVE_DIR, "remote_node"], check=True, capture_output=True)
+    proc = subprocess.Popen(
+        [BINARY, "0"], stdout=subprocess.PIPE, text=True, bufsize=1
+    )
+    line = proc.stdout.readline()
+    port = int(re.search(r"listening on (\d+)", line).group(1))
+    # readiness: the probe endpoint answers
+    import urllib.request
+
+    for _ in range(50):
+        try:
+            with urllib.request.urlopen(f"http://127.0.0.1:{port}/health/ping", timeout=1):
+                break
+        except OSError:
+            time.sleep(0.05)
+    yield port
+    proc.kill()
+    proc.wait()
+
+
+@pytest.mark.e2e
+class TestCppNodeConformance:
+    def test_direct_node_dialect(self, cpp_node):
+        """The node speaks the microservice REST dialect the Python
+        wrapper serves: SeldonMessage JSON in, SeldonMessage JSON out."""
+        from seldon_core_tpu.client.client import SeldonTpuClient
+
+        client = SeldonTpuClient(http_port=cpp_node, transport="rest")
+        out = client.microservice(
+            "predict", np.asarray([[1.0, 2.5, -3.0]]), payload_kind="ndarray"
+        )
+        assert out.success
+        np.testing.assert_allclose(np.asarray(out.data, dtype=float), [[2.0, 5.0, -6.0]])
+        assert out.response.names == ["doubled"]
+        assert out.meta.tags.get("wrapper") == "cpp"
+        client.close()
+
+    def test_joins_graph_as_remote_model(self, cpp_node):
+        """Deployment whose graph root is the C++ process: the engine's
+        RestClient edge carries the request there and back."""
+        from seldon_core_tpu.controlplane import Deployer, TpuDeployment
+        from seldon_core_tpu.runtime.message import InternalMessage
+
+        spec = TpuDeployment.from_dict(
+            {
+                "name": "cpp-graph",
+                "predictors": [
+                    {
+                        "name": "main",
+                        "traffic": 100,
+                        "graph": {
+                            "name": "cpp-model",
+                            "type": "MODEL",
+                            "image": "native/remote_node.cc",
+                            "endpoint": {
+                                "host": "127.0.0.1",
+                                "port": cpp_node,
+                                "transport": "REST",
+                            },
+                        },
+                    }
+                ],
+            }
+        )
+
+        async def scenario():
+            deployer = Deployer()
+            managed = await deployer.apply(spec, ready_timeout_s=30.0)
+            msg = InternalMessage(payload=np.asarray([[4.0, -1.0]]), kind="ndarray")
+            out = await managed.gateway.predict(msg)
+            np.testing.assert_allclose(out.array(), [[8.0, -2.0]])
+            # the engine's puid survived the C++ hop
+            assert out.meta.puid == msg.meta.puid
+            assert out.meta.tags.get("wrapper") == "cpp"
+            await deployer.delete("cpp-graph")
+
+        asyncio.run(scenario())
+
+    def test_malformed_payload_gets_seldon_failure(self, cpp_node):
+        """Protocol errors come back as SeldonMessage status, like the
+        Python wrapper's error contract."""
+        import json
+        import urllib.error
+        import urllib.request
+
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{cpp_node}/predict",
+            data=json.dumps({"strData": "no tensor here"}).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with pytest.raises(urllib.error.HTTPError) as err:
+            urllib.request.urlopen(req, timeout=5)
+        body = json.loads(err.value.read())
+        assert body["status"]["status"] == "FAILURE"
+        assert body["status"]["reason"] == "NO_NDARRAY"

@@ -1,0 +1,117 @@
+"""Sanitizer + fuzz tier for the native core (SURVEY §5.2).
+
+The reference backs its native data plane with race/sanitizer test
+tiers; here the C++ core gets the same treatment: the fuzz harness
+(tools/fuzz_native.py) runs in a subprocess against the
+AddressSanitizer build, and a concurrency exercise runs against the
+ThreadSanitizer build.  Sanitizer reports abort/annotate the subprocess,
+so the assertion is simply "exit 0 and no sanitizer output".
+"""
+
+import os
+import shutil
+import subprocess
+import sys
+
+import pytest
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+NATIVE_DIR = os.path.join(REPO_ROOT, "native")
+
+pytestmark = pytest.mark.e2e
+
+
+def _build(target: str, artifact: str) -> str:
+    if shutil.which("g++") is None:
+        pytest.skip("no g++ toolchain")
+    path = os.path.join(NATIVE_DIR, artifact)
+    res = subprocess.run(
+        ["make", "-C", NATIVE_DIR, target], capture_output=True, text=True, timeout=300
+    )
+    if res.returncode != 0 or not os.path.exists(path):
+        pytest.skip(f"sanitizer build unavailable: {res.stderr[-300:]}")
+    return path
+
+
+def _run(env_extra, code, timeout=300):
+    env = dict(os.environ)
+    env.update(env_extra)
+    return subprocess.run(
+        [sys.executable, "-c", code],
+        capture_output=True,
+        text=True,
+        timeout=timeout,
+        env=env,
+        cwd=REPO_ROOT,
+    )
+
+
+class TestAsanFuzz:
+    def test_codec_and_frontserver_fuzz_under_asan(self):
+        so = _build("asan", "libseldon_tpu_native_asan.so")
+        res = _run(
+            {
+                "SELDON_TPU_NATIVE_SO": so,
+                # asan runtime must be first in the link order for a
+                # python host process -> preload it
+                "LD_PRELOAD": subprocess.run(
+                    ["g++", "-print-file-name=libasan.so"],
+                    capture_output=True, text=True,
+                ).stdout.strip(),
+                "ASAN_OPTIONS": "detect_leaks=0,abort_on_error=1",
+            },
+            "import sys; from tools.fuzz_native import main; sys.exit(main(['--iterations', '600']))",
+        )
+        assert res.returncode == 0, f"fuzz failed:\n{res.stdout}\n{res.stderr[-2000:]}"
+        assert "AddressSanitizer" not in res.stderr, res.stderr[-2000:]
+        assert "survived" in res.stdout
+
+
+class TestTsanConcurrency:
+    def test_frontserver_concurrent_load_under_tsan(self):
+        so = _build("tsan", "libseldon_tpu_native_tsan.so")
+        code = """
+import json, threading, urllib.request
+from seldon_core_tpu.native.frontserver import NativeFrontServer
+
+def model(batch):
+    return batch[:, :1] * 2
+
+with NativeFrontServer(model_fn=model, feature_dim=2, out_dim=1, max_batch=16) as srv:
+    body = json.dumps({"data": {"tensor": {"shape": [1, 2], "values": [1.0, 2.0]}}}).encode()
+    errors = []
+    def hammer():
+        for _ in range(30):
+            try:
+                req = urllib.request.Request(
+                    f"http://127.0.0.1:{srv.port}/predict", data=body,
+                    headers={"Content-Type": "application/json"})
+                with urllib.request.urlopen(req, timeout=10) as resp:
+                    assert resp.status == 200
+            except Exception as e:
+                errors.append(e)
+    def control():
+        for _ in range(20):
+            srv.stats()
+            srv.set_ready(True)
+    threads = [threading.Thread(target=hammer) for _ in range(8)]
+    threads.append(threading.Thread(target=control))
+    for t in threads: t.start()
+    for t in threads: t.join()
+    assert not errors, errors[:3]
+print("tsan exercise done")
+"""
+        res = _run(
+            {
+                "SELDON_TPU_NATIVE_SO": so,
+                "LD_PRELOAD": subprocess.run(
+                    ["g++", "-print-file-name=libtsan.so"],
+                    capture_output=True, text=True,
+                ).stdout.strip(),
+                "TSAN_OPTIONS": "report_bugs=1,exitcode=66,history_size=4",
+            },
+            code,
+        )
+        assert res.returncode == 0, f"tsan run failed (rc={res.returncode}):\n{res.stdout}\n{res.stderr[-3000:]}"
+        assert "WARNING: ThreadSanitizer" not in res.stderr, res.stderr[-3000:]
+        assert "tsan exercise done" in res.stdout

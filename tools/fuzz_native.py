@@ -1,0 +1,138 @@
+"""Malformed-input fuzzing of the native C++ core (SURVEY §5.2).
+
+Drives the codec hot loops (b64, JSON number parsing, batch gather) and
+the front server's HTTP/protocol parser with adversarial inputs.  Run
+against a sanitizer build to turn silent memory bugs into reports:
+
+    make -C native asan
+    SELDON_TPU_NATIVE_SO=native/libseldon_tpu_native_asan.so \
+        python tools/fuzz_native.py --iterations 2000
+
+Exit code 0 = survived; any ASan report aborts the process (that is the
+point).  tests/test_sanitizers.py runs a budgeted version of this in CI
+fashion; the reference's equivalent is its Java/Go race and fuzz test
+tiers (SURVEY §5.2).
+"""
+
+from __future__ import annotations
+
+import argparse
+import random
+import socket
+import string
+import sys
+
+
+def fuzz_codecs(iterations: int, seed: int) -> int:
+    from seldon_core_tpu import native
+
+    if not native.available():
+        print("native library unavailable; nothing to fuzz", file=sys.stderr)
+        return 1
+    rng = random.Random(seed)
+    printable = string.printable
+    b64ish = string.ascii_letters + string.digits + "+/=\n\r "
+    for i in range(iterations):
+        n = rng.randrange(0, 512)
+        case = i % 4
+        if case == 0:  # arbitrary bytes into the b64 decoder
+            text = "".join(rng.choice(printable) for _ in range(n))
+        elif case == 1:  # base64 alphabet but wrong padding/length
+            text = "".join(rng.choice(b64ish) for _ in range(n))
+        elif case == 2:  # valid encode, then corrupt
+            raw = bytes(rng.randrange(256) for _ in range(n))
+            text = native.b64encode(raw)
+            if text:
+                pos = rng.randrange(len(text))
+                text = text[:pos] + rng.choice(printable) + text[pos + 1:]
+        else:  # truncation
+            raw = bytes(rng.randrange(256) for _ in range(n))
+            text = native.b64encode(raw)[: rng.randrange(0, max(n, 1))]
+        try:
+            native.b64decode(text)
+        except Exception:  # noqa: BLE001 — rejection is fine; crashing is not
+            pass
+
+        # JSON float-array parser: malformed numbers, nesting, junk
+        frags = ["[", "]", ",", "-", ".", "e", "E", "+", "1", "9", "0",
+                 "nan", "inf", "null", '"x"', "{", "}", " "]
+        text = "".join(rng.choice(frags) for _ in range(rng.randrange(0, 64)))
+        try:
+            native.parse_f64_array(text)
+        except Exception:  # noqa: BLE001
+            pass
+    print(f"codec fuzz: {iterations} iterations survived")
+    return 0
+
+
+def fuzz_frontserver(iterations: int, seed: int) -> int:
+    """Raw socket garbage at the front server's HTTP parser."""
+    from seldon_core_tpu.native.frontserver import NativeFrontServer
+
+    rng = random.Random(seed)
+    with NativeFrontServer(stub=True, feature_dim=4, out_dim=3) as srv:
+        for i in range(iterations):
+            kind = i % 5
+            if kind == 0:  # pure garbage
+                payload = bytes(rng.randrange(256) for _ in range(rng.randrange(1, 256)))
+            elif kind == 1:  # plausible request line, broken headers
+                payload = (
+                    b"POST /predict HTTP/1.1\r\nContent-Length: "
+                    + str(rng.randrange(-5, 1 << 32)).encode()
+                    + b"\r\n\r\n" + b"A" * rng.randrange(0, 64)
+                )
+            elif kind == 2:  # huge/negative lengths and truncated bodies
+                payload = (
+                    b"POST /predict HTTP/1.1\r\nContent-Length: 999999999\r\n\r\n{}"
+                )
+            elif kind == 3:  # header folding / missing terminator
+                payload = b"GET /metrics HTTP/1.1\r\nX-Junk: " + b"\xff" * 64
+            else:  # valid-ish JSON with broken tensor bodies
+                body = ('{"data":{"tensor":{"shape":[' +
+                        ",".join(str(rng.randrange(-4, 9)) for _ in range(3)) +
+                        '],"values":[' + "1," * rng.randrange(0, 8) + "}}}" )
+                payload = (
+                    b"POST /predict HTTP/1.1\r\nContent-Length: "
+                    + str(len(body)).encode() + b"\r\n\r\n" + body.encode()
+                )
+            try:
+                with socket.create_connection(("127.0.0.1", srv.port), timeout=1) as s:
+                    s.sendall(payload)
+                    s.settimeout(0.5)
+                    try:
+                        s.recv(4096)
+                    except OSError:
+                        pass
+            except OSError:
+                pass
+        # the server must still answer a well-formed request afterwards
+        import json
+        import urllib.request
+
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{srv.port}/predict",
+            data=json.dumps({"data": {"tensor": {"shape": [1, 4], "values": [1, 2, 3, 4]}}}).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=5) as resp:
+            assert resp.status == 200
+    print(f"frontserver fuzz: {iterations} iterations survived, server still sane")
+    return 0
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--iterations", type=int, default=2000)
+    parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--target", choices=("codecs", "frontserver", "all"), default="all")
+    args = parser.parse_args(argv)
+    rc = 0
+    if args.target in ("codecs", "all"):
+        rc |= fuzz_codecs(args.iterations, args.seed)
+    if args.target in ("frontserver", "all"):
+        rc |= fuzz_frontserver(max(args.iterations // 10, 50), args.seed)
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())
