@@ -1,0 +1,246 @@
+"""Cloud storage + credentials, exercised through mocked SDKs.
+
+The reference tests its storage lanes with mocked clients
+(reference: python/tests/test_s3_storage.py); same approach here since
+the environment is egress-free: fake boto3 / google.cloud.storage /
+azure.storage.blob modules are injected into sys.modules and the
+downloader's behaviour (listing, prefix-relative paths, credential
+plumbing) is asserted against them.
+"""
+
+import base64
+import sys
+import types
+
+import pytest
+
+from seldon_core_tpu.utils.credentials import (
+    AzureCredentials,
+    GcsCredentials,
+    S3Credentials,
+)
+
+
+class TestS3Credentials:
+    def test_from_env_reference_names(self):
+        env = {
+            "AWS_ACCESS_KEY_ID": "AK",
+            "AWS_SECRET_ACCESS_KEY": "SK",
+            "AWS_ENDPOINT_URL": "http://minio:9000",
+            "AWS_REGION": "us-east-1",
+            "USE_SSL": "0",
+        }
+        creds = S3Credentials.from_env(env)
+        kwargs = creds.client_kwargs()
+        assert kwargs == {
+            "aws_access_key_id": "AK",
+            "aws_secret_access_key": "SK",
+            "endpoint_url": "http://minio:9000",
+            "region_name": "us-east-1",
+            "use_ssl": False,
+        }
+
+    def test_from_secret_base64_values(self):
+        secret = {
+            "awsAccessKeyID": base64.b64encode(b"AK2").decode(),
+            "awsSecretAccessKey": base64.b64encode(b"SK2").decode(),
+            "s3Endpoint": "s3.example.com",
+        }
+        creds = S3Credentials.from_secret(secret)
+        assert creds.access_key == "AK2"
+        assert creds.secret_key == "SK2"
+        assert creds.endpoint == "s3.example.com"
+
+    def test_empty_env_omits_kwargs(self):
+        kwargs = S3Credentials.from_env({}).client_kwargs()
+        assert kwargs == {"use_ssl": True}
+
+
+class TestOtherCredentials:
+    def test_gcs_from_env(self):
+        creds = GcsCredentials.from_env({"GOOGLE_APPLICATION_CREDENTIALS": "/sa.json"})
+        assert creds.service_account_file == "/sa.json"
+
+    def test_azure_from_env(self):
+        creds = AzureCredentials.from_env(
+            {"AZURE_STORAGE_ACCOUNT": "acct", "AZURE_STORAGE_ACCESS_KEY": "key"}
+        )
+        assert creds.account_name == "acct" and creds.account_key == "key"
+
+
+@pytest.fixture
+def fake_s3(monkeypatch):
+    """boto3 stand-in recording calls and serving two objects."""
+    calls = {}
+
+    class FakeS3:
+        def list_objects_v2(self, Bucket, Prefix):
+            calls["list"] = (Bucket, Prefix)
+            return {
+                "Contents": [
+                    {"Key": f"{Prefix}/weights.msgpack"},
+                    {"Key": f"{Prefix}/sub/meta.json"},
+                ]
+            }
+
+        def download_file(self, bucket, key, dest):
+            calls.setdefault("downloads", []).append((bucket, key, dest))
+            with open(dest, "wb") as f:
+                f.write(b"data:" + key.encode())
+
+    fake = types.ModuleType("boto3")
+    fake.client = lambda service, **kwargs: calls.setdefault("client", (service, kwargs)) and FakeS3() or FakeS3()
+    monkeypatch.setitem(sys.modules, "boto3", fake)
+    return calls
+
+
+class TestS3Download:
+    def test_lists_downloads_and_plumbs_credentials(self, fake_s3, tmp_path, monkeypatch):
+        from seldon_core_tpu.utils import storage
+
+        monkeypatch.setenv("AWS_ACCESS_KEY_ID", "AK")
+        monkeypatch.setenv("AWS_SECRET_ACCESS_KEY", "SK")
+        monkeypatch.setenv("AWS_ENDPOINT_URL", "http://minio:9000")
+        out = storage.download("s3://models/resnet/v1", out_dir=str(tmp_path))
+        assert out == str(tmp_path)
+        assert fake_s3["list"] == ("models", "resnet/v1")
+        service, kwargs = fake_s3["client"]
+        assert service == "s3"
+        assert kwargs["aws_access_key_id"] == "AK"
+        assert kwargs["endpoint_url"] == "http://minio:9000"
+        # prefix-relative layout preserved
+        assert (tmp_path / "weights.msgpack").read_bytes() == b"data:resnet/v1/weights.msgpack"
+        assert (tmp_path / "sub" / "meta.json").exists()
+
+    def test_empty_bucket_raises(self, tmp_path, monkeypatch):
+        fake = types.ModuleType("boto3")
+
+        class Empty:
+            def list_objects_v2(self, Bucket, Prefix):
+                return {}
+
+        fake.client = lambda *a, **k: Empty()
+        monkeypatch.setitem(sys.modules, "boto3", fake)
+        from seldon_core_tpu.utils import storage
+
+        with pytest.raises(FileNotFoundError):
+            storage.download("s3://models/none", out_dir=str(tmp_path))
+
+
+@pytest.fixture
+def fake_gcs(monkeypatch):
+    calls = {}
+
+    class Blob:
+        def __init__(self, name):
+            self.name = name
+
+        def download_to_filename(self, dest):
+            calls.setdefault("downloads", []).append((self.name, dest))
+            with open(dest, "wb") as f:
+                f.write(b"gcs:" + self.name.encode())
+
+    class FakeClient:
+        def bucket(self, name):
+            calls["bucket"] = name
+            return name
+
+        def list_blobs(self, bucket, prefix):
+            calls["list"] = (bucket, prefix)
+            return [Blob(f"{prefix}/model.msgpack")]
+
+    gcloud = types.ModuleType("google.cloud")
+    gcs_mod = types.ModuleType("google.cloud.storage")
+    gcs_mod.Client = FakeClient
+    FakeClient.from_service_account_json = classmethod(
+        lambda cls, path: calls.setdefault("sa_file", path) and cls() or cls()
+    )
+    gcloud.storage = gcs_mod
+    monkeypatch.setitem(sys.modules, "google.cloud", gcloud)
+    monkeypatch.setitem(sys.modules, "google.cloud.storage", gcs_mod)
+    return calls
+
+
+class TestGcsDownload:
+    def test_downloads_with_service_account(self, fake_gcs, tmp_path, monkeypatch):
+        from seldon_core_tpu.utils import storage
+
+        monkeypatch.setenv("GOOGLE_APPLICATION_CREDENTIALS", "/sa.json")
+        out = storage.download("gs://bucket/models/m1", out_dir=str(tmp_path))
+        assert out == str(tmp_path)
+        assert fake_gcs["sa_file"] == "/sa.json"
+        assert fake_gcs["list"] == ("bucket", "models/m1")
+        assert (tmp_path / "model.msgpack").read_bytes() == b"gcs:models/m1/model.msgpack"
+
+
+@pytest.fixture
+def fake_azure(monkeypatch):
+    calls = {}
+
+    class Downloader:
+        def __init__(self, name):
+            self.name = name
+
+        def readinto(self, f):
+            f.write(b"az:" + self.name.encode())
+
+    class Container:
+        def list_blobs(self, name_starts_with):
+            calls["list"] = name_starts_with
+            return [types.SimpleNamespace(name=f"{name_starts_with}/weights.bin")]
+
+        def download_blob(self, name):
+            calls.setdefault("downloads", []).append(name)
+            return Downloader(name)
+
+    class FakeService:
+        def get_container_client(self, container):
+            calls["container"] = container
+            return Container()
+
+    def service_ctor(account_url=None, credential=None):
+        calls["account_url"] = account_url
+        calls["credential"] = credential
+        return FakeService()
+
+    az = types.ModuleType("azure")
+    az_storage = types.ModuleType("azure.storage")
+    az_blob = types.ModuleType("azure.storage.blob")
+    az_blob.BlobServiceClient = service_ctor
+    az_blob.BlobServiceClient.from_connection_string = lambda cs: calls.setdefault("cs", cs) and FakeService() or FakeService()
+    az_storage.blob = az_blob
+    az.storage = az_storage
+    monkeypatch.setitem(sys.modules, "azure", az)
+    monkeypatch.setitem(sys.modules, "azure.storage", az_storage)
+    monkeypatch.setitem(sys.modules, "azure.storage.blob", az_blob)
+    return calls
+
+
+class TestAzureDownload:
+    def test_azure_scheme(self, fake_azure, tmp_path, monkeypatch):
+        from seldon_core_tpu.utils import storage
+
+        monkeypatch.setenv("AZURE_STORAGE_ACCOUNT", "acct")
+        monkeypatch.setenv("AZURE_STORAGE_ACCESS_KEY", "key")
+        out = storage.download("azure://acct/container/models/m1", out_dir=str(tmp_path))
+        assert out == str(tmp_path)
+        assert fake_azure["account_url"] == "https://acct.blob.core.windows.net"
+        assert fake_azure["credential"] == "key"
+        assert fake_azure["container"] == "container"
+        assert fake_azure["list"] == "models/m1"
+        assert (tmp_path / "weights.bin").read_bytes() == b"az:models/m1/weights.bin"
+
+    def test_native_https_form(self, fake_azure, tmp_path):
+        from seldon_core_tpu.utils import storage
+
+        out = storage.download(
+            "https://acct.blob.core.windows.net/container/models/m2", out_dir=str(tmp_path)
+        )
+        assert out == str(tmp_path)
+        assert fake_azure["account_url"] == "https://acct.blob.core.windows.net"
+
+    def test_missing_container_rejected(self, fake_azure, tmp_path):
+        from seldon_core_tpu.utils import storage
+
+        with pytest.raises(ValueError):
+            storage.download("azure://acct", out_dir=str(tmp_path))
