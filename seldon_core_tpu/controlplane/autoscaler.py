@@ -132,6 +132,11 @@ class ReplicaSet:
 
     def _spawn_one(self) -> SupervisedProcess:
         self._serial += 1
+        # internal replicas speak plaintext to the engine's BalancedClient
+        # (TLS terminates at the external gateway); never inherit the
+        # operator's SELDON_TLS_* into a replica
+        env = {"SELDON_TLS_CERT": "", "SELDON_TLS_KEY": "", "SELDON_TLS_CA": ""}
+        env.update(self.base.env)
         spec = ProcessSpec(
             name=f"{self.base.name}-{self._serial}",
             component=self.base.component,
@@ -139,7 +144,7 @@ class ReplicaSet:
             grpc_port=_free_port(),
             parameters_json=self.base.parameters_json,
             api=self.base.api,
-            env=dict(self.base.env),
+            env=env,
             cwd=self.base.cwd,
         )
         sp = SupervisedProcess(spec)

@@ -200,6 +200,10 @@ def _spawn_remote_workers(spec: TpuDeployment):
                     grpc_port=grpc_port,
                     parameters_json=json.dumps(unit.parameters or []),
                     api="BOTH",
+                    # TLS terminates at the external gateway; internal DCN
+                    # edges dial plaintext (the reference's in-cluster
+                    # model), so workers must not inherit SELDON_TLS_*
+                    env={"SELDON_TLS_CERT": "", "SELDON_TLS_KEY": "", "SELDON_TLS_CA": ""},
                 )
             )
             unit.endpoint = Endpoint(host="127.0.0.1", port=grpc_port, transport=GRPC)
@@ -424,6 +428,28 @@ async def serve_deployment(
     if frontend is None:
         frontend = str(spec.annotations.get("seldon.io/frontend", "python")).lower()
 
+    # external TLS termination: annotations win, SELDON_TLS_* env is the
+    # operator-injected fallback (reference: cert secrets mounted into
+    # the engine pod).  Internal graph edges stay plaintext.
+    from seldon_core_tpu.utils.tls import TlsConfig
+
+    tls = None
+    cert = spec.annotations.get("seldon.io/tls-cert", "")
+    if cert or spec.annotations.get("seldon.io/tls-key"):
+        tls = TlsConfig(
+            cert_file=cert,
+            key_file=spec.annotations.get("seldon.io/tls-key", ""),
+            ca_file=spec.annotations.get("seldon.io/tls-ca", ""),
+            require_client_auth=spec.annotations.get("seldon.io/tls-require-client-auth") == "1",
+        )
+    else:
+        tls = TlsConfig.from_env()
+    if tls is not None and frontend == "native":
+        # the C++ ingress does not terminate TLS; honouring the TLS
+        # request matters more than the native fast lane
+        logger.warning("TLS requested: using python frontend (native ingress is plaintext)")
+        frontend = "python"
+
     class _GatewayProxy:
         """Delegates to the live generation's gateway."""
 
@@ -455,9 +481,12 @@ async def serve_deployment(
                 await http_handle.stop()
 
     runner, grpc_srv = await engine_server.serve_gateway(
-        proxy, host=host, http_port=http_port, grpc_port=grpc_port
+        proxy, host=host, http_port=http_port, grpc_port=grpc_port, tls=tls
     )
-    logger.info("deployment %s serving http=:%d grpc=:%d", name, http_port, grpc_port)
+    logger.info(
+        "deployment %s serving http=:%d grpc=:%d%s",
+        name, http_port, grpc_port, " (TLS)" if tls is not None else "",
+    )
     return runner, grpc_srv
 
 

@@ -161,7 +161,9 @@ def main(argv: Optional[List[str]] = None) -> None:
         user_model.load()
 
     tls = None
-    if args.ssl_cert:
+    if args.ssl_cert or args.ssl_key:
+        # key-without-cert must fail loudly (TlsConfig raises), not
+        # silently serve the plaintext the operator thinks is TLS
         from seldon_core_tpu.utils.tls import TlsConfig
 
         tls = TlsConfig(

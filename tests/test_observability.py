@@ -3,6 +3,7 @@ request-pair logging (reference: analytics.md:9-16 metric contract,
 PredictionService.java:169-202 pair format)."""
 
 import asyncio
+import os
 import json
 
 import numpy as np
@@ -148,3 +149,64 @@ class TestRequestLogger:
         assert pairs[0]["request"]["data"]["tensor"]["values"] == [3.0]
         assert pairs[0]["response"]["data"]["tensor"]["values"] == [6.0]
         assert pairs[0]["puid"]
+
+
+class TestMonitoringAssets:
+    """The shipped prometheus/alertmanager/grafana configs stay coherent
+    with the metric names the code emits (reference analogue: the
+    seldon-core-analytics chart's rules + dashboards)."""
+
+    MONITORING = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "monitoring")
+
+    def _load(self, name):
+        import yaml
+
+        with open(os.path.join(self.MONITORING, name)) as f:
+            return yaml.safe_load(f)
+
+    def test_alert_rules_parse_and_reference_emitted_metrics(self):
+        rules = self._load("alert-rules.yml")
+        exprs = " ".join(
+            r["expr"] for g in rules["groups"] for r in g["rules"]
+        )
+        # metric families that PrometheusObserver and the detectors emit
+        for metric in (
+            "seldon_api_engine_server_requests_duration_seconds",
+            "seldon_api_engine_client_requests_duration_seconds",
+            "seldon_api_model_feedback",
+            "outliers_total",
+        ):
+            assert metric in exprs, f"alert rules no longer cover {metric}"
+        for g in rules["groups"]:
+            for r in g["rules"]:
+                assert r["labels"]["severity"] in ("warning", "critical")
+                assert "summary" in r["annotations"]
+
+    def test_prometheus_config_wires_rules_and_alertmanager(self):
+        prom = self._load("prometheus.yml")
+        assert "alert-rules.yml" in prom["rule_files"]
+        targets = prom["alerting"]["alertmanagers"][0]["static_configs"][0]["targets"]
+        assert targets == ["localhost:9093"]
+
+    def test_alertmanager_routes_and_inhibition(self):
+        am = self._load("alertmanager.yml")
+        names = {r["name"] for r in am["receivers"]}
+        assert am["route"]["receiver"] in names
+        for route in am["route"].get("routes", []):
+            assert route["receiver"] in names
+        assert am["inhibit_rules"]
+
+    def test_dashboards_parse_and_use_emitted_metrics(self):
+        import json
+
+        gdir = os.path.join(self.MONITORING, "grafana")
+        dashboards = [f for f in os.listdir(gdir) if f.endswith(".json")]
+        assert len(dashboards) >= 2  # predictions + outliers (reference ships several)
+        for name in dashboards:
+            with open(os.path.join(gdir, name)) as f:
+                dash = json.load(f)
+            assert dash["panels"], name
+            exprs = " ".join(
+                t["expr"] for p in dash["panels"] for t in p.get("targets", [])
+            )
+            assert "seldon_api" in exprs or "outliers_total" in exprs, name
