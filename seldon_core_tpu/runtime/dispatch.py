@@ -71,6 +71,31 @@ def _try_raw(user_model: Any, raw_name: str, msg) -> Optional[InternalMessage]:
     return InternalMessage.from_proto(result)
 
 
+def _traced(method_name: str):
+    """Span per microservice method call — the wrapper-level tracing the
+    reference does around its endpoints (microservice.py:124-155).
+    No-op (one global read) when tracing is not set up."""
+    import functools
+
+    def deco(fn):
+        @functools.wraps(fn)
+        def wrapper(user_model, msg, *args, **kwargs):
+            from seldon_core_tpu.utils.tracing import maybe_span
+
+            first = msg[0] if isinstance(msg, list) and msg else msg
+            meta = getattr(first, "meta", None) or getattr(
+                getattr(first, "request", None), "meta", None
+            )
+            puid = meta.puid if meta is not None else ""
+            with maybe_span(f"microservice.{method_name}", trace_id=puid):
+                return fn(user_model, msg, *args, **kwargs)
+
+        return wrapper
+
+    return deco
+
+
+@_traced("predict")
 def predict(user_model: Any, msg: InternalMessage) -> InternalMessage:
     raw = _try_raw(user_model, "predict_raw", msg)
     if raw is not None:
@@ -94,6 +119,7 @@ async def predict_async(user_model: Any, msg: InternalMessage) -> InternalMessag
     return _construct_response(user_model, msg, result)
 
 
+@_traced("transform_input")
 def transform_input(user_model: Any, msg: InternalMessage) -> InternalMessage:
     raw = _try_raw(user_model, "transform_input_raw", msg)
     if raw is not None:
@@ -103,6 +129,7 @@ def transform_input(user_model: Any, msg: InternalMessage) -> InternalMessage:
     return _construct_response(user_model, msg, result)
 
 
+@_traced("transform_output")
 def transform_output(user_model: Any, msg: InternalMessage) -> InternalMessage:
     raw = _try_raw(user_model, "transform_output_raw", msg)
     if raw is not None:
@@ -112,6 +139,7 @@ def transform_output(user_model: Any, msg: InternalMessage) -> InternalMessage:
     return _construct_response(user_model, msg, result)
 
 
+@_traced("route")
 def route(user_model: Any, msg: InternalMessage) -> InternalMessage:
     """Returns a message whose payload is [[branch_index]]
     (reference: seldon_methods.py route semantics)."""
@@ -132,6 +160,7 @@ def route(user_model: Any, msg: InternalMessage) -> InternalMessage:
     return out
 
 
+@_traced("aggregate")
 def aggregate(user_model: Any, msgs: List[InternalMessage]) -> InternalMessage:
     fn = getattr(user_model, "aggregate_raw", None)
     if fn is not None:
@@ -156,6 +185,7 @@ def aggregate(user_model: Any, msgs: List[InternalMessage]) -> InternalMessage:
     return out
 
 
+@_traced("send_feedback")
 def send_feedback(
     user_model: Any, feedback: InternalFeedback, predictive_unit_id: Optional[str] = None
 ) -> InternalMessage:

@@ -38,14 +38,26 @@ from seldon_core_tpu.runtime.params import (
 
 logger = logging.getLogger(__name__)
 
-SERVICE_TYPES = ("MODEL", "ROUTER", "TRANSFORMER", "COMBINER", "OUTLIER_DETECTOR")
+SERVICE_TYPES = (
+    "MODEL",
+    "ROUTER",
+    "TRANSFORMER",
+    "OUTPUT_TRANSFORMER",
+    "COMBINER",
+    "OUTLIER_DETECTOR",
+)
 
 
 def import_component(dotted: str, **kwargs: Any) -> Any:
-    """Instantiate `pkg.module.Class` with typed parameter kwargs."""
+    """Instantiate a component with typed parameter kwargs.
+
+    Accepts ``pkg.module.Class`` or the reference s2i contract's bare
+    name ``MyModel`` — module ``MyModel`` defining ``class MyModel``
+    (reference: python/seldon_core/microservice.py interface_name).
+    """
     module_name, _, class_name = dotted.rpartition(".")
     if not module_name:
-        raise ValueError(f"component path must be 'module.Class', got {dotted!r}")
+        module_name = class_name = dotted
     sys.path.insert(0, os.getcwd())
     module = importlib.import_module(module_name)
     cls = getattr(module, class_name)

@@ -11,8 +11,12 @@ duration, tags, parent linkage via puid) and pluggable export:
   gateway's debug endpoint;
 * JSON-lines file exporter, one span per line, trivially shippable to
   any backend;
-* an OTLP/Jaeger exporter can be slotted in where available — the span
-  dataclass carries exactly the fields those protocols need.
+* ``OtlpHttpExporter`` — OTLP/HTTP JSON (the protocol Jaeger >=1.35
+  and every OpenTelemetry collector ingest natively on :4318) emitted
+  directly with the stdlib, no opentelemetry-sdk dependency; enabled by
+  the standard ``OTEL_EXPORTER_OTLP_ENDPOINT`` env (the role the
+  reference's JAEGER_AGENT_HOST envs play, reference:
+  python/seldon_core/microservice.py:124-155).
 
 Spans cover the same cut points as the reference: one span per external
 request, one per graph-node method call.
@@ -51,11 +55,152 @@ class Span:
         }
 
 
+class OtlpHttpExporter:
+    """Ships spans as OTLP/HTTP JSON resourceSpans batches.
+
+    Buffered: spans accumulate and flush when ``batch_size`` is reached
+    or on ``flush()``/``close()``.  Export failures are counted, never
+    raised — tracing must not take the data plane down.
+    """
+
+    def __init__(
+        self,
+        endpoint: str = "http://127.0.0.1:4318/v1/traces",
+        service_name: str = "seldon-tpu",
+        batch_size: int = 64,
+        timeout_s: float = 5.0,
+    ):
+        import queue
+
+        self.endpoint = endpoint
+        self.service_name = service_name
+        self.batch_size = int(batch_size)
+        self.timeout_s = float(timeout_s)
+        self.exported = 0
+        self.failures = 0
+        self._buffer: List[Span] = []
+        self._lock = threading.Lock()
+        # exports happen on a worker thread: record() is called from the
+        # serving event loop, and a slow/blackholed collector must not
+        # stall requests (same pattern as reqlogger's HTTP worker)
+        self._queue: "queue.Queue[Optional[List[Span]]]" = queue.Queue()
+        self._worker = threading.Thread(target=self._drain, daemon=True, name="otlp-export")
+        self._worker.start()
+
+    def _drain(self) -> None:
+        while True:
+            batch = self._queue.get()
+            if batch is None:
+                self._queue.task_done()
+                return
+            self.export(batch)
+            self._queue.task_done()
+
+    @staticmethod
+    def _hex_id(seed: str, nbytes: int) -> str:
+        import hashlib
+
+        return hashlib.sha256(seed.encode()).hexdigest()[: nbytes * 2]
+
+    def _otlp_span(self, s: Span) -> Dict[str, Any]:
+        start = int(s.start_s * 1e9)
+        # span id is a pure function of (trace, name) so a child's
+        # parentSpanId — derived from (trace, parent name) — actually
+        # matches its parent's spanId and collectors render a tree
+        return {
+            "traceId": self._hex_id(s.trace_id or s.name, 16),
+            "spanId": self._hex_id(f"{s.trace_id}/{s.name}", 8),
+            **(
+                {"parentSpanId": self._hex_id(f"{s.trace_id}/{s.parent}", 8)}
+                if s.parent
+                else {}
+            ),
+            "name": s.name,
+            "kind": 2,  # SPAN_KIND_SERVER
+            "startTimeUnixNano": str(start),
+            "endTimeUnixNano": str(start + int(s.duration_s * 1e9)),
+            "attributes": [
+                {"key": k, "value": {"stringValue": str(v)}} for k, v in s.tags.items()
+            ],
+        }
+
+    def payload(self, spans: List[Span]) -> Dict[str, Any]:
+        return {
+            "resourceSpans": [
+                {
+                    "resource": {
+                        "attributes": [
+                            {
+                                "key": "service.name",
+                                "value": {"stringValue": self.service_name},
+                            }
+                        ]
+                    },
+                    "scopeSpans": [
+                        {
+                            "scope": {"name": "seldon_core_tpu.utils.tracing"},
+                            "spans": [self._otlp_span(s) for s in spans],
+                        }
+                    ],
+                }
+            ]
+        }
+
+    def export(self, spans: List[Span]) -> bool:
+        import urllib.request
+
+        if not spans:
+            return True
+        req = urllib.request.Request(
+            self.endpoint,
+            data=json.dumps(self.payload(spans)).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout_s) as resp:
+                ok = resp.status < 400
+        except Exception:  # noqa: BLE001 — collector down must not hurt serving
+            ok = False
+        if ok:
+            self.exported += len(spans)
+        else:
+            self.failures += 1
+        return ok
+
+    def __call__(self, span: Span) -> None:
+        with self._lock:
+            self._buffer.append(span)
+            if len(self._buffer) < self.batch_size:
+                return
+            batch, self._buffer = self._buffer, []
+        self._queue.put(batch)  # non-blocking hand-off to the worker
+
+    def flush(self) -> None:
+        """Hand any partial batch to the worker and wait for it."""
+        with self._lock:
+            batch, self._buffer = self._buffer, []
+        if batch:
+            self._queue.put(batch)
+        self._queue.join()
+
+    def close(self) -> None:
+        self.flush()
+        self._queue.put(None)
+        self._worker.join(timeout=self.timeout_s)
+
+
 class Tracer:
-    def __init__(self, service_name: str = "seldon-tpu", capacity: int = 4096, export_path: Optional[str] = None):
+    def __init__(
+        self,
+        service_name: str = "seldon-tpu",
+        capacity: int = 4096,
+        export_path: Optional[str] = None,
+        exporter: Optional[Any] = None,  # callable(Span), e.g. OtlpHttpExporter
+    ):
         self.service_name = service_name
         self.spans: Deque[Span] = deque(maxlen=capacity)
         self.export_path = export_path
+        self.exporter = exporter
         self._lock = threading.Lock()
         self._file = open(export_path, "a") if export_path else None
 
@@ -75,6 +220,11 @@ class Tracer:
             if self._file is not None:
                 self._file.write(json.dumps(s.to_dict()) + "\n")
                 self._file.flush()
+        if self.exporter is not None:
+            try:
+                self.exporter(s)
+            except Exception:  # noqa: BLE001 — exporters never break serving
+                pass
 
     def find(self, trace_id: str) -> List[Span]:
         with self._lock:
@@ -83,12 +233,30 @@ class Tracer:
     def close(self) -> None:
         if self._file is not None:
             self._file.close()
+        if self.exporter is not None and hasattr(self.exporter, "close"):
+            self.exporter.close()
 
 
-def setup_tracing(service_name: str = "seldon-tpu", export_path: Optional[str] = None) -> Tracer:
-    """Install the global tracer (reference: setup_tracing env-driven init)."""
+def setup_tracing(
+    service_name: str = "seldon-tpu",
+    export_path: Optional[str] = None,
+    otlp_endpoint: Optional[str] = None,
+) -> Tracer:
+    """Install the global tracer (reference: setup_tracing env-driven
+    init, microservice.py:124-155).  ``OTEL_EXPORTER_OTLP_ENDPOINT``
+    (or the argument) turns on the OTLP/HTTP exporter."""
+    import os
+
     global _tracer
-    _tracer = Tracer(service_name=service_name, export_path=export_path)
+    if _tracer is not None:  # flush + release the previous tracer's sinks
+        _tracer.close()
+    endpoint = otlp_endpoint or os.environ.get("OTEL_EXPORTER_OTLP_ENDPOINT", "")
+    exporter = None
+    if endpoint:
+        if not endpoint.rstrip("/").endswith("/v1/traces"):
+            endpoint = endpoint.rstrip("/") + "/v1/traces"
+        exporter = OtlpHttpExporter(endpoint=endpoint, service_name=service_name)
+    _tracer = Tracer(service_name=service_name, export_path=export_path, exporter=exporter)
     return _tracer
 
 
