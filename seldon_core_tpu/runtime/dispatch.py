@@ -71,6 +71,23 @@ def _try_raw(user_model: Any, raw_name: str, msg) -> Optional[InternalMessage]:
     return InternalMessage.from_proto(result)
 
 
+def _ensure_puid(msg) -> str:
+    """puid of the message (or its feedback request), assigning one when
+    the caller didn't — standalone microservices have no engine upstream
+    to mint ids, and tracing/logging need a non-empty trace id."""
+    first = msg[0] if isinstance(msg, list) and msg else msg
+    meta = getattr(first, "meta", None) or getattr(
+        getattr(first, "request", None), "meta", None
+    )
+    if meta is None:
+        return ""
+    if not meta.puid:
+        import uuid
+
+        meta.puid = uuid.uuid4().hex[:24]
+    return meta.puid
+
+
 def _traced(method_name: str):
     """Span per microservice method call — the wrapper-level tracing the
     reference does around its endpoints (microservice.py:124-155).
@@ -82,11 +99,7 @@ def _traced(method_name: str):
         def wrapper(user_model, msg, *args, **kwargs):
             from seldon_core_tpu.utils.tracing import maybe_span
 
-            first = msg[0] if isinstance(msg, list) and msg else msg
-            meta = getattr(first, "meta", None) or getattr(
-                getattr(first, "request", None), "meta", None
-            )
-            puid = meta.puid if meta is not None else ""
+            puid = _ensure_puid(msg)
             with maybe_span(f"microservice.{method_name}", trace_id=puid):
                 return fn(user_model, msg, *args, **kwargs)
 
@@ -114,8 +127,11 @@ async def predict_async(user_model: Any, msg: InternalMessage) -> InternalMessag
         from seldon_core_tpu.runtime.executor_pool import run_dispatch
 
         return await run_dispatch(predict, user_model, msg)
-    features = _features_for(user_model, msg)
-    result = await fn(features, msg.names, meta=msg.meta.to_dict())
+    from seldon_core_tpu.utils.tracing import maybe_span
+
+    with maybe_span("microservice.predict", trace_id=_ensure_puid(msg)):
+        features = _features_for(user_model, msg)
+        result = await fn(features, msg.names, meta=msg.meta.to_dict())
     return _construct_response(user_model, msg, result)
 
 

@@ -24,15 +24,26 @@ request, one per graph-node method call.
 
 from __future__ import annotations
 
+import contextvars
 import json
 import threading
 import time
+import uuid
 from collections import deque
 from contextlib import contextmanager
 from dataclasses import dataclass, field
 from typing import Any, Deque, Dict, List, Optional
 
 _tracer: Optional["Tracer"] = None
+# the active span of the current task/thread; contextvars propagate
+# through asyncio tasks, so nested spans self-link without plumbing
+_current_span: "contextvars.ContextVar[Optional[Span]]" = contextvars.ContextVar(
+    "seldon_tpu_current_span", default=None
+)
+
+
+def _new_id(nbytes: int) -> str:
+    return uuid.uuid4().hex[: nbytes * 2]
 
 
 @dataclass
@@ -42,7 +53,9 @@ class Span:
     start_s: float
     duration_s: float = 0.0
     tags: Dict[str, Any] = field(default_factory=dict)
-    parent: Optional[str] = None
+    parent: Optional[str] = None  # parent span NAME (informational)
+    span_id: str = field(default_factory=lambda: _new_id(8))
+    parent_span_id: Optional[str] = None
 
     def to_dict(self) -> Dict[str, Any]:
         return {
@@ -104,17 +117,13 @@ class OtlpHttpExporter:
 
     def _otlp_span(self, s: Span) -> Dict[str, Any]:
         start = int(s.start_s * 1e9)
-        # span id is a pure function of (trace, name) so a child's
-        # parentSpanId — derived from (trace, parent name) — actually
-        # matches its parent's spanId and collectors render a tree
+        # trace id derives from the puid; span ids are real per-span
+        # uuids assigned at creation, parent links resolved via the
+        # contextvar span stack — unique even for repeated span names
         return {
-            "traceId": self._hex_id(s.trace_id or s.name, 16),
-            "spanId": self._hex_id(f"{s.trace_id}/{s.name}", 8),
-            **(
-                {"parentSpanId": self._hex_id(f"{s.trace_id}/{s.parent}", 8)}
-                if s.parent
-                else {}
-            ),
+            "traceId": self._hex_id(s.trace_id, 16) if s.trace_id else _new_id(16),
+            "spanId": s.span_id,
+            **({"parentSpanId": s.parent_span_id} if s.parent_span_id else {}),
             "name": s.name,
             "kind": 2,  # SPAN_KIND_SERVER
             "startTimeUnixNano": str(start),
@@ -207,17 +216,26 @@ class Tracer:
     @contextmanager
     def span(self, name: str, trace_id: str = "", parent: Optional[str] = None, **tags: Any):
         s = Span(trace_id=trace_id, name=name, start_s=time.time(), tags=dict(tags), parent=parent)
+        enclosing = _current_span.get()
+        if enclosing is not None:
+            s.parent_span_id = enclosing.span_id
+            if s.parent is None:
+                s.parent = enclosing.name
+            if not s.trace_id:
+                s.trace_id = enclosing.trace_id
+        token = _current_span.set(s)
         t0 = time.perf_counter()
         try:
             yield s
         finally:
+            _current_span.reset(token)
             s.duration_s = time.perf_counter() - t0
             self.record(s)
 
     def record(self, s: Span) -> None:
         with self._lock:
             self.spans.append(s)
-            if self._file is not None:
+            if self._file is not None and not self._file.closed:
                 self._file.write(json.dumps(s.to_dict()) + "\n")
                 self._file.flush()
         if self.exporter is not None:
@@ -231,8 +249,10 @@ class Tracer:
             return [s for s in self.spans if s.trace_id == trace_id]
 
     def close(self) -> None:
-        if self._file is not None:
-            self._file.close()
+        with self._lock:  # record() writes under this lock — no close race
+            if self._file is not None:
+                self._file.close()
+                self._file = None
         if self.exporter is not None and hasattr(self.exporter, "close"):
             self.exporter.close()
 

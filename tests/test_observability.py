@@ -249,10 +249,10 @@ class TestOtlpExporter:
             )
             tracer = Tracer(exporter=exporter)
             with tracer.span("predictor.predict", trace_id="puid-1", model="m1"):
-                pass
-            with tracer.span("node.transform_input", trace_id="puid-1",
-                             parent="predictor.predict"):
-                pass
+                # nested span: parent linkage comes from the contextvar
+                # stack, the way the engine's node spans nest in practice
+                with tracer.span("node.transform_input"):
+                    pass
             # batch_size=2 -> one POST fired (on the export worker)
             exporter.flush()
             assert len(received) == 1
@@ -262,12 +262,16 @@ class TestOtlpExporter:
             svc_attr = rs["resource"]["attributes"][0]
             assert svc_attr == {"key": "service.name", "value": {"stringValue": "svc-x"}}
             spans = rs["scopeSpans"][0]["spans"]
-            assert [s["name"] for s in spans] == ["predictor.predict", "node.transform_input"]
+            # child closes (and records) first
+            spans.sort(key=lambda x: x["name"])
+            assert [s["name"] for s in spans] == ["node.transform_input", "predictor.predict"]
+            spans.reverse()  # [parent, child]
             # same puid -> same 32-hex traceId; child links its parent
             assert spans[0]["traceId"] == spans[1]["traceId"]
             assert len(spans[0]["traceId"]) == 32 and len(spans[0]["spanId"]) == 16
-            # the child's parent link resolves to the parent's actual id
+            # the child inherited the trace and links the parent's real id
             assert spans[1]["parentSpanId"] == spans[0]["spanId"]
+            assert spans[1]["spanId"] != spans[0]["spanId"]
             assert int(spans[0]["endTimeUnixNano"]) >= int(spans[0]["startTimeUnixNano"])
             assert exporter.exported == 2
         finally:
