@@ -42,6 +42,9 @@ def _register_optional() -> None:
     from seldon_core_tpu.models.proxyserver import RestProxyServer, TFServingGrpcProxy
 
     register_implementation("REST_PROXY", RestProxyServer)
+    from seldon_core_tpu.models.generate import GenerativeLM
+
+    register_implementation("GENERATIVE_LM", GenerativeLM)
     # Reference's TENSORFLOW_SERVER prepackaged proxy
     # (operator/controllers/seldondeployment_prepackaged_servers.go:109)
     register_implementation("TENSORFLOW_SERVER", TFServingGrpcProxy)

@@ -26,6 +26,12 @@ class TransformerBlock(nn.Module):
     dtype: Any = jnp.bfloat16
     attn_fn: AttnFn = staticmethod(plain_attention)
     causal: bool = False
+    # decode mode: keep K/V in a flax 'cache' variable collection and
+    # attend against it — both prefill (L = prompt length) and
+    # incremental steps (L = 1) scatter at the running index, so one
+    # compiled program per (batch, L) bucket serves the whole loop
+    decode: bool = False
+    max_len: int = 2048
 
     @nn.compact
     def __call__(self, x):
@@ -35,9 +41,11 @@ class TransformerBlock(nn.Module):
         qkv = nn.Dense(3 * d_model, dtype=self.dtype, name="qkv")(y)
         q, k, v = jnp.split(qkv, 3, axis=-1)
         shape = (*y.shape[:-1], self.num_heads, head_dim)
-        attn_out = self.attn_fn(
-            q.reshape(shape), k.reshape(shape), v.reshape(shape), causal=self.causal
-        )
+        q, k, v = q.reshape(shape), k.reshape(shape), v.reshape(shape)
+        if self.decode:
+            attn_out = self._cached_attention(q, k, v, head_dim)
+        else:
+            attn_out = self.attn_fn(q, k, v, causal=self.causal)
         attn_out = attn_out.reshape(y.shape)
         x = x + nn.Dense(d_model, dtype=self.dtype, name="attn_proj")(attn_out)
         y = nn.LayerNorm(dtype=jnp.float32)(x)
@@ -45,6 +53,44 @@ class TransformerBlock(nn.Module):
         y = nn.gelu(y)
         x = x + nn.Dense(d_model, dtype=self.dtype, name="mlp_out")(y)
         return x
+
+    def _cached_attention(self, q, k, v, head_dim):
+        """Scatter this call's K/V into the cache, attend causally over
+        everything seen so far (flax nn.SelfAttention's decode pattern,
+        generalised to multi-token prefill writes)."""
+        import jax
+
+        batch, seg_len, heads, _ = q.shape
+        cached_key = self.variable(
+            "cache", "cached_key",
+            lambda: jnp.zeros((batch, self.max_len, heads, head_dim), self.dtype),
+        )
+        cached_value = self.variable(
+            "cache", "cached_value",
+            lambda: jnp.zeros((batch, self.max_len, heads, head_dim), self.dtype),
+        )
+        cache_index = self.variable(
+            "cache", "cache_index", lambda: jnp.zeros((), jnp.int32)
+        )
+        index = cache_index.value
+        ck = jax.lax.dynamic_update_slice(
+            cached_key.value, k.astype(self.dtype), (0, index, 0, 0)
+        )
+        cv = jax.lax.dynamic_update_slice(
+            cached_value.value, v.astype(self.dtype), (0, index, 0, 0)
+        )
+        cached_key.value, cached_value.value = ck, cv
+        cache_index.value = index + seg_len
+
+        scale = 1.0 / jnp.sqrt(head_dim).astype(q.dtype)
+        scores = jnp.einsum("bqhd,bkhd->bhqk", q * scale, ck)
+        # query i (absolute position index+i) sees cache slots <= index+i
+        q_pos = index + jnp.arange(seg_len)[:, None]
+        k_pos = jnp.arange(self.max_len)[None, :]
+        mask = k_pos <= q_pos  # (seg_len, max_len)
+        scores = jnp.where(mask[None, None], scores, jnp.finfo(scores.dtype).min)
+        weights = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(self.dtype)
+        return jnp.einsum("bhqk,bkhd->bqhd", weights, cv)
 
 
 class TransformerEncoder(nn.Module):
@@ -81,7 +127,13 @@ class TransformerEncoder(nn.Module):
 
 
 class TransformerLM(nn.Module):
-    """Causal decoder: next-token logits (scoring / generation)."""
+    """Causal decoder: next-token logits (scoring / generation).
+
+    ``decode=True`` builds the kv-cached variant (same parameter tree —
+    a trained TransformerLM checkpoint drives cached generation
+    unchanged); callers then pass absolute ``positions`` and manage the
+    flax 'cache' collection (see models/generate.py).
+    """
 
     vocab_size: int = 32_000
     d_model: int = 256
@@ -90,19 +142,20 @@ class TransformerLM(nn.Module):
     max_len: int = 2048
     dtype: Any = jnp.bfloat16
     attn_fn: AttnFn = staticmethod(plain_attention)
+    decode: bool = False
 
     @nn.compact
-    def __call__(self, tokens, train: bool = False):
+    def __call__(self, tokens, train: bool = False, positions=None):
         tokens = tokens.astype(jnp.int32)
         x = nn.Embed(self.vocab_size, self.d_model, dtype=self.dtype, name="tok_embed")(tokens)
-        pos = nn.Embed(self.max_len, self.d_model, dtype=self.dtype, name="pos_embed")(
-            jnp.arange(tokens.shape[1])
-        )
-        x = x + pos[None]
+        if positions is None:
+            positions = jnp.arange(tokens.shape[1])
+        pos = nn.Embed(self.max_len, self.d_model, dtype=self.dtype, name="pos_embed")(positions)
+        x = x + (pos[None] if pos.ndim == 2 else pos)
         for i in range(self.num_layers):
             x = TransformerBlock(
                 num_heads=self.num_heads, dtype=self.dtype, attn_fn=self.attn_fn,
-                causal=True, name=f"block_{i}",
+                causal=True, decode=self.decode, max_len=self.max_len, name=f"block_{i}",
             )(x)
         x = nn.LayerNorm(dtype=jnp.float32)(x)
         logits = nn.Dense(self.vocab_size, dtype=self.dtype, name="head")(x)

@@ -121,7 +121,7 @@ class OtlpHttpExporter:
         # uuids assigned at creation, parent links resolved via the
         # contextvar span stack — unique even for repeated span names
         return {
-            "traceId": self._hex_id(s.trace_id, 16) if s.trace_id else _new_id(16),
+            "traceId": self._hex_id(s.trace_id, 16) if s.trace_id else _new_id(16),  # fallback for hand-built spans
             "spanId": s.span_id,
             **({"parentSpanId": s.parent_span_id} if s.parent_span_id else {}),
             "name": s.name,
@@ -223,6 +223,10 @@ class Tracer:
                 s.parent = enclosing.name
             if not s.trace_id:
                 s.trace_id = enclosing.trace_id
+        if not s.trace_id:
+            # root span without a puid: mint the trace id here, once,
+            # so children (and the exporter) all see the same trace
+            s.trace_id = _new_id(16)
         token = _current_span.set(s)
         t0 = time.perf_counter()
         try:
