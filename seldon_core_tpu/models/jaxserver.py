@@ -7,6 +7,7 @@ GPU-proxy path (reference: integrations/nvidia-inference-server/
 TRTProxy.py:50-81), collapsed into one in-process component:
 
 * the model is a flax module (builtin registry: resnet18/34/50/101/152,
+  vit_tiny/base16/large16, transformer encoder/LM,
   mlp, tiny test configs — or any dotted ``pkg.module.fn`` returning a
   module) jit-compiled to XLA at ``load()``;
 * parameters load from ``model_uri`` (flax msgpack via the storage
@@ -53,7 +54,7 @@ def _compute_dtype(name: str):
 
 def _model_registry() -> Dict[str, Callable[..., Tuple[Any, Tuple[int, ...]]]]:
     """name -> factory(num_classes, dtype) -> (module, example_input_shape)."""
-    from seldon_core_tpu.models import mlp, resnet
+    from seldon_core_tpu.models import mlp, resnet, vit
 
     def entry(cls, shape):
         def factory(num_classes: int, dtype, **kw):
@@ -72,6 +73,9 @@ def _model_registry() -> Dict[str, Callable[..., Tuple[Any, Tuple[int, ...]]]]:
         "resnet152": entry(resnet.ResNet152, img),
         "resnet_tiny": entry(resnet.ResNetTiny, (32, 32, 3)),
         "mlp": entry(mlp.MLPClassifier, (4,)),
+        "vit_tiny": entry(vit.ViTTiny, (32, 32, 3)),
+        "vit_base16": entry(vit.ViTBase16, img),
+        "vit_large16": entry(vit.ViTLarge16, img),
         # long-context families: input is a token-id sequence (int32);
         # input_shape must be given explicitly (the served context length)
         "transformer_encoder": entry(transformer.TransformerEncoder, None),

@@ -1,0 +1,97 @@
+"""Vision Transformer in flax.
+
+Completes the vision family alongside the ResNets (the reference serves
+arbitrary image classifiers through its prepackaged servers; here the
+zoo is TPU-first flax).  Patchify is a strided conv — the layout XLA
+maps straight onto the MXU — and the encoder reuses TransformerBlock,
+so the attention path (and its pluggable ``attn_fn``) is shared with
+the language family.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from seldon_core_tpu.models.transformer import TransformerBlock
+
+
+class VisionTransformer(nn.Module):
+    """ViT-style classifier: patch embed + transformer + CLS head."""
+
+    num_classes: int = 1000
+    patch_size: int = 16
+    d_model: int = 384
+    num_layers: int = 12
+    num_heads: int = 6
+    mlp_ratio: int = 4
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        # (B, H, W, C) uint8/float -> patches via strided conv (MXU-friendly)
+        if x.shape[1] % self.patch_size or x.shape[2] % self.patch_size:
+            raise ValueError(
+                f"ViT input {x.shape[1]}x{x.shape[2]} not divisible by "
+                f"patch_size {self.patch_size} — the strided conv would "
+                "silently crop edge pixels"
+            )
+        x = jnp.asarray(x, self.dtype)
+        x = nn.Conv(
+            self.d_model,
+            kernel_size=(self.patch_size, self.patch_size),
+            strides=(self.patch_size, self.patch_size),
+            dtype=self.dtype,
+            name="patch_embed",
+        )(x)
+        b, h, w, c = x.shape
+        x = x.reshape(b, h * w, c)
+        cls = self.param("cls_token", nn.initializers.zeros, (1, 1, self.d_model))
+        x = jnp.concatenate([jnp.asarray(cls, self.dtype).repeat(b, 0), x], axis=1)
+        n_tokens = x.shape[1]
+        pos = self.param(
+            "pos_embed", nn.initializers.normal(0.02), (1, n_tokens, self.d_model)
+        )
+        if pos.shape[1] != n_tokens:
+            # a second signature at a different resolution would need
+            # position interpolation; fail with intent, not a broadcast
+            raise ValueError(
+                f"ViT position table holds {pos.shape[1]} tokens but this "
+                f"input yields {n_tokens}; ViT serves ONE resolution "
+                "(extra_input_shapes with differing H/W is unsupported)"
+            )
+        x = x + jnp.asarray(pos, self.dtype)
+        for i in range(self.num_layers):
+            x = TransformerBlock(
+                num_heads=self.num_heads,
+                mlp_ratio=self.mlp_ratio,
+                dtype=self.dtype,
+                causal=False,
+                name=f"block_{i}",
+            )(x)
+        x = nn.LayerNorm(dtype=jnp.float32)(x)
+        logits = nn.Dense(self.num_classes, dtype=self.dtype, name="head")(x[:, 0])
+        return logits.astype(jnp.float32)
+
+
+class ViTTiny(VisionTransformer):
+    """Small config for tests and the CPU tier (serve at 32x32)."""
+
+    patch_size: int = 8
+    d_model: int = 64
+    num_layers: int = 2
+    num_heads: int = 4
+
+
+class ViTBase16(VisionTransformer):
+    d_model: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+
+
+class ViTLarge16(VisionTransformer):
+    d_model: int = 1024
+    num_layers: int = 24
+    num_heads: int = 16

@@ -380,3 +380,33 @@ class TestTopK:
         logits = full.predict(x, [])
         np.testing.assert_allclose(np.sort(logits, axis=1)[:, -3:][:, ::-1], scores, rtol=1e-5)
         server.unload(); full.unload()
+
+
+class TestViT:
+    def test_vit_tiny_serves_images(self):
+        from seldon_core_tpu.models.jaxserver import JaxServer
+
+        server = JaxServer(
+            model="vit_tiny", num_classes=10, input_shape=(32, 32, 3),
+            dtype="float32", max_batch_size=4, warmup=False,
+            warmup_dtypes=("float32",),
+        )
+        server.load()
+        out = server.predict(np.zeros((2, 32, 32, 3), np.float32), [])
+        arr = np.asarray(out)
+        assert arr.shape == (2, 10)
+        assert np.isfinite(arr).all()
+        server.unload()
+
+    def test_vit_patch_and_cls_shapes(self):
+        import jax
+        import jax.numpy as jnp
+
+        from seldon_core_tpu.models.vit import ViTTiny
+
+        m = ViTTiny(num_classes=5, dtype=jnp.float32)
+        variables = m.init(jax.random.key(0), jnp.zeros((1, 32, 32, 3)))
+        # 32/8 = 4 -> 16 patches + CLS = 17 positions
+        assert variables["params"]["pos_embed"].shape == (1, 17, 64)
+        logits = m.apply(variables, jnp.ones((3, 32, 32, 3)))
+        assert logits.shape == (3, 5)
