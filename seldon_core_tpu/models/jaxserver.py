@@ -73,16 +73,54 @@ def _model_registry() -> Dict[str, Callable[..., Tuple[Any, Tuple[int, ...]]]]:
         "resnet152": entry(resnet.ResNet152, img),
         "resnet_tiny": entry(resnet.ResNetTiny, (32, 32, 3)),
         "mlp": entry(mlp.MLPClassifier, (4,)),
-        "vit_tiny": entry(vit.ViTTiny, (32, 32, 3)),
-        "vit_base16": entry(vit.ViTBase16, img),
-        "vit_large16": entry(vit.ViTLarge16, img),
+        "vit_tiny": entry(_with_attention(vit.ViTTiny), (32, 32, 3)),
+        "vit_base16": entry(_with_attention(vit.ViTBase16), img),
+        "vit_large16": entry(_with_attention(vit.ViTLarge16), img),
         # long-context families: input is a token-id sequence (int32);
-        # input_shape must be given explicitly (the served context length)
-        "transformer_encoder": entry(transformer.TransformerEncoder, None),
+        # input_shape must be given explicitly (the served context length).
+        # model_kwargs may name the attention impl: {"attention": "flash"}
+        # selects the pallas blockwise kernel, "plain" the einsum path
+        # (ring attention needs a mesh, so it stays programmatic).
+        "transformer_encoder": entry(
+            lambda num_classes, dtype, **kw: transformer.TransformerEncoder(
+                num_classes=num_classes, dtype=dtype, **_resolve_attention(kw)
+            ),
+            None,
+        ),
         "transformer_lm": entry(
-            lambda num_classes, dtype, **kw: transformer.TransformerLM(dtype=dtype, **kw), None
+            lambda num_classes, dtype, **kw: transformer.TransformerLM(
+                dtype=dtype, **_resolve_attention(kw)
+            ),
+            None,
         ),
     }
+
+
+def _with_attention(cls):
+    """Registry factory routing the "attention" model_kwarg for classes
+    with a pluggable attn_fn (vit_* share the transformer blocks)."""
+
+    def make(num_classes: int, dtype, **kw):
+        return cls(num_classes=num_classes, dtype=dtype, **_resolve_attention(kw))
+
+    return make
+
+
+def _resolve_attention(kw: Dict[str, Any]) -> Dict[str, Any]:
+    """Map a JSON-able {"attention": "flash"|"plain"} kwarg to attn_fn."""
+    kw = dict(kw)
+    choice = kw.pop("attention", None)
+    if choice == "flash":
+        from seldon_core_tpu.ops.kernels import flash_attn_fn
+
+        kw["attn_fn"] = flash_attn_fn()
+    elif choice not in (None, "plain"):
+        raise MicroserviceError(
+            f"unknown attention {choice!r} (supported: plain, flash)",
+            status_code=400,
+            reason="BAD_ATTENTION",
+        )
+    return kw
 
 
 class JaxServer(TPUComponent):

@@ -15,7 +15,8 @@ from typing import Any
 import flax.linen as nn
 import jax.numpy as jnp
 
-from seldon_core_tpu.models.transformer import TransformerBlock
+from seldon_core_tpu.models.transformer import AttnFn, TransformerBlock
+from seldon_core_tpu.parallel.ring_attention import plain_attention
 
 
 class VisionTransformer(nn.Module):
@@ -28,6 +29,7 @@ class VisionTransformer(nn.Module):
     num_heads: int = 6
     mlp_ratio: int = 4
     dtype: Any = jnp.bfloat16
+    attn_fn: AttnFn = staticmethod(plain_attention)
 
     @nn.compact
     def __call__(self, x, train: bool = False):
@@ -51,23 +53,19 @@ class VisionTransformer(nn.Module):
         cls = self.param("cls_token", nn.initializers.zeros, (1, 1, self.d_model))
         x = jnp.concatenate([jnp.asarray(cls, self.dtype).repeat(b, 0), x], axis=1)
         n_tokens = x.shape[1]
+        # ViT serves ONE resolution: applying params trained at another
+        # resolution fails in flax's param shape check on this line
+        # (position interpolation is out of scope)
         pos = self.param(
             "pos_embed", nn.initializers.normal(0.02), (1, n_tokens, self.d_model)
         )
-        if pos.shape[1] != n_tokens:
-            # a second signature at a different resolution would need
-            # position interpolation; fail with intent, not a broadcast
-            raise ValueError(
-                f"ViT position table holds {pos.shape[1]} tokens but this "
-                f"input yields {n_tokens}; ViT serves ONE resolution "
-                "(extra_input_shapes with differing H/W is unsupported)"
-            )
         x = x + jnp.asarray(pos, self.dtype)
         for i in range(self.num_layers):
             x = TransformerBlock(
                 num_heads=self.num_heads,
                 mlp_ratio=self.mlp_ratio,
                 dtype=self.dtype,
+                attn_fn=self.attn_fn,
                 causal=False,
                 name=f"block_{i}",
             )(x)

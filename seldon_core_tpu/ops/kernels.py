@@ -163,3 +163,128 @@ class Int8Dense:
         if self.bias is not None:
             y = y + jnp.asarray(self.bias, y.dtype)
         return y
+
+
+# ---------------------------------------------------------------------------
+# flash attention (single-chip blockwise online softmax)
+# ---------------------------------------------------------------------------
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
+                  block_q: int, block_k: int, n_kv: int, causal: bool,
+                  scale: float):
+    """Grid cell (batch*head, q-block, kv-block): the kv axis is the
+    innermost grid dimension, so the online-softmax carry lives in VMEM
+    scratch across kv steps — KV streams block-by-block from HBM and
+    VMEM holds O(block_q * d + block_k * d), independent of sequence
+    length (the standard TPU flash-attention shape)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    qi = pl.program_id(1)
+    j = pl.program_id(2)
+
+    @pl.when(j == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, -jnp.inf)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    # causal: kv blocks entirely beyond this q block contribute nothing
+    needed = jnp.logical_or(
+        jnp.logical_not(causal), j * block_k <= (qi + 1) * block_q - 1
+    )
+
+    @pl.when(needed)
+    def _step():
+        q = q_ref[0].astype(jnp.float32) * scale  # (block_q, d)
+        k = k_ref[0].astype(jnp.float32)  # (block_k, d)
+        v = v_ref[0].astype(jnp.float32)
+        s = q @ k.T
+        if causal:
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0
+            )
+            k_pos = j * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1
+            )
+            s = jnp.where(k_pos > q_pos, -jnp.inf, s)
+        m = m_ref[...]
+        blk_max = jnp.max(s, axis=-1)
+        new_m = jnp.maximum(m, blk_max)
+        safe_m = jnp.where(jnp.isfinite(new_m), new_m, 0.0)
+        p = jnp.exp(s - safe_m[:, None])
+        p = jnp.where(jnp.isfinite(s), p, 0.0)
+        correction = jnp.where(jnp.isfinite(m), jnp.exp(m - safe_m), 0.0)
+        m_ref[...] = new_m
+        l_ref[...] = l_ref[...] * correction + jnp.sum(p, axis=-1)
+        acc_ref[...] = acc_ref[...] * correction[:, None] + p @ v
+
+    @pl.when(j == n_kv - 1)
+    def _emit():
+        l = l_ref[...]
+        l = jnp.where(l > 0, l, 1.0)  # fully-masked rows output zeros
+        o_ref[0] = (acc_ref[...] / l[:, None]).astype(o_ref.dtype)
+
+
+def flash_attention(q, k, v, causal: bool = False, block_q: int = 128, block_k: int = 128):
+    """Blockwise attention, numerically identical to plain softmax
+    attention but O(L) memory: the (L, L) score matrix never exists and
+    VMEM holds only the current q/kv blocks + the carry.
+
+    Shapes follow plain_attention: (batch, seq, heads, head_dim).  The
+    per-chip counterpart of ring attention (which shards ACROSS chips;
+    this streams WITHIN one chip's sequence shard).  Falls back to the
+    einsum path when the sequence does not tile.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    from seldon_core_tpu.parallel.ring_attention import plain_attention
+
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    block_q = min(block_q, sq)
+    block_k = min(block_k, sk)
+    if sq % block_q or sk % block_k or (causal and sq != sk):
+        return plain_attention(q, k, v, causal=causal)
+    n_kv = sk // block_k
+
+    # (B, L, H, D) -> (B*H, L, D): one grid row per (batch, head)
+    def fold(x):
+        return x.transpose(0, 2, 1, 3).reshape(b * h, x.shape[1], d)
+
+    qf, kf, vf = fold(q), fold(k), fold(v)
+    kernel = functools.partial(
+        _flash_kernel, block_q=block_q, block_k=block_k, n_kv=n_kv,
+        causal=causal, scale=1.0 / float(np.sqrt(d)),
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid=(b * h, sq // block_q, n_kv),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bh, qi, j: (bh, qi, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, qi, j: (bh, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, qi, j: (bh, j, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda bh, qi, j: (bh, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_q,), jnp.float32),
+            pltpu.VMEM((block_q,), jnp.float32),
+            pltpu.VMEM((block_q, d), jnp.float32),
+        ],
+        interpret=_use_interpret(),
+    )(qf, kf, vf)
+    return out.reshape(b, h, sq, d).transpose(0, 2, 1, 3)
+
+
+def flash_attn_fn(block_q: int = 128, block_k: int = 128):
+    """Drop-in ``attn_fn`` for the transformer family."""
+
+    def fn(q, k, v, causal: bool = False):
+        return flash_attention(q, k, v, causal=causal, block_q=block_q, block_k=block_k)
+
+    return fn

@@ -410,3 +410,46 @@ class TestViT:
         assert variables["params"]["pos_embed"].shape == (1, 17, 64)
         logits = m.apply(variables, jnp.ones((3, 32, 32, 3)))
         assert logits.shape == (3, 5)
+
+
+class TestFlashAttentionServing:
+    def test_transformer_served_with_flash_attention(self):
+        from seldon_core_tpu.models.jaxserver import JaxServer
+
+        server = JaxServer(
+            model="transformer_encoder", num_classes=3, input_shape=(32,),
+            dtype="float32", max_batch_size=2, warmup=False,
+            warmup_dtypes=("int32",),
+            model_kwargs={"vocab_size": 64, "d_model": 32, "num_layers": 1,
+                          "num_heads": 2, "max_len": 32, "attention": "flash"},
+        )
+        server.load()
+        out = np.asarray(server.predict(np.zeros((2, 32), np.int32), []))
+        assert out.shape == (2, 3) and np.isfinite(out).all()
+        server.unload()
+
+    def test_unknown_attention_rejected(self):
+        from seldon_core_tpu.models.jaxserver import JaxServer
+        from seldon_core_tpu.runtime.component import MicroserviceError
+
+        server = JaxServer(
+            model="transformer_encoder", num_classes=3, input_shape=(32,),
+            dtype="float32", warmup=False,
+            model_kwargs={"vocab_size": 64, "max_len": 32, "attention": "nope"},
+        )
+        with pytest.raises(MicroserviceError):
+            server.load()
+
+    def test_vit_accepts_flash_attention(self):
+        from seldon_core_tpu.models.jaxserver import JaxServer
+
+        server = JaxServer(
+            model="vit_tiny", num_classes=10, input_shape=(32, 32, 3),
+            dtype="float32", max_batch_size=2, warmup=False,
+            warmup_dtypes=("float32",),
+            model_kwargs={"attention": "flash"},
+        )
+        server.load()
+        out = np.asarray(server.predict(np.zeros((2, 32, 32, 3), np.float32), []))
+        assert out.shape == (2, 10) and np.isfinite(out).all()
+        server.unload()

@@ -75,3 +75,58 @@ class TestInt8Matmul:
         expected = x @ kernel + bias
         # quantisation error bounded relative to activation scale
         assert np.abs(out - expected).max() < 0.1 * np.abs(expected).max()
+
+
+class TestFlashAttention:
+    """Blockwise online-softmax parity with the einsum reference."""
+
+    def _qkv(self, b=2, l=64, h=2, d=16, seed=0):
+        rng = np.random.default_rng(seed)
+        mk = lambda: jnp.asarray(rng.normal(size=(b, l, h, d)), jnp.float32)
+        return mk(), mk(), mk()
+
+    @pytest.mark.parametrize("causal", [False, True], ids=["full", "causal"])
+    def test_parity_with_plain_attention(self, causal):
+        from seldon_core_tpu.ops.kernels import flash_attention
+        from seldon_core_tpu.parallel.ring_attention import plain_attention
+
+        q, k, v = self._qkv()
+        got = flash_attention(q, k, v, causal=causal, block_q=16, block_k=16)
+        want = plain_attention(q, k, v, causal=causal)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-5)
+
+    def test_single_block_and_multi_block_agree(self):
+        from seldon_core_tpu.ops.kernels import flash_attention
+
+        q, k, v = self._qkv(l=32)
+        one = flash_attention(q, k, v, block_q=32, block_k=32)
+        many = flash_attention(q, k, v, block_q=8, block_k=8)
+        np.testing.assert_allclose(np.asarray(one), np.asarray(many), atol=1e-5)
+
+    def test_odd_lengths_fall_back(self):
+        from seldon_core_tpu.ops.kernels import flash_attention
+        from seldon_core_tpu.parallel.ring_attention import plain_attention
+
+        q, k, v = self._qkv(l=50)  # not tileable by 16
+        got = flash_attention(q, k, v, causal=True, block_q=16, block_k=16)
+        want = plain_attention(q, k, v, causal=True)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-5)
+
+    def test_transformer_with_flash_attn(self):
+        import jax
+
+        from seldon_core_tpu.models.transformer import TransformerEncoder
+        from seldon_core_tpu.ops.kernels import flash_attn_fn
+        from seldon_core_tpu.parallel.ring_attention import plain_attention
+
+        tokens = jnp.asarray(np.random.default_rng(0).integers(0, 32, size=(2, 32)))
+        kw = dict(num_classes=3, vocab_size=32, d_model=32, num_layers=1,
+                  num_heads=2, max_len=32, dtype=jnp.float32)
+        flash = TransformerEncoder(attn_fn=flash_attn_fn(block_q=16, block_k=16), **kw)
+        plain = TransformerEncoder(attn_fn=plain_attention, **kw)
+        params = plain.init(jax.random.key(0), tokens)
+        np.testing.assert_allclose(
+            np.asarray(flash.apply(params, tokens)),
+            np.asarray(plain.apply(params, tokens)),
+            atol=1e-4,
+        )
