@@ -452,6 +452,19 @@ async def child_main() -> None:
     except Exception as e:  # noqa: BLE001
         status["extra"]["stub_error"] = str(e)[:200]
 
+    try:
+        native = native_front_qps()
+        if native is not None:
+            native_qps, native_errors = native
+            status["extra"]["native_front_qps"] = round(native_qps, 1)
+            status["extra"]["native_vs_reference_grpc"] = round(
+                native_qps / REFERENCE_GRPC_QPS, 3
+            )
+            if native_errors:
+                status["extra"]["native_front_errors"] = native_errors[:3]
+    except Exception as e:  # noqa: BLE001
+        status["extra"]["native_front_error"] = str(e)[:200]
+
     if os.environ.get("BENCH_INT8", "0") == "1":
         try:
             status["extra"]["int8"] = await int8_phase(shape)
@@ -517,6 +530,85 @@ async def int8_phase(shape) -> dict:
     return out
 
 
+def native_front_qps(seconds: float = 5.0, concurrency: int = 8):
+    """Stub-model QPS through the C++ front server's raw-frame lane —
+    the data-plane number directly comparable to the reference's
+    published engine benchmark (28,256 req/s gRPC,
+    reference: doc/source/reference/benchmarking.md:54-58).  The C++
+    ingress parses HTTP, decodes the SRT1 binary tensor frame, batches,
+    and calls the stub entirely outside Python.  Returns
+    (qps, worker_errors), or None when the native library is
+    unavailable."""
+    import socket
+    import threading
+
+    import numpy as np
+
+    try:
+        from seldon_core_tpu.native.frontserver import (
+            NativeFrontServer,
+            pack_raw_frame,
+        )
+
+        server = NativeFrontServer(stub=True, feature_dim=4, out_dim=3, model_name="stub")
+    except Exception:  # noqa: BLE001 — no native lib on this host
+        return None
+
+    with server as srv:
+        frame = pack_raw_frame(np.ones((1, 4), np.float32))
+        head = (
+            "POST /api/v0.1/predictions HTTP/1.1\r\nHost: bench\r\n"
+            "Content-Type: application/x-seldon-raw\r\n"
+            f"Content-Length: {len(frame)}\r\n\r\n"
+        ).encode()
+        payload = head + frame
+        stop_at = time.perf_counter() + seconds
+        counts = []
+
+        errors = []
+
+        def worker():
+            n = 0
+            sock = None
+            try:
+                sock = socket.create_connection(("127.0.0.1", srv.port))
+                sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                buf = b""
+                while time.perf_counter() < stop_at:
+                    sock.sendall(payload)
+                    while b"\r\n\r\n" not in buf:
+                        chunk = sock.recv(65536)
+                        if not chunk:  # server closed the connection
+                            raise ConnectionError("server closed mid-response")
+                        buf += chunk
+                    headers, _, rest = buf.partition(b"\r\n\r\n")
+                    length = next(
+                        int(line.split(b":")[1])
+                        for line in headers.split(b"\r\n")
+                        if line.lower().startswith(b"content-length")
+                    )
+                    while len(rest) < length:
+                        chunk = sock.recv(65536)
+                        if not chunk:
+                            raise ConnectionError("server closed mid-body")
+                        rest += chunk
+                    buf = rest[length:]
+                    n += 1
+            except Exception as e:  # noqa: BLE001 — a dead worker must not hide
+                errors.append(str(e)[:120])
+            finally:
+                if sock is not None:
+                    sock.close()
+                counts.append(n)  # partial counts still contribute
+
+        threads = [threading.Thread(target=worker) for _ in range(concurrency)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        return sum(counts) / seconds, errors
+
+
 if __name__ == "__main__":
     if os.environ.get("BENCH_CHILD") == "1":
         import asyncio
@@ -524,3 +616,4 @@ if __name__ == "__main__":
         asyncio.run(child_main())
     else:
         supervise()
+

@@ -171,7 +171,7 @@ class Int8Dense:
 
 def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
                   block_q: int, block_k: int, n_kv: int, causal: bool,
-                  scale: float):
+                  scale: float, valid_k: int):
     """Grid cell (batch*head, q-block, kv-block): the kv axis is the
     innermost grid dimension, so the online-softmax carry lives in VMEM
     scratch across kv steps — KV streams block-by-block from HBM and
@@ -201,14 +201,16 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
         k = k_ref[0].astype(jnp.float32)  # (block_k, d)
         v = v_ref[0].astype(jnp.float32)
         s = q @ k.T
+        k_pos = j * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1
+        )
         if causal:
             q_pos = qi * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0
             )
-            k_pos = j * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1
-            )
             s = jnp.where(k_pos > q_pos, -jnp.inf, s)
+        if valid_k % block_k:  # tail block carries sequence padding
+            s = jnp.where(k_pos >= valid_k, -jnp.inf, s)
         m = m_ref[...]
         blk_max = jnp.max(s, axis=-1)
         new_m = jnp.maximum(m, blk_max)
@@ -234,8 +236,9 @@ def flash_attention(q, k, v, causal: bool = False, block_q: int = 128, block_k: 
 
     Shapes follow plain_attention: (batch, seq, heads, head_dim).  The
     per-chip counterpart of ring attention (which shards ACROSS chips;
-    this streams WITHIN one chip's sequence shard).  Falls back to the
-    einsum path when the sequence does not tile.
+    this streams WITHIN one chip's sequence shard).  Non-tiling lengths
+    are block-padded (padded keys masked in-kernel); only cross-length
+    causal falls back to the einsum path.
     """
     import jax
     import jax.numpy as jnp
@@ -246,11 +249,30 @@ def flash_attention(q, k, v, causal: bool = False, block_q: int = 128, block_k: 
 
     b, sq, h, d = q.shape
     sk = k.shape[1]
+    if causal and sq != sk:
+        # cross-length causal has no absolute-position convention here
+        return plain_attention(q, k, v, causal=causal)
     block_q = min(block_q, sq)
     block_k = min(block_k, sk)
-    if sq % block_q or sk % block_k or (causal and sq != sk):
-        return plain_attention(q, k, v, causal=causal)
-    n_kv = sk // block_k
+    # non-tiling lengths (e.g. ViT's 197 tokens) pad up to the block
+    # grid; padded keys are masked inside the kernel, padded query rows
+    # are sliced off the output
+    pad_q = (-sq) % block_q
+    pad_k = (-sk) % block_k
+    valid_k = sk
+    if pad_q or pad_k:
+        cfg = [(0, 0), (0, 0), (0, 0), (0, 0)]
+        if pad_q:
+            qcfg = list(cfg)
+            qcfg[1] = (0, pad_q)
+            q = jnp.pad(q, qcfg)
+        if pad_k:
+            kcfg = list(cfg)
+            kcfg[1] = (0, pad_k)
+            k = jnp.pad(k, kcfg)
+            v = jnp.pad(v, kcfg)
+    sq_p, sk_p = sq + pad_q, sk + pad_k
+    n_kv = sk_p // block_k
 
     # (B, L, H, D) -> (B*H, L, D): one grid row per (batch, head)
     def fold(x):
@@ -259,18 +281,18 @@ def flash_attention(q, k, v, causal: bool = False, block_q: int = 128, block_k: 
     qf, kf, vf = fold(q), fold(k), fold(v)
     kernel = functools.partial(
         _flash_kernel, block_q=block_q, block_k=block_k, n_kv=n_kv,
-        causal=causal, scale=1.0 / float(np.sqrt(d)),
+        causal=causal, scale=1.0 / float(np.sqrt(d)), valid_k=valid_k,
     )
     out = pl.pallas_call(
         kernel,
-        grid=(b * h, sq // block_q, n_kv),
+        grid=(b * h, sq_p // block_q, n_kv),
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda bh, qi, j: (bh, qi, 0)),
             pl.BlockSpec((1, block_k, d), lambda bh, qi, j: (bh, j, 0)),
             pl.BlockSpec((1, block_k, d), lambda bh, qi, j: (bh, j, 0)),
         ],
         out_specs=pl.BlockSpec((1, block_q, d), lambda bh, qi, j: (bh, qi, 0)),
-        out_shape=jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((b * h, sq_p, d), q.dtype),
         scratch_shapes=[
             pltpu.VMEM((block_q,), jnp.float32),
             pltpu.VMEM((block_q,), jnp.float32),
@@ -278,7 +300,8 @@ def flash_attention(q, k, v, causal: bool = False, block_q: int = 128, block_k: 
         ],
         interpret=_use_interpret(),
     )(qf, kf, vf)
-    return out.reshape(b, h, sq, d).transpose(0, 2, 1, 3)
+    out = out.reshape(b, h, sq_p, d).transpose(0, 2, 1, 3)
+    return out[:, :sq] if pad_q else out
 
 
 def flash_attn_fn(block_q: int = 128, block_k: int = 128):

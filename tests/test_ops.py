@@ -103,14 +103,19 @@ class TestFlashAttention:
         many = flash_attention(q, k, v, block_q=8, block_k=8)
         np.testing.assert_allclose(np.asarray(one), np.asarray(many), atol=1e-5)
 
-    def test_odd_lengths_fall_back(self):
+    def test_odd_lengths_pad_through_the_kernel(self):
         from seldon_core_tpu.ops.kernels import flash_attention
         from seldon_core_tpu.parallel.ring_attention import plain_attention
 
-        q, k, v = self._qkv(l=50)  # not tileable by 16
-        got = flash_attention(q, k, v, causal=True, block_q=16, block_k=16)
-        want = plain_attention(q, k, v, causal=True)
-        np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-5)
+        for l in (50, 197):  # 197 = ViT-base token count (prime)
+            q, k, v = self._qkv(l=l)
+            for causal in (False, True):
+                got = flash_attention(q, k, v, causal=causal, block_q=16, block_k=16)
+                want = plain_attention(q, k, v, causal=causal)
+                np.testing.assert_allclose(
+                    np.asarray(got), np.asarray(want), atol=1e-5,
+                    err_msg=f"l={l} causal={causal}",
+                )
 
     def test_transformer_with_flash_attn(self):
         import jax
