@@ -593,6 +593,12 @@ def native_front_qps(seconds: float = 5.0, concurrency: int = 8):
                             raise ConnectionError("server closed mid-body")
                         rest += chunk
                     buf = rest[length:]
+                    # only 2xx responses count — a regression answering
+                    # cheap 400s must not inflate the headline QPS
+                    if not headers.startswith(b"HTTP/1.1 2"):
+                        raise RuntimeError(
+                            f"non-2xx response: {headers.split(chr(13).encode())[0][:60]!r}"
+                        )
                     n += 1
             except Exception as e:  # noqa: BLE001 — a dead worker must not hide
                 errors.append(str(e)[:120])

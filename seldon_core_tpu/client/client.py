@@ -68,10 +68,8 @@ class SeldonTpuClient:
 
     # ------------------------------------------------------------- internals
 
-    def _grpc_call(self, service: str, method: str, request_proto):
+    def _ensure_channel(self):
         import grpc
-
-        from seldon_core_tpu.proto import services
 
         if self._channel is None:
             addr = f"{self.host}:{self.grpc_port}"
@@ -83,11 +81,18 @@ class SeldonTpuClient:
                 )
             else:
                 self._channel = grpc.insecure_channel(addr)
-        call = services.unary_callable(self._channel, service, method)
-        metadata = []
+        return self._channel
+
+    def _call_metadata(self):
         if self.call_credentials is not None and self.call_credentials.token:
-            metadata.append(("x-auth-token", self.call_credentials.token))
-        return call(request_proto, timeout=self.timeout_s, metadata=metadata or None)
+            return [("x-auth-token", self.call_credentials.token)]
+        return None
+
+    def _grpc_call(self, service: str, method: str, request_proto):
+        from seldon_core_tpu.proto import services
+
+        call = services.unary_callable(self._ensure_channel(), service, method)
+        return call(request_proto, timeout=self.timeout_s, metadata=self._call_metadata())
 
     def _rest_post(self, path: str, body: Dict[str, Any]) -> Tuple[int, Dict[str, Any]]:
         import requests
@@ -169,6 +174,30 @@ class SeldonTpuClient:
                                                   "strData" in body or "jsonData" in body) else \
             InternalMessage(kind="jsonData", status=body.get("status"))
         return ClientResponse(code < 400 and self._success(out), out, body)
+
+    def predict_stream(
+        self,
+        data: Any = None,
+        names: Optional[List[str]] = None,
+        payload_kind: Optional[str] = None,
+        meta: Optional[Dict[str, Any]] = None,
+        chunk_bytes: Optional[int] = None,
+    ) -> ClientResponse:
+        """Chunked predict over gRPC streaming — for payloads beyond the
+        unary message limits (additive to the reference contract)."""
+        from seldon_core_tpu.proto import pb, services
+
+        if self.transport != "grpc":
+            raise ValueError("predict_stream requires transport='grpc'")
+        msg = self._build_message(data, names, payload_kind, meta)
+        call = services.stream_callable(self._ensure_channel(), "Seldon", "PredictStream")
+        chunks = services.chunk_message(
+            msg.to_proto(), chunk_bytes or services.STREAM_CHUNK_BYTES
+        )
+        reply_chunks = call(chunks, timeout=self.timeout_s, metadata=self._call_metadata())
+        proto = services.assemble_chunks(reply_chunks, pb.SeldonMessage)
+        out = InternalMessage.from_proto(proto)
+        return ClientResponse(self._success(out), out, proto)
 
     def feedback(
         self,

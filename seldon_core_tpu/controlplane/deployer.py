@@ -515,7 +515,9 @@ def main(argv: Optional[List[str]] = None) -> None:
 
         deployer = Deployer()
         await deployer.apply(spec)
-        await serve_deployment(
+        # the handles MUST stay referenced: a garbage-collected sync
+        # grpc.Server stops itself, silently dropping the gRPC listener
+        handles = await serve_deployment(
             deployer, spec.name, host=args.host, http_port=args.http_port, grpc_port=args.grpc_port
         )
         # SIGTERM/SIGINT must tear the deployment down — supervised
@@ -527,6 +529,7 @@ def main(argv: Optional[List[str]] = None) -> None:
         await stop.wait()
         logger.info("shutting down deployment %s", spec.name)
         await deployer.delete(spec.name)
+        del handles  # keeps the servers alive until shutdown
 
     asyncio.run(_run())
 

@@ -259,8 +259,37 @@ def add_seldon_service(server: grpc.aio.Server, gateway: Gateway) -> None:
         out = await gateway.send_feedback(fb)
         return out.to_proto()
 
+    async def predict_stream(request_iterator, context):
+        """Chunked predict: reassemble -> predict -> stream the reply.
+
+        The stream lane has its own total-size cap (the per-frame gRPC
+        limit no longer bounds memory once frames accumulate)."""
+        parts = []
+        total = 0
+        async for chunk in request_iterator:
+            total += len(chunk.data)
+            if total > services.STREAM_MAX_BYTES:
+                await context.abort(
+                    grpc.StatusCode.RESOURCE_EXHAUSTED,
+                    f"stream exceeds {services.STREAM_MAX_BYTES} bytes",
+                )
+            parts.append(chunk.data)
+        request = pb.SeldonMessage.FromString(b"".join(parts))
+        out = await gateway.predict(InternalMessage.from_proto(request))
+        for chunk in services.chunk_message(out.to_proto()):
+            yield chunk
+
     server.add_generic_rpc_handlers(
-        (services.generic_handler("Seldon", {"Predict": predict, "SendFeedback": send_feedback}),)
+        (
+            services.generic_handler(
+                "Seldon",
+                {
+                    "Predict": predict,
+                    "SendFeedback": send_feedback,
+                    "PredictStream": predict_stream,
+                },
+            ),
+        )
     )
 
 

@@ -59,6 +59,24 @@ class SyncSeldonService:
         out = self._bridge(self.gateway.send_feedback(fb))
         return out.to_proto()
 
+    def predict_stream(self, request_iterator, context):
+        """Chunked predict: reassemble on the handler thread, run the
+        ordinary predict path, stream the reply back in chunks.  Bounded
+        by the stream lane's own total-size cap."""
+        parts = []
+        total = 0
+        for chunk in request_iterator:
+            total += len(chunk.data)
+            if total > services.STREAM_MAX_BYTES:
+                context.abort(
+                    grpc.StatusCode.RESOURCE_EXHAUSTED,
+                    f"stream exceeds {services.STREAM_MAX_BYTES} bytes",
+                )
+            parts.append(chunk.data)
+        request = pb.SeldonMessage.FromString(b"".join(parts))
+        reply = self.predict(request, context)
+        yield from services.chunk_message(reply)
+
 
 def build_sync_seldon_server(
     gateway,
@@ -77,7 +95,12 @@ def build_sync_seldon_server(
     server.add_generic_rpc_handlers(
         (
             services.generic_handler(
-                "Seldon", {"Predict": service.predict, "SendFeedback": service.send_feedback}
+                "Seldon",
+                {
+                    "Predict": service.predict,
+                    "SendFeedback": service.send_feedback,
+                    "PredictStream": service.predict_stream,
+                },
             ),
         )
     )

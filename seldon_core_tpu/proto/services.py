@@ -48,6 +48,41 @@ SERVICES: Dict[str, Dict[str, Tuple[type, type]]] = {
 }
 
 
+# stream-stream methods (transport-level chunking; additive to the
+# reference contract): method -> (chunk class in, chunk class out)
+STREAM_METHODS: Dict[str, Dict[str, Tuple[type, type]]] = {
+    "Seldon": {
+        "PredictStream": (pb.MessageChunk, pb.MessageChunk),
+    },
+}
+
+# default chunk payload size for the streaming lanes (1 MiB keeps each
+# frame comfortably under any configured gRPC message cap)
+STREAM_CHUNK_BYTES = 1 << 20
+
+# total reassembled-message cap for a stream (env-overridable): the
+# per-frame gRPC limit stops bounding memory once frames accumulate,
+# so the stream lane enforces its own ceiling
+import os as _os
+
+STREAM_MAX_BYTES = int(_os.environ.get("SELDON_STREAM_MAX_BYTES", str(2 << 30)))
+
+
+def chunk_message(msg, chunk_bytes: int = STREAM_CHUNK_BYTES):
+    """Serialize a proto message into a MessageChunk iterator."""
+    raw = msg.SerializeToString()
+    if not raw:
+        yield pb.MessageChunk(data=b"")
+        return
+    for off in range(0, len(raw), chunk_bytes):
+        yield pb.MessageChunk(data=raw[off:off + chunk_bytes])
+
+
+def assemble_chunks(chunks, cls):
+    """Reassemble a MessageChunk iterable into a `cls` message."""
+    return cls.FromString(b"".join(c.data for c in chunks))
+
+
 def full_service_name(service: str) -> str:
     return f"{PACKAGE}.{service}"
 
@@ -75,7 +110,26 @@ def generic_handler(service: str, dispatch: Dict[str, Callable]):
             request_deserializer=req_cls.FromString,
             response_serializer=lambda msg, _c=resp_cls: msg.SerializeToString(),
         )
+    for method, (req_cls, resp_cls) in STREAM_METHODS.get(service, {}).items():
+        fn = dispatch.get(method)
+        if fn is None:
+            continue
+        handlers[method] = grpc.stream_stream_rpc_method_handler(
+            fn,
+            request_deserializer=req_cls.FromString,
+            response_serializer=lambda msg, _c=resp_cls: msg.SerializeToString(),
+        )
     return grpc.method_handlers_generic_handler(full_service_name(service), handlers)
+
+
+def stream_callable(channel, service: str, method: str):
+    """Client-side stream-stream callable for service/method."""
+    _req_cls, resp_cls = STREAM_METHODS[service][method]
+    return channel.stream_stream(
+        method_path(service, method),
+        request_serializer=lambda msg: msg.SerializeToString(),
+        response_deserializer=resp_cls.FromString,
+    )
 
 
 def unary_callable(channel, service: str, method: str):

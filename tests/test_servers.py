@@ -535,3 +535,97 @@ class TestSyncFastPath:
 
         run(scenario())
         assert seen == [0.5]
+
+
+class TestPredictStream:
+    """Chunked gRPC predict: payloads beyond the unary message limits
+    ride a MessageChunk stream (additive to the reference contract)."""
+
+    def _serve(self, max_message_bytes):
+        import threading
+
+        from seldon_core_tpu.engine.server import Gateway
+        from seldon_core_tpu.engine.service import PredictorService
+        from seldon_core_tpu.engine.sync_server import build_sync_seldon_server
+        from seldon_core_tpu.engine.graph import UnitSpec
+        from seldon_core_tpu.runtime import TPUComponent
+
+        class Echo(TPUComponent):
+            def predict(self, X, names, meta=None):
+                return np.asarray(X)
+
+        holder = {}
+        started = threading.Event()
+
+        def runner():
+            async def main():
+                gw = Gateway(
+                    [(PredictorService(UnitSpec(name="m", type="MODEL", component=Echo())), 1.0)]
+                )
+                server = build_sync_seldon_server(
+                    gw, asyncio.get_running_loop(), max_message_bytes=max_message_bytes
+                )
+                holder["port"] = server.add_insecure_port("127.0.0.1:0")
+                server.start()
+                holder["stop"] = asyncio.Event()
+                started.set()
+                await holder["stop"].wait()
+                server.stop(None)
+
+            asyncio.run(main())
+
+        t = threading.Thread(target=runner, daemon=True)
+        t.start()
+        assert started.wait(30)
+        return holder
+
+    def test_large_payload_exceeding_unary_limit(self):
+        from seldon_core_tpu.client.client import SeldonTpuClient
+
+        # 8 MB payload through a server capped at 2 MB unary messages
+        holder = self._serve(max_message_bytes=2 * 1024 * 1024)
+        big = np.random.default_rng(0).normal(size=(1024, 1024)).astype(np.float64)
+        client = SeldonTpuClient(grpc_port=holder["port"], transport="grpc")
+        try:
+            import grpc
+
+            with pytest.raises(grpc.RpcError):  # unary path rejects it
+                client.predict(big, payload_kind="rawTensor")
+            out = client.predict_stream(big, payload_kind="rawTensor")
+            assert out.success
+            np.testing.assert_array_equal(np.asarray(out.data), big)
+        finally:
+            client.close()
+            holder["stop"].set()
+
+    def test_small_payload_roundtrip(self):
+        from seldon_core_tpu.client.client import SeldonTpuClient
+
+        holder = self._serve(max_message_bytes=64 * 1024 * 1024)
+        client = SeldonTpuClient(grpc_port=holder["port"], transport="grpc")
+        try:
+            out = client.predict_stream(np.arange(6.0).reshape(2, 3))
+            assert out.success
+            np.testing.assert_array_equal(np.asarray(out.data), np.arange(6.0).reshape(2, 3))
+            assert out.meta.puid  # full engine semantics on the stream path
+        finally:
+            client.close()
+            holder["stop"].set()
+
+    def test_stream_size_cap_rejected(self, monkeypatch):
+        import grpc
+
+        from seldon_core_tpu.client.client import SeldonTpuClient
+        from seldon_core_tpu.proto import services
+
+        monkeypatch.setattr(services, "STREAM_MAX_BYTES", 1024 * 1024)
+        holder = self._serve(max_message_bytes=64 * 1024 * 1024)
+        client = SeldonTpuClient(grpc_port=holder["port"], transport="grpc")
+        try:
+            big = np.zeros((1024, 1024), np.float64)  # 8 MB > 1 MB cap
+            with pytest.raises(grpc.RpcError) as err:
+                client.predict_stream(big, payload_kind="rawTensor")
+            assert err.value.code() == grpc.StatusCode.RESOURCE_EXHAUSTED
+        finally:
+            client.close()
+            holder["stop"].set()
