@@ -629,3 +629,44 @@ class TestPredictStream:
         finally:
             client.close()
             holder["stop"].set()
+
+
+class TestSKLearnServer:
+    """Behavior test with a real fitted model (reference analogue:
+    servers/sklearnserver + its sample iris flow) — the gated path is
+    exercised beyond the ImportError message."""
+
+    def test_joblib_model_roundtrip(self, tmp_path):
+        sklearn = pytest.importorskip("sklearn")  # noqa: F841
+        import joblib
+        from sklearn.linear_model import LogisticRegression
+
+        from seldon_core_tpu.models.sklearnserver import SKLearnServer
+
+        rng = np.random.default_rng(0)
+        X = rng.normal(size=(64, 4))
+        y = (X[:, 0] + X[:, 1] > 0).astype(int)
+        clf = LogisticRegression().fit(X, y)
+        path = tmp_path / "model.joblib"
+        joblib.dump(clf, path)
+
+        server = SKLearnServer(model_uri=str(path))
+        server.load()
+        probs = np.asarray(server.predict(X[:8], []))
+        assert probs.shape == (8, 2)
+        np.testing.assert_allclose(probs.sum(axis=1), 1.0, atol=1e-6)
+        np.testing.assert_allclose(probs, clf.predict_proba(X[:8]))
+
+    def test_directory_uri_picks_model_file(self, tmp_path):
+        pytest.importorskip("sklearn")
+        import joblib
+        from sklearn.dummy import DummyClassifier
+
+        from seldon_core_tpu.models.sklearnserver import SKLearnServer
+
+        clf = DummyClassifier(strategy="most_frequent").fit([[0.0]], [1])
+        joblib.dump(clf, tmp_path / "model.joblib")
+        server = SKLearnServer(model_uri=str(tmp_path), method="predict")
+        server.load()
+        out = np.asarray(server.predict(np.zeros((3, 1)), []))
+        assert out.tolist() == [1, 1, 1]
