@@ -55,9 +55,12 @@ class HpaSpec:
     min_replicas: int = 1
     max_replicas: int = 4
     # exactly one target should be > 0; the metric_fn passed to the
-    # Autoscaler must produce the matching quantity (total across replicas)
+    # Autoscaler must produce the matching quantity.  qps/inflight are
+    # totals shared across replicas (the per-replica load falls as
+    # replicas rise); latency is a direct signal (p95 ms vs target)
     target_qps_per_replica: float = 0.0
     target_inflight_per_replica: float = 0.0
+    target_p95_ms: float = 0.0
     tolerance: float = 0.1  # k8s horizontal-pod-autoscaler-tolerance
     scale_down_stabilization_s: float = 60.0
     poll_interval_s: float = 2.0
@@ -65,12 +68,29 @@ class HpaSpec:
     def __post_init__(self) -> None:
         if self.min_replicas < 1 or self.max_replicas < self.min_replicas:
             raise ValueError("need 1 <= min_replicas <= max_replicas")
-        if (self.target_qps_per_replica <= 0) == (self.target_inflight_per_replica <= 0):
-            raise ValueError("set exactly one of target_qps_per_replica / target_inflight_per_replica")
+        targets_set = sum(
+            t > 0
+            for t in (
+                self.target_qps_per_replica,
+                self.target_inflight_per_replica,
+                self.target_p95_ms,
+            )
+        )
+        if targets_set != 1:
+            raise ValueError(
+                "set exactly one of target_qps_per_replica / "
+                "target_inflight_per_replica / target_p95_ms"
+            )
 
     @property
     def target(self) -> float:
-        return self.target_qps_per_replica or self.target_inflight_per_replica
+        return self.target_qps_per_replica or self.target_inflight_per_replica or self.target_p95_ms
+
+    @property
+    def per_replica(self) -> bool:
+        """Whether the metric divides across replicas (qps/inflight do;
+        a latency quantile compares against the target directly)."""
+        return self.target_p95_ms <= 0
 
     @classmethod
     def from_dict(cls, d: Dict[str, Any]) -> "HpaSpec":
@@ -92,6 +112,7 @@ class HpaSpec:
             target_inflight_per_replica=float(
                 pick("target_inflight_per_replica", "targetInflight", default=0.0)
             ),
+            target_p95_ms=float(pick("target_p95_ms", "targetP95Ms", default=0.0)),
             tolerance=float(pick("tolerance", default=0.1)),
             scale_down_stabilization_s=float(
                 pick("scale_down_stabilization_s", "stabilizationWindowSeconds", default=60.0)
@@ -274,11 +295,20 @@ class Autoscaler:
         self._thread: Optional[threading.Thread] = None
 
     def _desired(self, metric: float, current: int) -> int:
-        """k8s formula: desired = ceil(current * ratio), dead-banded."""
+        """k8s formula: desired = ceil(current * ratio), dead-banded.
+
+        Latency targets skip the per-replica division: p95 does not
+        halve because a second replica exists, but scaling by the
+        overload ratio still moves capacity the right direction (and a
+        zero-latency idle window never scales up)."""
         if current == 0:
             return self.hpa.min_replicas
-        per_replica = metric / current
-        ratio = per_replica / self.hpa.target
+        if self.hpa.per_replica:
+            ratio = (metric / current) / self.hpa.target
+        else:
+            if metric <= 0:  # no traffic in the window: hold
+                return current
+            ratio = metric / self.hpa.target
         if abs(ratio - 1.0) <= self.hpa.tolerance:
             desired = current
         else:

@@ -124,7 +124,7 @@ def build_generation(spec: TpuDeployment, device_ids: Optional[List[int]] = None
                 # register the replica set before start(): a partial
                 # spawn failure must reach the cleanup handler below
                 replicasets.append(rs)
-                asc = make_autoscaler(svc)
+                asc = make_autoscaler(svc, observer)
                 asc.start()  # spawns min_replicas synchronously, then loops
                 autoscalers.append(asc)
             if p.explainer:
@@ -277,9 +277,22 @@ def _build_autoscaled_root(p, annotations) -> Tuple[Any, Any, Any]:
         on_change=on_change,
     )
 
-    def make_autoscaler(svc: PredictorService) -> Autoscaler:
-        qps = CounterRateSampler(lambda: svc.stats.get("requests", 0))
-        return Autoscaler(rs, hpa, metric_fn=qps)
+    def make_autoscaler(svc: PredictorService, observer=None) -> Autoscaler:
+        if hpa.target_p95_ms > 0:
+            if observer is None:
+                # silently swapping in the QPS counter would compare
+                # requests/sec against a milliseconds target
+                raise DeploymentSpecError(
+                    f"predictor {p.name!r}: target_p95_ms needs the "
+                    "predictor's PrometheusObserver"
+                )
+            from seldon_core_tpu.utils.metrics import api_latency_sampler
+
+            p95 = api_latency_sampler(observer, quantile=0.95)
+            metric_fn = lambda: p95() * 1000.0  # noqa: E731 — seconds -> ms
+        else:
+            metric_fn = CounterRateSampler(lambda: svc.stats.get("requests", 0))
+        return Autoscaler(rs, hpa, metric_fn=metric_fn)
 
     return balanced, rs, make_autoscaler
 

@@ -39,6 +39,7 @@ class _MetricCache:
         self._cache: Dict[Tuple[str, str, Tuple[str, ...]], Any] = {}
         self._lock = threading.Lock()
 
+
     def get(self, kind: str, name: str, labelnames: Tuple[str, ...], documentation: str = ""):
         key = (kind, name, labelnames)
         with self._lock:
@@ -55,6 +56,26 @@ class _MetricCache:
                 metric = cls(name, documentation or name, **kwargs)
                 self._cache[key] = metric
         return metric
+
+
+# one cache per registry: prometheus_client raises Duplicated timeseries
+# on re-registration, so observers sharing a registry (two predictors of
+# one deployment, rolling re-apply in one process) must share the
+# metric objects and differ only in label values
+_CACHES: Dict[int, _MetricCache] = {}
+_CACHES_LOCK = threading.Lock()
+
+
+def _cache_for(registry=None) -> _MetricCache:
+    import prometheus_client as prom
+
+    reg = registry if registry is not None else prom.REGISTRY
+    with _CACHES_LOCK:
+        cache = _CACHES.get(id(reg))
+        if cache is None:
+            cache = _MetricCache(reg)
+            _CACHES[id(reg)] = cache
+        return cache
 
 
 class PrometheusObserver:
@@ -75,7 +96,7 @@ class PrometheusObserver:
     ):
         self.deployment_name = deployment_name
         self.predictor_name = predictor_name
-        self._cache = _MetricCache(registry)
+        self._cache = _cache_for(registry)
 
     # ---- base tags --------------------------------------------------------
 
@@ -150,3 +171,73 @@ class PrometheusObserver:
             self._cache.get("gauge", key, names).labels(**labels).set(value)
         elif mtype == "TIMER":  # milliseconds, like the reference
             self._cache.get("histogram", key, names).labels(**labels).observe(value / 1000.0)
+
+
+class HistogramQuantileSampler:
+    """Windowed quantile over a prometheus Histogram child.
+
+    Each call diffs the cumulative bucket counters against the previous
+    sample and interpolates the quantile from the window's bucket deltas
+    (the same estimate PromQL's histogram_quantile(rate(...)) gives) —
+    the latency signal the autoscaler consumes for target_p95_ms
+    policies.  Returns 0.0 until traffic arrives.
+    """
+
+    def __init__(self, histogram_child, quantile: float = 0.95):
+        self._child = histogram_child
+        self.quantile = float(quantile)
+        self._last: Optional[List[float]] = None
+
+    def _cumulative(self) -> Tuple[List[float], List[float]]:
+        bounds = [float(b) for b in self._child._upper_bounds]  # noqa: SLF001
+        counts = [float(acc.get()) for acc in self._child._buckets]  # noqa: SLF001
+        # _buckets are per-bucket (non-cumulative) in prometheus_client
+        cum = []
+        total = 0.0
+        for c in counts:
+            total += c
+            cum.append(total)
+        return bounds, cum
+
+    def __call__(self) -> float:
+        bounds, cum = self._cumulative()
+        if self._last is None:
+            self._last = cum
+            return 0.0
+        deltas = [c - p for c, p in zip(cum, self._last)]
+        self._last = cum
+        total = deltas[-1]
+        if total <= 0:
+            return 0.0
+        rank = self.quantile * total
+        prev_bound = 0.0
+        prev_cum = 0.0
+        for bound, c in zip(bounds, deltas):
+            if c >= rank:
+                if bound == float("inf"):
+                    return prev_bound
+                span = c - prev_cum
+                frac = (rank - prev_cum) / span if span > 0 else 1.0
+                return prev_bound + frac * (bound - prev_bound)
+            prev_bound, prev_cum = bound, c
+        return prev_bound
+
+
+def api_latency_sampler(
+    observer: "PrometheusObserver", quantile: float = 0.95, method: str = "predictions"
+) -> HistogramQuantileSampler:
+    """Quantile sampler over an observer's server-request histogram
+    (seconds); multiply by 1000 at the call site for ms targets."""
+    labels = {
+        "deployment_name": observer.deployment_name,
+        "predictor_name": observer.predictor_name,
+        "method": method,
+        "code": "200",
+    }
+    hist = observer._cache.get(  # noqa: SLF001 — same module
+        "histogram",
+        "seldon_api_engine_server_requests_duration_seconds",
+        tuple(sorted(labels)),
+        "external API request latency",
+    )
+    return HistogramQuantileSampler(hist.labels(**labels), quantile=quantile)

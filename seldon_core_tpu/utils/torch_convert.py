@@ -116,7 +116,18 @@ def load_torch_state_dict(path: str) -> Dict[str, np.ndarray]:
     """Load a .pt/.pth checkpoint to numpy (no grad state, CPU)."""
     import torch
 
-    obj = torch.load(path, map_location="cpu", weights_only=True)
+    try:
+        obj = torch.load(path, map_location="cpu", weights_only=True)
+    except Exception:  # noqa: BLE001 — real Lightning ckpts carry
+        # non-tensor globals (hyper_parameters, callbacks) the safe
+        # loader rejects; fall back to full unpickling with a warning
+        import logging
+
+        logging.getLogger(__name__).warning(
+            "%s is not loadable with weights_only=True; falling back to "
+            "full unpickling — only convert checkpoints you trust", path
+        )
+        obj = torch.load(path, map_location="cpu", weights_only=False)
     if isinstance(obj, dict) and "state_dict" in obj:  # lightning-style wrapper
         obj = obj["state_dict"]
     sd = {k: v.numpy() if hasattr(v, "numpy") else np.asarray(v) for k, v in obj.items()}
@@ -152,10 +163,13 @@ def main(argv=None) -> None:
     parser.add_argument("--arch", default="resnet50", choices=sorted(RESNET_STAGES))
     args = parser.parse_args(argv)
     variables = convert_checkpoint(args.input, args.output, arch=args.arch)
-    import jax
 
-    n = sum(np.asarray(x).size for x in jax.tree_util.tree_leaves(variables))
-    print(f"converted {args.arch}: {n:,} values -> {args.output}")
+    def count(node) -> int:
+        if isinstance(node, dict):
+            return sum(count(v) for v in node.values())
+        return int(np.asarray(node).size)
+
+    print(f"converted {args.arch}: {count(variables):,} values -> {args.output}")
 
 
 if __name__ == "__main__":

@@ -293,3 +293,64 @@ class TestDeployerHpaIntegration:
                 _time.sleep(0.1)
             else:
                 raise AssertionError(f"replica pid {pid} still alive after delete")
+
+
+class TestLatencyTarget:
+    """target_p95_ms: scale on the latency quantile instead of QPS
+    (k8s-style multi-metric HPA breadth)."""
+
+    def test_spec_accepts_exactly_one_target(self):
+        hpa = HpaSpec(target_p95_ms=50.0)
+        assert hpa.target == 50.0 and not hpa.per_replica
+        with pytest.raises(ValueError):
+            HpaSpec(target_p95_ms=50.0, target_qps_per_replica=10.0)
+
+    def test_latency_ratio_scales_directly(self):
+        rs = FakeReplicaSet(2)
+        clock = FakeClock()
+        metric = {"v": 150.0}  # p95 ms, 3x the target
+        asc = Autoscaler(
+            rs,
+            HpaSpec(target_p95_ms=50.0, max_replicas=8, scale_down_stabilization_s=0),
+            metric_fn=lambda: metric["v"],
+            clock=clock,
+        )
+        assert asc.evaluate_once() == 6  # ceil(2 * 150/50)
+        clock.advance(1)
+        metric["v"] = 0.0  # idle window: hold, never scale on no-traffic
+        assert asc.evaluate_once() == 6
+        clock.advance(1)
+        metric["v"] = 10.0  # healthy: ratio 0.2 -> drains toward min
+        assert asc.evaluate_once() == 2
+
+    def test_histogram_quantile_sampler_windows(self):
+        import prometheus_client as prom
+
+        from seldon_core_tpu.utils.metrics import HistogramQuantileSampler
+
+        reg = prom.CollectorRegistry()
+        h = prom.Histogram("lat_seconds", "d", registry=reg,
+                           buckets=(0.01, 0.05, 0.1, 0.5, 1.0))
+        sampler = HistogramQuantileSampler(h, 0.95)
+        assert sampler() == 0.0  # priming
+        for _ in range(90):
+            h.observe(0.03)
+        for _ in range(10):
+            h.observe(0.4)
+        p95 = sampler()
+        assert 0.08 < p95 < 0.55
+        assert sampler() == 0.0  # no traffic since last sample
+
+    def test_api_latency_sampler_reads_observer_histogram(self):
+        import prometheus_client as prom
+
+        from seldon_core_tpu.utils.metrics import PrometheusObserver, api_latency_sampler
+
+        obs = PrometheusObserver(
+            deployment_name="d", predictor_name="p", registry=prom.CollectorRegistry()
+        )
+        sampler = api_latency_sampler(obs)
+        sampler()  # prime
+        for _ in range(100):
+            obs("predict_done", "m", 0.2)
+        assert 0.05 < sampler() <= 0.5

@@ -352,3 +352,27 @@ class TestKafkaPairLogger:
         assert pair["response"]["data"]["ndarray"] == [[0.9]]
         logger.close()
         assert logger._producer.flushed and logger._producer.closed
+
+
+class TestSharedRegistryObservers:
+    def test_two_observers_one_registry_no_duplicate_timeseries(self):
+        """Two predictors of one deployment (or a rolling re-apply)
+        share the process registry; metric objects must be shared, with
+        only label values differing."""
+        import prometheus_client as prom
+
+        from seldon_core_tpu.utils.metrics import PrometheusObserver, api_latency_sampler
+
+        registry = prom.CollectorRegistry()
+        a = PrometheusObserver("dep", "main", registry=registry)
+        b = PrometheusObserver("dep", "canary", registry=registry)
+        # both paths that register metrics must not collide
+        a("predict_done", "m", 0.01)
+        b("predict_done", "m", 0.02)
+        sampler_a = api_latency_sampler(a)
+        sampler_b = api_latency_sampler(b)
+        sampler_a(), sampler_b()  # prime both without raising
+        for _ in range(10):
+            a("predict_done", "m", 0.2)
+        assert sampler_a() > 0.0
+        assert sampler_b() == 0.0  # canary saw no traffic
