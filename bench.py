@@ -567,6 +567,8 @@ def native_front_qps(seconds: float = 5.0, concurrency: int = 8):
 
         errors = []
 
+        from seldon_core_tpu.native.frontserver import read_http_response
+
         def worker():
             n = 0
             sock = None
@@ -576,29 +578,11 @@ def native_front_qps(seconds: float = 5.0, concurrency: int = 8):
                 buf = b""
                 while time.perf_counter() < stop_at:
                     sock.sendall(payload)
-                    while b"\r\n\r\n" not in buf:
-                        chunk = sock.recv(65536)
-                        if not chunk:  # server closed the connection
-                            raise ConnectionError("server closed mid-response")
-                        buf += chunk
-                    headers, _, rest = buf.partition(b"\r\n\r\n")
-                    length = next(
-                        int(line.split(b":")[1])
-                        for line in headers.split(b"\r\n")
-                        if line.lower().startswith(b"content-length")
-                    )
-                    while len(rest) < length:
-                        chunk = sock.recv(65536)
-                        if not chunk:
-                            raise ConnectionError("server closed mid-body")
-                        rest += chunk
-                    buf = rest[length:]
+                    status, _body, buf = read_http_response(sock, buf)
                     # only 2xx responses count — a regression answering
                     # cheap 400s must not inflate the headline QPS
-                    if not headers.startswith(b"HTTP/1.1 2"):
-                        raise RuntimeError(
-                            f"non-2xx response: {headers.split(chr(13).encode())[0][:60]!r}"
-                        )
+                    if not 200 <= status < 300:
+                        raise RuntimeError(f"non-2xx response: {status}")
                     n += 1
             except Exception as e:  # noqa: BLE001 — a dead worker must not hide
                 errors.append(str(e)[:120])

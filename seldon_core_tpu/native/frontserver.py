@@ -364,6 +364,48 @@ def pack_raw_frame(arr: np.ndarray) -> bytes:
     return head + shape + arr.tobytes()
 
 
+class StaleConnection(ConnectionError):
+    """A reused keep-alive socket was closed by the peer before any
+    response byte — the one case a client may transparently retry."""
+
+
+def read_http_response(sock, buf: bytes, timeout_s: Optional[float] = None):
+    """Blocking HTTP/1.1 response read on a keep-alive socket.
+
+    Returns (status_code, body, remaining_buffer).  Raises
+    StaleConnection when the peer closed before ANY byte arrived (safe
+    to retry on a fresh connection); ConnectionError on mid-response
+    close.  Shared by the SDK's RawFrameClient and the bench's
+    native-front workers so the parsing logic cannot drift.
+    """
+    if timeout_s is not None:
+        sock.settimeout(timeout_s)
+    got_any = bool(buf)
+    while b"\r\n\r\n" not in buf:
+        chunk = sock.recv(65536)
+        if not chunk:
+            if not got_any:
+                raise StaleConnection("peer closed an idle keep-alive socket")
+            raise ConnectionError("server closed mid-response")
+        got_any = True
+        buf += chunk
+    headers, _, rest = buf.partition(b"\r\n\r\n")
+    status = int(headers.split(b" ", 2)[1])
+    length = None
+    for line in headers.split(b"\r\n"):
+        if line.lower().startswith(b"content-length"):
+            length = int(line.split(b":")[1])
+            break
+    if length is None:
+        raise ConnectionError("response carries no Content-Length")
+    while len(rest) < length:
+        chunk = sock.recv(65536)
+        if not chunk:
+            raise ConnectionError("server closed mid-body")
+        rest += chunk
+    return status, rest[:length], rest[length:]
+
+
 def unpack_raw_frame(data: bytes) -> np.ndarray:
     """Decode a binary raw-tensor frame (SRT1) into an array."""
     import struct
