@@ -45,6 +45,9 @@ def _register_optional() -> None:
     from seldon_core_tpu.models.generate import GenerativeLM
 
     register_implementation("GENERATIVE_LM", GenerativeLM)
+    from seldon_core_tpu.models.paged import StreamingLM
+
+    register_implementation("STREAMING_LM", StreamingLM)
     # Reference's TENSORFLOW_SERVER prepackaged proxy
     # (operator/controllers/seldondeployment_prepackaged_servers.go:109)
     register_implementation("TENSORFLOW_SERVER", TFServingGrpcProxy)

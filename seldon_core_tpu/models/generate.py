@@ -260,6 +260,10 @@ class GenerativeLM(TPUComponent):
         self.model_uri = model_uri
         self.seed = int(seed)
         self.generator: Optional[Generator] = None
+        import threading
+
+        self._counter = 0
+        self._counter_lock = threading.Lock()
 
     def load(self) -> None:
         import jax
@@ -299,8 +303,9 @@ class GenerativeLM(TPUComponent):
 
                 request_seed = zlib.crc32(puid.encode())
             else:
-                self._counter = getattr(self, "_counter", 0) + 1
-                request_seed = self._counter
+                with self._counter_lock:
+                    self._counter += 1
+                    request_seed = self._counter
         out = self.generator.generate(
             np.asarray(X),
             max_new_tokens=int(tags.get("max_new_tokens", self.max_new_tokens)),
