@@ -10,10 +10,17 @@ random-payload generation by shape for smoke tests.
 from __future__ import annotations
 
 import dataclasses
+import socket
 from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
 
 import numpy as np
 
+from seldon_core_tpu.native.frontserver import (
+    StaleConnection,
+    pack_raw_frame,
+    read_http_response,
+    unpack_raw_frame,
+)
 from seldon_core_tpu.runtime.message import InternalFeedback, InternalMessage
 
 
@@ -293,8 +300,6 @@ class RawFrameClient:
         self._buf = b""
 
     def _connect(self):
-        import socket
-
         sock = socket.create_connection((self.host, self.port), timeout=self.timeout_s)
         sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         return sock
@@ -309,15 +314,6 @@ class RawFrameClient:
         after a timeout would duplicate in-flight work on an already
         slow server.
         """
-        import socket as socket_mod
-
-        from seldon_core_tpu.native.frontserver import (
-            StaleConnection,
-            pack_raw_frame,
-            read_http_response,
-            unpack_raw_frame,
-        )
-
         frame = pack_raw_frame(np.asarray(arr))
         head = (
             f"POST {self.path} HTTP/1.1\r\nHost: {self.host}\r\n"
@@ -331,20 +327,31 @@ class RawFrameClient:
                 self._buf = b""
             try:
                 self._sock.sendall(head + frame)
+            except (ConnectionError, OSError) as e:
+                # send failed: the server never received the full request,
+                # so a resend cannot duplicate work — retry once when the
+                # reused socket turned out to be idle-closed
+                self.close()
+                if attempt or fresh or not isinstance(
+                    e, (BrokenPipeError, ConnectionResetError)
+                ):
+                    raise
+                continue
+            try:
                 status, body, self._buf = read_http_response(
                     self._sock, self._buf, timeout_s=self.timeout_s
                 )
                 break
-            except socket_mod.timeout:
+            except StaleConnection:
+                # clean close before ANY response byte on a reused socket
+                self.close()
+                if attempt or fresh:
+                    raise
+            except (ConnectionError, OSError):
+                # timeout / reset / mid-response close AFTER the server had
+                # the request: it may have been processed — never resend
                 self.close()
                 raise
-            except (StaleConnection, ConnectionError, OSError) as e:
-                retryable = not fresh and (
-                    isinstance(e, (StaleConnection, BrokenPipeError, ConnectionResetError))
-                )
-                self.close()
-                if attempt or not retryable:
-                    raise
         if status >= 400:
             raise RuntimeError(f"front server returned {status}: {body[:200]!r}")
         return unpack_raw_frame(body)

@@ -373,21 +373,36 @@ def read_http_response(sock, buf: bytes, timeout_s: Optional[float] = None):
     """Blocking HTTP/1.1 response read on a keep-alive socket.
 
     Returns (status_code, body, remaining_buffer).  Raises
-    StaleConnection when the peer closed before ANY byte arrived (safe
-    to retry on a fresh connection); ConnectionError on mid-response
-    close.  Shared by the SDK's RawFrameClient and the bench's
-    native-front workers so the parsing logic cannot drift.
+    StaleConnection when the peer closed before ANY byte arrived — a
+    clean FIN *or* an RST (the usual idle-keep-alive race: a small send
+    lands in the kernel buffer after the peer's FIN, the peer answers
+    RST, and recv fails with ConnectionResetError before any response
+    byte) — safe to retry on a fresh connection.  ConnectionError on
+    mid-response close/reset.  Shared by the SDK's RawFrameClient and
+    the bench's native-front workers so the parsing logic cannot drift.
     """
     if timeout_s is not None:
         sock.settimeout(timeout_s)
     got_any = bool(buf)
+
+    def _recv():
+        nonlocal got_any
+        try:
+            chunk = sock.recv(65536)
+        except ConnectionResetError as e:
+            if not got_any:
+                raise StaleConnection("peer reset an idle keep-alive socket") from e
+            raise ConnectionError("server reset mid-response") from e
+        if chunk:
+            got_any = True
+        return chunk
+
     while b"\r\n\r\n" not in buf:
-        chunk = sock.recv(65536)
+        chunk = _recv()
         if not chunk:
             if not got_any:
                 raise StaleConnection("peer closed an idle keep-alive socket")
             raise ConnectionError("server closed mid-response")
-        got_any = True
         buf += chunk
     headers, _, rest = buf.partition(b"\r\n\r\n")
     status = int(headers.split(b" ", 2)[1])
@@ -399,7 +414,7 @@ def read_http_response(sock, buf: bytes, timeout_s: Optional[float] = None):
     if length is None:
         raise ConnectionError("response carries no Content-Length")
     while len(rest) < length:
-        chunk = sock.recv(65536)
+        chunk = _recv()
         if not chunk:
             raise ConnectionError("server closed mid-body")
         rest += chunk

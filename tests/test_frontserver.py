@@ -828,3 +828,50 @@ class TestRawFrameClient:
             with RawFrameClient(port=srv.port, path="/not-fast-lane") as client:
                 with pytest.raises(RuntimeError, match="503"):
                     client.predict(np.ones((2, 9), np.float32))
+
+
+class TestReadHttpResponseResetSemantics:
+    """RST handling in the shared response reader: reset before ANY
+    byte on a reused socket is the idle-keep-alive race (retryable,
+    StaleConnection); reset mid-response is not."""
+
+    class _Sock:
+        def __init__(self, script):
+            self.script = list(script)
+
+        def settimeout(self, t):
+            pass
+
+        def recv(self, n):
+            item = self.script.pop(0)
+            if isinstance(item, Exception):
+                raise item
+            return item
+
+    def test_rst_before_any_byte_is_stale(self):
+        sock = self._Sock([ConnectionResetError()])
+        with pytest.raises(fsmod.StaleConnection):
+            fsmod.read_http_response(sock, b"")
+
+    def test_rst_mid_headers_is_not_stale(self):
+        sock = self._Sock([b"HTTP/1.1 200 OK\r\n", ConnectionResetError()])
+        with pytest.raises(ConnectionError) as ei:
+            fsmod.read_http_response(sock, b"")
+        assert not isinstance(ei.value, fsmod.StaleConnection)
+
+    def test_rst_mid_body_is_not_stale(self):
+        sock = self._Sock([
+            b"HTTP/1.1 200 OK\r\nContent-Length: 5\r\n\r\nab",
+            ConnectionResetError(),
+        ])
+        with pytest.raises(ConnectionError) as ei:
+            fsmod.read_http_response(sock, b"")
+        assert not isinstance(ei.value, fsmod.StaleConnection)
+
+    def test_leftover_buffer_counts_as_received(self):
+        # bytes already buffered from this response mean a reset is
+        # mid-response even if recv never returned anything
+        sock = self._Sock([ConnectionResetError()])
+        with pytest.raises(ConnectionError) as ei:
+            fsmod.read_http_response(sock, b"HTTP/1.1 2")
+        assert not isinstance(ei.value, fsmod.StaleConnection)
