@@ -30,6 +30,15 @@ class VisionTransformer(nn.Module):
     mlp_ratio: int = 4
     dtype: Any = jnp.bfloat16
     attn_fn: AttnFn = staticmethod(plain_attention)
+    # native position-embedding grid (e.g. 14 for 224/16).  When set,
+    # the pos_embed param is declared at this grid and bicubically
+    # resized to the runtime patch grid, so ONE checkpoint serves any
+    # resolution divisible by patch_size (interpolation is resolved at
+    # trace time — each served resolution is its own XLA program, the
+    # same bucket-ladder compile discipline as everywhere else).
+    # 0 = legacy behavior: param shape follows the first input seen and
+    # only that resolution is servable.
+    pos_grid: int = 0
 
     @nn.compact
     def __call__(self, x, train: bool = False):
@@ -53,12 +62,28 @@ class VisionTransformer(nn.Module):
         cls = self.param("cls_token", nn.initializers.zeros, (1, 1, self.d_model))
         x = jnp.concatenate([jnp.asarray(cls, self.dtype).repeat(b, 0), x], axis=1)
         n_tokens = x.shape[1]
-        # ViT serves ONE resolution: applying params trained at another
-        # resolution fails in flax's param shape check on this line
-        # (position interpolation is out of scope)
-        pos = self.param(
-            "pos_embed", nn.initializers.normal(0.02), (1, n_tokens, self.d_model)
-        )
+        if self.pos_grid:
+            g = self.pos_grid
+            pos = self.param(
+                "pos_embed", nn.initializers.normal(0.02), (1, g * g + 1, self.d_model)
+            )
+            if (h, w) != (g, g):
+                import jax
+
+                cls_pos, grid_pos = pos[:, :1], pos[:, 1:]
+                grid_pos = jax.image.resize(
+                    jnp.asarray(grid_pos, jnp.float32).reshape(1, g, g, self.d_model),
+                    (1, h, w, self.d_model),
+                    method="bicubic",
+                ).reshape(1, h * w, self.d_model)
+                pos = jnp.concatenate([jnp.asarray(cls_pos, jnp.float32), grid_pos], axis=1)
+        else:
+            # legacy single-resolution mode: the param takes the shape of
+            # the first input seen; other resolutions fail flax's shape
+            # check here — set pos_grid to serve multiple resolutions
+            pos = self.param(
+                "pos_embed", nn.initializers.normal(0.02), (1, n_tokens, self.d_model)
+            )
         x = x + jnp.asarray(pos, self.dtype)
         for i in range(self.num_layers):
             x = TransformerBlock(
@@ -75,21 +100,29 @@ class VisionTransformer(nn.Module):
 
 
 class ViTTiny(VisionTransformer):
-    """Small config for tests and the CPU tier (serve at 32x32)."""
+    """Small config for tests and the CPU tier (native 32x32).
+
+    pos_grid anchors the pos_embed param at the native grid — the param
+    shape is unchanged from the single-resolution era, so round-2
+    checkpoints load as-is while other resolutions interpolate.
+    """
 
     patch_size: int = 8
     d_model: int = 64
     num_layers: int = 2
     num_heads: int = 4
+    pos_grid: int = 4  # 32 / 8
 
 
 class ViTBase16(VisionTransformer):
     d_model: int = 768
     num_layers: int = 12
     num_heads: int = 12
+    pos_grid: int = 14  # 224 / 16
 
 
 class ViTLarge16(VisionTransformer):
     d_model: int = 1024
     num_layers: int = 24
     num_heads: int = 16
+    pos_grid: int = 14  # 224 / 16

@@ -411,6 +411,60 @@ class TestViT:
         logits = m.apply(variables, jnp.ones((3, 32, 32, 3)))
         assert logits.shape == (3, 5)
 
+    def test_position_interpolation_serves_multiple_resolutions(self):
+        """One ViT checkpoint, several input resolutions: pos_embed is
+        anchored at pos_grid and bicubically resized at trace time."""
+        import jax
+        import jax.numpy as jnp
+
+        from seldon_core_tpu.models.vit import ViTTiny
+
+        m = ViTTiny(num_classes=5, dtype=jnp.float32)
+        variables = m.init(jax.random.key(0), jnp.zeros((1, 32, 32, 3)))
+        for res in (48, 64):
+            logits = m.apply(variables, jnp.ones((2, res, res, 3)))
+            assert logits.shape == (2, 5)
+            assert np.isfinite(np.asarray(logits)).all()
+        # still rejects non-multiples of patch_size
+        with pytest.raises(ValueError):
+            m.apply(variables, jnp.ones((1, 33, 33, 3)))
+
+    def test_interpolation_is_identity_at_native_resolution(self):
+        """pos_grid must not perturb the native path: a legacy
+        (pos_grid=0) module with the same params produces bitwise-equal
+        logits at the anchor resolution."""
+        import jax
+        import jax.numpy as jnp
+
+        from seldon_core_tpu.models.vit import ViTTiny
+
+        anchored = ViTTiny(num_classes=5, dtype=jnp.float32)
+        legacy = ViTTiny(num_classes=5, dtype=jnp.float32, pos_grid=0)
+        variables = anchored.init(jax.random.key(0), jnp.zeros((1, 32, 32, 3)))
+        x = jnp.asarray(np.random.default_rng(1).normal(size=(2, 32, 32, 3)), jnp.float32)
+        np.testing.assert_array_equal(
+            np.asarray(anchored.apply(variables, x)),
+            np.asarray(legacy.apply(variables, x)),
+        )
+
+    def test_multi_resolution_through_jaxserver_signatures(self):
+        """Serving-side: extra_input_shapes + pos_grid = one server, one
+        checkpoint, several resolutions (MultiSignatureBatcher path)."""
+        from seldon_core_tpu.models.jaxserver import JaxServer
+
+        server = JaxServer(
+            model="vit_tiny", num_classes=10, input_shape=(32, 32, 3),
+            extra_input_shapes=[(48, 48, 3)],
+            dtype="float32", max_batch_size=4, warmup=False,
+            warmup_dtypes=("float32",),
+        )
+        server.load()
+        small = np.asarray(server.predict(np.zeros((2, 32, 32, 3), np.float32), []))
+        large = np.asarray(server.predict(np.zeros((2, 48, 48, 3), np.float32), []))
+        assert small.shape == (2, 10) and large.shape == (2, 10)
+        assert np.isfinite(small).all() and np.isfinite(large).all()
+        server.unload()
+
 
 class TestFlashAttentionServing:
     def test_transformer_served_with_flash_attention(self):
