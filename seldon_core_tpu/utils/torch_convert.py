@@ -157,12 +157,23 @@ def convert_checkpoint(in_path: str, out_path: str, arch: str = "resnet50") -> D
 def main(argv=None) -> None:
     import argparse
 
-    parser = argparse.ArgumentParser(description="torch checkpoint -> flax msgpack")
-    parser.add_argument("input", help="torch .pt/.pth state_dict")
+    parser = argparse.ArgumentParser(description="torch/TF checkpoint -> flax msgpack")
+    parser.add_argument("input", help="torch .pt/.pth state_dict, or keras .keras/.h5/SavedModel with --framework tf")
     parser.add_argument("output", help="flax msgpack path (serve via model_uri)")
     parser.add_argument("--arch", default="resnet50", choices=sorted(RESNET_STAGES))
+    parser.add_argument(
+        "--framework", default="torch", choices=("torch", "tf"),
+        help="source checkpoint framework (tf = keras-applications ResNets)",
+    )
     args = parser.parse_args(argv)
-    variables = convert_checkpoint(args.input, args.output, arch=args.arch)
+    if args.framework == "tf":
+        from seldon_core_tpu.utils import tf_convert
+
+        if args.arch not in tf_convert.KERAS_STAGES:
+            parser.error(f"--framework tf supports {sorted(tf_convert.KERAS_STAGES)}")
+        variables = tf_convert.convert_checkpoint(args.input, args.output, arch=args.arch)
+    else:
+        variables = convert_checkpoint(args.input, args.output, arch=args.arch)
 
     def count(node) -> int:
         if isinstance(node, dict):
