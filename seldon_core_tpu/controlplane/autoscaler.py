@@ -32,7 +32,7 @@ import threading
 import time
 from collections import deque
 from dataclasses import dataclass, field
-from typing import Any, Callable, Deque, Dict, List, Optional, Tuple
+from typing import Any, Callable, Deque, Dict, List, Optional, Tuple, Union
 
 from seldon_core_tpu.controlplane.supervisor import ProcessSpec, SupervisedProcess
 
@@ -54,43 +54,60 @@ class HpaSpec:
 
     min_replicas: int = 1
     max_replicas: int = 4
-    # exactly one target should be > 0; the metric_fn passed to the
-    # Autoscaler must produce the matching quantity.  qps/inflight are
-    # totals shared across replicas (the per-replica load falls as
-    # replicas rise); latency is a direct signal (p95 ms vs target)
+    # any subset (at least one) of the targets may be set; each active
+    # target yields its own replica proposal and the applied count is
+    # the MAX of the proposals — k8s autoscaling/v2 multi-metric
+    # semantics.  qps/inflight are totals shared across replicas (the
+    # per-replica load falls as replicas rise); latency is a direct
+    # signal (p95 ms vs target)
     target_qps_per_replica: float = 0.0
     target_inflight_per_replica: float = 0.0
     target_p95_ms: float = 0.0
+    # named custom metrics with per-replica targets (k8s Pods-type
+    # custom metrics); the Autoscaler needs a matching metric_fns entry
+    custom_targets: Dict[str, float] = field(default_factory=dict)
     tolerance: float = 0.1  # k8s horizontal-pod-autoscaler-tolerance
     scale_down_stabilization_s: float = 60.0
     poll_interval_s: float = 2.0
 
+    # reserved names for the builtin targets
+    _BUILTIN = ("qps", "inflight", "p95_ms")
+
     def __post_init__(self) -> None:
         if self.min_replicas < 1 or self.max_replicas < self.min_replicas:
             raise ValueError("need 1 <= min_replicas <= max_replicas")
-        targets_set = sum(
-            t > 0
-            for t in (
-                self.target_qps_per_replica,
-                self.target_inflight_per_replica,
-                self.target_p95_ms,
-            )
-        )
-        if targets_set != 1:
+        bad = [k for k, v in self.custom_targets.items() if v <= 0 or k in self._BUILTIN]
+        if bad:
+            raise ValueError(f"custom_targets entries must be > 0 and not shadow builtins: {bad}")
+        if not self.metric_specs():
             raise ValueError(
-                "set exactly one of target_qps_per_replica / "
-                "target_inflight_per_replica / target_p95_ms"
+                "set at least one of target_qps_per_replica / "
+                "target_inflight_per_replica / target_p95_ms / custom_targets"
             )
+
+    def metric_specs(self) -> List[Tuple[str, float, bool]]:
+        """Active metrics as (name, target, divides_per_replica)."""
+        out: List[Tuple[str, float, bool]] = []
+        if self.target_qps_per_replica > 0:
+            out.append(("qps", self.target_qps_per_replica, True))
+        if self.target_inflight_per_replica > 0:
+            out.append(("inflight", self.target_inflight_per_replica, True))
+        if self.target_p95_ms > 0:
+            # a latency quantile does not divide across replicas
+            out.append(("p95_ms", self.target_p95_ms, False))
+        for name in sorted(self.custom_targets):
+            out.append((name, self.custom_targets[name], True))
+        return out
 
     @property
     def target(self) -> float:
-        return self.target_qps_per_replica or self.target_inflight_per_replica or self.target_p95_ms
+        """First active target (single-metric convenience accessor)."""
+        return self.metric_specs()[0][1]
 
     @property
     def per_replica(self) -> bool:
-        """Whether the metric divides across replicas (qps/inflight do;
-        a latency quantile compares against the target directly)."""
-        return self.target_p95_ms <= 0
+        """Whether the first active metric divides across replicas."""
+        return self.metric_specs()[0][2]
 
     @classmethod
     def from_dict(cls, d: Dict[str, Any]) -> "HpaSpec":
@@ -113,6 +130,10 @@ class HpaSpec:
                 pick("target_inflight_per_replica", "targetInflight", default=0.0)
             ),
             target_p95_ms=float(pick("target_p95_ms", "targetP95Ms", default=0.0)),
+            custom_targets={
+                str(k): float(v)
+                for k, v in (pick("custom_targets", "customTargets", default={}) or {}).items()
+            },
             tolerance=float(pick("tolerance", default=0.1)),
             scale_down_stabilization_s=float(
                 pick("scale_down_stabilization_s", "stabilizationWindowSeconds", default=60.0)
@@ -266,25 +287,45 @@ def gateway_request_count(gateway) -> Callable[[], float]:
 @dataclass
 class ScaleDecision:
     at: float
-    metric: float
+    metric: float  # the value of the proposal that won (max rule)
     desired: int
     applied: int
+    metrics: Dict[str, float] = field(default_factory=dict)
 
 
 class Autoscaler:
     """The HPA control loop over one ReplicaSet (or anything exposing
-    ``replica_count`` and ``scale(n)``)."""
+    ``replica_count`` and ``scale(n)``).
+
+    ``metric_fn`` may be a single callable (when the spec has exactly
+    one active target) or a dict mapping the spec's metric names
+    (``qps`` / ``inflight`` / ``p95_ms`` / custom names) to callables.
+    With several active metrics each produces its own replica proposal
+    and the max wins (k8s autoscaling/v2), so a deployment can hold
+    both a QPS floor and a latency ceiling at once.
+    """
 
     def __init__(
         self,
         replicaset: Any,
         hpa: HpaSpec,
-        metric_fn: Callable[[], float],
+        metric_fn: Union[Callable[[], float], Dict[str, Callable[[], float]]],
         clock: Callable[[], float] = time.monotonic,
     ):
         self.replicaset = replicaset
         self.hpa = hpa
-        self.metric_fn = metric_fn
+        specs = hpa.metric_specs()
+        if callable(metric_fn):
+            if len(specs) != 1:
+                raise ValueError(
+                    f"spec has {len(specs)} active metrics "
+                    f"({[n for n, _, _ in specs]}); pass metric_fn as a dict"
+                )
+            metric_fn = {specs[0][0]: metric_fn}
+        missing = [n for n, _, _ in specs if n not in metric_fn]
+        if missing:
+            raise ValueError(f"metric_fn missing samplers for {missing}")
+        self.metric_fns: Dict[str, Callable[[], float]] = dict(metric_fn)
         self.clock = clock
         # bounded: one decision lands every poll interval for the life
         # of the deployment
@@ -294,7 +335,7 @@ class Autoscaler:
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
-    def _desired(self, metric: float, current: int) -> int:
+    def _desired(self, metric: float, current: int, target: float, per_replica: bool) -> int:
         """k8s formula: desired = ceil(current * ratio), dead-banded.
 
         Latency targets skip the per-replica division: p95 does not
@@ -303,12 +344,12 @@ class Autoscaler:
         zero-latency idle window never scales up)."""
         if current == 0:
             return self.hpa.min_replicas
-        if self.hpa.per_replica:
-            ratio = (metric / current) / self.hpa.target
+        if per_replica:
+            ratio = (metric / current) / target
         else:
             if metric <= 0:  # no traffic in the window: hold
                 return current
-            ratio = metric / self.hpa.target
+            ratio = metric / target
         if abs(ratio - 1.0) <= self.hpa.tolerance:
             desired = current
         else:
@@ -318,9 +359,16 @@ class Autoscaler:
     def evaluate_once(self) -> int:
         """One reconcile step; returns the replica count now in force."""
         now = self.clock()
-        metric = float(self.metric_fn())
         current = self.replicaset.replica_count
-        desired = self._desired(metric, current)
+        # one proposal per active metric; the max wins (k8s multi-metric)
+        samples: Dict[str, float] = {}
+        desired, winner = 0, 0.0
+        for name, target, per_replica in self.hpa.metric_specs():
+            value = float(self.metric_fns[name]())
+            samples[name] = value
+            proposal = self._desired(value, current, target, per_replica)
+            if proposal > desired:  # proposals are already >= min_replicas
+                desired, winner = proposal, value
         # scale-down stabilization: act on the max desired seen in-window
         horizon = now - self.hpa.scale_down_stabilization_s
         self._recommendations = [(t, d) for t, d in self._recommendations if t >= horizon]
@@ -330,7 +378,9 @@ class Autoscaler:
         applied = current
         if desired != current:
             applied = self.replicaset.scale(desired)
-        self.history.append(ScaleDecision(at=now, metric=metric, desired=desired, applied=applied))
+        self.history.append(
+            ScaleDecision(at=now, metric=winner, desired=desired, applied=applied, metrics=samples)
+        )
         return applied
 
     def start(self) -> None:

@@ -278,21 +278,34 @@ def _build_autoscaled_root(p, annotations) -> Tuple[Any, Any, Any]:
     )
 
     def make_autoscaler(svc: PredictorService, observer=None) -> Autoscaler:
-        if hpa.target_p95_ms > 0:
-            if observer is None:
-                # silently swapping in the QPS counter would compare
-                # requests/sec against a milliseconds target
-                raise DeploymentSpecError(
-                    f"predictor {p.name!r}: target_p95_ms needs the "
-                    "predictor's PrometheusObserver"
-                )
-            from seldon_core_tpu.utils.metrics import api_latency_sampler
+        # one sampler per active target; the Autoscaler applies the max
+        # of the per-metric proposals (k8s autoscaling/v2 semantics), so
+        # a spec may hold e.g. a QPS floor AND a p95 ceiling at once
+        metric_fns = {}
+        for name, _target, _pr in hpa.metric_specs():
+            if name == "qps":
+                metric_fns[name] = CounterRateSampler(lambda: svc.stats.get("requests", 0))
+            elif name == "inflight":
+                metric_fns[name] = lambda: float(getattr(svc, "_inflight", 0))
+            elif name == "p95_ms":
+                if observer is None:
+                    # silently swapping in the QPS counter would compare
+                    # requests/sec against a milliseconds target
+                    raise DeploymentSpecError(
+                        f"predictor {p.name!r}: target_p95_ms needs the "
+                        "predictor's PrometheusObserver"
+                    )
+                from seldon_core_tpu.utils.metrics import api_latency_sampler
 
-            p95 = api_latency_sampler(observer, quantile=0.95)
-            metric_fn = lambda: p95() * 1000.0  # noqa: E731 — seconds -> ms
-        else:
-            metric_fn = CounterRateSampler(lambda: svc.stats.get("requests", 0))
-        return Autoscaler(rs, hpa, metric_fn=metric_fn)
+                p95 = api_latency_sampler(observer, quantile=0.95)
+                metric_fns[name] = lambda p95=p95: p95() * 1000.0  # s -> ms
+            else:
+                raise DeploymentSpecError(
+                    f"predictor {p.name!r}: custom_targets metric {name!r} "
+                    "has no declarative sampler; construct the Autoscaler "
+                    "programmatically with a metric_fn dict"
+                )
+        return Autoscaler(rs, hpa, metric_fn=metric_fns)
 
     return balanced, rs, make_autoscaler
 

@@ -62,8 +62,13 @@ class TestHpaAlgorithm:
             HpaSpec(min_replicas=3, max_replicas=2, target_qps_per_replica=1)
         with pytest.raises(ValueError):
             HpaSpec()  # no target set
+        # several targets at once is the multi-metric path (valid)
+        hpa = HpaSpec(target_qps_per_replica=1, target_inflight_per_replica=1)
+        assert [n for n, _, _ in hpa.metric_specs()] == ["qps", "inflight"]
         with pytest.raises(ValueError):
-            HpaSpec(target_qps_per_replica=1, target_inflight_per_replica=1)
+            HpaSpec(custom_targets={"queue_depth": 0.0})  # target must be > 0
+        with pytest.raises(ValueError):
+            HpaSpec(custom_targets={"qps": 5.0})  # shadows a builtin name
 
     def test_from_dict_accepts_reference_camelcase(self):
         hpa = HpaSpec.from_dict(
@@ -131,6 +136,82 @@ class TestHpaAlgorithm:
             predictors = [Svc(3), Svc(4)]
 
         assert gateway_request_count(Gw())() == 7.0
+
+
+class TestMultiMetric:
+    """k8s autoscaling/v2 multi-metric semantics: every active target
+    yields a replica proposal and the max is applied."""
+
+    def _make(self, fns, *, current=2, clock=None, **hpa_kwargs):
+        rs = FakeReplicaSet(current)
+        asc = Autoscaler(rs, HpaSpec(**hpa_kwargs), metric_fn=fns,
+                         clock=clock or FakeClock())
+        return asc, rs
+
+    def test_max_proposal_wins(self):
+        # qps says hold (20/2/10 = 1.0); p95 says double (400/200 = 2.0)
+        asc, rs = self._make(
+            {"qps": lambda: 20.0, "p95_ms": lambda: 400.0},
+            target_qps_per_replica=10.0, target_p95_ms=200.0,
+        )
+        assert asc.evaluate_once() == 4
+        assert asc.history[-1].metrics == {"qps": 20.0, "p95_ms": 400.0}
+        assert asc.history[-1].metric == 400.0  # the winning sample
+
+    def test_hold_beats_scale_down(self):
+        # qps would drain to 1; inflight is in the dead-band -> hold at 2
+        asc, rs = self._make(
+            {"qps": lambda: 0.0, "inflight": lambda: 4.2},
+            target_qps_per_replica=10.0, target_inflight_per_replica=2.0,
+        )
+        assert asc.evaluate_once() == 2
+        assert rs.calls == []
+
+    def test_custom_metric_scales(self):
+        hpa = HpaSpec.from_dict(
+            {"targetQps": 100.0, "customTargets": {"queue_depth": 8.0}}
+        )
+        assert ("queue_depth", 8.0, True) in hpa.metric_specs()
+        rs = FakeReplicaSet(1)
+        asc = Autoscaler(
+            rs, hpa,
+            metric_fn={"qps": lambda: 1.0, "queue_depth": lambda: 24.0},
+            clock=FakeClock(),
+        )
+        assert asc.evaluate_once() == 3  # 24 depth / 1 replica / 8 target
+
+    def test_single_callable_rejected_for_multi_target(self):
+        with pytest.raises(ValueError, match="dict"):
+            Autoscaler(
+                FakeReplicaSet(1),
+                HpaSpec(target_qps_per_replica=1, target_p95_ms=100.0),
+                metric_fn=lambda: 0.0,
+            )
+
+    def test_missing_sampler_rejected(self):
+        with pytest.raises(ValueError, match="p95_ms"):
+            Autoscaler(
+                FakeReplicaSet(1),
+                HpaSpec(target_qps_per_replica=1, target_p95_ms=100.0),
+                metric_fn={"qps": lambda: 0.0},
+            )
+
+    def test_stabilization_applies_across_metrics(self):
+        clock = FakeClock()
+        asc, rs = self._make(
+            {"qps": lambda: 35.0, "p95_ms": lambda: 100.0},
+            current=1, clock=clock,
+            target_qps_per_replica=10.0, target_p95_ms=200.0,
+            scale_down_stabilization_s=30.0,
+        )
+        assert asc.evaluate_once() == 4  # qps-driven
+        asc.metric_fns["qps"] = lambda: 0.0
+        clock.advance(5)
+        assert asc.evaluate_once() == 4  # window holds
+        clock.advance(31)
+        # after the window: qps proposes min (1) but p95 at half target
+        # still supports 2 — the max proposal governs the drain too
+        assert asc.evaluate_once() == 2
 
 
 class TestBalancedClient:
@@ -299,11 +380,12 @@ class TestLatencyTarget:
     """target_p95_ms: scale on the latency quantile instead of QPS
     (k8s-style multi-metric HPA breadth)."""
 
-    def test_spec_accepts_exactly_one_target(self):
+    def test_spec_single_and_multi_target(self):
         hpa = HpaSpec(target_p95_ms=50.0)
         assert hpa.target == 50.0 and not hpa.per_replica
-        with pytest.raises(ValueError):
-            HpaSpec(target_p95_ms=50.0, target_qps_per_replica=10.0)
+        # a second target is the multi-metric path, not an error
+        both = HpaSpec(target_p95_ms=50.0, target_qps_per_replica=10.0)
+        assert [n for n, _, _ in both.metric_specs()] == ["qps", "p95_ms"]
 
     def test_latency_ratio_scales_directly(self):
         rs = FakeReplicaSet(2)
