@@ -39,15 +39,24 @@ def _register_optional() -> None:
         register_implementation("MLFLOW_SERVER", MLFlowServer)
     except ImportError:
         pass
-    from seldon_core_tpu.models.proxyserver import RestProxyServer, TFServingGrpcProxy
+    from seldon_core_tpu.models.proxyserver import (
+        RestProxyServer,
+        SageMakerProxy,
+        TFServingGrpcProxy,
+    )
 
     register_implementation("REST_PROXY", RestProxyServer)
+    # Reference's SAGEMAKER proxy integration (SagemakerProxy.py:1-33)
+    register_implementation("SAGEMAKER_PROXY", SageMakerProxy)
     from seldon_core_tpu.models.generate import GenerativeLM
 
     register_implementation("GENERATIVE_LM", GenerativeLM)
     from seldon_core_tpu.models.paged import StreamingLM
 
     register_implementation("STREAMING_LM", StreamingLM)
+    from seldon_core_tpu.models.speculative import SpeculativeLM
+
+    register_implementation("SPECULATIVE_LM", SpeculativeLM)
     # Reference's TENSORFLOW_SERVER prepackaged proxy
     # (operator/controllers/seldondeployment_prepackaged_servers.go:109)
     register_implementation("TENSORFLOW_SERVER", TFServingGrpcProxy)

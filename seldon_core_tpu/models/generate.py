@@ -38,6 +38,29 @@ def _buckets_for(max_len: int) -> List[int]:
     return out
 
 
+def load_lm_params(model_uri: str, config: Dict[str, int], seed: int):
+    """Shared TransformerLM checkpoint loader for the generation lanes
+    (GenerativeLM / StreamingLM / SpeculativeLM): init the tree shape,
+    then overlay a flax msgpack checkpoint from the storage downloader
+    when ``model_uri`` is set."""
+    import jax
+    import jax.numpy as jnp
+
+    from seldon_core_tpu.models.transformer import TransformerLM
+
+    module = TransformerLM(dtype=jnp.bfloat16, **config)
+    params = module.init(jax.random.key(seed), jnp.zeros((1, 8), jnp.int32))["params"]
+    if model_uri:
+        from flax import serialization
+
+        from seldon_core_tpu.utils import storage
+
+        path = storage.download(model_uri)
+        with open(path, "rb") as f:
+            params = serialization.from_bytes(params, f.read())
+    return params
+
+
 class Generator:
     """Compiled generation harness around a TransformerLM checkpoint."""
 
@@ -266,24 +289,7 @@ class GenerativeLM(TPUComponent):
         self._counter_lock = threading.Lock()
 
     def load(self) -> None:
-        import jax
-        import jax.numpy as jnp
-
-        from seldon_core_tpu.models.transformer import TransformerLM
-
-        module = TransformerLM(dtype=jnp.bfloat16, **self.config)
-        variables = module.init(
-            jax.random.key(self.seed), jnp.zeros((1, 8), jnp.int32)
-        )
-        params = variables["params"]
-        if self.model_uri:
-            from flax import serialization
-
-            from seldon_core_tpu.utils import storage
-
-            path = storage.download(self.model_uri)
-            with open(path, "rb") as f:
-                params = serialization.from_bytes(params, f.read())
+        params = load_lm_params(self.model_uri, self.config, self.seed)
         self.generator = Generator(params, **self.config)
 
     def predict(self, X, names, meta=None):

@@ -162,6 +162,27 @@ def get_paged_lm_class():
     return _MODULES[1]
 
 
+def write_kv(pk, pv, new_k, new_v, block_tables, start, valid, *, page_size, max_len):
+    """Scatter (layers, B, L, h, hd) K/V into a paged pool.
+
+    ``start``: (B,) absolute position of each row's first token;
+    invalid lanes are redirected to trash page 0.  Shared by the
+    continuous-batching engine and the speculative decoder.
+    """
+    import jax.numpy as jnp
+
+    seg_len = new_k.shape[2]
+    pos = start[:, None] + jnp.arange(seg_len)[None, :]  # (B, L)
+    pos = jnp.minimum(pos, max_len - 1)
+    page_ids = jnp.take_along_axis(block_tables, pos // page_size, axis=1)  # (B, L)
+    page_ids = jnp.where(valid, page_ids, 0)
+    offs = pos % page_size
+    # scatter: pool[layer, page_ids[b,l], offs[b,l]] = new[layer, b, l]
+    pk = pk.at[:, page_ids, offs].set(new_k)
+    pv = pv.at[:, page_ids, offs].set(new_v)
+    return pk, pv
+
+
 # ---------------------------------------------------------------------------
 # host-side engine
 # ---------------------------------------------------------------------------
@@ -270,24 +291,10 @@ class PagedEngine:
     # ---- jitted programs --------------------------------------------------
 
     def _write_kv(self, pk, pv, new_k, new_v, block_row_or_tables, start, valid):
-        """Scatter (layers, B, L, h, hd) K/V into the pool.
-
-        ``start``: (B,) absolute position of each row's first token;
-        invalid lanes are redirected to trash page 0.
-        """
-        jnp = self._jnp
-        seg_len = new_k.shape[2]
-        pos = start[:, None] + jnp.arange(seg_len)[None, :]  # (B, L)
-        pos = jnp.minimum(pos, self.max_len - 1)
-        page_ids = jnp.take_along_axis(
-            block_row_or_tables, pos // self.page_size, axis=1
-        )  # (B, L)
-        page_ids = jnp.where(valid, page_ids, 0)
-        offs = pos % self.page_size
-        # scatter: pool[layer, page_ids[b,l], offs[b,l]] = new[layer, b, l]
-        pk = pk.at[:, page_ids, offs].set(new_k)
-        pv = pv.at[:, page_ids, offs].set(new_v)
-        return pk, pv
+        return write_kv(
+            pk, pv, new_k, new_v, block_row_or_tables, start, valid,
+            page_size=self.page_size, max_len=self.max_len,
+        )
 
     def _build_prefill(self, bucket: int):
         jax, jnp = self._jax, self._jnp
@@ -689,22 +696,11 @@ class StreamingLM(TPUComponent):
         self._counter_lock = threading.Lock()
 
     def load(self) -> None:
-        import jax
         import jax.numpy as jnp
 
-        from seldon_core_tpu.models.transformer import TransformerLM
+        from seldon_core_tpu.models.generate import load_lm_params
 
-        module = TransformerLM(dtype=jnp.bfloat16, **self.config)
-        variables = module.init(jax.random.key(self.seed), jnp.zeros((1, 8), jnp.int32))
-        params = variables["params"]
-        if self.model_uri:
-            from flax import serialization
-
-            from seldon_core_tpu.utils import storage
-
-            path = storage.download(self.model_uri)
-            with open(path, "rb") as f:
-                params = serialization.from_bytes(params, f.read())
+        params = load_lm_params(self.model_uri, self.config, self.seed)
         self.engine = PagedEngine(params, dtype=jnp.bfloat16, **self.config, **self.engine_config)
         self._loop_thread = threading.Thread(
             target=self._loop, name="streaminglm-decode", daemon=True

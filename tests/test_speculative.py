@@ -1,0 +1,201 @@
+"""Speculative greedy decoding: exactness + acceptance accounting.
+
+The invariant is absolute: speculative output must be BIT-IDENTICAL to
+vanilla greedy decoding (drafts only change how many argmaxes one
+forward confirms), for both the n-gram and draft-model lanes.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from seldon_core_tpu.models.speculative import (
+    SpeculativeGenerator,
+    SpeculativeLM,
+    ngram_draft,
+)
+from seldon_core_tpu.models.transformer import TransformerLM
+from seldon_core_tpu.runtime.component import MicroserviceError
+
+CFG = dict(vocab_size=64, d_model=32, num_layers=2, num_heads=4, max_len=128)
+
+
+@pytest.fixture(scope="module")
+def lm():
+    module = TransformerLM(dtype=jnp.float32, **CFG)
+    params = module.init(jax.random.key(0), jnp.zeros((1, 8), jnp.int32))["params"]
+    return module, params
+
+
+def _greedy_uncached(module, params, prompt, n):
+    tokens = np.asarray(prompt, np.int32).copy()
+    out = []
+    for _ in range(n):
+        logits = module.apply({"params": params}, jnp.asarray(tokens))
+        nxt = int(jnp.argmax(logits[0, -1]))
+        out.append(nxt)
+        tokens = np.concatenate([tokens, [[nxt]]], axis=1)
+    return out
+
+
+def _gen(params, **kw):
+    base = dict(dtype=jnp.float32, page_size=8, draft_k=4)
+    base.update(kw)
+    return SpeculativeGenerator(params, **CFG, **base)
+
+
+class TestNgramDraft:
+    def test_proposes_continuation_of_repeated_suffix(self):
+        ctx = np.array([7, 1, 2, 3, 9, 1, 2], np.int32)
+        # suffix (1, 2) matched earlier at index 1 -> followed by 3, 9, 1
+        np.testing.assert_array_equal(ngram_draft(ctx, 3), [3, 9, 1])
+
+    def test_prefers_latest_match(self):
+        ctx = np.array([1, 2, 5, 1, 2, 8, 1, 2], np.int32)
+        np.testing.assert_array_equal(ngram_draft(ctx, 1), [8])
+
+    def test_falls_back_to_unigram_then_empty(self):
+        ctx = np.array([4, 9, 4], np.int32)
+        np.testing.assert_array_equal(ngram_draft(ctx, 2), [9, 4])
+        assert len(ngram_draft(np.array([1, 2, 3], np.int32), 2)) == 0
+
+
+class TestExactness:
+    @pytest.mark.parametrize("n", [1, 5, 17])
+    def test_ngram_lane_matches_vanilla_greedy(self, lm, n):
+        module, params = lm
+        gen = _gen(params)
+        prompt = np.array([5, 9, 13, 2, 30, 5, 9], np.int32)  # repetitive
+        got = gen.generate(prompt, max_new_tokens=n).tolist()
+        want = _greedy_uncached(module, params, prompt[None], n)
+        assert got == want
+
+    def test_random_prompt_still_exact(self, lm):
+        module, params = lm
+        gen = _gen(params)
+        prompt = np.random.default_rng(3).integers(
+            0, CFG["vocab_size"], size=11
+        ).astype(np.int32)
+        got = gen.generate(prompt, max_new_tokens=12).tolist()
+        want = _greedy_uncached(module, params, prompt[None], 12)
+        assert got == want
+
+    def test_model_draft_lane_exact_with_perfect_draft(self, lm):
+        """Draft model == target: every draft accepted, output exact, and
+        the acceptance counter proves the fast path actually ran."""
+        module, params = lm
+        gen = _gen(params, draft="model", draft_params=params)
+        prompt = np.array([5, 9, 13, 2], np.int32)
+        got = gen.generate(prompt, max_new_tokens=12).tolist()
+        want = _greedy_uncached(module, params, prompt[None], 12)
+        assert got == want
+        assert gen.stats["accepted"] == gen.stats["drafted"] > 0
+
+    def test_model_draft_lane_exact_with_wrong_draft(self, lm):
+        """A deliberately different draft model must not perturb output
+        — bad drafts cost speed, never correctness."""
+        module, params = lm
+        other = TransformerLM(dtype=jnp.float32, **CFG).init(
+            jax.random.key(42), jnp.zeros((1, 8), jnp.int32)
+        )["params"]
+        gen = _gen(params, draft="model", draft_params=other)
+        prompt = np.array([3, 1, 4, 1, 5], np.int32)
+        got = gen.generate(prompt, max_new_tokens=10).tolist()
+        want = _greedy_uncached(module, params, prompt[None], 10)
+        assert got == want
+
+    def test_generation_continues_correct_after_many_rounds(self, lm):
+        """Long generation crosses page boundaries and many verify
+        rounds; the cache-length bookkeeping must never drift."""
+        module, params = lm
+        gen = _gen(params, draft_k=3)
+        prompt = np.array([5, 9], np.int32)
+        got = gen.generate(prompt, max_new_tokens=40).tolist()
+        want = _greedy_uncached(module, params, prompt[None], 40)
+        assert got == want
+
+
+class TestSemantics:
+    def test_eos_truncates_and_pads(self, lm):
+        module, params = lm
+        gen = _gen(params)
+        prompt = np.array([5, 9, 13, 2, 30], np.int32)
+        first = _greedy_uncached(module, params, prompt[None], 1)[0]
+        out = gen.generate(prompt, max_new_tokens=6, eos_id=first)
+        assert out[0] == first and (out[1:] == first).all()
+
+    def test_bounds_rejected(self, lm):
+        _, params = lm
+        gen = _gen(params)
+        with pytest.raises(MicroserviceError):
+            gen.generate(np.zeros((0,), np.int32), max_new_tokens=4)
+        with pytest.raises(MicroserviceError):
+            gen.generate(np.zeros(100, np.int32), max_new_tokens=40)
+
+    def test_program_budget_is_bounded(self, lm):
+        _, params = lm
+        gen = _gen(params)
+        gen.generate(np.array([1, 2, 3], np.int32), max_new_tokens=8)
+        gen.generate(np.array([4, 5, 6, 7], np.int32), max_new_tokens=8)
+        # one prefill bucket + one verify program
+        assert len(gen._forward_jit) == 2
+
+    def test_acceptance_stats_accumulate(self, lm):
+        _, params = lm
+        gen = _gen(params)
+        gen.generate(np.array([5, 9, 5, 9, 5], np.int32), max_new_tokens=10)
+        assert gen.stats["rounds"] > 0
+        assert gen.stats["tokens"] == 10
+
+
+class TestComponent:
+    def test_component_serves_and_exports_metrics(self, lm):
+        _, params = lm
+        comp = SpeculativeLM(max_new_tokens=5, page_size=8, **CFG)
+        comp.load()
+        comp.generator = _gen(params)  # pin the test checkpoint
+        out = comp.predict(np.array([[3, 1, 4], [1, 5, 9]], np.int32), [])
+        assert out.shape == (2, 5)
+        keys = {m["key"] for m in comp.metrics()}
+        assert "speculative_acceptance_rate" in keys
+
+
+class TestComponentConcurrency:
+    def test_concurrent_predicts_serialize_and_stay_exact(self, lm):
+        """The serving stack dispatches predicts on a thread pool; the
+        single shared pool must serialize, never interleave scatters."""
+        import threading
+
+        module, params = lm
+        comp = SpeculativeLM(max_new_tokens=6, page_size=8, **CFG)
+        comp.load()
+        comp.generator = _gen(params)
+        prompts = [np.array([5, 9, 13], np.int32),
+                   np.array([1, 2, 3, 4], np.int32),
+                   np.array([7, 7, 7], np.int32)]
+        results = {}
+
+        def call(i):
+            results[i] = comp.predict(prompts[i][None], [])
+
+        threads = [threading.Thread(target=call, args=(i,)) for i in range(3)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=180)
+        for i, p in enumerate(prompts):
+            want = _greedy_uncached(module, params, p[None], 6)
+            assert results[i][0].tolist() == want
+
+    def test_rounds_metric_is_gauge(self, lm):
+        _, params = lm
+        comp = SpeculativeLM(max_new_tokens=3, page_size=8, **CFG)
+        comp.load()
+        comp.generator = _gen(params)
+        comp.predict(np.array([[3, 1, 4]], np.int32), [])
+        by_key = {m["key"]: m for m in comp.metrics()}
+        # collected after every request -> cumulative values must be
+        # GAUGEs or Prometheus inc()s them quadratically
+        assert by_key["speculative_rounds"]["type"] == "GAUGE"
