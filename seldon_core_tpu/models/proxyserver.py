@@ -56,7 +56,9 @@ class RestProxyServer(TPUComponent):
         self.headers = json.loads(headers_json) if isinstance(headers_json, str) else dict(headers_json)
         self._session = None
 
-    def _post(self, body: Dict[str, Any]) -> Dict[str, Any]:
+    def _send(self, **post_kwargs):
+        """POST with retries; returns the requests Response (shared by
+        the JSON dialect and the raw-body SageMaker dialect)."""
         import requests
 
         if self._session is None:
@@ -64,14 +66,16 @@ class RestProxyServer(TPUComponent):
         last: Optional[Exception] = None
         for _ in range(self.retries + 1):
             try:
-                resp = self._session.post(self.url, json=body, headers=self.headers, timeout=self.timeout_s)
+                resp = self._session.post(
+                    self.url, headers=self.headers, timeout=self.timeout_s, **post_kwargs
+                )
                 if resp.status_code >= 400:
                     raise MicroserviceError(
                         f"upstream {self.url} returned {resp.status_code}: {resp.text[:200]}",
                         status_code=502,
                         reason="UPSTREAM_ERROR",
                     )
-                return resp.json()
+                return resp
             except MicroserviceError:
                 raise
             except Exception as e:  # noqa: BLE001
@@ -79,6 +83,20 @@ class RestProxyServer(TPUComponent):
         raise MicroserviceError(
             f"upstream {self.url} unreachable: {last}", status_code=502, reason="UPSTREAM_UNREACHABLE"
         )
+
+    def _parse_json(self, resp) -> Any:
+        """2xx with a non-JSON body (misconfigured LB serving HTML) must
+        surface as an upstream fault, not an internal 500."""
+        try:
+            return resp.json()
+        except ValueError as e:
+            raise MicroserviceError(
+                f"upstream {self.url} returned non-JSON body: {resp.text[:200]!r}",
+                status_code=502, reason="BAD_UPSTREAM_RESPONSE",
+            ) from e
+
+    def _post(self, body: Dict[str, Any]) -> Dict[str, Any]:
+        return self._parse_json(self._send(json=body))
 
     def predict(self, X, names, meta=None):
         payload = np.asarray(X).tolist() if not isinstance(X, (str, bytes, dict)) else X
@@ -91,6 +109,71 @@ class RestProxyServer(TPUComponent):
 
     def health_status(self):
         return {"proxy": self.url}
+
+
+class SageMakerProxy(RestProxyServer):
+    """Proxy to a SageMaker-style ``/invocations`` endpoint.
+
+    Reference analogue: integrations/sagemaker/SagemakerProxy.py:1-33 —
+    the reference shells out to boto3's ``invoke_endpoint`` with a CSV
+    body and parses a CSV reply; here the same runtime contract is
+    spoken as plain HTTP (``POST {base}/endpoints/{name}/invocations``
+    or any explicit ``url``), with ``content_type`` selecting the
+    ``text/csv`` or ``application/json`` body encoding.  SigV4 signing
+    is out of scope by design (zero-egress stance): front the endpoint
+    with a signing gateway or inject pre-signed headers via
+    ``headers_json``.
+    """
+
+    def __init__(
+        self,
+        url: str = "",
+        base_url: str = "",
+        endpoint_name: str = "",
+        content_type: str = "text/csv",
+        timeout_s: float = 10.0,
+        retries: int = 2,
+        headers_json: str = "{}",
+        **kwargs: Any,
+    ):
+        if not url:
+            if not (base_url and endpoint_name):
+                raise MicroserviceError(
+                    "SageMakerProxy needs url, or base_url + endpoint_name",
+                    status_code=400, reason="MISSING_URL",
+                )
+            url = f"{base_url.rstrip('/')}/endpoints/{endpoint_name}/invocations"
+        if content_type not in ("text/csv", "application/json"):
+            raise MicroserviceError(
+                f"unsupported content_type {content_type!r}",
+                status_code=400, reason="BAD_CONTENT_TYPE",
+            )
+        super().__init__(
+            url=url, timeout_s=timeout_s, retries=retries,
+            headers_json=headers_json, **kwargs,
+        )
+        self.content_type = content_type
+        self.headers.setdefault("Content-Type", content_type)
+        self.headers.setdefault("Accept", content_type)
+
+    def predict(self, X, names, meta=None):
+        arr = np.atleast_2d(np.asarray(X))
+        if self.content_type == "text/csv":
+            body = "\n".join(",".join(repr(v) for v in row) for row in arr.tolist())
+            resp = self._send(data=body.encode())
+            try:
+                rows = [
+                    [float(cell) for cell in line.split(",")]
+                    for line in resp.text.strip().splitlines() if line.strip()
+                ]
+            except ValueError as e:
+                raise MicroserviceError(
+                    f"upstream {self.url} returned non-CSV body: {resp.text[:200]!r}",
+                    status_code=502, reason="BAD_UPSTREAM_RESPONSE",
+                ) from e
+            return np.asarray(rows)
+        resp = self._send(data=json.dumps(arr.tolist()).encode())
+        return np.asarray(self._parse_json(resp))
 
 
 TFSERVING_PREDICT_METHOD = "/tensorflow.serving.PredictionService/Predict"

@@ -91,3 +91,142 @@ class TestRestProxy:
         from seldon_core_tpu.engine.units import BUILTIN_IMPLEMENTATIONS
 
         assert "REST_PROXY" in BUILTIN_IMPLEMENTATIONS
+
+
+class TestSageMakerProxy:
+    def test_csv_invocations_roundtrip(self):
+        """The reference's SageMaker contract: CSV rows in, CSV rows out
+        at POST {base}/endpoints/{name}/invocations."""
+        from aiohttp import web
+        from aiohttp.test_utils import TestServer
+
+        from seldon_core_tpu.models.proxyserver import SageMakerProxy
+
+        seen = {}
+
+        async def scenario():
+            async def invocations(request: web.Request) -> web.Response:
+                seen["path"] = request.path
+                seen["content_type"] = request.headers["Content-Type"]
+                rows = [
+                    [float(c) for c in line.split(",")]
+                    for line in (await request.text()).splitlines()
+                ]
+                doubled = [[v * 2 for v in row] for row in rows]
+                return web.Response(
+                    text="\n".join(",".join(str(v) for v in r) for r in doubled),
+                    content_type="text/csv",
+                )
+
+            app = web.Application()
+            app.router.add_post("/endpoints/my-model/invocations", invocations)
+            server = TestServer(app)
+            await server.start_server()
+            proxy = SageMakerProxy(
+                base_url=f"http://127.0.0.1:{server.port}",
+                endpoint_name="my-model", timeout_s=5,
+            )
+            out = await asyncio.to_thread(
+                proxy.predict, np.array([[1.5, 2.0], [3.0, 4.5]]), []
+            )
+            await server.close()
+            return out
+
+        out = run(scenario())
+        np.testing.assert_allclose(out, [[3.0, 4.0], [6.0, 9.0]])
+        assert seen["path"] == "/endpoints/my-model/invocations"
+        assert seen["content_type"] == "text/csv"
+
+    def test_json_dialect(self):
+        from aiohttp import web
+        from aiohttp.test_utils import TestServer
+
+        from seldon_core_tpu.models.proxyserver import SageMakerProxy
+
+        async def scenario():
+            async def invocations(request: web.Request) -> web.Response:
+                rows = np.asarray(await request.json())
+                return web.json_response((rows + 1).tolist())
+
+            app = web.Application()
+            app.router.add_post("/invoke", invocations)
+            server = TestServer(app)
+            await server.start_server()
+            proxy = SageMakerProxy(
+                url=f"http://127.0.0.1:{server.port}/invoke",
+                content_type="application/json", timeout_s=5,
+            )
+            out = await asyncio.to_thread(proxy.predict, np.array([[1.0, 2.0]]), [])
+            await server.close()
+            return out
+
+        np.testing.assert_allclose(run(scenario()), [[2.0, 3.0]])
+
+    def test_config_validation(self):
+        from seldon_core_tpu.models.proxyserver import SageMakerProxy
+        from seldon_core_tpu.runtime import MicroserviceError
+
+        with pytest.raises(MicroserviceError):
+            SageMakerProxy()  # neither url nor base+name
+        with pytest.raises(MicroserviceError):
+            SageMakerProxy(url="http://x/invocations", content_type="text/plain")
+
+    def test_registered(self):
+        import seldon_core_tpu.models  # noqa: F401
+        from seldon_core_tpu.engine.units import BUILTIN_IMPLEMENTATIONS
+
+        assert "SAGEMAKER_PROXY" in BUILTIN_IMPLEMENTATIONS
+
+
+class TestUpstreamBodyFaults:
+    def test_json_dialect_html_body_maps_to_502(self):
+        from aiohttp import web
+        from aiohttp.test_utils import TestServer
+
+        from seldon_core_tpu.models.proxyserver import RestProxyServer
+        from seldon_core_tpu.runtime import MicroserviceError
+
+        async def scenario():
+            async def upstream(request: web.Request) -> web.Response:
+                return web.Response(text="<html>ok</html>", content_type="text/html")
+
+            app = web.Application()
+            app.router.add_post("/p", upstream)
+            server = TestServer(app)
+            await server.start_server()
+            proxy = RestProxyServer(url=f"http://127.0.0.1:{server.port}/p",
+                                    timeout_s=5, retries=0)
+            try:
+                with pytest.raises(MicroserviceError) as ei:
+                    await asyncio.to_thread(proxy.predict, np.ones((1, 2)), [])
+                return ei.value.status_code
+            finally:
+                await server.close()
+
+        assert run(scenario()) == 502
+
+    def test_sagemaker_csv_garbage_maps_to_502(self):
+        from aiohttp import web
+        from aiohttp.test_utils import TestServer
+
+        from seldon_core_tpu.models.proxyserver import SageMakerProxy
+        from seldon_core_tpu.runtime import MicroserviceError
+
+        async def scenario():
+            async def invocations(request: web.Request) -> web.Response:
+                return web.Response(text="not,a\nnumber,row", content_type="text/csv")
+
+            app = web.Application()
+            app.router.add_post("/invocations", invocations)
+            server = TestServer(app)
+            await server.start_server()
+            proxy = SageMakerProxy(url=f"http://127.0.0.1:{server.port}/invocations",
+                                   timeout_s=5, retries=0)
+            try:
+                with pytest.raises(MicroserviceError) as ei:
+                    await asyncio.to_thread(proxy.predict, np.ones((1, 2)), [])
+                return ei.value.status_code
+            finally:
+                await server.close()
+
+        assert run(scenario()) == 502
