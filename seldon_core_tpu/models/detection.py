@@ -1,0 +1,178 @@
+"""Object detection family — anchor-free CenterNet-style heads, TPU-first.
+
+The reference serves arbitrary vision models through its prepackaged
+servers and GPU proxies (reference:
+integrations/nvidia-inference-server/TRTProxy.py:50-81); detection is a
+flagship workload of that path.  Here the detector is a first-class
+zoo member with shape discipline XLA likes:
+
+* backbone: the existing :class:`~seldon_core_tpu.models.resnet.ResNet`
+  with ``capture_features=True`` — the SAME parameter tree as the
+  classifier, so a torchvision/keras-converted ImageNet checkpoint
+  (utils/torch_convert.py, utils/tf_convert.py) seeds the detector
+  backbone unchanged;
+* neck: one 3x3 conv + upsample x2 (keeps the head cheap but doubles
+  localisation resolution over the stride-32 map);
+* heads: per-pixel class heatmap (sigmoid), box size (w, h) and center
+  offset — the CenterNet decomposition, which needs NO anchor boxes,
+  NO NMS loops, and decodes with one ``lax.top_k``: everything stays
+  static-shaped and fused on device;
+* decode: peak-NMS via 3x3 max-pool equality (the CenterNet trick —
+  a dynamic-shape-free replacement for IoU-NMS), then ``top_k`` over
+  the flattened heatmap — the same fused on-device top-k the jaxserver
+  response path uses.
+
+Output contract: ``(batch, k, 6)`` rows ``[x1, y1, x2, y2, score,
+class]`` in input-pixel coordinates, fixed ``k`` (pad rows have
+score 0) — static shapes end-to-end, ready for the RawTensor codec.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Sequence, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from seldon_core_tpu.models import resnet as resnet_mod
+
+
+class DetectionHead(nn.Module):
+    """Neck + CenterNet heads over a backbone feature map."""
+
+    num_classes: int = 80
+    head_dim: int = 64
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, features):
+        # features: (B, H, W, C) stride-32 map -> stride-16 predictions
+        x = nn.Conv(self.head_dim, (3, 3), dtype=self.dtype, name="neck_conv")(features)
+        x = nn.relu(x)
+        b, h, w, c = x.shape
+        x = jax.image.resize(x, (b, h * 2, w * 2, c), method="bilinear")
+        x = nn.Conv(self.head_dim, (3, 3), dtype=self.dtype, name="refine_conv")(x)
+        x = nn.relu(x)
+        heat = nn.Conv(self.num_classes, (1, 1), dtype=self.dtype, name="heatmap")(x)
+        size = nn.Conv(2, (1, 1), dtype=self.dtype, name="size")(x)
+        offset = nn.Conv(2, (1, 1), dtype=self.dtype, name="offset")(x)
+        return (
+            heat.astype(jnp.float32),
+            size.astype(jnp.float32),
+            offset.astype(jnp.float32),
+        )
+
+
+class Detector(nn.Module):
+    """ResNet backbone + CenterNet head; returns raw head maps.
+
+    Use :func:`decode_detections` (or serve ``detector_*`` through the
+    jaxserver registry, which fuses decode into the compiled program)
+    to turn maps into boxes.
+    """
+
+    num_classes: int = 80
+    backbone: str = "resnet18"
+    num_filters: int = 64
+    head_dim: int = 64
+    dtype: Any = jnp.bfloat16
+
+    def setup(self):
+        cls = {
+            "resnet18": resnet_mod.ResNet18,
+            "resnet34": resnet_mod.ResNet34,
+            "resnet50": resnet_mod.ResNet50,
+            "resnet_tiny": resnet_mod.ResNetTiny,
+        }[self.backbone]
+        # num_classes here is the CLASSIFIER head's width — irrelevant to
+        # detection but kept at 1000 so ImageNet checkpoints drop in
+        self.backbone_module = cls(
+            num_classes=1000, num_filters=self.num_filters, dtype=self.dtype,
+            name="backbone",
+        )
+        self.head = DetectionHead(
+            num_classes=self.num_classes, head_dim=self.head_dim,
+            dtype=self.dtype, name="det_head",
+        )
+
+    def __call__(self, x, train: bool = False):
+        _, features = self.backbone_module(x, train=train, capture_features=True)
+        return self.head(features)
+
+
+def decode_detections(
+    heat, size, offset, *, top_k: int = 100, stride: int = 16, score_threshold: float = 0.0
+):
+    """CenterNet decode: head maps -> (B, k, 6) [x1, y1, x2, y2, score, cls].
+
+    Static shapes throughout: peak-NMS is a 3x3 max-pool equality mask,
+    selection is one ``lax.top_k`` over the flattened heatmap.  Rows
+    below ``score_threshold`` are zeroed, never dropped (fixed k).
+    """
+    b, h, w, c = heat.shape
+    prob = jax.nn.sigmoid(heat)
+    pooled = jax.lax.reduce_window(
+        prob, -jnp.inf, jax.lax.max, (1, 3, 3, 1), (1, 1, 1, 1), "SAME"
+    )
+    prob = jnp.where(prob == pooled, prob, 0.0)  # local peaks only
+    flat = prob.reshape(b, h * w * c)
+    scores, idx = jax.lax.top_k(flat, top_k)  # (B, k)
+    cls = idx % c
+    cell = idx // c
+    cy, cx = cell // w, cell % w
+
+    def gather_map(m):  # (B, H, W, 2) -> (B, k, 2) at the peak cells
+        flat_m = m.reshape(b, h * w, 2)
+        return jnp.take_along_axis(flat_m, cell[..., None], axis=1)
+
+    off = gather_map(offset)
+    sz = jnp.abs(gather_map(size))  # sizes are magnitudes by definition
+    center_x = (cx.astype(jnp.float32) + off[..., 0]) * stride
+    center_y = (cy.astype(jnp.float32) + off[..., 1]) * stride
+    half_w = sz[..., 0] * stride / 2.0
+    half_h = sz[..., 1] * stride / 2.0
+    keep = (scores >= score_threshold).astype(jnp.float32)
+    boxes = jnp.stack(
+        [
+            center_x - half_w, center_y - half_h,
+            center_x + half_w, center_y + half_h,
+            scores, cls.astype(jnp.float32),
+        ],
+        axis=-1,
+    )
+    return boxes * keep[..., None]
+
+
+def make_detector(
+    num_classes: int,
+    dtype,
+    *,
+    backbone: str = "resnet_tiny",
+    num_filters: int = 0,  # 0 = backbone-appropriate default
+    head_dim: int = 64,
+    top_k: int = 50,
+    stride: int = 16,
+    score_threshold: float = 0.0,
+    input_size: int = 64,
+) -> Tuple[Any, Tuple[int, ...]]:
+    """jaxserver registry factory: a module whose __call__ returns
+    decoded boxes directly, so decode fuses into the served program."""
+    if not num_filters:
+        num_filters = 8 if backbone == "resnet_tiny" else 64
+
+    class ServedDetector(nn.Module):
+        dtype_: Any = dtype
+
+        @nn.compact
+        def __call__(self, x, train: bool = False):
+            maps = Detector(
+                num_classes=num_classes, backbone=backbone,
+                num_filters=num_filters, head_dim=head_dim,
+                dtype=self.dtype_, name="detector",
+            )(x, train=train)
+            return decode_detections(
+                *maps, top_k=top_k, stride=stride, score_threshold=score_threshold
+            )
+
+    return ServedDetector(), (input_size, input_size, 3)

@@ -93,7 +93,26 @@ def _model_registry() -> Dict[str, Callable[..., Tuple[Any, Tuple[int, ...]]]]:
             ),
             None,
         ),
+        # detection family: output is (batch, top_k, 6) decoded boxes
+        # [x1,y1,x2,y2,score,cls] — decode (peak-NMS + lax.top_k) fuses
+        # into the served XLA program; model_kwargs: backbone, top_k,
+        # score_threshold, input_size, head_dim
+        "detector_tiny": _detector_entry("resnet_tiny", 64),
+        "detector_resnet18": _detector_entry("resnet18", 512),
+        "detector_resnet50": _detector_entry("resnet50", 512),
     }
+
+
+def _detector_entry(backbone: str, default_size: int):
+    from seldon_core_tpu.models.detection import make_detector
+
+    def factory(num_classes: int, dtype, **kw):
+        kw.setdefault("backbone", backbone)
+        kw.setdefault("input_size", default_size)
+        module, shape = make_detector(num_classes, dtype, **kw)
+        return module, shape
+
+    return factory
 
 
 def _with_attention(cls):

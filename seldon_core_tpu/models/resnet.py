@@ -78,7 +78,7 @@ class ResNet(nn.Module):
     dtype: Any = jnp.bfloat16
 
     @nn.compact
-    def __call__(self, x, train: bool = False):
+    def __call__(self, x, train: bool = False, capture_features: bool = False):
         conv = partial(nn.Conv, use_bias=False, dtype=self.dtype)
         norm = partial(
             nn.BatchNorm,
@@ -101,9 +101,16 @@ class ResNet(nn.Module):
                     conv=conv,
                     norm=norm,
                 )(x)
+        features = x  # (B, H/32, W/32, C) final stage map
         x = jnp.mean(x, axis=(1, 2))
         x = nn.Dense(self.num_classes, dtype=self.dtype, name="head")(x)
-        return jnp.asarray(x, jnp.float32)
+        logits = jnp.asarray(x, jnp.float32)
+        if capture_features:
+            # same param tree either way: the classifier head above is
+            # always created, so classification checkpoints (including
+            # torch/TF-converted ones) seed detection backbones as-is
+            return logits, features
+        return logits
 
 
 ResNet18 = partial(ResNet, stage_sizes=[2, 2, 2, 2], block_cls=BasicBlock)

@@ -1,0 +1,136 @@
+"""Detection family: head shapes, CenterNet decode, checkpoint seeding.
+
+Decode correctness is tested against hand-crafted head maps (known
+peak, size, offset -> known box), independent of any trained weights.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from seldon_core_tpu.models.detection import (
+    Detector,
+    decode_detections,
+    make_detector,
+)
+
+
+class TestDecode:
+    def _maps(self, h=8, w=8, c=3):
+        heat = np.full((1, h, w, c), -10.0, np.float32)  # sigmoid ~ 0
+        size = np.zeros((1, h, w, 2), np.float32)
+        offset = np.zeros((1, h, w, 2), np.float32)
+        return heat, size, offset
+
+    def test_single_peak_recovers_box(self):
+        heat, size, offset = self._maps()
+        cy, cx, cls = 3, 5, 2
+        heat[0, cy, cx, cls] = 10.0  # sigmoid ~ 1
+        size[0, cy, cx] = [4.0, 6.0]     # w, h in cells
+        offset[0, cy, cx] = [0.25, 0.5]  # x, y sub-cell
+        out = np.asarray(decode_detections(
+            jnp.asarray(heat), jnp.asarray(size), jnp.asarray(offset),
+            top_k=5, stride=16, score_threshold=0.5,
+        ))
+        x1, y1, x2, y2, score, klass = out[0, 0]
+        center_x, center_y = (cx + 0.25) * 16, (cy + 0.5) * 16
+        assert score > 0.99 and int(klass) == cls
+        np.testing.assert_allclose(
+            [x1, y1, x2, y2],
+            [center_x - 32, center_y - 48, center_x + 32, center_y + 48],
+            atol=1e-4,
+        )
+        # rows under the threshold (flat background "peaks") are zeroed
+        assert np.allclose(out[0, 1:], 0.0)
+
+    def test_peak_nms_suppresses_neighbours(self):
+        heat, size, offset = self._maps()
+        heat[0, 4, 4, 0] = 10.0
+        heat[0, 4, 5, 0] = 9.0  # adjacent, weaker -> suppressed
+        heat[0, 1, 1, 0] = 8.0  # distant -> second detection
+        out = np.asarray(decode_detections(
+            jnp.asarray(heat), jnp.asarray(size), jnp.asarray(offset), top_k=5
+        ))
+        scores = out[0, :, 4]
+        assert (scores > 0.5).sum() == 2  # the 9.0 neighbour is gone
+
+    def test_score_threshold_zeroes_rows(self):
+        heat, size, offset = self._maps()
+        heat[0, 2, 2, 0] = 10.0
+        heat[0, 6, 6, 1] = -2.0  # sigmoid ~ 0.12
+        out = np.asarray(decode_detections(
+            jnp.asarray(heat), jnp.asarray(size), jnp.asarray(offset),
+            top_k=5, score_threshold=0.5,
+        ))
+        assert (out[0, :, 4] > 0).sum() == 1
+
+    def test_static_shapes_and_jittable(self):
+        heat, size, offset = self._maps()
+        fn = jax.jit(lambda h, s, o: decode_detections(h, s, o, top_k=7))
+        out = fn(jnp.asarray(heat), jnp.asarray(size), jnp.asarray(offset))
+        assert out.shape == (1, 7, 6)
+
+
+class TestDetectorModule:
+    def test_head_map_shapes(self):
+        det = Detector(num_classes=5, backbone="resnet_tiny",
+                       num_filters=8, head_dim=16, dtype=jnp.float32)
+        variables = det.init(jax.random.key(0), jnp.zeros((1, 64, 64, 3)))
+        heat, size, offset = det.apply(variables, jnp.ones((2, 64, 64, 3)))
+        # stride-32 backbone map upsampled x2 -> stride 16: 64/16 = 4
+        assert heat.shape == (2, 4, 4, 5)
+        assert size.shape == (2, 4, 4, 2) and offset.shape == (2, 4, 4, 2)
+
+    def test_classifier_checkpoint_seeds_backbone(self):
+        """An ImageNet-style classifier checkpoint (same tree the
+        torch/TF converters emit) drops into the detector backbone."""
+        from seldon_core_tpu.models import resnet as resnet_mod
+
+        classifier = resnet_mod.ResNetTiny(num_classes=1000, dtype=jnp.float32)
+        cvars = classifier.init(jax.random.key(1), jnp.zeros((1, 64, 64, 3)))
+
+        det = Detector(num_classes=5, backbone="resnet_tiny",
+                       num_filters=8, head_dim=16, dtype=jnp.float32)
+        dvars = det.init(jax.random.key(0), jnp.zeros((1, 64, 64, 3)))
+        assert (
+            jax.tree_util.tree_structure(dvars["params"]["backbone"])
+            == jax.tree_util.tree_structure(cvars["params"])
+        )
+        grafted = {
+            "params": {**dvars["params"], "backbone": cvars["params"]},
+            "batch_stats": {**dvars["batch_stats"], "backbone": cvars["batch_stats"]},
+        }
+        x = jnp.asarray(np.random.default_rng(0).normal(size=(1, 64, 64, 3)), jnp.float32)
+        heat, _, _ = det.apply(grafted, x)
+        # the grafted backbone must produce the classifier's features
+        _, want_features = classifier.apply(cvars, x, capture_features=True)
+        got_features = det.apply(
+            grafted, x, method=lambda m, x: m.backbone_module(x, capture_features=True)
+        )[1]
+        np.testing.assert_allclose(np.asarray(got_features), np.asarray(want_features))
+        assert np.isfinite(np.asarray(heat)).all()
+
+
+class TestServing:
+    def test_detector_through_jaxserver(self):
+        from seldon_core_tpu.models.jaxserver import JaxServer
+
+        server = JaxServer(
+            model="detector_tiny", num_classes=5, input_shape=(64, 64, 3),
+            dtype="float32", max_batch_size=2, warmup=False,
+            warmup_dtypes=("float32",),
+            model_kwargs={"num_filters": 8, "head_dim": 16, "top_k": 10},
+        )
+        server.load()
+        out = np.asarray(server.predict(np.zeros((2, 64, 64, 3), np.float32), []))
+        assert out.shape == (2, 10, 6)
+        assert np.isfinite(out).all()
+        server.unload()
+
+    def test_registry_has_detector_family(self):
+        from seldon_core_tpu.models.jaxserver import _model_registry
+
+        names = set(_model_registry())
+        assert {"detector_tiny", "detector_resnet18", "detector_resnet50"} <= names
