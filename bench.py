@@ -34,7 +34,8 @@ UNAVAILABLE, and a wedged in-process TPU client cannot be recovered):
 Env knobs: BENCH_MODEL (resnet50|resnet_tiny), BENCH_SECONDS,
 BENCH_CONCURRENCY, BENCH_MAX_BATCH, BENCH_QUICK=1 (tiny model, short),
 BENCH_ATTEMPTS, BENCH_ATTEMPT_TIMEOUT_S, BENCH_PLATFORM (cpu for local
-smoke runs), BENCH_INT8=1 (add an int8 quantized comparison phase).
+smoke runs), BENCH_INT8=1 (add an int8 quantized comparison phase),
+BENCH_GEN=1 (add a generation decode tokens/s phase).
 """
 
 from __future__ import annotations
@@ -471,6 +472,12 @@ async def child_main() -> None:
         except Exception as e:  # noqa: BLE001
             status["extra"]["int8_error"] = str(e)[:200]
 
+    if os.environ.get("BENCH_GEN", "0") == "1":
+        try:
+            status["extra"]["generation"] = generation_phase()
+        except Exception as e:  # noqa: BLE001
+            status["extra"]["generation_error"] = str(e)[:200]
+
     status["extra"]["mean_batch_rows"] = round(server.batcher.stats.mean_batch_rows, 2)
     status["extra"]["device_batches"] = server.batcher.stats.batches
     server.unload()
@@ -493,6 +500,55 @@ async def child_main() -> None:
         "vs_baseline": round(P50_TARGET_MS / p50, 3),
         "extra": extra,
     })
+
+
+def generation_phase() -> dict:
+    """Decode throughput (tokens/s) of the kv-cache generation lane.
+
+    Random weights — decode cost is architecture-bound, not
+    weight-value-bound; a 512-wide 8-layer bf16 TransformerLM at
+    batch 8 is the canonical single-chip decode shape here.
+    """
+    import time as _time
+
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    from seldon_core_tpu.models.generate import Generator
+    from seldon_core_tpu.models.transformer import TransformerLM
+
+    cfg = dict(vocab_size=16384, d_model=512, num_layers=8, num_heads=8, max_len=1024)
+    if os.environ.get("BENCH_QUICK", "0") == "1" or MODEL == "resnet_tiny":
+        cfg = dict(vocab_size=256, d_model=64, num_layers=2, num_heads=4, max_len=256)
+    batch, plen, max_new = 8, 128, 128
+    module = TransformerLM(dtype=jnp.bfloat16, **cfg)
+    params = module.init(jax.random.key(0), jnp.zeros((1, 8), jnp.int32))["params"]
+    gen = Generator(params, dtype=jnp.bfloat16, **cfg)
+    prompts = np.random.default_rng(0).integers(
+        0, cfg["vocab_size"], size=(batch, plen)
+    ).astype(np.int32)
+    gen.generate(prompts, max_new_tokens=max_new)  # pays the compiles
+    gen.generate(prompts, max_new_tokens=1)
+    # prefill-corrected decode rate: the full call minus a
+    # prefill-plus-one-step call isolates the per-token decode cost
+    t0 = _time.perf_counter()
+    gen.generate(prompts, max_new_tokens=1)
+    dt_prefill = _time.perf_counter() - t0
+    t0 = _time.perf_counter()
+    out = gen.generate(prompts, max_new_tokens=max_new)
+    dt_full = _time.perf_counter() - t0
+    assert out.shape == (batch, max_new)
+    decode_dt = max(dt_full - dt_prefill, 1e-9)
+    return {
+        "decode_tokens_per_s": round(batch * (max_new - 1) / decode_dt, 1),
+        "overall_tokens_per_s": round(batch * max_new / dt_full, 1),
+        "prefill_ms": round(dt_prefill * 1000.0, 2),
+        "batch": batch, "prompt_len": plen, "max_new": max_new,
+        "config": f"d{cfg['d_model']} L{cfg['num_layers']} "
+                  f"H{cfg['num_heads']} v{cfg['vocab_size']} bf16",
+    }
 
 
 async def int8_phase(shape) -> dict:

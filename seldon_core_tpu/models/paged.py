@@ -240,6 +240,9 @@ class PagedEngine:
         steps_per_call: int = 8,
         prompt_buckets: Optional[Sequence[int]] = None,
         dtype: Any = None,
+        mesh: Any = None,
+        model_axis: str = "model",
+        shard_min_weight_size: int = 16_384,
     ):
         import jax
         import jax.numpy as jnp
@@ -267,8 +270,22 @@ class PagedEngine:
             num_heads=num_heads, max_len=max_len, dtype=dtype,
         )
         pool_shape = (num_layers, self.num_pages, self.page_size, num_heads, head_dim)
-        self.pages_k = jnp.zeros(pool_shape, dtype)
-        self.pages_v = jnp.zeros(pool_shape, dtype)
+        if mesh is not None:
+            # tensor-parallel decode: megatron-style param shardings +
+            # the pool sharded on its heads axis (created sharded, never
+            # materialised on one device); XLA inserts the ICI
+            # collectives inside the SAME compiled chunk program (the
+            # scaling-book recipe — no hand-written collectives)
+            from seldon_core_tpu.parallel.sharding import shard_decode_state
+
+            self.params, self.pages_k, self.pages_v = shard_decode_state(
+                params, mesh, pool_shape=pool_shape, dtype=dtype,
+                model_axis=model_axis, min_weight_size=shard_min_weight_size,
+            )
+            params = self.params
+        else:
+            self.pages_k = jnp.zeros(pool_shape, dtype)
+            self.pages_v = jnp.zeros(pool_shape, dtype)
         self._logits = jnp.zeros((self.max_slots, self.vocab_size), jnp.float32)
         # rng state kept as raw key data so masked carries can jnp.where it
         self._keys = jax.random.key_data(
@@ -669,6 +686,7 @@ class StreamingLM(TPUComponent):
         num_pages: int = 0,
         max_slots: int = 8,
         steps_per_call: int = 8,
+        mesh_axes: Optional[Dict[str, int]] = None,
         **kwargs: Any,
     ):
         super().__init__(**kwargs)
@@ -681,6 +699,7 @@ class StreamingLM(TPUComponent):
             page_size=int(page_size), num_pages=int(num_pages) or None,
             max_slots=int(max_slots), steps_per_call=int(steps_per_call),
         )
+        self.mesh_axes = dict(mesh_axes) if mesh_axes else None
         self.max_new_tokens = int(max_new_tokens)
         self.temperature = float(temperature)
         self.top_k = int(top_k)
@@ -701,7 +720,15 @@ class StreamingLM(TPUComponent):
         from seldon_core_tpu.models.generate import load_lm_params
 
         params = load_lm_params(self.model_uri, self.config, self.seed)
-        self.engine = PagedEngine(params, dtype=jnp.bfloat16, **self.config, **self.engine_config)
+        mesh = None
+        if self.mesh_axes:
+            from seldon_core_tpu.parallel.mesh import create_mesh
+
+            mesh = create_mesh(self.mesh_axes)
+        self.engine = PagedEngine(
+            params, dtype=jnp.bfloat16, mesh=mesh,
+            **self.config, **self.engine_config,
+        )
         self._loop_thread = threading.Thread(
             target=self._loop, name="streaminglm-decode", daemon=True
         )

@@ -60,7 +60,8 @@ def ngram_draft(context: np.ndarray, k: int, ngram: int = 2) -> np.ndarray:
 class _PagedState:
     """Single-stream paged cache with an identity block table."""
 
-    def __init__(self, module, params, *, max_len: int, page_size: int, dtype):
+    def __init__(self, module, params, *, max_len: int, page_size: int, dtype,
+                 mesh=None, model_axis: str = "model"):
         import jax.numpy as jnp
 
         self.module = module
@@ -71,8 +72,18 @@ class _PagedState:
         cfg = module
         head_dim = cfg.d_model // cfg.num_heads
         shape = (cfg.num_layers, num_pages, page_size, cfg.num_heads, head_dim)
-        self.pk = jnp.zeros(shape, dtype)
-        self.pv = jnp.zeros(shape, dtype)
+        if mesh is not None:
+            # same tensor-parallel layout as PagedEngine (shared helper):
+            # megatron param specs + pool sharded on heads, created
+            # sharded, collectives inserted by XLA
+            from seldon_core_tpu.parallel.sharding import shard_decode_state
+
+            self.params, self.pk, self.pv = shard_decode_state(
+                params, mesh, pool_shape=shape, dtype=dtype, model_axis=model_axis
+            )
+        else:
+            self.pk = jnp.zeros(shape, dtype)
+            self.pv = jnp.zeros(shape, dtype)
         # logical page p lives at pool page p+1 (0 is the trash page)
         self.table = jnp.arange(1, max_len // page_size + 1, dtype=jnp.int32)[None, :]
         self.length = 0  # host-side; rollback = assignment
@@ -104,6 +115,8 @@ class SpeculativeGenerator:
         draft_config: Optional[Dict[str, int]] = None,
         prompt_buckets: Optional[Sequence[int]] = None,
         dtype: Any = None,
+        mesh: Any = None,
+        model_axis: str = "model",
     ):
         import jax
         import jax.numpy as jnp
@@ -131,7 +144,8 @@ class SpeculativeGenerator:
             num_heads=num_heads, max_len=max_len, dtype=dtype,
         )
         self.target = _PagedState(
-            cls(**target_cfg), params, max_len=max_len, page_size=page_size, dtype=dtype
+            cls(**target_cfg), params, max_len=max_len, page_size=page_size,
+            dtype=dtype, mesh=mesh, model_axis=model_axis,
         )
         self.draft_state: Optional[_PagedState] = None
         if draft == "model":
@@ -140,7 +154,8 @@ class SpeculativeGenerator:
             cfg["vocab_size"] = vocab_size  # must share the vocabulary
             cfg["max_len"] = max_len
             self.draft_state = _PagedState(
-                cls(**cfg), draft_params, max_len=max_len, page_size=page_size, dtype=dtype
+                cls(**cfg), draft_params, max_len=max_len, page_size=page_size,
+                dtype=dtype, mesh=mesh, model_axis=model_axis,
             )
 
         self._forward_jit: Dict[Tuple[int, int], Any] = {}

@@ -82,6 +82,48 @@ def shard_params(
     )
 
 
+def shard_decode_state(
+    params: Any,
+    mesh,
+    *,
+    pool_shape,
+    dtype,
+    model_axis: str = MODEL_AXIS,
+    min_weight_size: int = 16_384,
+):
+    """Tensor-parallel layout for the paged-decode lanes: megatron param
+    specs + K/V pools sharded on their heads axis (dim 3 of
+    ``(layers, pages, page_size, heads, head_dim)``).
+
+    Pools are created ALREADY SHARDED (jit with out_shardings) — a
+    ``jnp.zeros`` then ``device_put`` would materialise the full pool
+    on one device first, defeating the memory win sharding buys.
+
+    Returns ``(params, pool_k, pool_v)``.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from seldon_core_tpu.parallel.mesh import mesh_shape
+
+    params = shard_params(
+        params, mesh, model_axis=model_axis, min_weight_size=min_weight_size
+    )
+    axis_size = mesh_shape(mesh).get(model_axis, 1)
+    num_heads = pool_shape[3]
+    pool_spec = (
+        P(None, None, None, model_axis, None)
+        if axis_size > 1 and num_heads % axis_size == 0
+        else P()
+    )
+    make_pool = jax.jit(
+        lambda: jnp.zeros(pool_shape, dtype),
+        out_shardings=NamedSharding(mesh, pool_spec),
+    )
+    return params, make_pool(), make_pool()
+
+
 def sharding_tree(specs: Any, mesh):
     import jax
     from jax.sharding import NamedSharding

@@ -199,3 +199,17 @@ class TestComponentConcurrency:
         # collected after every request -> cumulative values must be
         # GAUGEs or Prometheus inc()s them quadratically
         assert by_key["speculative_rounds"]["type"] == "GAUGE"
+
+
+class TestMeshSharded:
+    def test_sharded_speculative_matches_vanilla(self, lm):
+        from seldon_core_tpu.parallel.mesh import create_mesh
+
+        module, params = lm
+        mesh = create_mesh({"model": 4})
+        gen = _gen(params, mesh=mesh)
+        prompt = np.array([5, 9, 13, 2, 30, 5, 9], np.int32)
+        got = gen.generate(prompt, max_new_tokens=10).tolist()
+        want = _greedy_uncached(module, params, prompt[None], 10)
+        assert got == want
+        assert "model" in [ax for ax in gen.target.pk.sharding.spec if ax]
