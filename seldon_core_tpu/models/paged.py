@@ -270,22 +270,17 @@ class PagedEngine:
             num_heads=num_heads, max_len=max_len, dtype=dtype,
         )
         pool_shape = (num_layers, self.num_pages, self.page_size, num_heads, head_dim)
-        if mesh is not None:
-            # tensor-parallel decode: megatron-style param shardings +
-            # the pool sharded on its heads axis (created sharded, never
-            # materialised on one device); XLA inserts the ICI
-            # collectives inside the SAME compiled chunk program (the
-            # scaling-book recipe — no hand-written collectives)
-            from seldon_core_tpu.parallel.sharding import shard_decode_state
+        # tensor-parallel decode: megatron-style param shardings + the
+        # pool sharded on its heads axis (created sharded, never
+        # materialised on one device); XLA inserts the ICI collectives
+        # inside the SAME compiled chunk program (the scaling-book
+        # recipe — no hand-written collectives). mesh=None -> plain pools
+        from seldon_core_tpu.parallel.sharding import shard_decode_state
 
-            self.params, self.pages_k, self.pages_v = shard_decode_state(
-                params, mesh, pool_shape=pool_shape, dtype=dtype,
-                model_axis=model_axis, min_weight_size=shard_min_weight_size,
-            )
-            params = self.params
-        else:
-            self.pages_k = jnp.zeros(pool_shape, dtype)
-            self.pages_v = jnp.zeros(pool_shape, dtype)
+        self.params, self.pages_k, self.pages_v = shard_decode_state(
+            params, mesh, pool_shape=pool_shape, dtype=dtype,
+            model_axis=model_axis, min_weight_size=shard_min_weight_size,
+        )
         self._logits = jnp.zeros((self.max_slots, self.vocab_size), jnp.float32)
         # rng state kept as raw key data so masked carries can jnp.where it
         self._keys = jax.random.key_data(
@@ -720,11 +715,9 @@ class StreamingLM(TPUComponent):
         from seldon_core_tpu.models.generate import load_lm_params
 
         params = load_lm_params(self.model_uri, self.config, self.seed)
-        mesh = None
-        if self.mesh_axes:
-            from seldon_core_tpu.parallel.mesh import create_mesh
+        from seldon_core_tpu.parallel.mesh import mesh_from_axes
 
-            mesh = create_mesh(self.mesh_axes)
+        mesh = mesh_from_axes(self.mesh_axes)
         self.engine = PagedEngine(
             params, dtype=jnp.bfloat16, mesh=mesh,
             **self.config, **self.engine_config,

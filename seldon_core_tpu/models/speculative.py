@@ -61,7 +61,8 @@ class _PagedState:
     """Single-stream paged cache with an identity block table."""
 
     def __init__(self, module, params, *, max_len: int, page_size: int, dtype,
-                 mesh=None, model_axis: str = "model"):
+                 mesh=None, model_axis: str = "model",
+                 min_weight_size: int = 16_384):
         import jax.numpy as jnp
 
         self.module = module
@@ -72,18 +73,15 @@ class _PagedState:
         cfg = module
         head_dim = cfg.d_model // cfg.num_heads
         shape = (cfg.num_layers, num_pages, page_size, cfg.num_heads, head_dim)
-        if mesh is not None:
-            # same tensor-parallel layout as PagedEngine (shared helper):
-            # megatron param specs + pool sharded on heads, created
-            # sharded, collectives inserted by XLA
-            from seldon_core_tpu.parallel.sharding import shard_decode_state
+        # same tensor-parallel layout as PagedEngine (shared helper):
+        # megatron param specs + pool sharded on heads, created sharded,
+        # collectives inserted by XLA; mesh=None -> plain pools
+        from seldon_core_tpu.parallel.sharding import shard_decode_state
 
-            self.params, self.pk, self.pv = shard_decode_state(
-                params, mesh, pool_shape=shape, dtype=dtype, model_axis=model_axis
-            )
-        else:
-            self.pk = jnp.zeros(shape, dtype)
-            self.pv = jnp.zeros(shape, dtype)
+        self.params, self.pk, self.pv = shard_decode_state(
+            params, mesh, pool_shape=shape, dtype=dtype,
+            model_axis=model_axis, min_weight_size=min_weight_size,
+        )
         # logical page p lives at pool page p+1 (0 is the trash page)
         self.table = jnp.arange(1, max_len // page_size + 1, dtype=jnp.int32)[None, :]
         self.length = 0  # host-side; rollback = assignment
@@ -117,6 +115,7 @@ class SpeculativeGenerator:
         dtype: Any = None,
         mesh: Any = None,
         model_axis: str = "model",
+        shard_min_weight_size: int = 16_384,
     ):
         import jax
         import jax.numpy as jnp
@@ -146,6 +145,7 @@ class SpeculativeGenerator:
         self.target = _PagedState(
             cls(**target_cfg), params, max_len=max_len, page_size=page_size,
             dtype=dtype, mesh=mesh, model_axis=model_axis,
+            min_weight_size=shard_min_weight_size,
         )
         self.draft_state: Optional[_PagedState] = None
         if draft == "model":
@@ -156,6 +156,7 @@ class SpeculativeGenerator:
             self.draft_state = _PagedState(
                 cls(**cfg), draft_params, max_len=max_len, page_size=page_size,
                 dtype=dtype, mesh=mesh, model_axis=model_axis,
+                min_weight_size=shard_min_weight_size,
             )
 
         self._forward_jit: Dict[Tuple[int, int], Any] = {}
@@ -319,6 +320,7 @@ class SpeculativeLM(TPUComponent):
         draft_config: Optional[Dict[str, int]] = None,
         page_size: int = 64,
         seed: int = 0,
+        mesh_axes: Optional[Dict[str, int]] = None,
         **kwargs: Any,
     ):
         super().__init__(**kwargs)
@@ -337,6 +339,8 @@ class SpeculativeLM(TPUComponent):
         self.draft_config = dict(draft_config or {})
         self.page_size = int(page_size)
         self.seed = int(seed)
+        # same knob as StreamingLM: {"model": N} -> tensor-parallel decode
+        self.mesh_axes = dict(mesh_axes) if mesh_axes else None
         self.generator: Optional[SpeculativeGenerator] = None
         import threading
 
@@ -358,11 +362,14 @@ class SpeculativeLM(TPUComponent):
             cfg["vocab_size"] = self.config["vocab_size"]
             cfg["max_len"] = self.config["max_len"]
             draft_params = load_lm_params(self.draft_uri, cfg, self.seed + 1)
+        from seldon_core_tpu.parallel.mesh import mesh_from_axes
+
+        mesh = mesh_from_axes(self.mesh_axes)
         self.generator = SpeculativeGenerator(
             params, dtype=jnp.bfloat16, page_size=self.page_size,
             draft=self.draft, draft_k=self.draft_k, ngram=self.ngram,
             draft_params=draft_params, draft_config=self.draft_config,
-            **self.config,
+            mesh=mesh, **self.config,
         )
 
     def predict(self, X, names, meta=None):

@@ -58,5 +58,13 @@ def single_device_mesh():
     return create_mesh({DATA_AXIS: 1})
 
 
+def mesh_from_axes(mesh_axes):
+    """``{"model": 4}`` -> Mesh, or None when ``mesh_axes`` is falsy.
+
+    The one-liner every component with a ``mesh_axes`` config knob
+    (StreamingLM, SpeculativeLM, JaxServer) shares."""
+    return create_mesh(dict(mesh_axes)) if mesh_axes else None
+
+
 def mesh_shape(mesh) -> Dict[str, int]:
     return dict(zip(mesh.axis_names, mesh.devices.shape))

@@ -77,10 +77,14 @@ def _ring_shard_body(q, k, v, axis_name: str, causal: bool):
     m0 = jnp.full((b, h, s_local), NEG_INF, jnp.float32)
     l0 = jnp.zeros((b, h, s_local), jnp.float32)
     acc0 = jnp.zeros((b, h, s_local, d), jnp.float32)
-    try:  # newer jax: loop carries must be typed as axis-varying
+    # newer jax: loop carries must be typed as axis-varying (pcast
+    # replaces the deprecated pvary; older jax has neither)
+    if hasattr(lax, "pcast"):
+        m0, l0, acc0 = (
+            lax.pcast(x, (axis_name,), to="varying") for x in (m0, l0, acc0)
+        )
+    elif hasattr(lax, "pvary"):  # pragma: no cover — pre-pcast jax
         m0, l0, acc0 = (lax.pvary(x, (axis_name,)) for x in (m0, l0, acc0))
-    except AttributeError:  # pragma: no cover — older jax has no VMA typing
-        pass
     _, _, m, l, acc = lax.fori_loop(0, n, step, (k, v, m0, l0, acc0))
     out = acc / jnp.maximum(l, 1e-30)[..., None]  # [b,h,q,d]
     return jnp.einsum("bhqd->bqhd", out).astype(q.dtype)

@@ -99,6 +99,9 @@ def shard_decode_state(
     ``jnp.zeros`` then ``device_put`` would materialise the full pool
     on one device first, defeating the memory win sharding buys.
 
+    ``mesh=None`` is the single-device case: params untouched, plain
+    unsharded pools — so callers need no conditional.
+
     Returns ``(params, pool_k, pool_v)``.
     """
     import jax
@@ -107,16 +110,28 @@ def shard_decode_state(
 
     from seldon_core_tpu.parallel.mesh import mesh_shape
 
+    if mesh is None:
+        return params, jnp.zeros(pool_shape, dtype), jnp.zeros(pool_shape, dtype)
+
     params = shard_params(
         params, mesh, model_axis=model_axis, min_weight_size=min_weight_size
     )
     axis_size = mesh_shape(mesh).get(model_axis, 1)
     num_heads = pool_shape[3]
-    pool_spec = (
-        P(None, None, None, model_axis, None)
-        if axis_size > 1 and num_heads % axis_size == 0
-        else P()
-    )
+    if axis_size > 1 and num_heads % axis_size == 0:
+        pool_spec = P(None, None, None, model_axis, None)
+    else:
+        if axis_size > 1:
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "KV pool NOT sharded: num_heads=%d is not divisible by "
+                "mesh axis %r size %d — every device will hold the full "
+                "pool (no per-device memory win). Pick a head count "
+                "divisible by the model-axis size.",
+                num_heads, model_axis, axis_size,
+            )
+        pool_spec = P()
     make_pool = jax.jit(
         lambda: jnp.zeros(pool_shape, dtype),
         out_shardings=NamedSharding(mesh, pool_spec),

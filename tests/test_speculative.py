@@ -207,9 +207,32 @@ class TestMeshSharded:
 
         module, params = lm
         mesh = create_mesh({"model": 4})
-        gen = _gen(params, mesh=mesh)
+        # shard_min_weight_size=0 so the tiny test weights really shard —
+        # otherwise every leaf stays replicated and the megatron matmul
+        # path is not exercised
+        gen = _gen(params, mesh=mesh, shard_min_weight_size=0)
         prompt = np.array([5, 9, 13, 2, 30, 5, 9], np.int32)
         got = gen.generate(prompt, max_new_tokens=10).tolist()
         want = _greedy_uncached(module, params, prompt[None], 10)
         assert got == want
         assert "model" in [ax for ax in gen.target.pk.sharding.spec if ax]
+        sharded_leaves = [
+            leaf
+            for leaf in jax.tree.leaves(gen.target.params)
+            if any(ax for ax in getattr(leaf.sharding, "spec", ()) if ax)
+        ]
+        assert sharded_leaves, "no parameter leaf actually sharded"
+
+    def test_component_mesh_axes_reaches_generator(self, lm):
+        module, params = lm
+        comp = SpeculativeLM(
+            max_new_tokens=4, page_size=8, mesh_axes={"model": 4}, **CFG
+        )
+        comp.load()
+        pool_axes = [ax for ax in comp.generator.target.pk.sharding.spec if ax]
+        assert "model" in pool_axes
+        prompt = np.array([[5, 9, 13, 2, 30, 5, 9]], np.int32)
+        got = comp.predict(prompt, [])
+        # random-init params (no model_uri) differ from the fixture's, so
+        # only check shape/dtype — exactness is covered above
+        assert got.shape == (1, 4) and got.dtype == np.int32
