@@ -251,7 +251,6 @@ class PagedEngine:
             raise ValueError(f"max_len {max_len} must be a multiple of page_size {page_size}")
         self._jax, self._jnp = jax, jnp
         dtype = dtype or jnp.bfloat16
-        self.params = params
         self.vocab_size = int(vocab_size)
         self.max_len = int(max_len)
         self.page_size = int(page_size)

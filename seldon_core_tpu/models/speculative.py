@@ -66,7 +66,6 @@ class _PagedState:
         import jax.numpy as jnp
 
         self.module = module
-        self.params = params
         self.max_len = max_len
         self.page_size = page_size
         num_pages = max_len // page_size + 1  # + trash page 0

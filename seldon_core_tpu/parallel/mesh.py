@@ -62,7 +62,7 @@ def mesh_from_axes(mesh_axes):
     """``{"model": 4}`` -> Mesh, or None when ``mesh_axes`` is falsy.
 
     The one-liner every component with a ``mesh_axes`` config knob
-    (StreamingLM, SpeculativeLM, JaxServer) shares."""
+    (StreamingLM, SpeculativeLM) shares."""
     return create_mesh(dict(mesh_axes)) if mesh_axes else None
 
 

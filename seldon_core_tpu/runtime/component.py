@@ -120,6 +120,26 @@ class TPUComponent:
     def restore_state(self, state: Dict[str, Any]) -> None:
         pass
 
+    # ---- custom serving surface (optional) --------------------------------
+
+    def custom_routes(self) -> Dict[str, Any]:
+        """Extra REST endpoints merged into the microservice app:
+        ``{path: handler}`` where a handler is either an aiohttp
+        handler (async, returns a Response) or a plain callable whose
+        JSON-serialisable return value becomes the response body.
+        Covers the reference's custom-endpoint pattern
+        (reference: examples/models/mean_classifier_with_custom_endpoints)
+        without a second server process.
+
+        A component may also define ``custom_service()`` — a blocking
+        side loop the CLI runs on a daemon thread at startup (the
+        reference runs it as a second process,
+        reference: microservice.py:29-47,363-368).  Deliberately NOT
+        defined here: its presence is detected by ``hasattr``, so a
+        base-class stub would make every component look like it has
+        one."""
+        return {}
+
 
 # ---------------------------------------------------------------------------
 # duck-typed accessors (reference: user_model.py client_* helpers)

@@ -144,6 +144,22 @@ async def run_servers(
         await runner.cleanup()
 
 
+def start_custom_service(user_model: Any):
+    """Run the component's optional ``custom_service()`` side loop on a
+    daemon thread (the reference runs it as a second process,
+    reference: microservice.py:29-47,363-368 — a thread gives the same
+    lifetime without the fork). Returns the thread, or None."""
+    if not hasattr(user_model, "custom_service"):
+        return None
+    import threading
+
+    thread = threading.Thread(
+        target=user_model.custom_service, name="custom-service", daemon=True
+    )
+    thread.start()
+    return thread
+
+
 def main(argv: Optional[List[str]] = None) -> None:
     args = parse_args(argv)
     logging.basicConfig(level=args.log_level.upper(), format="%(asctime)s %(name)s %(levelname)s %(message)s")
@@ -171,6 +187,8 @@ def main(argv: Optional[List[str]] = None) -> None:
 
     if hasattr(user_model, "load"):
         user_model.load()
+
+    start_custom_service(user_model)
 
     tls = None
     if args.ssl_cert or args.ssl_key:

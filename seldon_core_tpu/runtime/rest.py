@@ -29,18 +29,78 @@ from seldon_core_tpu.runtime.message import InternalFeedback, InternalMessage
 logger = logging.getLogger(__name__)
 
 
+def _loads_400(text: Any, what: str) -> Any:
+    """json.loads that maps client syntax errors to 400, not 500."""
+    try:
+        return json.loads(text)
+    except json.JSONDecodeError as e:
+        raise MicroserviceError(
+            f"{what} is not valid JSON: {e}", status_code=400, reason="BAD_REQUEST"
+        )
+
+
+async def _multipart_body(request: web.Request) -> Dict[str, Any]:
+    """multipart/form-data request: every top-level message key is a
+    form field (reference: flask_utils.get_multi_form_data_request).
+
+    Text fields are JSON-parsed except ``strData`` (taken literally);
+    file uploads are raw bytes for ``binData`` and utf-8 text otherwise
+    (``strData`` may arrive either way)."""
+    form = await request.post()
+    keys = list(form.keys())
+    if "json" in keys:
+        # whole-message-in-one-field style (the form/query `json`
+        # contract, sent as multipart); mixing it with per-key fields
+        # is ambiguous and rejected
+        if len(keys) > 1:
+            raise MicroserviceError(
+                "multipart request mixes a 'json' field with message-key fields",
+                status_code=400,
+                reason="BAD_REQUEST",
+            )
+        val = form["json"]
+        if isinstance(val, web.FileField):  # json=@file.json upload
+            val = val.file.read()
+        return _loads_400(val, "multipart field 'json'")
+    out: Dict[str, Any] = {}
+    for key, val in form.items():
+        if isinstance(val, web.FileField):
+            data = val.file.read()
+            if key == "binData":
+                out[key] = data
+            else:
+                try:
+                    out[key] = data.decode("utf-8")
+                except UnicodeDecodeError:
+                    raise MicroserviceError(
+                        f"multipart file field {key!r} is not utf-8 "
+                        "(binary payloads go in 'binData')",
+                        status_code=400,
+                        reason="BAD_REQUEST",
+                    )
+        elif key == "strData":
+            out[key] = val
+        else:
+            out[key] = _loads_400(val, f"multipart field {key!r}")
+    if not out:
+        raise MicroserviceError("empty multipart request", status_code=400, reason="BAD_REQUEST")
+    return out
+
+
 async def _request_body(request: web.Request) -> Dict[str, Any]:
-    """JSON body, or a `json` field in form/query (reference:
-    flask_utils.get_request semantics)."""
+    """JSON body, a `json` field in form/query, or multipart fields
+    (reference: flask_utils.get_request semantics)."""
     if request.content_type == "application/json":
         try:
             return await request.json()
         except json.JSONDecodeError as e:
             raise MicroserviceError(f"invalid JSON body: {e}", status_code=400, reason="BAD_REQUEST")
+    if request.content_type and request.content_type.startswith("multipart/form-data"):
+        return await _multipart_body(request)
     if request.method == "POST":
         form = await request.post()
         if "json" in form:
-            return json.loads(form["json"])
+            return _loads_400(form["json"], "form field 'json'")
         # raw body fallback
         text = await request.text()
         if text:
@@ -49,7 +109,7 @@ async def _request_body(request: web.Request) -> Dict[str, Any]:
             except json.JSONDecodeError as e:
                 raise MicroserviceError(f"invalid JSON body: {e}", status_code=400, reason="BAD_REQUEST")
     if "json" in request.query:
-        return json.loads(request.query["json"])
+        return _loads_400(request.query["json"], "query field 'json'")
     raise MicroserviceError("empty request body", status_code=400, reason="BAD_REQUEST")
 
 
@@ -60,6 +120,30 @@ def _error_response(e: Exception) -> web.Response:
     logger.exception("unhandled microservice error")
     body = {"status": {"status": "FAILURE", "code": 500, "info": str(e), "reason": "MICROSERVICE_INTERNAL_ERROR"}}
     return web.json_response(body, status=500)
+
+
+def _custom_endpoint(user_handler: Callable) -> Callable:
+    """Wrap a user custom-route handler: aiohttp Responses pass
+    through, anything else JSON-serialises, errors map to Status.
+    Sync handlers run on the dispatch pool — they are expected to
+    block (that is why the reference isolates them in a second
+    process), and must not freeze the event loop."""
+
+    async def handler(request: web.Request) -> web.Response:
+        try:
+            if asyncio.iscoroutinefunction(user_handler):
+                result = await user_handler(request)
+            else:
+                result = await run_dispatch(user_handler, request)
+                if asyncio.iscoroutine(result):  # sync fn returned a coroutine
+                    result = await result
+            if isinstance(result, web.Response):
+                return result
+            return web.json_response(result)
+        except Exception as e:  # noqa: BLE001
+            return _error_response(e)
+
+    return handler
 
 
 def _message_endpoint(user_model: Any, fn: Callable) -> Callable:
@@ -147,6 +231,13 @@ def build_app(
 
     for path, handler in (extra_routes or {}).items():
         app.router.add_route("*", path, handler)
+
+    # component-declared endpoints (reference analogue: custom_service
+    # second process exposing user routes)
+    custom = getattr(user_model, "custom_routes", None)
+    if callable(custom):
+        for path, user_handler in (custom() or {}).items():
+            app.router.add_route("*", path, _custom_endpoint(user_handler))
     return app
 
 

@@ -330,3 +330,74 @@ class TestPackager:
         from seldon_core_tpu.runtime.packager import SERVICE_METHODS
 
         assert set(SERVICE_METHODS) == set(SERVICE_TYPES)
+
+
+class TestGraphVisualizer:
+    """seldon-tpu-graph: spec -> DOT / ASCII (reference analogue:
+    notebooks/visualizer.py)."""
+
+    @staticmethod
+    def _spec():
+        from seldon_core_tpu.controlplane.spec import TpuDeployment
+
+        return TpuDeployment.load("examples/mab_abtest.yaml")
+
+    def test_dot_contains_every_node_and_traffic_edge(self):
+        from seldon_core_tpu.utils.graphviz import to_dot
+
+        dot = to_dot(self._spec())
+        assert dot.startswith('digraph "mab-demo"')
+        for label in ("eg-router", "model-a", "model-b", "gateway"):
+            assert label in dot
+        assert "ROUTER: EPSILON_GREEDY" in dot
+        assert 'label="100%"' in dot  # gateway edge carries the split
+        # router -> both children
+        assert dot.count("n0_0 -> n0_0_") == 2
+
+    def test_ascii_tree_shows_hierarchy(self):
+        from seldon_core_tpu.utils.graphviz import to_ascii
+
+        text = to_ascii(self._spec())
+        lines = text.splitlines()
+        assert lines[0] == "mab-demo"
+        router_idx = next(i for i, l in enumerate(lines) if "eg-router" in l)
+        child_lines = [l for l in lines if "model-a" in l or "model-b" in l]
+        assert len(child_lines) == 2
+        # children indent deeper than the router
+        assert all(
+            len(l) - len(l.lstrip()) > len(lines[router_idx]) - len(lines[router_idx].lstrip())
+            for l in child_lines
+        )
+
+    def test_shadow_and_remote_marked(self):
+        from seldon_core_tpu.controlplane.spec import TpuDeployment
+        from seldon_core_tpu.utils.graphviz import to_ascii, to_dot
+
+        spec = TpuDeployment.from_dict(
+            {
+                "name": "viz",
+                "predictors": [
+                    {"name": "main", "traffic": 100,
+                     "graph": {"name": "m", "type": "MODEL",
+                               "implementation": "SIMPLE_MODEL"}},
+                    {"name": "mirror", "shadow": True,
+                     "graph": {"name": "s", "type": "MODEL",
+                               "implementation": "SIMPLE_MODEL",
+                               "children": [{"name": "w", "type": "MODEL",
+                                             "implementation": "SIMPLE_MODEL",
+                                             "remote": True}]}},
+                ],
+            }
+        )
+        dot = to_dot(spec)
+        assert 'label="shadow"' in dot and "style=dashed" in dot
+        assert "dotted" in dot  # remote node border
+        text = to_ascii(spec)
+        assert "(remote)" in text and "shadow" in text
+
+    def test_cli_writes_dot_file(self, tmp_path):
+        from seldon_core_tpu.utils.graphviz import main
+
+        out = tmp_path / "graph.dot"
+        main(["examples/mab_abtest.yaml", "--format", "dot", "-o", str(out)])
+        assert out.read_text().startswith("digraph")

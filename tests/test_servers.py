@@ -132,6 +132,277 @@ class TestRestMicroservice:
         assert run(scenario())["data"]["ndarray"] == [[3.0]]
 
 
+class TestMultipartRest:
+    """multipart/form-data parity (reference:
+    flask_utils.get_multi_form_data_request; example
+    sklearn_iris_multipart_formdata)."""
+
+    @staticmethod
+    def _form():
+        import aiohttp
+
+        return aiohttp.FormData()
+
+    def test_data_and_meta_fields(self):
+        import json as _json
+
+        async def scenario():
+            client = await _rest_client(rest.build_app(Doubler()))
+            form = self._form()
+            form.add_field("data", _json.dumps({"ndarray": [[1.0, 2.0]]}),
+                           content_type="application/json")
+            form.add_field("meta", _json.dumps({"tags": {"origin": "multipart"}}),
+                           content_type="application/json")
+            resp = await client.post("/predict", data=form)
+            body = await resp.json()
+            await client.close()
+            return resp.status, body
+
+        status, body = run(scenario())
+        assert status == 200
+        assert body["data"]["ndarray"] == [[2.0, 4.0]]
+
+    def test_strdata_text_field_taken_literally(self):
+        class Upper(TPUComponent):
+            def predict(self, X, names, meta=None):
+                return X.upper()
+
+        async def scenario():
+            client = await _rest_client(rest.build_app(Upper()))
+            form = self._form()
+            # not valid JSON on purpose — strData must not be json-parsed
+            form.add_field("strData", "hello world", content_type="text/plain")
+            resp = await client.post("/predict", data=form)
+            body = await resp.json()
+            await client.close()
+            return body
+
+        assert run(scenario())["strData"] == "HELLO WORLD"
+
+    def test_strdata_as_file_upload(self):
+        class Upper(TPUComponent):
+            def predict(self, X, names, meta=None):
+                return X.upper()
+
+        async def scenario():
+            client = await _rest_client(rest.build_app(Upper()))
+            form = self._form()
+            form.add_field("strData", b"from a file", filename="payload.txt",
+                           content_type="text/plain")
+            resp = await client.post("/predict", data=form)
+            body = await resp.json()
+            await client.close()
+            return body
+
+        assert run(scenario())["strData"] == "FROM A FILE"
+
+    def test_bindata_file_upload_stays_bytes(self):
+        import base64
+
+        class Rev(TPUComponent):
+            def predict(self, X, names, meta=None):
+                assert isinstance(X, bytes)
+                return X[::-1]
+
+        async def scenario():
+            client = await _rest_client(rest.build_app(Rev()))
+            form = self._form()
+            form.add_field("binData", b"\x01\x02\x03", filename="blob.bin",
+                           content_type="application/octet-stream")
+            resp = await client.post("/predict", data=form)
+            body = await resp.json()
+            await client.close()
+            return body
+
+        body = run(scenario())
+        assert base64.b64decode(body["binData"]) == b"\x03\x02\x01"
+
+    def test_invalid_json_field_is_400(self):
+        async def scenario():
+            client = await _rest_client(rest.build_app(Doubler()))
+            form = self._form()
+            form.add_field("data", "{not json", content_type="application/json")
+            resp = await client.post("/predict", data=form)
+            body = await resp.json()
+            await client.close()
+            return resp.status, body
+
+        status, body = run(scenario())
+        assert status == 400
+        assert body["status"]["status"] == "FAILURE"
+
+    def test_lone_json_field_carries_whole_message(self):
+        """The form-style `json` field also works inside multipart."""
+        import json as _json
+
+        async def scenario():
+            client = await _rest_client(rest.build_app(Doubler()))
+            form = self._form()
+            form.add_field("json", _json.dumps({"data": {"ndarray": [[3.0]]}}),
+                           content_type="application/json")
+            resp = await client.post("/predict", data=form)
+            body = await resp.json()
+            await client.close()
+            return body
+
+        assert run(scenario())["data"]["ndarray"] == [[6.0]]
+
+    def test_lone_json_field_as_file_upload(self):
+        import json as _json
+
+        async def scenario():
+            client = await _rest_client(rest.build_app(Doubler()))
+            form = self._form()
+            form.add_field("json", _json.dumps({"data": {"ndarray": [[4.0]]}}).encode(),
+                           filename="msg.json", content_type="application/json")
+            resp = await client.post("/predict", data=form)
+            body = await resp.json()
+            await client.close()
+            return resp.status, body
+
+        status, body = run(scenario())
+        assert status == 200
+        assert body["data"]["ndarray"] == [[8.0]]
+
+    def test_json_field_mixed_with_message_keys_is_400(self):
+        import json as _json
+
+        async def scenario():
+            client = await _rest_client(rest.build_app(Doubler()))
+            form = self._form()
+            form.add_field("json", _json.dumps({"data": {"ndarray": [[3.0]]}}),
+                           content_type="application/json")
+            form.add_field("strData", "also this", content_type="text/plain")
+            resp = await client.post("/predict", data=form)
+            await client.close()
+            return resp.status
+
+        assert run(scenario()) == 400
+
+    def test_non_utf8_text_file_is_400(self):
+        async def scenario():
+            client = await _rest_client(rest.build_app(Doubler()))
+            form = self._form()
+            form.add_field("strData", b"\xff\xfe\x00bad", filename="x.txt",
+                           content_type="text/plain")
+            resp = await client.post("/predict", data=form)
+            body = await resp.json()
+            await client.close()
+            return resp.status, body
+
+        status, body = run(scenario())
+        assert status == 400
+        assert body["status"]["status"] == "FAILURE"
+
+    def test_malformed_form_json_is_400_not_500(self):
+        async def scenario():
+            client = await _rest_client(rest.build_app(Doubler()))
+            resp = await client.post("/predict", data={"json": "{broken"})
+            body = await resp.json()
+            await client.close()
+            return resp.status, body
+
+        status, body = run(scenario())
+        assert status == 400
+        assert body["status"]["reason"] == "BAD_REQUEST"
+
+
+class TestCustomServingSurface:
+    """Component-declared endpoints + side service (reference:
+    mean_classifier_with_custom_endpoints; microservice.py custom_service)."""
+
+    def test_custom_routes_sync_and_async(self):
+        from aiohttp import web
+
+        class WithRoutes(Doubler):
+            def custom_routes(self):
+                async def info_async(_request):
+                    return web.json_response({"via": "async"})
+
+                def info_sync(_request):
+                    return {"via": "sync", "loaded": True}
+
+                return {"/custom/async": info_async, "/custom/sync": info_sync}
+
+        async def scenario():
+            client = await _rest_client(rest.build_app(WithRoutes()))
+            a = await (await client.get("/custom/async")).json()
+            s = await (await client.get("/custom/sync")).json()
+            # the standard surface still works alongside
+            p = await client.post("/predict", json={"data": {"ndarray": [[1.0]]}})
+            out = (a, s, (await p.json())["data"]["ndarray"])
+            await client.close()
+            return out
+
+        a, s, pred = run(scenario())
+        assert a == {"via": "async"}
+        assert s == {"via": "sync", "loaded": True}
+        assert pred == [[2.0]]
+
+    def test_custom_route_error_maps_to_status(self):
+        class Boom(Doubler):
+            def custom_routes(self):
+                def bad(_request):
+                    raise RuntimeError("side endpoint broke")
+
+                return {"/custom/bad": bad}
+
+        async def scenario():
+            client = await _rest_client(rest.build_app(Boom()))
+            resp = await client.get("/custom/bad")
+            body = await resp.json()
+            await client.close()
+            return resp.status, body
+
+        status, body = run(scenario())
+        assert status == 500
+        assert body["status"]["status"] == "FAILURE"
+
+    def test_sync_custom_route_does_not_block_event_loop(self):
+        import time as _time
+
+        class Slow(Doubler):
+            def custom_routes(self):
+                def slow(_request):
+                    _time.sleep(0.6)  # blocking by design
+                    return {"done": True}
+
+                return {"/custom/slow": slow}
+
+        async def scenario():
+            client = await _rest_client(rest.build_app(Slow()))
+            slow_task = asyncio.ensure_future(client.get("/custom/slow"))
+            await asyncio.sleep(0.1)  # slow handler is now mid-sleep
+            t0 = asyncio.get_event_loop().time()
+            ping = await client.get("/health/ping")
+            ping_latency = asyncio.get_event_loop().time() - t0
+            slow_resp = await slow_task
+            out = (ping.status, ping_latency, (await slow_resp.json()))
+            await client.close()
+            return out
+
+        ping_status, ping_latency, slow_body = run(scenario())
+        assert ping_status == 200
+        assert ping_latency < 0.4  # served while the sync handler slept
+        assert slow_body == {"done": True}
+
+    def test_custom_service_runs_on_daemon_thread(self):
+        import threading
+
+        from seldon_core_tpu.runtime.microservice import start_custom_service
+
+        ran = threading.Event()
+
+        class WithService(Doubler):
+            def custom_service(self):
+                ran.set()
+
+        thread = start_custom_service(WithService())
+        assert thread is not None and thread.daemon
+        assert ran.wait(timeout=5.0)
+        assert start_custom_service(Doubler()) is None
+
+
 class TestGrpcMicroservice:
     def test_predict_over_socket(self):
         async def scenario():
