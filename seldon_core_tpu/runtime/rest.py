@@ -68,16 +68,19 @@ async def _multipart_body(request: web.Request) -> Dict[str, Any]:
             data = val.file.read()
             if key == "binData":
                 out[key] = data
-            else:
-                try:
-                    out[key] = data.decode("utf-8")
-                except UnicodeDecodeError:
-                    raise MicroserviceError(
-                        f"multipart file field {key!r} is not utf-8 "
-                        "(binary payloads go in 'binData')",
-                        status_code=400,
-                        reason="BAD_REQUEST",
-                    )
+                continue
+            try:
+                text = data.decode("utf-8")
+            except UnicodeDecodeError:
+                raise MicroserviceError(
+                    f"multipart file field {key!r} is not utf-8 "
+                    "(binary payloads go in 'binData')",
+                    status_code=400,
+                    reason="BAD_REQUEST",
+                )
+            # a file upload carries the same content its text-field
+            # twin would: strData stays literal, JSON keys are parsed
+            out[key] = text if key == "strData" else _loads_400(text, f"multipart file field {key!r}")
         elif key == "strData":
             out[key] = val
         else:
@@ -91,10 +94,7 @@ async def _request_body(request: web.Request) -> Dict[str, Any]:
     """JSON body, a `json` field in form/query, or multipart fields
     (reference: flask_utils.get_request semantics)."""
     if request.content_type == "application/json":
-        try:
-            return await request.json()
-        except json.JSONDecodeError as e:
-            raise MicroserviceError(f"invalid JSON body: {e}", status_code=400, reason="BAD_REQUEST")
+        return _loads_400(await request.text(), "JSON body")
     if request.content_type and request.content_type.startswith("multipart/form-data"):
         return await _multipart_body(request)
     if request.method == "POST":
@@ -104,10 +104,7 @@ async def _request_body(request: web.Request) -> Dict[str, Any]:
         # raw body fallback
         text = await request.text()
         if text:
-            try:
-                return json.loads(text)
-            except json.JSONDecodeError as e:
-                raise MicroserviceError(f"invalid JSON body: {e}", status_code=400, reason="BAD_REQUEST")
+            return _loads_400(text, "request body")
     if "json" in request.query:
         return _loads_400(request.query["json"], "query field 'json'")
     raise MicroserviceError("empty request body", status_code=400, reason="BAD_REQUEST")

@@ -106,7 +106,23 @@ def to_dot(spec) -> str:
 def to_ascii(spec) -> str:
     """Terminal tree view of every predictor graph."""
     out: List[str] = [spec.name]
-    for predictor in spec.predictors:
+
+    def walk(unit, prefix: str, last: bool) -> None:
+        branch = "└─ " if last else "├─ "
+        detail = _node_detail(unit)
+        line = f"{prefix}{branch}{unit.name} <{unit.type}"
+        if detail:
+            line += f": {detail}"
+        line += ">"
+        if unit.remote:
+            line += " (remote)"
+        out.append(line)
+        child_prefix = prefix + ("   " if last else "│  ")
+        for i, child in enumerate(unit.children):
+            walk(child, child_prefix, i == len(unit.children) - 1)
+
+    for pi, predictor in enumerate(spec.predictors):
+        last_predictor = pi == len(spec.predictors) - 1
         extras = []
         if predictor.traffic:
             extras.append(f"{predictor.traffic:g}%")
@@ -115,23 +131,9 @@ def to_ascii(spec) -> str:
         if predictor.hpa:
             extras.append("hpa")
         suffix = f" [{', '.join(extras)}]" if extras else ""
-        out.append(f"└─ predictor {predictor.name} (replicas={predictor.replicas}){suffix}")
-
-        def walk(unit, prefix: str, last: bool) -> None:
-            branch = "└─ " if last else "├─ "
-            detail = _node_detail(unit)
-            line = f"{prefix}{branch}{unit.name} <{unit.type}"
-            if detail:
-                line += f": {detail}"
-            line += ">"
-            if unit.remote:
-                line += " (remote)"
-            out.append(line)
-            child_prefix = prefix + ("   " if last else "│  ")
-            for i, child in enumerate(unit.children):
-                walk(child, child_prefix, i == len(unit.children) - 1)
-
-        walk(predictor.graph, "   ", True)
+        glyph = "└─" if last_predictor else "├─"
+        out.append(f"{glyph} predictor {predictor.name} (replicas={predictor.replicas}){suffix}")
+        walk(predictor.graph, "   " if last_predictor else "│  ", True)
     return "\n".join(out) + "\n"
 
 

@@ -198,6 +198,100 @@ class TestExplainers:
         assert isinstance(e, PermutationExplainer)
 
 
+class _LinearComponent(TPUComponent):
+    """f(x) = x @ W + c — Shapley values are exactly W_jt * x_j for
+    baseline 0, the canonical correctness oracle for SHAP estimators."""
+
+    def __init__(self, weights, intercept=0.0):
+        self.weights = np.asarray(weights, np.float64)  # (M,) or (M, K)
+        self.intercept = intercept
+        self.calls = 0
+
+    def predict(self, X, names, meta=None):
+        self.calls += 1
+        return np.asarray(X) @ self.weights + self.intercept
+
+
+class TestKernelShap:
+    def test_exact_enumeration_recovers_linear_shapley(self):
+        from seldon_core_tpu.components.explainers import KernelShapExplainer
+
+        w = np.array([2.0, -1.0, 0.5, 3.0])
+        model = _LinearComponent(w, intercept=0.7)
+        explainer = KernelShapExplainer(model=model, n_samples=64)  # 2^4-2=14 -> exact
+        x = np.array([[1.0, 2.0, -1.0, 0.5]])
+        out = explainer.explain(x, names=["a", "b", "c", "d"])
+        np.testing.assert_allclose(out["attributions"][0], w * x[0], atol=1e-4)
+        assert out["method"] == "kernel_shap"
+        assert out["base_values"][0] == pytest.approx(0.7)
+
+    def test_one_batched_predict_per_row(self):
+        """All coalitions must ride a single predict call (the TPU-first
+        contract: one XLA dispatch, not one per coalition)."""
+        from seldon_core_tpu.components.explainers import KernelShapExplainer
+
+        model = _LinearComponent(np.ones(4))
+        explainer = KernelShapExplainer(model=model)
+        explainer.explain(np.ones((3, 4)))
+        assert model.calls == 3  # one per explained row
+
+    def test_sampled_path_on_wide_input(self):
+        from seldon_core_tpu.components.explainers import KernelShapExplainer
+
+        m = 12  # 2^12-2 coalitions >> n_samples -> paired sampling
+        rng = np.random.default_rng(0)
+        w = rng.normal(size=m)
+        model = _LinearComponent(w)
+        explainer = KernelShapExplainer(model=model, n_samples=256, seed=1)
+        x = rng.normal(size=(1, m))
+        out = explainer.explain(x)
+        # linear model => regression target is exactly linear in z, so
+        # even the sampled design recovers the Shapley values
+        np.testing.assert_allclose(out["attributions"][0], w * x[0], atol=1e-3)
+        # efficiency axiom: sum phi == f(x) - f(baseline)
+        assert np.sum(out["attributions"][0]) == pytest.approx(float(w @ x[0]), abs=1e-6)
+
+    def test_multiclass_explains_argmax_target(self):
+        from seldon_core_tpu.components.explainers import KernelShapExplainer
+
+        W = np.array([[1.0, -1.0], [0.0, 2.0], [0.5, 0.5]])  # (M=3, K=2)
+        model = _LinearComponent(W)
+        explainer = KernelShapExplainer(model=model)
+        x = np.array([[1.0, 3.0, 1.0]])  # class 1 wins (6.5 vs -0.5)
+        out = explainer.explain(x)
+        assert out["targets"] == [1]
+        np.testing.assert_allclose(out["attributions"][0], W[:, 1] * x[0], atol=1e-4)
+
+    def test_on_jaxserver_mlp(self):
+        from seldon_core_tpu.components.explainers import KernelShapExplainer
+        from seldon_core_tpu.models.jaxserver import JaxServer
+
+        server = JaxServer(model="mlp", num_classes=3, input_shape=(4,), dtype="float32",
+                           max_batch_size=16, warmup=False, warmup_dtypes=("float32",))
+        server.load()
+        explainer = KernelShapExplainer(model=server)
+        x = np.array([[0.5, -1.0, 2.0, 0.1]], np.float32)
+        out = explainer.explain(x)
+        attrs = np.asarray(out["attributions"])
+        assert attrs.shape == (1, 4) and np.isfinite(attrs).all()
+        # efficiency: sum phi = f(x) - f(b) on the target logit
+        logits_x = np.asarray(server.predict(x, []))[0]
+        logits_b = np.asarray(server.predict(np.zeros((1, 4), np.float32), []))[0]
+        t = out["targets"][0]
+        assert attrs.sum() == pytest.approx(float(logits_x[t] - logits_b[t]), rel=1e-3)
+        server.unload()
+
+    def test_registry_and_too_few_features(self):
+        from seldon_core_tpu.components.explainers import KernelShapExplainer
+        from seldon_core_tpu.runtime.component import MicroserviceError
+
+        e = build_explainer({"type": "kernel_shap", "n_samples": 32})
+        assert isinstance(e, KernelShapExplainer)
+        e.attach(_LinearComponent(np.ones(1)))
+        with pytest.raises(MicroserviceError):
+            e.explain(np.ones((1, 1)))
+
+
 class TestTorchServer:
     def test_torchscript_roundtrip(self, tmp_path):
         import torch
@@ -394,6 +488,11 @@ class TestGraphVisualizer:
         assert "dotted" in dot  # remote node border
         text = to_ascii(spec)
         assert "(remote)" in text and "shadow" in text
+        lines = text.splitlines()
+        # non-last predictor draws the sibling glyph + a continuing rail
+        assert lines[1].startswith("├─ predictor main")
+        assert lines[2].startswith("│  ")
+        assert lines[3].startswith("└─ predictor mirror")
 
     def test_cli_writes_dot_file(self, tmp_path):
         from seldon_core_tpu.utils.graphviz import main

@@ -100,3 +100,41 @@ class TestExplainerDeployment:
         out = run(scenario())
         assert out.status["status"] == "FAILURE"
         assert out.status["code"] == 404
+
+
+class TestKernelShapDeployment:
+    def test_kernel_shap_through_gateway_route(self):
+        spec = {
+            "name": "shap-explained",
+            "predictors": [
+                {
+                    "name": "main",
+                    "explainer": {"type": "kernel_shap", "n_samples": 64},
+                    "graph": dict(SPEC["predictors"][0]["graph"]),
+                }
+            ],
+        }
+
+        async def scenario():
+            from aiohttp.test_utils import TestClient, TestServer
+
+            deployer = Deployer(device_ids=[0])
+            managed = await deployer.apply(TpuDeployment.from_dict(spec))
+            app = build_gateway_app(managed.gateway)
+            client = TestClient(TestServer(app))
+            await client.start_server()
+            resp = await client.post(
+                "/api/v0.1/explanations",
+                json={"data": {"ndarray": [[1.0, -1.0, 0.5, 2.0]]}},
+            )
+            body = await resp.json()
+            await client.close()
+            await deployer.delete("shap-explained")
+            return resp.status, body
+
+        status, body = run(scenario())
+        assert status == 200
+        payload = body["jsonData"]
+        assert payload["method"] == "kernel_shap"
+        attrs = np.asarray(payload["attributions"])
+        assert attrs.shape == (1, 4) and np.isfinite(attrs).all()

@@ -247,6 +247,23 @@ class TestMultipartRest:
 
         assert run(scenario())["data"]["ndarray"] == [[6.0]]
 
+    def test_data_field_as_file_upload_is_parsed(self):
+        import json as _json
+
+        async def scenario():
+            client = await _rest_client(rest.build_app(Doubler()))
+            form = self._form()
+            form.add_field("data", _json.dumps({"ndarray": [[5.0]]}).encode(),
+                           filename="payload.json", content_type="application/json")
+            resp = await client.post("/predict", data=form)
+            body = await resp.json()
+            await client.close()
+            return resp.status, body
+
+        status, body = run(scenario())
+        assert status == 200
+        assert body["data"]["ndarray"] == [[10.0]]
+
     def test_lone_json_field_as_file_upload(self):
         import json as _json
 
