@@ -18,9 +18,9 @@ same chip.
   KernelSHAP weighted regression (the estimator behind the reference's
   alibi KernelShap explainer option).  TPU-first shape: every sampled
   coalition becomes one row of ONE batched predict (rides the dynamic
-  batcher / one XLA call), and the weighted least-squares solve is a
-  tiny on-device linear system.  With few features all coalitions are
-  enumerated, making the values exact.
+  batcher / one XLA call); the weighted least squares is a tiny
+  (M−1)² host-side float64 solve, factored once per call.  With few
+  features all coalitions are enumerated, making the values exact.
 """
 
 from __future__ import annotations
@@ -165,13 +165,19 @@ class KernelShapExplainer(TPUComponent):
     For instance ``x`` with baseline ``b``, coalition ``z ∈ {0,1}^M``
     maps to the masked input ``z·x + (1−z)·b``; the model is evaluated
     on ALL coalitions in one batched predict, then attributions solve
-    the Shapley-kernel-weighted least squares with the efficiency
-    constraint ``Σφ = f(x) − f(b)`` enforced by substitution.
+    the Shapley-kernel-weighted least squares (host-side float64,
+    factored once per call) with the efficiency constraint
+    ``Σφ = f(x) − f(b)`` enforced by substitution.
 
     When ``2^M − 2 <= n_samples`` every coalition is enumerated and the
     result is the exact Shapley value; otherwise coalitions are sampled
     in complement pairs, sizes drawn ∝ (M−1)/(s(M−s)) (the kernel's
     size profile, so the regression weights stay uniform).
+
+    ``baseline``: "zeros", "mean" (column means of the explained batch),
+    or pass ``background`` — rows of reference data whose column means
+    become the baseline (what "mean" should be for single-instance
+    explain calls).
     """
 
     def __init__(
@@ -179,6 +185,7 @@ class KernelShapExplainer(TPUComponent):
         model: Any = None,
         n_samples: int = 256,
         baseline: str = "zeros",  # zeros | mean
+        background: Optional[Any] = None,  # reference rows (list or array)
         seed: int = 0,
         ridge: float = 1e-6,
         **kwargs: Any,
@@ -186,7 +193,15 @@ class KernelShapExplainer(TPUComponent):
         super().__init__(**kwargs)
         self.model = model
         self.n_samples = int(n_samples)
+        if self.n_samples < 4:
+            raise MicroserviceError(
+                "kernel SHAP needs n_samples >= 4 (got "
+                f"{self.n_samples}) — fewer coalitions cannot support the regression",
+                status_code=400,
+                reason="BAD_REQUEST",
+            )
         self.baseline = baseline
+        self.background = None if background is None else np.atleast_2d(np.asarray(background, np.float64))
         self.seed = int(seed)
         self.ridge = float(ridge)
 
@@ -226,21 +241,27 @@ class KernelShapExplainer(TPUComponent):
 
     # ---- the solve --------------------------------------------------------
 
-    @staticmethod
-    def _solve(Z: np.ndarray, w: np.ndarray, y: np.ndarray, fx: float, fb: float, ridge: float):
-        """Weighted least squares with Σφ = fx − fb substituted out
-        (phi_last = (fx−fb) − Σ others)."""
-        import jax.numpy as jnp
-
-        m = Z.shape[1]
-        A = jnp.asarray(Z[:, :-1] - Z[:, -1:])  # (S, m-1)
-        target = jnp.asarray(y - fb - Z[:, -1] * (fx - fb))
-        wj = jnp.asarray(w)
-        AtW = A.T * wj[None, :]
-        lhs = AtW @ A + ridge * jnp.eye(m - 1)
-        phi_head = jnp.linalg.solve(lhs, AtW @ target)
-        phi_last = (fx - fb) - phi_head.sum()
-        return np.asarray(jnp.concatenate([phi_head, jnp.asarray(phi_last)[None]]))
+    def _baseline(self, X: np.ndarray) -> np.ndarray:
+        m = X.shape[1]
+        if self.background is not None:
+            if self.background.shape[1] != m:
+                raise MicroserviceError(
+                    f"background has {self.background.shape[1]} features, request has {m}",
+                    status_code=400,
+                    reason="BAD_REQUEST",
+                )
+            return self.background.mean(axis=0)
+        if self.baseline == "mean":
+            if len(X) < 2:
+                raise MicroserviceError(
+                    "baseline='mean' over a single instance collapses to the "
+                    "instance itself (all-zero attributions); pass reference "
+                    "rows via 'background' or explain a batch",
+                    status_code=400,
+                    reason="BAD_REQUEST",
+                )
+            return X.mean(axis=0)
+        return np.zeros(m)
 
     def explain(self, X, names=None) -> Dict[str, Any]:
         if self.model is None:
@@ -252,13 +273,21 @@ class KernelShapExplainer(TPUComponent):
                 "kernel SHAP needs at least 2 features", status_code=400, reason="BAD_REQUEST"
             )
         rng = np.random.default_rng(self.seed)
-        b = X.mean(axis=0) if self.baseline == "mean" else np.zeros(m)
+        b = self._baseline(X)
         Z, w = self._coalitions(m, rng)
 
+        # the weighted normal equations share Z/w across every row:
+        # factor once (float64 on host — the system is (m-1)^2 tiny;
+        # the device's job is the batched coalition forwards, not this)
+        A = Z[:, :-1] - Z[:, -1:]  # (S, m-1)
+        AtW = A.T * w[None, :]
+        lhs = AtW @ A + self.ridge * np.eye(m - 1)
+
         names = list(names or [])
-        attributions: List[List[float]] = []
         targets: List[int] = []
         base_values: List[float] = []
+        rhs_cols: List[np.ndarray] = []
+        fx_fb: List[tuple] = []
         for x in X:
             # ONE batched predict: [x, b, every masked coalition]
             masked = Z * x[None, :] + (1.0 - Z) * b[None, :]
@@ -267,11 +296,19 @@ class KernelShapExplainer(TPUComponent):
             if out.ndim == 1:
                 out = out[:, None]
             target = int(np.argmax(out[0]))
-            fx, fb, y = float(out[0, target]), float(out[1, target]), out[2:, target]
-            phi = self._solve(Z, w, y.astype(np.float64), fx, fb, self.ridge)
-            attributions.append(phi.tolist())
+            fx, fb = float(out[0, target]), float(out[1, target])
+            y = out[2:, target].astype(np.float64)
+            rhs_cols.append(AtW @ (y - fb - Z[:, -1] * (fx - fb)))
+            fx_fb.append((fx, fb))
             targets.append(target)
             base_values.append(fb)
+        # one multi-RHS solve for the whole batch; efficiency constraint
+        # Σφ = fx − fb substituted out (phi_last = (fx−fb) − Σ others)
+        phi_head = np.linalg.solve(lhs, np.stack(rhs_cols, axis=1))  # (m-1, n)
+        attributions = [
+            np.append(phi_head[:, i], (fx - fb) - phi_head[:, i].sum()).tolist()
+            for i, (fx, fb) in enumerate(fx_fb)
+        ]
         return {
             "method": "kernel_shap",
             "attributions": attributions,

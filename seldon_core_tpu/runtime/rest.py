@@ -43,9 +43,10 @@ async def _multipart_body(request: web.Request) -> Dict[str, Any]:
     """multipart/form-data request: every top-level message key is a
     form field (reference: flask_utils.get_multi_form_data_request).
 
-    Text fields are JSON-parsed except ``strData`` (taken literally);
-    file uploads are raw bytes for ``binData`` and utf-8 text otherwise
-    (``strData`` may arrive either way)."""
+    A field means the same thing whether sent as text or as a file
+    upload: ``strData`` is taken literally, ``binData`` (file only)
+    stays raw bytes, every other key is JSON-parsed.  A lone ``json``
+    field carries the whole message."""
     form = await request.post()
     keys = list(form.keys())
     if "json" in keys:

@@ -281,6 +281,35 @@ class TestKernelShap:
         assert attrs.sum() == pytest.approx(float(logits_x[t] - logits_b[t]), rel=1e-3)
         server.unload()
 
+    def test_mean_baseline_single_row_is_rejected(self):
+        """mean-of-a-single-row == the row itself -> every attribution
+        would be silently zero; must 400 instead."""
+        from seldon_core_tpu.components.explainers import KernelShapExplainer
+        from seldon_core_tpu.runtime.component import MicroserviceError
+
+        e = KernelShapExplainer(model=_LinearComponent(np.ones(4)), baseline="mean")
+        with pytest.raises(MicroserviceError, match="background"):
+            e.explain(np.ones((1, 4)))
+
+    def test_background_rows_set_the_baseline(self):
+        from seldon_core_tpu.components.explainers import KernelShapExplainer
+
+        w = np.array([2.0, -1.0, 0.5, 3.0])
+        bg = np.array([[1.0, 1.0, 1.0, 1.0], [3.0, 3.0, 3.0, 3.0]])  # mean = 2
+        e = KernelShapExplainer(model=_LinearComponent(w), background=bg)
+        x = np.array([[1.0, 2.0, -1.0, 0.5]])
+        out = e.explain(x)
+        # linear oracle with baseline b: phi_j = w_j * (x_j - b_j)
+        np.testing.assert_allclose(out["attributions"][0], w * (x[0] - 2.0), atol=1e-4)
+        assert out["base_values"][0] == pytest.approx(float(w @ (2.0 * np.ones(4))))
+
+    def test_tiny_n_samples_rejected_at_construction(self):
+        from seldon_core_tpu.components.explainers import KernelShapExplainer
+        from seldon_core_tpu.runtime.component import MicroserviceError
+
+        with pytest.raises(MicroserviceError, match="n_samples"):
+            KernelShapExplainer(model=_LinearComponent(np.ones(4)), n_samples=1)
+
     def test_registry_and_too_few_features(self):
         from seldon_core_tpu.components.explainers import KernelShapExplainer
         from seldon_core_tpu.runtime.component import MicroserviceError
