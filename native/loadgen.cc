@@ -1,0 +1,282 @@
+// Native closed-loop HTTP load generator (the loadtester's hot lane).
+//
+// The reference benchmarks its engine with 64 Locust slaves on three
+// separate nodes (reference: doc/source/reference/benchmarking.md:31-34)
+// so the client never throttles the server.  On a single bench host a
+// Python thread-per-connection client costs more than the C++ front
+// server it is measuring; this epoll client generates pipelined load
+// from one thread at a fraction of the per-request cost, so the
+// measured QPS is the server's, not the client's.
+//
+// Protocol: sends a fixed, caller-built HTTP/1.1 request byte-blob
+// over N keep-alive connections with a configurable number of
+// in-flight requests per connection (pipelining); parses responses by
+// Content-Length framing and counts 2xx completions within the
+// deadline.  POSIX + stdlib only, same constraints as frontserver.cc.
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/epoll.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <cctype>
+#include <cerrno>
+#include <chrono>
+#include <cstdint>
+#include <cstring>
+#include <string>
+#include <vector>
+
+namespace {
+
+using Clock = std::chrono::steady_clock;
+
+struct Conn {
+  int fd = -1;
+  bool connected = false;
+  bool dead = false;
+  int32_t in_flight = 0;     // responses owed by the server
+  int64_t to_send = 0;       // whole requests still to enqueue
+  size_t write_off = 0;      // offset into the current request blob
+  std::string inbuf;
+};
+
+// Parse one response out of buf[pos..). Returns total framed length
+// (header + body) when complete, 0 when more bytes are needed,
+// -1 on unframeable garbage.  *status_out gets the HTTP status code.
+int64_t parse_response(const std::string& buf, size_t pos, int* status_out) {
+  size_t hdr_end = buf.find("\r\n\r\n", pos);
+  if (hdr_end == std::string::npos) return 0;
+  // status line: "HTTP/1.1 NNN ..."
+  size_t sp = buf.find(' ', pos);
+  if (sp == std::string::npos || sp + 3 >= buf.size()) return -1;
+  int status = 0;
+  for (int i = 1; i <= 3; ++i) {
+    char c = buf[sp + i];
+    if (!isdigit((unsigned char)c)) return -1;
+    status = status * 10 + (c - '0');
+  }
+  // find Content-Length (case-insensitive scan of the header block)
+  int64_t content_len = -1;
+  size_t line = pos;
+  while (line < hdr_end) {
+    size_t eol = buf.find("\r\n", line);
+    if (eol == std::string::npos || eol > hdr_end) eol = hdr_end;
+    static const char kCl[] = "content-length:";
+    if (eol - line > sizeof(kCl) - 1) {
+      bool match = true;
+      for (size_t i = 0; i < sizeof(kCl) - 1; ++i) {
+        if (tolower((unsigned char)buf[line + i]) != kCl[i]) { match = false; break; }
+      }
+      if (match) {
+        content_len = 0;
+        for (size_t i = line + sizeof(kCl) - 1; i < eol; ++i) {
+          char c = buf[i];
+          if (isdigit((unsigned char)c)) content_len = content_len * 10 + (c - '0');
+          else if (c != ' ') break;
+        }
+      }
+    }
+    line = eol + 2;
+  }
+  if (content_len < 0) return -1;  // our servers always send it
+  int64_t total = (int64_t)(hdr_end + 4 - pos) + content_len;
+  if ((int64_t)(buf.size() - pos) < total) return 0;
+  *status_out = status;
+  return total;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Run closed-loop load against 127.0.0.1:port. Returns the number of
+// 2xx responses completed before the deadline; *non2xx_out and
+// *errors_out (optional) receive the non-2xx count and the number of
+// connections that died (connect/IO/framing failures).
+int64_t lg_run(const uint8_t* payload, int64_t payload_len, int32_t port,
+               double seconds, int32_t connections, int32_t depth,
+               int64_t* non2xx_out, int64_t* errors_out) {
+  int64_t ok = 0, non2xx = 0, errors = 0;
+  if (payload_len <= 0 || connections <= 0 || depth <= 0 || seconds <= 0) {
+    if (non2xx_out) *non2xx_out = 0;
+    if (errors_out) *errors_out = 1;
+    return 0;
+  }
+
+  int ep = epoll_create1(0);
+  if (ep < 0) {
+    if (errors_out) *errors_out = 1;
+    return 0;
+  }
+
+  std::vector<Conn> conns((size_t)connections);
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons((uint16_t)port);
+  addr.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+
+  auto deadline = Clock::now() + std::chrono::duration<double>(seconds);
+  // after the deadline, wait briefly for in-flight responses so the
+  // count is not biased against deep pipelines
+  auto drain_deadline = deadline + std::chrono::milliseconds(250);
+
+  auto arm = [&](size_t i, uint32_t events) {
+    epoll_event ev{};
+    ev.events = events;
+    ev.data.u64 = i;
+    epoll_ctl(ep, EPOLL_CTL_MOD, conns[i].fd, &ev);
+  };
+
+  auto kill = [&](size_t i, bool count_as_error) {
+    if (conns[i].fd >= 0) {
+      epoll_ctl(ep, EPOLL_CTL_DEL, conns[i].fd, nullptr);
+      close(conns[i].fd);
+      conns[i].fd = -1;
+    }
+    if (!conns[i].dead && count_as_error) ++errors;
+    conns[i].dead = true;
+  };
+
+  size_t alive = 0;
+  for (size_t i = 0; i < conns.size(); ++i) {
+    Conn& c = conns[i];
+    c.fd = socket(AF_INET, SOCK_STREAM | SOCK_NONBLOCK, 0);
+    if (c.fd < 0) { c.dead = true; ++errors; continue; }
+    int one = 1;
+    setsockopt(c.fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    int rc = connect(c.fd, (sockaddr*)&addr, sizeof(addr));
+    if (rc < 0 && errno != EINPROGRESS) { kill(i, true); continue; }
+    c.connected = (rc == 0);
+    c.to_send = depth;
+    epoll_event ev{};
+    ev.events = EPOLLIN | EPOLLOUT;
+    ev.data.u64 = i;
+    epoll_ctl(ep, EPOLL_CTL_ADD, c.fd, &ev);
+    ++alive;
+  }
+
+  std::vector<epoll_event> events(conns.size() ? conns.size() : 1);
+  char rbuf[1 << 16];
+
+  while (alive > 0) {
+    auto now = Clock::now();
+    bool past_deadline = now >= deadline;
+    if (now >= drain_deadline) break;
+    // when the clock runs out, connections with nothing in flight close
+    if (past_deadline) {
+      for (size_t i = 0; i < conns.size(); ++i) {
+        if (!conns[i].dead && conns[i].fd >= 0 && conns[i].in_flight == 0) {
+          kill(i, false);
+          --alive;
+        }
+      }
+      if (alive == 0) break;
+    }
+    auto cap = past_deadline ? drain_deadline : deadline;
+    int timeout_ms = (int)std::chrono::duration_cast<std::chrono::milliseconds>(
+                         cap - now).count() + 1;
+    int n = epoll_wait(ep, events.data(), (int)events.size(), timeout_ms);
+    if (n < 0) {
+      if (errno == EINTR) continue;
+      break;
+    }
+    for (int e = 0; e < n; ++e) {
+      size_t i = (size_t)events[e].data.u64;
+      Conn& c = conns[i];
+      if (c.dead || c.fd < 0) continue;
+
+      if (events[e].events & (EPOLLERR | EPOLLHUP)) {
+        kill(i, true);
+        --alive;
+        continue;
+      }
+
+      if (events[e].events & EPOLLOUT) {
+        if (!c.connected) {
+          int err = 0;
+          socklen_t len = sizeof(err);
+          getsockopt(c.fd, SOL_SOCKET, SO_ERROR, &err, &len);
+          if (err != 0) { kill(i, true); --alive; continue; }
+          c.connected = true;
+        }
+        bool stalled = false;
+        while (!past_deadline && (c.to_send > 0 || c.write_off > 0)) {
+          const uint8_t* p = payload + c.write_off;
+          int64_t want = payload_len - (int64_t)c.write_off;
+          ssize_t w = send(c.fd, p, (size_t)want, MSG_NOSIGNAL);
+          if (w < 0) {
+            if (errno == EAGAIN || errno == EWOULDBLOCK) { stalled = true; break; }
+            kill(i, true);
+            --alive;
+            break;
+          }
+          c.write_off += (size_t)w;
+          if ((int64_t)c.write_off == payload_len) {
+            c.write_off = 0;
+            c.to_send--;
+            c.in_flight++;
+          }
+        }
+        if (c.dead) continue;
+        // stop waking on writability unless a write is pending
+        arm(i, stalled ? (EPOLLIN | EPOLLOUT) : EPOLLIN);
+      }
+
+      if (events[e].events & EPOLLIN) {
+        for (;;) {
+          ssize_t r = recv(c.fd, rbuf, sizeof(rbuf), 0);
+          if (r > 0) {
+            c.inbuf.append(rbuf, (size_t)r);
+            if (r < (ssize_t)sizeof(rbuf)) break;
+            continue;
+          }
+          if (r < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) break;
+          kill(i, true);  // peer closed or error with responses owed
+          --alive;
+          break;
+        }
+        if (c.dead) continue;
+        size_t pos = 0;
+        bool want_write = false;
+        for (;;) {
+          int status = 0;
+          int64_t total = parse_response(c.inbuf, pos, &status);
+          if (total == 0) break;
+          if (total < 0) { kill(i, true); --alive; break; }
+          pos += (size_t)total;
+          c.in_flight--;
+          if (status >= 200 && status < 300) ++ok;
+          else ++non2xx;
+          if (!past_deadline) {
+            c.to_send++;  // closed loop: a completion re-arms a request
+            want_write = true;
+          }
+        }
+        if (c.dead) continue;
+        if (pos > 0) c.inbuf.erase(0, pos);
+        if (past_deadline && c.in_flight == 0) {
+          kill(i, false);
+          --alive;
+          continue;
+        }
+        if (want_write) arm(i, EPOLLIN | EPOLLOUT);
+      }
+    }
+  }
+
+  for (size_t i = 0; i < conns.size(); ++i) {
+    if (conns[i].fd >= 0) {
+      close(conns[i].fd);
+      conns[i].fd = -1;
+    }
+  }
+  close(ep);
+  if (non2xx_out) *non2xx_out = non2xx;
+  if (errors_out) *errors_out = errors;
+  return ok;
+}
+
+}  // extern "C"

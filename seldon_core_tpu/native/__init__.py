@@ -37,7 +37,7 @@ def _so_path() -> str:
 
 # the shared library's inputs (keep in sync with SRCS in native/Makefile;
 # other .cc files there — e.g. remote_node.cc — build separate binaries)
-_LIB_SOURCES = ("codec.cc", "frontserver.cc", "Makefile")
+_LIB_SOURCES = ("codec.cc", "frontserver.cc", "loadgen.cc", "Makefile")
 
 
 def _is_stale(so: str) -> bool:

@@ -364,6 +364,50 @@ def pack_raw_frame(arr: np.ndarray) -> bytes:
     return head + shape + arr.tobytes()
 
 
+def native_load(
+    port: int,
+    payload: bytes,
+    seconds: float = 5.0,
+    connections: int = 8,
+    depth: int = 8,
+) -> Optional[dict]:
+    """Closed-loop load from the C++ epoll client (``native/loadgen.cc``).
+
+    ``payload`` is a complete HTTP/1.1 request blob sent over
+    ``connections`` keep-alive loopback sockets with ``depth`` requests
+    in flight each.  Returns ``{qps, ok, non2xx, errors}`` or None when
+    the native library (or ``lg_run``) is unavailable.  The reference
+    keeps its load generator off the benched host entirely (64 Locust
+    slaves on 3 nodes, reference: benchmarking.md:31-34); this is the
+    single-host equivalent — a client cheap enough that the measured
+    number is the server's.
+    """
+    if seconds <= 0:
+        raise ValueError(f"seconds must be positive, got {seconds}")
+    lib = get_lib()
+    if lib is None or not hasattr(lib, "lg_run"):
+        return None
+    lib.lg_run.restype = ctypes.c_int64
+    lib.lg_run.argtypes = [
+        ctypes.c_char_p, ctypes.c_int64, ctypes.c_int32, ctypes.c_double,
+        ctypes.c_int32, ctypes.c_int32,
+        ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64),
+    ]
+    non2xx = ctypes.c_int64(0)
+    errors = ctypes.c_int64(0)
+    ok = lib.lg_run(
+        payload, len(payload), int(port), float(seconds),
+        int(connections), int(depth),
+        ctypes.byref(non2xx), ctypes.byref(errors),
+    )
+    return {
+        "qps": ok / seconds,
+        "ok": int(ok),
+        "non2xx": int(non2xx.value),
+        "errors": int(errors.value),
+    }
+
+
 class StaleConnection(ConnectionError):
     """A reused keep-alive socket was closed by the peer before any
     response byte — the one case a client may transparently retry."""

@@ -875,3 +875,56 @@ class TestReadHttpResponseResetSemantics:
         with pytest.raises(ConnectionError) as ei:
             fsmod.read_http_response(sock, b"HTTP/1.1 2")
         assert not isinstance(ei.value, fsmod.StaleConnection)
+
+
+class TestNativeLoadgen:
+    """The C++ epoll load client (native/loadgen.cc) — the bench's
+    client must be cheaper than the server it measures."""
+
+    @staticmethod
+    def _payload(path="/api/v0.1/predictions"):
+        frame = fsmod.pack_raw_frame(np.ones((1, 4), np.float32))
+        head = (
+            f"POST {path} HTTP/1.1\r\nHost: t\r\n"
+            "Content-Type: application/x-seldon-raw\r\n"
+            f"Content-Length: {len(frame)}\r\n\r\n"
+        ).encode()
+        return head + frame
+
+    def test_counts_match_server_stats(self):
+        with NativeFrontServer(stub=True, out_dim=3, feature_dim=4, model_name="stub") as srv:
+            out = fsmod.native_load(srv.port, self._payload(), seconds=1.0,
+                                    connections=2, depth=8)
+            assert out is not None
+            assert out["errors"] == 0 and out["non2xx"] == 0
+            assert out["ok"] > 100  # sanity: real throughput flowed
+            stats = srv.stats()
+        # every counted completion was a request the server actually served
+        # (the server may have served a few more in the drain window)
+        assert stats["requests"] >= out["ok"]
+        assert stats["failures"] == 0
+
+    def test_non_2xx_not_counted_as_ok(self):
+        with NativeFrontServer(stub=True, out_dim=3, feature_dim=4, model_name="stub") as srv:
+            out = fsmod.native_load(srv.port, self._payload(path="/nope"),
+                                    seconds=0.5, connections=2, depth=4)
+            assert out is not None
+            assert out["ok"] == 0
+            assert out["non2xx"] > 0
+
+    def test_connection_refused_reports_errors(self):
+        # a port nothing listens on: every connection dies, zero counted
+        sock = socket.socket()
+        sock.bind(("127.0.0.1", 0))
+        port = sock.getsockname()[1]
+        sock.close()  # free it; nothing listens now
+        out = fsmod.native_load(port, self._payload(), seconds=0.5,
+                                connections=3, depth=2)
+        assert out is not None
+        assert out["ok"] == 0
+        assert out["errors"] == 3
+
+    def test_bad_args_are_rejected(self):
+        out = fsmod.native_load(1, b"", seconds=0.5, connections=2, depth=2)
+        assert out is not None
+        assert out["ok"] == 0 and out["errors"] >= 1

@@ -115,3 +115,36 @@ print("tsan exercise done")
         assert res.returncode == 0, f"tsan run failed (rc={res.returncode}):\n{res.stdout}\n{res.stderr[-3000:]}"
         assert "WARNING: ThreadSanitizer" not in res.stderr, res.stderr[-3000:]
         assert "tsan exercise done" in res.stdout
+
+    def test_native_loadgen_against_frontserver_under_tsan(self):
+        """Both ends native: lg_run on the caller thread hammering the
+        server's IO/batcher threads in the same process."""
+        so = _build("tsan", "libseldon_tpu_native_tsan.so")
+        code = """
+import numpy as np
+from seldon_core_tpu.native import frontserver as fsmod
+
+with fsmod.NativeFrontServer(stub=True, feature_dim=4, out_dim=3, model_name="s") as srv:
+    frame = fsmod.pack_raw_frame(np.ones((1, 4), np.float32))
+    head = ("POST /api/v0.1/predictions HTTP/1.1\\r\\nHost: t\\r\\n"
+            "Content-Type: application/x-seldon-raw\\r\\n"
+            f"Content-Length: {len(frame)}\\r\\n\\r\\n").encode()
+    out = fsmod.native_load(srv.port, head + frame, seconds=1.0,
+                            connections=4, depth=8)
+    assert out and out["ok"] > 0 and out["errors"] == 0, out
+print("tsan loadgen done")
+"""
+        res = _run(
+            {
+                "SELDON_TPU_NATIVE_SO": so,
+                "LD_PRELOAD": subprocess.run(
+                    ["g++", "-print-file-name=libtsan.so"],
+                    capture_output=True, text=True,
+                ).stdout.strip(),
+                "TSAN_OPTIONS": "report_bugs=1,exitcode=66,history_size=4",
+            },
+            code,
+        )
+        assert res.returncode == 0, f"tsan run failed (rc={res.returncode}):\n{res.stdout}\n{res.stderr[-3000:]}"
+        assert "WARNING: ThreadSanitizer" not in res.stderr, res.stderr[-3000:]
+        assert "tsan loadgen done" in res.stdout
