@@ -619,13 +619,13 @@ def native_front_qps(seconds: float = 5.0, concurrency: int = 8):
     except Exception:  # noqa: BLE001 — no native lib on this host
         return None
 
-    frame = pack_raw_frame(np.ones((1, 4), np.float32))
-    head = (
-        "POST /api/v0.1/predictions HTTP/1.1\r\nHost: bench\r\n"
-        "Content-Type: application/x-seldon-raw\r\n"
-        f"Content-Length: {len(frame)}\r\n\r\n"
-    ).encode()
-    payload = head + frame
+    from seldon_core_tpu.testing.loadgen import build_http_blob
+
+    payload = build_http_blob(
+        "/api/v0.1/predictions",
+        pack_raw_frame(np.ones((1, 4), np.float32)),
+        content_type="application/x-seldon-raw",
+    )
 
     if hasattr(get_lib(), "lg_run"):
         with server as srv:

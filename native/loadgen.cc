@@ -45,8 +45,11 @@ struct Conn {
 
 // Parse one response out of buf[pos..). Returns total framed length
 // (header + body) when complete, 0 when more bytes are needed,
-// -1 on unframeable garbage.  *status_out gets the HTTP status code.
-int64_t parse_response(const std::string& buf, size_t pos, int* status_out) {
+// -1 on unframeable garbage.  *status_out gets the HTTP status code;
+// *close_out is set when the server sent "Connection: close" (an
+// HTTP/1.1 client must not reuse that connection).
+int64_t parse_response(const std::string& buf, size_t pos, int* status_out,
+                       bool* close_out) {
   size_t hdr_end = buf.find("\r\n\r\n", pos);
   if (hdr_end == std::string::npos) return 0;
   // status line: "HTTP/1.1 NNN ..."
@@ -58,26 +61,34 @@ int64_t parse_response(const std::string& buf, size_t pos, int* status_out) {
     if (!isdigit((unsigned char)c)) return -1;
     status = status * 10 + (c - '0');
   }
-  // find Content-Length (case-insensitive scan of the header block)
+  // scan headers (case-insensitive) for Content-Length and
+  // Connection: close
   int64_t content_len = -1;
+  auto matches = [&](size_t line, size_t eol, const char* name, size_t len) {
+    if (eol - line <= len) return false;
+    for (size_t i = 0; i < len; ++i) {
+      if (tolower((unsigned char)buf[line + i]) != name[i]) return false;
+    }
+    return true;
+  };
   size_t line = pos;
   while (line < hdr_end) {
     size_t eol = buf.find("\r\n", line);
     if (eol == std::string::npos || eol > hdr_end) eol = hdr_end;
     static const char kCl[] = "content-length:";
-    if (eol - line > sizeof(kCl) - 1) {
-      bool match = true;
-      for (size_t i = 0; i < sizeof(kCl) - 1; ++i) {
-        if (tolower((unsigned char)buf[line + i]) != kCl[i]) { match = false; break; }
+    static const char kConn[] = "connection:";
+    if (matches(line, eol, kCl, sizeof(kCl) - 1)) {
+      content_len = 0;
+      for (size_t i = line + sizeof(kCl) - 1; i < eol; ++i) {
+        char c = buf[i];
+        if (isdigit((unsigned char)c)) content_len = content_len * 10 + (c - '0');
+        else if (c != ' ') break;
       }
-      if (match) {
-        content_len = 0;
-        for (size_t i = line + sizeof(kCl) - 1; i < eol; ++i) {
-          char c = buf[i];
-          if (isdigit((unsigned char)c)) content_len = content_len * 10 + (c - '0');
-          else if (c != ' ') break;
-        }
-      }
+    } else if (matches(line, eol, kConn, sizeof(kConn) - 1)) {
+      std::string v = buf.substr(line + sizeof(kConn) - 1,
+                                 eol - line - (sizeof(kConn) - 1));
+      for (auto& ch : v) ch = (char)tolower((unsigned char)ch);
+      if (v.find("close") != std::string::npos && close_out) *close_out = true;
     }
     line = eol + 2;
   }
@@ -188,13 +199,17 @@ int64_t lg_run(const uint8_t* payload, int64_t payload_len, int32_t port,
       Conn& c = conns[i];
       if (c.dead || c.fd < 0) continue;
 
-      if (events[e].events & (EPOLLERR | EPOLLHUP)) {
+      // ERR/HUP (close, RST) is judged AFTER draining: responses already
+      // buffered still count, and a close with nothing owed is clean
+      bool hangup = (events[e].events & (EPOLLERR | EPOLLHUP)) != 0;
+
+      if (!c.connected && hangup) {  // connect itself failed
         kill(i, true);
         --alive;
         continue;
       }
 
-      if (events[e].events & EPOLLOUT) {
+      if ((events[e].events & EPOLLOUT) && !hangup) {
         if (!c.connected) {
           int err = 0;
           socklen_t len = sizeof(err);
@@ -225,7 +240,8 @@ int64_t lg_run(const uint8_t* payload, int64_t payload_len, int32_t port,
         arm(i, stalled ? (EPOLLIN | EPOLLOUT) : EPOLLIN);
       }
 
-      if (events[e].events & EPOLLIN) {
+      if ((events[e].events & EPOLLIN) || hangup) {
+        bool peer_closed = hangup;
         for (;;) {
           ssize_t r = recv(c.fd, rbuf, sizeof(rbuf), 0);
           if (r > 0) {
@@ -234,29 +250,36 @@ int64_t lg_run(const uint8_t* payload, int64_t payload_len, int32_t port,
             continue;
           }
           if (r < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) break;
-          kill(i, true);  // peer closed or error with responses owed
-          --alive;
-          break;
+          peer_closed = true;  // orderly close or error — parse what we
+          break;               // already have before judging it
         }
-        if (c.dead) continue;
         size_t pos = 0;
         bool want_write = false;
         for (;;) {
           int status = 0;
-          int64_t total = parse_response(c.inbuf, pos, &status);
+          bool server_close = false;
+          int64_t total = parse_response(c.inbuf, pos, &status, &server_close);
           if (total == 0) break;
           if (total < 0) { kill(i, true); --alive; break; }
           pos += (size_t)total;
           c.in_flight--;
           if (status >= 200 && status < 300) ++ok;
           else ++non2xx;
-          if (!past_deadline) {
+          if (server_close) peer_closed = true;  // must not reuse this socket
+          if (!past_deadline && !peer_closed) {
             c.to_send++;  // closed loop: a completion re-arms a request
             want_write = true;
           }
         }
         if (c.dead) continue;
         if (pos > 0) c.inbuf.erase(0, pos);
+        if (peer_closed) {
+          // a close with every owed response delivered is clean (a
+          // Connection: close server); owed responses lost = error
+          kill(i, c.in_flight > 0);
+          --alive;
+          continue;
+        }
         if (past_deadline && c.in_flight == 0) {
           kill(i, false);
           --alive;

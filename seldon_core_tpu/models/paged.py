@@ -295,6 +295,10 @@ class PagedEngine:
         self._lengths = np.zeros((self.max_slots,), np.int32)
         self._next_id = 0
         self._closed = False
+        # observability counters (exported by StreamingLM.metrics();
+        # updated under _lock)
+        self._counters = {"chunks": 0, "tokens": 0, "evictions": 0,
+                          "stalls": 0, "prefills": 0, "completed": 0}
 
         self._prefill_jit: Dict[int, Any] = {}
         self._chunk = jax.jit(self._chunk_fn, donate_argnums=(1, 2))
@@ -515,6 +519,7 @@ class PagedEngine:
         self._free(stream.pages)
         stream.pages = []
         self._lengths[slot] = 0
+        self._counters["completed"] += 1
         stream.event.set()
 
     def _evict_locked(self, stream: _Stream) -> None:
@@ -527,11 +532,24 @@ class PagedEngine:
         stream.tokens = []
         stream.slot = None
         self._lengths[slot] = 0
+        self._counters["evictions"] += 1
         self._queue.insert(0, stream)
 
     def has_work(self) -> bool:
         with self._lock:
             return bool(self._queue) or any(s is not None for s in self._slots)
+
+    def engine_stats(self) -> Dict[str, Any]:
+        """Counters + live occupancy, the generation observability
+        surface (jaxserver's batcher stats equivalent)."""
+        with self._lock:
+            return {
+                **self._counters,
+                "active_slots": sum(s is not None for s in self._slots),
+                "queued_streams": len(self._queue),
+                "pool_pages_used": self.num_pages - 1 - len(self._free_pages),
+                "pool_pages_total": self.num_pages - 1,
+            }
 
     def close(self, exc: Optional[Exception] = None) -> None:
         """Permanently shut the engine: future submits are rejected with
@@ -573,6 +591,7 @@ class PagedEngine:
             self._prefill_stream(stream)
 
         with self._lock:
+            self._counters["prefills"] += len(admitted)
             active = [s for s in self._slots if s is not None]
             if not active:
                 return bool(self._queue)
@@ -580,6 +599,7 @@ class PagedEngine:
             for stream in active:
                 if not self._ensure_pages_locked(stream):
                     stalled[stream.slot] = True
+            self._counters["stalls"] += int(stalled.sum())
             # every active stream stalled on pool pressure: evict victims
             # (least progress lost, ties to the youngest) back to the head
             # of the queue until someone can run.  Seeds are deterministic
@@ -624,11 +644,13 @@ class PagedEngine:
         self._lengths = np.array(lengths_out)  # copy: jax views are read-only
 
         with self._lock:
+            self._counters["chunks"] += 1
             for stream in active:
                 s = stream.slot
                 if stalled[s]:
                     continue
                 n = int(emitted_np[s])
+                self._counters["tokens"] += n
                 got = toks_np[s, :n].tolist()
                 stream.tokens.extend(got)
                 hit_eos = stream.eos_id in got
@@ -790,6 +812,26 @@ class StreamingLM(TPUComponent):
             if stream.error:
                 raise stream.error
         return np.stack([s.result for s in streams])
+
+    def metrics(self):
+        """Paged-engine health for the dashboards.  All GAUGEs:
+        metrics() is collected after every request, so cumulative values
+        exported as COUNTERs would be inc()'d repeatedly (same
+        convention as jaxserver/SpeculativeLM)."""
+        if self.engine is None:
+            return []
+        s = self.engine.engine_stats()
+        total = max(1, s["pool_pages_total"])
+        return [
+            {"type": "GAUGE", "key": "paged_active_slots", "value": s["active_slots"]},
+            {"type": "GAUGE", "key": "paged_queued_streams", "value": s["queued_streams"]},
+            {"type": "GAUGE", "key": "paged_pool_utilization", "value": s["pool_pages_used"] / total},
+            {"type": "GAUGE", "key": "paged_evictions", "value": s["evictions"]},
+            {"type": "GAUGE", "key": "paged_stall_events", "value": s["stalls"]},
+            {"type": "GAUGE", "key": "paged_chunks", "value": s["chunks"]},
+            {"type": "GAUGE", "key": "paged_tokens_emitted", "value": s["tokens"]},
+            {"type": "GAUGE", "key": "paged_streams_completed", "value": s["completed"]},
+        ]
 
     def class_names(self):
         return []

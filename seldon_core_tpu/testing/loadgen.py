@@ -87,3 +87,118 @@ def run_load(
     for t in threads:
         t.join()
     return LoadResult(duration_s=duration_s, requests=len(latencies), errors=errors[0], latencies_ms=latencies)
+
+
+# ---------------------------------------------------------------------------
+# standalone CLI (the reference's locust scripts as one command)
+# ---------------------------------------------------------------------------
+
+
+def build_http_blob(path: str, body: bytes, content_type: str, host: str = "load") -> bytes:
+    """A complete HTTP/1.1 keep-alive request as one byte-blob (what the
+    native epoll client replays)."""
+    head = (
+        f"POST {path} HTTP/1.1\r\nHost: {host}\r\n"
+        f"Content-Type: {content_type}\r\n"
+        f"Content-Length: {len(body)}\r\n\r\n"
+    ).encode()
+    return head + body
+
+
+def native_http_load(
+    port: int,
+    path: str,
+    body: bytes,
+    content_type: str = "application/json",
+    seconds: float = 10.0,
+    connections: int = 8,
+    depth: int = 16,
+) -> Optional[Dict[str, Any]]:
+    """Drive a loopback HTTP endpoint from the C++ epoll client
+    (``native/loadgen.cc``) — maximum-throughput mode, where the client
+    must not throttle the server.  Returns ``{qps, ok, non2xx, errors}``
+    or None when the native library is unavailable."""
+    from seldon_core_tpu.native.frontserver import native_load
+
+    return native_load(
+        port, build_http_blob(path, body, content_type),
+        seconds=seconds, connections=connections, depth=depth,
+    )
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    """CLI: seldon-tpu-load HOST PORT [--shape 1,4 | --body-file f.json]
+
+    Two lanes, mirroring how the reference splits Locust workers from
+    the benched service:
+
+    * default — Python closed-loop workers (latency percentiles, any
+      host);
+    * ``--native`` — the C++ epoll client (throughput-first, loopback
+      only, needs the native library).
+    """
+    import argparse
+    import json as _json
+    import urllib.request
+
+    import numpy as np
+
+    parser = argparse.ArgumentParser(description="seldon-core-tpu load generator")
+    parser.add_argument("host", nargs="?", default="127.0.0.1")
+    parser.add_argument("port", type=int)
+    parser.add_argument("--path", default="/api/v0.1/predictions")
+    parser.add_argument("--shape", default="1,4",
+                        help="random ndarray payload shape, e.g. 1,4 or 1,224,224,3")
+    parser.add_argument("--body-file", default="",
+                        help="send this file's bytes instead of a generated payload")
+    parser.add_argument("--content-type", default="application/json")
+    parser.add_argument("--duration", type=float, default=10.0)
+    parser.add_argument("--concurrency", type=int, default=16)
+    parser.add_argument("--native", action="store_true",
+                        help="C++ epoll client (loopback only, max throughput)")
+    parser.add_argument("--connections", type=int, default=8)
+    parser.add_argument("--depth", type=int, default=16)
+    args = parser.parse_args(argv)
+
+    if args.body_file:
+        with open(args.body_file, "rb") as f:
+            body = f.read()
+    else:
+        shape = tuple(int(d) for d in args.shape.split(","))
+        rng = np.random.default_rng(0)
+        body = _json.dumps(
+            {"data": {"ndarray": rng.random(shape).round(4).tolist()}}
+        ).encode()
+
+    if args.native:
+        if args.host not in ("127.0.0.1", "localhost"):
+            print(_json.dumps({"error": "--native drives loopback only"}))
+            return 2
+        out = native_http_load(
+            args.port, args.path, body, content_type=args.content_type,
+            seconds=args.duration, connections=args.connections, depth=args.depth,
+        )
+        if out is None:
+            print(_json.dumps({"error": "native library unavailable"}))
+            return 2
+        print(_json.dumps(out))
+        return 0 if out["errors"] == 0 and out["non2xx"] == 0 else 1
+
+    url = f"http://{args.host}:{args.port}{args.path}"
+
+    def one() -> bool:
+        req = urllib.request.Request(
+            url, data=body, headers={"Content-Type": args.content_type}
+        )
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            return 200 <= resp.status < 300
+
+    result = run_load(one, duration_s=args.duration, concurrency=args.concurrency)
+    print(_json.dumps(result.summary()))
+    return 0 if result.errors == 0 else 1
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

@@ -145,6 +145,54 @@ class TestLoadgen:
         assert summary["p50_ms"] is not None
         assert summary["qps"] > 0
 
+    def test_cli_native_lane_against_front_server(self, capsys):
+        import json as _json
+
+        from seldon_core_tpu.native.frontserver import NativeFrontServer
+        from seldon_core_tpu.testing.loadgen import main
+
+        with NativeFrontServer(stub=True, feature_dim=4, out_dim=3,
+                               model_name="stub") as srv:
+            rc = main(["127.0.0.1", str(srv.port), "--native",
+                       "--duration", "0.5", "--shape", "1,4",
+                       "--connections", "2", "--depth", "4"])
+        out = _json.loads(capsys.readouterr().out)
+        assert rc == 0
+        assert out["ok"] > 0 and out["errors"] == 0 and out["non2xx"] == 0
+
+    def test_cli_python_lane_against_rest_microservice(self, capsys):
+        import asyncio
+        import json as _json
+
+        from seldon_core_tpu.runtime import rest
+        from seldon_core_tpu.testing.loadgen import main
+
+        class Echo(TPUComponent):
+            def predict(self, X, names, meta=None):
+                return np.asarray(X)
+
+        async def scenario():
+            app = rest.build_app(Echo())
+            runner = await rest.serve(app, host="127.0.0.1", port=0)
+            port = runner.addresses[0][1]
+            rc = await asyncio.to_thread(
+                main, ["127.0.0.1", str(port), "--path", "/predict",
+                       "--shape", "1,4", "--duration", "0.5",
+                       "--concurrency", "2"])
+            await runner.cleanup()
+            return rc
+
+        rc = asyncio.run(scenario())
+        out = _json.loads(capsys.readouterr().out)
+        assert rc == 0
+        assert out["qps"] > 0 and out["errors"] == 0
+
+    def test_cli_native_refuses_remote_hosts(self, capsys):
+        from seldon_core_tpu.testing.loadgen import main
+
+        rc = main(["10.0.0.1", "80", "--native", "--duration", "0.1"])
+        assert rc == 2
+
 
 class TestExplainers:
     def test_integrated_gradients_on_jaxserver(self):

@@ -883,13 +883,12 @@ class TestNativeLoadgen:
 
     @staticmethod
     def _payload(path="/api/v0.1/predictions"):
-        frame = fsmod.pack_raw_frame(np.ones((1, 4), np.float32))
-        head = (
-            f"POST {path} HTTP/1.1\r\nHost: t\r\n"
-            "Content-Type: application/x-seldon-raw\r\n"
-            f"Content-Length: {len(frame)}\r\n\r\n"
-        ).encode()
-        return head + frame
+        from seldon_core_tpu.testing.loadgen import build_http_blob
+
+        return build_http_blob(
+            path, fsmod.pack_raw_frame(np.ones((1, 4), np.float32)),
+            content_type="application/x-seldon-raw",
+        )
 
     def test_counts_match_server_stats(self):
         with NativeFrontServer(stub=True, out_dim=3, feature_dim=4, model_name="stub") as srv:
@@ -928,3 +927,38 @@ class TestNativeLoadgen:
         out = fsmod.native_load(1, b"", seconds=0.5, connections=2, depth=2)
         assert out is not None
         assert out["ok"] == 0 and out["errors"] >= 1
+
+    def test_connection_close_server_counts_delivered_responses(self):
+        """A server that answers once then closes (Connection: close)
+        must yield its delivered responses as ok, not as errors."""
+        import socketserver
+
+        class OneShot(socketserver.BaseRequestHandler):
+            def handle(self):
+                buf = b""
+                while b"\r\n\r\n" not in buf:
+                    chunk = self.request.recv(4096)
+                    if not chunk:
+                        return
+                    buf += chunk
+                body = b"{}"
+                self.request.sendall(
+                    b"HTTP/1.1 200 OK\r\nConnection: close\r\n"
+                    b"Content-Length: %d\r\n\r\n%s" % (len(body), body)
+                )
+                # close happens when handle returns
+
+        with socketserver.ThreadingTCPServer(("127.0.0.1", 0), OneShot) as srv:
+            t = threading.Thread(target=srv.serve_forever, daemon=True)
+            t.start()
+            out = fsmod.native_load(
+                srv.server_address[1], self._payload(), seconds=0.5,
+                connections=3, depth=1,
+            )
+            srv.shutdown()
+        assert out is not None
+        # each connection delivered exactly one response before closing;
+        # the close with one request still owed is the server's choice,
+        # not a client error
+        assert out["ok"] == 3, out
+        assert out["errors"] == 0, out

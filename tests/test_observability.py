@@ -175,11 +175,15 @@ class TestMonitoringAssets:
             "seldon_api_engine_client_requests_duration_seconds",
             "seldon_api_model_feedback",
             "outliers_total",
+            # generation lane (StreamingLM/SpeculativeLM metrics())
+            "paged_pool_utilization",
+            "paged_evictions",
+            "speculative_acceptance_rate",
         ):
             assert metric in exprs, f"alert rules no longer cover {metric}"
         for g in rules["groups"]:
             for r in g["rules"]:
-                assert r["labels"]["severity"] in ("warning", "critical")
+                assert r["labels"]["severity"] in ("info", "warning", "critical")
                 assert "summary" in r["annotations"]
 
     def test_prometheus_config_wires_rules_and_alertmanager(self):
@@ -201,7 +205,9 @@ class TestMonitoringAssets:
 
         gdir = os.path.join(self.MONITORING, "grafana")
         dashboards = [f for f in os.listdir(gdir) if f.endswith(".json")]
-        assert len(dashboards) >= 2  # predictions + outliers (reference ships several)
+        # predictions + outliers + generation (reference ships several)
+        assert len(dashboards) >= 3
+        emitted_families = ("seldon_api", "outliers_total", "paged_", "speculative_")
         for name in dashboards:
             with open(os.path.join(gdir, name)) as f:
                 dash = json.load(f)
@@ -209,7 +215,19 @@ class TestMonitoringAssets:
             exprs = " ".join(
                 t["expr"] for p in dash["panels"] for t in p.get("targets", [])
             )
-            assert "seldon_api" in exprs or "outliers_total" in exprs, name
+            assert any(fam in exprs for fam in emitted_families), name
+
+    def test_generation_dashboard_covers_engine_stats(self):
+        import json
+
+        with open(os.path.join(self.MONITORING, "grafana", "generation-dashboard.json")) as f:
+            dash = json.load(f)
+        exprs = " ".join(
+            t["expr"] for p in dash["panels"] for t in p.get("targets", [])
+        )
+        for metric in ("paged_pool_utilization", "paged_tokens_emitted",
+                       "paged_stall_events", "speculative_acceptance_rate"):
+            assert metric in exprs, metric
 
 
 class TestOtlpExporter:

@@ -356,3 +356,48 @@ class TestStreamingComponent:
         )
         comp.shutdown()
         assert out.shape == (1, 7)
+
+
+class TestEngineStats:
+    def test_counters_track_a_generation(self, lm):
+        _, params = lm
+        engine = _engine(params)
+        engine.generate(np.array([5, 9, 13], np.int32), max_new_tokens=6)
+        s = engine.engine_stats()
+        assert s["prefills"] == 1
+        assert s["completed"] == 1
+        assert s["tokens"] == 6
+        assert s["chunks"] >= 2  # 6 tokens at steps_per_call=4
+        assert s["active_slots"] == 0 and s["queued_streams"] == 0
+        assert s["pool_pages_used"] == 0  # everything freed on finish
+        assert s["pool_pages_total"] == engine.num_pages - 1
+
+    def test_evictions_and_stalls_counted_under_pressure(self, lm):
+        _, params = lm
+        # pool too small for two full-length streams -> stall + evict
+        engine = _engine(params, num_pages=2 * (24 // 8) - 1, max_slots=2)
+        s1 = engine.submit(np.arange(8, dtype=np.int32) % 60, max_new_tokens=12)
+        s2 = engine.submit(np.arange(6, dtype=np.int32) % 60, max_new_tokens=12)
+        engine.run()
+        assert s1.result is not None and s2.result is not None
+        s = engine.engine_stats()
+        assert s["stalls"] + s["evictions"] > 0
+        assert s["completed"] == 2
+
+    def test_streaming_component_exports_gauges(self, lm):
+        _, params = lm
+        comp = StreamingLM(max_new_tokens=4, max_slots=2, page_size=8,
+                           steps_per_call=2, **CFG)
+        comp.load()
+        comp.engine = PagedEngine(
+            params, dtype=jnp.float32, page_size=8, max_slots=2,
+            steps_per_call=2, **CFG,
+        )
+        comp.predict(np.array([[3, 1, 4]]), [])
+        by_key = {m["key"]: m for m in comp.metrics()}
+        comp.shutdown()
+        assert by_key["paged_tokens_emitted"]["value"] == 4
+        assert by_key["paged_streams_completed"]["value"] == 1
+        assert 0.0 <= by_key["paged_pool_utilization"]["value"] <= 1.0
+        # collected after every request -> cumulative values must be GAUGEs
+        assert all(m["type"] == "GAUGE" for m in comp.metrics())

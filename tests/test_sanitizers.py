@@ -123,13 +123,13 @@ print("tsan exercise done")
         code = """
 import numpy as np
 from seldon_core_tpu.native import frontserver as fsmod
+from seldon_core_tpu.testing.loadgen import build_http_blob
 
 with fsmod.NativeFrontServer(stub=True, feature_dim=4, out_dim=3, model_name="s") as srv:
-    frame = fsmod.pack_raw_frame(np.ones((1, 4), np.float32))
-    head = ("POST /api/v0.1/predictions HTTP/1.1\\r\\nHost: t\\r\\n"
-            "Content-Type: application/x-seldon-raw\\r\\n"
-            f"Content-Length: {len(frame)}\\r\\n\\r\\n").encode()
-    out = fsmod.native_load(srv.port, head + frame, seconds=1.0,
+    blob = build_http_blob("/api/v0.1/predictions",
+                           fsmod.pack_raw_frame(np.ones((1, 4), np.float32)),
+                           content_type="application/x-seldon-raw")
+    out = fsmod.native_load(srv.port, blob, seconds=1.0,
                             connections=4, depth=8)
     assert out and out["ok"] > 0 and out["errors"] == 0, out
 print("tsan loadgen done")
