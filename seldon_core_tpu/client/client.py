@@ -60,6 +60,8 @@ class SeldonTpuClient:
         timeout_s: float = 30.0,
         channel_credentials=None,  # utils.tls.ChannelCredentials -> TLS
         call_credentials=None,  # utils.tls.CallCredentials -> auth token
+        oauth_key: str = "",  # gateway client-credentials grant
+        oauth_secret: str = "",  # (reference: seldon_client.py:1186-1227)
     ):
         if transport not in ("rest", "grpc"):
             raise ValueError("transport must be 'rest' or 'grpc'")
@@ -70,6 +72,9 @@ class SeldonTpuClient:
         self.timeout_s = timeout_s
         self.channel_credentials = channel_credentials
         self.call_credentials = call_credentials
+        self.oauth_key = oauth_key
+        self.oauth_secret = oauth_secret
+        self._bearer_token: str = ""
         self._channel = None
         self._session = None
 
@@ -90,16 +95,57 @@ class SeldonTpuClient:
                 self._channel = grpc.insecure_channel(addr)
         return self._channel
 
-    def _call_metadata(self):
+    def get_token(self, refresh: bool = False) -> str:
+        """Fetch (and cache) a bearer token from the gateway's
+        ``/oauth/token`` with the client-credentials grant (HTTP Basic,
+        reference: seldon_client.py get_token)."""
+        if self._bearer_token and not refresh:
+            return self._bearer_token
+        import requests
+
+        scheme = "http"
+        kwargs: Dict[str, Any] = {}
+        if self.channel_credentials is not None:
+            from seldon_core_tpu.utils.tls import requests_tls_kwargs
+
+            scheme = "https"
+            kwargs = requests_tls_kwargs(self.channel_credentials)
+        resp = requests.post(
+            f"{scheme}://{self.host}:{self.http_port}/oauth/token",
+            auth=(self.oauth_key, self.oauth_secret),
+            data={"grant_type": "client_credentials"},
+            timeout=self.timeout_s,
+            **kwargs,
+        )
+        if resp.status_code != 200:
+            raise ConnectionError(f"token request failed: {resp.status_code} {resp.text[:200]}")
+        self._bearer_token = resp.json()["access_token"]
+        return self._bearer_token
+
+    def _call_metadata(self, refresh_token: bool = False):
+        md = []
+        if self.oauth_key:
+            md.append(("authorization", f"Bearer {self.get_token(refresh=refresh_token)}"))
         if self.call_credentials is not None and self.call_credentials.token:
-            return [("x-auth-token", self.call_credentials.token)]
-        return None
+            md.append(("x-auth-token", self.call_credentials.token))
+        return md or None
 
     def _grpc_call(self, service: str, method: str, request_proto):
+        import grpc
+
         from seldon_core_tpu.proto import services
 
         call = services.unary_callable(self._ensure_channel(), service, method)
-        return call(request_proto, timeout=self.timeout_s, metadata=self._call_metadata())
+        try:
+            return call(request_proto, timeout=self.timeout_s, metadata=self._call_metadata())
+        except grpc.RpcError as e:
+            # expired token: one transparent refresh, like the REST lane
+            if self.oauth_key and e.code() == grpc.StatusCode.UNAUTHENTICATED:
+                return call(
+                    request_proto, timeout=self.timeout_s,
+                    metadata=self._call_metadata(refresh_token=True),
+                )
+            raise
 
     def _rest_post(self, path: str, body: Dict[str, Any]) -> Tuple[int, Dict[str, Any]]:
         import requests
@@ -114,15 +160,20 @@ class SeldonTpuClient:
             scheme = "https"
             kwargs = requests_tls_kwargs(self.channel_credentials)
         headers = {}
+        if self.oauth_key:
+            headers["Authorization"] = f"Bearer {self.get_token()}"
         if self.call_credentials is not None and self.call_credentials.token:
             headers["X-Auth-Token"] = self.call_credentials.token
+        url = f"{scheme}://{self.host}:{self.http_port}{path}"
         resp = self._session.post(
-            f"{scheme}://{self.host}:{self.http_port}{path}",
-            json=body,
-            timeout=self.timeout_s,
-            headers=headers or None,
-            **kwargs,
+            url, json=body, timeout=self.timeout_s, headers=headers or None, **kwargs
         )
+        if resp.status_code == 401 and self.oauth_key:
+            # expired token: one transparent refresh
+            headers["Authorization"] = f"Bearer {self.get_token(refresh=True)}"
+            resp = self._session.post(
+                url, json=body, timeout=self.timeout_s, headers=headers, **kwargs
+            )
         try:
             return resp.status_code, resp.json()
         except ValueError:

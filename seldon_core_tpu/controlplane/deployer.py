@@ -470,6 +470,25 @@ async def serve_deployment(
         )
     else:
         tls = TlsConfig.from_env()
+
+    # gateway OAuth (the reference's legacy API-gateway token flow):
+    # annotations carry the client-credentials pair
+    auth = None
+    oauth_key = spec.annotations.get("seldon.io/oauth-key", "")
+    if oauth_key or spec.annotations.get("seldon.io/oauth-secret"):
+        from seldon_core_tpu.utils.auth import OAuthConfig
+
+        auth = OAuthConfig(
+            key=oauth_key,
+            secret=spec.annotations.get("seldon.io/oauth-secret", ""),
+            ttl_s=float(spec.annotations.get("seldon.io/oauth-token-ttl-s", "3600")),
+        )
+    if auth is not None and frontend == "native":
+        logger.warning(
+            "oauth requested: using python frontend (native ingress has no token lane)"
+        )
+        frontend = "python"
+
     if tls is not None and frontend == "native":
         # the C++ ingress does not terminate TLS; honouring the TLS
         # request matters more than the native fast lane
@@ -507,11 +526,14 @@ async def serve_deployment(
                 await http_handle.stop()
 
     runner, grpc_srv = await engine_server.serve_gateway(
-        proxy, host=host, http_port=http_port, grpc_port=grpc_port, tls=tls
+        proxy, host=host, http_port=http_port, grpc_port=grpc_port, tls=tls,
+        auth=auth,
     )
     logger.info(
-        "deployment %s serving http=:%d grpc=:%d%s",
-        name, http_port, grpc_port, " (TLS)" if tls is not None else "",
+        "deployment %s serving http=:%d grpc=:%d%s%s",
+        name, http_port, grpc_port,
+        " (TLS)" if tls is not None else "",
+        " (oauth)" if auth is not None else "",
     )
     return runner, grpc_srv
 

@@ -162,8 +162,61 @@ class Gateway:
         await asyncio.gather(*(p.close() for p in self.predictors))
 
 
-def build_gateway_app(gateway: Gateway) -> web.Application:
-    app = web.Application(client_max_size=1024 * 1024 * 512)
+def build_gateway_app(gateway: Gateway, auth=None) -> web.Application:
+    """``auth`` is an ``utils.auth.OAuthConfig``; when set, the data
+    endpoints require ``Authorization: Bearer`` tokens issued by this
+    gateway's ``/oauth/token`` (client-credentials grant — the
+    reference's legacy API-gateway flow,
+    reference: seldon_client.py:1186-1227). Health/metrics endpoints
+    stay open, like the reference's probe surface."""
+    issuer = None
+    if auth is not None:
+        from seldon_core_tpu.utils.auth import TokenIssuer, parse_basic_auth
+
+        issuer = TokenIssuer(auth)
+
+        @web.middleware
+        async def require_token(request: web.Request, handler):
+            # data endpoints AND mutating admin verbs (/pause, /unpause)
+            # need a token; probes + /metrics + /oauth/token stay open
+            guarded = (
+                request.path.startswith("/api/")
+                or request.path in ("/predict", "/pause", "/unpause")
+            )
+            if guarded and not issuer.verify_header(request.headers.get("Authorization")):
+                from seldon_core_tpu.utils.auth import UNAUTHENTICATED_MSG
+
+                resp = web.json_response(
+                    {"status": {"status": "FAILURE", "code": 401,
+                                "info": UNAUTHENTICATED_MSG,
+                                "reason": "UNAUTHORIZED"}},
+                    status=401,
+                )
+                # small declared bodies drain (keeps keep-alive sockets
+                # reusable); big or unsized (chunked) unauthenticated
+                # payloads must not be buffered — close the connection
+                # instead of paying for the bytes
+                cl = request.content_length
+                if cl is not None and cl <= 1 << 20:
+                    await request.read()
+                else:
+                    resp.force_close()
+                return resp
+            return await handler(request)
+
+        app = web.Application(
+            client_max_size=1024 * 1024 * 512, middlewares=[require_token]
+        )
+
+        async def oauth_token(request: web.Request) -> web.Response:
+            creds = parse_basic_auth(request.headers.get("Authorization"))
+            if creds is None or not issuer.check_credentials(*creds):
+                return web.json_response({"error": "invalid_client"}, status=401)
+            return web.json_response(issuer.issue())
+
+        app.router.add_post("/oauth/token", oauth_token)
+    else:
+        app = web.Application(client_max_size=1024 * 1024 * 512)
 
     async def predictions(request: web.Request) -> web.Response:
         try:
@@ -246,15 +299,29 @@ def build_gateway_app(gateway: Gateway) -> web.Application:
     return app
 
 
-def add_seldon_service(server: grpc.aio.Server, gateway: Gateway) -> None:
-    """Register the external Seldon gRPC service."""
+def add_seldon_service(server: grpc.aio.Server, gateway: Gateway, auth=None) -> None:
+    """Register the external Seldon gRPC service.  With ``auth`` set,
+    calls must carry ``authorization: Bearer <token>`` metadata."""
+    issuer = None
+    if auth is not None:
+        from seldon_core_tpu.utils.auth import TokenIssuer
+
+        issuer = TokenIssuer(auth)
+
+    async def check_auth(context) -> None:
+        if issuer is not None and not issuer.verify_grpc(context):
+            from seldon_core_tpu.utils.auth import UNAUTHENTICATED_MSG
+
+            await context.abort(grpc.StatusCode.UNAUTHENTICATED, UNAUTHENTICATED_MSG)
 
     async def predict(request: pb.SeldonMessage, context) -> pb.SeldonMessage:
+        await check_auth(context)
         msg = InternalMessage.from_proto(request)
         out = await gateway.predict(msg)
         return out.to_proto()
 
     async def send_feedback(request: pb.Feedback, context) -> pb.SeldonMessage:
+        await check_auth(context)
         fb = InternalFeedback.from_proto(request)
         out = await gateway.send_feedback(fb)
         return out.to_proto()
@@ -264,6 +331,7 @@ def add_seldon_service(server: grpc.aio.Server, gateway: Gateway) -> None:
 
         The stream lane has its own total-size cap (the per-frame gRPC
         limit no longer bounds memory once frames accumulate)."""
+        await check_auth(context)  # fail before buffering the stream
         parts = []
         total = 0
         async for chunk in request_iterator:
@@ -316,18 +384,20 @@ async def serve_gateway(
     max_message_bytes: int = 512 * 1024 * 1024,
     grpc_mode: str = "sync",  # sync (fast path, default) | aio
     tls=None,  # utils.tls.TlsConfig — terminates TLS on both listeners
+    auth=None,  # utils.auth.OAuthConfig — bearer tokens on both listeners
 ):
     """Start REST + gRPC front servers; returns (runner, GrpcServerHandle)."""
     from seldon_core_tpu.runtime import rest
     from seldon_core_tpu.utils.tls import add_grpc_port
 
-    app = build_gateway_app(gateway)
+    app = build_gateway_app(gateway, auth=auth)
     runner = await rest.serve(app, host=host, port=http_port, tls=tls)
     if grpc_mode == "sync":
         from seldon_core_tpu.engine.sync_server import build_sync_seldon_server
 
         server = build_sync_seldon_server(
-            gateway, asyncio.get_running_loop(), max_message_bytes=max_message_bytes
+            gateway, asyncio.get_running_loop(), max_message_bytes=max_message_bytes,
+            auth=auth,
         )
         add_grpc_port(server, f"{host}:{grpc_port}", tls)
         server.start()
@@ -338,7 +408,7 @@ async def serve_gateway(
             ("grpc.max_receive_message_length", max_message_bytes),
         ]
     )
-    add_seldon_service(server, gateway)
+    add_seldon_service(server, gateway, auth=auth)
     add_grpc_port(server, f"{host}:{grpc_port}", tls)
     await server.start()
     return runner, GrpcServerHandle(server, is_aio=True)

@@ -35,14 +35,22 @@ DEFAULT_MAX_MSG_BYTES = 512 * 1024 * 1024
 
 
 class SyncSeldonService:
-    def __init__(self, gateway, loop: asyncio.AbstractEventLoop):
+    def __init__(self, gateway, loop: asyncio.AbstractEventLoop, issuer=None):
         self.gateway = gateway
         self.loop = loop
+        self.issuer = issuer  # utils.auth.TokenIssuer when oauth is on
 
     def _bridge(self, coro):
         return asyncio.run_coroutine_threadsafe(coro, self.loop).result()
 
+    def _check_auth(self, context) -> None:
+        if self.issuer is not None and not self.issuer.verify_grpc(context):
+            from seldon_core_tpu.utils.auth import UNAUTHENTICATED_MSG
+
+            context.abort(grpc.StatusCode.UNAUTHENTICATED, UNAUTHENTICATED_MSG)
+
     def predict(self, request: pb.SeldonMessage, context) -> pb.SeldonMessage:
+        self._check_auth(context)
         msg = InternalMessage.from_proto(request)
         svc = self.gateway.pick()
         for shadow in self.gateway.shadows:
@@ -55,6 +63,7 @@ class SyncSeldonService:
         return self.gateway.finalize_response(out, msg, svc).to_proto()
 
     def send_feedback(self, request: pb.Feedback, context) -> pb.SeldonMessage:
+        self._check_auth(context)
         fb = InternalFeedback.from_proto(request)
         out = self._bridge(self.gateway.send_feedback(fb))
         return out.to_proto()
@@ -63,6 +72,7 @@ class SyncSeldonService:
         """Chunked predict: reassemble on the handler thread, run the
         ordinary predict path, stream the reply back in chunks.  Bounded
         by the stream lane's own total-size cap."""
+        self._check_auth(context)  # fail before buffering the stream
         parts = []
         total = 0
         for chunk in request_iterator:
@@ -83,8 +93,14 @@ def build_sync_seldon_server(
     loop: asyncio.AbstractEventLoop,
     max_workers: int = 64,
     max_message_bytes: int = DEFAULT_MAX_MSG_BYTES,
+    auth=None,
 ) -> grpc.Server:
-    service = SyncSeldonService(gateway, loop)
+    issuer = None
+    if auth is not None:
+        from seldon_core_tpu.utils.auth import TokenIssuer
+
+        issuer = TokenIssuer(auth)
+    service = SyncSeldonService(gateway, loop, issuer=issuer)
     server = grpc.server(
         futures.ThreadPoolExecutor(max_workers=max_workers, thread_name_prefix="seldon-grpc"),
         options=[

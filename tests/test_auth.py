@@ -1,0 +1,295 @@
+"""Gateway OAuth: token issuing, REST/gRPC enforcement, client flow
+(reference: seldon_client.py:1186-1227 get_token + the legacy API
+gateway's client-credentials grant)."""
+
+import asyncio
+import base64
+
+import numpy as np
+import pytest
+
+from seldon_core_tpu.engine import PredictorService, UnitSpec
+from seldon_core_tpu.engine.server import Gateway, build_gateway_app
+from seldon_core_tpu.runtime import TPUComponent
+from seldon_core_tpu.utils.auth import OAuthConfig, TokenIssuer, parse_basic_auth
+
+
+class Doubler(TPUComponent):
+    def predict(self, X, names, meta=None):
+        return np.asarray(X) * 2
+
+
+def model_unit(name, component):
+    return UnitSpec(name=name, type="MODEL", component=component)
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def _gateway():
+    return Gateway([(PredictorService(model_unit("m", Doubler()), name="main"), 100.0)])
+
+
+AUTH = OAuthConfig(key="oauth-key", secret="oauth-secret", ttl_s=60.0)
+
+
+def _basic(key, secret):
+    return "Basic " + base64.b64encode(f"{key}:{secret}".encode()).decode()
+
+
+class TestTokenIssuer:
+    def test_roundtrip_and_expiry(self):
+        issuer = TokenIssuer(AUTH)
+        tok = issuer.issue(now=1000.0)["access_token"]
+        assert issuer.verify(tok, now=1000.0)
+        assert issuer.verify(tok, now=1059.0)
+        assert not issuer.verify(tok, now=1061.0)  # past ttl
+
+    def test_tampered_token_rejected(self):
+        issuer = TokenIssuer(AUTH)
+        tok = issuer.issue()["access_token"]
+        payload, sig = tok.split(".", 1)
+        # flip a payload char: the signature no longer matches
+        flipped = ("A" if payload[0] != "A" else "B") + payload[1:]
+        assert not issuer.verify(f"{flipped}.{sig}")
+        assert not issuer.verify("garbage")
+        assert not issuer.verify("")
+
+    def test_token_from_other_secret_rejected(self):
+        other = TokenIssuer(OAuthConfig(key="oauth-key", secret="different"))
+        tok = other.issue()["access_token"]
+        assert not TokenIssuer(AUTH).verify(tok)
+
+    def test_header_parsing(self):
+        issuer = TokenIssuer(AUTH)
+        tok = issuer.issue()["access_token"]
+        assert issuer.verify_header(f"Bearer {tok}")
+        assert issuer.verify_header(f"bearer {tok}")  # case-insensitive
+        assert not issuer.verify_header(tok)  # scheme required
+        assert not issuer.verify_header(None)
+        assert parse_basic_auth(_basic("k", "s")) == ("k", "s")
+        assert parse_basic_auth("Bearer x") is None
+        assert parse_basic_auth(None) is None
+
+    def test_empty_credentials_rejected_at_config(self):
+        with pytest.raises(ValueError):
+            OAuthConfig(key="", secret="s")
+
+
+class TestGatewayRestAuth:
+    def test_data_endpoints_require_token_health_stays_open(self):
+        async def scenario():
+            from aiohttp.test_utils import TestClient, TestServer
+
+            app = build_gateway_app(_gateway(), auth=AUTH)
+            client = TestClient(TestServer(app))
+            await client.start_server()
+
+            no_token = await client.post(
+                "/api/v0.1/predictions", json={"data": {"ndarray": [[3.0]]}}
+            )
+            no_token_body = await no_token.json()  # read before reuse
+            bad_creds = await client.post(
+                "/oauth/token", headers={"Authorization": _basic("oauth-key", "wrong")}
+            )
+            token_resp = await client.post(
+                "/oauth/token",
+                headers={"Authorization": _basic("oauth-key", "oauth-secret")},
+            )
+            token = (await token_resp.json())["access_token"]
+            with_token = await client.post(
+                "/api/v0.1/predictions",
+                json={"data": {"ndarray": [[3.0]]}},
+                headers={"Authorization": f"Bearer {token}"},
+            )
+            body = await with_token.json()
+            ping = await client.get("/ping")
+            ready = await client.get("/ready")
+            metrics = await client.get("/metrics")
+            await client.close()
+            return (no_token.status, no_token_body, bad_creds.status,
+                    token_resp.status, with_token.status, body,
+                    ping.status, ready.status, metrics.status)
+
+        (no_token_status, no_token_body, bad_creds_status, token_status,
+         ok_status, body, ping, ready, metrics) = run(scenario())
+        assert no_token_status == 401
+        assert no_token_body["status"]["reason"] == "UNAUTHORIZED"
+        assert bad_creds_status == 401
+        assert token_status == 200
+        assert ok_status == 200
+        assert body["data"]["ndarray"] == [[6.0]]
+        # probes and metrics stay open (the reference's probe surface)
+        assert (ping, ready, metrics) == (200, 200, 200)
+
+    def test_pause_unpause_require_token(self):
+        """The mutating admin verbs are a denial of service if left
+        open; only probes and /metrics stay unauthenticated."""
+
+        async def scenario():
+            from aiohttp.test_utils import TestClient, TestServer
+
+            gw = _gateway()
+            app = build_gateway_app(gw, auth=AUTH)
+            client = TestClient(TestServer(app))
+            await client.start_server()
+            denied = await client.post("/pause")
+            denied_status = denied.status
+            still_ready = await gw.ready()
+            token_resp = await client.post(
+                "/oauth/token",
+                headers={"Authorization": _basic("oauth-key", "oauth-secret")},
+            )
+            token = (await token_resp.json())["access_token"]
+            allowed = await client.post(
+                "/pause", headers={"Authorization": f"Bearer {token}"}
+            )
+            paused = not await gw.ready()
+            await client.post(
+                "/unpause", headers={"Authorization": f"Bearer {token}"}
+            )
+            await client.close()
+            return denied_status, still_ready, allowed.status, paused
+
+        denied, still_ready, allowed, paused = run(scenario())
+        assert denied == 401
+        assert still_ready  # the unauthenticated pause did nothing
+        assert allowed == 200
+        assert paused
+
+    def test_oversized_unauthenticated_body_not_buffered(self):
+        """A rejected request with a huge declared body must be closed,
+        not drained into memory."""
+
+        async def scenario():
+            from aiohttp.test_utils import TestClient, TestServer
+
+            app = build_gateway_app(_gateway(), auth=AUTH)
+            client = TestClient(TestServer(app))
+            await client.start_server()
+
+            async def big_body():
+                yield b"x" * 1024  # server should reject before reading all
+
+            resp = await client.post(
+                "/api/v0.1/predictions",
+                data=big_body(),
+                headers={"Content-Length": str(64 * 1024 * 1024)},
+            )
+            status = resp.status
+            closed = resp.connection is None or resp.headers.get("Connection") == "close"
+            await client.close()
+            return status, closed
+
+        status, _closed = run(scenario())
+        assert status == 401
+
+    def test_no_auth_config_means_open_gateway(self):
+        async def scenario():
+            from aiohttp.test_utils import TestClient, TestServer
+
+            app = build_gateway_app(_gateway())
+            client = TestClient(TestServer(app))
+            await client.start_server()
+            resp = await client.post(
+                "/api/v0.1/predictions", json={"data": {"ndarray": [[3.0]]}}
+            )
+            token = await client.post("/oauth/token")
+            await client.close()
+            return resp.status, token.status
+
+        status, token_status = run(scenario())
+        assert status == 200
+        assert token_status == 404  # no token endpoint without auth
+
+
+class TestGatewayGrpcAuth:
+    def test_sync_grpc_requires_bearer_metadata(self):
+        import grpc
+
+        from seldon_core_tpu.engine.sync_server import build_sync_seldon_server
+        from seldon_core_tpu.proto import pb, services
+
+        async def scenario():
+            gw = _gateway()
+            server = build_sync_seldon_server(
+                gw, asyncio.get_running_loop(), auth=AUTH
+            )
+            port = server.add_insecure_port("127.0.0.1:0")
+            server.start()
+
+            issuer = TokenIssuer(AUTH)
+            token = issuer.issue()["access_token"]
+            req = pb.SeldonMessage()
+            req.data.ndarray.values.add().number_value = 0  # placeholder
+            del req.data.ndarray.values[:]
+            row = req.data.ndarray.values.add()
+            row.list_value.values.add().number_value = 3.0
+
+            def call(md):
+                channel = grpc.insecure_channel(f"127.0.0.1:{port}")
+                fn = services.unary_callable(channel, "Seldon", "Predict")
+                try:
+                    return fn(req, timeout=10, metadata=md), None
+                except grpc.RpcError as e:
+                    return None, e.code()
+                finally:
+                    channel.close()
+
+            out = await asyncio.gather(
+                asyncio.to_thread(call, None),
+                asyncio.to_thread(call, [("authorization", f"Bearer {token}")]),
+                asyncio.to_thread(call, [("authorization", "Bearer nope")]),
+            )
+            await asyncio.to_thread(server.stop(0).wait)
+            return out
+
+        (no_md, with_token, bad_token) = run(scenario())
+        assert no_md[1] == __import__("grpc").StatusCode.UNAUTHENTICATED
+        assert bad_token[1] == __import__("grpc").StatusCode.UNAUTHENTICATED
+        reply, err = with_token
+        assert err is None
+        assert [v.list_value.values[0].number_value for v in reply.data.ndarray.values] == [6.0]
+
+
+class TestClientOAuthFlow:
+    def test_client_fetches_token_and_refreshes_after_401(self):
+        from aiohttp.test_utils import TestServer as AioTestServer
+
+        from seldon_core_tpu.client.client import SeldonTpuClient
+
+        async def scenario():
+            app = build_gateway_app(_gateway(), auth=AUTH)
+            server = AioTestServer(app)
+            await server.start_server()
+            port = server.port
+
+            def client_calls():
+                client = SeldonTpuClient(
+                    host="127.0.0.1", http_port=port,
+                    oauth_key="oauth-key", oauth_secret="oauth-secret",
+                )
+                first = client.predict(np.array([[3.0]]))
+                # poison the cached token: the client must refresh once
+                client._bearer_token = "stale.token"
+                second = client.predict(np.array([[4.0]]))
+                wrong = SeldonTpuClient(
+                    host="127.0.0.1", http_port=port,
+                    oauth_key="oauth-key", oauth_secret="wrong",
+                )
+                try:
+                    wrong.predict(np.array([[1.0]]))
+                    wrong_err = None
+                except ConnectionError as e:
+                    wrong_err = str(e)
+                return first, second, wrong_err
+
+            result = await asyncio.to_thread(client_calls)
+            await server.close()
+            return result
+
+        first, second, wrong_err = run(scenario())
+        assert first.success and first.data.tolist() == [[6.0]]
+        assert second.success and second.data.tolist() == [[8.0]]
+        assert wrong_err is not None and "401" in wrong_err
