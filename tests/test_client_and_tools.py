@@ -2,6 +2,7 @@
 
 import asyncio
 import json
+import os
 import threading
 
 import numpy as np
@@ -577,3 +578,40 @@ class TestGraphVisualizer:
         out = tmp_path / "graph.dot"
         main(["examples/mab_abtest.yaml", "--format", "dot", "-o", str(out)])
         assert out.read_text().startswith("digraph")
+
+
+class TestBenchConfigs:
+    """tools/bench_configs.py — the five-config benchmark matrix."""
+
+    def test_quick_single_config_end_to_end(self):
+        import json as _json
+        import subprocess
+        import sys
+
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        res = subprocess.run(
+            [sys.executable, os.path.join(repo, "tools", "bench_configs.py"),
+             "--configs", "single_model_rest", "--seconds", "1",
+             "--concurrency", "2", "--platform", "cpu"],
+            capture_output=True, text=True, timeout=300, cwd=repo,
+        )
+        assert res.returncode == 0, res.stderr[-2000:]
+        lines = [l for l in res.stdout.splitlines() if l.startswith("{")]
+        rows = [_json.loads(l) for l in lines]
+        assert rows[-1]["summary"] and rows[-1]["configs_failed"] == 0
+        config_row = rows[0]
+        assert config_row["config"] == "single_model_rest"
+        assert config_row["qps"] > 0 and config_row["errors"] == 0
+
+    def test_unknown_config_rejected(self):
+        import subprocess
+        import sys
+
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        res = subprocess.run(
+            [sys.executable, os.path.join(repo, "tools", "bench_configs.py"),
+             "--configs", "nope"],
+            capture_output=True, text=True, timeout=60, cwd=repo,
+        )
+        assert res.returncode != 0
+        assert "unknown configs" in res.stderr
