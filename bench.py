@@ -541,7 +541,7 @@ def generation_phase() -> dict:
     dt_full = _time.perf_counter() - t0
     assert out.shape == (batch, max_new)
     decode_dt = max(dt_full - dt_prefill, 1e-9)
-    return {
+    result = {
         "decode_tokens_per_s": round(batch * (max_new - 1) / decode_dt, 1),
         "overall_tokens_per_s": round(batch * max_new / dt_full, 1),
         "prefill_ms": round(dt_prefill * 1000.0, 2),
@@ -549,6 +549,21 @@ def generation_phase() -> dict:
         "config": f"d{cfg['d_model']} L{cfg['num_layers']} "
                   f"H{cfg['num_heads']} v{cfg['vocab_size']} bf16",
     }
+    if os.environ.get("BENCH_INT8", "0") == "1":
+        # weight-only int8 decode: same architecture, same protocol
+        q = Generator(params, dtype=jnp.bfloat16, quantize="int8", **cfg)
+        q.generate(prompts, max_new_tokens=max_new)
+        q.generate(prompts, max_new_tokens=1)
+        t0 = _time.perf_counter()
+        q.generate(prompts, max_new_tokens=1)
+        q_prefill = _time.perf_counter() - t0
+        t0 = _time.perf_counter()
+        q.generate(prompts, max_new_tokens=max_new)
+        q_full = _time.perf_counter() - t0
+        q_decode = max(q_full - q_prefill, 1e-9)
+        result["int8_decode_tokens_per_s"] = round(batch * (max_new - 1) / q_decode, 1)
+        result["int8_vs_fp_decode"] = round(decode_dt / q_decode, 2)
+    return result
 
 
 async def int8_phase(shape) -> dict:

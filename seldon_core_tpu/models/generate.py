@@ -75,6 +75,7 @@ class Generator:
         max_len: int = 2048,
         dtype: Any = None,
         prompt_buckets: Optional[Sequence[int]] = None,
+        quantize: str = "",
     ):
         import jax
         import jax.numpy as jnp
@@ -84,6 +85,18 @@ class Generator:
         dtype = dtype or jnp.bfloat16
         self.max_len = int(max_len)
         self.vocab_size = int(vocab_size)
+        if quantize not in ("", "int8"):
+            raise ValueError(f"unknown quantize mode {quantize!r} (supported: 'int8')")
+        self.quantize = quantize
+        self.quantize_manifest: List[Dict[str, Any]] = []
+        if quantize == "int8":
+            # decode is HBM-bandwidth-bound: int8 weights halve the
+            # bytes each cached step pulls (same surgery as jaxserver;
+            # dequant fuses into the consuming matmul inside the jit)
+            from seldon_core_tpu.ops.surgery import quantize_params
+
+            params, self.quantize_manifest = quantize_params(params)
+        self._compute_dtype = dtype
         self.params = params
         self.module = TransformerLM(
             vocab_size=vocab_size, d_model=d_model, num_layers=num_layers,
@@ -106,8 +119,16 @@ class Generator:
                 lambda sd: jnp.zeros(sd.shape, sd.dtype), shapes
             )
 
+        def materialize(params):
+            if self.quantize == "int8":
+                from seldon_core_tpu.ops.surgery import dequantize_params
+
+                return dequantize_params(params, self._compute_dtype)
+            return params
+
         def prefill(params, cache, tokens, true_len):
             """Padded prompt -> (next-token logits at true_len-1, cache)."""
+            params = materialize(params)
             positions = jnp.arange(tokens.shape[1])
             logits, mutated = self.module.apply(
                 {"params": params, "cache": cache},
@@ -122,6 +143,7 @@ class Generator:
 
         def decode_step(params, cache, token, pos):
             """One cached step: token (B,1), absolute pos (B,) -> logits."""
+            params = materialize(params)
             logits, mutated = self.module.apply(
                 {"params": params, "cache": cache},
                 token, positions=pos[:1], mutable=["cache"],
@@ -268,6 +290,7 @@ class GenerativeLM(TPUComponent):
         eos_id: int = -1,
         model_uri: str = "",
         seed: int = 0,
+        quantize: str = "",
         **kwargs: Any,
     ):
         super().__init__(**kwargs)
@@ -282,6 +305,7 @@ class GenerativeLM(TPUComponent):
         self.eos_id = int(eos_id)
         self.model_uri = model_uri
         self.seed = int(seed)
+        self.quantize = quantize
         self.generator: Optional[Generator] = None
         import threading
 
@@ -290,7 +314,7 @@ class GenerativeLM(TPUComponent):
 
     def load(self) -> None:
         params = load_lm_params(self.model_uri, self.config, self.seed)
-        self.generator = Generator(params, **self.config)
+        self.generator = Generator(params, quantize=self.quantize, **self.config)
 
     def predict(self, X, names, meta=None):
         if self.generator is None:

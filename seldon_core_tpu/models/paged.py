@@ -243,14 +243,33 @@ class PagedEngine:
         mesh: Any = None,
         model_axis: str = "model",
         shard_min_weight_size: int = 16_384,
+        quantize: str = "",
     ):
         import jax
         import jax.numpy as jnp
 
         if max_len % page_size:
             raise ValueError(f"max_len {max_len} must be a multiple of page_size {page_size}")
+        if quantize not in ("", "int8"):
+            raise ValueError(f"unknown quantize mode {quantize!r} (supported: 'int8')")
+        if quantize and mesh is not None:
+            raise ValueError(
+                "quantize='int8' with a mesh is not supported yet: the "
+                "megatron spec inference does not understand QuantizedKernel "
+                "leaves — pick one of tensor-parallel or int8 decode"
+            )
+        if quantize == "int8":
+            # decode is HBM-bandwidth-bound; int8 weights halve the bytes
+            # each chunk pulls (same surgery as jaxserver)
+            from seldon_core_tpu.ops.surgery import quantize_params
+
+            params, self.quantize_manifest = quantize_params(params)
+        else:
+            self.quantize_manifest = []
+        self.quantize = quantize
         self._jax, self._jnp = jax, jnp
         dtype = dtype or jnp.bfloat16
+        self._dtype = dtype
         self.vocab_size = int(vocab_size)
         self.max_len = int(max_len)
         self.page_size = int(page_size)
@@ -311,11 +330,20 @@ class PagedEngine:
             page_size=self.page_size, max_len=self.max_len,
         )
 
+    def _materialize(self, params):
+        """Inside-jit dequant of int8 weights (fuses into consumers)."""
+        if self.quantize == "int8":
+            from seldon_core_tpu.ops.surgery import dequantize_params
+
+            return dequantize_params(params, self._dtype)
+        return params
+
     def _build_prefill(self, bucket: int):
         jax, jnp = self._jax, self._jnp
 
         def prefill(params, pk, pv, tokens, true_len, block_row):
             # tokens: (1, bucket)   block_row: (P,)
+            params = self._materialize(params)
             positions = jnp.arange(bucket)[None, :]
             lengths = jnp.zeros((1,), jnp.int32)
             logits, nk, nv = self.module.apply(
@@ -352,6 +380,7 @@ class PagedEngine:
     ):
         """``steps_per_call`` decode steps for all slots, on device."""
         jax, jnp = self._jax, self._jnp
+        params = self._materialize(params)
 
         def step(carry, _):
             pk, pv, logits, lengths, keys, done, emitted = carry
@@ -703,6 +732,7 @@ class StreamingLM(TPUComponent):
         max_slots: int = 8,
         steps_per_call: int = 8,
         mesh_axes: Optional[Dict[str, int]] = None,
+        quantize: str = "",
         **kwargs: Any,
     ):
         super().__init__(**kwargs)
@@ -714,6 +744,7 @@ class StreamingLM(TPUComponent):
         self.engine_config = dict(
             page_size=int(page_size), num_pages=int(num_pages) or None,
             max_slots=int(max_slots), steps_per_call=int(steps_per_call),
+            quantize=quantize,
         )
         self.mesh_axes = dict(mesh_axes) if mesh_axes else None
         self.max_new_tokens = int(max_new_tokens)

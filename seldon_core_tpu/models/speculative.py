@@ -62,9 +62,22 @@ class _PagedState:
 
     def __init__(self, module, params, *, max_len: int, page_size: int, dtype,
                  mesh=None, model_axis: str = "model",
-                 min_weight_size: int = 16_384):
+                 min_weight_size: int = 16_384, quantize: str = ""):
         import jax.numpy as jnp
 
+        if quantize not in ("", "int8"):
+            raise ValueError(f"unknown quantize mode {quantize!r} (supported: 'int8')")
+        if quantize and mesh is not None:
+            raise ValueError(
+                "quantize='int8' with a mesh is not supported yet — "
+                "pick one of tensor-parallel or int8 decode"
+            )
+        self.quantize = quantize
+        self.dtype = dtype
+        if quantize == "int8":
+            from seldon_core_tpu.ops.surgery import quantize_params
+
+            params, _ = quantize_params(params)
         self.module = module
         self.max_len = max_len
         self.page_size = page_size
@@ -115,6 +128,7 @@ class SpeculativeGenerator:
         mesh: Any = None,
         model_axis: str = "model",
         shard_min_weight_size: int = 16_384,
+        quantize: str = "",
     ):
         import jax
         import jax.numpy as jnp
@@ -144,7 +158,7 @@ class SpeculativeGenerator:
         self.target = _PagedState(
             cls(**target_cfg), params, max_len=max_len, page_size=page_size,
             dtype=dtype, mesh=mesh, model_axis=model_axis,
-            min_weight_size=shard_min_weight_size,
+            min_weight_size=shard_min_weight_size, quantize=quantize,
         )
         self.draft_state: Optional[_PagedState] = None
         if draft == "model":
@@ -155,7 +169,7 @@ class SpeculativeGenerator:
             self.draft_state = _PagedState(
                 cls(**cfg), draft_params, max_len=max_len, page_size=page_size,
                 dtype=dtype, mesh=mesh, model_axis=model_axis,
-                min_weight_size=shard_min_weight_size,
+                min_weight_size=shard_min_weight_size, quantize=quantize,
             )
 
         self._forward_jit: Dict[Tuple[int, int], Any] = {}
@@ -171,6 +185,10 @@ class SpeculativeGenerator:
         if key not in self._forward_jit:
 
             def run(params, pk, pv, toks, start, table):
+                if state.quantize == "int8":
+                    from seldon_core_tpu.ops.surgery import dequantize_params
+
+                    params = dequantize_params(params, state.dtype)
                 positions = start + jnp.arange(toks.shape[1])[None, :]
                 positions = jnp.minimum(positions, state.max_len - 1)
                 logits, nk, nv = state.module.apply(
@@ -320,6 +338,7 @@ class SpeculativeLM(TPUComponent):
         page_size: int = 64,
         seed: int = 0,
         mesh_axes: Optional[Dict[str, int]] = None,
+        quantize: str = "",
         **kwargs: Any,
     ):
         super().__init__(**kwargs)
@@ -340,6 +359,7 @@ class SpeculativeLM(TPUComponent):
         self.seed = int(seed)
         # same knob as StreamingLM: {"model": N} -> tensor-parallel decode
         self.mesh_axes = dict(mesh_axes) if mesh_axes else None
+        self.quantize = quantize
         self.generator: Optional[SpeculativeGenerator] = None
         import threading
 
@@ -368,7 +388,7 @@ class SpeculativeLM(TPUComponent):
             params, dtype=jnp.bfloat16, page_size=self.page_size,
             draft=self.draft, draft_k=self.draft_k, ngram=self.ngram,
             draft_params=draft_params, draft_config=self.draft_config,
-            mesh=mesh, **self.config,
+            mesh=mesh, quantize=self.quantize, **self.config,
         )
 
     def predict(self, X, names, meta=None):

@@ -69,6 +69,7 @@ async def _bench_one(
     t0 = time.perf_counter()
     await deployer.apply(spec, ready_timeout_s=600.0)
     handles = None
+    clients = []  # per-thread SDK clients; closed in the teardown
     try:
         handles = await serve_deployment(
             deployer, spec.name, host="127.0.0.1",
@@ -95,6 +96,7 @@ async def _bench_one(
                 host="127.0.0.1", http_port=http_port, grpc_port=grpc_port,
                 transport=transport,
             )
+            clients.append(client)
             rng = np.random.default_rng(threading.get_ident() & 0xFFFFFFFF)
             state = {"n": 0}
 
@@ -124,6 +126,11 @@ async def _bench_one(
     finally:
         # teardown must run even when the load phase dies, or the leaked
         # deployment skews every following config's numbers
+        for client in clients:
+            try:
+                client.close()
+            except Exception:  # noqa: BLE001 — teardown must finish
+                pass
         await deployer.delete(spec.name)
         if handles is not None:
             runner, grpc_srv = handles
