@@ -525,22 +525,27 @@ def generation_phase() -> dict:
     batch, plen, max_new = 8, 128, 128
     module = TransformerLM(dtype=jnp.bfloat16, **cfg)
     params = module.init(jax.random.key(0), jnp.zeros((1, 8), jnp.int32))["params"]
-    gen = Generator(params, dtype=jnp.bfloat16, **cfg)
     prompts = np.random.default_rng(0).integers(
         0, cfg["vocab_size"], size=(batch, plen)
     ).astype(np.int32)
-    gen.generate(prompts, max_new_tokens=max_new)  # pays the compiles
-    gen.generate(prompts, max_new_tokens=1)
-    # prefill-corrected decode rate: the full call minus a
-    # prefill-plus-one-step call isolates the per-token decode cost
-    t0 = _time.perf_counter()
-    gen.generate(prompts, max_new_tokens=1)
-    dt_prefill = _time.perf_counter() - t0
-    t0 = _time.perf_counter()
-    out = gen.generate(prompts, max_new_tokens=max_new)
-    dt_full = _time.perf_counter() - t0
-    assert out.shape == (batch, max_new)
-    decode_dt = max(dt_full - dt_prefill, 1e-9)
+
+    def measure(gen):
+        """One shared timing protocol, so fp and int8 stay comparable:
+        warm both programs, then the prefill-corrected decode rate —
+        full call minus a prefill-plus-one-step call isolates the
+        per-token decode cost."""
+        gen.generate(prompts, max_new_tokens=max_new)  # pays the compiles
+        gen.generate(prompts, max_new_tokens=1)
+        t0 = _time.perf_counter()
+        gen.generate(prompts, max_new_tokens=1)
+        dt_prefill = _time.perf_counter() - t0
+        t0 = _time.perf_counter()
+        out = gen.generate(prompts, max_new_tokens=max_new)
+        dt_full = _time.perf_counter() - t0
+        assert out.shape == (batch, max_new)
+        return dt_prefill, dt_full, max(dt_full - dt_prefill, 1e-9)
+
+    dt_prefill, dt_full, decode_dt = measure(Generator(params, dtype=jnp.bfloat16, **cfg))
     result = {
         "decode_tokens_per_s": round(batch * (max_new - 1) / decode_dt, 1),
         "overall_tokens_per_s": round(batch * max_new / dt_full, 1),
@@ -551,16 +556,9 @@ def generation_phase() -> dict:
     }
     if os.environ.get("BENCH_INT8", "0") == "1":
         # weight-only int8 decode: same architecture, same protocol
-        q = Generator(params, dtype=jnp.bfloat16, quantize="int8", **cfg)
-        q.generate(prompts, max_new_tokens=max_new)
-        q.generate(prompts, max_new_tokens=1)
-        t0 = _time.perf_counter()
-        q.generate(prompts, max_new_tokens=1)
-        q_prefill = _time.perf_counter() - t0
-        t0 = _time.perf_counter()
-        q.generate(prompts, max_new_tokens=max_new)
-        q_full = _time.perf_counter() - t0
-        q_decode = max(q_full - q_prefill, 1e-9)
+        _, _, q_decode = measure(
+            Generator(params, dtype=jnp.bfloat16, quantize="int8", **cfg)
+        )
         result["int8_decode_tokens_per_s"] = round(batch * (max_new - 1) / q_decode, 1)
         result["int8_vs_fp_decode"] = round(decode_dt / q_decode, 2)
     return result

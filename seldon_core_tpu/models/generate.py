@@ -85,9 +85,9 @@ class Generator:
         dtype = dtype or jnp.bfloat16
         self.max_len = int(max_len)
         self.vocab_size = int(vocab_size)
-        if quantize not in ("", "int8"):
-            raise ValueError(f"unknown quantize mode {quantize!r} (supported: 'int8')")
-        self.quantize = quantize
+        from seldon_core_tpu.ops.surgery import validate_quantize_mode
+
+        self.quantize = validate_quantize_mode(quantize)
         self.quantize_manifest: List[Dict[str, Any]] = []
         if quantize == "int8":
             # decode is HBM-bandwidth-bound: int8 weights halve the
@@ -97,7 +97,9 @@ class Generator:
 
             params, self.quantize_manifest = quantize_params(params)
         self._compute_dtype = dtype
-        self.params = params
+        # pin on device: surgery/msgpack trees are host numpy, and numpy
+        # args to jit re-upload every call
+        self.params = jax.device_put(params)
         self.module = TransformerLM(
             vocab_size=vocab_size, d_model=d_model, num_layers=num_layers,
             num_heads=num_heads, max_len=max_len, dtype=dtype, decode=True,
@@ -120,11 +122,9 @@ class Generator:
             )
 
         def materialize(params):
-            if self.quantize == "int8":
-                from seldon_core_tpu.ops.surgery import dequantize_params
+            from seldon_core_tpu.ops.surgery import materialize as _mat
 
-                return dequantize_params(params, self._compute_dtype)
-            return params
+            return _mat(params, self.quantize, self._compute_dtype)
 
         def prefill(params, cache, tokens, true_len):
             """Padded prompt -> (next-token logits at true_len-1, cache)."""
@@ -305,7 +305,9 @@ class GenerativeLM(TPUComponent):
         self.eos_id = int(eos_id)
         self.model_uri = model_uri
         self.seed = int(seed)
-        self.quantize = quantize
+        from seldon_core_tpu.ops.surgery import validate_quantize_mode
+
+        self.quantize = validate_quantize_mode(quantize)  # fail at construction
         self.generator: Optional[Generator] = None
         import threading
 

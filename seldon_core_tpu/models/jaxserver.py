@@ -203,12 +203,12 @@ class JaxServer(TPUComponent):
         # quantize="int8": weight-only quantisation of the loaded
         # checkpoint (ops/surgery.py) — kernels live in HBM as int8,
         # dequant fuses into the consuming matmul/conv inside the jit
-        if quantize not in ("", "int8"):
-            raise MicroserviceError(
-                f"unknown quantize mode {quantize!r} (supported: 'int8')",
-                status_code=400,
-                reason="BAD_QUANTIZE",
-            )
+        from seldon_core_tpu.ops.surgery import validate_quantize_mode
+
+        try:
+            validate_quantize_mode(quantize)
+        except ValueError as e:
+            raise MicroserviceError(str(e), status_code=400, reason="BAD_QUANTIZE")
         self.quantize = quantize
         self.quantize_manifest: List[Dict[str, Any]] = []
         # normalize=True: uint8 image batches go through the fused

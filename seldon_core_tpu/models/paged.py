@@ -250,17 +250,15 @@ class PagedEngine:
 
         if max_len % page_size:
             raise ValueError(f"max_len {max_len} must be a multiple of page_size {page_size}")
-        if quantize not in ("", "int8"):
-            raise ValueError(f"unknown quantize mode {quantize!r} (supported: 'int8')")
-        if quantize and mesh is not None:
-            raise ValueError(
-                "quantize='int8' with a mesh is not supported yet: the "
-                "megatron spec inference does not understand QuantizedKernel "
-                "leaves — pick one of tensor-parallel or int8 decode"
-            )
+        from seldon_core_tpu.ops.surgery import validate_quantize_mode
+
+        validate_quantize_mode(quantize)
         if quantize == "int8":
             # decode is HBM-bandwidth-bound; int8 weights halve the bytes
-            # each chunk pulls (same surgery as jaxserver)
+            # each chunk pulls (same surgery as jaxserver).  Composes
+            # with tensor-parallel: QuantizedKernel children are pytree
+            # leaves, so the megatron spec inference shards q like the
+            # fp kernel it replaced (scales are tiny and replicate)
             from seldon_core_tpu.ops.surgery import quantize_params
 
             params, self.quantize_manifest = quantize_params(params)
@@ -332,11 +330,9 @@ class PagedEngine:
 
     def _materialize(self, params):
         """Inside-jit dequant of int8 weights (fuses into consumers)."""
-        if self.quantize == "int8":
-            from seldon_core_tpu.ops.surgery import dequantize_params
+        from seldon_core_tpu.ops.surgery import materialize
 
-            return dequantize_params(params, self._dtype)
-        return params
+        return materialize(params, self.quantize, self._dtype)
 
     def _build_prefill(self, bucket: int):
         jax, jnp = self._jax, self._jnp
@@ -380,9 +376,13 @@ class PagedEngine:
     ):
         """``steps_per_call`` decode steps for all slots, on device."""
         jax, jnp = self._jax, self._jnp
-        params = self._materialize(params)
 
         def step(carry, _):
+            # materialize INSIDE the step body so the int8->fp dequant
+            # can fuse into this step's matmuls (each step then reads
+            # int8-width weights from HBM); hoisting it above the scan
+            # would hand every step a full-width fp tree
+            params_step = self._materialize(params)
             pk, pv, logits, lengths, keys, done, emitted = carry
             typed = jax.random.wrap_key_data(keys)
             split = jax.vmap(jax.random.split)(typed)
@@ -400,7 +400,7 @@ class PagedEngine:
             done = done | (token == eos_ids) | (emitted >= max_new)
             positions = lengths[:, None]  # new token's absolute position
             new_logits, nk, nv = self.module.apply(
-                {"params": params}, token[:, None],
+                {"params": params_step}, token[:, None],
                 jnp.minimum(positions, self.max_len - 1),
                 pk, pv, block_tables, lengths,
             )
@@ -741,10 +741,12 @@ class StreamingLM(TPUComponent):
             num_layers=int(num_layers), num_heads=int(num_heads),
             max_len=int(max_len),
         )
+        from seldon_core_tpu.ops.surgery import validate_quantize_mode
+
         self.engine_config = dict(
             page_size=int(page_size), num_pages=int(num_pages) or None,
             max_slots=int(max_slots), steps_per_call=int(steps_per_call),
-            quantize=quantize,
+            quantize=validate_quantize_mode(quantize),  # fail at construction
         )
         self.mesh_axes = dict(mesh_axes) if mesh_axes else None
         self.max_new_tokens = int(max_new_tokens)

@@ -65,19 +65,15 @@ class _PagedState:
                  min_weight_size: int = 16_384, quantize: str = ""):
         import jax.numpy as jnp
 
-        if quantize not in ("", "int8"):
-            raise ValueError(f"unknown quantize mode {quantize!r} (supported: 'int8')")
-        if quantize and mesh is not None:
-            raise ValueError(
-                "quantize='int8' with a mesh is not supported yet — "
-                "pick one of tensor-parallel or int8 decode"
-            )
-        self.quantize = quantize
+        from seldon_core_tpu.ops.surgery import validate_quantize_mode
+
+        self.quantize = validate_quantize_mode(quantize)
         self.dtype = dtype
+        self.quantize_manifest: list = []
         if quantize == "int8":
             from seldon_core_tpu.ops.surgery import quantize_params
 
-            params, _ = quantize_params(params)
+            params, self.quantize_manifest = quantize_params(params)
         self.module = module
         self.max_len = max_len
         self.page_size = page_size
@@ -160,6 +156,7 @@ class SpeculativeGenerator:
             dtype=dtype, mesh=mesh, model_axis=model_axis,
             min_weight_size=shard_min_weight_size, quantize=quantize,
         )
+        self.quantize_manifest = self.target.quantize_manifest
         self.draft_state: Optional[_PagedState] = None
         if draft == "model":
             cfg = dict(target_cfg)
@@ -185,10 +182,9 @@ class SpeculativeGenerator:
         if key not in self._forward_jit:
 
             def run(params, pk, pv, toks, start, table):
-                if state.quantize == "int8":
-                    from seldon_core_tpu.ops.surgery import dequantize_params
+                from seldon_core_tpu.ops.surgery import materialize
 
-                    params = dequantize_params(params, state.dtype)
+                params = materialize(params, state.quantize, state.dtype)
                 positions = start + jnp.arange(toks.shape[1])[None, :]
                 positions = jnp.minimum(positions, state.max_len - 1)
                 logits, nk, nv = state.module.apply(
@@ -359,7 +355,9 @@ class SpeculativeLM(TPUComponent):
         self.seed = int(seed)
         # same knob as StreamingLM: {"model": N} -> tensor-parallel decode
         self.mesh_axes = dict(mesh_axes) if mesh_axes else None
-        self.quantize = quantize
+        from seldon_core_tpu.ops.surgery import validate_quantize_mode
+
+        self.quantize = validate_quantize_mode(quantize)  # fail at construction
         self.generator: Optional[SpeculativeGenerator] = None
         import threading
 

@@ -32,6 +32,8 @@ __all__ = [
     "QuantizedKernel",
     "quantize_params",
     "dequantize_params",
+    "materialize",
+    "validate_quantize_mode",
     "tree_hbm_bytes",
 ]
 
@@ -167,6 +169,23 @@ def dequantize_params(variables: Any, dtype=None) -> Any:
     return jax.tree_util.tree_map(
         dequant, variables, is_leaf=lambda x: isinstance(x, QuantizedKernel)
     )
+
+
+def validate_quantize_mode(quantize: str) -> str:
+    """The one place the supported modes live; every lane calls this."""
+    if quantize not in ("", "int8"):
+        raise ValueError(f"unknown quantize mode {quantize!r} (supported: 'int8')")
+    return quantize
+
+
+def materialize(params: Any, quantize: str, dtype=None) -> Any:
+    """Inside-jit weight materialisation for a (possibly) quantized
+    tree: the shared 'dequant if int8, else pass through' every serving
+    lane uses at program entry.  Traceable; dequant fuses into the
+    consuming matmul/conv."""
+    if quantize == "int8":
+        return dequantize_params(params, dtype)
+    return params
 
 
 def tree_hbm_bytes(variables: Any) -> int:

@@ -111,7 +111,13 @@ def shard_decode_state(
     from seldon_core_tpu.parallel.mesh import mesh_shape
 
     if mesh is None:
-        return params, jnp.zeros(pool_shape, dtype), jnp.zeros(pool_shape, dtype)
+        # pin params on device: trees straight from surgery/msgpack are
+        # host numpy, and numpy args to jit re-upload EVERY call
+        return (
+            jax.device_put(params),
+            jnp.zeros(pool_shape, dtype),
+            jnp.zeros(pool_shape, dtype),
+        )
 
     params = shard_params(
         params, mesh, model_axis=model_axis, min_weight_size=min_weight_size
