@@ -241,6 +241,32 @@ def probe_device(jax, attempts: int = 3) -> str:
     raise RuntimeError(f"device probe failed after {attempts} attempts: {last}")
 
 
+def measure_relay_rtt(n: int = 15) -> dict:
+    """Median round-trip of a minimal sequential dispatch + device→host
+    readback — the harness-relay context number for reading the wire
+    p50s.  NOT subtracted from anything: the serving path pipelines
+    many in-flight requests through the relay, so its per-request p50
+    can sit well below this sequential RTT (measured: serving p50
+    97 ms vs sequential RTT 190 ms on the same run).  Directly-attached
+    hardware measures microseconds here."""
+    import numpy as np
+
+    import jax.numpy as jnp
+
+    x = jnp.ones((1,), jnp.float32)
+    (x + 1).block_until_ready()  # compile outside the timing loop
+    samples = []
+    for _ in range(n):
+        t0 = time.perf_counter()
+        np.asarray((x + 1).block_until_ready())
+        samples.append((time.perf_counter() - t0) * 1000.0)
+    samples.sort()
+    return {
+        "relay_rtt_ms": round(samples[len(samples) // 2], 2),
+        "relay_rtt_min_ms": round(samples[0], 2),
+    }
+
+
 def build_gateway():
     from seldon_core_tpu.engine import PredictorService, UnitSpec
     from seldon_core_tpu.engine.server import Gateway
@@ -384,6 +410,10 @@ async def child_main() -> None:
 
     device = probe_device(jax)
     status["extra"]["device"] = device
+    try:
+        status["extra"].update(measure_relay_rtt())
+    except Exception as e:  # noqa: BLE001 — diagnostics only, never fatal
+        status["extra"]["relay_rtt_error"] = str(e)[:120]
     status["phase"] = "probed"
     _checkpoint(status)
 
