@@ -90,9 +90,10 @@ class Generator:
         self.quantize = validate_quantize_mode(quantize)
         self.quantize_manifest: List[Dict[str, Any]] = []
         if quantize == "int8":
-            # decode is HBM-bandwidth-bound: int8 weights halve the
-            # bytes each cached step pulls (same surgery as jaxserver;
-            # dequant fuses into the consuming matmul inside the jit)
+            # weight-only int8 (same surgery as jaxserver): weights rest
+            # in HBM at half the bytes and dequantise ONCE per compiled
+            # call (measured 1.38x decode rate on TPU; per-step dequant
+            # measured 0.48x — see _build_generate)
             from seldon_core_tpu.ops.surgery import quantize_params
 
             params, self.quantize_manifest = quantize_params(params)
@@ -121,14 +122,10 @@ class Generator:
                 lambda sd: jnp.zeros(sd.shape, sd.dtype), shapes
             )
 
-        def materialize(params):
-            from seldon_core_tpu.ops.surgery import materialize as _mat
-
-            return _mat(params, self.quantize, self._compute_dtype)
-
         def prefill(params, cache, tokens, true_len):
-            """Padded prompt -> (next-token logits at true_len-1, cache)."""
-            params = materialize(params)
+            """Padded prompt -> (next-token logits at true_len-1, cache).
+            Takes already-materialised (fp) params — run() dequantises
+            once at program entry."""
             positions = jnp.arange(tokens.shape[1])
             logits, mutated = self.module.apply(
                 {"params": params, "cache": cache},
@@ -142,8 +139,10 @@ class Generator:
             return last, cache
 
         def decode_step(params, cache, token, pos):
-            """One cached step: token (B,1), absolute pos (B,) -> logits."""
-            params = materialize(params)
+            """One cached step: token (B,1), absolute pos (B,) -> logits.
+            Callers materialize quantized params ONCE at program entry —
+            measured on TPU, per-step dequant does not fuse into the
+            matmuls and re-materializes the fp tree every step (0.48x)."""
             logits, mutated = self.module.apply(
                 {"params": params, "cache": cache},
                 token, positions=pos[:1], mutable=["cache"],
@@ -155,6 +154,12 @@ class Generator:
         self._decode_step = decode_step  # jitted inside the scan below
         self._generate_jit: Dict[Tuple[int, int, int], Any] = {}
         self._jax, self._jnp = jax, jnp
+
+    def _materialize(self, params):
+        """Once-per-program dequant of int8 weights (no-op for fp)."""
+        from seldon_core_tpu.ops.surgery import materialize
+
+        return materialize(params, self.quantize, self._compute_dtype)
 
     @staticmethod
     def _set_index(cache, true_len):
@@ -172,6 +177,11 @@ class Generator:
         lax = jax.lax
 
         def run(params, tokens, true_len, max_new_arr, rng, temperature, top_k, eos_id):
+            # dequant once per compiled call, amortised over every scan
+            # step — measured 1.38x the fp decode rate on TPU, vs 0.48x
+            # when dequant sat inside the step body (it does not fuse;
+            # XLA re-materialised the fp tree every step)
+            params = self._materialize(params)
             cache = self._init_cache(batch)
             last_logits, cache = self._prefill(params, cache, tokens, true_len)
 

@@ -254,11 +254,12 @@ class PagedEngine:
 
         validate_quantize_mode(quantize)
         if quantize == "int8":
-            # decode is HBM-bandwidth-bound; int8 weights halve the bytes
-            # each chunk pulls (same surgery as jaxserver).  Composes
-            # with tensor-parallel: QuantizedKernel children are pytree
-            # leaves, so the megatron spec inference shards q like the
-            # fp kernel it replaced (scales are tiny and replicate)
+            # weight-only int8: weights rest in HBM at half the bytes
+            # and dequantise once per chunk program (measured 1.38x
+            # decode rate; per-step dequant measured 0.48x — it does
+            # not fuse).  Composes with tensor-parallel: QuantizedKernel
+            # children are pytree leaves, so the megatron spec inference
+            # shards q like the fp kernel it replaced (scales replicate)
             from seldon_core_tpu.ops.surgery import quantize_params
 
             params, self.quantize_manifest = quantize_params(params)
@@ -329,7 +330,9 @@ class PagedEngine:
         )
 
     def _materialize(self, params):
-        """Inside-jit dequant of int8 weights (fuses into consumers)."""
+        """Once-per-program dequant of int8 weights (no-op for fp).
+        Call at program ENTRY, never inside a scan step — per-step
+        dequant does not fuse and measured 0.48x on TPU."""
         from seldon_core_tpu.ops.surgery import materialize
 
         return materialize(params, self.quantize, self._dtype)
@@ -376,13 +379,12 @@ class PagedEngine:
     ):
         """``steps_per_call`` decode steps for all slots, on device."""
         jax, jnp = self._jax, self._jnp
+        # dequant ONCE per chunk, amortised over steps_per_call decode
+        # steps (int8 halves resident weight HBM; measured on TPU,
+        # per-step dequant does not fuse and ran 0.48x)
+        params = self._materialize(params)
 
         def step(carry, _):
-            # materialize INSIDE the step body so the int8->fp dequant
-            # can fuse into this step's matmuls (each step then reads
-            # int8-width weights from HBM); hoisting it above the scan
-            # would hand every step a full-width fp tree
-            params_step = self._materialize(params)
             pk, pv, logits, lengths, keys, done, emitted = carry
             typed = jax.random.wrap_key_data(keys)
             split = jax.vmap(jax.random.split)(typed)
@@ -400,7 +402,7 @@ class PagedEngine:
             done = done | (token == eos_ids) | (emitted >= max_new)
             positions = lengths[:, None]  # new token's absolute position
             new_logits, nk, nv = self.module.apply(
-                {"params": params_step}, token[:, None],
+                {"params": params}, token[:, None],
                 jnp.minimum(positions, self.max_len - 1),
                 pk, pv, block_tables, lengths,
             )
