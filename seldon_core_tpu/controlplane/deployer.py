@@ -213,6 +213,40 @@ def _spawn_remote_workers(spec: TpuDeployment):
     return supervisor
 
 
+def _reject_device_exclusive_root(predictor: str, component: str, hpa) -> None:
+    """TPU-exclusivity guard for hpa replica scaling.
+
+    libtpu binds ONE process per chip: spawning N subprocess replicas of
+    a TPU-resident root (jaxserver, generation components) would wedge
+    on device acquisition — the k8s HPA the reference leans on
+    (reference: seldondeployment_controller.go:92-114) assumes pods land
+    on distinct machines, which this single-host deployer cannot give a
+    chip-pinned component.  Reject with guidance instead of wedging at
+    runtime; CPU-resident components (sklearn/xgboost/routers/...)
+    replicate fine, and a pinned max_replicas=1 (supervised restart
+    only — exactly one process ever owns the chip) is also fine.  An
+    unimportable component class is the subprocess's problem, not this
+    guard's — skip silently.
+    """
+    import importlib
+
+    if getattr(hpa, "max_replicas", 2) <= 1:
+        return
+    module, _, cls = component.rpartition(".")
+    try:
+        klass = getattr(importlib.import_module(module), cls)
+    except Exception:  # noqa: BLE001
+        return
+    if getattr(klass, "device_exclusive", False):
+        raise DeploymentSpecError(
+            f"predictor {predictor!r}: hpa subprocess replicas are not "
+            f"possible for TPU-device-exclusive component {component!r} "
+            "(libtpu is single-process per chip). Scale in-process "
+            "instead: raise max_batch_size / batcher concurrency, or "
+            "give the predictor more chips via mesh_axes."
+        )
+
+
 def _build_autoscaled_root(p, annotations) -> Tuple[Any, Any, Any]:
     """ReplicaSet + BalancedClient wiring for an hpa predictor.
 
@@ -251,6 +285,8 @@ def _build_autoscaled_root(p, annotations) -> Tuple[Any, Any, Any]:
         hpa = HpaSpec.from_dict(p.hpa)
     except (ValueError, TypeError) as e:
         raise DeploymentSpecError(f"predictor {p.name!r} hpa block invalid: {e}")
+
+    _reject_device_exclusive_root(p.name, component, hpa)
 
     balanced = BalancedClient()
 

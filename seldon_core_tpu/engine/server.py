@@ -138,13 +138,56 @@ class Gateway:
         return self.finalize_response(response, request, svc)
 
     async def send_feedback(self, feedback: InternalFeedback) -> InternalMessage:
-        # feedback goes to the predictor that served the request when
-        # identifiable (predictor tag or recorded puid), else to all
+        # feedback goes ONLY to the predictor that served the request
+        # (predictor tag or recorded puid).  Unidentifiable feedback is
+        # a counted drop — never a broadcast: the reference follows the
+        # recorded routing path and nothing else
+        # (reference: PredictiveUnitBean.java:206-246); broadcasting
+        # would teach every predictor's bandit from traffic it never
+        # served, silently corrupting A/B statistics.
         target = self._feedback_target(feedback)
-        if target is not None:
-            return await target.send_feedback(feedback)
-        results = await asyncio.gather(*(p.send_feedback(feedback) for p in self.predictors))
-        return results[0]
+        if target is None and len(self.entries) == 1 and not self._has_identifiers(feedback):
+            # single-predictor gateway AND the feedback never carried a
+            # tag/puid (the reference client's bare request-only shape):
+            # the route is unambiguous.  Feedback whose identifiers
+            # FAILED to resolve (stale tag from a removed predictor,
+            # evicted puid) still drops — it may belong to a predictor
+            # that no longer exists here.
+            target = self.entries[0][0]
+        if target is None:
+            self._count_unrouted_feedback()
+            msg = InternalMessage(
+                payload=None,
+                kind="jsonData",
+                status={
+                    "status": "FAILURE",
+                    "code": 404,
+                    "info": "feedback not routable: no predictor tag and "
+                            "puid unknown (expired or never served here)",
+                    "reason": "FEEDBACK_UNROUTED",
+                },
+            )
+            return msg
+        return await target.send_feedback(feedback)
+
+    @staticmethod
+    def _has_identifiers(feedback: InternalFeedback) -> bool:
+        """True when the feedback carries any routing identifier (a
+        predictor tag or a puid) on its response or request."""
+        for msg in (feedback.response, feedback.request):
+            if msg is not None and (msg.meta.tags.get("predictor") or msg.meta.puid):
+                return True
+        return False
+
+    def _count_unrouted_feedback(self) -> None:
+        logger.warning("dropping unroutable feedback (no predictor tag, puid unknown)")
+        from seldon_core_tpu.utils.metrics import increment_counter
+
+        increment_counter(
+            "seldon_api_gateway_feedback_unrouted",
+            "feedback messages dropped because the serving predictor "
+            "could not be identified",
+        )
 
     async def ready(self) -> bool:
         checks = await asyncio.gather(*(p.ready() for p in self.predictors))
@@ -160,6 +203,17 @@ class Gateway:
 
     async def close(self) -> None:
         await asyncio.gather(*(p.close() for p in self.predictors))
+
+
+def _http_status(out: InternalMessage) -> int:
+    """HTTP code for a gateway response: FAILURE statuses surface their
+    code (clamped to a valid HTTP error range), everything else is 200.
+    Shared by the REST handlers and the native lane's bridge handler
+    (native/frontserver.py)."""
+    if out.status and out.status.get("status") == "FAILURE":
+        code = int(out.status.get("code", 500))
+        return code if 400 <= code < 600 else 500
+    return 200
 
 
 def build_gateway_app(gateway: Gateway, auth=None) -> web.Application:
@@ -193,12 +247,18 @@ def build_gateway_app(gateway: Gateway, auth=None) -> web.Application:
                     status=401,
                 )
                 # small declared bodies drain (keeps keep-alive sockets
-                # reusable); big or unsized (chunked) unauthenticated
-                # payloads must not be buffered — close the connection
-                # instead of paying for the bytes
+                # reusable); body-less requests (GET/HEAD probes, POSTs
+                # with no Content-Length and no Transfer-Encoding) have
+                # nothing to drain and keep their socket too; only
+                # chunked/unsized uploads or oversized declared bodies
+                # force a close — buffering those for a 401 would pay
+                # for bytes we are rejecting
                 cl = request.content_length
+                chunked = "chunked" in request.headers.get("Transfer-Encoding", "").lower()
                 if cl is not None and cl <= 1 << 20:
                     await request.read()
+                elif cl is None and not chunked:
+                    pass  # no body on the wire — nothing to drain
                 else:
                     resp.force_close()
                 return resp
@@ -223,12 +283,7 @@ def build_gateway_app(gateway: Gateway, auth=None) -> web.Application:
             body = await _request_body(request)
             msg = InternalMessage.from_json(body)
             out = await gateway.predict(msg, predictor=request.query.get("predictor"))
-            status_code = 200
-            if out.status and out.status.get("status") == "FAILURE":
-                status_code = int(out.status.get("code", 500))
-                if not (400 <= status_code < 600):
-                    status_code = 500
-            return web.json_response(out.to_json(), status=status_code)
+            return web.json_response(out.to_json(), status=_http_status(out))
         except Exception as e:  # noqa: BLE001
             return _error_response(e)
 
@@ -238,12 +293,7 @@ def build_gateway_app(gateway: Gateway, auth=None) -> web.Application:
             msg = InternalMessage.from_json(body)
             svc = gateway.by_name(request.query.get("predictor", "")) or gateway.pick()
             out = await svc.explain(msg)
-            status_code = 200
-            if out.status and out.status.get("status") == "FAILURE":
-                status_code = int(out.status.get("code", 500))
-                if not (400 <= status_code < 600):
-                    status_code = 500
-            return web.json_response(out.to_json(), status=status_code)
+            return web.json_response(out.to_json(), status=_http_status(out))
         except Exception as e:  # noqa: BLE001
             return _error_response(e)
 
@@ -252,7 +302,7 @@ def build_gateway_app(gateway: Gateway, auth=None) -> web.Application:
             body = await _request_body(request)
             fb = InternalFeedback.from_json(body)
             out = await gateway.send_feedback(fb)
-            return web.json_response(out.to_json())
+            return web.json_response(out.to_json(), status=_http_status(out))
         except Exception as e:  # noqa: BLE001
             return _error_response(e)
 

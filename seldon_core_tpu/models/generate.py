@@ -287,6 +287,8 @@ class GenerativeLM(TPUComponent):
     TransformerLM parameter tree).
     """
 
+    device_exclusive = True  # TPU-resident weights/KV: one process per chip
+
     def __init__(
         self,
         vocab_size: int = 32000,

@@ -146,6 +146,9 @@ class JaxServer(TPUComponent):
     """Serve a flax model jit-compiled to XLA with dynamic batching."""
 
     accepts_device_arrays = True
+    # libtpu is single-process per chip: subprocess replicas of this
+    # component would fight over the device (controlplane hpa guard)
+    device_exclusive = True
 
     def __init__(
         self,

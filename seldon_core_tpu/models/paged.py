@@ -257,9 +257,11 @@ class PagedEngine:
             # weight-only int8: weights rest in HBM at half the bytes
             # and dequantise once per chunk program (measured 1.38x
             # decode rate; per-step dequant measured 0.48x — it does
-            # not fuse).  Composes with tensor-parallel: QuantizedKernel
-            # children are pytree leaves, so the megatron spec inference
-            # shards q like the fp kernel it replaced (scales replicate)
+            # not fuse).  Composes with tensor-parallel: the spec
+            # inference treats each QuantizedKernel as one unit — q
+            # sharded on its output-channel dim with scale sharded the
+            # same axis (or scale replicated when q shards an input
+            # dim), so the fused dequant needs no resharding collective
             from seldon_core_tpu.ops.surgery import quantize_params
 
             params, self.quantize_manifest = quantize_params(params)
@@ -715,6 +717,8 @@ class StreamingLM(TPUComponent):
     Per-request overrides via ``meta.tags``: ``max_new_tokens``,
     ``temperature``, ``top_k``, ``seed``.
     """
+
+    device_exclusive = True  # TPU-resident weights/KV: one process per chip
 
     def __init__(
         self,

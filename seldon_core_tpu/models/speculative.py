@@ -316,6 +316,8 @@ class SpeculativeLM(TPUComponent):
     so speculation efficiency lands on the dashboards.
     """
 
+    device_exclusive = True  # TPU-resident weights/KV: one process per chip
+
     def __init__(
         self,
         vocab_size: int = 32000,

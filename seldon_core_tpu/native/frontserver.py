@@ -329,12 +329,9 @@ class GatewayRawHandler:
                     {"status": {"status": "FAILURE", "code": 404,
                                 "info": f"no route {path}", "reason": "NOT_FOUND"}}
                 ).encode()
-            status = 200
-            if out.status and out.status.get("status") == "FAILURE":
-                status = int(out.status.get("code", 500))
-                if not 400 <= status < 600:
-                    status = 500
-            return status, "application/json", json.dumps(out.to_json()).encode()
+            from seldon_core_tpu.engine.server import _http_status
+
+            return _http_status(out), "application/json", json.dumps(out.to_json()).encode()
         except (ValueError, KeyError, TypeError) as e:
             # bad payloads are the client's fault: 400, matching the app
             return 400, "application/json", json.dumps(

@@ -47,13 +47,17 @@ def infer_param_specs(
     import jax
     from jax.sharding import PartitionSpec as P
 
+    from seldon_core_tpu.ops.surgery import QuantizedKernel
+
     axis_size = dict(zip(mesh.axis_names, mesh.devices.shape)).get(model_axis, 1)
 
-    def spec_for(x) -> P:
-        shape = getattr(x, "shape", ())
+    def dense_spec(shape, prefer_last: bool = False) -> P:
         if axis_size <= 1 or not shape or int(np.prod(shape)) < min_weight_size:
             return P()
         order = sorted(range(len(shape)), key=lambda d: shape[d], reverse=True)
+        if prefer_last:
+            order.remove(len(shape) - 1)
+            order.insert(0, len(shape) - 1)
         for dim in order:
             if shape[dim] % axis_size == 0 and shape[dim] >= axis_size:
                 entries: list = [None] * len(shape)
@@ -61,7 +65,23 @@ def infer_param_specs(
                 return P(*entries)
         return P()
 
-    return jax.tree.map(spec_for, params)
+    def spec_for(x):
+        # a QuantizedKernel is one unit: its (N,) scale must follow the
+        # q layout, so prefer sharding q on the last (output-channel)
+        # dim — then scale shards the same axis and the fused dequant
+        # needs no resharding collective.  q sharded on an input dim
+        # keeps scale replicated (broadcast over sharded rows is free).
+        if isinstance(x, QuantizedKernel):
+            q_spec = dense_spec(x.q.shape, prefer_last=True)
+            entries = tuple(q_spec)
+            if entries and entries[-1] == model_axis:
+                return QuantizedKernel(q_spec, P(model_axis))
+            return QuantizedKernel(q_spec, P())
+        return dense_spec(getattr(x, "shape", ()))
+
+    return jax.tree.map(
+        spec_for, params, is_leaf=lambda x: isinstance(x, QuantizedKernel)
+    )
 
 
 def shard_params(

@@ -62,6 +62,12 @@ class NotImplementedByUser(MicroserviceError):
 class TPUComponent:
     """Base class for models / routers / transformers / combiners."""
 
+    # True for components whose load() pins a TPU device: libtpu binds
+    # one process per chip, so such components cannot be replicated as
+    # subprocesses (the control plane's hpa guard reads this — scale
+    # batcher/worker concurrency in-process instead)
+    device_exclusive: bool = False
+
     def __init__(self, **kwargs: Any):
         pass
 

@@ -262,13 +262,31 @@ class InternalFeedback:
             truth=InternalMessage.from_proto(fb.truth) if fb.HasField("truth") else None,
         )
 
+    @staticmethod
+    def _message_from_json(body: Dict[str, Any]) -> InternalMessage:
+        """Feedback members may omit the payload entirely: the proto's
+        payload oneof can be unset (a meta-only response carrying just
+        the routing tags/puid is a legal Feedback shape,
+        reference: proto/prediction.proto:77-82), which the strict
+        predict-path parser rejects.  Only the genuinely-absent case is
+        lenient — a malformed payload (typo'd key, bad dtype) must
+        still raise so the client sees 400, not a silent drop."""
+        if not any(k in body for k in ("data", "binData", "strData", "jsonData")):
+            return InternalMessage(
+                payload=None,
+                kind="jsonData",
+                meta=MsgMeta.from_dict(body.get("meta", {})),
+                status=body.get("status"),
+            )
+        return InternalMessage.from_json(body)
+
     @classmethod
     def from_json(cls, body: Dict[str, Any]) -> "InternalFeedback":
         return cls(
-            request=InternalMessage.from_json(body["request"]) if "request" in body else None,
-            response=InternalMessage.from_json(body["response"]) if "response" in body else None,
+            request=cls._message_from_json(body["request"]) if "request" in body else None,
+            response=cls._message_from_json(body["response"]) if "response" in body else None,
             reward=float(body.get("reward", 0.0)),
-            truth=InternalMessage.from_json(body["truth"]) if "truth" in body else None,
+            truth=cls._message_from_json(body["truth"]) if "truth" in body else None,
         )
 
     def to_proto(self) -> pb.Feedback:

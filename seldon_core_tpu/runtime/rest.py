@@ -115,6 +115,13 @@ def _error_response(e: Exception) -> web.Response:
     if isinstance(e, MicroserviceError):
         body = {"status": e.to_status()}
         return web.json_response(body, status=e.status_code)
+    from seldon_core_tpu.codec.tensor import PayloadError
+
+    if isinstance(e, PayloadError):
+        # undecodable payload is the client's error, not a server fault
+        body = {"status": {"status": "FAILURE", "code": 400, "info": str(e),
+                           "reason": "BAD_PAYLOAD"}}
+        return web.json_response(body, status=400)
     logger.exception("unhandled microservice error")
     body = {"status": {"status": "FAILURE", "code": 500, "info": str(e), "reason": "MICROSERVICE_INTERNAL_ERROR"}}
     return web.json_response(body, status=500)

@@ -47,9 +47,12 @@ class TokenIssuer:
         ).digest()
 
     def check_credentials(self, key: str, secret: str) -> bool:
-        return hmac.compare_digest(key, self.config.key) and hmac.compare_digest(
-            secret, self.config.secret
-        )
+        # compare encoded bytes: compare_digest on str raises TypeError
+        # for non-ASCII input, which would turn a bad Basic header into
+        # a 500 instead of 401 invalid_client
+        return hmac.compare_digest(
+            key.encode(), self.config.key.encode()
+        ) and hmac.compare_digest(secret.encode(), self.config.secret.encode())
 
     def issue(self, now: Optional[float] = None) -> dict:
         now = time.time() if now is None else now

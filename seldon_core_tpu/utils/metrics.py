@@ -78,6 +78,16 @@ def _cache_for(registry=None) -> _MetricCache:
         return cache
 
 
+def increment_counter(name: str, documentation: str = "", registry=None) -> None:
+    """Public label-less counter increment against the (default)
+    registry.  Never raises: metrics must not break the data path —
+    failures are logged so a broken counter is visible, not silent."""
+    try:
+        _cache_for(registry).get("counter", name, (), documentation).inc()
+    except Exception:  # noqa: BLE001
+        logger.exception("failed to increment counter %s", name)
+
+
 class PrometheusObserver:
     """Engine observer -> prometheus.
 

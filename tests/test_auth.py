@@ -76,6 +76,14 @@ class TestTokenIssuer:
         with pytest.raises(ValueError):
             OAuthConfig(key="", secret="s")
 
+    def test_non_ascii_credentials_rejected_not_crash(self):
+        """compare_digest on str raises TypeError for non-ASCII; the
+        check must return False (401 invalid_client), not raise 500."""
+        issuer = TokenIssuer(AUTH)
+        assert not issuer.check_credentials("clé", "sécret")
+        assert not issuer.check_credentials("oauth-key", "sécret")
+        assert issuer.check_credentials("oauth-key", "oauth-secret")
+
 
 class TestGatewayRestAuth:
     def test_data_endpoints_require_token_health_stays_open(self):
@@ -184,6 +192,31 @@ class TestGatewayRestAuth:
 
         status, _closed = run(scenario())
         assert status == 401
+
+    def test_bodyless_401_keeps_connection_alive(self):
+        """A rejected GET/HEAD probe (no body on the wire) must not
+        force-close the socket — only chunked/oversized uploads do."""
+
+        async def scenario():
+            from aiohttp.test_utils import TestClient, TestServer
+
+            app = build_gateway_app(_gateway(), auth=AUTH)
+            client = TestClient(TestServer(app))
+            await client.start_server()
+            first = await client.get("/api/v0.1/predictions")
+            first_conn = first.headers.get("Connection", "")
+            await first.read()
+            # same client session: if the server closed the socket the
+            # second request still works (reconnect) but the header
+            # tells us the server asked for a close
+            second = await client.get("/api/v0.1/predictions")
+            await second.read()
+            await client.close()
+            return first.status, first_conn.lower(), second.status
+
+        status, conn_header, second_status = run(scenario())
+        assert status == 401 and second_status == 401
+        assert conn_header != "close"
 
     def test_no_auth_config_means_open_gateway(self):
         async def scenario():

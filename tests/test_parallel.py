@@ -51,6 +51,52 @@ class TestShardings:
         assert specs["dense"]["bias"] == P()
         assert specs["norm"]["scale"] == P()
 
+    def test_quantized_kernel_paired_spec(self):
+        """A QuantizedKernel shards as ONE unit: q on its output-channel
+        (last) dim with scale sharded the same axis — never q on the
+        input dim with a mismatched scale layout, which would force a
+        resharding collective inside the fused dequant (ADVICE r2)."""
+        from jax.sharding import PartitionSpec as P
+
+        from seldon_core_tpu.ops.surgery import QuantizedKernel
+
+        mesh = create_mesh({"data": 4, "model": 2})
+        params = {
+            # input dim (256) is larger than output (128): the paired
+            # rule must still prefer the last dim so scale can follow
+            "proj": {"kernel": QuantizedKernel(
+                np.zeros((256, 128), np.int8), np.ones((128,), np.float32))},
+            # output dim not divisible by axis 2 -> q shards input dim,
+            # scale replicates
+            "odd": {"kernel": QuantizedKernel(
+                np.zeros((64, 33), np.int8), np.ones((33,), np.float32))},
+        }
+        specs = infer_param_specs(params, mesh, min_weight_size=1024)
+        proj = specs["proj"]["kernel"]
+        assert isinstance(proj, QuantizedKernel)
+        assert proj.q == P(None, "model")
+        assert proj.scale == P("model")
+        odd = specs["odd"]["kernel"]
+        assert odd.q == P("model", None)
+        assert odd.scale == P()
+
+    def test_quantized_kernel_shard_params_roundtrip(self):
+        from seldon_core_tpu.ops.surgery import QuantizedKernel
+
+        mesh = create_mesh({"data": 4, "model": 2})
+        qk = QuantizedKernel(
+            np.arange(64 * 32, dtype=np.int8).reshape(64, 32) % 7,
+            np.linspace(0.5, 1.5, 32).astype(np.float32),
+        )
+        sharded = shard_params({"w": qk}, mesh, model_axis="model",
+                               min_weight_size=512)
+        out = sharded["w"]
+        # q sharded on output channels, scale on the matching axis
+        assert out.q.addressable_shards[0].data.shape == (64, 16)
+        assert out.scale.addressable_shards[0].data.shape == (16,)
+        np.testing.assert_array_equal(np.asarray(out.q), np.asarray(qk.q))
+        np.testing.assert_allclose(np.asarray(out.scale), qk.scale)
+
     def test_shard_params_places_on_mesh(self):
         mesh = create_mesh({"data": 4, "model": 2})
         params = {"w": np.ones((64, 32), np.float32)}

@@ -72,8 +72,9 @@ class TestRestMicroservice:
             return resp.status, body
 
         status, body = run(scenario())
-        assert status == 500 or status == 400
+        assert status == 400
         assert body["status"]["status"] == "FAILURE"
+        assert body["status"]["reason"] == "BAD_PAYLOAD"
 
     def test_health_and_metrics(self):
         async def scenario():
@@ -577,14 +578,132 @@ class TestGateway:
                 name = resp.meta.tags["predictor"]
                 served[name] += 1
                 await gw.send_feedback(InternalFeedback(response=resp, reward=1.0))
-            # unidentifiable feedback still broadcasts
-            await gw.send_feedback(InternalFeedback(reward=0.0))
-            return served, ma.feedback_count, mb.feedback_count
+            # unidentifiable feedback is a counted drop, never a broadcast
+            dropped = await gw.send_feedback(InternalFeedback(reward=0.0))
+            return served, ma.feedback_count, mb.feedback_count, dropped
 
-        served, fa, fb = run(scenario())
+        served, fa, fb, dropped = run(scenario())
         assert served["a"] > 0 and served["b"] > 0
-        assert fa == served["a"] + 1  # own traffic + 1 broadcast
-        assert fb == served["b"] + 1
+        assert fa == served["a"]  # own traffic only — no broadcast
+        assert fb == served["b"]
+        assert dropped.status["reason"] == "FEEDBACK_UNROUTED"
+        assert dropped.status["code"] == 404
+
+    def test_unroutable_feedback_counted_and_inert(self):
+        """Feedback with an evicted/absent puid must mutate no MAB state
+        and increment the unrouted counter (VERDICT r2: the reference
+        never broadcasts, PredictiveUnitBean.java:206-246)."""
+
+        class FbCounter(Doubler):
+            def __init__(self):
+                self.feedback_count = 0
+
+            def send_feedback(self, features, feature_names, reward, truth, routing=None):
+                self.feedback_count += 1
+
+        async def scenario():
+            from seldon_core_tpu.runtime.message import InternalFeedback
+            from seldon_core_tpu.utils.metrics import _cache_for
+
+            counter = _cache_for().get(
+                "counter", "seldon_api_gateway_feedback_unrouted", ()
+            )
+            before = counter._value.get()
+
+            ma, mb = FbCounter(), FbCounter()
+            a = PredictorService(model_unit("m", ma), name="a")
+            b = PredictorService(model_unit("m", mb), name="b")
+            # ambiguous (two-predictor) gateway: no broadcast allowed
+            gw = Gateway([(a, 50.0), (b, 50.0)])
+            # absent puid
+            await gw.send_feedback(InternalFeedback(reward=1.0))
+            # evicted puid: a response whose puid the gateway never saw
+            ghost = InternalMessage(payload=np.ones((1, 2)), kind="ndarray")
+            ghost.meta.puid = "never-served-here"
+            await gw.send_feedback(InternalFeedback(response=ghost, reward=1.0))
+            return ma.feedback_count + mb.feedback_count, counter._value.get() - before
+
+        fb_count, delta = run(scenario())
+        assert fb_count == 0  # no MAB state mutated
+        assert delta == 2  # both drops counted
+
+    def test_meta_only_feedback_response_parses_and_routes(self):
+        """A feedback `response` carrying only meta (routing tags, no
+        payload) is a legal Feedback shape — the proto payload oneof
+        may be unset (reference: proto/prediction.proto:77-82)."""
+        from seldon_core_tpu.runtime.message import InternalFeedback
+
+        fb = InternalFeedback.from_json(
+            {
+                "request": {"data": {"ndarray": [[1.0, 2.0]]}},
+                "response": {"meta": {"tags": {"predictor": "alpha"}}},
+                "reward": 1.0,
+            }
+        )
+        assert fb.request is not None and fb.request.payload is not None
+        assert fb.response is not None and fb.response.payload is None
+        assert fb.response.meta.tags["predictor"] == "alpha"
+
+    def test_malformed_feedback_payload_still_rejected(self):
+        """Lenience covers only the ABSENT-payload case: a typo'd data
+        key must still raise (client sees 400), not silently drop."""
+        from seldon_core_tpu.codec.tensor import PayloadError
+        from seldon_core_tpu.runtime.message import InternalFeedback
+
+        with pytest.raises(PayloadError):
+            InternalFeedback.from_json(
+                {"request": {"data": {"tenzor": [[1.0]]}}, "reward": 1.0}
+            )
+
+    def test_single_predictor_feedback_still_routes(self):
+        """With exactly one predictor the route is unambiguous: bare
+        Feedback (request only — the reference client's normal shape)
+        must still reach it, not be dropped."""
+
+        class FbCounter(Doubler):
+            def __init__(self):
+                self.feedback_count = 0
+
+            def send_feedback(self, features, feature_names, reward, truth, routing=None):
+                self.feedback_count += 1
+
+        async def scenario():
+            from seldon_core_tpu.runtime.message import InternalFeedback
+
+            ma = FbCounter()
+            gw = Gateway([(PredictorService(model_unit("m", ma), name="a"), 1.0)])
+            out = await gw.send_feedback(InternalFeedback(reward=1.0))
+            return ma.feedback_count, out
+
+        fb_count, out = run(scenario())
+        assert fb_count == 1
+        assert not (out.status and out.status.get("status") == "FAILURE")
+
+    def test_single_predictor_stale_identifier_still_drops(self):
+        """Even with one predictor, feedback whose identifiers FAILED
+        to resolve (stale tag from a removed predictor, evicted puid)
+        drops — it may belong to a predictor that no longer exists."""
+
+        class FbCounter(Doubler):
+            def __init__(self):
+                self.feedback_count = 0
+
+            def send_feedback(self, features, feature_names, reward, truth, routing=None):
+                self.feedback_count += 1
+
+        async def scenario():
+            from seldon_core_tpu.runtime.message import InternalFeedback
+
+            ma = FbCounter()
+            gw = Gateway([(PredictorService(model_unit("m", ma), name="a"), 1.0)])
+            stale = InternalMessage(payload=np.ones((1, 2)), kind="ndarray")
+            stale.meta.tags["predictor"] = "removed-predictor"
+            out = await gw.send_feedback(InternalFeedback(response=stale, reward=1.0))
+            return ma.feedback_count, out
+
+        fb_count, out = run(scenario())
+        assert fb_count == 0
+        assert out.status["reason"] == "FEEDBACK_UNROUTED"
 
     def test_feedback_routed_by_puid_when_tag_stripped(self):
         class FbCounter(Doubler):
