@@ -26,8 +26,10 @@
 // environment.
 //
 // Threading model (sized for small hosts): 1 IO thread (epoll: accept,
-// read, parse, decode, write), 1 batcher thread (coalesce, model call,
-// serialise), N raw-worker threads (Python fallback).  Completed
+// read, parse, decode, write), K batch-worker threads (coalesce, model
+// call, serialise — K concurrent model calls pipeline device batches,
+// the throughput lever when device roundtrips have high fixed
+// latency), N raw-worker threads (Python fallback).  Completed
 // responses return to the IO thread through an eventfd-signalled queue.
 
 #include <arpa/inet.h>
@@ -50,11 +52,14 @@
 #include <deque>
 #include <map>
 #include <mutex>
+#include <memory>
 #include <random>
 #include <string>
 #include <thread>
 #include <unordered_map>
 #include <vector>
+
+#include "h2grpc.h"
 
 // from codec.cc (same shared object)
 extern "C" {
@@ -72,11 +77,16 @@ using Clock = std::chrono::steady_clock;
 
 extern "C" {
 
-// One Python call per coalesced batch: in = [rows, cols] float32
-// (padded to the bucket), out = [rows, out_cols] float32 to fill.
-// Return 0 on success.
-typedef int32_t (*fs_batch_cb)(void* ctx, const float* in, int64_t rows,
-                               int64_t cols, float* out, int64_t out_cols);
+// One Python call per coalesced batch: in = [rows, cols] of `dtype`
+// (0 = float32, 1 = uint8; padded to the bucket), out = [rows,
+// out_cols] float32 to fill.  Return 0 on success.  May be invoked
+// from SEVERAL batch-worker threads concurrently (cfg.batch_threads):
+// callbacks that block on device readback pipeline N batches in
+// flight, which is what sets serving throughput on a
+// high-latency host<->accelerator link.
+typedef int32_t (*fs_batch_cb)(void* ctx, const void* in, int64_t rows,
+                               int64_t cols, int32_t dtype, float* out,
+                               int64_t out_cols);
 
 // Fallback lane: full request handed to Python, response returned as a
 // buffer obtained from fs_alloc (freed by the server after writing).
@@ -99,6 +109,7 @@ typedef struct {
                            // empty — the in-flight model call is the
                            // accumulation window; max_wait only bounds
                            // collection when requests are already queued
+  int32_t batch_threads;   // fast-lane workers = in-flight model calls
   const char* model_name;  // for requestPath / names in responses
   const char* names_csv;   // response names ("" -> t:0..out_dim-1)
   const char* buckets_csv; // padding ladder ("" -> powers of two); MUST
@@ -301,17 +312,24 @@ bool parse_raw_frame(const uint8_t* body, int64_t len, RawFrame* out) {
 // request / response plumbing
 // ---------------------------------------------------------------------------
 
-enum class Lane { FAST_JSON, FAST_RAW, RAW };
+enum class Lane { FAST_JSON, FAST_RAW, RAW, GRPC };
 
 struct PendingReq {
   uint64_t conn_id;
   uint64_t seq;
   Lane lane;
   bool keep_alive;
-  // fast lane
-  std::vector<float> features;  // [rows * cols]
+  // fast lane: raw bytes of [rows * cols] elements of `dtype`
+  // (0 = float32, 1 = uint8 — uint8 image payloads stay uint8 all the
+  // way to the device, no 4x float inflation on the wire or in RAM)
+  std::vector<uint8_t> features;
   int64_t rows = 0;
+  int64_t cols = 0;
+  uint8_t dtype = 0;
   std::string puid;             // echoed if the client sent one
+  // gRPC (h2) lane
+  uint32_t h2_stream = 0;
+  bool h2_mirror_raw = false;   // request used rawTensor -> mirror it
   // raw lane
   std::string method;
   std::string path;
@@ -322,7 +340,13 @@ struct DoneResp {
   uint64_t conn_id;
   uint64_t seq;
   bool keep_alive;
-  std::string bytes;  // full HTTP response
+  std::string bytes;  // full HTTP response (HTTP/1.1 lanes)
+  // gRPC (h2) lane: stream + payload; the IO thread frames it with the
+  // connection's flow-control state
+  uint32_t h2_stream = 0;
+  int32_t grpc_status = 0;
+  std::string grpc_msg;
+  std::string h2_proto;
 };
 
 struct Conn {
@@ -330,6 +354,9 @@ struct Conn {
   std::string in;
   std::string out;
   size_t out_off = 0;
+  // non-null once the HTTP/2 client preface is seen on this socket —
+  // the h2c gRPC lane shares the port with HTTP/1.1
+  std::unique_ptr<h2::Conn> h2c;
   uint64_t next_assign = 0;   // next request sequence on this connection
   uint64_t next_write = 0;    // next sequence to write (ordering)
   std::map<uint64_t, DoneResp> ready;  // out-of-order completions
@@ -399,6 +426,7 @@ class FrontServer {
     if (cfg_.max_wait_us < 0) cfg_.max_wait_us = 1000;
     if (cfg_.out_dim < 1) cfg_.out_dim = 3;
     if (cfg_.raw_workers < 1) cfg_.raw_workers = 2;
+    if (cfg_.batch_threads < 1) cfg_.batch_threads = 4;
     if (cfg_.backlog < 1) cfg_.backlog = 512;
     // bucket ladder: explicit list from the caller (the Python side's
     // normalize_buckets output, so warmup covers exactly the shapes
@@ -490,7 +518,9 @@ class FrontServer {
 
     running_.store(true);
     io_thread_ = std::thread([this] { io_loop(); });
-    batch_thread_ = std::thread([this] { batch_loop(); });
+    for (int i = 0; i < cfg_.batch_threads; i++) {
+      batch_threads_.emplace_back([this] { batch_loop(); });
+    }
     for (int i = 0; i < cfg_.raw_workers; i++) {
       raw_threads_.emplace_back([this] { raw_loop(); });
     }
@@ -509,7 +539,9 @@ class FrontServer {
       raw_cv_.notify_all();
     }
     if (io_thread_.joinable()) io_thread_.join();
-    if (batch_thread_.joinable()) batch_thread_.join();
+    for (auto& t : batch_threads_)
+      if (t.joinable()) t.join();
+    batch_threads_.clear();
     for (auto& t : raw_threads_)
       if (t.joinable()) t.join();
     raw_threads_.clear();
@@ -636,6 +668,25 @@ class FrontServer {
 
   void process_input(uint64_t id) {
     auto it = conns_.find(id);
+    if (it == conns_.end()) return;
+    {
+      Conn& c = it->second;
+      // h2c detection: the gRPC client preface shares the port with
+      // HTTP/1.1.  "P" is ambiguous (POST/PUT/PATCH) until more bytes
+      // arrive; a full prefix match switches the connection to HTTP/2.
+      if (!c.h2c && !c.in.empty() && c.in[0] == 'P') {
+        bool maybe = false;
+        if (h2::is_h2_preface(c.in, &maybe)) {
+          c.h2c.reset(new h2::Conn());
+        } else if (maybe) {
+          return;  // wait for enough bytes to disambiguate
+        }
+      }
+      if (c.h2c) {
+        process_h2(id);
+        return;
+      }
+    }
     while (it != conns_.end()) {
       Conn& c = it->second;
       size_t header_end = c.in.find("\r\n\r\n");
@@ -678,6 +729,67 @@ class FrontServer {
       }
       it = conns_.find(id);  // route may close the connection
     }
+  }
+
+  void process_h2(uint64_t id) {
+    auto it = conns_.find(id);
+    if (it == conns_.end()) return;
+    Conn& c = it->second;
+    std::vector<h2::GrpcRequest> reqs;
+    bool ok = c.h2c->on_bytes(&c.in, &c.out, &reqs);
+    for (auto& r : reqs) {
+      route_grpc(id, r);
+      if (!conns_.count(id)) return;
+    }
+    if (!ok) {
+      // protocol violation: flush what the state machine queued
+      // (GOAWAY-ish best effort), then drop the connection
+      flush_out(id);
+      if (conns_.count(id)) close_conn(id);
+      return;
+    }
+    flush_out(id);
+  }
+
+  void route_grpc(uint64_t id, h2::GrpcRequest& r) {
+    auto it = conns_.find(id);
+    if (it == conns_.end()) return;
+    Conn& c = it->second;
+    if (r.path == "/seldon.protos.Seldon/Predict" &&
+        (batch_cb_ != nullptr || cfg_.stub_mode)) {
+      h2::ParsedPredict pp;
+      if (h2::parse_predict_request(r.message, &pp) &&
+          (cfg_.feature_dim <= 0 || pp.cols == cfg_.feature_dim)) {
+        PendingReq p;
+        p.conn_id = id;
+        p.lane = Lane::GRPC;
+        p.keep_alive = true;
+        p.h2_stream = r.stream_id;
+        p.rows = pp.rows;
+        p.cols = pp.cols;
+        p.dtype = (uint8_t)pp.dtype;
+        p.features = std::move(pp.features);
+        p.puid = std::move(pp.puid);
+        p.h2_mirror_raw = pp.was_raw;
+        p.seq = c.next_assign++;  // monotonic; h2 writes bypass ordering
+        c.inflight++;
+        enqueue_fast(std::move(p));
+        return;
+      }
+      requests_.fetch_add(1);
+      failures_.fetch_add(1);
+      c.h2c->send_response(r.stream_id, "", 3 /* INVALID_ARGUMENT */,
+                           "native lane accepts 2-D tensor/rawTensor payloads",
+                           &c.out);
+      return;
+    }
+    // other methods (SendFeedback, streams, other services) live on the
+    // engine's gRPC port with full semantics
+    requests_.fetch_add(1);
+    c.h2c->send_response(r.stream_id, "", 12 /* UNIMPLEMENTED */,
+                         "native ingress serves Seldon/Predict; use the "
+                         "engine gRPC port for other methods",
+                         &c.out);
   }
 
   // queue a response computed inline on the IO thread (control endpoints
@@ -752,12 +864,14 @@ class FrontServer {
       if (req.is_raw_tensor) {
         RawFrame f;
         if (parse_raw_frame((const uint8_t*)body.data(), (int64_t)body.size(), &f) &&
-            f.dtype == 0 && f.shape.size() == 2 &&
+            (f.dtype == 0 || f.dtype == 1) && f.shape.size() == 2 &&
             f.shape[0] >= 1 && f.shape[1] >= 1 &&  // mirror the JSON lane: no empty batches
             (cfg_.feature_dim <= 0 || f.shape[1] == cfg_.feature_dim)) {
           p.lane = Lane::FAST_RAW;
           p.rows = f.shape[0];
-          p.features.resize((size_t)(f.shape[0] * f.shape[1]));
+          p.cols = f.shape[1];
+          p.dtype = (uint8_t)f.dtype;
+          p.features.resize((size_t)f.data_len);
           memcpy(p.features.data(), f.data, f.data_len);
           p.seq = c.next_assign++;
           c.inflight++;
@@ -843,7 +957,11 @@ class FrontServer {
       int64_t n = json_parse_f64(body.data() + vpos, vend - vpos, vals.data(), elems);
       if (n != elems) return false;
       p->rows = rows;
-      p->features.assign(vals.begin(), vals.end());  // f64 -> f32
+      p->cols = cols;
+      p->dtype = 0;
+      p->features.resize((size_t)elems * sizeof(float));
+      float* dst = (float*)p->features.data();
+      for (int64_t i = 0; i < elems; i++) dst[i] = (float)vals[i];
       return true;
     }
 
@@ -886,7 +1004,11 @@ class FrontServer {
       int64_t cols = n / rows;
       if (cfg_.feature_dim > 0 && cols != cfg_.feature_dim) return false;
       p->rows = rows;
-      p->features.assign(vals.begin(), vals.begin() + n);
+      p->cols = cols;
+      p->dtype = 0;
+      p->features.resize((size_t)n * sizeof(float));
+      float* dst = (float*)p->features.data();
+      for (int64_t i = 0; i < n; i++) dst[i] = (float)vals[i];
       return true;
     }
     return false;
@@ -944,9 +1066,17 @@ class FrontServer {
           d.conn_id = it2.conn_id;
           d.seq = it2.seq;
           d.keep_alive = it2.keep_alive;
-          d.bytes = http_response(500, "application/json",
-                                  seldon_error_json(500, "batch failed", "ENGINE_ERROR"),
-                                  it2.keep_alive);
+          if (it2.lane == Lane::GRPC) {
+            // an HTTP/1.1 body on an h2 socket would corrupt the whole
+            // connection — fail the stream with proper gRPC trailers
+            d.h2_stream = it2.h2_stream;
+            d.grpc_status = 13;  // INTERNAL
+            d.grpc_msg = "batch failed";
+          } else {
+            d.bytes = http_response(500, "application/json",
+                                    seldon_error_json(500, "batch failed", "ENGINE_ERROR"),
+                                    it2.keep_alive);
+          }
           failures_.fetch_add(1);
           requests_.fetch_add(1);
           complete(std::move(d));
@@ -962,33 +1092,35 @@ class FrontServer {
   }
 
   void run_batch(std::vector<PendingReq>& all_items) {
-    // group by feature width: with feature_dim configured all requests
-    // share it, but the unconstrained mode must not concatenate rows of
-    // different widths into one buffer
-    std::map<int64_t, std::vector<PendingReq*>> groups;
+    // group by (feature width, dtype): with feature_dim configured all
+    // requests share the width, but the unconstrained mode must not
+    // concatenate rows of different widths — and mixed-dtype requests
+    // must never share one buffer (each (shape, dtype) pair is its own
+    // compiled XLA program on the Python side)
+    std::map<std::pair<int64_t, int>, std::vector<PendingReq*>> groups;
     for (auto& it : all_items) {
-      int64_t c = it.rows > 0 ? (int64_t)it.features.size() / it.rows : 0;
-      groups[c].push_back(&it);
+      groups[{it.cols, (int)it.dtype}].push_back(&it);
     }
-    for (auto& kv : groups) run_batch_group(kv.second, kv.first);
+    for (auto& kv : groups) run_batch_group(kv.second, kv.first.first, kv.first.second);
   }
 
-  void run_batch_group(std::vector<PendingReq*>& items, int64_t cols) {
+  void run_batch_group(std::vector<PendingReq*>& items, int64_t cols, int dtype) {
     int64_t rows = 0;
     for (auto* it : items) rows += it->rows;
     int64_t bucket = bucket_for(rows);
-    std::vector<float> batch((size_t)(bucket * cols), 0.0f);
+    const size_t item = dtype == 1 ? 1 : sizeof(float);
+    std::vector<uint8_t> batch((size_t)(bucket * cols) * item, 0);
     int64_t off = 0;
     for (auto* it : items) {
-      memcpy(batch.data() + off * cols, it->features.data(),
-             it->features.size() * sizeof(float));
+      memcpy(batch.data() + (size_t)(off * cols) * item, it->features.data(),
+             it->features.size());
       off += it->rows;
     }
     int64_t out_cols = cfg_.out_dim;
     std::vector<float> out((size_t)(bucket * out_cols), 0.0f);
     int rc = 0;
     if (batch_cb_ != nullptr) {
-      rc = batch_cb_(batch_ctx_, batch.data(), bucket, cols, out.data(), out_cols);
+      rc = batch_cb_(batch_ctx_, batch.data(), bucket, cols, dtype, out.data(), out_cols);
     } else if (cfg_.stub_mode) {
       // in-C++ stub model: fixed per-class scores, the reference's
       // SIMPLE_MODEL benchmarking methodology (engine measured, model
@@ -1011,6 +1143,24 @@ class FrontServer {
       d.conn_id = it->conn_id;
       d.seq = it->seq;
       d.keep_alive = it->keep_alive;
+      if (it->lane == Lane::GRPC) {
+        d.h2_stream = it->h2_stream;
+        if (rc != 0) {
+          failures_.fetch_add(1);
+          d.grpc_status = 13;  // INTERNAL
+          d.grpc_msg = "model call failed";
+        } else {
+          std::string puid = it->puid.empty() ? next_puid() : it->puid;
+          d.h2_proto = h2::build_predict_response(
+              out.data() + row_off * out_cols, it->rows, out_cols, puid,
+              model_name_, names_, it->h2_mirror_raw);
+        }
+        row_off += it->rows;
+        fast_requests_.fetch_add(1);
+        requests_.fetch_add(1);
+        complete(std::move(d));
+        continue;
+      }
       if (rc != 0) {
         failures_.fetch_add(1);
         d.bytes = http_response(500, "application/json",
@@ -1149,6 +1299,15 @@ class FrontServer {
       if (it == conns_.end()) continue;  // connection died meanwhile
       Conn& c = it->second;
       c.inflight--;
+      if (d.h2_stream != 0) {
+        // h2 streams are independent — no HTTP/1.1 response ordering
+        if (c.h2c) {
+          c.h2c->send_response(d.h2_stream, d.h2_proto, d.grpc_status,
+                               d.grpc_msg, &c.out);
+        }
+        flush_out(conn_id);
+        continue;
+      }
       c.ready.emplace(seq, std::move(d));
       try_write_ready(c);
       flush_out(conn_id);
@@ -1226,7 +1385,8 @@ class FrontServer {
   fs_raw_cb raw_cb_ = nullptr;
   void* raw_ctx_ = nullptr;
 
-  std::thread io_thread_, batch_thread_;
+  std::thread io_thread_;
+  std::vector<std::thread> batch_threads_;
   std::vector<std::thread> raw_threads_;
 
   std::unordered_map<uint64_t, Conn> conns_;

@@ -1,0 +1,1016 @@
+// HTTP/2 (h2c) + gRPC unary + HPACK + minimal SeldonMessage proto
+// codec for the native front server.  See h2grpc.h for scope.
+//
+// Design notes:
+// * Single-threaded per connection: on_bytes/send_response are called
+//   only from the server's IO thread; batch workers hand response
+//   payloads back through the completion queue.
+// * HPACK decode implements the full instruction set (indexed,
+//   literal +/- indexing, dynamic-table size update) with the RFC 7541
+//   static table and a dynamic table.  Huffman decoding is built
+//   canonically from the printable-ASCII code lengths of the RFC 7541
+//   table (the code IS canonical: codes assigned consecutively by
+//   ascending length, symbols ascending within a length — verified
+//   against the published table's spot values).  gRPC metadata is
+//   ASCII; a block using longer codes fails decode and the connection
+//   is refused cleanly.
+// * Responses encode headers as literal never-indexed raw strings
+//   (stateless, always legal) plus the one static index :status 200.
+
+#include "h2grpc.h"
+
+#include <cstring>
+
+namespace h2 {
+namespace {
+
+// ---------------------------------------------------------------------------
+// HPACK Huffman (printable ASCII subset, canonical construction)
+// ---------------------------------------------------------------------------
+
+// RFC 7541 Appendix B code lengths for symbols 32..126.
+const uint8_t kHuffLen[95] = {
+    /* ' ' */ 6,  /* ! */ 10, /* " */ 10, /* # */ 12, /* $ */ 13,
+    /* %   */ 6,  /* & */ 8,  /* ' */ 11, /* ( */ 10, /* ) */ 10,
+    /* *   */ 8,  /* + */ 11, /* , */ 8,  /* - */ 6,  /* . */ 6,
+    /* /   */ 6,  /* 0 */ 5,  /* 1 */ 5,  /* 2 */ 5,  /* 3 */ 6,
+    /* 4   */ 6,  /* 5 */ 6,  /* 6 */ 6,  /* 7 */ 6,  /* 8 */ 6,
+    /* 9   */ 6,  /* : */ 7,  /* ; */ 8,  /* < */ 15, /* = */ 6,
+    /* >   */ 12, /* ? */ 10, /* @ */ 13, /* A */ 6,  /* B */ 7,
+    /* C   */ 7,  /* D */ 7,  /* E */ 7,  /* F */ 7,  /* G */ 7,
+    /* H   */ 7,  /* I */ 7,  /* J */ 7,  /* K */ 7,  /* L */ 7,
+    /* M   */ 7,  /* N */ 7,  /* O */ 7,  /* P */ 7,  /* Q */ 7,
+    /* R   */ 7,  /* S */ 7,  /* T */ 7,  /* U */ 7,  /* V */ 7,
+    /* W   */ 7,  /* X */ 8,  /* Y */ 7,  /* Z */ 8,  /* [ */ 13,
+    /* \\  */ 19, /* ] */ 13, /* ^ */ 14, /* _ */ 6,  /* ` */ 15,
+    /* a   */ 5,  /* b */ 6,  /* c */ 5,  /* d */ 6,  /* e */ 5,
+    /* f   */ 6,  /* g */ 6,  /* h */ 6,  /* i */ 5,  /* j */ 7,
+    /* k   */ 7,  /* l */ 6,  /* m */ 6,  /* n */ 6,  /* o */ 5,
+    /* p   */ 6,  /* q */ 7,  /* r */ 6,  /* s */ 5,  /* t */ 5,
+    /* u   */ 6,  /* v */ 7,  /* w */ 7,  /* x */ 7,  /* y */ 7,
+    /* z   */ 7,  /* { */ 15, /* | */ 11, /* } */ 14, /* ~ */ 13,
+};
+
+constexpr int kMaxHuffLen = 15;  // codes beyond this are refused
+
+struct HuffTables {
+  // decode: per length, the first canonical code and symbol list
+  uint32_t first_code[kMaxHuffLen + 1] = {0};
+  std::vector<uint8_t> syms[kMaxHuffLen + 1];
+  // encode (used by tests): per symbol (code, len)
+  uint32_t enc_code[95] = {0};
+  uint8_t enc_len[95] = {0};
+
+  HuffTables() {
+    // canonical assignment must walk ALL symbols with codes <= 15 bits
+    // in (length, symbol) order: that set is NUL (length 13, RFC 7541
+    // gives it 0x1ff8) plus printable ASCII — omitting NUL would shift
+    // every length-13..15 code by one (guarded by h2_huff_selftest)
+    auto len_of = [](int sym) -> int {
+      if (sym == 0) return 13;
+      if (sym >= 32 && sym <= 126) return kHuffLen[sym - 32];
+      return 0;  // > 15 bits: outside the decode subset
+    };
+    uint32_t code = 0;
+    for (int len = 1; len <= kMaxHuffLen; len++) {
+      code <<= 1;
+      first_code[len] = code;
+      for (int s = 0; s < 256; s++) {
+        if (len_of(s) == len) {
+          syms[len].push_back((uint8_t)s);
+          if (s >= 32 && s <= 126) {
+            enc_code[s - 32] = code;
+            enc_len[s - 32] = (uint8_t)len;
+          }
+          code++;
+        }
+      }
+    }
+  }
+};
+
+const HuffTables& huff() {
+  static HuffTables t;
+  return t;
+}
+
+bool huff_decode(const uint8_t* p, size_t n, std::string* out) {
+  const HuffTables& t = huff();
+  uint32_t code = 0;
+  int len = 0;
+  for (size_t i = 0; i < n; i++) {
+    for (int b = 7; b >= 0; b--) {
+      code = (code << 1) | ((p[i] >> b) & 1);
+      len++;
+      if (len > kMaxHuffLen) {
+        // could be EOS padding only at the very end (all ones)
+        return false;
+      }
+      uint32_t base = t.first_code[len];
+      if (!t.syms[len].empty() && code >= base &&
+          code < base + t.syms[len].size()) {
+        out->push_back((char)t.syms[len][code - base]);
+        code = 0;
+        len = 0;
+      }
+    }
+  }
+  // leftover bits must be a prefix of EOS: all ones, fewer than 8 bits
+  if (len >= 8) return false;
+  return code == ((1u << len) - 1);
+}
+
+// test hook: encode with the same table (ASCII only)
+bool huff_encode(const std::string& in, std::string* out) {
+  const HuffTables& t = huff();
+  uint64_t acc = 0;
+  int nbits = 0;
+  for (unsigned char c : in) {
+    if (c < 32 || c > 126 || t.enc_len[c - 32] == 0) return false;
+    acc = (acc << t.enc_len[c - 32]) | t.enc_code[c - 32];
+    nbits += t.enc_len[c - 32];
+    while (nbits >= 8) {
+      out->push_back((char)((acc >> (nbits - 8)) & 0xff));
+      nbits -= 8;
+    }
+  }
+  if (nbits) {
+    uint64_t pad = (1u << (8 - nbits)) - 1;  // EOS prefix
+    out->push_back((char)(((acc << (8 - nbits)) | pad) & 0xff));
+  }
+  return true;
+}
+
+// ---------------------------------------------------------------------------
+// HPACK static table (RFC 7541 Appendix A)
+// ---------------------------------------------------------------------------
+
+struct StaticEntry {
+  const char* name;
+  const char* value;
+};
+const StaticEntry kStatic[61] = {
+    {":authority", ""}, {":method", "GET"}, {":method", "POST"},
+    {":path", "/"}, {":path", "/index.html"}, {":scheme", "http"},
+    {":scheme", "https"}, {":status", "200"}, {":status", "204"},
+    {":status", "206"}, {":status", "304"}, {":status", "400"},
+    {":status", "404"}, {":status", "500"}, {"accept-charset", ""},
+    {"accept-encoding", "gzip, deflate"}, {"accept-language", ""},
+    {"accept-ranges", ""}, {"accept", ""},
+    {"access-control-allow-origin", ""}, {"age", ""}, {"allow", ""},
+    {"authorization", ""}, {"cache-control", ""},
+    {"content-disposition", ""}, {"content-encoding", ""},
+    {"content-language", ""}, {"content-length", ""},
+    {"content-location", ""}, {"content-range", ""}, {"content-type", ""},
+    {"cookie", ""}, {"date", ""}, {"etag", ""}, {"expect", ""},
+    {"expires", ""}, {"from", ""}, {"host", ""}, {"if-match", ""},
+    {"if-modified-since", ""}, {"if-none-match", ""}, {"if-range", ""},
+    {"if-unmodified-since", ""}, {"last-modified", ""}, {"link", ""},
+    {"location", ""}, {"max-forwards", ""}, {"proxy-authenticate", ""},
+    {"proxy-authorization", ""}, {"range", ""}, {"referer", ""},
+    {"refresh", ""}, {"retry-after", ""}, {"server", ""},
+    {"set-cookie", ""}, {"strict-transport-security", ""},
+    {"transfer-encoding", ""}, {"user-agent", ""}, {"vary", ""},
+    {"via", ""}, {"www-authenticate", ""},
+};
+
+// ---------------------------------------------------------------------------
+// HPACK decoder
+// ---------------------------------------------------------------------------
+
+struct Hpack {
+  std::vector<std::pair<std::string, std::string>> dyn;  // front = newest
+  size_t dyn_size = 0;
+  size_t dyn_max = 4096;
+
+  void evict() {
+    while (dyn_size > dyn_max && !dyn.empty()) {
+      dyn_size -= dyn.back().first.size() + dyn.back().second.size() + 32;
+      dyn.pop_back();
+    }
+  }
+  void add(const std::string& n, const std::string& v) {
+    dyn.insert(dyn.begin(), {n, v});
+    dyn_size += n.size() + v.size() + 32;
+    evict();
+  }
+  bool lookup(uint64_t idx, std::string* n, std::string* v) const {
+    if (idx >= 1 && idx <= 61) {
+      *n = kStatic[idx - 1].name;
+      *v = kStatic[idx - 1].value;
+      return true;
+    }
+    uint64_t d = idx - 62;
+    if (d < dyn.size()) {
+      *n = dyn[d].first;
+      *v = dyn[d].second;
+      return true;
+    }
+    return false;
+  }
+};
+
+bool hpack_int(const uint8_t* p, size_t n, size_t* pos, int prefix,
+               uint64_t* out) {
+  if (*pos >= n) return false;
+  uint64_t mask = (1u << prefix) - 1;
+  uint64_t v = p[*pos] & mask;
+  (*pos)++;
+  if (v < mask) {
+    *out = v;
+    return true;
+  }
+  uint64_t m = 0;
+  while (*pos < n) {
+    uint8_t b = p[(*pos)++];
+    v += (uint64_t)(b & 0x7f) << m;
+    m += 7;
+    if (m > 56) return false;  // bounded: reject absurd integers
+    if (!(b & 0x80)) {
+      *out = v;
+      return true;
+    }
+  }
+  return false;
+}
+
+bool hpack_string(const uint8_t* p, size_t n, size_t* pos, std::string* out) {
+  if (*pos >= n) return false;
+  bool huffman = (p[*pos] & 0x80) != 0;
+  uint64_t len;
+  if (!hpack_int(p, n, pos, 7, &len)) return false;
+  if (*pos + len > n || len > (1u << 24)) return false;
+  if (huffman) {
+    bool ok = huff_decode(p + *pos, (size_t)len, out);
+    *pos += len;
+    return ok;
+  }
+  out->assign((const char*)(p + *pos), (size_t)len);
+  *pos += len;
+  return true;
+}
+
+// Decode one header block into (name, value) pairs.
+bool hpack_decode(Hpack* hp, const std::string& block,
+                  std::vector<std::pair<std::string, std::string>>* out) {
+  const uint8_t* p = (const uint8_t*)block.data();
+  size_t n = block.size(), pos = 0;
+  while (pos < n) {
+    uint8_t b = p[pos];
+    if (b & 0x80) {  // indexed
+      uint64_t idx;
+      if (!hpack_int(p, n, &pos, 7, &idx) || idx == 0) return false;
+      std::string name, value;
+      if (!hp->lookup(idx, &name, &value)) return false;
+      out->push_back({name, value});
+    } else if (b & 0x40) {  // literal with incremental indexing
+      uint64_t idx;
+      if (!hpack_int(p, n, &pos, 6, &idx)) return false;
+      std::string name, value;
+      if (idx) {
+        std::string unused;
+        if (!hp->lookup(idx, &name, &unused)) return false;
+      } else if (!hpack_string(p, n, &pos, &name)) {
+        return false;
+      }
+      if (!hpack_string(p, n, &pos, &value)) return false;
+      hp->add(name, value);
+      out->push_back({name, value});
+    } else if (b & 0x20) {  // dynamic table size update
+      uint64_t sz;
+      if (!hpack_int(p, n, &pos, 5, &sz)) return false;
+      if (sz > (1u << 22)) return false;
+      hp->dyn_max = (size_t)sz;
+      hp->evict();
+    } else {  // literal without indexing (0000) / never indexed (0001)
+      uint64_t idx;
+      if (!hpack_int(p, n, &pos, 4, &idx)) return false;
+      std::string name, value;
+      if (idx) {
+        std::string unused;
+        if (!hp->lookup(idx, &name, &unused)) return false;
+      } else if (!hpack_string(p, n, &pos, &name)) {
+        return false;
+      }
+      if (!hpack_string(p, n, &pos, &value)) return false;
+      out->push_back({name, value});
+    }
+  }
+  return true;
+}
+
+// stateless response encoding: raw never-indexed literal (0x10), no
+// Huffman — always legal, no encoder state to share across threads
+void emit_never_indexed(std::string* out, const std::string& name,
+                        const std::string& value) {
+  auto emit_len = [out](size_t len) {  // 7-bit prefix int, H bit 0
+    if (len < 127) {
+      out->push_back((char)len);
+    } else {
+      out->push_back(127);
+      size_t v = len - 127;
+      while (v >= 128) {
+        out->push_back((char)(0x80 | (v & 0x7f)));
+        v >>= 7;
+      }
+      out->push_back((char)v);
+    }
+  };
+  out->push_back(0x10);
+  emit_len(name.size());
+  out->append(name);
+  emit_len(value.size());
+  out->append(value);
+}
+
+// ---------------------------------------------------------------------------
+// HTTP/2 framing
+// ---------------------------------------------------------------------------
+
+constexpr uint8_t FT_DATA = 0x0, FT_HEADERS = 0x1, FT_PRIORITY = 0x2,
+                  FT_RST = 0x3, FT_SETTINGS = 0x4, FT_PUSH = 0x5,
+                  FT_PING = 0x6, FT_GOAWAY = 0x7, FT_WINUP = 0x8,
+                  FT_CONT = 0x9;
+constexpr uint8_t FL_END_STREAM = 0x1, FL_END_HEADERS = 0x4, FL_ACK = 0x1,
+                  FL_PADDED = 0x8, FL_PRIORITY = 0x20;
+
+const char kPreface[] = "PRI * HTTP/2.0\r\n\r\nSM\r\n\r\n";
+constexpr size_t kPrefaceLen = 24;
+
+void frame_header(std::string* out, uint32_t len, uint8_t type, uint8_t flags,
+                  uint32_t sid) {
+  out->push_back((char)((len >> 16) & 0xff));
+  out->push_back((char)((len >> 8) & 0xff));
+  out->push_back((char)(len & 0xff));
+  out->push_back((char)type);
+  out->push_back((char)flags);
+  out->push_back((char)((sid >> 24) & 0x7f));
+  out->push_back((char)((sid >> 16) & 0xff));
+  out->push_back((char)((sid >> 8) & 0xff));
+  out->push_back((char)(sid & 0xff));
+}
+
+void u32be(std::string* out, uint32_t v) {
+  out->push_back((char)((v >> 24) & 0xff));
+  out->push_back((char)((v >> 16) & 0xff));
+  out->push_back((char)((v >> 8) & 0xff));
+  out->push_back((char)(v & 0xff));
+}
+
+}  // namespace
+
+// ---------------------------------------------------------------------------
+// Conn implementation
+// ---------------------------------------------------------------------------
+
+struct Stream {
+  std::string header_block;
+  bool headers_done = false;
+  std::string path;
+  std::string data;         // gRPC frames as received
+  int64_t send_window = 65535;
+  // response bytes blocked on flow control
+  std::string pending_data;     // gRPC DATA payload not yet framed out
+  std::string pending_trailers; // HEADERS(trailers) frame bytes
+  bool responded = false;
+};
+
+struct ConnImpl {
+  bool preface_done = false;
+  Hpack hpack;
+  std::map<uint32_t, Stream> streams;
+  int64_t conn_send_window = 65535;
+  int64_t peer_initial_window = 65535;
+  uint32_t peer_max_frame = 16384;
+  uint32_t cont_stream = 0;  // nonzero: expecting CONTINUATION
+  uint8_t cont_flags = 0;
+  bool goaway = false;
+
+  bool on_bytes(std::string* in, std::string* out,
+                std::vector<GrpcRequest>* reqs);
+  void flush_stream(uint32_t sid, Stream* s, std::string* out);
+  void send_response(uint32_t sid, const std::string& proto, int gstatus,
+                     const std::string& gmsg, std::string* out);
+  void finish_headers(uint32_t sid, uint8_t flags, std::string* out,
+                      std::vector<GrpcRequest>* reqs);
+  void complete_request(uint32_t sid, Stream* s, std::string* out,
+                        std::vector<GrpcRequest>* reqs);
+};
+
+bool ConnImpl::on_bytes(std::string* in, std::string* out,
+                        std::vector<GrpcRequest>* reqs) {
+  if (!preface_done) {
+    if (in->size() < kPrefaceLen) return true;  // wait
+    if (memcmp(in->data(), kPreface, kPrefaceLen) != 0) return false;
+    in->erase(0, kPrefaceLen);
+    preface_done = true;
+    // our SETTINGS: big stream windows + big frames so multi-MB tensor
+    // requests stream without round trips
+    frame_header(out, 12, FT_SETTINGS, 0, 0);
+    out->push_back(0); out->push_back(4);          // INITIAL_WINDOW_SIZE
+    u32be(out, 0x7fffffff);
+    out->push_back(0); out->push_back(5);          // MAX_FRAME_SIZE
+    u32be(out, 1u << 20);
+    // connection window: top up to max
+    frame_header(out, 4, FT_WINUP, 0, 0);
+    u32be(out, 0x7fffffff - 65535);
+  }
+  while (in->size() >= 9) {
+    const uint8_t* p = (const uint8_t*)in->data();
+    uint32_t len = ((uint32_t)p[0] << 16) | ((uint32_t)p[1] << 8) | p[2];
+    uint8_t type = p[3], flags = p[4];
+    uint32_t sid = (((uint32_t)p[5] & 0x7f) << 24) | ((uint32_t)p[6] << 16) |
+                   ((uint32_t)p[7] << 8) | p[8];
+    if (len > (1u << 20) + 1024) return false;  // over our advertised max
+    if (in->size() < 9 + (size_t)len) return true;  // wait for payload
+    const uint8_t* pl = p + 9;
+
+    if (cont_stream != 0 && type != FT_CONT) return false;
+
+    switch (type) {
+      case FT_SETTINGS: {
+        if (!(flags & FL_ACK)) {
+          for (uint32_t off = 0; off + 6 <= len; off += 6) {
+            uint16_t id = ((uint16_t)pl[off] << 8) | pl[off + 1];
+            uint32_t val = ((uint32_t)pl[off + 2] << 24) |
+                           ((uint32_t)pl[off + 3] << 16) |
+                           ((uint32_t)pl[off + 4] << 8) | pl[off + 5];
+            if (id == 4) {  // INITIAL_WINDOW_SIZE
+              int64_t delta = (int64_t)val - peer_initial_window;
+              peer_initial_window = val;
+              for (auto& kv : streams) kv.second.send_window += delta;
+            } else if (id == 5) {
+              if (val >= 16384 && val <= 16777215) peer_max_frame = val;
+            }
+          }
+          frame_header(out, 0, FT_SETTINGS, FL_ACK, 0);
+        }
+        break;
+      }
+      case FT_PING: {
+        if (!(flags & FL_ACK) && len == 8) {
+          frame_header(out, 8, FT_PING, FL_ACK, 0);
+          out->append((const char*)pl, 8);
+        }
+        break;
+      }
+      case FT_WINUP: {
+        if (len == 4) {
+          uint32_t inc = (((uint32_t)pl[0] & 0x7f) << 24) |
+                         ((uint32_t)pl[1] << 16) | ((uint32_t)pl[2] << 8) |
+                         pl[3];
+          if (sid == 0) {
+            conn_send_window += inc;
+          } else {
+            auto it = streams.find(sid);
+            if (it != streams.end()) it->second.send_window += inc;
+          }
+          for (auto it = streams.begin(); it != streams.end();) {
+            uint32_t id = it->first;
+            Stream* s = &it->second;
+            ++it;  // flush may erase
+            flush_stream(id, s, out);
+          }
+        }
+        break;
+      }
+      case FT_HEADERS: {
+        if (sid == 0) return false;
+        size_t off = 0, end = len;
+        if (flags & FL_PADDED) {
+          if (len < 1) return false;
+          uint8_t pad = pl[0];
+          off = 1;
+          if (pad >= end - off) return false;
+          end -= pad;
+        }
+        if (flags & FL_PRIORITY) {
+          if (end - off < 5) return false;
+          off += 5;
+        }
+        Stream& s = streams[sid];
+        if (s.send_window == 65535) s.send_window = peer_initial_window;
+        s.header_block.append((const char*)(pl + off), end - off);
+        if (flags & FL_END_HEADERS) {
+          finish_headers(sid, flags, out, reqs);
+        } else {
+          cont_stream = sid;
+          cont_flags = flags;
+        }
+        break;
+      }
+      case FT_CONT: {
+        if (sid == 0 || sid != cont_stream) return false;
+        Stream& s = streams[sid];
+        s.header_block.append((const char*)pl, len);
+        if (flags & FL_END_HEADERS) {
+          uint8_t first_flags = cont_flags;
+          cont_stream = 0;
+          finish_headers(sid, first_flags | FL_END_HEADERS, out, reqs);
+        }
+        break;
+      }
+      case FT_DATA: {
+        if (sid == 0) return false;
+        auto it = streams.find(sid);
+        size_t off = 0, end = len;
+        if (flags & FL_PADDED) {
+          if (len < 1) return false;
+          uint8_t pad = pl[0];
+          off = 1;
+          if (pad >= end - off) return false;
+          end -= pad;
+        }
+        if (it != streams.end() && !it->second.responded) {
+          if (it->second.data.size() + (end - off) > (1u << 29)) return false;
+          it->second.data.append((const char*)(pl + off), end - off);
+        }
+        // credit receive windows back immediately (conn + stream)
+        if (len > 0) {
+          frame_header(out, 4, FT_WINUP, 0, 0);
+          u32be(out, len);
+          frame_header(out, 4, FT_WINUP, 0, sid);
+          u32be(out, len);
+        }
+        if ((flags & FL_END_STREAM) && it != streams.end()) {
+          complete_request(sid, &it->second, out, reqs);
+        }
+        break;
+      }
+      case FT_RST: {
+        streams.erase(sid);
+        break;
+      }
+      case FT_GOAWAY: {
+        goaway = true;
+        break;
+      }
+      case FT_PUSH:
+        return false;  // clients must not push
+      case FT_PRIORITY:
+      default:
+        break;  // ignore
+    }
+    in->erase(0, 9 + (size_t)len);
+  }
+  return true;
+}
+
+void ConnImpl::finish_headers(uint32_t sid, uint8_t flags, std::string* out,
+                              std::vector<GrpcRequest>* reqs) {
+  Stream& s = streams[sid];
+  std::vector<std::pair<std::string, std::string>> hdrs;
+  bool ok = hpack_decode(&hpack, s.header_block, &hdrs);
+  s.header_block.clear();
+  if (!ok) {
+    // refuse the stream cleanly (decode uses the ASCII Huffman subset)
+    frame_header(out, 4, FT_RST, 0, sid);
+    u32be(out, 0x9);  // COMPRESSION_ERROR... per-stream refusal
+    streams.erase(sid);
+    return;
+  }
+  if (!s.headers_done) {
+    for (auto& kv : hdrs) {
+      if (kv.first == ":path") s.path = kv.second;
+    }
+    s.headers_done = true;
+  }
+  if (flags & FL_END_STREAM) complete_request(sid, &s, out, reqs);
+}
+
+void ConnImpl::complete_request(uint32_t sid, Stream* s, std::string* out,
+                                std::vector<GrpcRequest>* reqs) {
+  if (s->responded) return;
+  GrpcRequest r;
+  r.stream_id = sid;
+  r.path = s->path;
+  // gRPC framing: 1-byte compressed flag + 4-byte length + message
+  const std::string& d = s->data;
+  if (d.size() >= 5 && d[0] == 0) {
+    uint32_t mlen = ((uint32_t)(uint8_t)d[1] << 24) |
+                    ((uint32_t)(uint8_t)d[2] << 16) |
+                    ((uint32_t)(uint8_t)d[3] << 8) | (uint8_t)d[4];
+    if (5 + (size_t)mlen <= d.size()) r.message = d.substr(5, mlen);
+  }
+  s->data.clear();
+  reqs->push_back(std::move(r));
+  (void)out;
+}
+
+void ConnImpl::flush_stream(uint32_t sid, Stream* s, std::string* out) {
+  // DATA first, then trailers; bounded by both windows + frame size
+  while (!s->pending_data.empty()) {
+    int64_t allow = conn_send_window;
+    if (s->send_window < allow) allow = s->send_window;
+    if ((int64_t)peer_max_frame < allow) allow = peer_max_frame;
+    if (allow <= 0) return;
+    size_t chunk = (size_t)allow < s->pending_data.size()
+                       ? (size_t)allow
+                       : s->pending_data.size();
+    frame_header(out, (uint32_t)chunk, FT_DATA, 0, sid);
+    out->append(s->pending_data, 0, chunk);
+    s->pending_data.erase(0, chunk);
+    conn_send_window -= chunk;
+    s->send_window -= chunk;
+  }
+  if (!s->pending_trailers.empty()) {
+    out->append(s->pending_trailers);
+    s->pending_trailers.clear();
+    streams.erase(sid);
+  }
+}
+
+void ConnImpl::send_response(uint32_t sid, const std::string& proto,
+                             int gstatus, const std::string& gmsg,
+                             std::string* out) {
+  auto it = streams.find(sid);
+  if (it == streams.end()) return;  // client reset it meanwhile
+  Stream* s = &it->second;
+  if (s->responded) return;
+  s->responded = true;
+
+  // response HEADERS: :status 200 (static idx 8) + content-type
+  std::string hb;
+  hb.push_back((char)0x88);
+  emit_never_indexed(&hb, "content-type", "application/grpc");
+  frame_header(out, (uint32_t)hb.size(), FT_HEADERS, FL_END_HEADERS, sid);
+  out->append(hb);
+
+  if (gstatus == 0) {
+    std::string payload;
+    payload.push_back(0);  // uncompressed
+    u32be(&payload, (uint32_t)proto.size());
+    payload.append(proto);
+    s->pending_data = std::move(payload);
+  }
+
+  std::string tb;
+  emit_never_indexed(&tb, "grpc-status", std::to_string(gstatus));
+  if (!gmsg.empty()) emit_never_indexed(&tb, "grpc-message", gmsg);
+  std::string tf;
+  frame_header(&tf, (uint32_t)tb.size(), FT_HEADERS,
+               FL_END_HEADERS | FL_END_STREAM, sid);
+  tf.append(tb);
+  s->pending_trailers = std::move(tf);
+
+  flush_stream(sid, s, out);
+}
+
+// ---------------------------------------------------------------------------
+// public API
+// ---------------------------------------------------------------------------
+
+Conn::Conn() : impl_(new ConnImpl()) {}
+Conn::~Conn() { delete (ConnImpl*)impl_; }
+
+bool Conn::on_bytes(std::string* in, std::string* out,
+                    std::vector<GrpcRequest>* reqs) {
+  return ((ConnImpl*)impl_)->on_bytes(in, out, reqs);
+}
+
+void Conn::send_response(uint32_t stream_id, const std::string& proto_bytes,
+                         int grpc_status, const std::string& grpc_message,
+                         std::string* out) {
+  ((ConnImpl*)impl_)->send_response(stream_id, proto_bytes, grpc_status,
+                                    grpc_message, out);
+}
+
+bool Conn::has_blocked() const {
+  for (auto& kv : ((ConnImpl*)impl_)->streams) {
+    if (!kv.second.pending_data.empty() || !kv.second.pending_trailers.empty())
+      return true;
+  }
+  return false;
+}
+
+bool is_h2_preface(const std::string& in, bool* maybe) {
+  size_t n = in.size() < kPrefaceLen ? in.size() : kPrefaceLen;
+  if (memcmp(in.data(), kPreface, n) != 0) {
+    *maybe = false;
+    return false;
+  }
+  *maybe = in.size() < kPrefaceLen;
+  return in.size() >= kPrefaceLen;
+}
+
+// ---------------------------------------------------------------------------
+// SeldonMessage proto codec (manual wire format)
+// ---------------------------------------------------------------------------
+
+namespace {
+
+struct Cursor {
+  const uint8_t* p;
+  size_t n, pos = 0;
+  bool ok = true;
+
+  uint64_t varint() {
+    uint64_t v = 0;
+    int shift = 0;
+    while (pos < n && shift <= 63) {
+      uint8_t b = p[pos++];
+      v |= (uint64_t)(b & 0x7f) << shift;
+      if (!(b & 0x80)) return v;
+      shift += 7;
+    }
+    ok = false;
+    return 0;
+  }
+  bool skip(uint8_t wire) {
+    switch (wire) {
+      case 0: varint(); return ok;
+      case 1: if (pos + 8 > n) return ok = false; pos += 8; return true;
+      case 2: {
+        uint64_t len = varint();
+        if (!ok || pos + len > n) return ok = false;
+        pos += len;
+        return true;
+      }
+      case 5: if (pos + 4 > n) return ok = false; pos += 4; return true;
+      default: return ok = false;
+    }
+  }
+};
+
+}  // namespace
+
+bool parse_predict_request(const std::string& msg, ParsedPredict* out) {
+  Cursor c{(const uint8_t*)msg.data(), msg.size()};
+  std::string data_field, meta_field;
+  while (c.pos < c.n && c.ok) {
+    uint64_t tag = c.varint();
+    if (!c.ok) break;
+    uint32_t field = (uint32_t)(tag >> 3);
+    uint8_t wire = tag & 7;
+    if (field == 3 && wire == 2) {  // DefaultData data
+      uint64_t len = c.varint();
+      if (!c.ok || c.pos + len > c.n) return false;
+      data_field.assign((const char*)c.p + c.pos, (size_t)len);
+      c.pos += len;
+    } else if (field == 2 && wire == 2) {  // Meta
+      uint64_t len = c.varint();
+      if (!c.ok || c.pos + len > c.n) return false;
+      meta_field.assign((const char*)c.p + c.pos, (size_t)len);
+      c.pos += len;
+    } else if (!c.skip(wire)) {
+      return false;
+    }
+  }
+  if (!c.ok || data_field.empty()) return false;
+
+  if (!meta_field.empty()) {  // Meta.puid = field 1
+    Cursor m{(const uint8_t*)meta_field.data(), meta_field.size()};
+    while (m.pos < m.n && m.ok) {
+      uint64_t tag = m.varint();
+      if (!m.ok) break;
+      if ((tag >> 3) == 1 && (tag & 7) == 2) {
+        uint64_t len = m.varint();
+        if (!m.ok || m.pos + len > m.n) break;
+        out->puid.assign((const char*)m.p + m.pos, (size_t)len);
+        m.pos += len;
+      } else if (!m.skip(tag & 7)) {
+        break;
+      }
+    }
+  }
+
+  // DefaultData: tensor=2, rawTensor=5
+  Cursor d{(const uint8_t*)data_field.data(), data_field.size()};
+  std::string tensor_field, raw_field;
+  while (d.pos < d.n && d.ok) {
+    uint64_t tag = d.varint();
+    if (!d.ok) break;
+    uint32_t field = (uint32_t)(tag >> 3);
+    uint8_t wire = tag & 7;
+    if ((field == 2 || field == 5) && wire == 2) {
+      uint64_t len = d.varint();
+      if (!d.ok || d.pos + len > d.n) return false;
+      std::string* dst = field == 2 ? &tensor_field : &raw_field;
+      dst->assign((const char*)d.p + d.pos, (size_t)len);
+      d.pos += len;
+    } else if (!d.skip(wire)) {
+      return false;
+    }
+  }
+
+  if (!raw_field.empty()) {
+    // RawTensor: shape=1 (packed i64), dtype=2 (string), data=3 (bytes)
+    Cursor r{(const uint8_t*)raw_field.data(), raw_field.size()};
+    std::vector<int64_t> shape;
+    std::string dtype;
+    const uint8_t* bytes = nullptr;
+    size_t bytes_len = 0;
+    while (r.pos < r.n && r.ok) {
+      uint64_t tag = r.varint();
+      if (!r.ok) break;
+      uint32_t field = (uint32_t)(tag >> 3);
+      uint8_t wire = tag & 7;
+      if (field == 1 && wire == 2) {  // packed shape
+        uint64_t len = r.varint();
+        if (!r.ok || r.pos + len > r.n) return false;
+        size_t end = r.pos + (size_t)len;
+        while (r.pos < end && r.ok) shape.push_back((int64_t)r.varint());
+      } else if (field == 1 && wire == 0) {  // unpacked entry
+        shape.push_back((int64_t)r.varint());
+      } else if (field == 2 && wire == 2) {
+        uint64_t len = r.varint();
+        if (!r.ok || r.pos + len > r.n) return false;
+        dtype.assign((const char*)r.p + r.pos, (size_t)len);
+        r.pos += len;
+      } else if (field == 3 && wire == 2) {
+        uint64_t len = r.varint();
+        if (!r.ok || r.pos + len > r.n) return false;
+        bytes = r.p + r.pos;
+        bytes_len = (size_t)len;
+        r.pos += len;
+      } else if (!r.skip(wire)) {
+        return false;
+      }
+    }
+    if (shape.size() != 2 || shape[0] < 1 || shape[1] < 1 || !bytes)
+      return false;
+    int64_t elems = shape[0] * shape[1];
+    if (shape[0] > (int64_t)(1u << 31) / shape[1]) return false;
+    out->rows = shape[0];
+    out->cols = shape[1];
+    out->was_raw = true;
+    if (dtype == "uint8") {
+      if ((int64_t)bytes_len != elems) return false;
+      out->dtype = 1;
+      out->features.assign(bytes, bytes + bytes_len);
+      return true;
+    }
+    out->dtype = 0;
+    out->features.resize((size_t)elems * 4);
+    float* dst = (float*)out->features.data();
+    if (dtype == "float32") {
+      if ((int64_t)bytes_len != elems * 4) return false;
+      memcpy(dst, bytes, bytes_len);
+    } else if (dtype == "float64") {
+      if ((int64_t)bytes_len != elems * 8) return false;
+      for (int64_t i = 0; i < elems; i++) {
+        double v;
+        memcpy(&v, bytes + i * 8, 8);
+        dst[i] = (float)v;
+      }
+    } else if (dtype == "int32") {
+      if ((int64_t)bytes_len != elems * 4) return false;
+      for (int64_t i = 0; i < elems; i++) {
+        int32_t v;
+        memcpy(&v, bytes + i * 4, 4);
+        dst[i] = (float)v;
+      }
+    } else {
+      return false;
+    }
+    return true;
+  }
+
+  if (!tensor_field.empty()) {
+    // Tensor: shape=1 (packed i32), values=2 (packed f64)
+    Cursor t{(const uint8_t*)tensor_field.data(), tensor_field.size()};
+    std::vector<int64_t> shape;
+    const uint8_t* vals = nullptr;
+    size_t vals_len = 0;
+    while (t.pos < t.n && t.ok) {
+      uint64_t tag = t.varint();
+      if (!t.ok) break;
+      uint32_t field = (uint32_t)(tag >> 3);
+      uint8_t wire = tag & 7;
+      if (field == 1 && wire == 2) {
+        uint64_t len = t.varint();
+        if (!t.ok || t.pos + len > t.n) return false;
+        size_t end = t.pos + (size_t)len;
+        while (t.pos < end && t.ok) shape.push_back((int64_t)t.varint());
+      } else if (field == 1 && wire == 0) {
+        shape.push_back((int64_t)t.varint());
+      } else if (field == 2 && wire == 2) {
+        uint64_t len = t.varint();
+        if (!t.ok || t.pos + len > t.n) return false;
+        vals = t.p + t.pos;
+        vals_len = (size_t)len;
+        t.pos += len;
+      } else if (!t.skip(wire)) {
+        return false;
+      }
+    }
+    if (shape.size() != 2 || shape[0] < 1 || shape[1] < 1 || !vals)
+      return false;
+    int64_t elems = shape[0] * shape[1];
+    if (shape[0] > (int64_t)(1u << 31) / shape[1]) return false;
+    if ((int64_t)vals_len != elems * 8) return false;
+    out->rows = shape[0];
+    out->cols = shape[1];
+    out->dtype = 0;
+    out->was_raw = false;
+    out->features.resize((size_t)elems * 4);
+    float* dst = (float*)out->features.data();
+    for (int64_t i = 0; i < elems; i++) {
+      double v;
+      memcpy(&v, vals + i * 8, 8);
+      dst[i] = (float)v;
+    }
+    return true;
+  }
+  return false;
+}
+
+namespace {
+
+void emit_tag(std::string* out, uint32_t field, uint8_t wire) {
+  uint64_t tag = ((uint64_t)field << 3) | wire;
+  while (tag >= 128) {
+    out->push_back((char)(0x80 | (tag & 0x7f)));
+    tag >>= 7;
+  }
+  out->push_back((char)tag);
+}
+
+void emit_varint(std::string* out, uint64_t v) {
+  while (v >= 128) {
+    out->push_back((char)(0x80 | (v & 0x7f)));
+    v >>= 7;
+  }
+  out->push_back((char)v);
+}
+
+void emit_len_delim(std::string* out, uint32_t field, const std::string& bytes) {
+  emit_tag(out, field, 2);
+  emit_varint(out, bytes.size());
+  out->append(bytes);
+}
+
+}  // namespace
+
+std::string build_predict_response(const float* out, int64_t rows,
+                                   int64_t cols, const std::string& puid,
+                                   const std::string& model_name,
+                                   const std::vector<std::string>& names,
+                                   bool mirror_raw) {
+  // Meta { puid=1, requestPath=4 map<string,string> }
+  std::string meta;
+  emit_len_delim(&meta, 1, puid);
+  std::string entry;
+  emit_len_delim(&entry, 1, model_name);
+  emit_len_delim(&entry, 2, "native");
+  emit_len_delim(&meta, 4, entry);
+
+  // DefaultData { names=1 repeated, tensor=2 | rawTensor=5 }
+  std::string data;
+  for (auto& nm : names) emit_len_delim(&data, 1, nm);
+  if (mirror_raw) {
+    std::string raw;
+    std::string shape;
+    emit_varint(&shape, (uint64_t)rows);
+    emit_varint(&shape, (uint64_t)cols);
+    emit_len_delim(&raw, 1, shape);
+    emit_len_delim(&raw, 2, "float32");
+    std::string bytes((const char*)out, (size_t)(rows * cols) * 4);
+    emit_len_delim(&raw, 3, bytes);
+    emit_len_delim(&data, 5, raw);
+  } else {
+    std::string tensor;
+    std::string shape;
+    emit_varint(&shape, (uint64_t)rows);
+    emit_varint(&shape, (uint64_t)cols);
+    emit_len_delim(&tensor, 1, shape);
+    std::string vals;
+    vals.resize((size_t)(rows * cols) * 8);
+    for (int64_t i = 0; i < rows * cols; i++) {
+      double v = out[i];
+      memcpy(&vals[(size_t)i * 8], &v, 8);
+    }
+    emit_len_delim(&tensor, 2, vals);
+    emit_len_delim(&data, 2, tensor);
+  }
+
+  std::string msg;
+  emit_len_delim(&msg, 2, meta);
+  emit_len_delim(&msg, 3, data);
+  return msg;
+}
+
+// test hook, exported with C linkage for ctypes round-trip tests
+extern "C" {
+int32_t h2_huff_selftest() {
+  // spot values from the published RFC 7541 table
+  const HuffTables& t = huff();
+  struct Spot { char sym; uint32_t code; uint8_t len; };
+  const Spot spots[] = {
+      {'0', 0x0, 5},  {'a', 0x3, 5},  {' ', 0x14, 6}, {':', 0x5c, 7},
+      {'z', 0x7b, 7}, {'&', 0xf8, 8}, {'Z', 0xfd, 8}, {'!', 0x3f8, 10},
+      {'?', 0x3fc, 10}, {'\'', 0x7fa, 11}, {'#', 0xffa, 12},
+      {'$', 0x1ff9, 13}, {'^', 0x3ffc, 14}, {'<', 0x7ffc, 15},
+  };
+  for (auto& s : spots) {
+    int idx = s.sym - 32;
+    if (t.enc_len[idx] != s.len || t.enc_code[idx] != s.code) return 1;
+  }
+  std::string enc, dec;
+  if (!huff_encode("/seldon.protos.Seldon/Predict", &enc)) return 2;
+  if (!huff_decode((const uint8_t*)enc.data(), enc.size(), &dec)) return 3;
+  return dec == "/seldon.protos.Seldon/Predict" ? 0 : 4;
+}
+}
+
+}  // namespace h2

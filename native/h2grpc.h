@@ -1,0 +1,88 @@
+// Minimal HTTP/2 (h2c prior-knowledge) + gRPC unary framing for the
+// native front server — the native lane for the actual contract
+// surface (reference: the Java engine serves gRPC natively,
+// SeldonGrpcServer.java:30-60; here the C++ ingress does).
+//
+// Scope (by design, documented):
+//   * h2c with the client connection preface (what an insecure gRPC
+//     channel speaks) — no TLS/ALPN, matching the plaintext HTTP lane.
+//   * unary request/response streams; flow control honoured both ways.
+//   * HPACK: full static table, dynamic table, integer + string
+//     decoding.  Huffman decoding covers the printable-ASCII portion
+//     of the RFC 7541 table (gRPC metadata is ASCII); a header block
+//     using codes outside it is refused cleanly (RST_STREAM).
+//   * responses use literal never-indexed HPACK (stateless encode).
+#pragma once
+
+#include <cstdint>
+#include <map>
+#include <memory>
+#include <string>
+#include <vector>
+
+namespace h2 {
+
+// A complete unary gRPC request (END_STREAM seen, frames assembled).
+struct GrpcRequest {
+  uint32_t stream_id = 0;
+  std::string path;          // ":path", e.g. /seldon.protos.Seldon/Predict
+  std::string message;       // protobuf payload (gRPC 5-byte frame stripped)
+};
+
+struct Stream;
+
+class Conn {
+ public:
+  Conn();
+  ~Conn();
+
+  // Consume bytes from `in` (erasing what was processed), append any
+  // protocol output to `out`, push completed requests to `reqs`.
+  // Returns false on a fatal connection error — caller closes.
+  bool on_bytes(std::string* in, std::string* out, std::vector<GrpcRequest>* reqs);
+
+  // Queue a unary response on `stream_id` and flush what flow control
+  // allows into `out`.  grpc_status != 0 sends error trailers only.
+  void send_response(uint32_t stream_id, const std::string& proto_bytes,
+                     int grpc_status, const std::string& grpc_message,
+                     std::string* out);
+
+  // Streams with queued response bytes blocked on peer flow control.
+  bool has_blocked() const;
+
+ private:
+  friend struct ConnImpl;
+  void* impl_;
+};
+
+// True when `in` holds enough bytes to identify the HTTP/2 client
+// preface (and they match).  `maybe` reports "could still become one".
+bool is_h2_preface(const std::string& in, bool* maybe);
+
+// --- minimal SeldonMessage proto codec (wire format, no protobuf lib) ---
+//
+// Parse a seldon.protos.SeldonMessage: extracts the numeric payload as
+// (rows, cols, dtype 0=f32 1=u8) plus raw bytes, and the request puid.
+// Accepts data.rawTensor (uint8/float32/float64/int32 — converted to
+// f32 unless uint8) and data.tensor (f64 -> f32).  2-D shapes only
+// (the fast-lane contract).  Returns false when the message carries no
+// fast-lane-expressible payload.
+struct ParsedPredict {
+  int64_t rows = 0, cols = 0;
+  int dtype = 0;                  // 0=f32 1=u8 (fast-lane codes)
+  std::vector<uint8_t> features;  // rows*cols elements of dtype
+  std::string puid;
+  bool was_raw = false;           // request used rawTensor (mirror it)
+};
+bool parse_predict_request(const std::string& msg, ParsedPredict* out);
+
+// Build a response SeldonMessage: status SUCCESS, meta.puid,
+// meta.requestPath[model_name]="native", data as rawTensor f32 (when
+// mirror_raw) or packed Tensor f64.
+std::string build_predict_response(const float* out, int64_t rows, int64_t cols,
+                                   const std::string& puid,
+                                   const std::string& model_name,
+                                   const std::vector<std::string>& names,
+                                   bool mirror_raw);
+
+}  // namespace h2

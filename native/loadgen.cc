@@ -302,4 +302,291 @@ int64_t lg_run(const uint8_t* payload, int64_t payload_len, int32_t port,
   return ok;
 }
 
+// ---------------------------------------------------------------------------
+// h2c gRPC closed-loop load (paired with frontserver.cc's h2 lane)
+// ---------------------------------------------------------------------------
+//
+// A benchmark client for THIS server, not a general HTTP/2 client: it
+// relies on the server's large advertised windows and its per-DATA
+// window crediting (so the client does no send-side flow-control
+// bookkeeping), and it recognises trailers by the server's raw
+// never-indexed HPACK encoding of grpc-status.  Per request it sends
+// HEADERS (caller-built HPACK block) + DATA (caller-built gRPC frame)
+// on odd stream ids, `depth` streams in flight per connection.
+
+namespace {
+
+struct H2LoadConn {
+  int fd = -1;
+  bool connected = false;
+  bool dead = false;
+  bool preamble_sent = false;
+  int32_t in_flight = 0;
+  int64_t to_send = 0;
+  uint32_t next_stream = 1;
+  std::string outbuf;
+  size_t out_off = 0;
+  std::string inbuf;
+};
+
+void h2_frame_header(std::string* out, uint32_t len, uint8_t type,
+                     uint8_t flags, uint32_t sid) {
+  out->push_back((char)((len >> 16) & 0xff));
+  out->push_back((char)((len >> 8) & 0xff));
+  out->push_back((char)(len & 0xff));
+  out->push_back((char)type);
+  out->push_back((char)flags);
+  out->push_back((char)((sid >> 24) & 0x7f));
+  out->push_back((char)((sid >> 16) & 0xff));
+  out->push_back((char)((sid >> 8) & 0xff));
+  out->push_back((char)(sid & 0xff));
+}
+
+void h2_append_request(std::string* out, const uint8_t* hdr_block,
+                       int64_t hdr_len, const uint8_t* data, int64_t data_len,
+                       uint32_t sid) {
+  h2_frame_header(out, (uint32_t)hdr_len, 0x1 /*HEADERS*/,
+                  0x4 /*END_HEADERS*/, sid);
+  out->append((const char*)hdr_block, (size_t)hdr_len);
+  // server advertises 1 MB max frame; chunk DATA accordingly
+  const int64_t kChunk = 1 << 20;
+  int64_t off = 0;
+  do {
+    int64_t n = data_len - off < kChunk ? data_len - off : kChunk;
+    bool last = off + n >= data_len;
+    h2_frame_header(out, (uint32_t)n, 0x0 /*DATA*/,
+                    last ? 0x1 /*END_STREAM*/ : 0, sid);
+    out->append((const char*)data + off, (size_t)n);
+    off += n;
+  } while (off < data_len);
+}
+
+// returns 1 trailers-ok, 2 trailers-error, 0 not a completion
+int h2_classify_frame(uint8_t type, uint8_t flags, const char* payload,
+                      uint32_t len) {
+  if (type == 0x3 /*RST*/) return 2;
+  if (type != 0x1 /*HEADERS*/ || !(flags & 0x1 /*END_STREAM*/)) return 0;
+  // server encodes trailers as raw never-indexed literals:
+  // 0x10 len("grpc-status") "grpc-status" len(v) v
+  static const char kKey[] = "grpc-status";
+  for (uint32_t i = 0; i + sizeof(kKey) - 1 + 2 <= len; i++) {
+    if (memcmp(payload + i, kKey, sizeof(kKey) - 1) == 0) {
+      uint32_t vpos = i + sizeof(kKey) - 1;
+      if (vpos + 1 < len) {
+        uint8_t vlen = (uint8_t)payload[vpos];
+        if (vlen >= 1 && vpos + 1 + vlen <= len)
+          return (vlen == 1 && payload[vpos + 1] == '0') ? 1 : 2;
+      }
+    }
+  }
+  return 2;  // trailers without a readable grpc-status: count as error
+}
+
+}  // namespace
+
+int64_t lg_run_h2(const uint8_t* hdr_block, int64_t hdr_len,
+                  const uint8_t* data, int64_t data_len, int32_t port,
+                  double seconds, int32_t connections, int32_t depth,
+                  int64_t* non2xx_out, int64_t* errors_out) {
+  int64_t ok = 0, bad = 0, errors = 0;
+  if (hdr_len <= 0 || data_len < 0 || connections <= 0 || depth <= 0 ||
+      seconds <= 0) {
+    if (non2xx_out) *non2xx_out = 0;
+    if (errors_out) *errors_out = 1;
+    return 0;
+  }
+  int ep = epoll_create1(0);
+  if (ep < 0) {
+    if (errors_out) *errors_out = 1;
+    return 0;
+  }
+
+  // connection preamble: preface + SETTINGS(big windows) + conn window
+  std::string preamble = "PRI * HTTP/2.0\r\n\r\nSM\r\n\r\n";
+  h2_frame_header(&preamble, 6, 0x4 /*SETTINGS*/, 0, 0);
+  preamble.push_back(0); preamble.push_back(4);  // INITIAL_WINDOW_SIZE
+  preamble.push_back(0x7f); preamble.push_back((char)0xff);
+  preamble.push_back((char)0xff); preamble.push_back((char)0xff);
+  h2_frame_header(&preamble, 4, 0x8 /*WINDOW_UPDATE*/, 0, 0);
+  preamble.push_back(0x7f); preamble.push_back((char)0xff);
+  preamble.push_back((char)0xfe); preamble.push_back(0);
+
+  std::vector<H2LoadConn> conns((size_t)connections);
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons((uint16_t)port);
+  addr.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+
+  auto deadline = Clock::now() + std::chrono::duration<double>(seconds);
+  auto drain_deadline = deadline + std::chrono::milliseconds(250);
+
+  auto kill = [&](size_t i, bool as_error) {
+    if (conns[i].fd >= 0) {
+      epoll_ctl(ep, EPOLL_CTL_DEL, conns[i].fd, nullptr);
+      close(conns[i].fd);
+      conns[i].fd = -1;
+    }
+    if (!conns[i].dead && as_error) ++errors;
+    conns[i].dead = true;
+  };
+
+  size_t alive = 0;
+  for (size_t i = 0; i < conns.size(); ++i) {
+    H2LoadConn& c = conns[i];
+    c.fd = socket(AF_INET, SOCK_STREAM | SOCK_NONBLOCK, 0);
+    if (c.fd < 0) { c.dead = true; ++errors; continue; }
+    int one = 1;
+    setsockopt(c.fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    int rc = connect(c.fd, (sockaddr*)&addr, sizeof(addr));
+    if (rc < 0 && errno != EINPROGRESS) { kill(i, true); continue; }
+    c.connected = (rc == 0);
+    c.to_send = depth;
+    c.outbuf = preamble;
+    c.preamble_sent = true;
+    epoll_event ev{};
+    ev.events = EPOLLIN | EPOLLOUT;
+    ev.data.u64 = i;
+    epoll_ctl(ep, EPOLL_CTL_ADD, c.fd, &ev);
+    ++alive;
+  }
+
+  auto arm = [&](size_t i, uint32_t events) {
+    epoll_event ev{};
+    ev.events = events;
+    ev.data.u64 = i;
+    epoll_ctl(ep, EPOLL_CTL_MOD, conns[i].fd, &ev);
+  };
+
+  std::vector<epoll_event> events(conns.size() ? conns.size() : 1);
+  char rbuf[1 << 16];
+
+  while (alive > 0) {
+    auto now = Clock::now();
+    bool past_deadline = now >= deadline;
+    if (now >= drain_deadline) break;
+    if (past_deadline) {
+      for (size_t i = 0; i < conns.size(); ++i) {
+        if (!conns[i].dead && conns[i].fd >= 0 && conns[i].in_flight == 0) {
+          kill(i, false);
+          --alive;
+        }
+      }
+      if (alive == 0) break;
+    }
+    auto cap = past_deadline ? drain_deadline : deadline;
+    int timeout_ms = (int)std::chrono::duration_cast<std::chrono::milliseconds>(
+                         cap - now).count() + 1;
+    int n = epoll_wait(ep, events.data(), (int)events.size(), timeout_ms);
+    if (n < 0) {
+      if (errno == EINTR) continue;
+      break;
+    }
+    for (int e = 0; e < n; ++e) {
+      size_t i = (size_t)events[e].data.u64;
+      H2LoadConn& c = conns[i];
+      if (c.dead || c.fd < 0) continue;
+      bool hangup = (events[e].events & (EPOLLERR | EPOLLHUP)) != 0;
+      if (!c.connected && hangup) { kill(i, true); --alive; continue; }
+
+      if ((events[e].events & EPOLLOUT) && !hangup) {
+        if (!c.connected) {
+          int err = 0;
+          socklen_t len = sizeof(err);
+          getsockopt(c.fd, SOL_SOCKET, SO_ERROR, &err, &len);
+          if (err != 0) { kill(i, true); --alive; continue; }
+          c.connected = true;
+        }
+        // top up the out buffer with queued requests
+        while (!past_deadline && c.to_send > 0 &&
+               c.outbuf.size() - c.out_off < (4u << 20)) {
+          h2_append_request(&c.outbuf, hdr_block, hdr_len, data, data_len,
+                            c.next_stream);
+          c.next_stream += 2;
+          c.to_send--;
+          c.in_flight++;
+        }
+        bool stalled = false;
+        while (c.out_off < c.outbuf.size()) {
+          ssize_t w = send(c.fd, c.outbuf.data() + c.out_off,
+                           c.outbuf.size() - c.out_off, MSG_NOSIGNAL);
+          if (w < 0) {
+            if (errno == EAGAIN || errno == EWOULDBLOCK) { stalled = true; break; }
+            kill(i, true);
+            --alive;
+            break;
+          }
+          c.out_off += (size_t)w;
+        }
+        if (c.dead) continue;
+        if (c.out_off == c.outbuf.size()) {
+          c.outbuf.clear();
+          c.out_off = 0;
+        }
+        arm(i, (stalled || c.to_send > 0) ? (EPOLLIN | EPOLLOUT) : EPOLLIN);
+      }
+
+      if ((events[e].events & EPOLLIN) || hangup) {
+        bool peer_closed = hangup;
+        for (;;) {
+          ssize_t r = recv(c.fd, rbuf, sizeof(rbuf), 0);
+          if (r > 0) {
+            c.inbuf.append(rbuf, (size_t)r);
+            if (r < (ssize_t)sizeof(rbuf)) break;
+            continue;
+          }
+          if (r < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) break;
+          peer_closed = true;
+          break;
+        }
+        size_t pos = 0;
+        bool completed_any = false;
+        while (c.inbuf.size() - pos >= 9) {
+          const uint8_t* p = (const uint8_t*)c.inbuf.data() + pos;
+          uint32_t flen = ((uint32_t)p[0] << 16) | ((uint32_t)p[1] << 8) | p[2];
+          uint8_t type = p[3], flags = p[4];
+          if (c.inbuf.size() - pos < 9 + (size_t)flen) break;
+          if (type == 0x4 /*SETTINGS*/ && !(flags & 0x1)) {
+            h2_frame_header(&c.outbuf, 0, 0x4, 0x1 /*ACK*/, 0);
+          } else if (type == 0x6 /*PING*/ && !(flags & 0x1) && flen == 8) {
+            h2_frame_header(&c.outbuf, 8, 0x6, 0x1, 0);
+            c.outbuf.append((const char*)p + 9, 8);
+          } else if (type == 0x7 /*GOAWAY*/) {
+            peer_closed = true;
+          } else {
+            int cls = h2_classify_frame(type, flags,
+                                        c.inbuf.data() + pos + 9, flen);
+            if (cls != 0) {
+              c.in_flight--;
+              completed_any = true;
+              if (cls == 1) ++ok; else ++bad;
+              if (!past_deadline && !peer_closed) c.to_send++;
+            }
+          }
+          pos += 9 + flen;
+        }
+        if (pos > 0) c.inbuf.erase(0, pos);
+        if (peer_closed) {
+          kill(i, c.in_flight > 0);
+          --alive;
+          continue;
+        }
+        if (past_deadline && c.in_flight == 0) {
+          kill(i, false);
+          --alive;
+          continue;
+        }
+        if (completed_any || !c.outbuf.empty()) arm(i, EPOLLIN | EPOLLOUT);
+      }
+    }
+  }
+
+  for (size_t i = 0; i < conns.size(); ++i) {
+    if (conns[i].fd >= 0) close(conns[i].fd);
+  }
+  close(ep);
+  if (non2xx_out) *non2xx_out = bad;
+  if (errors_out) *errors_out = errors;
+  return ok;
+}
+
 }  // extern "C"

@@ -122,8 +122,8 @@ class DynamicBatcher:
         max_wait_ms: float = 2.0,
         buckets: Optional[Sequence[int]] = None,
         name: str = "batcher",
-        pipeline_depth: int = 8,
-        finisher_threads: int = 4,
+        pipeline_depth: int = 16,
+        finisher_threads: int = 12,
     ):
         self.predict_fn = predict_fn
         self.max_batch_size = max_batch_size
@@ -303,8 +303,8 @@ class MultiSignatureBatcher:
         max_wait_ms: float = 2.0,
         buckets: Optional[Sequence[int]] = None,
         name: str = "batcher",
-        pipeline_depth: int = 8,
-        finisher_threads: int = 2,
+        pipeline_depth: int = 16,
+        finisher_threads: int = 4,
         max_signatures: int = 16,
     ):
         self.predict_fn = predict_fn

@@ -163,6 +163,7 @@ async def serve_native_ingress(
     http_port: int = 8000,
     max_batch: Optional[int] = None,
     max_wait_ms: float = 1.0,
+    batch_threads: Optional[int] = None,
 ) -> NativeIngressHandle:
     """Start the C++ front server on ``http_port`` for ``gateway``.
 
@@ -171,10 +172,15 @@ async def serve_native_ingress(
     """
     from seldon_core_tpu.native.frontserver import NativeFrontServer
 
+    import os
+
     loop = asyncio.get_running_loop()
     handler = _DeploymentRawHandler(gateway, loop)
     lane = fast_lane_for(gateway)
-    kwargs = dict(port=http_port, raw_handler=handler, max_wait_ms=max_wait_ms, host=host)
+    if batch_threads is None:
+        batch_threads = int(os.environ.get("SELDON_TPU_NATIVE_BATCH_THREADS", "4"))
+    kwargs = dict(port=http_port, raw_handler=handler, max_wait_ms=max_wait_ms, host=host,
+                  batch_threads=batch_threads)
     if lane is not None:
         kwargs.update(
             model_fn=_live_model_fn(gateway, lane["feature_dim"], lane["out_dim"]),

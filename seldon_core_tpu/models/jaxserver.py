@@ -174,6 +174,8 @@ class JaxServer(TPUComponent):
         mesh: Optional[Any] = None,
         data_axis: str = "data",
         model_kwargs: Optional[Dict[str, Any]] = None,
+        pipeline_depth: int = 16,
+        finisher_threads: int = 12,
         **kwargs: Any,
     ):
         super().__init__(**kwargs)
@@ -224,6 +226,14 @@ class JaxServer(TPUComponent):
         self.mesh = mesh
         self.data_axis = data_axis
         self.model_kwargs = dict(model_kwargs or {})
+        # pipeline knobs: in-flight device batches and concurrent
+        # device->host readbacks.  Throughput through a high-latency
+        # host<->device link is depth x batch / RTT — on the relayed
+        # harness, 4 finishers measured 650 img/s and 12 measured
+        # ~3000 img/s for the same device work, so depth, not compute,
+        # sets serving capacity (see batching/batcher.py pipeline notes)
+        self.pipeline_depth = int(pipeline_depth)
+        self.finisher_threads = int(finisher_threads)
         self._loaded = False
         self.module = None
         self.variables = None
@@ -385,6 +395,8 @@ class JaxServer(TPUComponent):
             max_wait_ms=self.max_wait_ms,
             buckets=self.buckets,
             name=f"jaxserver-{self.model_name}",
+            pipeline_depth=self.pipeline_depth,
+            finisher_threads=self.finisher_threads,
         )
         self.batcher.start()
 
@@ -439,6 +451,14 @@ class JaxServer(TPUComponent):
         if tuple(arr.shape[1:]) not in accepted and tuple(arr.shape) in accepted:
             arr = arr[None]  # single example without batch dim
             squeeze = True
+        if tuple(arr.shape[1:]) not in accepted and arr.ndim == 2:
+            # flat rows [batch, prod(sig)]: the wire-efficient layout the
+            # native ingress fast lane speaks — reshape to the first
+            # matching signature (same rule as raw_batch_call)
+            for sig in accepted:
+                if arr.shape[1] == int(np.prod(sig)):
+                    arr = arr.reshape((arr.shape[0], *sig))
+                    break
         if tuple(arr.shape[1:]) not in accepted:
             shapes = " | ".join("(batch, " + ", ".join(map(str, s)) + ")" for s in accepted)
             raise MicroserviceError(
@@ -488,11 +508,13 @@ class JaxServer(TPUComponent):
 
         if not self._loaded:
             self.load()
-        arr = np.asarray(batch2d, np.float32).reshape((-1, *self.input_shape))
-        # same dtype canonicalisation as _prepare: only warmed dtypes
-        # may reach the device, or the call would trace mid-traffic
+        # dtype-preserving: a uint8 frame decoded in C++ reaches the
+        # device as uint8 (its program was warmed); only un-warmed
+        # dtypes canonicalise, or the call would trace mid-traffic
+        arr = np.asarray(batch2d)
         if arr.dtype.name not in self.warmup_dtypes:
             arr = arr.astype(np.dtype(self.warmup_dtypes[0]))
+        arr = arr.reshape((-1, *self.input_shape))
         out = np.asarray(self._predict_jit(self.variables, jnp.asarray(arr)))
         return out.reshape(out.shape[0], -1)
 

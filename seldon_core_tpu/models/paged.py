@@ -194,6 +194,7 @@ class _Stream:
     __slots__ = (
         "req_id", "prompt", "max_new", "temperature", "top_k", "eos_id",
         "seed", "tokens", "event", "result", "error", "slot", "pages",
+        "pending", "draft_hint",
     )
 
     def __init__(self, req_id, prompt, max_new, temperature, top_k, eos_id, seed):
@@ -210,6 +211,11 @@ class _Stream:
         self.error: Optional[Exception] = None
         self.slot: Optional[int] = None
         self.pages: List[int] = []
+        # speculative mode: the next greedy token (argmax of the last
+        # verified logits), decided on host between verify rounds
+        self.pending: Optional[int] = None
+        # draft='oracle' benchmarking lane: the expected continuation
+        self.draft_hint: Optional[np.ndarray] = None
 
 
 class PagedEngine:
@@ -244,6 +250,7 @@ class PagedEngine:
         model_axis: str = "model",
         shard_min_weight_size: int = 16_384,
         quantize: str = "",
+        speculative: Optional[Dict[str, Any]] = None,
     ):
         import jax
         import jax.numpy as jnp
@@ -318,10 +325,40 @@ class PagedEngine:
         # observability counters (exported by StreamingLM.metrics();
         # updated under _lock)
         self._counters = {"chunks": 0, "tokens": 0, "evictions": 0,
-                          "stalls": 0, "prefills": 0, "completed": 0}
+                          "stalls": 0, "prefills": 0, "completed": 0,
+                          "spec_drafted": 0, "spec_accepted": 0}
+
+        # speculative mode: per-slot draft/verify INSIDE the batched
+        # engine — each chunk is ONE verify forward of width draft_k+1
+        # per slot instead of steps_per_call sequential decode steps.
+        # Greedy bit-exactness per stream is preserved: every emitted
+        # token is the model's own argmax (drafts only decide how many
+        # argmaxes one forward confirms), so speculative and plain
+        # decode produce identical ids (asserted in tests).
+        self.speculative = dict(speculative) if speculative else None
+        if self.speculative is not None:
+            draft = self.speculative.setdefault("draft", "ngram")
+            if draft not in ("ngram", "oracle"):
+                # 'oracle' = caller-supplied continuation hints
+                # (submit(draft_hint=...)) — the acceptance-ceiling
+                # benchmarking lane; a draft-model lane lives in
+                # SpeculativeGenerator
+                raise ValueError(
+                    "PagedEngine speculative mode supports draft='ngram' "
+                    "or draft='oracle'"
+                )
+            self.speculative.setdefault("draft_k", 4)
+            self.speculative.setdefault("ngram", 2)
+            self.draft_k = int(self.speculative["draft_k"])
+            if self.draft_k < 1:
+                raise ValueError("speculative draft_k must be >= 1")
 
         self._prefill_jit: Dict[int, Any] = {}
         self._chunk = jax.jit(self._chunk_fn, donate_argnums=(1, 2))
+        self._spec_chunk = (
+            jax.jit(self._spec_chunk_fn, donate_argnums=(1, 2))
+            if self.speculative is not None else None
+        )
 
     # ---- jitted programs --------------------------------------------------
 
@@ -421,6 +458,46 @@ class PagedEngine:
         )
         return toks.T, pk, pv, logits, lengths, keys, done, emitted
 
+    def _spec_chunk_fn(self, params, pk, pv, segs, n_drafts, active,
+                       block_tables, lengths):
+        """One verify forward for every active slot.
+
+        ``segs[i]`` = [pending, d_1..d_k] (pads beyond ``n_drafts[i]``
+        are never accepted).  The forward writes K/V for ALL k+1
+        positions, but only ``accepted+1`` become visible — lengths
+        advance by exactly that and rejected entries are overwritten by
+        the next round (explicit lengths make rollback free, the same
+        discipline as SpeculativeGenerator single-stream).
+        """
+        jax, jnp = self._jax, self._jnp
+        params = self._materialize(params)
+        L = self.draft_k + 1
+        positions = lengths[:, None] + jnp.arange(L)[None, :]
+        logits, nk, nv = self.module.apply(
+            {"params": params}, segs,
+            jnp.minimum(positions, self.max_len - 1),
+            pk, pv, block_tables, lengths,
+        )
+        greedy = jnp.argmax(logits, axis=-1)  # (S, L)
+        match = (greedy[:, : L - 1] == segs[:, 1:]) & (
+            jnp.arange(L - 1)[None, :] < n_drafts[:, None]
+        )
+        accepted = jnp.cumprod(match.astype(jnp.int32), axis=1).sum(axis=1)
+        idx = jnp.arange(L)[None, :]
+        shifted = jnp.concatenate(
+            [segs[:, 1:], jnp.zeros((segs.shape[0], 1), segs.dtype)], axis=1
+        )
+        bonus = jnp.take_along_axis(greedy, accepted[:, None], axis=1)
+        out = jnp.where(idx < accepted[:, None], shifted,
+                        jnp.where(idx == accepted[:, None], bonus, 0))
+        counts = (accepted + 1) * active.astype(jnp.int32)
+        pk, pv = self._write_kv(
+            pk, pv, nk, nv, block_tables, lengths,
+            jnp.broadcast_to(active[:, None], segs.shape),
+        )
+        lengths = lengths + counts
+        return out, counts, pk, pv, lengths
+
     # ---- host control -----------------------------------------------------
 
     def submit(
@@ -431,9 +508,13 @@ class PagedEngine:
         top_k: int = 0,
         eos_id: int = -1,
         seed: int = 0,
+        draft_hint: Optional[np.ndarray] = None,
     ) -> _Stream:
         """Queue one prompt (1-D int array). Returns a stream handle whose
-        ``event`` fires when ``result`` (``(max_new,)`` ids) is ready."""
+        ``event`` fires when ``result`` (``(max_new,)`` ids) is ready.
+
+        ``draft_hint`` (speculative draft='oracle' only): the expected
+        continuation, drafted verbatim — the acceptance-ceiling lane."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         plen = len(prompt)
         if plen < 1:
@@ -445,13 +526,22 @@ class PagedEngine:
             raise MicroserviceError(
                 "max_new_tokens must be >= 1", status_code=400, reason="BAD_REQUEST"
             )
+        if self.speculative is not None and temperature > 0:
+            raise MicroserviceError(
+                "the speculative engine is greedy-exact only: verification "
+                "compares the model's argmax against drafts, which has no "
+                "meaning under sampling — deploy without speculative (or "
+                "send temperature=0) for this request",
+                status_code=400, reason="BAD_REQUEST",
+            )
+        headroom = (self.draft_k + 1) if self.speculative is not None else 0
         bucket = next((b for b in self.prompt_buckets if b >= plen), None)
-        if bucket is None or plen + max_new_tokens > self.max_len:
+        if bucket is None or plen + max_new_tokens + headroom > self.max_len:
             raise MicroserviceError(
                 f"prompt {plen} + max_new {max_new_tokens} exceeds max_len {self.max_len}",
                 status_code=400, reason="SEQUENCE_TOO_LONG",
             )
-        need = -(-(plen + max_new_tokens) // self.page_size)
+        need = -(-(plen + max_new_tokens + headroom) // self.page_size)
         if need > self.num_pages - 1:
             raise MicroserviceError(
                 f"request needs {need} pages but the pool holds {self.num_pages - 1}",
@@ -466,6 +556,8 @@ class PagedEngine:
                 self._next_id, prompt, max_new_tokens,
                 float(temperature), int(top_k), int(eos_id), int(seed),
             )
+            if draft_hint is not None:
+                stream.draft_hint = np.asarray(draft_hint, np.int32).reshape(-1)
             self._next_id += 1
             self._queue.append(stream)
         return stream
@@ -516,6 +608,9 @@ class PagedEngine:
             jnp.asarray(self._block_tables[stream.slot]),
         )
         self._logits = self._logits.at[stream.slot].set(last)
+        if self.speculative is not None:
+            # host decides the next greedy token between verify rounds
+            stream.pending = int(self._jnp.argmax(last))
         # deterministic per submit(seed=...): same seed -> same sample path
         # (per-request variation is the component layer's job, as in
         # GenerativeLM's puid/counter folding)
@@ -525,9 +620,15 @@ class PagedEngine:
     def _ensure_pages_locked(self, stream: _Stream) -> bool:
         """Grow the stream's block table to cover the next chunk."""
         slot = stream.slot
+        per_chunk = (
+            self.draft_k + 1 if self.speculative is not None else self.steps_per_call
+        )
+        cap = len(stream.prompt) + stream.max_new
+        if self.speculative is not None:
+            cap += self.draft_k + 1  # the verify segment may scribble past
         horizon = min(
-            int(self._lengths[slot]) + self.steps_per_call,
-            len(stream.prompt) + stream.max_new,
+            int(self._lengths[slot]) + per_chunk,
+            cap,
             self.max_len,
         )
         need = -(-horizon // self.page_size)
@@ -617,6 +718,8 @@ class PagedEngine:
 
         Returns True while there is (or may be) more work.
         """
+        if self.speculative is not None:
+            return self._step_speculative()
         jnp = self._jnp
         with self._lock:
             admitted = self._admit_locked()
@@ -691,6 +794,100 @@ class PagedEngine:
                     self._finish_locked(stream)
             return bool(self._queue) or any(s is not None for s in self._slots)
 
+    def _step_speculative(self) -> bool:
+        """One draft/verify round for every active slot.
+
+        Drafting is host-side ngram lookup on each stream's own context
+        (per-slot: streams draft independently), verification is one
+        batched forward — speculative decode and continuous batching
+        compose instead of being separate lanes.
+        """
+        from seldon_core_tpu.models.speculative import ngram_draft
+
+        jnp = self._jnp
+        with self._lock:
+            admitted = self._admit_locked()
+        for stream, _ in admitted:
+            self._prefill_stream(stream)
+
+        with self._lock:
+            self._counters["prefills"] += len(admitted)
+            for stream, _ in admitted:
+                # the prefill's argmax IS the first generated token:
+                # emit it now so round 1 verifies continuations of it
+                # (pending == tokens[-1] is the loop invariant)
+                stream.tokens.append(int(stream.pending))
+                self._counters["tokens"] += 1
+                if stream.pending == stream.eos_id or len(stream.tokens) >= stream.max_new:
+                    self._finish_locked(stream)
+            active = [s for s in self._slots if s is not None]
+            if not active:
+                return bool(self._queue)
+            stalled = np.zeros((self.max_slots,), bool)
+            for stream in active:
+                if not self._ensure_pages_locked(stream):
+                    stalled[stream.slot] = True
+            self._counters["stalls"] += int(stalled.sum())
+            while active and all(stalled[s.slot] for s in active):
+                victim = min(active, key=lambda s: (len(s.tokens), -s.req_id))
+                active.remove(victim)
+                self._evict_locked(victim)
+                for stream in active:
+                    if stalled[stream.slot] and self._ensure_pages_locked(stream):
+                        stalled[stream.slot] = False
+            if not active:
+                return bool(self._queue)
+            L = self.draft_k + 1
+            segs = np.zeros((self.max_slots, L), np.int32)
+            n_drafts = np.zeros((self.max_slots,), np.int32)
+            active_mask = np.zeros((self.max_slots,), bool)
+            runnable = [s for s in active if not stalled[s.slot]]
+            oracle = self.speculative["draft"] == "oracle"
+            for stream in runnable:
+                slot = stream.slot
+                if oracle and stream.draft_hint is not None:
+                    done = len(stream.tokens)
+                    drafted = stream.draft_hint[done : done + self.draft_k]
+                else:
+                    context = np.concatenate(
+                        [stream.prompt, np.asarray(stream.tokens, np.int32)]
+                    )
+                    drafted = ngram_draft(
+                        context, self.draft_k, ngram=int(self.speculative["ngram"])
+                    )[: self.draft_k]
+                segs[slot, 0] = stream.pending
+                segs[slot, 1 : 1 + len(drafted)] = drafted
+                n_drafts[slot] = len(drafted)
+                active_mask[slot] = True
+                self._counters["spec_drafted"] += len(drafted)
+            tables = jnp.asarray(self._block_tables)
+            lengths = jnp.asarray(self._lengths)
+
+        if not runnable:
+            return True
+        out, counts, self.pages_k, self.pages_v, lengths_out = self._spec_chunk(
+            self.params, self.pages_k, self.pages_v, jnp.asarray(segs),
+            jnp.asarray(n_drafts), jnp.asarray(active_mask), tables, lengths,
+        )
+        out_np = np.asarray(out)
+        counts_np = np.asarray(counts)
+        self._lengths = np.array(lengths_out)
+
+        with self._lock:
+            self._counters["chunks"] += 1
+            for stream in runnable:
+                s = stream.slot
+                n = int(counts_np[s])
+                got = out_np[s, :n].tolist()
+                self._counters["tokens"] += n
+                self._counters["spec_accepted"] += max(0, n - 1)
+                stream.tokens.extend(got)
+                stream.pending = int(got[-1]) if got else stream.pending
+                hit_eos = stream.eos_id in got
+                if hit_eos or len(stream.tokens) >= stream.max_new:
+                    self._finish_locked(stream)
+            return bool(self._queue) or any(s is not None for s in self._slots)
+
     def run(self) -> None:
         """Drain everything synchronously (test / batch-job entrypoint)."""
         while self.has_work():
@@ -739,6 +936,7 @@ class StreamingLM(TPUComponent):
         steps_per_call: int = 8,
         mesh_axes: Optional[Dict[str, int]] = None,
         quantize: str = "",
+        speculative: Optional[Dict[str, Any]] = None,
         **kwargs: Any,
     ):
         super().__init__(**kwargs)
@@ -753,6 +951,10 @@ class StreamingLM(TPUComponent):
             page_size=int(page_size), num_pages=int(num_pages) or None,
             max_slots=int(max_slots), steps_per_call=int(steps_per_call),
             quantize=validate_quantize_mode(quantize),  # fail at construction
+            # speculative={"draft": "ngram", "draft_k": k, "ngram": n}:
+            # per-slot draft/verify INSIDE the continuous-batching
+            # engine — greedy-exact, one verify forward per chunk
+            speculative=dict(speculative) if speculative else None,
         )
         self.mesh_axes = dict(mesh_axes) if mesh_axes else None
         self.max_new_tokens = int(max_new_tokens)
@@ -870,7 +1072,15 @@ class StreamingLM(TPUComponent):
             {"type": "GAUGE", "key": "paged_chunks", "value": s["chunks"]},
             {"type": "GAUGE", "key": "paged_tokens_emitted", "value": s["tokens"]},
             {"type": "GAUGE", "key": "paged_streams_completed", "value": s["completed"]},
-        ]
+        ] + (
+            [
+                {"type": "GAUGE", "key": "speculative_acceptance_rate",
+                 "value": s["spec_accepted"] / max(1, s["spec_drafted"])},
+                {"type": "GAUGE", "key": "speculative_rounds",
+                 "value": s["chunks"]},
+            ]
+            if self.engine.speculative is not None else []
+        )
 
     def class_names(self):
         return []

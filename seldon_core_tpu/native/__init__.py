@@ -37,7 +37,8 @@ def _so_path() -> str:
 
 # the shared library's inputs (keep in sync with SRCS in native/Makefile;
 # other .cc files there — e.g. remote_node.cc — build separate binaries)
-_LIB_SOURCES = ("codec.cc", "frontserver.cc", "loadgen.cc", "Makefile")
+_LIB_SOURCES = ("codec.cc", "frontserver.cc", "h2grpc.cc", "h2grpc.h",
+                "loadgen.cc", "Makefile")
 
 
 def _is_stale(so: str) -> bool:

@@ -10,9 +10,12 @@ runtime (the Java engine; reference: doc/source/graph/svcorch.md:1-8).
 
 Two callback surfaces:
 
-* ``model_fn(batch[rows, cols] f32) -> [rows, out_dim]`` — the fast
+* ``model_fn(batch[rows, cols] f32|u8) -> [rows, out_dim]`` — the fast
   lane.  For a JaxServer this is the jit-compiled apply; the GIL is
-  taken once per batch and released during XLA execution.
+  taken once per batch and released during XLA execution.  The server
+  runs ``batch_threads`` workers, so model_fn must be thread-safe —
+  concurrent calls pipeline device batches (throughput = in-flight
+  depth x batch / device roundtrip when the link latency dominates).
 * ``raw_handler(method, path, body) -> (status, content_type, body)``
   — the fallback lane, typically ``GatewayRawHandler`` bridging into
   the deployment's asyncio engine.
@@ -35,9 +38,10 @@ logger = logging.getLogger(__name__)
 _BATCH_CB = ctypes.CFUNCTYPE(
     ctypes.c_int32,
     ctypes.c_void_p,                  # ctx
-    ctypes.POINTER(ctypes.c_float),   # in
+    ctypes.c_void_p,                  # in ([rows*cols] of dtype)
     ctypes.c_int64,                   # rows
     ctypes.c_int64,                   # cols
+    ctypes.c_int32,                   # dtype: 0=f32 1=u8
     ctypes.POINTER(ctypes.c_float),   # out
     ctypes.c_int64,                   # out_cols
 )
@@ -69,6 +73,7 @@ class _FsConfig(ctypes.Structure):
         ("raw_workers", ctypes.c_int32),
         ("backlog", ctypes.c_int32),
         ("eager_when_idle", ctypes.c_int32),
+        ("batch_threads", ctypes.c_int32),
         ("model_name", ctypes.c_char_p),
         ("names_csv", ctypes.c_char_p),
         ("buckets_csv", ctypes.c_char_p),
@@ -146,6 +151,7 @@ class NativeFrontServer:
         eager_when_idle: bool = True,
         buckets: Optional[Sequence[int]] = None,
         host: str = "0.0.0.0",
+        batch_threads: int = 4,
     ):
         lib = get_lib()
         if lib is None or not hasattr(lib, "fs_create"):
@@ -164,6 +170,7 @@ class NativeFrontServer:
             raw_workers=raw_workers,
             backlog=512,
             eager_when_idle=1 if eager_when_idle else 0,
+            batch_threads=batch_threads,
             model_name=model_name.encode(),
             names_csv=",".join(names).encode() if names else b"",
             buckets_csv=",".join(str(int(b)) for b in buckets).encode() if buckets else b"",
@@ -187,9 +194,14 @@ class NativeFrontServer:
 
     # ------------------------------------------------------------ callbacks
 
-    def _on_batch(self, _ctx, in_ptr, rows, cols, out_ptr, out_cols) -> int:
+    def _on_batch(self, _ctx, in_ptr, rows, cols, dtype, out_ptr, out_cols) -> int:
         try:
-            batch = np.ctypeslib.as_array(in_ptr, shape=(rows, cols))
+            # dtype-preserving view: uint8 image payloads reach the
+            # model as uint8 (the jit program was warmed for it), f32
+            # stays f32 — no host-side cast of the batch
+            ctype = ctypes.c_uint8 if dtype == 1 else ctypes.c_float
+            typed = ctypes.cast(in_ptr, ctypes.POINTER(ctype))
+            batch = np.ctypeslib.as_array(typed, shape=(rows, cols))
             result = np.asarray(self.model_fn(batch), dtype=np.float32)
             if result.ndim == 1:
                 result = result[:, None]
@@ -394,6 +406,77 @@ def native_load(
     errors = ctypes.c_int64(0)
     ok = lib.lg_run(
         payload, len(payload), int(port), float(seconds),
+        int(connections), int(depth),
+        ctypes.byref(non2xx), ctypes.byref(errors),
+    )
+    return {
+        "qps": ok / seconds,
+        "ok": int(ok),
+        "non2xx": int(non2xx.value),
+        "errors": int(errors.value),
+    }
+
+
+def build_grpc_request_parts(path: str, proto_bytes: bytes,
+                             authority: str = "localhost") -> Tuple[bytes, bytes]:
+    """(HPACK header block, gRPC-framed DATA payload) for the h2c load
+    client (``lg_run_h2``).  Static indexes for :method POST / :scheme
+    http; everything else as raw never-indexed literals — exactly the
+    subset the C++ lane's HPACK decoder handles without state."""
+
+    def lit(name: bytes, value: bytes) -> bytes:
+        def ln(n: int) -> bytes:
+            if n < 127:
+                return bytes([n])
+            out = bytearray([127])
+            v = n - 127
+            while v >= 128:
+                out.append(0x80 | (v & 0x7F))
+                v >>= 7
+            out.append(v)
+            return bytes(out)
+
+        return b"\x10" + ln(len(name)) + name + ln(len(value)) + value
+
+    block = (
+        b"\x83"  # :method POST (static 3)
+        + b"\x86"  # :scheme http (static 6)
+        + lit(b":path", path.encode())
+        + lit(b":authority", authority.encode())
+        + lit(b"content-type", b"application/grpc")
+        + lit(b"te", b"trailers")
+    )
+    data = b"\x00" + len(proto_bytes).to_bytes(4, "big") + proto_bytes
+    return block, data
+
+
+def native_load_grpc(
+    port: int,
+    path: str,
+    proto_bytes: bytes,
+    seconds: float = 5.0,
+    connections: int = 8,
+    depth: int = 8,
+) -> Optional[dict]:
+    """Closed-loop gRPC (h2c) load against the native ingress — the
+    counterpart of :func:`native_load` for the contract surface the
+    reference's engine serves natively (SeldonGrpcServer.java:30-60)."""
+    if seconds <= 0:
+        raise ValueError(f"seconds must be positive, got {seconds}")
+    lib = get_lib()
+    if lib is None or not hasattr(lib, "lg_run_h2"):
+        return None
+    lib.lg_run_h2.restype = ctypes.c_int64
+    lib.lg_run_h2.argtypes = [
+        ctypes.c_char_p, ctypes.c_int64, ctypes.c_char_p, ctypes.c_int64,
+        ctypes.c_int32, ctypes.c_double, ctypes.c_int32, ctypes.c_int32,
+        ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64),
+    ]
+    block, data = build_grpc_request_parts(path, proto_bytes)
+    non2xx = ctypes.c_int64(0)
+    errors = ctypes.c_int64(0)
+    ok = lib.lg_run_h2(
+        block, len(block), data, len(data), int(port), float(seconds),
         int(connections), int(depth),
         ctypes.byref(non2xx), ctypes.byref(errors),
     )

@@ -186,6 +186,105 @@ class TestPythonModel:
             assert st["batches"] < st["rows"]
 
 
+class TestUint8FastLane:
+    def test_uint8_frame_reaches_model_as_uint8(self):
+        """A uint8 SRT1 frame must reach model_fn dtype-preserved (no
+        4x float inflation) and round-trip correctly."""
+        seen = []
+
+        def model(batch):
+            seen.append(batch.dtype)
+            return batch.astype(np.float32).sum(axis=1, keepdims=True)
+
+        with NativeFrontServer(model_fn=model, feature_dim=4, out_dim=1) as srv:
+            frame = pack_raw_frame(np.array([[1, 2, 3, 4]], np.uint8))
+            status, data = post(srv.port, "/api/v0.1/predictions", frame,
+                                content_type="application/x-seldon-raw")
+            assert status == 200
+            out = unpack_raw_frame(data)
+            np.testing.assert_allclose(np.asarray(out).ravel(), [10.0])
+            assert seen == [np.dtype(np.uint8)]
+
+    def test_mixed_dtype_requests_never_share_a_batch(self):
+        """Concurrent f32 and u8 requests must land in separate model
+        calls — each (shape, dtype) is its own compiled program."""
+        batches = []
+        lock = threading.Lock()
+
+        def model(batch):
+            with lock:
+                batches.append((batch.dtype.str, batch.shape[0]))
+            time.sleep(0.002)
+            return np.zeros((batch.shape[0], 1), np.float32)
+
+        with NativeFrontServer(model_fn=model, feature_dim=2, out_dim=1,
+                               max_batch=64) as srv:
+            f32 = pack_raw_frame(np.ones((1, 2), np.float32))
+            u8 = pack_raw_frame(np.ones((1, 2), np.uint8))
+            errs = []
+
+            def hammer(frame):
+                try:
+                    for _ in range(20):
+                        status, _ = post(srv.port, "/api/v0.1/predictions", frame,
+                                         content_type="application/x-seldon-raw")
+                        assert status == 200
+                except Exception as e:  # noqa: BLE001
+                    errs.append(e)
+
+            threads = [threading.Thread(target=hammer, args=(f,))
+                       for f in (f32, u8) for _ in range(4)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            assert not errs
+            dtypes = {d for d, _ in batches}
+            assert dtypes == {"<f4", "|u1"}
+
+
+class TestBatchWorkerPipeline:
+    def test_concurrent_model_calls(self):
+        """batch_threads > 1 must overlap slow model calls — the
+        pipelining that sets throughput through a high-latency
+        device link."""
+        inflight = []
+        peak = [0]
+        lock = threading.Lock()
+
+        def model(batch):
+            with lock:
+                inflight.append(1)
+                peak[0] = max(peak[0], len(inflight))
+            time.sleep(0.05)
+            with lock:
+                inflight.pop()
+            return np.zeros((batch.shape[0], 1), np.float32)
+
+        with NativeFrontServer(model_fn=model, feature_dim=2, out_dim=1,
+                               max_batch=1, batch_threads=4) as srv:
+            body = tensor_body([[1, 2]])
+            errs = []
+
+            def worker():
+                try:
+                    for _ in range(3):
+                        status, _ = post(srv.port, "/api/v0.1/predictions", body)
+                        assert status == 200
+                except Exception as e:  # noqa: BLE001
+                    errs.append(e)
+
+            threads = [threading.Thread(target=worker) for _ in range(8)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            assert not errs
+            # with max_batch=1 every request is its own model call;
+            # 4 workers must have overlapped at least 2 calls
+            assert peak[0] >= 2
+
+
 class TestRawFallbackLane:
     def test_custom_raw_handler(self):
         seen = []
