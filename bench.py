@@ -487,7 +487,7 @@ async def native_model_phase(handle, shape, seconds: float = 6.0) -> dict:
     if not hasattr(get_lib(), "lg_run"):
         return {"error": "native load client unavailable"}
 
-    rows = int(os.environ.get("BENCH_NATIVE_ROWS", "16"))
+    rows = int(os.environ.get("BENCH_NATIVE_ROWS", "8"))
     # constant payload content: through this harness's TPU relay,
     # INCOMPRESSIBLE host->device uploads bottleneck at ~20 MB/s
     # (an artifact of the relay, not of the framework or of real
@@ -506,16 +506,35 @@ async def native_model_phase(handle, shape, seconds: float = 6.0) -> dict:
         pack_raw_frame(img[:1]),
         content_type="application/x-seldon-raw",
     )
+    async def quiesce(max_wait: float = 8.0):
+        # a burst the deadline abandoned leaves orphaned requests in the
+        # server queue (dropped without a model call, but live batches
+        # still finish); wait for the batch counter to stop moving so
+        # configs don't poison each other on the 1-CPU host
+        last = -1
+        deadline = time.perf_counter() + max_wait
+        while time.perf_counter() < deadline:
+            now = handle.stats().get("batches", 0)
+            if now == last:
+                return
+            last = now
+            await asyncio.sleep(0.5)
+
     lat = await asyncio.to_thread(
         native_load, handle.port, one, min(seconds, 3.0), 1, 1
     )
+    await quiesce()
     best = {"qps": 0.0}
-    for conns, depth in ((8, 8), (12, 8), (16, 12)):
+    # modest in-flight volume: on a 1-CPU bench host the wire bytes
+    # (client write + server read + copies) compete with the
+    # host<->device link for the same core
+    for conns, depth in ((4, 4), (8, 4), (8, 8)):
         out = await asyncio.to_thread(
             native_load, handle.port, payload, seconds / 3.0, conns, depth
         )
         if out["qps"] > best["qps"]:
             best = dict(out, connections=conns, depth=depth)
+        await quiesce()
     # gRPC lane on the SAME port: uint8 rawTensor SeldonMessage
     greq = pb.SeldonMessage()
     greq.data.rawTensor.dtype = "uint8"
@@ -530,14 +549,16 @@ async def native_model_phase(handle, shape, seconds: float = 6.0) -> dict:
         native_load_grpc, handle.port, "/seldon.protos.Seldon/Predict",
         gone.SerializeToString(), min(seconds, 3.0), 1, 1
     )
+    await quiesce()
     gbest = {"qps": 0.0}
-    for conns, depth in ((8, 8), (12, 8), (16, 12)):
+    for conns, depth in ((4, 4), (8, 4), (8, 8)):
         gout = await asyncio.to_thread(
             native_load_grpc, handle.port, "/seldon.protos.Seldon/Predict",
             gbytes, seconds / 3.0, conns, depth
         )
-        if gout and gout["qps"] > gbest["qps"]:
+        if gout and (gout["qps"] > gbest["qps"] or "connections" not in gbest):
             gbest = dict(gout, connections=conns, depth=depth)
+        await quiesce()
 
     stats = handle.stats()
     return {
@@ -556,6 +577,7 @@ async def native_model_phase(handle, shape, seconds: float = 6.0) -> dict:
         "batches": stats.get("batches"),
         "errors": (best.get("errors", 0) or 0) + (best.get("non2xx", 0) or 0)
         + (gbest.get("errors", 0) or 0) + (gbest.get("non2xx", 0) or 0),
+        "dropped_orphans": stats.get("dropped_orphans"),
     }
 
 
@@ -922,6 +944,21 @@ def generation_phase() -> dict:
             return toks, dt, eng.engine_stats()
 
         plain_toks, plain_dt, plain_stats = run_engine(None)
+        # the classic speculation baseline: token-by-token decode (one
+        # device call per token) — what draft/verify replaces
+        def run_engine1():
+            eng = PagedEngine(
+                spec_params, dtype=jnp.float32, page_size=64, max_slots=spec_batch,
+                steps_per_call=1, **pe_cfg,
+            )
+            streams = [eng.submit(p, max_new_tokens=spec_new) for p in prompts]
+            eng.run()
+            t0 = _time.perf_counter()
+            streams = [eng.submit(p, max_new_tokens=spec_new) for p in prompts]
+            eng.run()
+            return _time.perf_counter() - t0, eng.engine_stats()
+
+        tok_dt, tok_stats = run_engine1()
         # acceptance CEILING: oracle drafts (the known continuation) —
         # verify-engine throughput at ~100% acceptance, the number a
         # trained model with a good draft source approaches
@@ -934,19 +971,30 @@ def generation_phase() -> dict:
         ng_toks, _ng_dt, ng_stats = run_engine({"draft": "ngram", "draft_k": 4})
         assert np.array_equal(plain_toks, ng_toks), "ngram lane must be greedy-exact"
         result["paged_decode_tokens_per_s"] = round(spec_batch * spec_new / plain_dt, 1)
+        result["paged_tokenwise_tokens_per_s"] = round(
+            spec_batch * spec_new / tok_dt, 1
+        )
         result["paged_spec_oracle_tokens_per_s"] = round(
             spec_batch * spec_new / spec_dt, 1
         )
+        # vs the token-by-token baseline speculation classically replaces
+        result["spec_oracle_vs_tokenwise"] = round(tok_dt / spec_dt, 2)
+        # vs chunked scan decode (8 steps per program): through a
+        # high-RTT harness wall-clock tracks device-CALL counts, so the
+        # portable signal is the call counts reported below
         result["spec_oracle_vs_plain_decode"] = round(plain_dt / spec_dt, 2)
+        result["tokenwise_chunks"] = tok_stats["chunks"] // 2
         result["spec_oracle_acceptance"] = round(
             spec_stats["spec_accepted"] / max(1, spec_stats["spec_drafted"]), 3
         )
         result["spec_ngram_acceptance"] = round(
             ng_stats["spec_accepted"] / max(1, ng_stats["spec_drafted"]), 3
         )
-        # compiled-program invocations over both (warm + timed) runs
-        result["spec_oracle_chunks"] = spec_stats["chunks"]
-        result["plain_chunks"] = plain_stats["chunks"]
+        # per-pass compiled-program invocations (each engine ran the
+        # workload twice — warm + timed — with identical deterministic
+        # chunk counts, so per-pass = total // 2)
+        result["spec_oracle_chunks"] = spec_stats["chunks"] // 2
+        result["plain_chunks"] = plain_stats["chunks"] // 2
     except Exception as e:  # noqa: BLE001
         result["speculative_error"] = str(e)[:200]
     return result
@@ -995,15 +1043,32 @@ async def int8_phase(shape) -> dict:
         for d in staged:
             d.block_until_ready()
         np.asarray(server._predict_jit(server.variables, staged[0]))  # warm resident path
-        n_calls = 30
-        t0 = time.perf_counter()
-        outs = [
-            server._predict_jit(server.variables, staged[i % len(staged)])
-            for i in range(n_calls)
-        ]
-        outs[-1].block_until_ready()
-        dt = time.perf_counter() - t0
-        out[f"{tag}_images_per_s"] = round(n_calls * MAX_BATCH / dt, 1)
+
+        def timed(n):
+            t0 = time.perf_counter()
+            outs = [
+                server._predict_jit(server.variables, staged[i % len(staged)])
+                for i in range(n)
+            ]
+            outs[-1].block_until_ready()
+            return time.perf_counter() - t0
+
+        # two-point timing: blocking on the last output pays ONE
+        # host<->device roundtrip regardless of n, which on a
+        # high-latency link dwarfs the per-batch compute being compared
+        # — the difference (t_big - t_small) isolates the queued
+        # device work of (n_big - n_small) batches
+        n_small, n_big = 10, 60
+        dt_small = timed(n_small)
+        dt_big = timed(n_big)
+        compute = dt_big - dt_small
+        if compute > 0.01:
+            out[f"{tag}_images_per_s"] = round(
+                (n_big - n_small) * MAX_BATCH / compute, 1
+            )
+        else:  # relay noise swallowed the difference: report the raw rate
+            out[f"{tag}_images_per_s"] = round(n_big * MAX_BATCH / dt_big, 1)
+            out[f"{tag}_timing_note"] = "roundtrip-dominated (relay)"
         server.unload()
     if out.get("fp_images_per_s") and out.get("int8_images_per_s"):
         out["int8_vs_fp"] = round(out["int8_images_per_s"] / out["fp_images_per_s"], 2)

@@ -57,6 +57,7 @@
 #include <string>
 #include <thread>
 #include <unordered_map>
+#include <unordered_set>
 #include <vector>
 
 #include "h2grpc.h"
@@ -127,6 +128,7 @@ typedef struct {
   int64_t padded_rows;     // padding rows added to reach buckets
   int64_t failures;        // 4xx/5xx responses
   int64_t connections;     // accepted connections
+  int64_t dropped_orphans; // fast-lane requests skipped: connection died
 } FsStats;
 
 }  // extern "C"
@@ -564,6 +566,7 @@ class FrontServer {
     s->padded_rows = padded_rows_.load();
     s->failures = failures_.load();
     s->connections = connections_.load();
+    s->dropped_orphans = dropped_orphans_.load();
   }
 
  private:
@@ -609,6 +612,10 @@ class FrontServer {
       Conn c;
       c.fd = fd;
       conns_.emplace(id, std::move(c));
+      {
+        std::lock_guard<std::mutex> lk(alive_mu_);
+        alive_conns_.insert(id);
+      }
       connections_.fetch_add(1);
       epoll_event ev{};
       ev.events = EPOLLIN;
@@ -623,6 +630,15 @@ class FrontServer {
     epoll_ctl(epoll_fd_, EPOLL_CTL_DEL, it->second.fd, nullptr);
     close(it->second.fd);
     conns_.erase(it);
+    {
+      std::lock_guard<std::mutex> lk(alive_mu_);
+      alive_conns_.erase(id);
+    }
+  }
+
+  bool conn_alive(uint64_t id) {
+    std::lock_guard<std::mutex> lk(alive_mu_);
+    return alive_conns_.count(id) != 0;
   }
 
   void handle_conn_event(uint64_t id, uint32_t evmask) {
@@ -1092,13 +1108,27 @@ class FrontServer {
   }
 
   void run_batch(std::vector<PendingReq>& all_items) {
+    // orphan drop: a request whose connection died (client gave up,
+    // load-phase deadline) must not spend a model call — stale backlog
+    // from an abandoned burst would otherwise delay live traffic by
+    // whole batches (the reference engine gets this for free from
+    // Tomcat's connection-scoped request lifecycle)
+    std::vector<PendingReq> live;
+    live.reserve(all_items.size());
+    for (auto& it : all_items) {
+      if (conn_alive(it.conn_id)) live.push_back(std::move(it));
+    }
+    if (live.size() != all_items.size()) {
+      dropped_orphans_.fetch_add((int64_t)(all_items.size() - live.size()));
+    }
+    if (live.empty()) return;
     // group by (feature width, dtype): with feature_dim configured all
     // requests share the width, but the unconstrained mode must not
     // concatenate rows of different widths — and mixed-dtype requests
     // must never share one buffer (each (shape, dtype) pair is its own
     // compiled XLA program on the Python side)
     std::map<std::pair<int64_t, int>, std::vector<PendingReq*>> groups;
-    for (auto& it : all_items) {
+    for (auto& it : live) {
       groups[{it.cols, (int)it.dtype}].push_back(&it);
     }
     for (auto& kv : groups) run_batch_group(kv.second, kv.first.first, kv.first.second);
@@ -1391,6 +1421,11 @@ class FrontServer {
 
   std::unordered_map<uint64_t, Conn> conns_;
   uint64_t next_conn_id_ = 1;
+  // connection liveness visible to batch workers (conns_ is IO-thread
+  // owned); lets the batch path skip requests of dead connections
+  std::mutex alive_mu_;
+  std::unordered_set<uint64_t> alive_conns_;
+  std::atomic<int64_t> dropped_orphans_{0};
 
   std::mutex batch_mu_;
   std::condition_variable batch_cv_;

@@ -132,6 +132,9 @@ class DynamicBatcher:
         self.name = name
         self.stats = BatcherStats()
         self._queue: "queue.Queue[Optional[_WorkItem]]" = queue.Queue()
+        # deferred item that would overflow the current batch (collector
+        # thread only — no locking needed)
+        self._carry: Optional[_WorkItem] = None
         # bounded: backpressure when `pipeline_depth` batches are in flight
         self._inflight: "queue.Queue[Optional[tuple]]" = queue.Queue(maxsize=pipeline_depth)
         self._thread: Optional[threading.Thread] = None
@@ -162,6 +165,11 @@ class DynamicBatcher:
         if self._thread is not None:
             self._thread.join(timeout=5.0)
             self._thread = None
+        if self._carry is not None:  # deferred item must not hang its caller
+            self._carry.future.set_exception(
+                RuntimeError(f"batcher {self.name!r} stopped")
+            )
+            self._carry = None
         for _ in self._finishers:
             self._inflight.put(None)
         for t in self._finishers:
@@ -187,8 +195,17 @@ class DynamicBatcher:
     # ---------------------------------------------------------------- worker
 
     def _collect(self) -> Optional[List[_WorkItem]]:
-        """Block for the first item, then fill until bucket/deadline."""
-        first = self._queue.get()
+        """Block for the first item, then fill until bucket/deadline.
+
+        A row-batched request that would push the coalesced batch PAST
+        ``max_batch_size`` is carried over to the next batch instead of
+        merged: two already-full batches concatenated would form an
+        oversized shape no warmup ever compiled, stalling the dispatch
+        thread on a mid-traffic jit trace.  (A single oversized request
+        still gets its honest full-size call — only merging is capped.)
+        """
+        first = self._carry if self._carry is not None else self._queue.get()
+        self._carry = None
         if first is None:
             return None
         items = [first]
@@ -204,6 +221,9 @@ class DynamicBatcher:
                 break
             if item is None:
                 self._queue.put(None)  # re-signal shutdown for the outer loop
+                break
+            if rows + item.rows > self.max_batch_size:
+                self._carry = item
                 break
             items.append(item)
             rows += item.rows

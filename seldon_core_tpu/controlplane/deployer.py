@@ -118,6 +118,7 @@ def build_generation(spec: TpuDeployment, device_ids: Optional[List[int]] = None
             svc = PredictorService(
                 p.graph, name=p.name, observer=observer, annotations=spec.annotations,
                 clients=clients,
+                request_logger=_request_logger_from_annotations(spec.annotations),
             )
             if scaled is not None:
                 balanced, rs, make_autoscaler = scaled
@@ -151,6 +152,29 @@ def build_generation(spec: TpuDeployment, device_ids: Optional[List[int]] = None
         replicasets=replicasets,
         supervisor=supervisor,
     )
+
+
+def _request_logger_from_annotations(annotations):
+    """Pair-logging sink from deployment annotations (the reference
+    wires its engine to the logging service via
+    ``message.logging.service``, PredictionService.java:169-202):
+
+    * ``seldon.io/request-log-url``   — CloudEvents POSTs to a
+      collector (e.g. ``seldon-tpu-reqlog serve``)
+    * ``seldon.io/request-log-jsonl`` — append to a local JSONL file
+      (ingestable by ``seldon-tpu-reqlog ingest``)
+    """
+    url = str(annotations.get("seldon.io/request-log-url", "") or "")
+    path = str(annotations.get("seldon.io/request-log-jsonl", "") or "")
+    if url:
+        from seldon_core_tpu.utils.reqlogger import HttpPairLogger
+
+        return HttpPairLogger(url)
+    if path:
+        from seldon_core_tpu.utils.reqlogger import JsonlPairLogger
+
+        return JsonlPairLogger(path)
+    return None
 
 
 def _spawn_remote_workers(spec: TpuDeployment):

@@ -257,3 +257,9 @@ class PredictorService:
 
     async def close(self) -> None:
         await self.executor.close()
+        # the pair logger is per-generation state this service owns for
+        # its lifetime: HttpPairLogger runs a drain thread that must be
+        # joined or rolling updates leak one thread per generation
+        logger_close = getattr(self.request_logger, "close", None)
+        if callable(logger_close):
+            await asyncio.get_running_loop().run_in_executor(None, logger_close)

@@ -845,16 +845,25 @@ class PagedEngine:
             oracle = self.speculative["draft"] == "oracle"
             for stream in runnable:
                 slot = stream.slot
-                if oracle and stream.draft_hint is not None:
+                # never draft past the stream's budget: each accepted
+                # draft + the bonus token advance the stream, so only
+                # remaining-1 drafts can ever be emitted — extra drafts
+                # would burn verify width and inflate acceptance stats
+                # with tokens _finish_locked discards
+                remaining = stream.max_new - len(stream.tokens)
+                k_eff = max(0, min(self.draft_k, remaining - 1))
+                if k_eff == 0:
+                    drafted = np.zeros((0,), np.int32)
+                elif oracle and stream.draft_hint is not None:
                     done = len(stream.tokens)
-                    drafted = stream.draft_hint[done : done + self.draft_k]
+                    drafted = stream.draft_hint[done : done + k_eff]
                 else:
                     context = np.concatenate(
                         [stream.prompt, np.asarray(stream.tokens, np.int32)]
                     )
                     drafted = ngram_draft(
-                        context, self.draft_k, ngram=int(self.speculative["ngram"])
-                    )[: self.draft_k]
+                        context, k_eff, ngram=int(self.speculative["ngram"])
+                    )[:k_eff]
                 segs[slot, 0] = stream.pending
                 segs[slot, 1 : 1 + len(drafted)] = drafted
                 n_drafts[slot] = len(drafted)

@@ -91,6 +91,7 @@ class _FsStats(ctypes.Structure):
         ("padded_rows", ctypes.c_int64),
         ("failures", ctypes.c_int64),
         ("connections", ctypes.c_int64),
+        ("dropped_orphans", ctypes.c_int64),
     ]
 
 

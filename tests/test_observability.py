@@ -151,6 +151,131 @@ class TestRequestLogger:
         assert pairs[0]["puid"]
 
 
+class TestRequestLogConsumer:
+    """The consumer side of the pair stream (VERDICT r2 missing #3;
+    reference: seldon-request-logger/app/app.py:15-60 indexes pairs
+    into ES — here SQLite + the same CloudEvents ingestion surface)."""
+
+    def test_predict_log_ingest_query_by_puid(self, tmp_path):
+        """The full loop: predict -> pair logged -> indexed -> queryable."""
+        from seldon_core_tpu.utils.reqconsumer import PairIndex
+
+        path = str(tmp_path / "pairs.jsonl")
+        svc = PredictorService(
+            UnitSpec(name="m", type="MODEL", component=MetricModel()),
+            request_logger=JsonlPairLogger(path),
+        )
+        out = run(svc.predict(msg([[3.0]])))
+        puid = out.meta.puid
+        index = PairIndex(str(tmp_path / "pairs.sqlite"))
+        assert index.ingest_jsonl(path) == 1
+        pair = index.get(puid)
+        assert pair is not None
+        assert pair["request"]["data"]["tensor"]["values"] == [3.0]
+        assert pair["response"]["data"]["tensor"]["values"] == [6.0]
+        assert index.get("no-such-puid") is None
+
+    def test_http_pair_logger_to_consumer_e2e(self, tmp_path):
+        """HttpPairLogger -> CloudEvents POST -> consumer app -> query:
+        the reference's engine->logger wire, end to end over sockets."""
+        import asyncio
+        import time as _time
+
+        from seldon_core_tpu.utils.reqconsumer import PairIndex, build_consumer_app
+        from seldon_core_tpu.utils.reqlogger import HttpPairLogger
+
+        async def scenario():
+            from aiohttp.test_utils import TestClient, TestServer
+
+            index = PairIndex()
+            client = TestClient(TestServer(build_consumer_app(index)))
+            await client.start_server()
+            url = f"http://127.0.0.1:{client.port}/"
+
+            svc = PredictorService(
+                UnitSpec(name="m", type="MODEL", component=MetricModel()),
+                request_logger=HttpPairLogger(url),
+            )
+            out = await svc.predict(msg([[4.0]]))
+            # the logger posts from a background thread
+            deadline = _time.time() + 10.0
+            while index.count() < 1 and _time.time() < deadline:
+                await asyncio.sleep(0.05)
+            svc.request_logger.close()
+
+            got = await client.get(f"/pairs/{out.meta.puid}")
+            body = await got.json()
+            listed = await client.get("/pairs", params={"limit": "10"})
+            listing = await listed.json()
+            stats = await (await client.get("/stats")).json()
+            await client.close()
+            return got.status, body, listing, stats
+
+        status, body, listing, stats = run(scenario())
+        assert status == 200
+        assert body["response"]["data"]["tensor"]["values"] == [8.0]
+        assert listing["count"] == 1
+        assert stats["pairs"] == 1
+
+    def test_deployment_annotation_wires_pair_logging(self, tmp_path):
+        """`seldon.io/request-log-jsonl` on a deployment spec turns on
+        pair logging declaratively (the reference's
+        message.logging.service env wiring)."""
+        import asyncio
+
+        from seldon_core_tpu.controlplane import Deployer, TpuDeployment
+        from seldon_core_tpu.utils.reqconsumer import PairIndex
+
+        path = str(tmp_path / "pairs.jsonl")
+        spec = TpuDeployment.from_dict({
+            "name": "logged-dep",
+            "annotations": {"seldon.io/request-log-jsonl": path},
+            "predictors": [{
+                "name": "main", "traffic": 100,
+                "graph": {"name": "stub", "type": "MODEL",
+                          "implementation": "SIMPLE_MODEL"},
+            }],
+        })
+
+        async def scenario():
+            deployer = Deployer(device_ids=[0])
+            managed = await deployer.apply(spec)
+            out = await managed.gateway.predict(msg([[1.0]]))
+            await deployer.delete("logged-dep")
+            return out.meta.puid
+
+        puid = asyncio.run(scenario())
+        index = PairIndex()
+        assert index.ingest_jsonl(path) >= 1
+        assert index.get(puid) is not None
+
+    def test_query_filters_and_upsert(self):
+        from seldon_core_tpu.utils.reqconsumer import PairIndex
+
+        index = PairIndex()
+        for i, (puid, predictor) in enumerate(
+            [("p1", "main"), ("p2", "main"), ("p3", "canary")]
+        ):
+            index.ingest({
+                "puid": puid, "time": 100.0 + i,
+                "request": {"data": {"ndarray": [[i]]}},
+                "response": {"meta": {"puid": puid, "tags": {"predictor": predictor}}},
+            })
+        assert index.count() == 3
+        assert len(index.query(predictor="main", limit=10)) == 2
+        assert len(index.query(since=101.5, limit=10)) == 1
+        # re-ingesting the same puid upserts, never duplicates
+        index.ingest({"puid": "p1", "time": 200.0,
+                      "request": {}, "response": {"meta": {"puid": "p1"}}})
+        assert index.count() == 3
+        assert index.get("p1")["time"] == 200.0
+        # a pair without any puid is rejected loudly
+        import pytest as _pytest
+
+        with _pytest.raises(ValueError):
+            index.ingest({"request": {}, "response": {}})
+
+
 class TestMonitoringAssets:
     """The shipped prometheus/alertmanager/grafana configs stay coherent
     with the metric names the code emits (reference analogue: the
