@@ -33,6 +33,20 @@ def _build(target: str, artifact: str) -> str:
     return path
 
 
+def _san_env(kind: str, so: str) -> dict:
+    """Env for a sanitizer subprocess: the runtime must be FIRST in the
+    library list for a python host process, hence the preload."""
+    preload = subprocess.run(
+        ["g++", f"-print-file-name=lib{kind}.so"], capture_output=True, text=True
+    ).stdout.strip()
+    env = {"SELDON_TPU_NATIVE_SO": so, "LD_PRELOAD": preload}
+    if kind == "asan":
+        env["ASAN_OPTIONS"] = "detect_leaks=0,abort_on_error=1"
+    else:
+        env["TSAN_OPTIONS"] = "report_bugs=1,exitcode=66,history_size=4"
+    return env
+
+
 def _run(env_extra, code, timeout=300):
     env = dict(os.environ)
     env.update(env_extra)
@@ -50,16 +64,7 @@ class TestAsanFuzz:
     def test_codec_and_frontserver_fuzz_under_asan(self):
         so = _build("asan", "libseldon_tpu_native_asan.so")
         res = _run(
-            {
-                "SELDON_TPU_NATIVE_SO": so,
-                # asan runtime must be first in the link order for a
-                # python host process -> preload it
-                "LD_PRELOAD": subprocess.run(
-                    ["g++", "-print-file-name=libasan.so"],
-                    capture_output=True, text=True,
-                ).stdout.strip(),
-                "ASAN_OPTIONS": "detect_leaks=0,abort_on_error=1",
-            },
+            _san_env("asan", so),
             "import sys; from tools.fuzz_native import main; sys.exit(main(['--iterations', '600']))",
         )
         assert res.returncode == 0, f"fuzz failed:\n{res.stdout}\n{res.stderr[-2000:]}"
@@ -102,19 +107,64 @@ with NativeFrontServer(model_fn=model, feature_dim=2, out_dim=1, max_batch=16) a
 print("tsan exercise done")
 """
         res = _run(
-            {
-                "SELDON_TPU_NATIVE_SO": so,
-                "LD_PRELOAD": subprocess.run(
-                    ["g++", "-print-file-name=libtsan.so"],
-                    capture_output=True, text=True,
-                ).stdout.strip(),
-                "TSAN_OPTIONS": "report_bugs=1,exitcode=66,history_size=4",
-            },
+            _san_env("tsan", so),
             code,
         )
         assert res.returncode == 0, f"tsan run failed (rc={res.returncode}):\n{res.stdout}\n{res.stderr[-3000:]}"
         assert "WARNING: ThreadSanitizer" not in res.stderr, res.stderr[-3000:]
         assert "tsan exercise done" in res.stdout
+
+    def test_h2_grpc_lane_under_tsan(self):
+        """The h2c gRPC lane under concurrent load with mixed HTTP/1.1
+        traffic on the same port: h2 per-conn state (IO thread), batch
+        workers, and the completion queue all race-checked together."""
+        so = _build("tsan", "libseldon_tpu_native_tsan.so")
+        code = """
+import json, threading, urllib.request
+from seldon_core_tpu.native import frontserver as fsmod
+from seldon_core_tpu.proto import pb
+
+req = pb.SeldonMessage()
+req.data.tensor.shape.extend([1, 4])
+req.data.tensor.values.extend([1.0, 2.0, 3.0, 4.0])
+payload = req.SerializeToString()
+
+with fsmod.NativeFrontServer(stub=True, feature_dim=4, out_dim=3,
+                             batch_threads=4) as srv:
+    errors = []
+    def grpc_load():
+        out = fsmod.native_load_grpc(
+            srv.port, "/seldon.protos.Seldon/Predict", payload,
+            seconds=1.5, connections=3, depth=8)
+        if not out or out["ok"] == 0 or out["errors"]:
+            errors.append(out)
+    def http_load():
+        body = json.dumps({"data": {"tensor": {"shape": [1, 4],
+                          "values": [1, 2, 3, 4]}}}).encode()
+        for _ in range(40):
+            try:
+                r = urllib.request.Request(
+                    f"http://127.0.0.1:{srv.port}/predict", data=body,
+                    headers={"Content-Type": "application/json"})
+                with urllib.request.urlopen(r, timeout=10) as resp:
+                    assert resp.status == 200
+            except Exception as e:
+                errors.append(e)
+    threads = [threading.Thread(target=grpc_load),
+               threading.Thread(target=http_load),
+               threading.Thread(target=http_load)]
+    for t in threads: t.start()
+    for t in threads: t.join()
+    assert not errors, errors[:3]
+print("tsan h2 done")
+"""
+        res = _run(
+            _san_env("tsan", so),
+            code,
+        )
+        assert res.returncode == 0, f"tsan run failed (rc={res.returncode}):\n{res.stdout}\n{res.stderr[-3000:]}"
+        assert "WARNING: ThreadSanitizer" not in res.stderr, res.stderr[-3000:]
+        assert "tsan h2 done" in res.stdout
 
     def test_native_loadgen_against_frontserver_under_tsan(self):
         """Both ends native: lg_run on the caller thread hammering the
@@ -135,14 +185,7 @@ with fsmod.NativeFrontServer(stub=True, feature_dim=4, out_dim=3, model_name="s"
 print("tsan loadgen done")
 """
         res = _run(
-            {
-                "SELDON_TPU_NATIVE_SO": so,
-                "LD_PRELOAD": subprocess.run(
-                    ["g++", "-print-file-name=libtsan.so"],
-                    capture_output=True, text=True,
-                ).stdout.strip(),
-                "TSAN_OPTIONS": "report_bugs=1,exitcode=66,history_size=4",
-            },
+            _san_env("tsan", so),
             code,
         )
         assert res.returncode == 0, f"tsan run failed (rc={res.returncode}):\n{res.stdout}\n{res.stderr[-3000:]}"

@@ -17,10 +17,14 @@ tiers (SURVEY §5.2).
 from __future__ import annotations
 
 import argparse
+import os
 import random
 import socket
 import string
 import sys
+
+# runnable as `python tools/fuzz_native.py` from anywhere
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def fuzz_codecs(iterations: int, seed: int) -> int:
@@ -120,17 +124,114 @@ def fuzz_frontserver(iterations: int, seed: int) -> int:
     return 0
 
 
+def fuzz_h2(iterations: int, seed: int) -> int:
+    """Adversarial HTTP/2 frames + HPACK blocks at the h2c gRPC lane.
+
+    The h2 path parses attacker-controlled frame headers, HPACK
+    integers/strings/Huffman, and protobuf wire format — every one a
+    classic memory-bug surface.  Strategies: random frames after a
+    valid preface, truncated/oversized declared lengths, mutated HPACK
+    blocks, mutated gRPC/proto payloads, and mid-frame connection cuts.
+    """
+    from seldon_core_tpu.native import get_lib
+    from seldon_core_tpu.native.frontserver import (
+        NativeFrontServer,
+        build_grpc_request_parts,
+    )
+
+    if not hasattr(get_lib(), "lg_run_h2"):
+        print("h2 fuzz: native lib lacks lg_run_h2 (stale build?); skipping",
+              file=sys.stderr)
+        return 0
+
+    rng = random.Random(seed)
+    preface = b"PRI * HTTP/2.0\r\n\r\nSM\r\n\r\n"
+
+    def frame(ftype, flags, sid, payload: bytes) -> bytes:
+        n = len(payload)
+        return (bytes([(n >> 16) & 0xFF, (n >> 8) & 0xFF, n & 0xFF,
+                       ftype & 0xFF, flags & 0xFF,
+                       (sid >> 24) & 0x7F, (sid >> 16) & 0xFF,
+                       (sid >> 8) & 0xFF, sid & 0xFF]) + payload)
+
+    # a valid request to mutate
+    block, data = build_grpc_request_parts(
+        "/seldon.protos.Seldon/Predict",
+        bytes.fromhex("1a0a0a08120612041a020104"),  # tiny-ish proto-ish bytes
+    )
+
+    with NativeFrontServer(stub=True, feature_dim=4, out_dim=3) as srv:
+        for i in range(iterations):
+            kind = i % 6
+            if kind == 0:  # random frames
+                payload = preface + b"".join(
+                    frame(rng.randrange(0, 12), rng.randrange(256),
+                          rng.randrange(0, 1 << 31),
+                          bytes(rng.randrange(256) for _ in range(rng.randrange(0, 64))))
+                    for _ in range(rng.randrange(1, 6))
+                )
+            elif kind == 1:  # declared length lies (truncated payload)
+                n = rng.randrange(1, 1 << 20)
+                hdr = bytes([(n >> 16) & 0xFF, (n >> 8) & 0xFF, n & 0xFF,
+                             rng.randrange(0, 10), rng.randrange(256), 0, 0, 0, 1])
+                payload = preface + hdr + b"x" * rng.randrange(0, 128)
+            elif kind == 2:  # mutated HPACK block in HEADERS
+                b = bytearray(block)
+                for _ in range(rng.randrange(1, 8)):
+                    b[rng.randrange(len(b))] = rng.randrange(256)
+                payload = preface + frame(0x1, 0x4 | 0x1, 1, bytes(b))
+            elif kind == 3:  # valid HEADERS, mutated gRPC DATA
+                d = bytearray(data)
+                for _ in range(rng.randrange(1, 8)):
+                    d[rng.randrange(len(d))] = rng.randrange(256)
+                payload = (preface + frame(0x1, 0x4, 1, bytes(block))
+                           + frame(0x0, 0x1, 1, bytes(d)))
+            elif kind == 4:  # HPACK integer/string bombs
+                bomb = bytes([0x1F] + [0xFF] * rng.randrange(1, 12)) + \
+                       bytes([0x7F] + [0xFF] * rng.randrange(1, 12))
+                payload = preface + frame(0x1, 0x4 | 0x1, 1, bomb)
+            else:  # truncated preface / mid-frame cut
+                full = preface + frame(0x1, 0x4, 1, bytes(block))
+                payload = full[: rng.randrange(1, len(full))]
+            try:
+                with socket.create_connection(("127.0.0.1", srv.port), timeout=1) as s:
+                    s.sendall(payload)
+                    s.settimeout(0.3)
+                    try:
+                        s.recv(4096)
+                    except OSError:
+                        pass
+            except OSError:
+                pass
+
+        # the server must still serve a well-formed gRPC request afterwards
+        from seldon_core_tpu.native.frontserver import native_load_grpc
+        from seldon_core_tpu.proto import pb
+
+        req = pb.SeldonMessage()
+        req.data.tensor.shape.extend([1, 4])
+        req.data.tensor.values.extend([1.0, 2.0, 3.0, 4.0])
+        out = native_load_grpc(srv.port, "/seldon.protos.Seldon/Predict",
+                               req.SerializeToString(), seconds=1.0,
+                               connections=1, depth=2)
+        assert out and out["ok"] > 0, f"h2 lane dead after fuzzing: {out}"
+    print(f"h2 fuzz: {iterations} iterations survived, gRPC lane still sane")
+    return 0
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument("--iterations", type=int, default=2000)
     parser.add_argument("--seed", type=int, default=0)
-    parser.add_argument("--target", choices=("codecs", "frontserver", "all"), default="all")
+    parser.add_argument("--target", choices=("codecs", "frontserver", "h2", "all"), default="all")
     args = parser.parse_args(argv)
     rc = 0
     if args.target in ("codecs", "all"):
         rc |= fuzz_codecs(args.iterations, args.seed)
     if args.target in ("frontserver", "all"):
         rc |= fuzz_frontserver(max(args.iterations // 10, 50), args.seed)
+    if args.target in ("h2", "all"):
+        rc |= fuzz_h2(max(args.iterations // 10, 50), args.seed)
     return rc
 
 
