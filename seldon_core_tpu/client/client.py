@@ -257,6 +257,38 @@ class SeldonTpuClient:
         out = InternalMessage.from_proto(proto)
         return ClientResponse(self._success(out), out, proto)
 
+    def generate_stream(
+        self,
+        prompt: Any,
+        meta: Optional[Dict[str, Any]] = None,
+        timeout_s: Optional[float] = None,
+    ):
+        """Token streaming (``Seldon/GenerateStream``): yields int32
+        arrays of newly decoded tokens for ONE prompt as the server's
+        generation engine emits them.  Per-request overrides
+        (max_new_tokens / temperature / top_k / seed) travel in
+        ``meta={"tags": {...}}``.  gRPC transport only.
+
+        ``timeout_s`` bounds the WHOLE stream; the default (None) sets
+        no deadline — a long generation outlives the client's unary
+        ``timeout_s``, and the server frees the stream's slot if the
+        consumer disconnects."""
+        import numpy as np
+
+        from seldon_core_tpu.proto import services
+
+        if self.transport != "grpc":
+            raise ValueError("generate_stream requires transport='grpc'")
+        msg = self._build_message(np.atleast_2d(np.asarray(prompt, np.int32)),
+                                  None, None, meta)
+        call = services.unary_stream_callable(
+            self._ensure_channel(), "Seldon", "GenerateStream"
+        )
+        for proto in call(msg.to_proto(), timeout=timeout_s,
+                          metadata=self._call_metadata()):
+            out = InternalMessage.from_proto(proto)
+            yield out.array().astype(np.int32).reshape(-1)
+
     def feedback(
         self,
         request: Optional[Union[InternalMessage, Any]] = None,

@@ -376,6 +376,55 @@ def add_seldon_service(server: grpc.aio.Server, gateway: Gateway, auth=None) -> 
         out = await gateway.send_feedback(fb)
         return out.to_proto()
 
+    async def generate_stream(request: pb.SeldonMessage, context):
+        """Token streaming on the aio server — same eligibility rule as
+        the sync lane: a single-local-model predictor whose component
+        implements ``predict_stream``.  The blocking generator is
+        driven from the default executor so the event loop never
+        blocks on a decode chunk."""
+        await check_auth(context)
+        import numpy as np
+
+        from seldon_core_tpu.runtime.component import MicroserviceError
+
+        msg = InternalMessage.from_proto(request)
+        svc = gateway.pick()
+        fast = svc.single_local_model()
+        component = fast[1] if fast is not None else None
+        gen_fn = getattr(component, "predict_stream", None)
+        if gen_fn is None:
+            await context.abort(
+                grpc.StatusCode.UNIMPLEMENTED,
+                "GenerateStream needs a single-local-model predictor whose "
+                "component implements predict_stream (e.g. STREAMING_LM)",
+            )
+        meta = {"tags": dict(msg.meta.tags), "puid": msg.meta.puid}
+        loop = asyncio.get_running_loop()
+        it = gen_fn(msg.array(), [], meta=meta)
+        sentinel = object()
+        try:
+            while True:
+                try:
+                    chunk = await loop.run_in_executor(None, next, it, sentinel)
+                except MicroserviceError as e:
+                    await context.abort(
+                        grpc.StatusCode.INVALID_ARGUMENT
+                        if 400 <= e.status_code < 500
+                        else grpc.StatusCode.INTERNAL,
+                        str(e),
+                    )
+                if chunk is sentinel:
+                    break
+                out = InternalMessage(
+                    payload=np.asarray(chunk)[None, :], kind="ndarray"
+                )
+                out.meta.puid = msg.meta.puid
+                yield out.to_proto()
+        finally:
+            # client cancel/disconnect: closing the generator triggers
+            # its finally-clause, which cancels the engine stream
+            await loop.run_in_executor(None, it.close)
+
     async def predict_stream(request_iterator, context):
         """Chunked predict: reassemble -> predict -> stream the reply.
 
@@ -405,6 +454,7 @@ def add_seldon_service(server: grpc.aio.Server, gateway: Gateway, auth=None) -> 
                     "Predict": predict,
                     "SendFeedback": send_feedback,
                     "PredictStream": predict_stream,
+                    "GenerateStream": generate_stream,
                 },
             ),
         )

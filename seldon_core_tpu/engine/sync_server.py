@@ -25,6 +25,7 @@ from concurrent import futures
 from typing import Optional
 
 import grpc
+import numpy as np
 
 from seldon_core_tpu.proto import pb, services
 from seldon_core_tpu.runtime.message import InternalFeedback, InternalMessage
@@ -67,6 +68,49 @@ class SyncSeldonService:
         fb = InternalFeedback.from_proto(request)
         out = self._bridge(self.gateway.send_feedback(fb))
         return out.to_proto()
+
+    def generate_stream(self, request: pb.SeldonMessage, context):
+        """Token streaming (server-streaming ``Seldon/GenerateStream``):
+        one prompt in, a SeldonMessage of newly decoded token ids out
+        per engine chunk.  Served when the picked predictor is a single
+        local model whose component implements ``predict_stream``
+        (STREAMING_LM does); anything else is UNIMPLEMENTED with
+        guidance — graph semantics for mid-stream transformers don't
+        exist in the contract."""
+        self._check_auth(context)
+        from seldon_core_tpu.runtime.component import MicroserviceError
+
+        msg = InternalMessage.from_proto(request)
+        svc = self.gateway.pick()
+        fast = svc.single_local_model()
+        component = fast[1] if fast is not None else None
+        gen_fn = getattr(component, "predict_stream", None)
+        if gen_fn is None:
+            context.abort(
+                grpc.StatusCode.UNIMPLEMENTED,
+                "GenerateStream needs a single-local-model predictor whose "
+                "component implements predict_stream (e.g. STREAMING_LM)",
+            )
+        meta = {"tags": dict(msg.meta.tags), "puid": msg.meta.puid}
+        it = gen_fn(msg.array(), [], meta=meta)
+        try:
+            for chunk in it:
+                out = InternalMessage(
+                    payload=np.asarray(chunk)[None, :], kind="ndarray"
+                )
+                out.meta.puid = msg.meta.puid
+                yield out.to_proto()
+        except MicroserviceError as e:
+            context.abort(
+                grpc.StatusCode.INVALID_ARGUMENT
+                if 400 <= e.status_code < 500 else grpc.StatusCode.INTERNAL,
+                str(e),
+            )
+        finally:
+            # client cancel/disconnect: closing the component generator
+            # runs its finally-clause, cancelling the engine stream so
+            # an abandoned request stops holding a slot
+            it.close()
 
     def predict_stream(self, request_iterator, context):
         """Chunked predict: reassemble on the handler thread, run the
@@ -116,6 +160,7 @@ def build_sync_seldon_server(
                     "Predict": service.predict,
                     "SendFeedback": service.send_feedback,
                     "PredictStream": service.predict_stream,
+                    "GenerateStream": service.generate_stream,
                 },
             ),
         )

@@ -35,6 +35,7 @@ need no dynamic control flow.
 
 from __future__ import annotations
 
+import queue as _queue
 import threading
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
@@ -194,7 +195,7 @@ class _Stream:
     __slots__ = (
         "req_id", "prompt", "max_new", "temperature", "top_k", "eos_id",
         "seed", "tokens", "event", "result", "error", "slot", "pages",
-        "pending", "draft_hint",
+        "pending", "draft_hint", "token_queue", "streamed", "cancelled",
     )
 
     def __init__(self, req_id, prompt, max_new, temperature, top_k, eos_id, seed):
@@ -216,6 +217,14 @@ class _Stream:
         self.pending: Optional[int] = None
         # draft='oracle' benchmarking lane: the expected continuation
         self.draft_hint: Optional[np.ndarray] = None
+        # token streaming: when set, every decode chunk pushes its new
+        # tokens here as they land; None marks the end of the stream.
+        # `streamed` is the already-pushed cursor — eviction resets
+        # tokens but not the cursor, so the deterministic re-run
+        # resumes pushing exactly where the consumer left off
+        self.token_queue: Optional["_queue.Queue"] = None
+        self.streamed = 0
+        self.cancelled = False
 
 
 class PagedEngine:
@@ -509,6 +518,7 @@ class PagedEngine:
         eos_id: int = -1,
         seed: int = 0,
         draft_hint: Optional[np.ndarray] = None,
+        stream_tokens: bool = False,
     ) -> _Stream:
         """Queue one prompt (1-D int array). Returns a stream handle whose
         ``event`` fires when ``result`` (``(max_new,)`` ids) is ready.
@@ -558,6 +568,8 @@ class PagedEngine:
             )
             if draft_hint is not None:
                 stream.draft_hint = np.asarray(draft_hint, np.int32).reshape(-1)
+            if stream_tokens:
+                stream.token_queue = _queue.Queue()
             self._next_id += 1
             self._queue.append(stream)
         return stream
@@ -640,6 +652,21 @@ class PagedEngine:
             stream.pages.extend(got)
         return True
 
+    def _stream_push(self, stream: _Stream) -> None:
+        """Push tokens the consumer has not seen yet (clamped to the
+        stream's budget and cut at eos, matching _finish_locked's
+        truncation so streamed == final result)."""
+        q = stream.token_queue
+        if q is None:
+            return
+        toks = stream.tokens[: stream.max_new]
+        if stream.eos_id in toks:
+            toks = toks[: toks.index(stream.eos_id) + 1]
+        new = toks[stream.streamed :]
+        if new:
+            stream.streamed += len(new)
+            q.put([int(t) for t in new])
+
     def _finish_locked(self, stream: _Stream) -> None:
         slot = stream.slot
         toks = stream.tokens[: stream.max_new]
@@ -649,6 +676,9 @@ class PagedEngine:
             toks = toks[:cut] + [eos] * (stream.max_new - cut)
         toks = toks + [eos] * (stream.max_new - len(toks))
         stream.result = np.asarray(toks, np.int32)
+        self._stream_push(stream)
+        if stream.token_queue is not None:
+            stream.token_queue.put(None)  # end-of-stream
         self._slots[slot] = None
         self._free(stream.pages)
         stream.pages = []
@@ -668,6 +698,39 @@ class PagedEngine:
         self._lengths[slot] = 0
         self._counters["evictions"] += 1
         self._queue.insert(0, stream)
+
+    def cancel(self, stream: _Stream) -> None:
+        """Abandon a stream (consumer disconnected): a queued stream is
+        resolved immediately; an in-slot stream is flagged and the step
+        loop retires it at its next bookkeeping point — never mid
+        device-chunk, so slot/page state can't race the in-flight call.
+        Its pages free and the slot re-admits the queue head."""
+        with self._lock:
+            if stream.result is not None or stream.error is not None:
+                return
+            if stream in self._queue:
+                self._queue.remove(stream)
+                toks = stream.tokens[: stream.max_new]
+                stream.result = np.asarray(
+                    toks + [stream.eos_id] * (stream.max_new - len(toks)),
+                    np.int32,
+                )
+                if stream.token_queue is not None:
+                    stream.token_queue.put(None)
+                stream.event.set()
+                return
+            stream.cancelled = True
+
+    def _retire_cancelled_locked(self, active: List[_Stream]) -> List[_Stream]:
+        """Finish flagged streams before the next chunk; returns the
+        still-live subset."""
+        live = []
+        for stream in active:
+            if stream.cancelled:
+                self._finish_locked(stream)
+            else:
+                live.append(stream)
+        return live
 
     def has_work(self) -> bool:
         with self._lock:
@@ -711,6 +774,8 @@ class PagedEngine:
                     self._free(stream.pages)
                     stream.pages = []
                 stream.error = exc
+                if stream.token_queue is not None:
+                    stream.token_queue.put(None)  # unblock the consumer
                 stream.event.set()
 
     def step(self) -> bool:
@@ -728,7 +793,9 @@ class PagedEngine:
 
         with self._lock:
             self._counters["prefills"] += len(admitted)
-            active = [s for s in self._slots if s is not None]
+            active = self._retire_cancelled_locked(
+                [s for s in self._slots if s is not None]
+            )
             if not active:
                 return bool(self._queue)
             stalled = np.zeros((self.max_slots,), bool)
@@ -792,6 +859,8 @@ class PagedEngine:
                 hit_eos = stream.eos_id in got
                 if hit_eos or len(stream.tokens) >= stream.max_new:
                     self._finish_locked(stream)
+                else:
+                    self._stream_push(stream)
             return bool(self._queue) or any(s is not None for s in self._slots)
 
     def _step_speculative(self) -> bool:
@@ -820,7 +889,11 @@ class PagedEngine:
                 self._counters["tokens"] += 1
                 if stream.pending == stream.eos_id or len(stream.tokens) >= stream.max_new:
                     self._finish_locked(stream)
-            active = [s for s in self._slots if s is not None]
+                else:
+                    self._stream_push(stream)
+            active = self._retire_cancelled_locked(
+                [s for s in self._slots if s is not None]
+            )
             if not active:
                 return bool(self._queue)
             stalled = np.zeros((self.max_slots,), bool)
@@ -895,6 +968,8 @@ class PagedEngine:
                 hit_eos = stream.eos_id in got
                 if hit_eos or len(stream.tokens) >= stream.max_new:
                     self._finish_locked(stream)
+                else:
+                    self._stream_push(stream)
             return bool(self._queue) or any(s is not None for s in self._slots)
 
     def run(self) -> None:
@@ -1062,6 +1137,67 @@ class StreamingLM(TPUComponent):
             if stream.error:
                 raise stream.error
         return np.stack([s.result for s in streams])
+
+    def predict_stream(self, X, names=None, meta=None):
+        """Token streaming for ONE prompt: a generator yielding int32
+        arrays of newly decoded tokens as the engine emits them (the
+        serving UX modern generation stacks expose; the reference
+        predates it).  Same per-request overrides as predict; greedy
+        re-runs after an eviction resume exactly where the consumer
+        left off (deterministic seeds + the streamed cursor).
+        """
+        if self.engine is None:
+            with self._load_lock:
+                if self.engine is None:
+                    self.load()
+        meta = meta or {}
+        tags = meta.get("tags", {})
+        max_new = int(tags.get("max_new_tokens", self.max_new_tokens))
+        temperature = float(tags.get("temperature", self.temperature))
+        top_k = int(tags.get("top_k", self.top_k))
+        # same seed rule as predict: tag override > puid > counter, so a
+        # streamed request samples identically to the unary predict of
+        # the same request (and a retried stream with the same puid
+        # reproduces its continuation)
+        if "seed" in tags:
+            request_seed = int(tags["seed"])
+        else:
+            puid = meta.get("puid", "")
+            if puid:
+                import zlib
+
+                request_seed = zlib.crc32(puid.encode())
+            else:
+                with self._counter_lock:
+                    self._counter += 1
+                    request_seed = self._counter
+        X = np.atleast_2d(np.asarray(X, np.int32))
+        if X.shape[0] != 1:
+            raise MicroserviceError(
+                "token streaming serves one prompt per stream; send rows "
+                "separately (predict() batches them)",
+                status_code=400, reason="BAD_REQUEST",
+            )
+        stream = self.engine.submit(
+            X[0], max_new_tokens=max_new, temperature=temperature,
+            top_k=top_k, eos_id=self.eos_id,
+            seed=self.seed ^ (request_seed * 1000003),
+            stream_tokens=True,
+        )
+        self._wake.set()
+        try:
+            while True:
+                got = stream.token_queue.get()
+                if got is None:
+                    break
+                yield np.asarray(got, np.int32)
+            if stream.error:
+                raise stream.error
+        finally:
+            # consumer gone (disconnect/cancel) or done: an abandoned
+            # stream must not keep decoding into an unread queue,
+            # holding a slot and pages against live requests
+            self.engine.cancel(stream)
 
     def metrics(self):
         """Paged-engine health for the dashboards.  All GAUGEs:

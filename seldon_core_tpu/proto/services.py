@@ -56,6 +56,15 @@ STREAM_METHODS: Dict[str, Dict[str, Tuple[type, type]]] = {
     },
 }
 
+# unary-stream methods (server streaming; additive): token streaming
+# for the generation family — one prompt in, incremental token chunks
+# out as the continuous-batching engine emits them
+UNARY_STREAM_METHODS: Dict[str, Dict[str, Tuple[type, type]]] = {
+    "Seldon": {
+        "GenerateStream": (pb.SeldonMessage, pb.SeldonMessage),
+    },
+}
+
 # default chunk payload size for the streaming lanes (1 MiB keeps each
 # frame comfortably under any configured gRPC message cap)
 STREAM_CHUNK_BYTES = 1 << 20
@@ -119,6 +128,15 @@ def generic_handler(service: str, dispatch: Dict[str, Callable]):
             request_deserializer=req_cls.FromString,
             response_serializer=lambda msg, _c=resp_cls: msg.SerializeToString(),
         )
+    for method, (req_cls, resp_cls) in UNARY_STREAM_METHODS.get(service, {}).items():
+        fn = dispatch.get(method)
+        if fn is None:
+            continue
+        handlers[method] = grpc.unary_stream_rpc_method_handler(
+            fn,
+            request_deserializer=req_cls.FromString,
+            response_serializer=lambda msg, _c=resp_cls: msg.SerializeToString(),
+        )
     return grpc.method_handlers_generic_handler(full_service_name(service), handlers)
 
 
@@ -126,6 +144,16 @@ def stream_callable(channel, service: str, method: str):
     """Client-side stream-stream callable for service/method."""
     _req_cls, resp_cls = STREAM_METHODS[service][method]
     return channel.stream_stream(
+        method_path(service, method),
+        request_serializer=lambda msg: msg.SerializeToString(),
+        response_deserializer=resp_cls.FromString,
+    )
+
+
+def unary_stream_callable(channel, service: str, method: str):
+    """Client-side unary-stream callable (server streaming)."""
+    _req_cls, resp_cls = UNARY_STREAM_METHODS[service][method]
+    return channel.unary_stream(
         method_path(service, method),
         request_serializer=lambda msg: msg.SerializeToString(),
         response_deserializer=resp_cls.FromString,

@@ -254,6 +254,232 @@ class TestSpeculativeEngine:
             spec.shutdown()
 
 
+class TestTokenStreaming:
+    """Incremental token delivery from the continuous-batching engine
+    (additive to the reference contract — it predates generation)."""
+
+    def test_streamed_tokens_equal_batch_result(self, lm):
+        _, params = lm
+        eng = _engine(params, steps_per_call=2)
+        prompt = np.array([5, 9, 13, 2, 30], np.int32)
+        want = eng.generate(prompt, max_new_tokens=8)
+        s = eng.submit(prompt, max_new_tokens=8, stream_tokens=True)
+        chunks = []
+        import threading as _t
+
+        runner = _t.Thread(target=eng.run)
+        runner.start()
+        while True:
+            got = s.token_queue.get(timeout=30)
+            if got is None:
+                break
+            chunks.append(got)
+        runner.join()
+        streamed = [t for c in chunks for t in c]
+        # several incremental chunks, concatenating to the exact result
+        assert len(chunks) >= 2
+        assert streamed == want.tolist()
+        np.testing.assert_array_equal(s.result, want)
+
+    def test_streaming_clamps_at_eos_and_budget(self, lm):
+        module, params = lm
+        prompt = np.array([5, 9, 13, 2, 30], np.int32)
+        first = _greedy_uncached(module, params, prompt[None], 1)[0]
+        eng = _engine(params, steps_per_call=4)
+        s = eng.submit(prompt, max_new_tokens=6, eos_id=first, stream_tokens=True)
+        eng.run()
+        chunks = []
+        while True:
+            got = s.token_queue.get(timeout=10)
+            if got is None:
+                break
+            chunks.append(got)
+        streamed = [t for c in chunks for t in c]
+        # stream ends at eos (inclusive), matching the padded result's cut
+        assert streamed == [first]
+
+    def test_streaminglm_predict_stream_component(self, lm):
+        _, params = lm
+        import tempfile
+
+        from flax import serialization
+
+        with tempfile.NamedTemporaryFile(suffix=".msgpack", delete=False) as f:
+            path = f.name
+            f.write(serialization.to_bytes(params))
+        comp = StreamingLM(model_uri=f"file://{path}", page_size=8,
+                           max_slots=4, max_new_tokens=8, **CFG)
+        try:
+            X = np.array([[5, 9, 13, 2, 30]], np.int32)
+            want = comp.predict(X, [])[0]
+            streamed = np.concatenate(list(comp.predict_stream(X, [])))
+            np.testing.assert_array_equal(streamed, want)
+            # multi-row predict_stream is a 400
+            with pytest.raises(MicroserviceError):
+                list(comp.predict_stream(np.ones((2, 3), np.int32), []))
+        finally:
+            comp.shutdown()
+
+    def test_abandoned_stream_frees_slot(self, lm):
+        """A consumer that stops reading must not leave the stream
+        decoding into an unread queue holding a slot/pages."""
+        _, params = lm
+        import tempfile
+
+        from flax import serialization
+
+        with tempfile.NamedTemporaryFile(suffix=".msgpack", delete=False) as f:
+            path = f.name
+            f.write(serialization.to_bytes(params))
+        comp = StreamingLM(model_uri=f"file://{path}", page_size=8,
+                           max_slots=2, max_new_tokens=30, steps_per_call=1,
+                           **CFG)
+        try:
+            gen = comp.predict_stream(np.array([[5, 9, 13]], np.int32), [])
+            first = next(gen)
+            assert len(first) >= 1
+            gen.close()  # consumer walks away
+            # the engine retires the stream at its next bookkeeping
+            # point: slot + pages free, loop goes idle
+            import time as _time
+
+            deadline = _time.time() + 20
+            while _time.time() < deadline:
+                stats = comp.engine.engine_stats()
+                if stats["active_slots"] == 0 and stats["queued_streams"] == 0:
+                    break
+                _time.sleep(0.1)
+            stats = comp.engine.engine_stats()
+            assert stats["active_slots"] == 0 and stats["queued_streams"] == 0
+            assert stats["pool_pages_used"] == 0
+            # far fewer tokens decoded than the abandoned budget
+            assert stats["tokens"] < 25
+            # the engine still serves new work afterwards
+            out = comp.predict(np.array([[1, 2]], np.int32), [],
+                               meta={"tags": {"max_new_tokens": 4}})
+            assert out.shape == (1, 4)
+        finally:
+            comp.shutdown()
+
+    def test_cancel_queued_stream_resolves_immediately(self, lm):
+        _, params = lm
+        eng = _engine(params)
+        s = eng.submit(np.array([1, 2, 3], np.int32), max_new_tokens=4,
+                       stream_tokens=True)
+        eng.cancel(s)  # never stepped: still queued
+        assert s.event.is_set()
+        assert s.token_queue.get(timeout=1) is None
+        assert not eng.has_work()
+
+    def test_grpc_generate_stream_end_to_end(self, lm):
+        """Seldon/GenerateStream over a real socket through the sync
+        server + client SDK."""
+        import asyncio
+        import tempfile
+
+        from flax import serialization
+
+        from seldon_core_tpu.client.client import SeldonTpuClient
+        from seldon_core_tpu.engine import PredictorService, UnitSpec
+        from seldon_core_tpu.engine.server import Gateway
+        from seldon_core_tpu.engine.sync_server import build_sync_seldon_server
+
+        _, params = lm
+        with tempfile.NamedTemporaryFile(suffix=".msgpack", delete=False) as f:
+            path = f.name
+            f.write(serialization.to_bytes(params))
+        comp = StreamingLM(model_uri=f"file://{path}", page_size=8,
+                           max_slots=4, max_new_tokens=8, steps_per_call=2,
+                           **CFG)
+
+        async def scenario():
+            svc = PredictorService(
+                UnitSpec(name="lm", type="MODEL", component=comp), name="main"
+            )
+            gw = Gateway([(svc, 1.0)])
+            server = build_sync_seldon_server(gw, asyncio.get_running_loop())
+            port = server.add_insecure_port("127.0.0.1:0")
+            server.start()
+
+            def client_work():
+                client = SeldonTpuClient(grpc_port=port, transport="grpc")
+                chunks = list(client.generate_stream(
+                    [5, 9, 13, 2, 30],
+                    meta={"tags": {"max_new_tokens": 6}},
+                ))
+                batch = client.predict(
+                    np.array([[5, 9, 13, 2, 30]], np.int32),
+                    meta={"tags": {"max_new_tokens": 6}},
+                )
+                client.close()
+                return chunks, batch
+
+            chunks, batch = await asyncio.to_thread(client_work)
+            await asyncio.to_thread(server.stop(0).wait)
+            return chunks, batch
+
+        chunks, batch = asyncio.run(scenario())
+        try:
+            streamed = np.concatenate(chunks)
+            assert len(chunks) >= 2  # genuinely incremental
+            np.testing.assert_array_equal(streamed, np.asarray(batch.data).reshape(-1))
+        finally:
+            comp.shutdown()
+
+
+    def test_aio_server_generate_stream(self, lm):
+        """The grpc.aio lane serves GenerateStream too (feature parity
+        across both gRPC server modes)."""
+        import asyncio
+        import tempfile
+
+        import grpc
+        from flax import serialization
+
+        from seldon_core_tpu.engine import PredictorService, UnitSpec
+        from seldon_core_tpu.engine.server import Gateway, add_seldon_service
+        from seldon_core_tpu.proto import services as proto_services
+        from seldon_core_tpu.runtime.message import InternalMessage
+
+        _, params = lm
+        with tempfile.NamedTemporaryFile(suffix=".msgpack", delete=False) as f:
+            path = f.name
+            f.write(serialization.to_bytes(params))
+        comp = StreamingLM(model_uri=f"file://{path}", page_size=8,
+                           max_slots=2, max_new_tokens=6, steps_per_call=2,
+                           **CFG)
+
+        async def scenario():
+            gw = Gateway([(PredictorService(
+                UnitSpec(name="lm", type="MODEL", component=comp), name="main"), 1.0)])
+            server = grpc.aio.server()
+            add_seldon_service(server, gw)
+            port = server.add_insecure_port("127.0.0.1:0")
+            await server.start()
+            channel = grpc.aio.insecure_channel(f"127.0.0.1:{port}")
+            call = proto_services.unary_stream_callable(
+                channel, "Seldon", "GenerateStream"
+            )
+            req = InternalMessage(
+                payload=__import__("numpy").array([[5, 9, 13]], "int32"),
+                kind="ndarray",
+            ).to_proto()
+            chunks = []
+            async for msg in call(req):
+                chunks.append(InternalMessage.from_proto(msg).array().reshape(-1))
+            await channel.close()
+            await server.stop(grace=None)
+            return chunks
+
+        chunks = asyncio.run(scenario())
+        try:
+            total = np.concatenate(chunks)
+            assert total.shape == (6,)
+            assert len(chunks) >= 2
+        finally:
+            comp.shutdown()
+
+
 class TestPageAccounting:
     def test_pages_are_reused_across_requests(self, lm):
         _, params = lm
