@@ -147,7 +147,12 @@ class SeldonTpuClient:
                 )
             raise
 
-    def _rest_post(self, path: str, body: Dict[str, Any]) -> Tuple[int, Dict[str, Any]]:
+    def _rest_request(self, path: str, body: Dict[str, Any], stream: bool = False,
+                      timeout: Any = None):
+        """One REST POST with the client's full connection setup (TLS
+        scheme, bearer + X-Auth-Token headers, one transparent 401
+        token refresh) — shared by the unary and SSE lanes so auth/TLS
+        behavior cannot drift between them."""
         import requests
 
         if self._session is None:
@@ -165,15 +170,23 @@ class SeldonTpuClient:
         if self.call_credentials is not None and self.call_credentials.token:
             headers["X-Auth-Token"] = self.call_credentials.token
         url = f"{scheme}://{self.host}:{self.http_port}{path}"
+        send_timeout = timeout if timeout is not None else self.timeout_s
         resp = self._session.post(
-            url, json=body, timeout=self.timeout_s, headers=headers or None, **kwargs
+            url, json=body, timeout=send_timeout, headers=headers or None,
+            stream=stream, **kwargs
         )
         if resp.status_code == 401 and self.oauth_key:
             # expired token: one transparent refresh
+            resp.close()
             headers["Authorization"] = f"Bearer {self.get_token(refresh=True)}"
             resp = self._session.post(
-                url, json=body, timeout=self.timeout_s, headers=headers, **kwargs
+                url, json=body, timeout=send_timeout, headers=headers,
+                stream=stream, **kwargs
             )
+        return resp
+
+    def _rest_post(self, path: str, body: Dict[str, Any]) -> Tuple[int, Dict[str, Any]]:
+        resp = self._rest_request(path, body)
         try:
             return resp.status_code, resp.json()
         except ValueError:
@@ -263,31 +276,72 @@ class SeldonTpuClient:
         meta: Optional[Dict[str, Any]] = None,
         timeout_s: Optional[float] = None,
     ):
-        """Token streaming (``Seldon/GenerateStream``): yields int32
-        arrays of newly decoded tokens for ONE prompt as the server's
-        generation engine emits them.  Per-request overrides
-        (max_new_tokens / temperature / top_k / seed) travel in
-        ``meta={"tags": {...}}``.  gRPC transport only.
+        """Token streaming: yields int32 arrays of newly decoded tokens
+        for ONE prompt as the server's generation engine emits them.
+        Per-request overrides (max_new_tokens / temperature / top_k /
+        seed) travel in ``meta={"tags": {...}}``.
 
-        ``timeout_s`` bounds the WHOLE stream; the default (None) sets
-        no deadline — a long generation outlives the client's unary
-        ``timeout_s``, and the server frees the stream's slot if the
-        consumer disconnects."""
+        Transports: gRPC uses ``Seldon/GenerateStream`` (``timeout_s``
+        is the whole-stream deadline; None = no deadline); REST uses
+        Server-Sent Events from ``/api/v0.1/generate/stream``
+        (``timeout_s`` is the connect/per-chunk read timeout — a slow
+        but steadily-emitting stream never times out).  Either way the
+        server frees the stream's slot if the consumer disconnects."""
         import numpy as np
 
-        from seldon_core_tpu.proto import services
-
-        if self.transport != "grpc":
-            raise ValueError("generate_stream requires transport='grpc'")
         msg = self._build_message(np.atleast_2d(np.asarray(prompt, np.int32)),
                                   None, None, meta)
-        call = services.unary_stream_callable(
-            self._ensure_channel(), "Seldon", "GenerateStream"
-        )
-        for proto in call(msg.to_proto(), timeout=timeout_s,
-                          metadata=self._call_metadata()):
-            out = InternalMessage.from_proto(proto)
-            yield out.array().astype(np.int32).reshape(-1)
+        if self.transport == "grpc":
+            from seldon_core_tpu.proto import services
+
+            call = services.unary_stream_callable(
+                self._ensure_channel(), "Seldon", "GenerateStream"
+            )
+            for proto in call(msg.to_proto(), timeout=timeout_s,
+                              metadata=self._call_metadata()):
+                out = InternalMessage.from_proto(proto)
+                yield out.array().astype(np.int32).reshape(-1)
+            return
+        yield from self._generate_stream_rest(msg, timeout_s)
+
+    def _generate_stream_rest(self, msg: InternalMessage, timeout_s):
+        """SSE lane: parse `data:` events into token arrays.  An
+        `event: error` surfaces as ConnectionError — and so does a
+        stream that closes WITHOUT an `end` event (a server crash or
+        dropped connection must not read as a complete generation;
+        the gRPC lane raises RpcError for the same cases)."""
+        import json as _json
+
+        import numpy as np
+
+        with self._rest_request(
+            "/api/v0.1/generate/stream", msg.to_json(), stream=True,
+            timeout=timeout_s,
+        ) as resp:
+            if resp.status_code >= 400:
+                raise ConnectionError(
+                    f"generate stream rejected: {resp.status_code} {resp.text[:200]}"
+                )
+            event = ""
+            ended = False
+            for line in resp.iter_lines(decode_unicode=True):
+                if not line:
+                    event = ""
+                    continue
+                if line.startswith("event:"):
+                    event = line.split(":", 1)[1].strip()
+                elif line.startswith("data:"):
+                    payload = _json.loads(line.split(":", 1)[1].strip())
+                    if event == "error":
+                        raise ConnectionError(f"stream error: {payload}")
+                    if event == "end":
+                        ended = True
+                        break
+                    yield np.asarray(payload["tokens"], np.int32)
+            if not ended:
+                raise ConnectionError(
+                    "token stream closed without an end event (truncated)"
+                )
 
     def feedback(
         self,

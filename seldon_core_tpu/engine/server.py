@@ -297,6 +297,86 @@ def build_gateway_app(gateway: Gateway, auth=None) -> web.Application:
         except Exception as e:  # noqa: BLE001
             return _error_response(e)
 
+    async def generate_stream_sse(request: web.Request) -> web.StreamResponse:
+        """Token streaming over HTTP: Server-Sent Events, one
+        ``data: {"tokens": [...]}`` event per engine chunk, then
+        ``event: end`` carrying the puid (the REST twin of the gRPC
+        ``Seldon/GenerateStream`` lane; same eligibility rule)."""
+        import asyncio as _asyncio
+        import json as _json
+
+        import numpy as _np
+
+        from seldon_core_tpu.runtime.component import MicroserviceError
+
+        try:
+            body = await _request_body(request)
+            msg = InternalMessage.from_json(body)
+        except Exception as e:  # noqa: BLE001
+            return _error_response(e)
+        svc = gateway.by_name(request.query.get("predictor", "")) or gateway.pick()
+        fast = svc.single_local_model()
+        component = fast[1] if fast is not None else None
+        gen_fn = getattr(component, "predict_stream", None)
+        if gen_fn is None:
+            return web.json_response(
+                {"status": {"status": "FAILURE", "code": 501,
+                            "info": "token streaming needs a single-local-model "
+                                    "predictor whose component implements "
+                                    "predict_stream (e.g. STREAMING_LM)",
+                            "reason": "NOT_IMPLEMENTED"}},
+                status=501,
+            )
+        meta = {"tags": dict(msg.meta.tags), "puid": msg.meta.puid}
+        loop = _asyncio.get_running_loop()
+        sentinel = object()
+        # pull the FIRST chunk before sending headers: bad prompts /
+        # engine rejections surface as proper HTTP errors, not an
+        # abruptly-closed 200 stream (the gRPC twin aborts with status)
+        try:
+            arr = msg.array()
+            it = gen_fn(arr, [], meta=meta)
+            first = await loop.run_in_executor(None, next, it, sentinel)
+        except Exception as e:  # noqa: BLE001
+            return _error_response(e)
+        resp = web.StreamResponse(headers={
+            "Content-Type": "text/event-stream",
+            "Cache-Control": "no-cache",
+        })
+        try:
+            await resp.prepare(request)
+            chunk = first
+            while True:
+                if chunk is sentinel:
+                    await resp.write(
+                        (f"event: end\ndata: {_json.dumps({'puid': msg.meta.puid})}\n\n").encode()
+                    )
+                    break
+                payload = _json.dumps({"tokens": _np.asarray(chunk).tolist()})
+                await resp.write(f"data: {payload}\n\n".encode())
+                try:
+                    chunk = await loop.run_in_executor(None, next, it, sentinel)
+                except MicroserviceError as e:
+                    await resp.write(
+                        (f"event: error\ndata: {_json.dumps(e.to_status())}\n\n").encode()
+                    )
+                    break
+                except Exception as e:  # noqa: BLE001 — mid-stream engine fault:
+                    # the consumer must see an error event, never a
+                    # silent truncation that reads as completion
+                    status = {"status": "FAILURE", "code": 500,
+                              "info": str(e), "reason": "ENGINE_ERROR"}
+                    await resp.write(
+                        (f"event: error\ndata: {_json.dumps(status)}\n\n").encode()
+                    )
+                    break
+            await resp.write_eof()
+        except (ConnectionResetError, ConnectionError, _asyncio.CancelledError):
+            pass  # client went away; the finally-clause frees the stream
+        finally:
+            await loop.run_in_executor(None, it.close)
+        return resp
+
     async def feedback(request: web.Request) -> web.Response:
         try:
             body = await _request_body(request)
@@ -339,6 +419,7 @@ def build_gateway_app(gateway: Gateway, auth=None) -> web.Application:
     app.router.add_get("/api/v0.1/predictions", predictions)
     app.router.add_post("/predict", predictions)  # convenience alias
     app.router.add_post("/api/v0.1/feedback", feedback)
+    app.router.add_post("/api/v0.1/generate/stream", generate_stream_sse)
     app.router.add_post("/api/v0.1/explanations", explanations)
     app.router.add_get("/ping", ping)
     app.router.add_get("/live", live)

@@ -427,6 +427,133 @@ class TestTokenStreaming:
             comp.shutdown()
 
 
+    def test_rest_sse_generate_stream_end_to_end(self, lm):
+        """Token streaming over REST: /api/v0.1/generate/stream emits
+        SSE events, the client SDK parses them, tokens match the unary
+        predict of the same request."""
+        import asyncio
+        import tempfile
+
+        from flax import serialization
+
+        from seldon_core_tpu.client.client import SeldonTpuClient
+        from seldon_core_tpu.engine import PredictorService, UnitSpec
+        from seldon_core_tpu.engine.server import Gateway, build_gateway_app
+
+        _, params = lm
+        with tempfile.NamedTemporaryFile(suffix=".msgpack", delete=False) as f:
+            path = f.name
+            f.write(serialization.to_bytes(params))
+        comp = StreamingLM(model_uri=f"file://{path}", page_size=8,
+                           max_slots=4, max_new_tokens=8, steps_per_call=2,
+                           **CFG)
+
+        async def scenario():
+            from aiohttp.test_utils import TestServer as AioTestServer
+
+            svc = PredictorService(
+                UnitSpec(name="lm", type="MODEL", component=comp), name="main"
+            )
+            gw = Gateway([(svc, 1.0)])
+            server = AioTestServer(build_gateway_app(gw))
+            await server.start_server()
+            port = server.port
+
+            def client_work():
+                client = SeldonTpuClient(http_port=port, transport="rest")
+                chunks = list(client.generate_stream(
+                    [5, 9, 13, 2, 30], meta={"tags": {"max_new_tokens": 6}}
+                ))
+                batch = client.predict(
+                    np.array([[5, 9, 13, 2, 30]], np.int32),
+                    meta={"tags": {"max_new_tokens": 6}},
+                )
+                client.close()
+                return chunks, batch
+
+            chunks, batch = await asyncio.to_thread(client_work)
+            await server.close()
+            return chunks, batch
+
+        chunks, batch = asyncio.run(scenario())
+        try:
+            assert len(chunks) >= 2
+            np.testing.assert_array_equal(
+                np.concatenate(chunks), np.asarray(batch.data).reshape(-1)
+            )
+        finally:
+            comp.shutdown()
+
+    def test_rest_sse_bad_prompt_is_http_error_not_stream(self, lm):
+        """Rejections surface BEFORE headers: a bad prompt gets a JSON
+        error status, never an abruptly-closed 200 stream."""
+        import asyncio
+        import tempfile
+
+        from flax import serialization
+
+        from seldon_core_tpu.engine import PredictorService, UnitSpec
+        from seldon_core_tpu.engine.server import Gateway, build_gateway_app
+
+        _, params = lm
+        with tempfile.NamedTemporaryFile(suffix=".msgpack", delete=False) as f:
+            path = f.name
+            f.write(serialization.to_bytes(params))
+        comp = StreamingLM(model_uri=f"file://{path}", page_size=8,
+                           max_slots=2, max_new_tokens=4, **CFG)
+
+        async def scenario():
+            from aiohttp.test_utils import TestClient, TestServer
+
+            svc = PredictorService(
+                UnitSpec(name="lm", type="MODEL", component=comp), name="main"
+            )
+            client = TestClient(TestServer(build_gateway_app(Gateway([(svc, 1.0)]))))
+            await client.start_server()
+            # two prompt rows: the streaming lane serves one per stream
+            resp = await client.post(
+                "/api/v0.1/generate/stream",
+                json={"data": {"ndarray": [[1, 2], [3, 4]]}},
+            )
+            body = await resp.json()
+            await client.close()
+            return resp.status, body
+
+        status, body = asyncio.run(scenario())
+        try:
+            assert status == 400
+            assert body["status"]["status"] == "FAILURE"
+        finally:
+            comp.shutdown()
+
+    def test_rest_sse_not_implemented_for_non_generation(self):
+        """A non-generation predictor answers 501 with guidance."""
+        import asyncio
+
+        from seldon_core_tpu.engine import PredictorService, UnitSpec
+        from seldon_core_tpu.engine.server import Gateway, build_gateway_app
+
+        async def scenario():
+            from aiohttp.test_utils import TestClient, TestServer
+
+            svc = PredictorService(
+                UnitSpec(name="stub", type="MODEL", implementation="SIMPLE_MODEL"),
+                name="main",
+            )
+            client = TestClient(TestServer(build_gateway_app(Gateway([(svc, 1.0)]))))
+            await client.start_server()
+            resp = await client.post(
+                "/api/v0.1/generate/stream",
+                json={"data": {"ndarray": [[1, 2]]}},
+            )
+            body = await resp.json()
+            await client.close()
+            return resp.status, body
+
+        status, body = asyncio.run(scenario())
+        assert status == 501
+        assert body["status"]["reason"] == "NOT_IMPLEMENTED"
+
     def test_aio_server_generate_stream(self, lm):
         """The grpc.aio lane serves GenerateStream too (feature parity
         across both gRPC server modes)."""
