@@ -92,8 +92,102 @@ def _read_status() -> dict:
         return {}
 
 
+FULL_RESULT_FILE = os.environ.get(
+    "BENCH_FULL_FILE", os.path.join(os.path.dirname(os.path.abspath(__file__)), "bench_full.json")
+)
+# the driver certifies ONLY the tail of stdout (~2000 chars); r3's full
+# line outgrew it and the whole round's numbers went uncertified
+# (BENCH_r03.json parsed: null).  The final printed line is therefore a
+# compact summary hard-capped well under the window; the complete
+# result lands in bench_full.json.
+COMPACT_BUDGET = 1500
+
+
+def _compact_result(full: dict) -> dict:
+    """Build the <=COMPACT_BUDGET-char certification line from the full
+    result: headline metric + the per-phase scalars the judge checks
+    (int8, generation, native-model, roofline/MFU, server-side p50),
+    priority-ordered so overflow drops the least important first."""
+    extra = full.get("extra", {}) or {}
+
+    def g(path):
+        cur = extra
+        for p in path:
+            if not isinstance(cur, dict):
+                return None
+            cur = cur.get(p)
+        return cur
+
+    # (short_key, path) in priority order — earliest survive truncation
+    picks = [
+        ("lat_p50_ms", ("latency_phase", "p50_ms")),
+        ("server_p50_ms", ("server_latency", "p50_ms")),
+        ("tput_img_s", ("throughput_phase", "images_per_s")),
+        ("inproc_img_s", ("inprocess_images_per_s",)),
+        ("roof_img_s", ("roofline", "raw_device_images_per_s")),
+        ("mfu_pct", ("roofline", "mfu_pct")),
+        ("loop_img_s", ("device_loop", "images_per_s")),
+        ("loop_mfu_pct", ("device_loop", "mfu_pct")),
+        ("int8_fwd_x", ("int8", "int8_vs_fp")),
+        ("int8_decode_x", ("generation", "int8_vs_fp_decode")),
+        ("gen_tok_s", ("generation", "decode_tokens_per_s")),
+        ("paged_tok_s", ("generation", "paged_serving_tokens_per_s")),
+        ("paged_micro_tok_s", ("generation", "paged_decode_tokens_per_s")),
+        ("spec_draft_acc", ("generation", "spec_draft_acceptance")),
+        ("spec_ngram_acc", ("generation", "spec_ngram_acceptance")),
+        ("native_img_s", ("native_model", "images_per_s")),
+        ("native_grpc_img_s", ("native_model", "grpc_images_per_s")),
+        ("native_vs_py", ("native_model", "vs_python_lane")),
+        ("h2_qps", ("native_grpc_qps",)),
+        ("h2_vs_ref", ("native_grpc_vs_reference",)),
+        ("stub_qps", ("stub_engine_qps",)),
+        ("native_front_qps", ("native_front_qps",)),
+        ("server_p99_ms", ("server_latency", "p99_ms")),
+        ("lat_p99_ms", ("latency_phase", "p99_ms")),
+        ("relay_ms", ("relay_rtt_ms",)),
+        ("device", ("device",)),
+        ("served_by", ("served_by",)),
+    ]
+    summary: dict = {}
+    for key, path in picks:
+        v = g(path)
+        if v is not None:
+            summary[key] = v
+    # semantic flags, never droppable: a truncated salvage line must not
+    # present a partial run as complete
+    if extra.get("partial"):
+        summary["partial"] = True
+    if extra.get("full_write_error"):
+        summary["full_write_error"] = True
+    summary["full"] = os.path.basename(FULL_RESULT_FILE)
+    out = {
+        "metric": full.get("metric"),
+        "value": full.get("value"),
+        "unit": full.get("unit"),
+        "vs_baseline": full.get("vs_baseline"),
+        "extra": summary,
+    }
+    # hard budget: drop lowest-priority summary keys until the line fits
+    keys_by_prio = [k for k, _ in reversed(picks) if k in summary]
+    while len(json.dumps(out)) > COMPACT_BUDGET and keys_by_prio:
+        summary.pop(keys_by_prio.pop(0), None)
+    return out
+
+
 def _emit(result: dict) -> None:
-    print(json.dumps(result), flush=True)
+    """Write the full result to bench_full.json (atomically — a stale
+    file from a prior round must never pass as this round's), print the
+    compact certification line LAST (driver contract: last line, tail
+    window)."""
+    try:
+        tmp = FULL_RESULT_FILE + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(result, f, indent=1)
+        os.replace(tmp, FULL_RESULT_FILE)
+    except OSError:
+        # flag it on the line: the pointed-at full file is NOT this run's
+        result.setdefault("extra", {})["full_write_error"] = True
+    print(json.dumps(_compact_result(result)), flush=True)
 
 
 def _result_from_partial(status: dict, diagnostics: dict) -> dict:
@@ -173,7 +267,10 @@ def supervise() -> None:
                 except ValueError:
                     continue
                 if isinstance(parsed, dict) and parsed.get("metric") and parsed.get("value") is not None:
-                    _emit(parsed)
+                    # child already wrote bench_full.json and compacted;
+                    # re-print verbatim (re-_emit would overwrite the
+                    # full file with the compact line)
+                    print(ln, flush=True)
                     return
             failures.append(
                 {

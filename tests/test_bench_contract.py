@@ -1,0 +1,210 @@
+"""Driver-certification contract for bench.py's output.
+
+The round driver records only the last ~2000 chars of bench stdout and
+json-parses the final line (r3's full line outgrew the window and the
+round's numbers went uncertified).  These tests pin the contract: the
+final line is a compact summary that always fits, carries the scalars
+the judge checks (int8, generation, native-model, MFU), and the full
+result round-trips through bench_full.json.
+"""
+
+import importlib.util
+import json
+import os
+
+import pytest
+
+_BENCH = os.path.join(os.path.dirname(__file__), os.pardir, "bench.py")
+
+
+@pytest.fixture(scope="module")
+def bench():
+    spec = importlib.util.spec_from_file_location("bench", _BENCH)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _r3_like_full_result():
+    """A full result at least as large as the r3 line that broke the
+    2000-char tail window, with every phase populated."""
+    return {
+        "metric": "resnet50_grpc_p50_ms",
+        "value": 117.093,
+        "unit": "ms",
+        "vs_baseline": 0.085,
+        "extra": {
+            "device": "TPU v5 lite0",
+            "relay_rtt_ms": 206.04,
+            "relay_rtt_min_ms": 176.23,
+            "served_by": "native-ingress (C++ h2c gRPC fast lane)",
+            "setup_s": 32.4,
+            "python_grpc_p50_ms": 116.758,
+            "inprocess_images_per_s": 3558.4,
+            "inprocess_payload": "constant (relay-compressible)",
+            "roofline": {
+                "raw_device_images_per_s": 4235.9,
+                "staging_s": 4.62,
+                "batches": 64,
+                "depth": 32,
+                "mfu_pct": 8.82,
+            },
+            "device_loop": {"images_per_s": 21000.0, "mfu_pct": 43.7, "iters": 64},
+            "server_latency": {"p50_ms": 3.1, "p99_ms": 9.8, "count": 4000},
+            "inprocess_vs_distinct_roofline": 0.84,
+            "native_model": {
+                "payload_content": "constant",
+                "images_per_s": 96.0,
+                "requests_per_s": 12.0,
+                "grpc_images_per_s": 92.0,
+                "grpc_requests_per_s": 11.5,
+                "grpc_p50_ms": None,
+                "rows_per_request": 8,
+                "connections": 4,
+                "client_depth": 4,
+                "p50_ms": 111.11,
+                "fast_requests": 746,
+                "batches": 576,
+                "errors": 0,
+                "dropped_orphans": 1,
+                "vs_python_lane": 1.2,
+            },
+            "native_model_qps": 12.0,
+            "stub_engine_qps": 18687.0,
+            "stub_vs_reference_grpc": 0.661,
+            "native_front_qps": 112147.8,
+            "native_vs_reference_grpc": 3.969,
+            "native_grpc_qps": 111044.0,
+            "native_grpc_vs_reference": 3.93,
+            "int8": {"fp_images_per_s": 12839.8, "int8_images_per_s": 12758.9, "int8_vs_fp": 0.99},
+            "generation": {
+                "decode_tokens_per_s": 8877.5,
+                "overall_tokens_per_s": 5149.1,
+                "prefill_ms": 84.42,
+                "batch": 8,
+                "prompt_len": 128,
+                "max_new": 128,
+                "config": "d512 L8 H8 v16384 bf16",
+                "int8_decode_tokens_per_s": 9723.1,
+                "int8_vs_fp_decode": 1.1,
+                "paged_decode_tokens_per_s": 89.8,
+                "paged_serving_tokens_per_s": 4400.0,
+                "paged_tokenwise_tokens_per_s": 12.7,
+                "paged_spec_oracle_tokens_per_s": 56.1,
+                "spec_oracle_vs_tokenwise": 4.4,
+                "spec_oracle_vs_plain_decode": 0.62,
+                "tokenwise_chunks": 64,
+                "spec_oracle_acceptance": 1.0,
+                "spec_ngram_acceptance": 0.541,
+                "spec_draft_acceptance": 0.87,
+                "spec_oracle_chunks": 13,
+                "plain_chunks": 8,
+            },
+            "mean_batch_rows": 26.69,
+            "device_batches": 1106,
+            "latency_phase": {
+                "concurrency": 4,
+                "qps": 29.7,
+                "p50_ms": 117.093,
+                "p90_ms": 174.635,
+                "p99_ms": 214.328,
+                "mean_ms": 134.642,
+                "errors": 0,
+            },
+            "throughput_phase": {
+                "concurrency": 8,
+                "client_batch": 32,
+                "images_per_s": 582.4,
+                "requests_per_s": 18.2,
+                "p50_ms": 423.421,
+                "errors": 0,
+            },
+        },
+    }
+
+
+def test_compact_line_fits_tail_window(bench):
+    full = _r3_like_full_result()
+    assert len(json.dumps(full)) > 2000  # the failure mode being pinned
+    compact = bench._compact_result(full)
+    line = json.dumps(compact)
+    assert len(line) <= bench.COMPACT_BUDGET
+    assert compact["metric"] == full["metric"]
+    assert compact["value"] == full["value"]
+    assert compact["vs_baseline"] == full["vs_baseline"]
+
+
+def test_compact_line_carries_judge_scalars(bench):
+    compact = bench._compact_result(_r3_like_full_result())
+    e = compact["extra"]
+    # int8 + generation + native-model (the r2/r3 certification asks)
+    assert e["int8_fwd_x"] == 0.99
+    assert e["int8_decode_x"] == 1.1
+    assert e["gen_tok_s"] == 8877.5
+    assert e["paged_tok_s"] == 4400.0
+    assert e["native_img_s"] == 96.0
+    assert e["mfu_pct"] == 8.82
+    assert e["loop_mfu_pct"] == 43.7
+    assert e["server_p50_ms"] == 3.1
+    assert e["full"] == os.path.basename(bench.FULL_RESULT_FILE)
+
+
+def test_compact_drops_low_priority_on_overflow(bench):
+    full = _r3_like_full_result()
+    # blow the budget with a giant but low-priority string field
+    full["extra"]["served_by"] = "x" * 5000
+    compact = bench._compact_result(full)
+    line = json.dumps(compact)
+    assert len(line) <= bench.COMPACT_BUDGET
+    # headline + highest-priority scalars survive
+    assert compact["value"] == full["value"]
+    assert "lat_p50_ms" in compact["extra"]
+    assert "served_by" not in compact["extra"]
+
+
+def test_emit_writes_full_and_prints_compact(bench, tmp_path, capsys, monkeypatch):
+    monkeypatch.setattr(bench, "FULL_RESULT_FILE", str(tmp_path / "bench_full.json"))
+    full = _r3_like_full_result()
+    bench._emit(full)
+    printed = capsys.readouterr().out.strip().splitlines()[-1]
+    assert len(printed) <= bench.COMPACT_BUDGET
+    parsed = json.loads(printed)
+    assert parsed["value"] == full["value"]
+    with open(tmp_path / "bench_full.json") as f:
+        roundtrip = json.load(f)
+    assert roundtrip == full  # nothing lost — the full blob is on disk
+
+
+def test_partial_flag_survives_overflow(bench):
+    # the partial flag is semantic, not a metric: overflow must not drop
+    # it (a truncated salvage line must not read as a complete run)
+    full = _r3_like_full_result()
+    full["extra"]["partial"] = True
+    full["extra"]["served_by"] = "x" * 5000
+    compact = bench._compact_result(full)
+    assert len(json.dumps(compact)) <= bench.COMPACT_BUDGET
+    assert compact["extra"]["partial"] is True
+
+
+def test_emit_flags_failed_full_write(bench, tmp_path, capsys, monkeypatch):
+    # unwritable full path: the line must carry full_write_error so a
+    # stale bench_full.json is never attributed to this run
+    monkeypatch.setattr(
+        bench, "FULL_RESULT_FILE", str(tmp_path / "nodir" / "bench_full.json")
+    )
+    bench._emit(_r3_like_full_result())
+    parsed = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert parsed["extra"]["full_write_error"] is True
+
+
+def test_partial_result_compacts(bench):
+    # supervisor salvage path: killed mid-run with only latency done
+    status = {
+        "extra": {"device": "TPU v5 lite0", "relay_rtt_ms": 200.0},
+        "latency_phase": {"p50_ms": 50.0, "p99_ms": 80.0, "qps": 10.0},
+    }
+    partial = bench._result_from_partial(status, {"failed_attempts": [], "killed": True})
+    compact = bench._compact_result(partial)
+    assert len(json.dumps(compact)) <= bench.COMPACT_BUDGET
+    assert compact["extra"]["partial"] is True
+    assert compact["value"] == 50.0
