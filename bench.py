@@ -122,6 +122,8 @@ def _compact_result(full: dict) -> dict:
     picks = [
         ("lat_p50_ms", ("latency_phase", "p50_ms")),
         ("server_p50_ms", ("server_latency", "p50_ms")),
+        ("attached_p50_est_ms", ("server_latency", "attached_p50_est_ms")),
+        ("batch1_fwd_ms", ("device_loop", "batch1_forward_ms")),
         ("tput_img_s", ("throughput_phase", "images_per_s")),
         ("inproc_img_s", ("inprocess_images_per_s",)),
         ("roof_img_s", ("roofline", "raw_device_images_per_s")),
@@ -560,6 +562,38 @@ def device_roofline(server, shape, batch: int = 32, n_batches: int = 16,
     return out
 
 
+def device_loop_phase(server) -> dict:
+    """The TRUE device roofline: N forwards per single dispatch via an
+    on-device ``lax.fori_loop`` (one scalar readback), so the relay's
+    per-dispatch cost cannot cap the number — unlike the pipelined
+    ``device_roofline``, which measures the link as much as the chip
+    (r3: pipelined said 4,236 img/s / 8.8% MFU while the chip's queued
+    rate was already ~12,800).  Sweeps batch size; batch-1 gives the
+    on-chip single-request forward latency that bounds the <10 ms p50
+    north star on directly-attached hosts."""
+    batches = [1, MAX_BATCH] if QUICK else [1, MAX_BATCH, 128, 256]
+    out: dict = {"sweep": {}}
+    best_rate, best_batch = 0.0, None
+    for b in sorted(set(batches)):
+        r = server.loop_forward_rate(batch=b)
+        entry = {
+            "images_per_s": r["images_per_s"],
+            "ms_per_batch": round(r["device_s_per_batch"] * 1000.0, 3),
+        }
+        if MODEL == "resnet50":
+            entry["mfu_pct"] = round(100.0 * r["images_per_s"] * 4.1e9 / 197e12, 2)
+        out["sweep"][str(b)] = entry
+        if b == 1:
+            out["batch1_forward_ms"] = entry["ms_per_batch"]
+        if r["images_per_s"] > best_rate:
+            best_rate, best_batch = r["images_per_s"], b
+    out["images_per_s"] = best_rate
+    out["batch"] = best_batch
+    if MODEL == "resnet50":
+        out["mfu_pct"] = round(100.0 * best_rate * 4.1e9 / 197e12, 2)
+    return out
+
+
 async def native_model_phase(handle, shape, seconds: float = 6.0) -> dict:
     """ResNet through the C++ ingress fast lane, both wire formats:
     uint8 SRT1 frames over HTTP/1.1 and uint8 rawTensor SeldonMessages
@@ -584,7 +618,10 @@ async def native_model_phase(handle, shape, seconds: float = 6.0) -> dict:
     if not hasattr(get_lib(), "lg_run"):
         return {"error": "native load client unavailable"}
 
-    rows = int(os.environ.get("BENCH_NATIVE_ROWS", "8"))
+    # matched to the Python lane's client mix (8 threads x batch-32,
+    # throughput_phase): same rows/request, same connection count —
+    # r3 ran rows=8 vs batch-32 and the "comparison" read backwards
+    rows = int(os.environ.get("BENCH_NATIVE_ROWS", "32"))
     # constant payload content: through this harness's TPU relay,
     # INCOMPRESSIBLE host->device uploads bottleneck at ~20 MB/s
     # (an artifact of the relay, not of the framework or of real
@@ -621,11 +658,20 @@ async def native_model_phase(handle, shape, seconds: float = 6.0) -> dict:
         native_load, handle.port, one, min(seconds, 3.0), 1, 1
     )
     await quiesce()
-    best = {"qps": 0.0}
-    # modest in-flight volume: on a 1-CPU bench host the wire bytes
-    # (client write + server read + copies) compete with the
-    # host<->device link for the same core
-    for conns, depth in ((4, 4), (8, 4), (8, 8)):
+    # MATCHED offered load vs the Python gRPC lane: 8 connections,
+    # depth 1 (sync closed loop per connection) — byte-identical rows
+    # and the exact client pattern of throughput_phase's 8 threads, so
+    # native-vs-python is one subtraction (vs_python_lane, added by the
+    # caller once both phases exist)
+    matched = await asyncio.to_thread(
+        native_load, handle.port, payload, seconds / 2.0, 8, 1
+    )
+    await quiesce()
+    best = dict(matched or {"qps": 0.0}, connections=8, depth=1)
+    # then the architecture's own capability: deeper pipelines (still
+    # modest — on a 1-CPU bench host the wire bytes compete with the
+    # host<->device link for the same core)
+    for conns, depth in ((8, 4), (8, 8), (8, 12)):
         out = await asyncio.to_thread(
             native_load, handle.port, payload, seconds / 3.0, conns, depth
         )
@@ -648,7 +694,7 @@ async def native_model_phase(handle, shape, seconds: float = 6.0) -> dict:
     )
     await quiesce()
     gbest = {"qps": 0.0}
-    for conns, depth in ((4, 4), (8, 4), (8, 8)):
+    for conns, depth in ((8, 1), (8, 4), (8, 8), (8, 12)):
         gout = await asyncio.to_thread(
             native_load_grpc, handle.port, "/seldon.protos.Seldon/Predict",
             gbytes, seconds / 3.0, conns, depth
@@ -662,6 +708,7 @@ async def native_model_phase(handle, shape, seconds: float = 6.0) -> dict:
         "payload_content": "constant (relay-compressible; see bench.py note)",
         "images_per_s": round(best["qps"] * rows, 1),
         "requests_per_s": round(best["qps"], 1),
+        "matched_images_per_s": round((matched or {}).get("qps", 0.0) * rows, 1),
         "grpc_images_per_s": round(gbest["qps"] * rows, 1),
         "grpc_requests_per_s": round(gbest["qps"], 1),
         "grpc_p50_ms": round(1000.0 / max(glat["qps"], 1e-9), 2)
@@ -774,6 +821,14 @@ async def child_main() -> None:
             "errors": len(lat_errors),
         }
         status["phase"] = "latency_done"
+        # server-side arrival->response histogram (recorded inside the
+        # batcher, enqueue -> future resolution): the in-process number
+        # the client RTT cannot give.  On this harness it still contains
+        # the relayed device call; wait_p50 + the device_loop batch-1
+        # forward (below) bound the attached-hardware p50.
+        sl = server.batcher.stats.latency_summary()
+        if sl:
+            status["extra"]["server_latency"] = sl
         _checkpoint(status)
 
     # ---- phase 2: throughput (high concurrency, batched requests) --------
@@ -835,6 +890,21 @@ async def child_main() -> None:
         status["extra"]["roofline_error"] = str(e)[:200]
     _checkpoint(status)
 
+    try:
+        loop = await asyncio.to_thread(device_loop_phase, server)
+        status["extra"]["device_loop"] = loop
+        # attached-hardware p50 bound: in-process queue wait + the
+        # on-chip batch-1 forward (the two components a direct PCIe/DMA
+        # host pays; the relay RTT is harness-only)
+        sl = status["extra"].get("server_latency")
+        if sl and loop.get("batch1_forward_ms") is not None:
+            status["extra"]["server_latency"]["attached_p50_est_ms"] = round(
+                (sl.get("wait_p50_ms") or 0.0) + loop["batch1_forward_ms"], 3
+            )
+    except Exception as e:  # noqa: BLE001
+        status["extra"]["device_loop_error"] = str(e)[:200]
+    _checkpoint(status)
+
     if os.environ.get("BENCH_NATIVE_MODEL", "1") == "1" and native_handle is not None:
         try:
             status["extra"]["native_model"] = await native_model_phase(
@@ -843,6 +913,12 @@ async def child_main() -> None:
             nm = status["extra"]["native_model"]
             if nm.get("images_per_s"):
                 status["extra"]["native_model_qps"] = nm["requests_per_s"]
+            # the r3 ask: native >= python at identical payload/offered
+            # load (matched = 8 sync connections x the same batch-32
+            # rows the Python throughput phase sends)
+            tput = status.get("throughput_phase", {}).get("images_per_s")
+            if tput and nm.get("matched_images_per_s"):
+                nm["vs_python_lane"] = round(nm["matched_images_per_s"] / tput, 2)
         except Exception as e:  # noqa: BLE001
             status["extra"]["native_model_error"] = str(e)[:200]
         _checkpoint(status)
@@ -1094,29 +1170,84 @@ def generation_phase() -> dict:
         result["plain_chunks"] = plain_stats["chunks"] // 2
     except Exception as e:  # noqa: BLE001
         result["speculative_error"] = str(e)[:200]
+
+    # serving-scale continuous batching: the number the engine posts at
+    # realistic stream counts (the micro-comparison above is 4x64 and
+    # device-CALL-bound through this harness's relay).  Batched prefill
+    # admits all streams in ONE device call; the steps ladder grows
+    # chunks to 256 decode steps once nothing waits for a slot, so the
+    # whole run is ~2-3 program calls — admission, not readback, bounds
+    # chunk cadence.
+    try:
+        from seldon_core_tpu.models.paged import PagedEngine
+
+        quick = os.environ.get("BENCH_QUICK", "0") == "1" or MODEL == "resnet_tiny"
+        serve_slots = 4 if quick else 16
+        serve_new = 16 if quick else 256
+        serve_cfg = dict(cfg)
+        serve_cfg["max_len"] = min(cfg["max_len"], 1024)
+        rng2 = np.random.default_rng(5)
+        plen_base = 16 if quick else 96
+        sprompts = [
+            rng2.integers(
+                0, cfg["vocab_size"], size=(plen_base + (i % 5) * 4,)
+            ).astype(np.int32)
+            for i in range(serve_slots)
+        ]
+        serve_engine = PagedEngine(
+            params, dtype=jnp.bfloat16, page_size=64, max_slots=serve_slots,
+            steps_per_call=8, max_steps_per_call=64 if quick else 256,
+            **serve_cfg,
+        )
+
+        def serve_run():
+            streams = [
+                serve_engine.submit(p, max_new_tokens=serve_new) for p in sprompts
+            ]
+            serve_engine.run()
+            return sum(int(s.result.shape[0]) for s in streams)
+
+        serve_run()  # pays the compiles (prefill k, ladder sizes)
+        stats0 = serve_engine.engine_stats()
+        t0 = _time.perf_counter()
+        total = serve_run()
+        serve_dt = _time.perf_counter() - t0
+        stats1 = serve_engine.engine_stats()
+        result["paged_serving_tokens_per_s"] = round(total / serve_dt, 1)
+        result["paged_serving_streams"] = serve_slots
+        result["paged_serving_max_new"] = serve_new
+        result["paged_serving_chunks"] = stats1["chunks"] - stats0["chunks"]
+        result["paged_serving_vs_scan"] = round(
+            result["paged_serving_tokens_per_s"]
+            / max(result["decode_tokens_per_s"], 1e-9), 3
+        )
+    except Exception as e:  # noqa: BLE001
+        result["paged_serving_error"] = str(e)[:200]
     return result
 
 
 async def int8_phase(shape) -> dict:
-    """fp-vs-int8 device forward rate on the same model family.
+    """fp-vs-int8 device forward rate on the same model family — THE
+    int8 forward number (docs cite it verbatim; one methodology, one
+    story).
 
-    Measured device-resident and pipelined (dispatch N, block at end):
-    a sequential served loop through a high-latency host link would be
-    RTT-bound and report a meaningless ~1.0x ratio regardless of the
-    actual compute difference.  int8 halves the HBM bytes the MXU
-    operands pull, which is the win being verified."""
+    Measured with the on-device loop (N forwards per dispatch, one
+    scalar readback, two trip counts): pure queued compute, no
+    dispatch/link term at all — strictly tighter than the r3 pipelined
+    two-point, which certified 0.99x while docs claimed 1.19x from a
+    different run.  For conv nets the weight tensors are small next to
+    activations, so weight-only int8 buys little forward-rate; the
+    honest expectation here is ~1.0x, with int8's real win on decode
+    (weight-HBM-bound; see the generation phase)."""
     import inspect
-
-    import numpy as np
-
-    import jax
 
     from seldon_core_tpu.models.jaxserver import JaxServer
 
     if "quantize" not in inspect.signature(JaxServer.__init__).parameters:
         raise RuntimeError("JaxServer has no quantize support; int8 phase would silently measure fp")
-    out: dict = {}
-    rng = np.random.default_rng(99)
+    import asyncio
+
+    out: dict = {"methodology": "on-device loop, two trip counts"}
     for tag, kwargs in (("fp", {}), ("int8", {"quantize": "int8"})):
         server = JaxServer(
             model=MODEL,
@@ -1131,41 +1262,8 @@ async def int8_phase(shape) -> dict:
             **kwargs,
         )
         server.load()
-        # distinct resident inputs: identical dispatches could be
-        # deduped/cached by a relayed backend and flatter the number
-        staged = [
-            jax.device_put(rng.integers(0, 255, size=(MAX_BATCH, *shape), dtype=np.uint8))
-            for _ in range(6)
-        ]
-        for d in staged:
-            d.block_until_ready()
-        np.asarray(server._predict_jit(server.variables, staged[0]))  # warm resident path
-
-        def timed(n):
-            t0 = time.perf_counter()
-            outs = [
-                server._predict_jit(server.variables, staged[i % len(staged)])
-                for i in range(n)
-            ]
-            outs[-1].block_until_ready()
-            return time.perf_counter() - t0
-
-        # two-point timing: blocking on the last output pays ONE
-        # host<->device roundtrip regardless of n, which on a
-        # high-latency link dwarfs the per-batch compute being compared
-        # — the difference (t_big - t_small) isolates the queued
-        # device work of (n_big - n_small) batches
-        n_small, n_big = 10, 60
-        dt_small = timed(n_small)
-        dt_big = timed(n_big)
-        compute = dt_big - dt_small
-        if compute > 0.01:
-            out[f"{tag}_images_per_s"] = round(
-                (n_big - n_small) * MAX_BATCH / compute, 1
-            )
-        else:  # relay noise swallowed the difference: report the raw rate
-            out[f"{tag}_images_per_s"] = round(n_big * MAX_BATCH / dt_big, 1)
-            out[f"{tag}_timing_note"] = "roundtrip-dominated (relay)"
+        r = await asyncio.to_thread(server.loop_forward_rate)
+        out[f"{tag}_images_per_s"] = r["images_per_s"]
         server.unload()
     if out.get("fp_images_per_s") and out.get("int8_images_per_s"):
         out["int8_vs_fp"] = round(out["int8_images_per_s"] / out["fp_images_per_s"], 2)

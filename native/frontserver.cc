@@ -97,6 +97,25 @@ typedef int32_t (*fs_raw_cb)(void* ctx, const char* method, const char* path,
                              uint8_t** out_buf, int64_t* out_len,
                              int32_t* http_status, char* content_type64);
 
+// Generic unary gRPC fallback: any Seldon method the in-C++ fast lane
+// does not express (SendFeedback, Predict with non-tensor payloads, …)
+// is handed to Python whole — the wire stays native, the semantics stay
+// in the engine (the reference's Java engine serves its full gRPC
+// contract the same way, SeldonService.java:30-67).  Response proto in
+// an fs_alloc buffer; nonzero return -> INTERNAL.
+typedef int32_t (*fs_grpc_cb)(void* ctx, const char* path, const uint8_t* msg,
+                              int64_t msg_len, uint8_t** out_buf,
+                              int64_t* out_len, int32_t* grpc_status,
+                              char* grpc_msg256);
+
+// Server-streaming gRPC (Seldon/GenerateStream): Python ACCEPTS the
+// stream (return 0) and pushes messages from its own producer thread
+// via fs_stream_push / fs_stream_close.  Nonzero return -> the server
+// closes the stream with the returned status (13 = INTERNAL).
+typedef int32_t (*fs_grpc_stream_cb)(void* ctx, const char* path,
+                                     const uint8_t* msg, int64_t msg_len,
+                                     uint64_t stream_handle);
+
 typedef struct {
   int32_t port;            // 0 = ephemeral
   int32_t max_batch;       // fast-lane coalescing cap (rows)
@@ -314,7 +333,7 @@ bool parse_raw_frame(const uint8_t* body, int64_t len, RawFrame* out) {
 // request / response plumbing
 // ---------------------------------------------------------------------------
 
-enum class Lane { FAST_JSON, FAST_RAW, RAW, GRPC };
+enum class Lane { FAST_JSON, FAST_RAW, RAW, GRPC, GRPC_UNARY, GRPC_STREAM };
 
 struct PendingReq {
   uint64_t conn_id;
@@ -336,6 +355,8 @@ struct PendingReq {
   std::string method;
   std::string path;
   std::vector<uint8_t> body;
+  // gRPC server-streaming lane
+  uint64_t stream_handle = 0;
 };
 
 struct DoneResp {
@@ -349,6 +370,22 @@ struct DoneResp {
   int32_t grpc_status = 0;
   std::string grpc_msg;
   std::string h2_proto;
+};
+
+// gRPC server-streaming bookkeeping: a handle the Python producer holds
+// maps to (connection, h2 stream); `alive` flips false when the client
+// goes away so the producer stops.
+struct StreamInfo {
+  uint64_t conn_id;
+  uint32_t h2_stream;
+  bool alive;
+};
+struct StreamEvent {
+  uint64_t handle;
+  bool close = false;
+  int32_t status = 0;
+  std::string msg;
+  std::string bytes;
 };
 
 struct Conn {
@@ -479,6 +516,46 @@ class FrontServer {
     raw_cb_ = cb;
     raw_ctx_ = ctx;
   }
+  void set_grpc_handler(fs_grpc_cb cb, void* ctx) {
+    grpc_cb_ = cb;
+    grpc_ctx_ = ctx;
+  }
+  void set_grpc_stream_handler(fs_grpc_stream_cb cb, void* ctx) {
+    grpc_stream_cb_ = cb;
+    grpc_stream_ctx_ = ctx;
+  }
+
+  // producer side of the gRPC server-streaming lane (Python thread):
+  // enqueue one message; -1 = stream dead (client gone) so the producer
+  // stops decoding for an unread stream
+  int64_t stream_push(uint64_t handle, const uint8_t* bytes, int64_t len) {
+    {
+      std::lock_guard<std::mutex> lk(stream_mu_);
+      auto it = stream_handles_.find(handle);
+      if (it == stream_handles_.end() || !it->second.alive) return -1;
+      StreamEvent e;
+      e.handle = handle;
+      e.bytes.assign((const char*)bytes, (size_t)len);
+      stream_q_.push_back(std::move(e));
+    }
+    wake();
+    return 0;
+  }
+
+  void stream_close_event(uint64_t handle, int32_t status, const char* msg) {
+    {
+      std::lock_guard<std::mutex> lk(stream_mu_);
+      if (stream_handles_.find(handle) == stream_handles_.end()) return;
+      StreamEvent e;
+      e.handle = handle;
+      e.close = true;
+      e.status = status;
+      e.msg = msg != nullptr ? msg : "";
+      stream_q_.push_back(std::move(e));
+    }
+    wake();
+  }
+
   void set_ready(bool r) { ready_.store(r); }
 
   int start() {
@@ -531,6 +608,12 @@ class FrontServer {
 
   void stop() {
     if (!running_.exchange(false)) return;
+    {
+      // stop stream producers first: their next push returns -1 and
+      // the Python side unwinds before worker threads are joined
+      std::lock_guard<std::mutex> lk(stream_mu_);
+      for (auto& kv : stream_handles_) kv.second.alive = false;
+    }
     wake();
     {
       std::lock_guard<std::mutex> lk(batch_mu_);
@@ -594,11 +677,13 @@ class FrontServer {
           while (read(wake_fd_, &v, 8) == 8) {
           }
           drain_done();
+          drain_streams();
         } else {
           handle_conn_event(tag, events[i].events);
         }
       }
       drain_done();
+      drain_streams();
     }
   }
 
@@ -633,6 +718,14 @@ class FrontServer {
     {
       std::lock_guard<std::mutex> lk(alive_mu_);
       alive_conns_.erase(id);
+    }
+    {
+      // stop producers of any server-streams on this connection (their
+      // next fs_stream_push returns -1; the close event erases the
+      // handle)
+      std::lock_guard<std::mutex> lk(stream_mu_);
+      for (auto& kv : stream_handles_)
+        if (kv.second.conn_id == id) kv.second.alive = false;
     }
   }
 
@@ -792,15 +885,62 @@ class FrontServer {
         enqueue_fast(std::move(p));
         return;
       }
-      requests_.fetch_add(1);
-      failures_.fetch_add(1);
-      c.h2c->send_response(r.stream_id, "", 3 /* INVALID_ARGUMENT */,
-                           "native lane accepts 2-D tensor/rawTensor payloads",
-                           &c.out);
+      if (grpc_cb_ == nullptr) {
+        requests_.fetch_add(1);
+        failures_.fetch_add(1);
+        c.h2c->send_response(r.stream_id, "", 3 /* INVALID_ARGUMENT */,
+                             "native lane accepts 2-D tensor/rawTensor payloads",
+                             &c.out);
+        return;
+      }
+      // non-fast-lane Predict payloads (strData/jsonData/ndarray, …)
+      // fall through to the full-semantics unary fallback below
+    }
+    // full-contract fallback: the message crosses to Python whole, the
+    // wire stays native (reference parity: the Java engine serves its
+    // entire gRPC surface on one server, SeldonService.java:30-67).
+    if (r.path == "/seldon.protos.Seldon/GenerateStream" &&
+        grpc_stream_cb_ != nullptr) {
+      uint64_t handle;
+      {
+        std::lock_guard<std::mutex> lk(stream_mu_);
+        handle = next_stream_handle_++;
+        stream_handles_.emplace(handle, StreamInfo{id, r.stream_id, true});
+      }
+      c.inflight++;
+      PendingReq p;
+      p.conn_id = id;
+      p.lane = Lane::GRPC_STREAM;
+      p.keep_alive = true;
+      p.h2_stream = r.stream_id;
+      p.path = r.path;
+      p.body.assign(r.message.begin(), r.message.end());
+      p.stream_handle = handle;
+      {
+        std::lock_guard<std::mutex> lk(raw_mu_);
+        raw_q_.push_back(std::move(p));
+      }
+      raw_cv_.notify_one();
       return;
     }
-    // other methods (SendFeedback, streams, other services) live on the
-    // engine's gRPC port with full semantics
+    if (grpc_cb_ != nullptr) {
+      PendingReq p;
+      p.conn_id = id;
+      p.lane = Lane::GRPC_UNARY;
+      p.keep_alive = true;
+      p.h2_stream = r.stream_id;
+      p.seq = c.next_assign++;
+      p.path = r.path;
+      p.body.assign(r.message.begin(), r.message.end());
+      c.inflight++;
+      {
+        std::lock_guard<std::mutex> lk(raw_mu_);
+        raw_q_.push_back(std::move(p));
+      }
+      raw_cv_.notify_one();
+      return;
+    }
+    // no fallback registered (stub/bench mode): unary-Predict only
     requests_.fetch_add(1);
     c.h2c->send_response(r.stream_id, "", 12 /* UNIMPLEMENTED */,
                          "native ingress serves Seldon/Predict; use the "
@@ -1278,6 +1418,54 @@ class FrontServer {
         p = std::move(raw_q_.front());
         raw_q_.pop_front();
       }
+      if (p.lane == Lane::GRPC_STREAM) {
+        // Python accepts (returning promptly after spawning its
+        // producer thread) and pushes via fs_stream_push/close
+        int rc = grpc_stream_cb_ != nullptr
+                     ? grpc_stream_cb_(grpc_stream_ctx_, p.path.c_str(),
+                                       p.body.data(), (int64_t)p.body.size(),
+                                       p.stream_handle)
+                     : 12;
+        requests_.fetch_add(1);
+        raw_requests_.fetch_add(1);
+        if (rc != 0) {
+          failures_.fetch_add(1);
+          stream_close_event(p.stream_handle, rc == 12 ? 12 : 13,
+                             "stream handler failed");
+        }
+        continue;
+      }
+      if (p.lane == Lane::GRPC_UNARY) {
+        uint8_t* gbuf = nullptr;
+        int64_t glen = 0;
+        int32_t gstatus = 0;
+        char gmsg[256];
+        gmsg[0] = 0;
+        int rc = grpc_cb_(grpc_ctx_, p.path.c_str(), p.body.data(),
+                          (int64_t)p.body.size(), &gbuf, &glen, &gstatus, gmsg);
+        DoneResp d;
+        d.conn_id = p.conn_id;
+        d.seq = p.seq;
+        d.keep_alive = true;
+        d.h2_stream = p.h2_stream;
+        requests_.fetch_add(1);
+        raw_requests_.fetch_add(1);
+        if (rc != 0) {
+          failures_.fetch_add(1);
+          d.grpc_status = 13;  // INTERNAL
+          d.grpc_msg = "handler failed";
+        } else {
+          gmsg[255] = 0;
+          d.grpc_status = gstatus;
+          d.grpc_msg = gmsg;
+          if (gstatus != 0) failures_.fetch_add(1);
+          if (gbuf != nullptr && glen > 0)
+            d.h2_proto.assign((char*)gbuf, (size_t)glen);
+        }
+        if (gbuf) free(gbuf);
+        complete(std::move(d));
+        continue;
+      }
       uint8_t* out_buf = nullptr;
       int64_t out_len = 0;
       int32_t status = 200;
@@ -1314,6 +1502,49 @@ class FrontServer {
       done_q_.push_back(std::move(d));
     }
     wake();
+  }
+
+  // ------------------------------------------ gRPC server-streaming lane
+
+  void mark_stream_dead(uint64_t handle) {
+    std::lock_guard<std::mutex> lk(stream_mu_);
+    auto it = stream_handles_.find(handle);
+    if (it != stream_handles_.end()) it->second.alive = false;
+  }
+
+  // IO thread: apply queued stream events to connections
+  void drain_streams() {
+    std::deque<StreamEvent> batch;
+    {
+      std::lock_guard<std::mutex> lk(stream_mu_);
+      batch.swap(stream_q_);
+    }
+    for (auto& e : batch) {
+      uint64_t conn_id;
+      uint32_t sid;
+      {
+        std::lock_guard<std::mutex> lk(stream_mu_);
+        auto it = stream_handles_.find(e.handle);
+        if (it == stream_handles_.end()) continue;
+        conn_id = it->second.conn_id;
+        sid = it->second.h2_stream;
+        if (e.close) stream_handles_.erase(it);
+      }
+      auto cit = conns_.find(conn_id);
+      if (cit == conns_.end() || !cit->second.h2c) {
+        if (!e.close) mark_stream_dead(e.handle);
+        continue;
+      }
+      Conn& c = cit->second;
+      if (e.close) {
+        c.h2c->send_stream_close(sid, e.status, e.msg, &c.out);
+        c.inflight--;
+        if (e.status != 0) failures_.fetch_add(1);
+      } else if (!c.h2c->send_stream_message(sid, e.bytes, &c.out)) {
+        mark_stream_dead(e.handle);  // client reset: stop the producer
+      }
+      flush_out(conn_id);
+    }
   }
 
   void drain_done() {
@@ -1414,6 +1645,15 @@ class FrontServer {
   void* batch_ctx_ = nullptr;
   fs_raw_cb raw_cb_ = nullptr;
   void* raw_ctx_ = nullptr;
+  fs_grpc_cb grpc_cb_ = nullptr;
+  void* grpc_ctx_ = nullptr;
+  fs_grpc_stream_cb grpc_stream_cb_ = nullptr;
+  void* grpc_stream_ctx_ = nullptr;
+
+  std::mutex stream_mu_;
+  std::unordered_map<uint64_t, StreamInfo> stream_handles_;
+  std::deque<StreamEvent> stream_q_;
+  uint64_t next_stream_handle_ = 1;
 
   std::thread io_thread_;
   std::vector<std::thread> batch_threads_;
@@ -1460,6 +1700,24 @@ void fs_set_batch_handler(void* h, fs_batch_cb cb, void* ctx) {
 
 void fs_set_raw_handler(void* h, fs_raw_cb cb, void* ctx) {
   ((FrontServer*)h)->set_raw_handler(cb, ctx);
+}
+
+void fs_set_grpc_handler(void* h, fs_grpc_cb cb, void* ctx) {
+  ((FrontServer*)h)->set_grpc_handler(cb, ctx);
+}
+
+void fs_set_grpc_stream_handler(void* h, fs_grpc_stream_cb cb, void* ctx) {
+  ((FrontServer*)h)->set_grpc_stream_handler(cb, ctx);
+}
+
+int64_t fs_stream_push(void* h, uint64_t handle, const uint8_t* bytes,
+                       int64_t len) {
+  return ((FrontServer*)h)->stream_push(handle, bytes, len);
+}
+
+void fs_stream_close(void* h, uint64_t handle, int32_t grpc_status,
+                     const char* grpc_msg) {
+  ((FrontServer*)h)->stream_close_event(handle, grpc_status, grpc_msg);
 }
 
 int32_t fs_start(void* h) { return ((FrontServer*)h)->start(); }

@@ -373,6 +373,7 @@ struct Stream {
   std::string pending_data;     // gRPC DATA payload not yet framed out
   std::string pending_trailers; // HEADERS(trailers) frame bytes
   bool responded = false;
+  bool stream_headers_sent = false;  // server-streaming: HEADERS emitted
 };
 
 struct ConnImpl {
@@ -391,6 +392,11 @@ struct ConnImpl {
   void flush_stream(uint32_t sid, Stream* s, std::string* out);
   void send_response(uint32_t sid, const std::string& proto, int gstatus,
                      const std::string& gmsg, std::string* out);
+  void emit_response_headers(uint32_t sid, Stream* s, std::string* out);
+  bool send_stream_message(uint32_t sid, const std::string& proto,
+                           std::string* out);
+  void send_stream_close(uint32_t sid, int gstatus, const std::string& gmsg,
+                         std::string* out);
   void finish_headers(uint32_t sid, uint8_t flags, std::string* out,
                       std::vector<GrpcRequest>* reqs);
   void complete_request(uint32_t sid, Stream* s, std::string* out,
@@ -620,6 +626,50 @@ void ConnImpl::flush_stream(uint32_t sid, Stream* s, std::string* out) {
   }
 }
 
+void ConnImpl::emit_response_headers(uint32_t sid, Stream* s, std::string* out) {
+  if (s->stream_headers_sent) return;
+  s->stream_headers_sent = true;
+  // response HEADERS: :status 200 (static idx 8) + content-type
+  std::string hb;
+  hb.push_back((char)0x88);
+  emit_never_indexed(&hb, "content-type", "application/grpc");
+  frame_header(out, (uint32_t)hb.size(), FT_HEADERS, FL_END_HEADERS, sid);
+  out->append(hb);
+}
+
+bool ConnImpl::send_stream_message(uint32_t sid, const std::string& proto,
+                                  std::string* out) {
+  auto it = streams.find(sid);
+  if (it == streams.end()) return false;  // client reset / gone
+  Stream* s = &it->second;
+  if (s->responded) return false;  // already closed with trailers
+  emit_response_headers(sid, s, out);
+  s->pending_data.push_back(0);  // uncompressed gRPC frame
+  u32be(&s->pending_data, (uint32_t)proto.size());
+  s->pending_data.append(proto);
+  flush_stream(sid, s, out);
+  return true;
+}
+
+void ConnImpl::send_stream_close(uint32_t sid, int gstatus,
+                                 const std::string& gmsg, std::string* out) {
+  auto it = streams.find(sid);
+  if (it == streams.end()) return;
+  Stream* s = &it->second;
+  if (s->responded) return;
+  s->responded = true;
+  emit_response_headers(sid, s, out);  // error-before-first-message case
+  std::string tb;
+  emit_never_indexed(&tb, "grpc-status", std::to_string(gstatus));
+  if (!gmsg.empty()) emit_never_indexed(&tb, "grpc-message", gmsg);
+  std::string tf;
+  frame_header(&tf, (uint32_t)tb.size(), FT_HEADERS,
+               FL_END_HEADERS | FL_END_STREAM, sid);
+  tf.append(tb);
+  s->pending_trailers = std::move(tf);
+  flush_stream(sid, s, out);
+}
+
 void ConnImpl::send_response(uint32_t sid, const std::string& proto,
                              int gstatus, const std::string& gmsg,
                              std::string* out) {
@@ -629,12 +679,7 @@ void ConnImpl::send_response(uint32_t sid, const std::string& proto,
   if (s->responded) return;
   s->responded = true;
 
-  // response HEADERS: :status 200 (static idx 8) + content-type
-  std::string hb;
-  hb.push_back((char)0x88);
-  emit_never_indexed(&hb, "content-type", "application/grpc");
-  frame_header(out, (uint32_t)hb.size(), FT_HEADERS, FL_END_HEADERS, sid);
-  out->append(hb);
+  emit_response_headers(sid, s, out);
 
   if (gstatus == 0) {
     std::string payload;
@@ -673,6 +718,23 @@ void Conn::send_response(uint32_t stream_id, const std::string& proto_bytes,
                          std::string* out) {
   ((ConnImpl*)impl_)->send_response(stream_id, proto_bytes, grpc_status,
                                     grpc_message, out);
+}
+
+bool Conn::send_stream_message(uint32_t stream_id,
+                               const std::string& proto_bytes,
+                               std::string* out) {
+  return ((ConnImpl*)impl_)->send_stream_message(stream_id, proto_bytes, out);
+}
+
+void Conn::send_stream_close(uint32_t stream_id, int grpc_status,
+                             const std::string& grpc_message,
+                             std::string* out) {
+  ((ConnImpl*)impl_)->send_stream_close(stream_id, grpc_status, grpc_message,
+                                        out);
+}
+
+bool Conn::stream_open(uint32_t stream_id) const {
+  return ((ConnImpl*)impl_)->streams.count(stream_id) != 0;
 }
 
 bool Conn::has_blocked() const {

@@ -47,6 +47,19 @@ class Conn {
                      int grpc_status, const std::string& grpc_message,
                      std::string* out);
 
+  // --- server streaming (Seldon/GenerateStream) ---------------------
+  // Push one gRPC message as DATA on an open stream (response HEADERS
+  // are emitted on the first push).  Returns false when the stream is
+  // gone (client RST / closed) so producers can stop.
+  bool send_stream_message(uint32_t stream_id, const std::string& proto_bytes,
+                           std::string* out);
+  // Finish a streaming response with grpc-status trailers (emits the
+  // response HEADERS first for error-before-first-message streams).
+  void send_stream_close(uint32_t stream_id, int grpc_status,
+                         const std::string& grpc_message, std::string* out);
+  // True while the client half of the stream still exists.
+  bool stream_open(uint32_t stream_id) const;
+
   // Streams with queued response bytes blocked on peer flow control.
   bool has_blocked() const;
 

@@ -41,6 +41,7 @@ import logging
 import queue
 import threading
 import time
+from collections import deque
 from concurrent.futures import Future
 from dataclasses import dataclass
 from typing import Any, Callable, List, Optional, Sequence
@@ -91,11 +92,33 @@ class _WorkItem:
 
 
 class BatcherStats:
-    def __init__(self) -> None:
+    def __init__(self, reservoir: int = 8192) -> None:
         self.requests = 0
         self.batches = 0
         self.rows = 0
         self.padded_rows = 0
+        # server-side latency reservoirs (ms), newest-wins ring buffers:
+        # wait = enqueue -> device launch; total = enqueue -> result set
+        # (arrival->response inside the serving process, the histogram
+        # client RTT cannot give).  Appends are atomic, but ITERATION
+        # concurrent with appends raises "deque mutated during
+        # iteration" — readers and writers share _lat_lock
+        self._lat_lock = threading.Lock()
+        self.wait_ms: "deque[float]" = deque(maxlen=reservoir)
+        self.total_ms: "deque[float]" = deque(maxlen=reservoir)
+
+    def record_wait(self, ms: float) -> None:
+        with self._lat_lock:
+            self.wait_ms.append(ms)
+
+    def record_total(self, ms: float) -> None:
+        with self._lat_lock:
+            self.total_ms.append(ms)
+
+    def latency_snapshot(self) -> tuple:
+        """Consistent copies of both reservoirs (safe under traffic)."""
+        with self._lat_lock:
+            return list(self.wait_ms), list(self.total_ms)
 
     def observe(self, batch_requests: int, rows: int, padded: int) -> None:
         self.requests += batch_requests
@@ -106,6 +129,29 @@ class BatcherStats:
     @property
     def mean_batch_rows(self) -> float:
         return self.rows / self.batches if self.batches else 0.0
+
+    def latency_summary(self) -> dict:
+        """Percentiles of the in-process arrival->response histogram
+        (and of queue wait alone).  Empty dict when nothing recorded."""
+        wait, total = self.latency_snapshot()
+        if not total:
+            return {}
+        total.sort()
+        wait.sort()
+
+        def pct(sorted_vals, q):
+            if not sorted_vals:
+                return None
+            return round(sorted_vals[min(len(sorted_vals) - 1, int(len(sorted_vals) * q))], 3)
+
+        return {
+            "p50_ms": pct(total, 0.50),
+            "p90_ms": pct(total, 0.90),
+            "p99_ms": pct(total, 0.99),
+            "wait_p50_ms": pct(wait, 0.50),
+            "wait_p99_ms": pct(wait, 0.99),
+            "count": len(total),
+        }
 
 
 class DynamicBatcher:
@@ -253,6 +299,9 @@ class DynamicBatcher:
         if hasattr(out, "copy_to_host_async"):
             out.copy_to_host_async()  # overlap readback with later batches
         self.stats.observe(len(items), rows, padded)
+        launched = time.perf_counter()
+        for it in items:
+            self.stats.record_wait((launched - it.enqueued_at) * 1000.0)
         self._inflight.put((items, out))
 
     def _finish_loop(self) -> None:
@@ -266,10 +315,12 @@ class DynamicBatcher:
             items, out = entry
             try:
                 out = np.asarray(out)
+                done = time.perf_counter()
                 offset = 0
                 for it in items:
                     it.future.set_result(out[offset : offset + it.rows])
                     offset += it.rows
+                    self.stats.record_total((done - it.enqueued_at) * 1000.0)
             except Exception as e:  # noqa: BLE001 — propagate to every caller
                 logger.exception("batch readback failed")
                 for it in items:
@@ -410,6 +461,9 @@ class MultiSignatureBatcher:
             agg.batches += g.stats.batches
             agg.rows += g.stats.rows
             agg.padded_rows += g.stats.padded_rows
+            gw, gt = g.stats.latency_snapshot()
+            agg.wait_ms.extend(gw)
+            agg.total_ms.extend(gt)
         return agg
 
     def __enter__(self) -> "MultiSignatureBatcher":

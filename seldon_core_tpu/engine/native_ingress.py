@@ -157,6 +157,130 @@ class _DeploymentRawHandler:
         return self._inner(method, path, body)
 
 
+class _DeploymentGrpcHandler:
+    """Full-contract unary gRPC fallback for the native ingress: any
+    Seldon method the in-C++ fast lane does not express (SendFeedback,
+    Predict with non-tensor payloads, …) arrives here whole and runs
+    through the Gateway with full engine semantics — one native server
+    for the entire contract, like the reference's Java engine
+    (reference: engine/src/main/java/io/seldon/engine/grpc/
+    SeldonService.java:30-67)."""
+
+    def __init__(self, gateway, loop):
+        self.gateway = gateway
+        self.loop = loop
+
+    def __call__(self, path: str, body: bytes):
+        from seldon_core_tpu.proto import pb
+        from seldon_core_tpu.runtime.component import MicroserviceError
+        from seldon_core_tpu.runtime.message import InternalFeedback, InternalMessage
+
+        try:
+            if path == "/seldon.protos.Seldon/Predict":
+                msg = InternalMessage.from_proto(pb.SeldonMessage.FromString(body))
+                fut = asyncio.run_coroutine_threadsafe(
+                    self.gateway.predict(msg), self.loop
+                )
+            elif path == "/seldon.protos.Seldon/SendFeedback":
+                fb = InternalFeedback.from_proto(pb.Feedback.FromString(body))
+                fut = asyncio.run_coroutine_threadsafe(
+                    self.gateway.send_feedback(fb), self.loop
+                )
+            else:
+                return 12, f"native ingress: no handler for {path}", b""
+            out = fut.result(timeout=120.0)
+            return 0, "", out.to_proto().SerializeToString()
+        except MicroserviceError as e:
+            return (3 if 400 <= e.status_code < 500 else 13), str(e), b""
+        except Exception as e:  # noqa: BLE001 — wire-level INTERNAL
+            logger.exception("native grpc fallback failed for %s", path)
+            return 13, str(e)[:200], b""
+
+
+class _DeploymentGrpcStreamHandler:
+    """Seldon/GenerateStream on the native lane: token chunks leave
+    through C++ h2 DATA frames as the engine emits them.  The accept
+    callback returns immediately; a daemon producer thread drives the
+    component's blocking ``predict_stream`` generator and pushes each
+    chunk — a dead push (client disconnect) closes the generator, which
+    cancels the engine stream (same lifecycle as the Python lane,
+    engine/server.py generate_stream)."""
+
+    def __init__(self, gateway, server_ref):
+        self.gateway = gateway
+        self._server_ref = server_ref  # callable -> NativeFrontServer
+
+    def __call__(self, path: str, body: bytes, handle: int) -> int:
+        import threading
+
+        from seldon_core_tpu.proto import pb
+        from seldon_core_tpu.runtime.message import InternalMessage
+
+        if path != "/seldon.protos.Seldon/GenerateStream":
+            return 12
+        server = self._server_ref()
+        if server is None:
+            return 13
+        try:
+            msg = InternalMessage.from_proto(pb.SeldonMessage.FromString(body))
+        except Exception:  # noqa: BLE001 — malformed request proto
+            server.stream_close(handle, 3, "malformed SeldonMessage")
+            return 0
+        threading.Thread(
+            target=self._produce, args=(server, msg, handle),
+            name=f"native-genstream-{handle}", daemon=True,
+        ).start()
+        return 0
+
+    def _produce(self, server, msg, handle: int) -> None:
+        import numpy as np
+
+        from seldon_core_tpu.runtime.component import MicroserviceError
+        from seldon_core_tpu.runtime.message import InternalMessage
+
+        it = None
+        try:
+            svc = self.gateway.pick()
+            fast = svc.single_local_model()
+            component = fast[1] if fast is not None else None
+            gen_fn = getattr(component, "predict_stream", None)
+            if gen_fn is None:
+                server.stream_close(
+                    handle, 12,
+                    "GenerateStream needs a single-local-model predictor whose "
+                    "component implements predict_stream (e.g. STREAMING_LM)",
+                )
+                return
+            meta = {"tags": dict(msg.meta.tags), "puid": msg.meta.puid}
+            it = gen_fn(msg.array(), [], meta=meta)
+            dead = False
+            for chunk in it:
+                out = InternalMessage(
+                    payload=np.asarray(chunk)[None, :], kind="ndarray"
+                )
+                out.meta.puid = msg.meta.puid
+                if server.stream_push(handle, out.to_proto().SerializeToString()) < 0:
+                    dead = True  # client gone: stop decoding
+                    break
+            # ALWAYS close: the close event is what releases the C++
+            # handle and the connection's inflight count — skipping it
+            # on a dead stream would leak both for the process lifetime
+            # (the server tolerates closing a stream whose h2 side or
+            # connection is already gone)
+            server.stream_close(handle, 1 if dead else 0,
+                                "client cancelled" if dead else "")
+        except MicroserviceError as e:
+            server.stream_close(
+                handle, 3 if 400 <= e.status_code < 500 else 13, str(e)[:200]
+            )
+        except Exception as e:  # noqa: BLE001 — mid-stream engine fault
+            logger.exception("native GenerateStream producer failed")
+            server.stream_close(handle, 13, str(e)[:200])
+        finally:
+            if it is not None:
+                it.close()
+
+
 async def serve_native_ingress(
     gateway,
     host: str = "0.0.0.0",
@@ -176,10 +300,17 @@ async def serve_native_ingress(
 
     loop = asyncio.get_running_loop()
     handler = _DeploymentRawHandler(gateway, loop)
+    grpc_handler = _DeploymentGrpcHandler(gateway, loop)
+    server_box: list = [None]
+    grpc_stream_handler = _DeploymentGrpcStreamHandler(
+        gateway, lambda: server_box[0]
+    )
     lane = fast_lane_for(gateway)
     if batch_threads is None:
         batch_threads = int(os.environ.get("SELDON_TPU_NATIVE_BATCH_THREADS", "4"))
-    kwargs = dict(port=http_port, raw_handler=handler, max_wait_ms=max_wait_ms, host=host,
+    kwargs = dict(port=http_port, raw_handler=handler, grpc_handler=grpc_handler,
+                  grpc_stream_handler=grpc_stream_handler,
+                  max_wait_ms=max_wait_ms, host=host,
                   batch_threads=batch_threads)
     if lane is not None:
         kwargs.update(
@@ -198,6 +329,7 @@ async def serve_native_ingress(
     else:
         logger.info("native ingress: fallback lane only (graph not fast-lane eligible)")
     server = NativeFrontServer(**kwargs)
+    server_box[0] = server
     server.start()
 
     async def _refresh_ready():

@@ -253,6 +253,7 @@ class PagedEngine:
         num_pages: Optional[int] = None,
         max_slots: int = 8,
         steps_per_call: int = 8,
+        max_steps_per_call: int = 0,
         prompt_buckets: Optional[Sequence[int]] = None,
         dtype: Any = None,
         mesh: Any = None,
@@ -293,6 +294,13 @@ class PagedEngine:
         self.pages_per_stream = self.max_len // self.page_size
         self.max_slots = int(max_slots)
         self.steps_per_call = int(steps_per_call)
+        # saturated-decode ladder: when no stream is waiting for a slot,
+        # chunks grow (x2 up to max_steps_per_call) so one program call
+        # decodes more tokens — admission latency only pays the SHORT
+        # chunk, because a non-empty queue pins chunks at steps_per_call.
+        # Each ladder size is one compiled program (power-of-two ladder
+        # keeps the count logarithmic).
+        self.max_steps = max(self.steps_per_call, int(max_steps_per_call))
         # default pool = worst case (every slot full-length) + trash page;
         # shrink for the actual memory win when streams are short-lived
         self.num_pages = int(
@@ -347,23 +355,52 @@ class PagedEngine:
         self.speculative = dict(speculative) if speculative else None
         if self.speculative is not None:
             draft = self.speculative.setdefault("draft", "ngram")
-            if draft not in ("ngram", "oracle"):
+            if draft not in ("ngram", "oracle", "model"):
                 # 'oracle' = caller-supplied continuation hints
                 # (submit(draft_hint=...)) — the acceptance-ceiling
-                # benchmarking lane; a draft-model lane lives in
-                # SpeculativeGenerator
+                # benchmarking lane; 'model' = a small trained draft LM
                 raise ValueError(
-                    "PagedEngine speculative mode supports draft='ngram' "
-                    "or draft='oracle'"
+                    "PagedEngine speculative mode supports draft='ngram', "
+                    "draft='oracle' or draft='model'"
                 )
             self.speculative.setdefault("draft_k", 4)
             self.speculative.setdefault("ngram", 2)
             self.draft_k = int(self.speculative["draft_k"])
             if self.draft_k < 1:
                 raise ValueError("speculative draft_k must be >= 1")
+            if draft == "model":
+                # draft-model lane: a small LM proposes k tokens per
+                # round from a sliding context window (stateless — no
+                # second KV pool to manage; the window re-forward is
+                # cheap because the draft is small).  Draft quality only
+                # moves ACCEPTANCE: every emitted token is still the
+                # target's own argmax via the verify forward, so a bad
+                # draft degrades speed, never output.
+                if self.speculative.get("draft_params") is None:
+                    raise ValueError(
+                        "draft='model' needs draft_params (and usually "
+                        "draft_config={vocab_size,d_model,num_layers,...})"
+                    )
+                from seldon_core_tpu.models.transformer import TransformerLM
 
-        self._prefill_jit: Dict[int, Any] = {}
-        self._chunk = jax.jit(self._chunk_fn, donate_argnums=(1, 2))
+                dc = dict(self.speculative.get("draft_config") or {})
+                dc.setdefault("vocab_size", self.vocab_size)
+                if int(dc["vocab_size"]) != self.vocab_size:
+                    raise ValueError(
+                        "draft model must share the target's vocab_size"
+                    )
+                self.draft_window = int(self.speculative.get("draft_window", 64))
+                dc.setdefault("max_len", self.draft_window)
+                if int(dc["max_len"]) < self.draft_window:
+                    raise ValueError(
+                        "draft_config.max_len must cover draft_window"
+                    )
+                self._draft_module = TransformerLM(dtype=dtype, **dc)
+                self._draft_params = self.speculative["draft_params"]
+                self._draft_rollout = jax.jit(self._draft_rollout_fn)
+
+        self._prefill_jit: Dict[Tuple[int, int], Any] = {}  # (bucket, k)
+        self._chunk_jit: Dict[int, Any] = {}  # steps -> compiled program
         self._spec_chunk = (
             jax.jit(self._spec_chunk_fn, donate_argnums=(1, 2))
             if self.speculative is not None else None
@@ -385,23 +422,30 @@ class PagedEngine:
 
         return materialize(params, self.quantize, self._dtype)
 
-    def _build_prefill(self, bucket: int):
+    def _build_prefill(self, bucket: int, k: int):
+        """Prefill program for ``k`` same-bucket prompts in ONE call.
+
+        Admission cost through a high-latency host link is per device
+        CALL, not per prompt: 16 joiners prefilled one-by-one pay 16
+        round-trips; batched they pay one.  Pad rows (``true_lens`` 1,
+        block row 0) write only the trash page."""
         jax, jnp = self._jax, self._jnp
 
-        def prefill(params, pk, pv, tokens, true_len, block_row):
-            # tokens: (1, bucket)   block_row: (P,)
+        def prefill(params, pk, pv, tokens, true_lens, block_rows):
+            # tokens: (k, bucket)  true_lens: (k,)  block_rows: (k, P)
             params = self._materialize(params)
-            positions = jnp.arange(bucket)[None, :]
-            lengths = jnp.zeros((1,), jnp.int32)
+            positions = jnp.broadcast_to(jnp.arange(bucket)[None, :], (k, bucket))
+            lengths = jnp.zeros((k,), jnp.int32)
             logits, nk, nv = self.module.apply(
                 {"params": params}, tokens, positions, pk, pv,
-                block_row[None, :], lengths,
+                block_rows, lengths,
             )
-            valid = (jnp.arange(bucket) < true_len)[None, :]
+            valid = jnp.arange(bucket)[None, :] < true_lens[:, None]
             pk, pv = self._write_kv(
-                pk, pv, nk, nv, block_row[None, :], jnp.zeros((1,), jnp.int32), valid
+                pk, pv, nk, nv, block_rows, jnp.zeros((k,), jnp.int32), valid
             )
-            return logits[0, true_len - 1], pk, pv
+            last = logits[jnp.arange(k), true_lens - 1]  # (k, vocab)
+            return last, pk, pv
 
         return jax.jit(prefill, donate_argnums=(1, 2))
 
@@ -421,11 +465,23 @@ class PagedEngine:
 
         return jax.lax.cond(temperature > 0, draw, lambda _: greedy, None)
 
+    def _get_chunk(self, steps: int):
+        """Compiled decode program for one ladder size (lazy, cached)."""
+        fn = self._chunk_jit.get(steps)
+        if fn is None:
+            from functools import partial
+
+            fn = self._jax.jit(
+                partial(self._chunk_fn, steps), donate_argnums=(1, 2)
+            )
+            self._chunk_jit[steps] = fn
+        return fn
+
     def _chunk_fn(
-        self, params, pk, pv, logits, lengths, block_tables, keys,
+        self, steps, params, pk, pv, logits, lengths, block_tables, keys,
         done, emitted, max_new, temps, top_ks, eos_ids,
     ):
-        """``steps_per_call`` decode steps for all slots, on device."""
+        """``steps`` decode steps for all slots, on device."""
         jax, jnp = self._jax, self._jnp
         # dequant ONCE per chunk, amortised over steps_per_call decode
         # steps (int8 halves resident weight HBM; measured on TPU,
@@ -463,9 +519,48 @@ class PagedEngine:
 
         (pk, pv, logits, lengths, keys, done, emitted), toks = jax.lax.scan(
             step, (pk, pv, logits, lengths, keys, done, emitted),
-            None, length=self.steps_per_call,
+            None, length=steps,
         )
         return toks.T, pk, pv, logits, lengths, keys, done, emitted
+
+    def _draft_rollout_fn(self, params, windows, lens):
+        """Greedy ``draft_k``-token rollout of the windowed draft model
+        for every slot in ONE program.
+
+        ``windows`` (slots, W) holds each context's last <=W tokens
+        LEFT-aligned with ``lens`` (slots,) valid counts: for contexts
+        that fit the window, token positions equal absolute positions —
+        a draft sharing the target's architecture then reproduces the
+        target's own argmaxes (the self-draft ceiling).  Longer
+        contexts slide (drop-oldest), trading positional alignment for
+        recency — a draft trained on sliding windows expects exactly
+        that.  Draft quality only moves acceptance; the verify forward
+        keeps output greedy-exact regardless.  Causal masking makes the
+        zero-padding after ``lens`` invisible to positions < lens."""
+        jax, jnp = self._jax, self._jnp
+        W = self.draft_window
+        S = windows.shape[0]
+
+        def step(carry, _):
+            win, ln = carry
+            logits = self._draft_module.apply({"params": params}, win)
+            tok = jnp.argmax(
+                logits[jnp.arange(S), jnp.maximum(ln - 1, 0)], axis=-1
+            ).astype(jnp.int32)
+            full = ln >= W
+            shifted = jnp.concatenate(
+                [win[:, 1:], jnp.zeros((S, 1), win.dtype)], axis=1
+            )
+            win = jnp.where(full[:, None], shifted, win)
+            pos = jnp.where(full, W - 1, ln)
+            win = win.at[jnp.arange(S), pos].set(tok)
+            ln = jnp.minimum(ln + 1, W)
+            return (win, ln), tok
+
+        (_, _), toks = jax.lax.scan(
+            step, (windows, lens), None, length=self.draft_k
+        )
+        return toks.T  # (slots, draft_k)
 
     def _spec_chunk_fn(self, params, pk, pv, segs, n_drafts, active,
                        block_tables, lengths):
@@ -606,35 +701,63 @@ class PagedEngine:
             admitted.append((stream, plen))
         return admitted
 
-    def _prefill_stream(self, stream: _Stream) -> None:
+    def _prefill_streams(self, streams: List[_Stream]) -> None:
+        """Prefill admitted streams, batching same-bucket prompts into
+        one device call each (k padded to the next power of two so the
+        compile count stays logarithmic)."""
         jnp = self._jnp
-        plen = len(stream.prompt)
-        bucket = next(b for b in self.prompt_buckets if b >= plen)
-        if bucket not in self._prefill_jit:
-            self._prefill_jit[bucket] = self._build_prefill(bucket)
-        padded = np.zeros((1, bucket), np.int32)
-        padded[0, :plen] = stream.prompt
-        last, self.pages_k, self.pages_v = self._prefill_jit[bucket](
-            self.params, self.pages_k, self.pages_v,
-            jnp.asarray(padded), jnp.asarray(plen, jnp.int32),
-            jnp.asarray(self._block_tables[stream.slot]),
-        )
-        self._logits = self._logits.at[stream.slot].set(last)
-        if self.speculative is not None:
-            # host decides the next greedy token between verify rounds
-            stream.pending = int(self._jnp.argmax(last))
-        # deterministic per submit(seed=...): same seed -> same sample path
-        # (per-request variation is the component layer's job, as in
-        # GenerativeLM's puid/counter folding)
-        key = self._jax.random.key_data(self._jax.random.key(stream.seed))
-        self._keys = self._keys.at[stream.slot].set(key)
+        by_bucket: Dict[int, List[_Stream]] = {}
+        for stream in streams:
+            plen = len(stream.prompt)
+            bucket = next(b for b in self.prompt_buckets if b >= plen)
+            by_bucket.setdefault(bucket, []).append(stream)
+        for bucket, group in by_bucket.items():
+            k = 1
+            while k < len(group):
+                k *= 2
+            key = (bucket, k)
+            if key not in self._prefill_jit:
+                self._prefill_jit[key] = self._build_prefill(bucket, k)
+            padded = np.zeros((k, bucket), np.int32)
+            true_lens = np.ones((k,), np.int32)  # pad rows: 1 token -> trash
+            block_rows = np.zeros((k, self.pages_per_stream), np.int32)
+            for i, stream in enumerate(group):
+                plen = len(stream.prompt)
+                padded[i, :plen] = stream.prompt
+                true_lens[i] = plen
+                block_rows[i] = self._block_tables[stream.slot]
+            last, self.pages_k, self.pages_v = self._prefill_jit[key](
+                self.params, self.pages_k, self.pages_v,
+                jnp.asarray(padded), jnp.asarray(true_lens),
+                jnp.asarray(block_rows),
+            )
+            g = len(group)
+            for i, stream in enumerate(group):
+                # async dispatches (cached scalar-index programs), no
+                # readback — the per-stream cost batching must avoid is
+                # blocking round-trips, not launches
+                self._logits = self._logits.at[stream.slot].set(last[i])
+                # deterministic per submit(seed=...): same seed -> same
+                # sample path (per-request variation is the component
+                # layer's job, as in GenerativeLM's puid/counter folding)
+                key_data = self._jax.random.key_data(
+                    self._jax.random.key(stream.seed)
+                )
+                self._keys = self._keys.at[stream.slot].set(key_data)
+            if self.speculative is not None:
+                # host decides the next greedy token between verify
+                # rounds — ONE blocking readback for the whole group
+                pending = np.asarray(jnp.argmax(last[:g], axis=-1))
+                for i, stream in enumerate(group):
+                    stream.pending = int(pending[i])
 
-    def _ensure_pages_locked(self, stream: _Stream) -> bool:
+    def _ensure_pages_locked(self, stream: _Stream, per_chunk: Optional[int] = None) -> bool:
         """Grow the stream's block table to cover the next chunk."""
         slot = stream.slot
-        per_chunk = (
-            self.draft_k + 1 if self.speculative is not None else self.steps_per_call
-        )
+        if per_chunk is None:
+            per_chunk = (
+                self.draft_k + 1 if self.speculative is not None else self.steps_per_call
+            )
         cap = len(stream.prompt) + stream.max_new
         if self.speculative is not None:
             cap += self.draft_k + 1  # the verify segment may scribble past
@@ -788,8 +911,7 @@ class PagedEngine:
         jnp = self._jnp
         with self._lock:
             admitted = self._admit_locked()
-        for stream, _ in admitted:
-            self._prefill_stream(stream)
+        self._prefill_streams([s for s, _ in admitted])
 
         with self._lock:
             self._counters["prefills"] += len(admitted)
@@ -798,9 +920,36 @@ class PagedEngine:
             )
             if not active:
                 return bool(self._queue)
+            # saturated-decode ladder: with nothing waiting for a slot,
+            # bigger chunks amortise the per-call round-trip; a waiting
+            # queue pins the short chunk so admission cadence (not the
+            # chunk length) stays the latency bound.  Each doubling is
+            # taken only if the POOL can back it for every active
+            # stream — otherwise a shrunk pool would mass-stall and the
+            # evict/re-admit cycle would discard decoded progress that
+            # base-size chunks were making steadily.
+            steps = self.steps_per_call
+            if not self._queue:
+                most = max(s.max_new - len(s.tokens) for s in active)
+                free = len(self._free_pages)
+                while steps * 2 <= self.max_steps and steps < most:
+                    nxt = steps * 2
+                    need = 0
+                    for s in active:
+                        horizon = min(
+                            int(self._lengths[s.slot]) + nxt,
+                            len(s.prompt) + s.max_new,
+                            self.max_len,
+                        )
+                        need += max(
+                            0, -(-horizon // self.page_size) - len(s.pages)
+                        )
+                    if need > free:
+                        break
+                    steps = nxt
             stalled = np.zeros((self.max_slots,), bool)
             for stream in active:
-                if not self._ensure_pages_locked(stream):
+                if not self._ensure_pages_locked(stream, per_chunk=steps):
                     stalled[stream.slot] = True
             self._counters["stalls"] += int(stalled.sum())
             # every active stream stalled on pool pressure: evict victims
@@ -814,7 +963,9 @@ class PagedEngine:
                 active.remove(victim)
                 self._evict_locked(victim)
                 for stream in active:
-                    if stalled[stream.slot] and self._ensure_pages_locked(stream):
+                    if stalled[stream.slot] and self._ensure_pages_locked(
+                        stream, per_chunk=steps
+                    ):
                         stalled[stream.slot] = False
             if not active:
                 return bool(self._queue)
@@ -835,7 +986,7 @@ class PagedEngine:
             emitted0 = jnp.zeros((self.max_slots,), jnp.int32)
 
         toks, self.pages_k, self.pages_v, self._logits, lengths_out, self._keys, _, emitted = (
-            self._chunk(
+            self._get_chunk(steps)(
                 self.params, self.pages_k, self.pages_v, self._logits,
                 lengths, tables, self._keys, jnp.asarray(done_in),
                 emitted0, jnp.asarray(max_new), jnp.asarray(temps),
@@ -876,8 +1027,7 @@ class PagedEngine:
         jnp = self._jnp
         with self._lock:
             admitted = self._admit_locked()
-        for stream, _ in admitted:
-            self._prefill_stream(stream)
+        self._prefill_streams([s for s, _ in admitted])
 
         with self._lock:
             self._counters["prefills"] += len(admitted)
@@ -915,7 +1065,30 @@ class PagedEngine:
             n_drafts = np.zeros((self.max_slots,), np.int32)
             active_mask = np.zeros((self.max_slots,), bool)
             runnable = [s for s in active if not stalled[s.slot]]
-            oracle = self.speculative["draft"] == "oracle"
+            mode = self.speculative["draft"]
+            model_drafts = None
+            if mode == "model" and runnable:
+                # one batched rollout call for every runnable slot (the
+                # draft is small; through a relayed host this adds one
+                # round-trip per round — on attached hardware it is
+                # microseconds).  Windows end at each stream's pending
+                # token (tokens[-1] — the loop invariant), so drafts
+                # continue exactly the sequence the verify checks.
+                W = self.draft_window
+                windows = np.zeros((self.max_slots, W), np.int32)
+                lens = np.zeros((self.max_slots,), np.int32)
+                for stream in runnable:
+                    ctx = np.concatenate(
+                        [stream.prompt, np.asarray(stream.tokens, np.int32)]
+                    )
+                    tail = ctx[-W:]
+                    windows[stream.slot, : len(tail)] = tail
+                    lens[stream.slot] = len(tail)
+                model_drafts = np.asarray(
+                    self._draft_rollout(
+                        self._draft_params, jnp.asarray(windows), jnp.asarray(lens)
+                    )
+                )
             for stream in runnable:
                 slot = stream.slot
                 # never draft past the stream's budget: each accepted
@@ -927,9 +1100,11 @@ class PagedEngine:
                 k_eff = max(0, min(self.draft_k, remaining - 1))
                 if k_eff == 0:
                     drafted = np.zeros((0,), np.int32)
-                elif oracle and stream.draft_hint is not None:
+                elif mode == "oracle" and stream.draft_hint is not None:
                     done = len(stream.tokens)
                     drafted = stream.draft_hint[done : done + k_eff]
+                elif mode == "model":
+                    drafted = model_drafts[slot, :k_eff]
                 else:
                     context = np.concatenate(
                         [stream.prompt, np.asarray(stream.tokens, np.int32)]
@@ -1018,6 +1193,7 @@ class StreamingLM(TPUComponent):
         num_pages: int = 0,
         max_slots: int = 8,
         steps_per_call: int = 8,
+        max_steps_per_call: int = 0,
         mesh_axes: Optional[Dict[str, int]] = None,
         quantize: str = "",
         speculative: Optional[Dict[str, Any]] = None,
@@ -1034,6 +1210,7 @@ class StreamingLM(TPUComponent):
         self.engine_config = dict(
             page_size=int(page_size), num_pages=int(num_pages) or None,
             max_slots=int(max_slots), steps_per_call=int(steps_per_call),
+            max_steps_per_call=int(max_steps_per_call),
             quantize=validate_quantize_mode(quantize),  # fail at construction
             # speculative={"draft": "ngram", "draft_k": k, "ngram": n}:
             # per-slot draft/verify INSIDE the continuous-batching

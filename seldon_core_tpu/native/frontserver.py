@@ -62,6 +62,28 @@ _RAW_CB = ctypes.CFUNCTYPE(
 )
 
 
+_GRPC_CB = ctypes.CFUNCTYPE(
+    ctypes.c_int32,
+    ctypes.c_void_p,                          # ctx
+    ctypes.c_char_p,                          # path
+    ctypes.POINTER(ctypes.c_uint8),           # msg
+    ctypes.c_int64,                           # msg_len
+    ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8)),  # out_buf
+    ctypes.POINTER(ctypes.c_int64),           # out_len
+    ctypes.POINTER(ctypes.c_int32),           # grpc_status
+    ctypes.POINTER(ctypes.c_char),            # grpc_msg[256] (writable)
+)
+
+_GRPC_STREAM_CB = ctypes.CFUNCTYPE(
+    ctypes.c_int32,
+    ctypes.c_void_p,                          # ctx
+    ctypes.c_char_p,                          # path
+    ctypes.POINTER(ctypes.c_uint8),           # msg
+    ctypes.c_int64,                           # msg_len
+    ctypes.c_uint64,                          # stream_handle
+)
+
+
 class _FsConfig(ctypes.Structure):
     _fields_ = [
         ("port", ctypes.c_int32),
@@ -107,6 +129,19 @@ def _bind(lib) -> None:
     lib.fs_destroy.argtypes = [ctypes.c_void_p]
     lib.fs_set_batch_handler.argtypes = [ctypes.c_void_p, _BATCH_CB, ctypes.c_void_p]
     lib.fs_set_raw_handler.argtypes = [ctypes.c_void_p, _RAW_CB, ctypes.c_void_p]
+    if hasattr(lib, "fs_set_grpc_handler"):  # older .so builds lack the lane
+        lib.fs_set_grpc_handler.argtypes = [ctypes.c_void_p, _GRPC_CB, ctypes.c_void_p]
+        lib.fs_set_grpc_stream_handler.argtypes = [
+            ctypes.c_void_p, _GRPC_STREAM_CB, ctypes.c_void_p
+        ]
+        lib.fs_stream_push.restype = ctypes.c_int64
+        lib.fs_stream_push.argtypes = [
+            ctypes.c_void_p, ctypes.c_uint64,
+            ctypes.POINTER(ctypes.c_uint8), ctypes.c_int64,
+        ]
+        lib.fs_stream_close.argtypes = [
+            ctypes.c_void_p, ctypes.c_uint64, ctypes.c_int32, ctypes.c_char_p
+        ]
     lib.fs_start.restype = ctypes.c_int32
     lib.fs_start.argtypes = [ctypes.c_void_p]
     lib.fs_stop.argtypes = [ctypes.c_void_p]
@@ -125,6 +160,12 @@ def available() -> bool:
 
 
 RawHandler = Callable[[str, str, bytes], Tuple[int, str, bytes]]
+# (path, request_proto_bytes) -> (grpc_status, grpc_message, response_proto)
+GrpcHandler = Callable[[str, bytes], Tuple[int, str, bytes]]
+# (path, request_proto_bytes, stream_handle) -> 0 to accept; the handler
+# spawns its own producer thread and pushes via server.stream_push /
+# server.stream_close
+GrpcStreamHandler = Callable[[str, bytes, int], int]
 
 
 class NativeFrontServer:
@@ -148,6 +189,8 @@ class NativeFrontServer:
         model_name: str = "model",
         names: Optional[Sequence[str]] = None,
         raw_handler: Optional[RawHandler] = None,
+        grpc_handler: Optional[GrpcHandler] = None,
+        grpc_stream_handler: Optional[GrpcStreamHandler] = None,
         raw_workers: int = 2,
         eager_when_idle: bool = True,
         buckets: Optional[Sequence[int]] = None,
@@ -161,6 +204,8 @@ class NativeFrontServer:
         self._lib = lib
         self.model_fn = model_fn
         self.raw_handler = raw_handler
+        self.grpc_handler = grpc_handler
+        self.grpc_stream_handler = grpc_stream_handler
         cfg = _FsConfig(
             port=port,
             max_batch=max_batch,
@@ -187,6 +232,14 @@ class NativeFrontServer:
         if raw_handler is not None:
             self._raw_cb = _RAW_CB(self._on_raw)
             lib.fs_set_raw_handler(self._handle, self._raw_cb, None)
+        self._grpc_cb = None
+        self._grpc_stream_cb = None
+        if grpc_handler is not None and hasattr(lib, "fs_set_grpc_handler"):
+            self._grpc_cb = _GRPC_CB(self._on_grpc)
+            lib.fs_set_grpc_handler(self._handle, self._grpc_cb, None)
+        if grpc_stream_handler is not None and hasattr(lib, "fs_set_grpc_stream_handler"):
+            self._grpc_stream_cb = _GRPC_STREAM_CB(self._on_grpc_stream)
+            lib.fs_set_grpc_stream_handler(self._handle, self._grpc_stream_cb, None)
         self.port = 0
         self._started = False
         # serialises stop() against set_ready()/stats(): the C++ object
@@ -232,6 +285,55 @@ class NativeFrontServer:
         except Exception:
             logger.exception("native front server raw callback failed")
             return 1
+
+    def _on_grpc(self, _ctx, path, msg_ptr, msg_len, out_buf, out_len,
+                 status_ptr, msg_buf) -> int:
+        try:
+            body = ctypes.string_at(msg_ptr, msg_len) if msg_len else b""
+            status, message, payload = self.grpc_handler(path.decode(), body)
+            buf = self._lib.fs_alloc(len(payload))
+            if payload:
+                ctypes.memmove(buf, payload, len(payload))
+            out_buf[0] = buf
+            out_len[0] = len(payload)
+            status_ptr[0] = int(status)
+            m = message.encode()[:255]
+            ctypes.memmove(msg_buf, m + b"\x00", len(m) + 1)
+            return 0
+        except Exception:
+            logger.exception("native front server grpc callback failed")
+            return 1
+
+    def _on_grpc_stream(self, _ctx, path, msg_ptr, msg_len, handle) -> int:
+        try:
+            body = ctypes.string_at(msg_ptr, msg_len) if msg_len else b""
+            return int(self.grpc_stream_handler(path.decode(), body, int(handle)))
+        except Exception:
+            logger.exception("native front server grpc stream callback failed")
+            return 1
+
+    # ----------------------------------------------- stream producer API
+
+    def stream_push(self, handle: int, payload: bytes) -> int:
+        """Queue one gRPC message on an open server-stream.  Returns -1
+        when the stream is dead (client gone) — producers must stop."""
+        with self._handle_lock:
+            if not self._handle:
+                return -1
+            buf = (ctypes.c_uint8 * len(payload)).from_buffer_copy(payload)
+            return int(self._lib.fs_stream_push(
+                self._handle, ctypes.c_uint64(handle), buf, len(payload)
+            ))
+
+    def stream_close(self, handle: int, grpc_status: int = 0,
+                     grpc_message: str = "") -> None:
+        with self._handle_lock:
+            if not self._handle:
+                return
+            self._lib.fs_stream_close(
+                self._handle, ctypes.c_uint64(handle),
+                ctypes.c_int32(grpc_status), grpc_message.encode()[:255]
+            )
 
     # ------------------------------------------------------------ lifecycle
 

@@ -706,9 +706,11 @@ class TestPageAccounting:
         eng = _engine(params)
         eng.generate(np.array([1, 2, 3], np.int32), max_new_tokens=4)
         eng.generate(np.arange(20, dtype=np.int32), max_new_tokens=9)
-        # prefill ladder: buckets 16 and 32; decode: the single chunk jit
-        assert set(eng._prefill_jit) <= set(eng.prompt_buckets)
-        assert eng._chunk._cache_size() == 1
+        # prefill ladder: (bucket, k) programs with buckets from the
+        # ladder; decode: one chunk program per ladder size used (no
+        # max_steps_per_call here -> exactly the base program)
+        assert {b for (b, _k) in eng._prefill_jit} <= set(eng.prompt_buckets)
+        assert list(eng._chunk_jit) == [eng.steps_per_call]
 
 
 class TestMeshShardedDecode:
@@ -871,3 +873,166 @@ class TestEngineStats:
         assert 0.0 <= by_key["paged_pool_utilization"]["value"] <= 1.0
         # collected after every request -> cumulative values must be GAUGEs
         assert all(m["type"] == "GAUGE" for m in comp.metrics())
+
+
+class TestStepsLadder:
+    """max_steps_per_call: saturated decode grows chunks (x2 ladder) so
+    one program call decodes more tokens; a waiting queue pins the short
+    chunk so admission cadence stays the latency bound."""
+
+    def test_ladder_reduces_chunks_and_stays_exact(self, lm):
+        module, params = lm
+        prompt = np.arange(9, dtype=np.int32) % CFG["vocab_size"]
+        base = _engine(params)
+        toks_base = base.generate(prompt, max_new_tokens=24)
+        ladder = _engine(params, max_steps_per_call=16)
+        toks_ladder = ladder.generate(prompt, max_new_tokens=24)
+        np.testing.assert_array_equal(toks_base, toks_ladder)
+        assert ladder.engine_stats()["chunks"] < base.engine_stats()["chunks"]
+
+    def test_queue_pressure_pins_short_chunks(self, lm):
+        module, params = lm
+        # 5 streams into 4 slots: one always queued, so every chunk while
+        # it waits must be the base size (admission cadence unharmed)
+        eng = _engine(params, max_steps_per_call=16, num_pages=4 * 8 + 1)
+        prompts = [
+            (np.arange(5 + i, dtype=np.int32) % CFG["vocab_size"]) for i in range(5)
+        ]
+        streams = [eng.submit(p, max_new_tokens=12) for p in prompts]
+        # first step: queue non-empty -> short chunk
+        eng.step()
+        assert eng.engine_stats()["chunks"] == 1
+        eng.run()
+        singles = [_engine(params).generate(p, max_new_tokens=12) for p in prompts]
+        for s, want in zip(streams, singles):
+            np.testing.assert_array_equal(s.result, want)
+
+
+class TestBatchedPrefill:
+    def test_same_bucket_joiners_prefill_in_one_call(self, lm):
+        module, params = lm
+        eng = _engine(params)
+        prompts = [
+            (np.arange(6 + i, dtype=np.int32) % CFG["vocab_size"]) for i in range(4)
+        ]
+        streams = [eng.submit(p, max_new_tokens=8) for p in prompts]
+        eng.run()
+        # all four prompts fit one bucket -> exactly one (bucket, k=4)
+        # prefill program was built and stats count 4 prefilled streams
+        assert eng.engine_stats()["prefills"] == 4
+        assert len(eng._prefill_jit) == 1
+        (bucket, k), = eng._prefill_jit.keys()
+        assert k == 4
+        for s, p in zip(streams, prompts):
+            want = _greedy_uncached(module, params, p[None, :], 8)
+            np.testing.assert_array_equal(s.result, np.asarray(want, np.int32))
+
+    def test_mixed_buckets_split_calls_stay_exact(self, lm):
+        module, params = lm
+        eng = _engine(params, prompt_buckets=[8, 32])
+        short = np.arange(5, dtype=np.int32) % CFG["vocab_size"]
+        long = np.arange(20, dtype=np.int32) % CFG["vocab_size"]
+        s1 = eng.submit(short, max_new_tokens=6)
+        s2 = eng.submit(long, max_new_tokens=6)
+        eng.run()
+        for s, p in zip((s1, s2), (short, long)):
+            want = _greedy_uncached(module, params, p[None, :], 6)
+            np.testing.assert_array_equal(s.result, np.asarray(want, np.int32))
+        assert {b for (b, _k) in eng._prefill_jit} == {8, 32}
+
+
+class TestDraftModelLane:
+    """draft='model': a small LM proposes tokens; verification keeps
+    greedy output bit-exact whatever the draft proposes."""
+
+    def _draft(self, seed=3):
+        dc = dict(vocab_size=CFG["vocab_size"], d_model=16, num_layers=1,
+                  num_heads=2, max_len=32)
+        module = TransformerLM(dtype=jnp.float32, **dc)
+        params = module.init(jax.random.key(seed), jnp.zeros((1, 8), jnp.int32))["params"]
+        return params, dc
+
+    def test_greedy_bit_exact_with_random_draft(self, lm):
+        module, params = lm
+        dparams, dc = self._draft()
+        prompts = [
+            (np.arange(7 + 3 * i, dtype=np.int32) % CFG["vocab_size"]) for i in range(3)
+        ]
+        plain = _engine(params)
+        spec = _engine(
+            params,
+            speculative={"draft": "model", "draft_k": 3, "draft_params": dparams,
+                         "draft_config": dc, "draft_window": 16},
+        )
+        for p in prompts:
+            want = plain.generate(p, max_new_tokens=10)
+            got = spec.generate(p, max_new_tokens=10)
+            np.testing.assert_array_equal(want, got)
+        stats = spec.engine_stats()
+        assert stats["spec_drafted"] > 0  # the model lane actually drafted
+
+    def test_target_as_its_own_draft_accepts(self, lm):
+        """Self-draft sanity: when the draft IS the target (same params,
+        full-context window, window-relative == absolute positions for
+        contexts shorter than the window), drafts are the target's own
+        argmaxes and acceptance is high."""
+        module, params = lm
+        spec = _engine(
+            params,
+            speculative={"draft": "model", "draft_k": 3, "draft_params": params,
+                         "draft_config": dict(CFG), "draft_window": CFG["max_len"]},
+        )
+        prompt = np.arange(6, dtype=np.int32) % CFG["vocab_size"]
+        plain = _engine(params)
+        np.testing.assert_array_equal(
+            plain.generate(prompt, max_new_tokens=12),
+            spec.generate(prompt, max_new_tokens=12),
+        )
+        s = spec.engine_stats()
+        # left-padded zeros vs absolute positions differ slightly; the
+        # bar is meaningful acceptance, not perfection
+        assert s["spec_accepted"] / max(1, s["spec_drafted"]) > 0.5
+
+    def test_model_draft_requires_params(self, lm):
+        module, params = lm
+        with pytest.raises(ValueError, match="draft_params"):
+            _engine(params, speculative={"draft": "model"})
+
+    def test_vocab_mismatch_rejected(self, lm):
+        module, params = lm
+        dparams, dc = self._draft()
+        dc["vocab_size"] = CFG["vocab_size"] * 2
+        with pytest.raises(ValueError, match="vocab"):
+            _engine(
+                params,
+                speculative={"draft": "model", "draft_params": dparams,
+                             "draft_config": dc},
+            )
+
+
+class TestLadderPoolPressure:
+    def test_ladder_never_induces_eviction_churn(self, lm):
+        """A shrunk pool: two streams each ultimately need 3 pages but
+        only 5 are usable — incremental growth lets one finish and free
+        pages for the other.  The ladder must not demand max-steps
+        worth of pages upfront (that would mass-stall and evict,
+        discarding decoded progress base-size chunks were making)."""
+        module, params = lm
+        eng = _engine(
+            params, page_size=8, max_slots=2, steps_per_call=4,
+            max_steps_per_call=32, num_pages=6,
+        )
+        prompts = [
+            (np.arange(5, dtype=np.int32) % CFG["vocab_size"]),
+            ((np.arange(5, dtype=np.int32) + 7) % CFG["vocab_size"]),
+        ]
+        streams = [eng.submit(p, max_new_tokens=19) for p in prompts]
+        eng.run()
+        stats = eng.engine_stats()
+        assert stats["evictions"] == 0
+        singles = [
+            _engine(params, page_size=8).generate(p, max_new_tokens=19)
+            for p in prompts
+        ]
+        for s, want in zip(streams, singles):
+            np.testing.assert_array_equal(s.result, want)
