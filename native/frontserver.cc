@@ -1539,7 +1539,9 @@ class FrontServer {
       if (e.close) {
         c.h2c->send_stream_close(sid, e.status, e.msg, &c.out);
         c.inflight--;
-        if (e.status != 0) failures_.fetch_add(1);
+        // status 1 = CANCELLED (the client's own disconnect) — a normal
+        // lifecycle event, not a server failure
+        if (e.status != 0 && e.status != 1) failures_.fetch_add(1);
       } else if (!c.h2c->send_stream_message(sid, e.bytes, &c.out)) {
         mark_stream_dead(e.handle);  // client reset: stop the producer
       }

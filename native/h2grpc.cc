@@ -733,10 +733,6 @@ void Conn::send_stream_close(uint32_t stream_id, int grpc_status,
                                         out);
 }
 
-bool Conn::stream_open(uint32_t stream_id) const {
-  return ((ConnImpl*)impl_)->streams.count(stream_id) != 0;
-}
-
 bool Conn::has_blocked() const {
   for (auto& kv : ((ConnImpl*)impl_)->streams) {
     if (!kv.second.pending_data.empty() || !kv.second.pending_trailers.empty())

@@ -57,8 +57,6 @@ class Conn {
   // response HEADERS first for error-before-first-message streams).
   void send_stream_close(uint32_t stream_id, int grpc_status,
                          const std::string& grpc_message, std::string* out);
-  // True while the client half of the stream still exists.
-  bool stream_open(uint32_t stream_id) const;
 
   // Streams with queued response bytes blocked on peer flow control.
   bool has_blocked() const;

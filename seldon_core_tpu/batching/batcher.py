@@ -142,7 +142,12 @@ class BatcherStats:
         def pct(sorted_vals, q):
             if not sorted_vals:
                 return None
-            return round(sorted_vals[min(len(sorted_vals) - 1, int(len(sorted_vals) * q))], 3)
+            # nearest-rank: ceil(q*n)-1 — int(q*n) reads one order
+            # statistic high (p99 of 100 samples would be the max)
+            import math
+
+            idx = max(0, math.ceil(q * len(sorted_vals)) - 1)
+            return round(sorted_vals[idx], 3)
 
         return {
             "p50_ms": pct(total, 0.50),
@@ -453,9 +458,12 @@ class MultiSignatureBatcher:
     @property
     def stats(self) -> BatcherStats:
         """Aggregate stats over all signature groups."""
-        agg = BatcherStats()
         with self._lock:
             groups = list(self._groups.values())
+        # reservoir sized to hold EVERY group's samples: aggregating N
+        # full groups into a default-size ring would silently evict all
+        # but the last-iterated signature's latencies
+        agg = BatcherStats(reservoir=max(1, len(groups)) * 8192)
         for g in groups:
             agg.requests += g.stats.requests
             agg.batches += g.stats.batches

@@ -308,10 +308,15 @@ async def serve_native_ingress(
     lane = fast_lane_for(gateway)
     if batch_threads is None:
         batch_threads = int(os.environ.get("SELDON_TPU_NATIVE_BATCH_THREADS", "4"))
+    # the raw-worker pool now also carries the gRPC fallback lanes
+    # (unary SendFeedback/Predict block in fut.result; stream accepts
+    # must never queue behind them) — default well above the bare
+    # HTTP-fallback sizing of 2
+    raw_workers = int(os.environ.get("SELDON_TPU_NATIVE_RAW_WORKERS", "8"))
     kwargs = dict(port=http_port, raw_handler=handler, grpc_handler=grpc_handler,
                   grpc_stream_handler=grpc_stream_handler,
                   max_wait_ms=max_wait_ms, host=host,
-                  batch_threads=batch_threads)
+                  batch_threads=batch_threads, raw_workers=raw_workers)
     if lane is not None:
         kwargs.update(
             model_fn=_live_model_fn(gateway, lane["feature_dim"], lane["out_dim"]),

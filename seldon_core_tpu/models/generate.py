@@ -325,10 +325,18 @@ class GenerativeLM(TPUComponent):
 
         self._counter = 0
         self._counter_lock = threading.Lock()
+        self._load_lock = threading.Lock()
 
     def load(self) -> None:
-        params = load_lm_params(self.model_uri, self.config, self.seed)
-        self.generator = Generator(params, quantize=self.quantize, **self.config)
+        # idempotent AND locked: the executor load()s on graph build
+        # while concurrent first predicts lazy-load — an unlocked
+        # check-then-act would let a second build swap the generator
+        # (and its donated-buffer state) under an in-flight caller
+        with self._load_lock:
+            if self.generator is not None:
+                return
+            params = load_lm_params(self.model_uri, self.config, self.seed)
+            self.generator = Generator(params, quantize=self.quantize, **self.config)
 
     def predict(self, X, names, meta=None):
         if self.generator is None:

@@ -1233,22 +1233,34 @@ class StreamingLM(TPUComponent):
         self._counter_lock = threading.Lock()
 
     def load(self) -> None:
-        import jax.numpy as jnp
+        # IDEMPOTENT, and it must be: the executor calls load() on graph
+        # build while lazy predict paths may already have loaded — a
+        # second load would replace self.engine and start a SECOND
+        # decode-loop thread, and both threads (the orphaned one reads
+        # self.engine dynamically) would step ONE engine concurrently,
+        # racing the donated pool buffers ("Array has been deleted")
+        with self._load_lock:
+            if self.engine is not None:
+                return
+            import jax.numpy as jnp
 
-        from seldon_core_tpu.models.generate import load_lm_params
+            from seldon_core_tpu.models.generate import load_lm_params
 
-        params = load_lm_params(self.model_uri, self.config, self.seed)
-        from seldon_core_tpu.parallel.mesh import mesh_from_axes
+            params = load_lm_params(self.model_uri, self.config, self.seed)
+            from seldon_core_tpu.parallel.mesh import mesh_from_axes
 
-        mesh = mesh_from_axes(self.mesh_axes)
-        self.engine = PagedEngine(
-            params, dtype=jnp.bfloat16, mesh=mesh,
-            **self.config, **self.engine_config,
-        )
-        self._loop_thread = threading.Thread(
-            target=self._loop, name="streaminglm-decode", daemon=True
-        )
-        self._loop_thread.start()
+            mesh = mesh_from_axes(self.mesh_axes)
+            engine = PagedEngine(
+                params, dtype=jnp.bfloat16, mesh=mesh,
+                **self.config, **self.engine_config,
+            )
+            self._loop_thread = threading.Thread(
+                target=self._loop, name="streaminglm-decode", daemon=True
+            )
+            # publish the engine only after full construction; the loop
+            # thread reads self.engine
+            self.engine = engine
+            self._loop_thread.start()
 
     def _loop(self) -> None:
         while not self._stop:
@@ -1275,9 +1287,7 @@ class StreamingLM(TPUComponent):
 
     def predict(self, X, names, meta=None):
         if self.engine is None:
-            with self._load_lock:
-                if self.engine is None:
-                    self.load()
+            self.load()  # idempotent + internally locked
         meta = meta or {}
         tags = meta.get("tags", {})
         max_new = int(tags.get("max_new_tokens", self.max_new_tokens))
@@ -1324,9 +1334,7 @@ class StreamingLM(TPUComponent):
         left off (deterministic seeds + the streamed cursor).
         """
         if self.engine is None:
-            with self._load_lock:
-                if self.engine is None:
-                    self.load()
+            self.load()  # idempotent + internally locked
         meta = meta or {}
         tags = meta.get("tags", {})
         max_new = int(tags.get("max_new_tokens", self.max_new_tokens))

@@ -367,8 +367,18 @@ class SpeculativeLM(TPUComponent):
         # predicts must serialize or they would interleave scatters into
         # the same donated buffers (use several replicas to parallelise)
         self._gen_lock = threading.Lock()
+        self._load_lock = threading.Lock()
 
     def load(self) -> None:
+        # idempotent AND locked: executor load() + concurrent lazy
+        # predict loads must not swap the generator (and its paged
+        # pool) mid-use
+        with self._load_lock:
+            if self.generator is not None:
+                return
+            self._load_locked()
+
+    def _load_locked(self) -> None:
         import jax.numpy as jnp
 
         from seldon_core_tpu.models.generate import load_lm_params

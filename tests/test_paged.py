@@ -1036,3 +1036,25 @@ class TestLadderPoolPressure:
         ]
         for s, want in zip(streams, singles):
             np.testing.assert_array_equal(s.result, want)
+
+
+class TestIdempotentLoad:
+    def test_double_load_keeps_one_engine_and_one_stepper(self, lm):
+        """The executor load()s on graph build while lazy predict may
+        already have loaded: a second load must NOT replace the engine —
+        the orphaned loop thread (which reads self.engine dynamically)
+        would step the new engine concurrently with the new thread,
+        racing the donated pool buffers ("Array has been deleted")."""
+        comp = StreamingLM(max_new_tokens=6, page_size=8, max_slots=2,
+                           steps_per_call=2, **CFG)
+        try:
+            prompt = np.array([5, 9, 13], np.int32)
+            first = comp.predict(prompt[None], [], meta={"tags": {"seed": 0}})
+            engine = comp.engine
+            comp.load()  # what PredictorService graph build does
+            assert comp.engine is engine
+            # serving still healthy and deterministic after the re-load
+            again = comp.predict(prompt[None], [], meta={"tags": {"seed": 0}})
+            np.testing.assert_array_equal(first, again)
+        finally:
+            comp.shutdown()
