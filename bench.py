@@ -140,6 +140,7 @@ def _compact_result(full: dict) -> dict:
         ("int8_decode_x", ("generation", "int8_vs_fp_decode")),
         ("gen_tok_s", ("generation", "decode_tokens_per_s")),
         ("paged_tok_s", ("generation", "paged_serving_tokens_per_s")),
+        ("paged_chunk_tok_s", ("generation", "paged_chunk_tokens_per_s")),
         ("paged_micro_tok_s", ("generation", "paged_decode_tokens_per_s")),
         ("spec_draft_acc", ("generation", "spec_draft_acceptance")),
         ("spec_ngram_acc", ("generation", "spec_ngram_acceptance")),
@@ -232,7 +233,13 @@ def supervise() -> None:
     # the full phase list (latency, throughput, in-process, roofline,
     # native model, stub, int8, generation) needs headroom; the
     # persistent XLA cache makes retried attempts much cheaper
-    timeout_s = float(os.environ.get("BENCH_ATTEMPT_TIMEOUT_S", "180" if QUICK else "900"))
+    # 1200 not 900: the r4 phase list (device-loop sweep, serving-scale
+    # paged, in-bench distillation) can exceed 900 s on a COLD compile
+    # cache; warm attempts finish in ~10-12 min
+    # QUICK's 320: the generation phase alone (scan + int8 + spec
+    # exactness + distilled draft + serving block) measured ~220 s of
+    # compile-dominated wall on a cold cache; 180 cut it off every time
+    timeout_s = float(os.environ.get("BENCH_ATTEMPT_TIMEOUT_S", "320" if QUICK else "1200"))
     backoffs = [10.0, 30.0, 60.0]
     failures: list = []
     best_status: dict = {}  # most-complete partial across ALL attempts
@@ -1021,8 +1028,9 @@ def generation_phase() -> dict:
     from seldon_core_tpu.models.generate import Generator
     from seldon_core_tpu.models.transformer import TransformerLM
 
+    quick = QUICK or MODEL == "resnet_tiny"
     cfg = dict(vocab_size=16384, d_model=512, num_layers=8, num_heads=8, max_len=1024)
-    if os.environ.get("BENCH_QUICK", "0") == "1" or MODEL == "resnet_tiny":
+    if quick:
         cfg = dict(vocab_size=256, d_model=64, num_layers=2, num_heads=4, max_len=256)
     batch, plen, max_new = 8, 128, 128
     module = TransformerLM(dtype=jnp.bfloat16, **cfg)
@@ -1069,7 +1077,17 @@ def generation_phase() -> dict:
     # tokens, fewer compiled-program invocations when drafts accept.
     # Repetition-heavy prompts are the representative speculation
     # workload (summaries / code edits / RAG echo their context).
+    # TPU f32 matmuls default to bf16 MXU passes, so the width-1 decode
+    # and width-(k+1) verify programs round logits differently and an
+    # argmax tie can flip (observed r4 after the horizon-slicing
+    # rework).  Greedy exactness is a single-numeric-regime property:
+    # the whole comparison runs at true-f32 matmul precision (tiny
+    # model — the cost is irrelevant, and both lanes pay it equally so
+    # the relative rates stay fair; the serving block below runs bf16
+    # at default precision).
+    _prev_prec = jax.config.jax_default_matmul_precision
     try:
+        jax.config.update("jax_default_matmul_precision", "highest")
         from seldon_core_tpu.models.paged import PagedEngine
 
         pe_cfg = dict(cfg)
@@ -1175,60 +1193,68 @@ def generation_phase() -> dict:
         result["plain_chunks"] = plain_stats["chunks"] // 2
 
         # draft-MODEL lane: a small draft LM distilled in-bench on the
-        # target's own greedy continuations of HELD-OUT echo prompts
-        # (behavioural cloning of the argmax path — the only honest way
-        # to get a "trained draft" for a random-weight target).  The
-        # measured prompts never enter training.  Greedy exactness is
-        # asserted; acceptance is reported as realised.
+        # target's greedy continuations of HELD-OUT echo prompts.  The
+        # workload's exploitable structure is copying (that is why
+        # ngram accepts 0.54), so training uses MANY random-pattern
+        # sequences — with distinct patterns per sequence, the only
+        # compressive solution is induction (copy heads), which
+        # transfers to the measured prompts; memorising a handful of
+        # sequences (the r4-interim 150-step version) transfers
+        # nothing and accepted 0.0.  Training runs ON DEVICE as one
+        # fori_loop program (one dispatch, not one per step — the same
+        # lesson as the device_loop roofline).  Measured prompts never
+        # enter training; greedy exactness is asserted either way.
         import optax
 
+        from seldon_core_tpu.models.generate import Generator
         from seldon_core_tpu.models.transformer import TransformerLM
 
         dc = dict(
             vocab_size=cfg["vocab_size"], d_model=max(64, cfg["d_model"] // 8),
             num_layers=2, num_heads=4, max_len=pe_cfg["max_len"],
         )
-        held_out = [
-            np.tile(np.arange(7, dtype=np.int32) + 11, 24)[: 40 + 6 * i]
-            % cfg["vocab_size"]
-            for i in range(6)
-        ]
-        held_streams = [warm.submit(p, max_new_tokens=spec_new) for p in held_out]
-        warm.run()  # continuous batching drains all six together
-        held_prior = [s.result for s in held_streams]
-        train_seqs = [
-            np.concatenate([p, g[g >= 0]]).astype(np.int32)
-            for p, g in zip(held_out, held_prior)
-        ]
-        L = max(len(s) for s in train_seqs)
-        batch_ids = np.zeros((len(train_seqs), L), np.int32)
-        mask = np.zeros((len(train_seqs), L), np.float32)
-        for i, s in enumerate(train_seqs):
-            batch_ids[i, : len(s)] = s
-            mask[i, : len(s) - 1] = 1.0
+        n_train, plen_train = (32, 48) if quick else (128, 96)
+        rng_d = np.random.default_rng(17)
+        patterns = rng_d.integers(
+            0, cfg["vocab_size"], size=(n_train, 8)
+        ).astype(np.int32)
+        train_prompts = np.concatenate(
+            [np.tile(p, plen_train // 8 + 1)[None, :plen_train] for p in patterns]
+        )
+        gen_f32 = Generator(spec_params, dtype=jnp.float32, **pe_cfg)
+        cont = gen_f32.generate(train_prompts, max_new_tokens=spec_new)
+        train_ids = np.concatenate([train_prompts, np.asarray(cont)], axis=1)
         draft_mod = TransformerLM(dtype=jnp.float32, **dc)
         dparams = draft_mod.init(
             jax.random.key(7), jnp.zeros((1, 8), jnp.int32)
         )["params"]
         opt = optax.adam(3e-3)
 
-        def loss_fn(p, ids, m):
+        def loss_fn(p, ids):
             logits = draft_mod.apply({"params": p}, ids)
             logp = jax.nn.log_softmax(logits[:, :-1])
-            tgt = ids[:, 1:]
-            nll = -jnp.take_along_axis(logp, tgt[..., None], axis=-1)[..., 0]
-            return (nll * m[:, : nll.shape[1]]).sum() / m.sum()
+            nll = -jnp.take_along_axis(
+                logp, ids[:, 1:][..., None], axis=-1
+            )[..., 0]
+            return nll.mean()
+
+        train_steps = 200 if quick else 3000
 
         @jax.jit
-        def train_step(p, o, ids, m):
-            g = jax.grad(loss_fn)(p, ids, m)
-            up, o = opt.update(g, o)
-            return optax.apply_updates(p, up), o
+        def train_all(p, o, ids):
+            def body(_, carry):
+                p, o = carry
+                g = jax.grad(loss_fn)(p, ids)
+                up, o = opt.update(g, o)
+                return optax.apply_updates(p, up), o
 
-        ostate = opt.init(dparams)
-        ids_d, mask_d = jnp.asarray(batch_ids), jnp.asarray(mask)
-        for _ in range(150):
-            dparams, ostate = train_step(dparams, ostate, ids_d, mask_d)
+            return jax.lax.fori_loop(0, train_steps, body, (p, o))
+
+        t0 = _time.perf_counter()
+        dparams, _ = jax.block_until_ready(
+            train_all(dparams, opt.init(dparams), jnp.asarray(train_ids))
+        )
+        distil_s = _time.perf_counter() - t0
 
         dm_toks, dm_dt, dm_stats = run_engine({
             "draft": "model", "draft_k": 4, "draft_params": dparams,
@@ -1240,9 +1266,14 @@ def generation_phase() -> dict:
         )
         result["paged_draft_tokens_per_s"] = round(spec_batch * spec_new / dm_dt, 1)
         result["spec_draft_chunks"] = dm_stats["chunks"] // 2
-        result["spec_draft_config"] = f"d{dc['d_model']} L2 distilled-150-steps"
+        result["spec_draft_config"] = (
+            f"d{dc['d_model']} L2 distilled {train_steps} steps on "
+            f"{n_train} held-out echo seqs ({round(distil_s, 1)}s)"
+        )
     except Exception as e:  # noqa: BLE001
         result["speculative_error"] = str(e)[:200]
+    finally:
+        jax.config.update("jax_default_matmul_precision", _prev_prec)
 
     # serving-scale continuous batching: the number the engine posts at
     # realistic stream counts (the micro-comparison above is 4x64 and
@@ -1254,9 +1285,8 @@ def generation_phase() -> dict:
     try:
         from seldon_core_tpu.models.paged import PagedEngine
 
-        quick = os.environ.get("BENCH_QUICK", "0") == "1" or MODEL == "resnet_tiny"
         serve_slots = 4 if quick else 16
-        serve_new = 16 if quick else 256
+        serve_new = 16 if quick else 384
         serve_cfg = dict(cfg)
         serve_cfg["max_len"] = min(cfg["max_len"], 1024)
         rng2 = np.random.default_rng(5)
@@ -1294,6 +1324,16 @@ def generation_phase() -> dict:
             result["paged_serving_tokens_per_s"]
             / max(result["decode_tokens_per_s"], 1e-9), 3
         )
+        # decode-only rate (engine wall inside chunk calls): what the
+        # decode path itself sustains, admission excluded — the number
+        # comparable to the contiguous scan lane's decode rate
+        chunk_wall = stats1["chunk_wall_s"] - stats0["chunk_wall_s"]
+        if chunk_wall > 0:
+            result["paged_chunk_tokens_per_s"] = round(total / chunk_wall, 1)
+            result["paged_chunk_vs_scan"] = round(
+                result["paged_chunk_tokens_per_s"]
+                / max(result["decode_tokens_per_s"], 1e-9), 3
+            )
     except Exception as e:  # noqa: BLE001
         result["paged_serving_error"] = str(e)[:200]
     return result

@@ -163,24 +163,84 @@ def get_paged_lm_class():
     return _MODULES[1]
 
 
-def write_kv(pk, pv, new_k, new_v, block_tables, start, valid, *, page_size, max_len):
-    """Scatter (layers, B, L, h, hd) K/V into a paged pool.
+def write_kv(pk, pv, new_k, new_v, block_tables, start, valid, *, page_size, max_len,
+             from_zero: bool = False):
+    """Write (layers, B, L, h, hd) K/V into a paged pool.
 
     ``start``: (B,) absolute position of each row's first token;
     invalid lanes are redirected to trash page 0.  Shared by the
     continuous-batching engine and the speculative decoder.
+
+    Lowering matters enormously on TPU: an arbitrary-index scatter
+    serialises (measured ~0.22 ms per index row at d512 — it dominated
+    both the decode chunk at 16 slots and the batched prefill at
+    16x128 tokens), while ``dynamic_update_slice`` stays in place on
+    scan carries and costs microseconds.  So every path here is DUS:
+
+    * **decode steps (seg_len == 1)** — one DUS per slot.
+    * **prefill (``from_zero=True``, static flag)** — writes always
+      begin at position 0, so each (row, page) pair is one CONTIGUOUS
+      page-block DUS; rows x pages unrolled statically.  Whole pages
+      are written (pad positions land in the row's own page or, for
+      rows without that page, in trash page 0 via the zero block-table
+      entry) — attention masks by length, and later tokens overwrite.
+    * **short segments (speculative verify)** — token-wise DUS,
+      seg_len x rows unrolled.
     """
+    import jax
     import jax.numpy as jnp
 
     seg_len = new_k.shape[2]
+    B = new_k.shape[1]
+    if seg_len == 1:
+        pos = jnp.minimum(start, max_len - 1)  # (B,)
+        page_idx = pos // page_size
+        offs = pos % page_size
+        for s in range(B):
+            page = jnp.where(
+                valid[s, 0], jnp.take(block_tables[s], page_idx[s]), 0
+            )
+            pk = jax.lax.dynamic_update_slice(
+                pk, new_k[:, s][:, None], (0, page, offs[s], 0, 0)
+            )
+            pv = jax.lax.dynamic_update_slice(
+                pv, new_v[:, s][:, None], (0, page, offs[s], 0, 0)
+            )
+        return pk, pv
+
+    if from_zero:
+        # rows x pages of contiguous block writes; pages a row never
+        # allocated hold 0 in its block table -> the block lands in the
+        # trash page, same redirection the scatter's valid-mask gave
+        for s in range(B):
+            for j in range(-(-seg_len // page_size)):
+                lo = j * page_size
+                blen = min(page_size, seg_len - lo)
+                page = block_tables[s, j]
+                pk = jax.lax.dynamic_update_slice(
+                    pk, new_k[:, s, lo : lo + blen][:, None], (0, page, 0, 0, 0)
+                )
+                pv = jax.lax.dynamic_update_slice(
+                    pv, new_v[:, s, lo : lo + blen][:, None], (0, page, 0, 0, 0)
+                )
+        return pk, pv
+
+    # short mid-sequence segments (draft_k+1 wide): token-wise DUS
     pos = start[:, None] + jnp.arange(seg_len)[None, :]  # (B, L)
     pos = jnp.minimum(pos, max_len - 1)
-    page_ids = jnp.take_along_axis(block_tables, pos // page_size, axis=1)  # (B, L)
-    page_ids = jnp.where(valid, page_ids, 0)
+    page_idx = pos // page_size
     offs = pos % page_size
-    # scatter: pool[layer, page_ids[b,l], offs[b,l]] = new[layer, b, l]
-    pk = pk.at[:, page_ids, offs].set(new_k)
-    pv = pv.at[:, page_ids, offs].set(new_v)
+    for s in range(B):
+        for t in range(seg_len):
+            page = jnp.where(
+                valid[s, t], jnp.take(block_tables[s], page_idx[s, t]), 0
+            )
+            pk = jax.lax.dynamic_update_slice(
+                pk, new_k[:, s, t][:, None, None], (0, page, offs[s, t], 0, 0)
+            )
+            pv = jax.lax.dynamic_update_slice(
+                pv, new_v[:, s, t][:, None, None], (0, page, offs[s, t], 0, 0)
+            )
     return pk, pv
 
 
@@ -343,7 +403,12 @@ class PagedEngine:
         # updated under _lock)
         self._counters = {"chunks": 0, "tokens": 0, "evictions": 0,
                           "stalls": 0, "prefills": 0, "completed": 0,
-                          "spec_drafted": 0, "spec_accepted": 0}
+                          "spec_drafted": 0, "spec_accepted": 0,
+                          # wall seconds inside device calls + readback,
+                          # split by phase: decode-rate observability
+                          # (tokens / chunk_wall_s) independent of
+                          # admission cost
+                          "chunk_wall_s": 0.0, "prefill_wall_s": 0.0}
 
         # speculative mode: per-slot draft/verify INSIDE the batched
         # engine — each chunk is ONE verify forward of width draft_k+1
@@ -401,6 +466,10 @@ class PagedEngine:
 
         self._prefill_jit: Dict[Tuple[int, int], Any] = {}  # (bucket, k)
         self._chunk_jit: Dict[int, Any] = {}  # steps -> compiled program
+        # one fixed-shape program deriving every slot's rng key data
+        self._derive_keys = jax.jit(
+            jax.vmap(lambda s: jax.random.key_data(jax.random.key(s)))
+        )
         self._spec_chunk = (
             jax.jit(self._spec_chunk_fn, donate_argnums=(1, 2))
             if self.speculative is not None else None
@@ -408,10 +477,11 @@ class PagedEngine:
 
     # ---- jitted programs --------------------------------------------------
 
-    def _write_kv(self, pk, pv, new_k, new_v, block_row_or_tables, start, valid):
+    def _write_kv(self, pk, pv, new_k, new_v, block_row_or_tables, start, valid,
+                  from_zero: bool = False):
         return write_kv(
             pk, pv, new_k, new_v, block_row_or_tables, start, valid,
-            page_size=self.page_size, max_len=self.max_len,
+            page_size=self.page_size, max_len=self.max_len, from_zero=from_zero,
         )
 
     def _materialize(self, params):
@@ -442,31 +512,75 @@ class PagedEngine:
             )
             valid = jnp.arange(bucket)[None, :] < true_lens[:, None]
             pk, pv = self._write_kv(
-                pk, pv, nk, nv, block_rows, jnp.zeros((k,), jnp.int32), valid
+                pk, pv, nk, nv, block_rows, jnp.zeros((k,), jnp.int32), valid,
+                from_zero=True,
             )
             last = logits[jnp.arange(k), true_lens - 1]  # (k, vocab)
             return last, pk, pv
 
         return jax.jit(prefill, donate_argnums=(1, 2))
 
-    def _sample(self, logits, key, temperature, top_k):
-        """Per-slot sampling — same semantics as Generator.sample."""
+    def _sample_batch(self, logits, keys, temps, top_ks):
+        """All-slot sampling — same per-slot semantics as
+        Generator.sample, restructured so the expensive branch is a
+        SCALAR-predicate ``lax.cond``.  A per-slot ``vmap(lax.cond)``
+        lowers to select — BOTH branches execute every step, so pure
+        greedy decode (the common serving case) was paying a full
+        (slots, vocab) sort + categorical per token; measured on TPU
+        this was the dominant per-step cost of the chunk program at 16
+        slots.  With the scalar cond, the sort runs only when some
+        live slot actually samples."""
         jax, jnp = self._jax, self._jnp
 
         greedy = jnp.argmax(logits, axis=-1)
 
-        def draw(_):
-            scaled = logits / jnp.maximum(temperature, 1e-6)
-            k = jnp.where(top_k > 0, top_k, logits.shape[-1])
+        def draw_slot(logits_i, key_i, temp_i, top_k_i):
+            scaled = logits_i / jnp.maximum(temp_i, 1e-6)
+            k = jnp.where(top_k_i > 0, top_k_i, logits_i.shape[-1])
             kth = -jnp.sort(-scaled)
             cutoff = kth[k - 1]
             masked = jnp.where(scaled >= cutoff, scaled, -jnp.inf)
-            return jax.random.categorical(key, masked)
+            return jax.random.categorical(key_i, masked)
 
-        return jax.lax.cond(temperature > 0, draw, lambda _: greedy, None)
+        def draw_all(_):
+            sampled = jax.vmap(draw_slot)(logits, keys, temps, top_ks)
+            return jnp.where(temps > 0, sampled, greedy)
+
+        return jax.lax.cond(
+            jnp.any(temps > 0), draw_all, lambda _: greedy, None
+        )
+
+    def _pages_horizon(self, runnable: List[_Stream], per_chunk: int) -> int:
+        """Block-table columns the next chunk actually needs.
+
+        The paged attention GATHERS every table column it is given each
+        step, so passing the full worst-case table makes short streams
+        pay max_len-sized HBM traffic (measured: the dominant cost of
+        the chunk program at 16 slots).  Slice to the live horizon —
+        the largest runnable stream's length plus this chunk — rounded
+        up to a power of two so jit sees a log-bounded set of shapes
+        (each is its own compiled program; a warm pass over a stream's
+        growth covers them).  Lanes masked done may hold longer
+        contexts than the slice; their compute is discarded (writes go
+        to the trash page, sampled tokens are overwritten), so the
+        truncated gather they see is harmless."""
+        if not runnable:
+            return 1
+        need = max(int(self._lengths[s.slot]) for s in runnable) + per_chunk
+        return self._pages_pow2(-(-need // self.page_size))
+
+    def _pages_pow2(self, need_pages: int) -> int:
+        """Round a page count up to a power of two, capped at the
+        per-stream table width — the one shared rounding rule, so
+        prefill and decode always land on the same compiled shapes."""
+        p = 1
+        while p < need_pages:
+            p *= 2
+        return min(p, self.pages_per_stream)
 
     def _get_chunk(self, steps: int):
-        """Compiled decode program for one ladder size (lazy, cached)."""
+        """Compiled decode program for one ladder size (lazy, cached);
+        jit specialises per sliced block-table width on top."""
         fn = self._chunk_jit.get(steps)
         if fn is None:
             from functools import partial
@@ -493,7 +607,7 @@ class PagedEngine:
             typed = jax.random.wrap_key_data(keys)
             split = jax.vmap(jax.random.split)(typed)
             step_keys = split[:, 1]
-            token = jax.vmap(self._sample)(logits, step_keys, temps, top_ks)
+            token = self._sample_batch(logits, step_keys, temps, top_ks)
             active = ~done
             # inactive lanes (finished OR stalled on pool pressure) must
             # keep their carries intact: a stalled stream resumes from
@@ -705,6 +819,9 @@ class PagedEngine:
         """Prefill admitted streams, batching same-bucket prompts into
         one device call each (k padded to the next power of two so the
         compile count stays logarithmic)."""
+        import time as _time
+
+        t_start = _time.perf_counter()
         jnp = self._jnp
         by_bucket: Dict[int, List[_Stream]] = {}
         for stream in streams:
@@ -718,38 +835,51 @@ class PagedEngine:
             key = (bucket, k)
             if key not in self._prefill_jit:
                 self._prefill_jit[key] = self._build_prefill(bucket, k)
+            # slice block rows to the bucket's page span: prefill reads
+            # no cache (lengths 0) and writes at most `bucket` tokens,
+            # so gathering the full worst-case table would be pure
+            # wasted HBM traffic (same reasoning as _pages_horizon)
+            pages_h = self._pages_pow2(-(-bucket // self.page_size))
             padded = np.zeros((k, bucket), np.int32)
             true_lens = np.ones((k,), np.int32)  # pad rows: 1 token -> trash
-            block_rows = np.zeros((k, self.pages_per_stream), np.int32)
+            block_rows = np.zeros((k, pages_h), np.int32)
             for i, stream in enumerate(group):
                 plen = len(stream.prompt)
                 padded[i, :plen] = stream.prompt
                 true_lens[i] = plen
-                block_rows[i] = self._block_tables[stream.slot]
+                block_rows[i] = self._block_tables[stream.slot, :pages_h]
             last, self.pages_k, self.pages_v = self._prefill_jit[key](
                 self.params, self.pages_k, self.pages_v,
                 jnp.asarray(padded), jnp.asarray(true_lens),
                 jnp.asarray(block_rows),
             )
             g = len(group)
+            # batched tail: per-stream .at[].set / key() calls are tiny
+            # device dispatches, and ~3 per stream serialised through a
+            # relayed dispatch stream measured as a large share of
+            # admission wall time at 16 joiners.  Three dispatches total
+            # instead: one fixed-shape key derivation, two scatters.
+            slots = jnp.asarray(np.array([s.slot for s in group], np.int32))
+            # deterministic per submit(seed=...): same seed -> same
+            # sample path (per-request variation is the component
+            # layer's job, as in GenerativeLM's puid/counter folding).
+            # Seeds fold into [0, 2^63) — same key for any practical
+            # seed (component layers derive seeds well below 2^63)
+            seeds = np.zeros((self.max_slots,), np.uint64)
             for i, stream in enumerate(group):
-                # async dispatches (cached scalar-index programs), no
-                # readback — the per-stream cost batching must avoid is
-                # blocking round-trips, not launches
-                self._logits = self._logits.at[stream.slot].set(last[i])
-                # deterministic per submit(seed=...): same seed -> same
-                # sample path (per-request variation is the component
-                # layer's job, as in GenerativeLM's puid/counter folding)
-                key_data = self._jax.random.key_data(
-                    self._jax.random.key(stream.seed)
-                )
-                self._keys = self._keys.at[stream.slot].set(key_data)
+                seeds[i] = stream.seed % (1 << 63)
+            all_keys = self._derive_keys(jnp.asarray(seeds))
+            self._keys = self._keys.at[slots].set(all_keys[:g])
+            self._logits = self._logits.at[slots].set(last[:g])
             if self.speculative is not None:
                 # host decides the next greedy token between verify
                 # rounds — ONE blocking readback for the whole group
                 pending = np.asarray(jnp.argmax(last[:g], axis=-1))
                 for i, stream in enumerate(group):
                     stream.pending = int(pending[i])
+        if streams:
+            with self._lock:
+                self._counters["prefill_wall_s"] += _time.perf_counter() - t_start
 
     def _ensure_pages_locked(self, stream: _Stream, per_chunk: Optional[int] = None) -> bool:
         """Grow the stream's block table to cover the next chunk."""
@@ -981,10 +1111,16 @@ class PagedEngine:
                 temps[s] = stream.temperature
                 top_ks[s] = stream.top_k
                 eos_ids[s] = stream.eos_id
-            tables = jnp.asarray(self._block_tables)
+            pages_h = self._pages_horizon(
+                [s for s in active if not stalled[s.slot]], steps
+            )
+            tables = jnp.asarray(self._block_tables[:, :pages_h])
             lengths = jnp.asarray(self._lengths)
             emitted0 = jnp.zeros((self.max_slots,), jnp.int32)
 
+        import time as _time
+
+        t_chunk = _time.perf_counter()
         toks, self.pages_k, self.pages_v, self._logits, lengths_out, self._keys, _, emitted = (
             self._get_chunk(steps)(
                 self.params, self.pages_k, self.pages_v, self._logits,
@@ -996,9 +1132,11 @@ class PagedEngine:
         toks_np = np.asarray(toks)
         emitted_np = np.asarray(emitted)
         self._lengths = np.array(lengths_out)  # copy: jax views are read-only
+        chunk_wall = _time.perf_counter() - t_chunk
 
         with self._lock:
             self._counters["chunks"] += 1
+            self._counters["chunk_wall_s"] += chunk_wall
             for stream in active:
                 s = stream.slot
                 if stalled[s]:
@@ -1117,7 +1255,8 @@ class PagedEngine:
                 n_drafts[slot] = len(drafted)
                 active_mask[slot] = True
                 self._counters["spec_drafted"] += len(drafted)
-            tables = jnp.asarray(self._block_tables)
+            pages_h = self._pages_horizon(runnable, self.draft_k + 1)
+            tables = jnp.asarray(self._block_tables[:, :pages_h])
             lengths = jnp.asarray(self._lengths)
 
         if not runnable:

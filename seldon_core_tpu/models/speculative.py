@@ -169,7 +169,7 @@ class SpeculativeGenerator:
                 min_weight_size=shard_min_weight_size, quantize=quantize,
             )
 
-        self._forward_jit: Dict[Tuple[int, int], Any] = {}
+        self._forward_jit: Dict[Tuple[int, int, bool], Any] = {}
 
     # ---- compiled pieces --------------------------------------------------
 
@@ -178,7 +178,12 @@ class SpeculativeGenerator:
         positions start..start+L-1; returns greedy ids (L,) and advances
         nothing (caller owns state.length)."""
         jax, jnp = self._jax, self._jnp
-        key = (id(state.module), tokens.shape[1])
+        # start==0 is the prompt prefill: write whole page blocks (one
+        # DUS per page) instead of unrolling one DUS per token — the
+        # token-wise branch would trace 2L sequential updates for an
+        # L-token prompt.  Static per-program flag, so it joins the key.
+        from_zero = start == 0
+        key = (id(state.module), tokens.shape[1], from_zero)
         if key not in self._forward_jit:
 
             def run(params, pk, pv, toks, start, table):
@@ -195,6 +200,7 @@ class SpeculativeGenerator:
                     pk, pv, nk, nv, table, jnp.full((1,), start, jnp.int32),
                     jnp.ones_like(toks, bool),
                     page_size=state.page_size, max_len=state.max_len,
+                    from_zero=from_zero,
                 )
                 return jnp.argmax(logits[0], axis=-1), pk, pv
 
