@@ -1,0 +1,255 @@
+# seldon-tpu R microservice wrapper.
+#
+# Serves a user component (an R source file defining a constructor
+# function) on the graph-node REST contract of the Python runtime
+# (seldon_core_tpu/runtime/rest.py:6-8):
+#
+#   POST /predict /transform-input /transform-output
+#        /route   /aggregate       /send-feedback
+#   GET  /health/ping /health/status /metrics
+#
+# Reference analogue: wrappers/s2i/R/microservice.R:1-333 —
+# re-designed for this framework: base-R httpuv-free option via
+# plumber, components are closures returning a named list of
+# functions (idiomatic R, no S4/R6 dependency), the same typed
+# {name,value,type} parameter contract as the Python and Node CLIs,
+# and the same FAILURE status envelope on errors.
+#
+# Usage:
+#   Rscript microservice.R MyModel.R --service-type MODEL --http-port 9000 \
+#       --parameters '[{"name":"k","value":"3","type":"INT"}]'
+#
+# The user file must define `new_component(parameters)` returning a
+# named list with any of: predict(rows, names, meta), route(rows,
+# names), aggregate(rows_list, names_list), transform_input /
+# transform_output, send_feedback(rows, names, reward, truth,
+# routing), tags(), metrics(), class_names().
+
+suppressMessages({
+  library(jsonlite)
+  library(plumber)
+})
+
+# ---- typed parameters (contract twin: runtime/params.py) -------------------
+
+parse_parameters <- function(raw) {
+  if (is.null(raw) || !nzchar(raw)) return(list())
+  specs <- fromJSON(raw, simplifyDataFrame = FALSE)
+  out <- list()
+  for (p in specs) {
+    v <- p$value
+    out[[p$name]] <- switch(
+      ifelse(is.null(p$type), "STRING", p$type),
+      STRING = as.character(v),
+      INT = as.integer(v),
+      FLOAT = as.numeric(v),
+      DOUBLE = as.numeric(v),
+      BOOL = identical(v, "true") || isTRUE(v),
+      JSON = fromJSON(v, simplifyDataFrame = FALSE),
+      stop(sprintf("unknown parameter type %s", p$type))
+    )
+  }
+  out
+}
+
+# ---- codecs (contract twin: runtime/message.py) ----------------------------
+
+decode_data <- function(data) {
+  if (is.null(data)) return(list(rows = list(), names = list(), kind = "ndarray"))
+  nm <- if (is.null(data$names)) list() else data$names
+  if (!is.null(data$tensor)) {
+    shape <- as.integer(unlist(data$tensor$shape))
+    values <- unlist(data$tensor$values)
+    if (length(values) != prod(shape))
+      stop(sprintf("tensor values/shape mismatch: %d vs %s",
+                   length(values), paste(shape, collapse = "x")))
+    # arbitrary rank (row-major wire order -> R's column-major array);
+    # components see rank-2 as a matrix, higher ranks as an array
+    rows <- if (length(shape) <= 2L) {
+      matrix(values, nrow = shape[1], byrow = TRUE)
+    } else {
+      aperm(array(values, dim = rev(shape)), rev(seq_along(shape)))
+    }
+    return(list(rows = rows, names = nm, kind = "tensor", shape = shape))
+  }
+  rows <- data$ndarray
+  if (is.list(rows)) rows <- do.call(rbind, lapply(rows, unlist))
+  list(rows = rows, names = nm, kind = "ndarray")
+}
+
+encode_data <- function(rows, names, kind) {
+  if (identical(kind, "tensor")) {
+    if (is.array(rows) && length(dim(rows)) > 2L) {
+      shape <- dim(rows)
+      # column-major array -> row-major wire order
+      values <- as.vector(aperm(rows, rev(seq_along(shape))))
+      return(list(names = names, tensor = list(shape = shape, values = values)))
+    }
+    m <- as.matrix(rows)
+    list(names = names,
+         tensor = list(shape = dim(m), values = as.vector(t(m))))
+  } else {
+    m <- as.matrix(rows)
+    list(names = names, ndarray = unname(apply(m, 1, as.list, simplify = FALSE)))
+  }
+}
+
+default_names <- function(rows) {
+  m <- as.matrix(rows)
+  if (ncol(m) == 0) return(list())
+  as.list(sprintf("t:%d", seq_len(ncol(m)) - 1))
+}
+
+# ---- dispatch (contract twin: runtime/dispatch.py) -------------------------
+
+build_meta <- function(component, request_meta) {
+  meta <- list()
+  if (!is.null(request_meta$puid)) meta$puid <- request_meta$puid
+  if (is.function(component$tags)) {
+    tg <- component$tags()
+    if (length(tg)) meta$tags <- tg
+  }
+  if (is.function(component$metrics)) {
+    ms <- component$metrics()
+    for (m in ms) {
+      if (is.null(m$key) || !(m$type %in% c("COUNTER", "GAUGE", "TIMER")))
+        stop("invalid metric entry")
+    }
+    if (length(ms)) meta$metrics <- ms
+  }
+  meta
+}
+
+failure_body <- function(code, reason, info) {
+  list(status = list(status = "FAILURE", code = code,
+                     reason = reason, info = info))
+}
+
+run_message <- function(component, method, message) {
+  d <- decode_data(message$data)
+  meta <- if (is.null(message$meta)) list() else message$meta
+  if (identical(method, "route")) {
+    branch <- if (is.function(component$route)) component$route(d$rows, d$names) else -1
+    # contract twin runtime/dispatch.py: a branch must be a whole number
+    if (!is.numeric(branch) || length(branch) != 1L || branch != as.integer(branch))
+      stop("INVALID_ROUTING: route() must return a single integer branch")
+    return(list(data = list(ndarray = list(list(as.integer(branch)))),
+                meta = build_meta(component, meta)))
+  }
+  fn <- component[[method]]
+  if (identical(method, "transform_input") && !is.function(fn)) fn <- component$predict
+  out <- if (is.function(fn)) fn(d$rows, d$names, meta) else d$rows
+  if (identical(method, "predict") && !is.function(component$predict))
+    stop("component has no predict()")
+  cn <- if (is.function(component$class_names)) component$class_names() else default_names(out)
+  list(data = encode_data(out, cn, d$kind), meta = build_meta(component, meta))
+}
+
+run_feedback <- function(component, fb) {
+  req <- decode_data(fb$request$data)
+  truth <- decode_data(fb$truth$data)
+  routing <- fb$response$meta$routing
+  if (is.function(component$send_feedback)) {
+    component$send_feedback(req$rows, req$names,
+                            ifelse(is.null(fb$reward), 0, fb$reward),
+                            truth$rows, routing)
+  }
+  list(meta = build_meta(component, list()))
+}
+
+run_aggregate <- function(component, req) {
+  msgs <- req$seldonMessages
+  if (is.null(msgs) || length(msgs) == 0L)
+    stop("EMPTY_AGGREGATE: aggregate needs at least one seldonMessage")
+  decoded <- lapply(msgs, function(m) decode_data(m$data))
+  rows <- component$aggregate(lapply(decoded, `[[`, "rows"),
+                              lapply(decoded, `[[`, "names"))
+  kind <- if (length(decoded)) decoded[[1]]$kind else "ndarray"
+  cn <- if (is.function(component$class_names)) component$class_names() else default_names(rows)
+  list(data = encode_data(rows, cn, kind),
+       meta = build_meta(component, list()))
+}
+
+# ---- server ----------------------------------------------------------------
+
+make_router <- function(component, service_type = "MODEL") {
+  counters <- new.env()
+  counters$requests <- 0L
+  counters$failures <- 0L
+  started <- Sys.time()
+
+  handle <- function(fn) {
+    function(req, res) {
+      counters$requests <- counters$requests + 1L
+      body <- tryCatch(fromJSON(req$postBody, simplifyDataFrame = FALSE),
+                       error = function(e) NULL)
+      if (is.null(body)) {
+        counters$failures <- counters$failures + 1L
+        res$status <- 400L
+        return(failure_body(400L, "BAD_REQUEST", "invalid JSON body"))
+      }
+      tryCatch(fn(body), error = function(e) {
+        counters$failures <- counters$failures + 1L
+        res$status <- 500L
+        failure_body(500L, "MICROSERVICE_INTERNAL_ERROR", conditionMessage(e))
+      })
+    }
+  }
+
+  # unboxed JSON everywhere: the wire contract carries scalars as
+  # scalars (status.code an int, meta.puid a string) — plumber's
+  # default serializer would box every scalar into a 1-element array
+  pr() |>
+    pr_set_serializer(serializer_unboxedJSON()) |>
+    pr_post("/predict", handle(function(b) run_message(component, "predict", b))) |>
+    pr_post("/api/v0.1/predictions", handle(function(b) run_message(component, "predict", b))) |>
+    pr_post("/transform-input", handle(function(b) run_message(component, "transform_input", b))) |>
+    pr_post("/transform-output", handle(function(b) run_message(component, "transform_output", b))) |>
+    pr_post("/route", handle(function(b) run_message(component, "route", b))) |>
+    pr_post("/aggregate", handle(function(b) run_aggregate(component, b))) |>
+    pr_post("/send-feedback", handle(function(b) run_feedback(component, b))) |>
+    pr_get("/health/ping", function() "pong", serializer = serializer_text()) |>
+    pr_get("/health/status", function() {
+      if (is.function(component$health_status)) component$health_status()
+      else list(data = list(names = list(), ndarray = list()), meta = list())
+    }) |>
+    pr_get("/metrics", function(res) {
+      up <- as.numeric(difftime(Sys.time(), started, units = "secs"))
+      paste0(
+        "# TYPE seldon_api_wrapper_requests_total counter\n",
+        sprintf("seldon_api_wrapper_requests_total{service_type=\"%s\"} %d\n",
+                service_type, counters$requests),
+        "# TYPE seldon_api_wrapper_failures_total counter\n",
+        sprintf("seldon_api_wrapper_failures_total{service_type=\"%s\"} %d\n",
+                service_type, counters$failures),
+        "# TYPE seldon_api_wrapper_uptime_seconds gauge\n",
+        sprintf("seldon_api_wrapper_uptime_seconds %f\n", up)
+      )
+    }, serializer = serializer_text())
+}
+
+main <- function() {
+  argv <- commandArgs(trailingOnly = TRUE)
+  component_file <- NULL
+  service_type <- "MODEL"
+  # env gives the default (operator-injected); an explicit CLI flag wins
+  port <- as.integer(Sys.getenv("PREDICTIVE_UNIT_SERVICE_PORT", "9000"))
+  params_raw <- Sys.getenv("PREDICTIVE_UNIT_PARAMETERS", "")
+  i <- 1L
+  while (i <= length(argv)) {
+    a <- argv[[i]]
+    if (identical(a, "--service-type")) { service_type <- argv[[i + 1L]]; i <- i + 2L }
+    else if (identical(a, "--http-port")) { port <- as.integer(argv[[i + 1L]]); i <- i + 2L }
+    else if (identical(a, "--parameters")) { params_raw <- argv[[i + 1L]]; i <- i + 2L }
+    else { component_file <- a; i <- i + 1L }
+  }
+  if (is.null(component_file)) stop("usage: Rscript microservice.R <Component.R> [--service-type T] [--http-port P]")
+  env <- new.env()
+  sys.source(component_file, envir = env)
+  if (!is.function(env$new_component)) stop("component file must define new_component(parameters)")
+  component <- env$new_component(parse_parameters(params_raw))
+  message(sprintf("seldon-tpu R microservice (%s) on :%d", service_type, port))
+  pr_run(make_router(component, service_type), host = "0.0.0.0", port = port)
+}
+
+if (sys.nframe() == 0L) main()

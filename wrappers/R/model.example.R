@@ -1,0 +1,25 @@
+# Example component for the seldon-tpu R wrapper: mean-centred scorer
+# with tags and metrics.  Components are closures returning a named
+# list of functions (duck-typed like the Python SeldonComponent,
+# reference python/seldon_core/user_model.py:20-104).
+
+new_component <- function(parameters) {
+  bias <- if (is.null(parameters$bias)) 0 else parameters$bias
+  calls <- 0L
+
+  predict <- function(rows, names, meta) {
+    calls <<- calls + 1L
+    m <- as.matrix(rows)
+    means <- rowMeans(m) + bias
+    cbind(means, -means)
+  }
+
+  list(
+    predict = predict,
+    class_names = function() list("score", "anti_score"),
+    tags = function() list(wrapper = "R"),
+    metrics = function() list(
+      list(type = "COUNTER", key = "example_calls_total", value = calls)
+    )
+  )
+}

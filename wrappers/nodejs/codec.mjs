@@ -1,0 +1,86 @@
+// Tensor codecs for the SeldonMessage JSON wire form.
+//
+// Mirrors the payload matrix the Python runtime serves
+// (seldon_core_tpu/runtime/message.py); reference analogue:
+// wrappers/s2i/nodejs/microservice.js:18-46 (rest_data_to_array /
+// array_to_rest_data).  Re-designed: no numjs — plain nested arrays
+// with explicit shape handling, so the wrapper has zero npm
+// dependencies.
+
+/** Flatten a nested array; returns [flatValues, shape]. */
+export function flatten(nested) {
+  const shape = [];
+  let probe = nested;
+  while (Array.isArray(probe)) {
+    shape.push(probe.length);
+    probe = probe[0];
+  }
+  const flat = [];
+  const walk = (a, depth) => {
+    if (depth === shape.length) {
+      flat.push(a);
+      return;
+    }
+    if (!Array.isArray(a) || a.length !== shape[depth]) {
+      throw new Error("ragged ndarray payload");
+    }
+    for (const el of a) walk(el, depth + 1);
+  };
+  walk(nested, 0);
+  return [flat, shape];
+}
+
+/** Rebuild a nested array from flat values + shape. */
+export function unflatten(values, shape) {
+  if (shape.length === 0) return values[0];
+  const total = shape.reduce((a, b) => a * b, 1);
+  if (values.length !== total) {
+    throw new Error(`tensor values/shape mismatch: ${values.length} vs ${shape}`);
+  }
+  let out = values.slice();
+  for (let d = shape.length - 1; d > 0; d--) {
+    const size = shape[d];
+    const next = [];
+    for (let i = 0; i < out.length; i += size) next.push(out.slice(i, i + size));
+    out = next;
+  }
+  return out;
+}
+
+/**
+ * Decode the `data` oneof of a SeldonMessage into {rows, names, kind}.
+ * kind remembers the encoding so responses round-trip in the caller's
+ * dialect (tensor stays tensor, ndarray stays ndarray).
+ */
+export function decodeData(data) {
+  if (data == null) return { rows: [], names: [], kind: "ndarray" };
+  const names = data.names || [];
+  if (data.tensor) {
+    return {
+      rows: unflatten(data.tensor.values, data.tensor.shape),
+      names,
+      kind: "tensor",
+    };
+  }
+  if (data.ndarray !== undefined) {
+    return { rows: data.ndarray, names, kind: "ndarray" };
+  }
+  return { rows: [], names, kind: "ndarray" };
+}
+
+/** Encode rows back into the requested dialect with class names. */
+export function encodeData(rows, names, kind) {
+  if (kind === "tensor") {
+    const [values, shape] = flatten(rows);
+    return { names, tensor: { shape, values } };
+  }
+  return { names, ndarray: rows };
+}
+
+/** Default class names: t:0 .. t:n-1 (reference naming scheme). */
+export function defaultNames(rows) {
+  const width = Array.isArray(rows) && Array.isArray(rows[0]) ? rows[0].length : 0;
+  const out = [];
+  for (let i = 0; i < width; i++) out.push(`t:${i}`);
+  return out;
+}

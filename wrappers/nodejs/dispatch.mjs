@@ -1,0 +1,128 @@
+// Role dispatch for a graph-node microservice.
+//
+// Same dispatch algebra as the Python runtime
+// (seldon_core_tpu/runtime/dispatch.py), which itself mirrors the
+// reference's seldon_methods.py:28-344: try the component's
+// raw (message-level) override first, fall back to the array-level
+// method, then construct the response with class names, tags and
+// metrics merged into meta.
+
+import { decodeData, encodeData, defaultNames } from "./codec.mjs";
+
+// aggregate / send_feedback have their own entry points below (their
+// raw overrides are checked there) — runMessage never sees them
+const RAW = {
+  predict: "predict_raw",
+  transform_input: "transform_input_raw",
+  transform_output: "transform_output_raw",
+  route: "route_raw",
+};
+
+function callIf(model, name, ...args) {
+  return typeof model[name] === "function" ? model[name](...args) : undefined;
+}
+
+function buildMeta(model, requestMeta) {
+  const meta = {};
+  const puid = requestMeta && requestMeta.puid;
+  if (puid) meta.puid = puid;
+  const tags = callIf(model, "tags");
+  if (tags && Object.keys(tags).length) meta.tags = tags;
+  const metrics = callIf(model, "metrics");
+  if (Array.isArray(metrics) && metrics.length) {
+    for (const m of metrics) {
+      if (!m.key || !["COUNTER", "GAUGE", "TIMER"].includes(m.type)) {
+        throw Object.assign(new Error(`invalid metric: ${JSON.stringify(m)}`), {
+          status: 500,
+          reason: "MICROSERVICE_INTERNAL_ERROR",
+        });
+      }
+    }
+    meta.metrics = metrics;
+  }
+  return meta;
+}
+
+export async function runMessage(model, method, message) {
+  const raw = RAW[method];
+  if (typeof model[raw] === "function") {
+    return await model[raw](message);
+  }
+  const { rows, names, kind } = decodeData(message.data);
+  const meta = message.meta || {};
+
+  if (method === "route") {
+    const branch = typeof model.route === "function" ? await model.route(rows, names) : -1;
+    // contract twin runtime/dispatch.py: a branch must be an integer
+    if (!Number.isInteger(branch)) {
+      throw Object.assign(new Error(`route() must return an integer branch, got ${JSON.stringify(branch)}`), {
+        status: 500,
+        reason: "INVALID_ROUTING",
+      });
+    }
+    return { data: { ndarray: [[branch]] }, meta: buildMeta(model, meta) };
+  }
+
+  const fn =
+    method === "transform_input" && typeof model.transform_input !== "function"
+      ? "predict" // MODEL used as input transformer passes through predict
+      : method === "transform_output" && typeof model.transform_output !== "function"
+        ? null // identity
+        : method;
+  let out = rows;
+  if (fn && typeof model[fn] === "function") {
+    out = await model[fn](rows, names, meta);
+  } else if (method === "predict") {
+    throw Object.assign(new Error("component has no predict()"), {
+      status: 500,
+      reason: "MICROSERVICE_INTERNAL_ERROR",
+    });
+  }
+  const classNames = callIf(model, "class_names") || defaultNames(out);
+  return {
+    data: encodeData(out, classNames, kind),
+    meta: buildMeta(model, meta),
+  };
+}
+
+export async function runAggregate(model, request) {
+  if (typeof model.aggregate_raw === "function") {
+    return await model.aggregate_raw(request);
+  }
+  const msgs = request.seldonMessages || [];
+  if (!msgs.length) {
+    throw Object.assign(new Error("aggregate needs at least one seldonMessage"), {
+      status: 400,
+      reason: "EMPTY_AGGREGATE",
+    });
+  }
+  const decoded = msgs.map((m) => decodeData(m.data));
+  const rows = await model.aggregate(
+    decoded.map((d) => d.rows),
+    decoded.map((d) => d.names),
+  );
+  const kind = decoded.length ? decoded[0].kind : "ndarray";
+  const classNames = callIf(model, "class_names") || defaultNames(rows);
+  return {
+    data: encodeData(rows, classNames, kind),
+    meta: buildMeta(model, (msgs[0] || {}).meta),
+  };
+}
+
+export async function runFeedback(model, feedback) {
+  if (typeof model.send_feedback_raw === "function") {
+    return await model.send_feedback_raw(feedback);
+  }
+  const req = decodeData((feedback.request || {}).data);
+  const truth = decodeData((feedback.truth || {}).data);
+  const routing = ((feedback.response || {}).meta || {}).routing || {};
+  if (typeof model.send_feedback === "function") {
+    await model.send_feedback(req.rows, req.names, feedback.reward || 0, truth.rows, routing);
+  }
+  return { meta: buildMeta(model, {}) };
+}
+
+export function healthStatus(model) {
+  const custom = callIf(model, "health_status");
+  return custom || { data: { names: [], ndarray: [] }, meta: {} };
+}

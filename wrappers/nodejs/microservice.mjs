@@ -1,0 +1,190 @@
+#!/usr/bin/env node
+// seldon-tpu Node.js microservice wrapper.
+//
+// Serves a user component (an ES module exporting a class) on the
+// same REST contract as the Python runtime
+// (seldon_core_tpu/runtime/rest.py:6-8):
+//
+//   POST /predict /transform-input /transform-output
+//        /route   /aggregate       /send-feedback
+//   GET  /health/ping /health/status /metrics
+//   plus the engine-compatible alias /api/v0.1/predictions
+//
+// Reference analogue: wrappers/s2i/nodejs/microservice.js:1-147 —
+// re-designed for this framework: zero npm dependencies (node:http
+// only), one dispatch layer shared by every role, typed parameters
+// with the same {name,value,type} contract as the Python CLI, and
+// graceful drain on SIGTERM.  gRPC termination for Node components is
+// delegated to the native ingress (native/frontserver.cc) fronting
+// this HTTP lane, the same pattern the C++ remote node uses
+// (native/remote_node.cc) — protocol neutrality is the point, not a
+// per-language gRPC stack.
+//
+// Usage:
+//   node microservice.mjs ./MyModel.mjs --service-type MODEL \
+//        --http-port 9000 --parameters '[{"name":"k","value":"3","type":"INT"}]'
+
+import http from "node:http";
+import process from "node:process";
+import { pathToFileURL } from "node:url";
+import { runMessage, runAggregate, runFeedback, healthStatus } from "./dispatch.mjs";
+
+const TYPES = { STRING: String, INT: (v) => parseInt(v, 10), FLOAT: parseFloat, DOUBLE: parseFloat, BOOL: (v) => v === "true" || v === true, JSON: (v) => (typeof v === "string" ? JSON.parse(v) : v) };
+
+export function parseParameters(raw) {
+  // [{name, value, type}] -> kwargs object (reference contract:
+  // PREDICTIVE_UNIT_PARAMETERS; python twin runtime/params.py)
+  const out = {};
+  for (const p of typeof raw === "string" ? JSON.parse(raw) : raw || []) {
+    const cast = TYPES[p.type || "STRING"];
+    if (!cast) throw new Error(`unknown parameter type ${p.type}`);
+    out[p.name] = cast(p.value);
+  }
+  return out;
+}
+
+export function parseArgs(argv) {
+  // env gives defaults (operator-injected); explicit CLI flags win
+  const args = {
+    api: "REST",
+    serviceType: "MODEL",
+    httpPort: parseInt(process.env.PREDICTIVE_UNIT_SERVICE_PORT || "9000", 10),
+    host: "0.0.0.0",
+    parameters: process.env.PREDICTIVE_UNIT_PARAMETERS
+      ? parseParameters(process.env.PREDICTIVE_UNIT_PARAMETERS)
+      : {},
+  };
+  const positional = [];
+  for (let i = 0; i < argv.length; i++) {
+    const a = argv[i];
+    if (a === "--api") args.api = argv[++i];
+    else if (a === "--service-type") args.serviceType = argv[++i];
+    else if (a === "--http-port") args.httpPort = parseInt(argv[++i], 10);
+    else if (a === "--host") args.host = argv[++i];
+    else if (a === "--parameters") args.parameters = parseParameters(argv[++i]);
+    else positional.push(a);
+  }
+  args.component = positional[0];
+  return args;
+}
+
+function errorBody(err) {
+  return {
+    status: {
+      status: "FAILURE",
+      code: err.status || 500,
+      reason: err.reason || "MICROSERVICE_INTERNAL_ERROR",
+      info: String(err.message || err),
+    },
+  };
+}
+
+async function readMessage(req) {
+  const chunks = [];
+  for await (const c of req) chunks.push(c);
+  const text = Buffer.concat(chunks).toString("utf-8");
+  if (!text) {
+    const u = new URL(req.url, "http://x");
+    const q = u.searchParams.get("json");
+    if (q) return JSON.parse(q);
+    throw Object.assign(new Error("empty request body"), { status: 400, reason: "BAD_REQUEST" });
+  }
+  try {
+    if (req.headers["content-type"] && req.headers["content-type"].includes("form-urlencoded")) {
+      const q = new URLSearchParams(text).get("json");
+      if (q) return JSON.parse(q);
+    }
+    return JSON.parse(text);
+  } catch (e) {
+    throw Object.assign(new Error(`invalid JSON: ${e.message}`), { status: 400, reason: "BAD_REQUEST" });
+  }
+}
+
+export function makeServer(model, { serviceType = "MODEL" } = {}) {
+  let requestsTotal = 0;
+  let failuresTotal = 0;
+  const started = Date.now();
+
+  const routes = {
+    "/predict": (m) => runMessage(model, "predict", m),
+    "/api/v0.1/predictions": (m) => runMessage(model, "predict", m),
+    "/transform-input": (m) => runMessage(model, "transform_input", m),
+    "/transform-output": (m) => runMessage(model, "transform_output", m),
+    "/route": (m) => runMessage(model, "route", m),
+    "/aggregate": (m) => runAggregate(model, m),
+    "/send-feedback": (m) => runFeedback(model, m),
+  };
+
+  return http.createServer(async (req, res) => {
+    const path = new URL(req.url, "http://x").pathname;
+    const send = (code, body, type = "application/json") => {
+      res.writeHead(code, { "Content-Type": type });
+      res.end(type === "application/json" ? JSON.stringify(body) : body);
+    };
+    try {
+      if (path === "/health/ping") return send(200, "pong", "text/plain");
+      if (path === "/health/status") return send(200, healthStatus(model));
+      if (path === "/metrics") {
+        // prometheus text format, reference metric naming
+        // (utils/metrics.py; doc/source/analytics/analytics.md:9-16)
+        const up = (Date.now() - started) / 1000;
+        return send(
+          200,
+          `# TYPE seldon_api_wrapper_requests_total counter\n` +
+            `seldon_api_wrapper_requests_total{service_type="${serviceType}"} ${requestsTotal}\n` +
+            `# TYPE seldon_api_wrapper_failures_total counter\n` +
+            `seldon_api_wrapper_failures_total{service_type="${serviceType}"} ${failuresTotal}\n` +
+            `# TYPE seldon_api_wrapper_uptime_seconds gauge\n` +
+            `seldon_api_wrapper_uptime_seconds ${up}\n`,
+          "text/plain",
+        );
+      }
+      const handler = routes[path];
+      if (!handler) return send(404, errorBody(Object.assign(new Error(`no route ${path}`), { status: 404, reason: "NOT_FOUND" })));
+      requestsTotal += 1;
+      const message = await readMessage(req);
+      const out = await handler(message);
+      return send(200, out);
+    } catch (err) {
+      failuresTotal += 1;
+      return send(err.status || 500, errorBody(err));
+    }
+  });
+}
+
+export async function loadComponent(path, parameters) {
+  const mod = await import(pathToFileURL(path).href);
+  const Cls = mod.default;
+  if (typeof Cls !== "function") throw new Error(`${path} must default-export a class`);
+  const model = new Cls(parameters);
+  if (typeof model.init === "function") await model.init();
+  return model;
+}
+
+async function main() {
+  const args = parseArgs(process.argv.slice(2));
+  if (!args.component) {
+    console.error("usage: node microservice.mjs <Component.mjs> [--service-type T] [--http-port P] [--parameters JSON]");
+    process.exit(2);
+  }
+  const model = await loadComponent(args.component, args.parameters);
+  const server = makeServer(model, { serviceType: args.serviceType });
+  server.listen(args.httpPort, args.host, () => {
+    console.log(`seldon-tpu nodejs microservice (${args.serviceType}) on ${args.host}:${args.httpPort}`);
+  });
+  // graceful drain: stop accepting, drop idle keep-alive sockets (they
+  // would otherwise hold close() open forever), let in-flight requests
+  // finish (reference analogue: engine /pause + Tomcat drain,
+  // App.java:60-97)
+  process.on("SIGTERM", () => {
+    server.close(() => process.exit(0));
+    server.closeIdleConnections();
+  });
+}
+
+if (import.meta.url === pathToFileURL(process.argv[1] || "").href) {
+  main().catch((e) => {
+    console.error(e);
+    process.exit(1);
+  });
+}

@@ -1,0 +1,36 @@
+// Example component: mean-centred linear scorer with tags/metrics.
+// The duck-typed surface matches the Python SeldonComponent
+// (seldon_core_tpu/runtime/component.py; reference
+// python/seldon_core/user_model.py:20-104): predict / tags / metrics
+// / class_names, all optional, arrays in, arrays out.
+
+export default class ExampleModel {
+  constructor(parameters = {}) {
+    this.bias = parameters.bias ?? 0;
+    this.calls = 0;
+  }
+
+  async init() {
+    // load weights here (storage download, etc.)
+  }
+
+  predict(rows) {
+    this.calls += 1;
+    return rows.map((r) => {
+      const mean = r.reduce((a, b) => a + b, 0) / r.length;
+      return [mean + this.bias, -mean - this.bias];
+    });
+  }
+
+  class_names() {
+    return ["score", "anti_score"];
+  }
+
+  tags() {
+    return { wrapper: "nodejs" };
+  }
+
+  metrics() {
+    return [{ type: "COUNTER", key: "example_calls_total", value: this.calls }];
+  }
+}

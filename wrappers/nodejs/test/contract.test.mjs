@@ -1,0 +1,94 @@
+// Contract tests for the Node.js wrapper (node --test).
+//
+// Mirrors the tier-1 strategy of the Python suite
+// (tests/test_runtime_rest.py; reference
+// python/tests/test_model_microservice.py:212-717): in-process
+// server, every payload dialect, meta propagation, error statuses.
+
+import { test } from "node:test";
+import assert from "node:assert/strict";
+import { once } from "node:events";
+import { makeServer, parseParameters, parseArgs } from "../microservice.mjs";
+import { flatten, unflatten, decodeData, encodeData } from "../codec.mjs";
+import ExampleModel from "../model.example.mjs";
+
+async function call(server, path, body) {
+  server.listen(0, "127.0.0.1");
+  await once(server, "listening");
+  const { port } = server.address();
+  try {
+    const res = await fetch(`http://127.0.0.1:${port}${path}`, {
+      method: body === undefined ? "GET" : "POST",
+      body: body === undefined ? undefined : JSON.stringify(body),
+    });
+    return { code: res.status, body: await res.json().catch(() => null) };
+  } finally {
+    server.close();
+  }
+}
+
+test("codec round-trips tensor and ndarray", () => {
+  const [vals, shape] = flatten([[1, 2], [3, 4]]);
+  assert.deepEqual(shape, [2, 2]);
+  assert.deepEqual(unflatten(vals, shape), [[1, 2], [3, 4]]);
+  const d = decodeData({ tensor: { shape: [1, 2], values: [5, 6] } });
+  assert.equal(d.kind, "tensor");
+  assert.deepEqual(encodeData(d.rows, ["a", "b"], "tensor").tensor.values, [5, 6]);
+});
+
+test("predict returns scores, class names, tags and metrics", async () => {
+  const { code, body } = await call(makeServer(new ExampleModel({})), "/predict", {
+    data: { ndarray: [[1, 2, 3]] },
+    meta: { puid: "abc" },
+  });
+  assert.equal(code, 200);
+  assert.equal(body.data.names[0], "score");
+  assert.equal(body.meta.puid, "abc");
+  assert.equal(body.meta.tags.wrapper, "nodejs");
+  assert.equal(body.meta.metrics[0].type, "COUNTER");
+});
+
+test("tensor dialect is preserved in the response", async () => {
+  const { body } = await call(makeServer(new ExampleModel({})), "/predict", {
+    data: { tensor: { shape: [1, 2], values: [4, 6] } },
+  });
+  assert.ok(body.data.tensor);
+  assert.deepEqual(body.data.tensor.shape, [1, 2]);
+});
+
+test("bad JSON gives a FAILURE status envelope", async () => {
+  const server = makeServer(new ExampleModel({}));
+  server.listen(0, "127.0.0.1");
+  await once(server, "listening");
+  const { port } = server.address();
+  const res = await fetch(`http://127.0.0.1:${port}/predict`, { method: "POST", body: "{nope" });
+  const body = await res.json();
+  server.close();
+  assert.equal(res.status, 400);
+  assert.equal(body.status.status, "FAILURE");
+  assert.equal(body.status.reason, "BAD_REQUEST");
+});
+
+test("feedback reaches send_feedback and routes by meta.routing", async () => {
+  let seen = null;
+  class FB extends ExampleModel {
+    send_feedback(rows, names, reward) {
+      seen = { rows, reward };
+    }
+  }
+  const { code } = await call(makeServer(new FB({})), "/send-feedback", {
+    request: { data: { ndarray: [[1]] } },
+    reward: 0.5,
+  });
+  assert.equal(code, 200);
+  assert.deepEqual(seen, { rows: [[1]], reward: 0.5 });
+});
+
+test("typed parameters cast like the Python CLI", () => {
+  const p = parseParameters('[{"name":"k","value":"3","type":"INT"},{"name":"s","value":"[4]","type":"JSON"}]');
+  assert.equal(p.k, 3);
+  assert.deepEqual(p.s, [4]);
+  const a = parseArgs(["./m.mjs", "--service-type", "ROUTER", "--http-port", "9100"]);
+  assert.equal(a.serviceType, "ROUTER");
+  assert.equal(a.httpPort, 9100);
+});
