@@ -144,6 +144,7 @@ def _compact_result(full: dict) -> dict:
         ("paged_micro_tok_s", ("generation", "paged_decode_tokens_per_s")),
         ("spec_draft_acc", ("generation", "spec_draft_acceptance")),
         ("spec_ngram_acc", ("generation", "spec_ngram_acceptance")),
+        ("spec_ngram_acc_arith", ("generation", "spec_ngram_acceptance_arith")),
         ("native_img_s", ("native_model", "images_per_s")),
         ("native_grpc_img_s", ("native_model", "grpc_images_per_s")),
         ("native_vs_py", ("native_model", "vs_python_lane")),
@@ -1118,17 +1119,21 @@ def generation_phase() -> dict:
             for p, g in zip(seed_prompts, prior)
         ]
 
-        def run_engine(speculative, hints=None):
+        def run_engine(speculative, hints=None, eng_params=None, eng_prompts=None):
+            # one timing protocol for every engine lane (echo + arith):
+            # warmup go() pays compiles, the second go() is timed
             eng = PagedEngine(
-                spec_params, dtype=jnp.float32, page_size=64, max_slots=spec_batch,
+                spec_params if eng_params is None else eng_params,
+                dtype=jnp.float32, page_size=64, max_slots=spec_batch,
                 steps_per_call=8, speculative=speculative, **pe_cfg,
             )
+            use_prompts = prompts if eng_prompts is None else eng_prompts
 
             def go():
                 streams = [
                     eng.submit(p, max_new_tokens=spec_new,
                                draft_hint=None if hints is None else hints[i])
-                    for i, p in enumerate(prompts)
+                    for i, p in enumerate(use_prompts)
                 ]
                 eng.run()
                 return np.stack([s.result for s in streams])
@@ -1192,83 +1197,167 @@ def generation_phase() -> dict:
         result["spec_oracle_chunks"] = spec_stats["chunks"] // 2
         result["plain_chunks"] = plain_stats["chunks"] // 2
 
-        # draft-MODEL lane: a small draft LM distilled in-bench on the
-        # target's greedy continuations of HELD-OUT echo prompts.  The
-        # workload's exploitable structure is copying (that is why
-        # ngram accepts 0.54), so training uses MANY random-pattern
-        # sequences — with distinct patterns per sequence, the only
-        # compressive solution is induction (copy heads), which
-        # transfers to the measured prompts; memorising a handful of
-        # sequences (the r4-interim 150-step version) transfers
-        # nothing and accepted 0.0.  Training runs ON DEVICE as one
-        # fori_loop program (one dispatch, not one per step — the same
-        # lesson as the device_loop roofline).  Measured prompts never
-        # enter training; greedy exactness is asserted either way.
+        # draft-MODEL lane: measured on a TRAINED-target scenario.
+        # With a random-weight target no draft can learn anything (its
+        # argmax is a hash of context — measured r4: hard-target
+        # distillation on held-out echo seqs memorises and transfers
+        # 0.0; infinite-fresh-data KL distillation plateaus at 6%
+        # argmax agreement).  The deployment scenario speculation
+        # exists for is a *trained* target with structure: here the
+        # target stand-in is trained in-bench on arithmetic-echo
+        # (s_t = s_{t-8}+1 mod V) — structure a copy drafter cannot
+        # exploit (ngram acceptance ~0 on it) — and the draft is
+        # KL-distilled from the frozen trained target on FRESH random
+        # sequences every step (nothing to memorise).  Both trainings
+        # run ON DEVICE as single fori_loop programs.  Two lessons are
+        # baked in, both measured on chip: sequences must cover the
+        # SERVING position range (position embeddings past the training
+        # length are untrained — the target went off-rule at exactly
+        # position 96 = the r4-interim training length), and crops must
+        # randomise the pattern phase (the engine drafts from sliding
+        # windows at every phase).  Measured prompts are held out;
+        # greedy exactness is asserted.
         import optax
 
-        from seldon_core_tpu.models.generate import Generator
         from seldon_core_tpu.models.transformer import TransformerLM
 
+        arith_len = 160  # covers prompt 64 + spec_new 64, with margin
+        tb = 4 if quick else 16  # train batch
+
+        def make_arith(key, n, length):
+            """Fresh arithmetic-echo batch at random phase offsets."""
+            pat = jax.random.randint(
+                key, (n, 8), 0, cfg["vocab_size"], jnp.int32
+            )
+            reps = (length + 16) // 8 + 2
+            incs = jnp.arange(reps, dtype=jnp.int32)[None, :, None]
+            full = ((pat[:, None, :] + incs) % cfg["vocab_size"]).reshape(n, -1)
+            key_off = jax.random.fold_in(key, 1)
+            off = jax.random.randint(key_off, (n,), 0, 8, jnp.int32)
+            return jax.vmap(
+                lambda row, o: jax.lax.dynamic_slice(row, (o,), (length,))
+            )(full, off)
+
+        target_mod = TransformerLM(dtype=jnp.float32, **pe_cfg)
+        at_params = target_mod.init(
+            jax.random.key(3), jnp.zeros((1, 8), jnp.int32)
+        )["params"]
+
+        def ce_loss(mod, p, ids):
+            logits = mod.apply({"params": p}, ids)
+            lp = jax.nn.log_softmax(logits[:, :-1])
+            return -jnp.take_along_axis(
+                lp, ids[:, 1:][..., None], axis=-1
+            )[..., 0].mean()
+
+        t_steps, d_steps = (100, 150) if quick else (1500, 1200)
+        topt = optax.adam(3e-4)
+
+        @jax.jit
+        def train_target(p, o, key):
+            def body(_, c):
+                p, o, key = c
+                key, k1 = jax.random.split(key)
+                ids = make_arith(k1, tb, arith_len)
+                g = jax.grad(lambda q: ce_loss(target_mod, q, ids))(p)
+                up, o = topt.update(g, o)
+                return optax.apply_updates(p, up), o, key
+
+            return jax.lax.fori_loop(0, t_steps, body, (p, o, key))
+
+        # trainings run at DEFAULT matmul precision: the surrounding
+        # 'highest' scope exists only so the two engine lanes compare
+        # greedy-exactly — both lanes consume the same trained weights,
+        # so training precision cannot affect that property, and 6-pass
+        # true-f32 matmuls would multiply the training wall-time
+        t0 = _time.perf_counter()
+        with jax.default_matmul_precision("default"):
+            at_params, _, _ = jax.block_until_ready(
+                train_target(at_params, topt.init(at_params), jax.random.key(21))
+            )
+        target_train_s = _time.perf_counter() - t0
+
         dc = dict(
-            vocab_size=cfg["vocab_size"], d_model=max(64, cfg["d_model"] // 8),
+            vocab_size=cfg["vocab_size"], d_model=max(64, cfg["d_model"] // 4),
             num_layers=2, num_heads=4, max_len=pe_cfg["max_len"],
         )
-        n_train, plen_train = (32, 48) if quick else (128, 96)
-        rng_d = np.random.default_rng(17)
-        patterns = rng_d.integers(
-            0, cfg["vocab_size"], size=(n_train, 8)
-        ).astype(np.int32)
-        train_prompts = np.concatenate(
-            [np.tile(p, plen_train // 8 + 1)[None, :plen_train] for p in patterns]
-        )
-        gen_f32 = Generator(spec_params, dtype=jnp.float32, **pe_cfg)
-        cont = gen_f32.generate(train_prompts, max_new_tokens=spec_new)
-        train_ids = np.concatenate([train_prompts, np.asarray(cont)], axis=1)
         draft_mod = TransformerLM(dtype=jnp.float32, **dc)
         dparams = draft_mod.init(
             jax.random.key(7), jnp.zeros((1, 8), jnp.int32)
         )["params"]
-        opt = optax.adam(3e-3)
-
-        def loss_fn(p, ids):
-            logits = draft_mod.apply({"params": p}, ids)
-            logp = jax.nn.log_softmax(logits[:, :-1])
-            nll = -jnp.take_along_axis(
-                logp, ids[:, 1:][..., None], axis=-1
-            )[..., 0]
-            return nll.mean()
-
-        train_steps = 200 if quick else 3000
+        dopt = optax.adam(1e-3)
 
         @jax.jit
-        def train_all(p, o, ids):
-            def body(_, carry):
-                p, o = carry
-                g = jax.grad(loss_fn)(p, ids)
-                up, o = opt.update(g, o)
-                return optax.apply_updates(p, up), o
+        def distil(p, o, key, teacher):
+            # teacher as an argument, not a closure: closed-over params
+            # would bake ~140 MB of weights into the traced program as
+            # compile-time constants
+            def body(_, c):
+                p, o, key = c
+                key, k1 = jax.random.split(key)
+                ids = make_arith(k1, tb, arith_len)
+                tl = jax.lax.stop_gradient(
+                    target_mod.apply({"params": teacher}, ids)
+                )
 
-            return jax.lax.fori_loop(0, train_steps, body, (p, o))
+                def kl(q):
+                    dl = draft_mod.apply({"params": q}, ids)
+                    t = jax.nn.log_softmax(tl[:, :-1])
+                    d = jax.nn.log_softmax(dl[:, :-1])
+                    return (jnp.exp(t) * (t - d)).sum(-1).mean()
+
+                g = jax.grad(kl)(p)
+                up, o = dopt.update(g, o)
+                return optax.apply_updates(p, up), o, key
+
+            return jax.lax.fori_loop(0, d_steps, body, (p, o, key))
 
         t0 = _time.perf_counter()
-        dparams, _ = jax.block_until_ready(
-            train_all(dparams, opt.init(dparams), jnp.asarray(train_ids))
-        )
+        with jax.default_matmul_precision("default"):
+            dparams, _, _ = jax.block_until_ready(
+                distil(dparams, dopt.init(dparams), jax.random.key(22), at_params)
+            )
         distil_s = _time.perf_counter() - t0
 
-        dm_toks, dm_dt, dm_stats = run_engine({
+        # held-out prompts (fresh patterns, never in training RNG line)
+        arith_prompts = [
+            np.asarray(make_arith(jax.random.key(424242 + i), 1, 64))[0]
+            for i in range(spec_batch)
+        ]
+
+        def run_arith(speculative):
+            return run_engine(
+                speculative, eng_params=at_params, eng_prompts=arith_prompts
+            )
+
+        ar_plain, _ar_dt, _ar_stats = run_arith(None)
+        dm_toks, dm_dt, dm_stats = run_arith({
             "draft": "model", "draft_k": 4, "draft_params": dparams,
-            "draft_config": dc, "draft_window": pe_cfg["max_len"],
+            "draft_config": dc,
         })
-        assert np.array_equal(plain_toks, dm_toks), "draft-model lane must be greedy-exact"
+        assert np.array_equal(ar_plain, dm_toks), "draft-model lane must be greedy-exact"
+        ar_ng, _, ar_ng_stats = run_arith({"draft": "ngram", "draft_k": 4})
+        assert np.array_equal(ar_plain, ar_ng), "ngram lane must be greedy-exact"
         result["spec_draft_acceptance"] = round(
             dm_stats["spec_accepted"] / max(1, dm_stats["spec_drafted"]), 3
         )
+        # copy drafting on the same workload — the contrast the trained
+        # draft exists to win
+        result["spec_ngram_acceptance_arith"] = round(
+            ar_ng_stats["spec_accepted"] / max(1, ar_ng_stats["spec_drafted"]), 3
+        )
         result["paged_draft_tokens_per_s"] = round(spec_batch * spec_new / dm_dt, 1)
         result["spec_draft_chunks"] = dm_stats["chunks"] // 2
+        # tokens each verify call advances a slot (k+1 at full
+        # acceptance vs 1 for the token-wise decode spec replaces)
+        result["spec_draft_tokens_per_call"] = round(
+            spec_new / max(1, dm_stats["chunks"] / 2), 2
+        )
         result["spec_draft_config"] = (
-            f"d{dc['d_model']} L2 distilled {train_steps} steps on "
-            f"{n_train} held-out echo seqs ({round(distil_s, 1)}s)"
+            f"target d{cfg['d_model']} trained {t_steps} steps "
+            f"({round(target_train_s, 1)}s) on arith-echo; draft "
+            f"d{dc['d_model']} L2 KL-distilled {d_steps} steps "
+            f"({round(distil_s, 1)}s), fresh data every step"
         )
     except Exception as e:  # noqa: BLE001
         result["speculative_error"] = str(e)[:200]
