@@ -65,6 +65,11 @@ def _build_modules():
         num_heads: int
         mlp_ratio: int = 4
         dtype: Any = jnp.bfloat16
+        # decode fast path (pallas flash-decoding) — the engine turns
+        # this off under tensor-parallel meshes: GSPMD cannot partition
+        # a pallas_call whose BlockSpecs span the full heads axis, so a
+        # heads-sharded pool would all-gather per layer per step
+        decode_kernel: bool = True
 
         @nn.compact
         def __call__(self, x, pk, pv, block_tables, lengths):
@@ -80,33 +85,84 @@ def _build_modules():
             shape = (batch, seg_len, heads, head_dim)
             q, k, v = q.reshape(shape), k.reshape(shape), v.reshape(shape)
 
-            # same arithmetic as TransformerBlock._cached_attention:
-            # bf16 scores masked with finfo.min, f32 softmax
             scale = 1.0 / jnp.sqrt(head_dim).astype(q.dtype)
-            gk = pk[block_tables]  # (B, P, ps, h, hd)
-            pages_per, page_size = gk.shape[1], gk.shape[2]
-            cache_len = pages_per * page_size
-            gk = gk.reshape(batch, cache_len, heads, head_dim)
-            gv = pv[block_tables].reshape(batch, cache_len, heads, head_dim)
 
-            sc = jnp.einsum("bqhd,bkhd->bhqk", q * scale, gk)
-            ss = jnp.einsum("bqhd,bkhd->bhqk", q * scale, k)
-            neg = jnp.finfo(sc.dtype).min
-            cache_mask = (
-                jnp.arange(cache_len)[None, :] < lengths[:, None]
-            )  # (B, cache_len)
-            sc = jnp.where(cache_mask[:, None, None, :], sc, neg)
-            seg_mask = (
-                jnp.arange(seg_len)[None, :] <= jnp.arange(seg_len)[:, None]
-            )  # (L, L) causal within this segment
-            ss = jnp.where(seg_mask[None, None], ss, neg)
-            scores = jnp.concatenate([sc, ss], axis=-1).astype(jnp.float32)
-            weights = jax.nn.softmax(scores, axis=-1).astype(self.dtype)
-            wc, ws = weights[..., :cache_len], weights[..., cache_len:]
-            attn = jnp.einsum("bhqk,bkhd->bqhd", wc, gv) + jnp.einsum(
-                "bhqk,bkhd->bqhd", ws, v
+            import os as _os
+
+            kernel_mode = _os.environ.get("SELDON_TPU_PAGED_KERNEL", "1")
+            use_kernel = (
+                seg_len == 1
+                and self.decode_kernel
+                and self.dtype == jnp.bfloat16
+                and (
+                    kernel_mode == "force"
+                    or (kernel_mode == "1" and jax.default_backend() == "tpu")
+                )
             )
-            attn = attn.reshape(batch, seg_len, d_model)
+            if use_kernel:
+                # pallas flash-decoding over the paged pool
+                # (ops/kernels.py paged_attention_decode): pages stream
+                # HBM->VMEM indexed by the block table; the
+                # (B, P, ps, h, hd) gathered copy below never
+                # materialises.  The current token merges via the flash
+                # rule.  NUMERIC REGIME: the kernel scores in f32 where
+                # the gather path scores in bf16, so on hardware a
+                # kernel-decode engine and a gather-path engine (e.g. a
+                # speculative verify program) can break argmax ties
+                # differently — each lane is deterministic, the f32
+                # exactness lanes always use the gather path, and
+                # SELDON_TPU_PAGED_KERNEL=0 restores one regime when
+                # cross-lane bit-equality matters more than speed.
+                from seldon_core_tpu.ops.kernels import paged_attention_decode
+
+                q1 = (q * scale)[:, 0]  # (B, h, hd)
+                acc, m, l = paged_attention_decode(
+                    q1, pk, pv, block_tables, lengths,
+                    page_size=pk.shape[1],
+                )
+                s_self = jnp.einsum(
+                    "bhd,bhd->bh",
+                    q1.astype(jnp.float32), k[:, 0].astype(jnp.float32),
+                )
+                m2 = jnp.maximum(m, s_self)
+                alpha = jnp.exp(m - m2)
+                w_self = jnp.exp(s_self - m2)
+                l2 = l * alpha + w_self
+                attn = (
+                    acc * alpha[..., None]
+                    + v[:, 0].astype(jnp.float32) * w_self[..., None]
+                ) / l2[..., None]
+                attn = attn[:, None].astype(self.dtype)
+                attn = attn.reshape(batch, seg_len, d_model)
+            else:
+                # gather path — same arithmetic as
+                # TransformerBlock._cached_attention: bf16 scores
+                # masked with finfo.min, f32 softmax
+                gk = pk[block_tables]  # (B, P, ps, h, hd)
+                pages_per, page_size = gk.shape[1], gk.shape[2]
+                cache_len = pages_per * page_size
+                gk = gk.reshape(batch, cache_len, heads, head_dim)
+                gv = pv[block_tables].reshape(batch, cache_len, heads, head_dim)
+
+                sc = jnp.einsum("bqhd,bkhd->bhqk", q * scale, gk)
+                ss = jnp.einsum("bqhd,bkhd->bhqk", q * scale, k)
+                neg = jnp.finfo(sc.dtype).min
+                cache_mask = (
+                    jnp.arange(cache_len)[None, :] < lengths[:, None]
+                )  # (B, cache_len)
+                sc = jnp.where(cache_mask[:, None, None, :], sc, neg)
+                seg_mask = (
+                    jnp.arange(seg_len)[None, :] <= jnp.arange(seg_len)[:, None]
+                )  # (L, L) causal within this segment
+                ss = jnp.where(seg_mask[None, None], ss, neg)
+                scores = jnp.concatenate([sc, ss], axis=-1).astype(jnp.float32)
+                weights = jax.nn.softmax(scores, axis=-1).astype(self.dtype)
+                wc, ws = weights[..., :cache_len], weights[..., cache_len:]
+                attn = jnp.einsum("bhqk,bkhd->bqhd", wc, gv) + jnp.einsum(
+                    "bhqk,bkhd->bqhd", ws, v
+                )
+                attn = attn.reshape(batch, seg_len, d_model)
+
             x = x + nn.Dense(d_model, dtype=self.dtype, name="attn_proj")(attn)
             y = nn.LayerNorm(dtype=jnp.float32)(x)
             y = nn.Dense(self.mlp_ratio * d_model, dtype=self.dtype, name="mlp_in")(y)
@@ -128,6 +184,7 @@ def _build_modules():
         num_heads: int = 8
         max_len: int = 2048
         dtype: Any = jnp.bfloat16
+        decode_kernel: bool = True
 
         @nn.compact
         def __call__(self, tokens, positions, pages_k, pages_v, block_tables, lengths):
@@ -142,7 +199,8 @@ def _build_modules():
             new_k, new_v = [], []
             for i in range(self.num_layers):
                 x, k, v = PagedTransformerBlock(
-                    num_heads=self.num_heads, dtype=self.dtype, name=f"block_{i}"
+                    num_heads=self.num_heads, dtype=self.dtype,
+                    decode_kernel=self.decode_kernel, name=f"block_{i}"
                 )(x, pages_k[i], pages_v[i], block_tables, lengths)
                 new_k.append(k)
                 new_v.append(v)
@@ -371,6 +429,10 @@ class PagedEngine:
         self.module = get_paged_lm_class()(
             vocab_size=vocab_size, d_model=d_model, num_layers=num_layers,
             num_heads=num_heads, max_len=max_len, dtype=dtype,
+            # pallas decode kernel and heads-sharded pools don't mix:
+            # GSPMD can't partition the custom call, so a TP mesh would
+            # all-gather the pool per layer per step
+            decode_kernel=mesh is None,
         )
         pool_shape = (num_layers, self.num_pages, self.page_size, num_heads, head_dim)
         # tensor-parallel decode: megatron-style param shardings + the

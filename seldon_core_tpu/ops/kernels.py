@@ -311,3 +311,127 @@ def flash_attn_fn(block_q: int = 128, block_k: int = 128):
         return flash_attention(q, k, v, causal=causal, block_q=block_q, block_k=block_k)
 
     return fn
+
+
+# ---------------------------------------------------------------------------
+# paged attention decode (flash-decoding over a paged K/V pool)
+# ---------------------------------------------------------------------------
+
+def _paged_decode_kernel(tables_ref, lens_ref, q_ref, k_ref, v_ref,
+                         acc_ref, m_ref, l_ref, *, page_size):
+    """One (slot, page) grid step of online-softmax decode attention.
+
+    The page block arrives via a block-table-indexed BlockSpec (scalar
+    prefetch), so each grid step DMAs exactly one page from HBM —
+    the (B, P, ps, h, hd) gathered copy the XLA path materialises per
+    layer per step never exists.  acc/m/l are outputs revisited across
+    the page dimension (flash carry), emitted unnormalised for the
+    caller to merge with the current-token term.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    b = pl.program_id(0)
+    p = pl.program_id(1)
+
+    @pl.when(p == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, -jnp.inf)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    length = lens_ref[b]
+    start = p * page_size
+
+    @pl.when(start < length)
+    def _step():
+        q = q_ref[0].astype(jnp.float32)          # (h, hd), pre-scaled
+        k = k_ref[0].astype(jnp.float32)          # (ps, h, hd)
+        v = v_ref[0].astype(jnp.float32)
+        # Mosaic has no batched-dot lowering — broadcast-multiply-
+        # reduce on the VPU instead; the (h, ps, hd) intermediate is
+        # ~128 KB of VMEM and the page DMA dominates regardless
+        kt = k.transpose(1, 0, 2)                 # (h, ps, hd)
+        s = (q[:, None, :] * kt).sum(axis=2)      # (h, ps)
+        pos = start + jax.lax.broadcasted_iota(jnp.int32, (1, page_size), 1)
+        s = jnp.where(pos < length, s, -jnp.inf)
+        # m/l carries are lane-padded to (h, 128) — Mosaic requires the
+        # last block dim be 128-divisible (or the full array dim);
+        # column 0 is the value, the broadcast keeps every lane equal
+        m_prev = m_ref[0, :, 0]                   # (h,)
+        l_prev = l_ref[0, :, 0]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1))
+        alpha = jnp.exp(m_prev - m_new)
+        w = jnp.exp(s - m_new[:, None])           # (h, ps)
+        m_ref[0] = jnp.broadcast_to(m_new[:, None], m_ref.shape[1:])
+        l_ref[0] = jnp.broadcast_to(
+            (l_prev * alpha + w.sum(axis=1))[:, None], l_ref.shape[1:]
+        )
+        vt = v.transpose(1, 0, 2)                 # (h, ps, hd)
+        pv_dot = (w[:, :, None] * vt).sum(axis=1)  # (h, hd)
+        acc_ref[0] = acc_ref[0] * alpha[:, None] + pv_dot
+
+
+def paged_attention_decode(q, pk, pv, block_tables, lengths, *, page_size):
+    """Unnormalised flash state of decode attention over a paged pool.
+
+    ``q`` (B, h, hd) — current-step queries, already scaled;
+    ``pk``/``pv`` (num_pages, ps, h, hd); ``block_tables`` (B, P);
+    ``lengths`` (B,) cached token counts.  Returns ``(acc, m, l)``
+    f32 — merge with the in-segment term via the usual flash rule.
+
+    TPU-first replacement for the ``pk[block_tables]`` gather in
+    ``PagedTransformerBlock`` (models/paged.py): the gather copies the
+    whole live cache through HBM per layer per step; here pages stream
+    HBM->VMEM once, indexed by the scalar-prefetched block table
+    (the vLLM paged-attention idea recast in pallas; reference has no
+    counterpart — it is pre-LLM).
+    """
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    B, h, hd = q.shape
+    P = block_tables.shape[1]
+    ps = pk.shape[1]
+    if page_size != ps:
+        raise ValueError(
+            f"page_size={page_size} does not match the pool's page dim {ps}"
+        )
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,  # tables, lengths
+        grid=(B, P),
+        in_specs=[
+            pl.BlockSpec((1, h, hd), lambda b, p, tables, lens: (b, 0, 0)),
+            pl.BlockSpec(
+                (1, ps, h, hd),
+                lambda b, p, tables, lens: (tables[b, p], 0, 0, 0),
+            ),
+            pl.BlockSpec(
+                (1, ps, h, hd),
+                lambda b, p, tables, lens: (tables[b, p], 0, 0, 0),
+            ),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, h, hd), lambda b, p, tables, lens: (b, 0, 0)),
+            pl.BlockSpec((1, h, 128), lambda b, p, tables, lens: (b, 0, 0)),
+            pl.BlockSpec((1, h, 128), lambda b, p, tables, lens: (b, 0, 0)),
+        ],
+    )
+    kernel = functools.partial(_paged_decode_kernel, page_size=ps)
+    acc, m, l = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((B, h, hd), jnp.float32),
+            jax.ShapeDtypeStruct((B, h, 128), jnp.float32),
+            jax.ShapeDtypeStruct((B, h, 128), jnp.float32),
+        ],
+        interpret=_use_interpret(),
+    )(block_tables, lengths, q, pk, pv)
+    return acc, m[:, :, 0], l[:, :, 0]
