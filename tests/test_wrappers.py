@@ -1,8 +1,8 @@
-"""Language-wrapper contract tests (SURVEY.md #18-19).
+"""Language-wrapper contract tests (SURVEY.md #18-20).
 
 Static surface checks always run; the wrapper's own runtime test
-suites run when the interpreter exists (this image has neither node
-nor R, so those gate gracefully — the same environment gating the
+suites run when the interpreter exists (this image has no node, R or
+JDK, so those gate gracefully — the same environment gating the
 reference applies to its s2i images, wrappers/s2i/nodejs/Makefile).
 """
 
@@ -42,6 +42,7 @@ def test_nodejs_package_json_valid():
 @pytest.mark.parametrize("wrapper,entry", [
     ("nodejs", "microservice.mjs"),
     ("R", "microservice.R"),
+    ("java", "src/io/seldon/tpu/Microservice.java"),
 ])
 def test_wrapper_serves_full_endpoint_surface(wrapper, entry):
     src = (WRAPPERS / wrapper / entry).read_text()
@@ -52,6 +53,7 @@ def test_wrapper_serves_full_endpoint_surface(wrapper, entry):
 @pytest.mark.parametrize("wrapper,entry", [
     ("nodejs", "microservice.mjs"),
     ("R", "microservice.R"),
+    ("java", "src/io/seldon/tpu/Microservice.java"),
 ])
 def test_wrapper_honours_typed_parameter_contract(wrapper, entry):
     src = (WRAPPERS / wrapper / entry).read_text()
@@ -61,19 +63,43 @@ def test_wrapper_honours_typed_parameter_contract(wrapper, entry):
     assert "PREDICTIVE_UNIT_PARAMETERS" in src
 
 
-@pytest.mark.parametrize("wrapper,entry", [
-    ("nodejs", "microservice.mjs"),
-    ("R", "microservice.R"),
+@pytest.mark.parametrize("wrapper,exts", [
+    ("nodejs", (".mjs",)),
+    ("R", (".R",)),
+    ("java", (".java",)),
 ])
-def test_wrapper_failure_envelope(wrapper, entry):
+def test_wrapper_failure_envelope(wrapper, exts):
+    # implementation sources only — test files also mention these
+    # strings and must not be able to satisfy the pin
     srcs = "".join(
         p.read_text()
-        for p in (WRAPPERS / wrapper).glob("*")
-        if p.is_file() and p.suffix in (".mjs", ".R")
+        for p in (WRAPPERS / wrapper).rglob("*")
+        if p.is_file() and p.suffix in exts and "test" not in p.parts
     )
     assert "FAILURE" in srcs
     assert "MICROSERVICE_INTERNAL_ERROR" in srcs
     assert "BAD_REQUEST" in srcs
+
+
+def test_java_wrapper_zero_dependency():
+    # the wrapper must import only JDK packages (java.*, javax.*,
+    # com.sun.net.httpserver.*) and itself — no Spring/Jackson/proto
+    # (the reference's stack, wrappers/s2i/java/.../App.java:1-16)
+    allowed = ("java.", "javax.", "com.sun.net.httpserver.", "io.seldon.")
+    for p in (WRAPPERS / "java").rglob("*.java"):
+        for line in p.read_text().splitlines():
+            line = line.strip()
+            if line.startswith("import "):
+                target = line[len("import "):].rstrip(";").replace("static ", "")
+                assert target.startswith(allowed), f"{p.name}: non-JDK import {target}"
+
+
+def test_java_wrapper_dispatch_covers_all_roles():
+    src = (WRAPPERS / "java" / "src/io/seldon/tpu/Dispatch.java").read_text()
+    for method in ("predict", "transform_input", "transform_output", "route"):
+        assert f'"{method}"' in src
+    assert "runAggregate" in src and "runFeedback" in src
+    assert "EMPTY_AGGREGATE" in src  # the aggregate guard all wrappers share
 
 
 def test_nodejs_runtime_suite():
@@ -83,6 +109,16 @@ def test_nodejs_runtime_suite():
     out = subprocess.run(
         [node, "--test", "test/"], cwd=WRAPPERS / "nodejs",
         capture_output=True, text=True, timeout=120,
+    )
+    assert out.returncode == 0, out.stdout + out.stderr
+
+
+def test_java_runtime_suite():
+    if shutil.which("javac") is None or shutil.which("make") is None:
+        pytest.skip("JDK not in this image (environment-gated, see wrappers/README.md)")
+    out = subprocess.run(
+        ["make", "test"], cwd=WRAPPERS / "java",
+        capture_output=True, text=True, timeout=300,
     )
     assert out.returncode == 0, out.stdout + out.stderr
 

@@ -1,0 +1,64 @@
+package io.seldon.example;
+
+import io.seldon.tpu.SeldonComponent;
+
+import java.util.ArrayList;
+import java.util.Arrays;
+import java.util.LinkedHashMap;
+import java.util.List;
+import java.util.Map;
+
+/**
+ * Example component: mean-centred linear scorer with tags/metrics —
+ * the Java twin of wrappers/nodejs/model.example.mjs.  Reference
+ * analogue: wrappers/s2i/java/test/model-template-app/.../
+ * ExampleModelHandler.java:12-19, without the Spring/proto stack.
+ */
+public class ExampleModel implements SeldonComponent {
+
+    private double bias = 0.0;
+    private long calls = 0;
+
+    @Override
+    public void init(Map<String, Object> parameters) {
+        Object b = parameters.get("bias");
+        if (b instanceof Number) bias = ((Number) b).doubleValue();
+    }
+
+    @Override
+    public double[][] predict(double[][] rows, List<String> names, Map<String, Object> meta) {
+        calls += 1;
+        double[][] out = new double[rows.length][2];
+        for (int i = 0; i < rows.length; i++) {
+            double mean = 0;
+            for (double v : rows[i]) mean += v;
+            mean /= Math.max(1, rows[i].length);
+            out[i][0] = mean + bias;
+            out[i][1] = -mean - bias;
+        }
+        return out;
+    }
+
+    @Override
+    public List<String> classNames() {
+        return Arrays.asList("score", "anti_score");
+    }
+
+    @Override
+    public Map<String, Object> tags() {
+        Map<String, Object> tags = new LinkedHashMap<>();
+        tags.put("wrapper", "java");
+        return tags;
+    }
+
+    @Override
+    public List<Map<String, Object>> metrics() {
+        Map<String, Object> m = new LinkedHashMap<>();
+        m.put("type", "COUNTER");
+        m.put("key", "example_calls_total");
+        m.put("value", (double) calls);
+        List<Map<String, Object>> out = new ArrayList<>();
+        out.add(m);
+        return out;
+    }
+}

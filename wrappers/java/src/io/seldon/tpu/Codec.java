@@ -1,0 +1,181 @@
+package io.seldon.tpu;
+
+import java.util.ArrayList;
+import java.util.LinkedHashMap;
+import java.util.List;
+import java.util.Map;
+
+/**
+ * Tensor codecs for the SeldonMessage JSON wire form — the Java twin of
+ * wrappers/nodejs/codec.mjs and the Python runtime's message layer
+ * (seldon_core_tpu/runtime/message.py).  Reference analogue:
+ * wrappers/s2i/nodejs/microservice.js:18-46 (rest_data_to_array /
+ * array_to_rest_data), re-designed: plain nested lists, no proto stack.
+ *
+ * `kind` remembers the caller's encoding ("tensor" or "ndarray") so the
+ * response round-trips in the same dialect.
+ */
+public final class Codec {
+
+    private Codec() {}
+
+    public static final class Decoded {
+        public final List<Object> rows;     // nested list form
+        public final List<String> names;
+        public final String kind;           // "tensor" | "ndarray"
+
+        Decoded(List<Object> rows, List<String> names, String kind) {
+            this.rows = rows;
+            this.names = names;
+            this.kind = kind;
+        }
+
+        /** Typed view for numeric components; 400s on non-numeric rows. */
+        public double[][] matrix() {
+            double[][] out = new double[rows.size()][];
+            for (int i = 0; i < rows.size(); i++) {
+                Object row = rows.get(i);
+                if (!(row instanceof List)) {
+                    throw new Dispatch.ApiError(400, "BAD_REQUEST",
+                            "numeric component needs a 2-D payload");
+                }
+                List<?> r = (List<?>) row;
+                out[i] = new double[r.size()];
+                for (int j = 0; j < r.size(); j++) {
+                    Object v = r.get(j);
+                    if (!(v instanceof Number)) {
+                        throw new Dispatch.ApiError(400, "BAD_REQUEST",
+                                "non-numeric value in ndarray; override predictRaw for mixed payloads");
+                    }
+                    out[i][j] = ((Number) v).doubleValue();
+                }
+            }
+            return out;
+        }
+    }
+
+    /** Flatten nested lists; returns flat values and writes shape. */
+    static void flatten(Object nested, List<Double> flat, List<Integer> shape, int depth) {
+        if (!(nested instanceof List)) {
+            if (!(nested instanceof Number)) {
+                throw new Dispatch.ApiError(500, "MICROSERVICE_INTERNAL_ERROR",
+                        "tensor payloads must be numeric");
+            }
+            flat.add(((Number) nested).doubleValue());
+            return;
+        }
+        List<?> list = (List<?>) nested;
+        if (depth == shape.size()) {
+            shape.add(list.size());
+        } else if (shape.get(depth) != list.size()) {
+            // flatten only runs on the encode path, so ragged rows are a
+            // component fault, not a client one (nodejs twin: plain Error)
+            throw new Dispatch.ApiError(500, "MICROSERVICE_INTERNAL_ERROR",
+                    "ragged tensor payload");
+        }
+        for (Object el : list) flatten(el, flat, shape, depth + 1);
+    }
+
+    /** Rebuild a nested list from flat values + shape. */
+    static Object unflatten(List<Object> values, List<Object> shape) {
+        long total = 1;
+        for (Object d : shape) {
+            if (!(d instanceof Number) || ((Number) d).longValue() < 0) {
+                throw new Dispatch.ApiError(400, "BAD_REQUEST",
+                        "tensor shape entries must be non-negative integers: " + shape);
+            }
+            total *= ((Number) d).longValue();
+        }
+        if (values.size() != total) {
+            throw new Dispatch.ApiError(400, "BAD_REQUEST",
+                    "tensor values/shape mismatch: " + values.size() + " vs " + shape);
+        }
+        if (shape.isEmpty()) return values.isEmpty() ? null : values.get(0);
+        List<Object> out = new ArrayList<>(values);
+        for (int d = shape.size() - 1; d > 0; d--) {
+            int size = ((Number) shape.get(d)).intValue();
+            List<Object> next = new ArrayList<>();
+            for (int i = 0; i < out.size(); i += size) {
+                next.add(new ArrayList<>(out.subList(i, Math.min(i + size, out.size()))));
+            }
+            out = next;
+        }
+        return out;
+    }
+
+    @SuppressWarnings("unchecked")
+    public static Decoded decode(Object data) {
+        if (!(data instanceof Map)) {
+            return new Decoded(new ArrayList<>(), new ArrayList<>(), "ndarray");
+        }
+        Map<String, Object> d = (Map<String, Object>) data;
+        List<String> names = new ArrayList<>();
+        Object rawNames = d.get("names");
+        if (rawNames instanceof List) {
+            for (Object n : (List<Object>) rawNames) names.add(String.valueOf(n));
+        }
+        Object tensor = d.get("tensor");
+        if (tensor instanceof Map) {
+            Map<String, Object> t = (Map<String, Object>) tensor;
+            List<Object> values = t.get("values") instanceof List
+                    ? (List<Object>) t.get("values") : new ArrayList<>();
+            List<Object> shape = t.get("shape") instanceof List
+                    ? (List<Object>) t.get("shape") : new ArrayList<>();
+            Object rows = unflatten(values, shape);
+            List<Object> rowList = rows instanceof List ? (List<Object>) rows : new ArrayList<>();
+            return new Decoded(rowList, names, "tensor");
+        }
+        Object nd = d.get("ndarray");
+        if (nd instanceof List) {
+            return new Decoded((List<Object>) nd, names, "ndarray");
+        }
+        return new Decoded(new ArrayList<>(), names, "ndarray");
+    }
+
+    /** Encode rows back into the requested dialect with class names. */
+    public static Map<String, Object> encode(Object rows, List<String> names, String kind) {
+        Map<String, Object> out = new LinkedHashMap<>();
+        out.put("names", names);
+        Object nested = toNested(rows);
+        if ("tensor".equals(kind)) {
+            List<Double> flat = new ArrayList<>();
+            List<Integer> shape = new ArrayList<>();
+            flatten(nested, flat, shape, 0);
+            Map<String, Object> tensor = new LinkedHashMap<>();
+            tensor.put("shape", shape);
+            tensor.put("values", flat);
+            out.put("tensor", tensor);
+        } else {
+            out.put("ndarray", nested);
+        }
+        return out;
+    }
+
+    /** Accept double[][] from typed components, pass lists through. */
+    static Object toNested(Object rows) {
+        if (rows instanceof double[][]) {
+            List<Object> out = new ArrayList<>();
+            for (double[] row : (double[][]) rows) {
+                List<Object> r = new ArrayList<>(row.length);
+                for (double v : row) r.add(v);
+                out.add(r);
+            }
+            return out;
+        }
+        return rows;
+    }
+
+    /** Default class names: t:0 .. t:n-1 (reference naming scheme). */
+    public static List<String> defaultNames(Object rows) {
+        int width = 0;
+        if (rows instanceof double[][] && ((double[][]) rows).length > 0) {
+            width = ((double[][]) rows)[0].length;
+        } else if (rows instanceof List && !((List<?>) rows).isEmpty()
+                && ((List<?>) rows).get(0) instanceof List) {
+            width = ((List<?>) ((List<?>) rows).get(0)).size();
+        }
+        List<String> out = new ArrayList<>(width);
+        for (int i = 0; i < width; i++) out.add("t:" + i);
+        return out;
+    }
+}

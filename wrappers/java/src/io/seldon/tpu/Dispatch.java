@@ -1,0 +1,196 @@
+package io.seldon.tpu;
+
+import java.util.ArrayList;
+import java.util.Arrays;
+import java.util.LinkedHashMap;
+import java.util.List;
+import java.util.Map;
+
+/**
+ * Role dispatch — the Java twin of wrappers/nodejs/dispatch.mjs and the
+ * Python runtime's dispatch layer (seldon_core_tpu/runtime/dispatch.py),
+ * which mirrors the reference's seldon_methods.py:28-344: try the
+ * component's raw (message-level) override first, fall back to the
+ * typed method, then construct the response with class names, tags and
+ * metrics merged into meta.
+ */
+public final class Dispatch {
+
+    private Dispatch() {}
+
+    public static final class ApiError extends RuntimeException {
+        public final int status;
+        public final String reason;
+
+        public ApiError(int status, String reason, String info) {
+            super(info);
+            this.status = status;
+            this.reason = reason;
+        }
+    }
+
+    private static final List<String> METRIC_TYPES =
+            Arrays.asList("COUNTER", "GAUGE", "TIMER");
+
+    static Map<String, Object> buildMeta(SeldonComponent model, Map<String, Object> requestMeta) {
+        Map<String, Object> meta = new LinkedHashMap<>();
+        if (requestMeta != null && requestMeta.get("puid") != null) {
+            meta.put("puid", requestMeta.get("puid"));
+        }
+        Map<String, Object> tags = model.tags();
+        if (tags != null && !tags.isEmpty()) meta.put("tags", tags);
+        List<Map<String, Object>> metrics = model.metrics();
+        if (metrics != null && !metrics.isEmpty()) {
+            for (Map<String, Object> m : metrics) {
+                if (m.get("key") == null || !METRIC_TYPES.contains(m.get("type"))) {
+                    throw new ApiError(500, "MICROSERVICE_INTERNAL_ERROR",
+                            "invalid metric: " + Json.write(m));
+                }
+            }
+            meta.put("metrics", metrics);
+        }
+        return meta;
+    }
+
+    @SuppressWarnings("unchecked")
+    private static Map<String, Object> metaOf(Map<String, Object> message) {
+        Object m = message.get("meta");
+        return m instanceof Map ? (Map<String, Object>) m : new LinkedHashMap<>();
+    }
+
+    private static Map<String, Object> respond(SeldonComponent model, Object rows,
+                                               String kind, Map<String, Object> requestMeta) {
+        List<String> names = model.classNames();
+        if (names == null) names = Codec.defaultNames(rows);
+        Map<String, Object> out = new LinkedHashMap<>();
+        out.put("data", Codec.encode(rows, names, kind));
+        out.put("meta", buildMeta(model, requestMeta));
+        return out;
+    }
+
+    public static Map<String, Object> runMessage(SeldonComponent model, String method,
+                                                 Map<String, Object> message) {
+        Map<String, Object> raw;
+        switch (method) {
+            case "predict":          raw = model.predictRaw(message); break;
+            case "transform_input":  raw = model.transformInputRaw(message); break;
+            case "transform_output": raw = model.transformOutputRaw(message); break;
+            case "route":            raw = model.routeRaw(message); break;
+            default: throw new ApiError(500, "MICROSERVICE_INTERNAL_ERROR",
+                    "unknown method " + method);
+        }
+        if (raw != null) return raw;
+
+        Codec.Decoded in = Codec.decode(message.get("data"));
+        Map<String, Object> meta = metaOf(message);
+
+        if (method.equals("route")) {
+            int branch = model.route(in.matrix(), in.names);
+            Map<String, Object> out = new LinkedHashMap<>();
+            Map<String, Object> data = new LinkedHashMap<>();
+            List<Object> row = new ArrayList<>();
+            row.add((double) branch);
+            List<Object> rows = new ArrayList<>();
+            rows.add(row);
+            data.put("ndarray", rows);
+            out.put("data", data);
+            out.put("meta", buildMeta(model, meta));
+            return out;
+        }
+
+        double[][] result;
+        if (method.equals("transform_input")) {
+            result = model.transformInput(in.matrix(), in.names, meta);
+            if (result == null) {
+                // MODEL used as input transformer passes through predict
+                result = model.predict(in.matrix(), in.names, meta);
+            }
+            if (result == null) result = in.matrix();           // identity
+        } else if (method.equals("transform_output")) {
+            result = model.transformOutput(in.matrix(), in.names, meta);
+            if (result == null) result = in.matrix();           // identity
+        } else {
+            result = model.predict(in.matrix(), in.names, meta);
+            if (result == null) {
+                throw new ApiError(500, "MICROSERVICE_INTERNAL_ERROR",
+                        "component has no predict()");
+            }
+        }
+        return respond(model, result, in.kind, meta);
+    }
+
+    @SuppressWarnings("unchecked")
+    public static Map<String, Object> runAggregate(SeldonComponent model,
+                                                   Map<String, Object> request) {
+        Map<String, Object> raw = model.aggregateRaw(request);
+        if (raw != null) return raw;
+
+        Object msgsObj = request.get("seldonMessages");
+        List<Object> msgs = msgsObj instanceof List ? (List<Object>) msgsObj : new ArrayList<>();
+        if (msgs.isEmpty()) {
+            throw new ApiError(400, "EMPTY_AGGREGATE",
+                    "aggregate needs at least one seldonMessage");
+        }
+        List<double[][]> rowsPer = new ArrayList<>();
+        List<List<String>> namesPer = new ArrayList<>();
+        String kind = "ndarray";
+        Map<String, Object> firstMeta = new LinkedHashMap<>();
+        for (int i = 0; i < msgs.size(); i++) {
+            Object m = msgs.get(i);
+            Map<String, Object> msg = m instanceof Map
+                    ? (Map<String, Object>) m : new LinkedHashMap<>();
+            Codec.Decoded d = Codec.decode(msg.get("data"));
+            if (i == 0) {
+                kind = d.kind;
+                firstMeta = metaOf(msg);
+            }
+            rowsPer.add(d.matrix());
+            namesPer.add(d.names);
+        }
+        double[][] out = model.aggregate(rowsPer, namesPer);
+        if (out == null) {
+            throw new ApiError(500, "MICROSERVICE_INTERNAL_ERROR",
+                    "component has no aggregate()");
+        }
+        return respond(model, out, kind, firstMeta);
+    }
+
+    @SuppressWarnings("unchecked")
+    public static Map<String, Object> runFeedback(SeldonComponent model,
+                                                  Map<String, Object> feedback) {
+        Map<String, Object> raw = model.sendFeedbackRaw(feedback);
+        if (raw != null) return raw;
+
+        Map<String, Object> request = feedback.get("request") instanceof Map
+                ? (Map<String, Object>) feedback.get("request") : new LinkedHashMap<>();
+        Map<String, Object> truth = feedback.get("truth") instanceof Map
+                ? (Map<String, Object>) feedback.get("truth") : new LinkedHashMap<>();
+        Map<String, Object> response = feedback.get("response") instanceof Map
+                ? (Map<String, Object>) feedback.get("response") : new LinkedHashMap<>();
+        Codec.Decoded req = Codec.decode(request.get("data"));
+        Codec.Decoded tr = Codec.decode(truth.get("data"));
+        Map<String, Object> respMeta = response.get("meta") instanceof Map
+                ? (Map<String, Object>) response.get("meta") : new LinkedHashMap<>();
+        Map<String, Object> routing = respMeta.get("routing") instanceof Map
+                ? (Map<String, Object>) respMeta.get("routing") : new LinkedHashMap<>();
+        double reward = feedback.get("reward") instanceof Number
+                ? ((Number) feedback.get("reward")).doubleValue() : 0.0;
+        model.sendFeedback(req.matrix(), req.names, reward, tr.matrix(), routing);
+
+        Map<String, Object> out = new LinkedHashMap<>();
+        out.put("meta", buildMeta(model, new LinkedHashMap<>()));
+        return out;
+    }
+
+    public static Map<String, Object> healthStatus(SeldonComponent model) {
+        Map<String, Object> custom = model.healthStatus();
+        if (custom != null) return custom;
+        Map<String, Object> out = new LinkedHashMap<>();
+        Map<String, Object> data = new LinkedHashMap<>();
+        data.put("names", new ArrayList<>());
+        data.put("ndarray", new ArrayList<>());
+        out.put("data", data);
+        out.put("meta", new LinkedHashMap<>());
+        return out;
+    }
+}

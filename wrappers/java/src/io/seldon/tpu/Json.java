@@ -1,0 +1,283 @@
+package io.seldon.tpu;
+
+import java.util.ArrayList;
+import java.util.LinkedHashMap;
+import java.util.List;
+import java.util.Map;
+
+/**
+ * Minimal JSON reader/writer over plain Java types: Map&lt;String,Object&gt;,
+ * List&lt;Object&gt;, String, Double, Boolean, null.
+ *
+ * The wrapper is zero-dependency by design (see wrappers/README.md) —
+ * the reference Java wrapper pulls Spring Boot + Jackson + a generated
+ * proto stack (wrappers/s2i/java/test/model-template-app/src/main/java/
+ * io/seldon/example/App.java:1-16); this one is JDK stdlib only, so the
+ * JSON layer is part of the wrapper.  Numbers are always parsed as
+ * Double (the JSON data model), matching the Node wrapper's semantics.
+ */
+public final class Json {
+
+    private Json() {}
+
+    // ---------------------------------------------------------------- parse
+
+    public static Object parse(String text) {
+        Parser p = new Parser(text);
+        Object v = p.value();
+        p.skipWs();
+        if (!p.atEnd()) {
+            throw new JsonError("trailing characters at offset " + p.pos);
+        }
+        return v;
+    }
+
+    public static final class JsonError extends RuntimeException {
+        public JsonError(String msg) { super(msg); }
+    }
+
+    private static final class Parser {
+        final String s;
+        int pos = 0;
+
+        Parser(String s) { this.s = s; }
+
+        boolean atEnd() { return pos >= s.length(); }
+
+        void skipWs() {
+            while (pos < s.length()) {
+                char c = s.charAt(pos);
+                if (c == ' ' || c == '\t' || c == '\n' || c == '\r') pos++;
+                else break;
+            }
+        }
+
+        char peek() {
+            if (atEnd()) throw new JsonError("unexpected end of input");
+            return s.charAt(pos);
+        }
+
+        void expect(char c) {
+            if (atEnd() || s.charAt(pos) != c) {
+                throw new JsonError("expected '" + c + "' at offset " + pos);
+            }
+            pos++;
+        }
+
+        Object value() {
+            skipWs();
+            char c = peek();
+            switch (c) {
+                case '{': return object();
+                case '[': return array();
+                case '"': return string();
+                case 't': literal("true"); return Boolean.TRUE;
+                case 'f': literal("false"); return Boolean.FALSE;
+                case 'n': literal("null"); return null;
+                default:  return number();
+            }
+        }
+
+        void literal(String lit) {
+            if (!s.startsWith(lit, pos)) {
+                throw new JsonError("invalid literal at offset " + pos);
+            }
+            pos += lit.length();
+        }
+
+        Map<String, Object> object() {
+            expect('{');
+            Map<String, Object> out = new LinkedHashMap<>();
+            skipWs();
+            if (!atEnd() && peek() == '}') { pos++; return out; }
+            while (true) {
+                skipWs();
+                String key = string();
+                skipWs();
+                expect(':');
+                out.put(key, value());
+                skipWs();
+                char c = peek();
+                if (c == ',') { pos++; continue; }
+                if (c == '}') { pos++; return out; }
+                throw new JsonError("expected ',' or '}' at offset " + pos);
+            }
+        }
+
+        List<Object> array() {
+            expect('[');
+            List<Object> out = new ArrayList<>();
+            skipWs();
+            if (!atEnd() && peek() == ']') { pos++; return out; }
+            while (true) {
+                out.add(value());
+                skipWs();
+                char c = peek();
+                if (c == ',') { pos++; continue; }
+                if (c == ']') { pos++; return out; }
+                throw new JsonError("expected ',' or ']' at offset " + pos);
+            }
+        }
+
+        String string() {
+            expect('"');
+            StringBuilder b = new StringBuilder();
+            while (true) {
+                if (atEnd()) throw new JsonError("unterminated string");
+                char c = s.charAt(pos++);
+                if (c == '"') return b.toString();
+                if (c == '\\') {
+                    if (atEnd()) throw new JsonError("unterminated escape");
+                    char e = s.charAt(pos++);
+                    switch (e) {
+                        case '"': b.append('"'); break;
+                        case '\\': b.append('\\'); break;
+                        case '/': b.append('/'); break;
+                        case 'b': b.append('\b'); break;
+                        case 'f': b.append('\f'); break;
+                        case 'n': b.append('\n'); break;
+                        case 'r': b.append('\r'); break;
+                        case 't': b.append('\t'); break;
+                        case 'u':
+                            if (pos + 4 > s.length()) throw new JsonError("bad \\u escape");
+                            try {
+                                b.append((char) Integer.parseInt(s.substring(pos, pos + 4), 16));
+                            } catch (NumberFormatException nfe) {
+                                throw new JsonError("bad \\u escape at offset " + pos);
+                            }
+                            pos += 4;
+                            break;
+                        default: throw new JsonError("bad escape '\\" + e + "'");
+                    }
+                } else {
+                    b.append(c);
+                }
+            }
+        }
+
+        Double number() {
+            int start = pos;
+            if (!atEnd() && (peek() == '-' || peek() == '+')) pos++;
+            while (!atEnd()) {
+                char c = s.charAt(pos);
+                if ((c >= '0' && c <= '9') || c == '.' || c == 'e' || c == 'E'
+                        || c == '-' || c == '+') pos++;
+                else break;
+            }
+            if (pos == start) throw new JsonError("invalid value at offset " + start);
+            try {
+                return Double.parseDouble(s.substring(start, pos));
+            } catch (NumberFormatException e) {
+                throw new JsonError("invalid number at offset " + start);
+            }
+        }
+    }
+
+    // ---------------------------------------------------------------- write
+
+    public static String write(Object v) {
+        StringBuilder b = new StringBuilder();
+        writeTo(b, v);
+        return b.toString();
+    }
+
+    @SuppressWarnings("unchecked")
+    private static void writeTo(StringBuilder b, Object v) {
+        if (v == null) { b.append("null"); return; }
+        if (v instanceof String) { writeString(b, (String) v); return; }
+        if (v instanceof Boolean) { b.append(v); return; }
+        if (v instanceof Number) { writeNumber(b, (Number) v); return; }
+        if (v instanceof Map) {
+            b.append('{');
+            boolean first = true;
+            for (Map.Entry<String, Object> e : ((Map<String, Object>) v).entrySet()) {
+                if (!first) b.append(',');
+                first = false;
+                writeString(b, e.getKey());
+                b.append(':');
+                writeTo(b, e.getValue());
+            }
+            b.append('}');
+            return;
+        }
+        if (v instanceof List) {
+            b.append('[');
+            boolean first = true;
+            for (Object e : (List<Object>) v) {
+                if (!first) b.append(',');
+                first = false;
+                writeTo(b, e);
+            }
+            b.append(']');
+            return;
+        }
+        if (v instanceof double[]) {
+            b.append('[');
+            double[] a = (double[]) v;
+            for (int i = 0; i < a.length; i++) {
+                if (i > 0) b.append(',');
+                writeNumber(b, a[i]);
+            }
+            b.append(']');
+            return;
+        }
+        if (v instanceof double[][]) {
+            b.append('[');
+            double[][] a = (double[][]) v;
+            for (int i = 0; i < a.length; i++) {
+                if (i > 0) b.append(',');
+                writeTo(b, a[i]);
+            }
+            b.append(']');
+            return;
+        }
+        if (v instanceof String[]) {
+            b.append('[');
+            String[] a = (String[]) v;
+            for (int i = 0; i < a.length; i++) {
+                if (i > 0) b.append(',');
+                writeString(b, a[i]);
+            }
+            b.append(']');
+            return;
+        }
+        throw new JsonError("cannot serialize " + v.getClass());
+    }
+
+    private static void writeNumber(StringBuilder b, Number n) {
+        double d = n.doubleValue();
+        if (Double.isNaN(d) || Double.isInfinite(d)) {
+            // JSON has no NaN/Inf; the Python runtime maps them to null
+            b.append("null");
+            return;
+        }
+        if (d == Math.rint(d) && Math.abs(d) < 1e15) {
+            b.append((long) d);   // integral values print without ".0"
+        } else {
+            b.append(d);
+        }
+    }
+
+    private static void writeString(StringBuilder b, String s) {
+        b.append('"');
+        for (int i = 0; i < s.length(); i++) {
+            char c = s.charAt(i);
+            switch (c) {
+                case '"': b.append("\\\""); break;
+                case '\\': b.append("\\\\"); break;
+                case '\b': b.append("\\b"); break;
+                case '\f': b.append("\\f"); break;
+                case '\n': b.append("\\n"); break;
+                case '\r': b.append("\\r"); break;
+                case '\t': b.append("\\t"); break;
+                default:
+                    if (c < 0x20) {
+                        b.append(String.format("\\u%04x", (int) c));
+                    } else {
+                        b.append(c);
+                    }
+            }
+        }
+        b.append('"');
+    }
+}

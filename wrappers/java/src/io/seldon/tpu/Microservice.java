@@ -1,0 +1,296 @@
+package io.seldon.tpu;
+
+import com.sun.net.httpserver.HttpExchange;
+import com.sun.net.httpserver.HttpServer;
+
+import java.io.IOException;
+import java.io.InputStream;
+import java.io.OutputStream;
+import java.net.InetSocketAddress;
+import java.net.URLDecoder;
+import java.nio.charset.StandardCharsets;
+import java.util.LinkedHashMap;
+import java.util.List;
+import java.util.Map;
+import java.util.concurrent.Executors;
+import java.util.concurrent.atomic.AtomicLong;
+import java.util.function.Function;
+
+/**
+ * seldon-tpu Java microservice wrapper.
+ *
+ * Serves a user component (a class implementing
+ * {@link SeldonComponent}) on the same REST contract as the Python
+ * runtime (seldon_core_tpu/runtime/rest.py:6-8):
+ *
+ *   POST /predict /transform-input /transform-output
+ *        /route   /aggregate       /send-feedback
+ *   GET  /health/ping /health/status /metrics
+ *   plus the engine-compatible alias /api/v0.1/predictions
+ *
+ * Reference analogue: the seldon-core-wrapper Spring Boot stack driven
+ * by wrappers/s2i/java/s2i/bin/run:1-60 — re-designed for this
+ * framework: zero dependencies (JDK stdlib HttpServer), one dispatch
+ * layer shared by every role, typed {name,value,type} parameters with
+ * the same contract as the Python CLI (runtime/params.py), graceful
+ * drain on SIGTERM.  gRPC termination for Java components is the native
+ * ingress's job (native/frontserver.cc h2c lane), the same pattern the
+ * C++ remote node uses — protocol neutrality, not a per-language gRPC
+ * stack.
+ *
+ * Usage:
+ *   java -cp build io.seldon.tpu.Microservice io.seldon.example.ExampleModel \
+ *        --service-type MODEL --http-port 9000 \
+ *        --parameters '[{"name":"k","value":"3","type":"INT"}]'
+ */
+public final class Microservice {
+
+    final SeldonComponent model;
+    final String serviceType;
+    final AtomicLong requestsTotal = new AtomicLong();
+    final AtomicLong failuresTotal = new AtomicLong();
+    final long started = System.nanoTime();
+    HttpServer server;
+
+    public Microservice(SeldonComponent model, String serviceType) {
+        this.model = model;
+        this.serviceType = serviceType;
+    }
+
+    // ------------------------------------------------------------ parameters
+
+    @SuppressWarnings("unchecked")
+    public static Map<String, Object> parseParameters(String raw) {
+        // [{name, value, type}] -> kwargs map (reference contract:
+        // PREDICTIVE_UNIT_PARAMETERS; python twin runtime/params.py)
+        Map<String, Object> out = new LinkedHashMap<>();
+        if (raw == null || raw.isEmpty()) return out;
+        Object parsed = Json.parse(raw);
+        if (!(parsed instanceof List)) {
+            throw new IllegalArgumentException("parameters must be a JSON list");
+        }
+        for (Object o : (List<Object>) parsed) {
+            Map<String, Object> p = (Map<String, Object>) o;
+            if (p.get("name") == null) {
+                throw new IllegalArgumentException("parameter missing 'name': " + Json.write(p));
+            }
+            String name = String.valueOf(p.get("name"));
+            String value = p.get("value") == null ? null : String.valueOf(p.get("value"));
+            String type = p.get("type") == null ? "STRING" : String.valueOf(p.get("type"));
+            switch (type) {
+                case "STRING": out.put(name, value); break;
+                case "INT":    out.put(name, (double) Long.parseLong(value)); break;
+                case "FLOAT":
+                case "DOUBLE": out.put(name, Double.parseDouble(value)); break;
+                case "BOOL":
+                    // same truthy set as runtime/params.py:25
+                    String b = value == null ? "" : value.toLowerCase();
+                    out.put(name, b.equals("1") || b.equals("true") || b.equals("yes"));
+                    break;
+                case "JSON":   out.put(name, Json.parse(value)); break;
+                default: throw new IllegalArgumentException("unknown parameter type " + type);
+            }
+        }
+        return out;
+    }
+
+    // --------------------------------------------------------------- serving
+
+    static Map<String, Object> errorBody(int status, String reason, String info) {
+        Map<String, Object> st = new LinkedHashMap<>();
+        st.put("status", "FAILURE");
+        st.put("code", (double) status);
+        st.put("reason", reason);
+        st.put("info", info);
+        Map<String, Object> out = new LinkedHashMap<>();
+        out.put("status", st);
+        return out;
+    }
+
+    @SuppressWarnings("unchecked")
+    static Map<String, Object> parseMessage(String text) {
+        // client payload errors are 400s, including valid-JSON non-objects
+        // (python twin: rest.py's loads-or-400 path)
+        Object parsed;
+        try {
+            parsed = Json.parse(text);
+        } catch (Json.JsonError e) {
+            throw new Dispatch.ApiError(400, "BAD_REQUEST", "invalid JSON: " + e.getMessage());
+        }
+        if (!(parsed instanceof Map)) {
+            throw new Dispatch.ApiError(400, "BAD_REQUEST",
+                    "request body must be a JSON object");
+        }
+        return (Map<String, Object>) parsed;
+    }
+
+    Map<String, Object> readMessage(HttpExchange ex) throws IOException {
+        byte[] body;
+        try (InputStream in = ex.getRequestBody()) {
+            body = in.readAllBytes();
+        }
+        String text = new String(body, StandardCharsets.UTF_8);
+        if (text.isEmpty()) {
+            String query = ex.getRequestURI().getRawQuery();
+            String q = queryParam(query, "json");
+            if (q != null) return parseMessage(q);
+            throw new Dispatch.ApiError(400, "BAD_REQUEST", "empty request body");
+        }
+        List<String> ct = ex.getRequestHeaders().get("Content-type");
+        if (ct != null && !ct.isEmpty() && ct.get(0).contains("form-urlencoded")) {
+            String q = queryParam(text, "json");
+            if (q != null) return parseMessage(q);
+        }
+        return parseMessage(text);
+    }
+
+    static String queryParam(String query, String key) throws IOException {
+        if (query == null) return null;
+        for (String pair : query.split("&")) {
+            int eq = pair.indexOf('=');
+            if (eq > 0 && pair.substring(0, eq).equals(key)) {
+                return URLDecoder.decode(pair.substring(eq + 1), StandardCharsets.UTF_8);
+            }
+        }
+        return null;
+    }
+
+    void send(HttpExchange ex, int code, String body, String type) throws IOException {
+        byte[] bytes = body.getBytes(StandardCharsets.UTF_8);
+        ex.getResponseHeaders().set("Content-Type", type);
+        ex.sendResponseHeaders(code, bytes.length);
+        try (OutputStream os = ex.getResponseBody()) {
+            os.write(bytes);
+        }
+    }
+
+    void handle(HttpExchange ex, Function<Map<String, Object>, Map<String, Object>> fn)
+            throws IOException {
+        requestsTotal.incrementAndGet();
+        try {
+            Map<String, Object> message = readMessage(ex);
+            send(ex, 200, Json.write(fn.apply(message)), "application/json");
+        } catch (Dispatch.ApiError e) {
+            failuresTotal.incrementAndGet();
+            send(ex, e.status, Json.write(errorBody(e.status, e.reason, e.getMessage())),
+                    "application/json");
+        } catch (Exception e) {
+            failuresTotal.incrementAndGet();
+            send(ex, 500, Json.write(errorBody(500, "MICROSERVICE_INTERNAL_ERROR",
+                    String.valueOf(e))), "application/json");
+        }
+    }
+
+    String metricsText() {
+        // prometheus text format, reference metric naming
+        // (utils/metrics.py; doc/source/analytics/analytics.md:9-16)
+        double up = (System.nanoTime() - started) / 1e9;
+        return "# TYPE seldon_api_wrapper_requests_total counter\n"
+                + "seldon_api_wrapper_requests_total{service_type=\"" + serviceType + "\"} "
+                + requestsTotal.get() + "\n"
+                + "# TYPE seldon_api_wrapper_failures_total counter\n"
+                + "seldon_api_wrapper_failures_total{service_type=\"" + serviceType + "\"} "
+                + failuresTotal.get() + "\n"
+                + "# TYPE seldon_api_wrapper_uptime_seconds gauge\n"
+                + "seldon_api_wrapper_uptime_seconds " + up + "\n";
+    }
+
+    public HttpServer start(String host, int port) throws IOException {
+        server = HttpServer.create(new InetSocketAddress(host, port), 128);
+        // daemon threads: HttpServer.stop() does not shut down a
+        // user-supplied executor, and an embedder (the contract test)
+        // must be able to exit after stop()
+        server.setExecutor(Executors.newFixedThreadPool(
+                Math.max(2, Runtime.getRuntime().availableProcessors()),
+                r -> {
+                    Thread t = new Thread(r, "microservice-worker");
+                    t.setDaemon(true);
+                    return t;
+                }));
+
+        // HttpServer contexts prefix-match, which would serve /predictX
+        // from the /predict handler; the Python runtime and nodejs
+        // wrapper route exact paths, so dispatch from one root context
+        Map<String, Function<Map<String, Object>, Map<String, Object>>> routes =
+                new LinkedHashMap<>();
+        routes.put("/predict", m -> Dispatch.runMessage(model, "predict", m));
+        routes.put("/api/v0.1/predictions", m -> Dispatch.runMessage(model, "predict", m));
+        routes.put("/transform-input", m -> Dispatch.runMessage(model, "transform_input", m));
+        routes.put("/transform-output", m -> Dispatch.runMessage(model, "transform_output", m));
+        routes.put("/route", m -> Dispatch.runMessage(model, "route", m));
+        routes.put("/aggregate", m -> Dispatch.runAggregate(model, m));
+        routes.put("/send-feedback", m -> Dispatch.runFeedback(model, m));
+
+        server.createContext("/", ex -> {
+            String path = ex.getRequestURI().getPath();
+            if (path.equals("/health/ping")) {
+                send(ex, 200, "pong", "text/plain");
+            } else if (path.equals("/health/status")) {
+                send(ex, 200, Json.write(Dispatch.healthStatus(model)), "application/json");
+            } else if (path.equals("/metrics")) {
+                send(ex, 200, metricsText(), "text/plain");
+            } else if (routes.containsKey(path)) {
+                handle(ex, routes.get(path));
+            } else {
+                send(ex, 404, Json.write(errorBody(404, "NOT_FOUND", "no route " + path)),
+                        "application/json");
+            }
+        });
+
+        server.start();
+        return server;
+    }
+
+    // ------------------------------------------------------------------ main
+
+    static void usageExit(String why) {
+        System.err.println(why);
+        System.err.println("usage: java io.seldon.tpu.Microservice <component.Class> "
+                + "[--service-type T] [--http-port P] [--host H] [--parameters JSON]");
+        System.exit(2);
+    }
+
+    public static void main(String[] args) throws Exception {
+        String componentClass = null;
+        String serviceType = "MODEL";
+        String host = "0.0.0.0";
+        String portEnv = System.getenv("PREDICTIVE_UNIT_SERVICE_PORT");
+        int port = portEnv != null ? Integer.parseInt(portEnv) : 9000;
+        Map<String, Object> parameters =
+                parseParameters(System.getenv("PREDICTIVE_UNIT_PARAMETERS"));
+
+        for (int i = 0; i < args.length; i++) {
+            boolean isFlag = args[i].startsWith("--");
+            if (isFlag && i + 1 >= args.length) {
+                usageExit("missing value for " + args[i]);
+            }
+            switch (args[i]) {
+                case "--service-type": serviceType = args[++i]; break;
+                case "--http-port":    port = Integer.parseInt(args[++i]); break;
+                case "--host":         host = args[++i]; break;
+                case "--parameters":   parameters = parseParameters(args[++i]); break;
+                case "--api":          ++i; break;   // REST only; gRPC is the native ingress's job
+                default:
+                    if (isFlag) usageExit("unknown flag " + args[i]);
+                    if (componentClass == null) componentClass = args[i];
+            }
+        }
+        if (componentClass == null) {
+            usageExit("missing component class");
+        }
+
+        SeldonComponent model = (SeldonComponent)
+                Class.forName(componentClass).getDeclaredConstructor().newInstance();
+        model.init(parameters);
+
+        Microservice svc = new Microservice(model, serviceType);
+        svc.start(host, port);
+        System.out.println("seldon-tpu java microservice (" + serviceType + ") on "
+                + host + ":" + port);
+
+        // graceful drain: stop accepting, let in-flight requests finish
+        // (reference analogue: engine /pause + Tomcat drain, App.java:60-97)
+        Runtime.getRuntime().addShutdownHook(new Thread(() -> svc.server.stop(5)));
+        Thread.currentThread().join();
+    }
+}

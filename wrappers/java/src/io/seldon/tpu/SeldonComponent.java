@@ -1,0 +1,78 @@
+package io.seldon.tpu;
+
+import java.util.List;
+import java.util.Map;
+
+/**
+ * The component a user implements to serve a graph node from Java —
+ * the Java twin of the Python duck-typed API
+ * (seldon_core_tpu/runtime/component.py) and the Node wrapper's class
+ * contract (wrappers/nodejs/model.example.mjs).  Reference analogue:
+ * io.seldon.wrapper.api.SeldonPredictionService (used by
+ * wrappers/s2i/java/test/model-template-app/.../ExampleModelHandler.java:12-19),
+ * re-designed: no Spring, no proto types — default methods returning
+ * null mean "not implemented" and the dispatch layer falls through,
+ * the same algebra as runtime/dispatch.py.
+ *
+ * Two levels per role, checked in order (raw wins):
+ *   raw   — full JSON message in, full JSON message out
+ *           (Map&lt;String,Object&gt; with the SeldonMessage layout)
+ *   typed — double[][] rows in, double[][] rows out
+ */
+public interface SeldonComponent {
+
+    /** Called once after construction with the typed parameters. */
+    default void init(Map<String, Object> parameters) {}
+
+    // ------------------------------------------------------------- raw level
+
+    default Map<String, Object> predictRaw(Map<String, Object> message) { return null; }
+
+    default Map<String, Object> transformInputRaw(Map<String, Object> message) { return null; }
+
+    default Map<String, Object> transformOutputRaw(Map<String, Object> message) { return null; }
+
+    default Map<String, Object> routeRaw(Map<String, Object> message) { return null; }
+
+    default Map<String, Object> aggregateRaw(Map<String, Object> request) { return null; }
+
+    default Map<String, Object> sendFeedbackRaw(Map<String, Object> feedback) { return null; }
+
+    // ----------------------------------------------------------- typed level
+
+    default double[][] predict(double[][] rows, List<String> names, Map<String, Object> meta) {
+        return null;
+    }
+
+    default double[][] transformInput(double[][] rows, List<String> names, Map<String, Object> meta) {
+        return null;
+    }
+
+    default double[][] transformOutput(double[][] rows, List<String> names, Map<String, Object> meta) {
+        return null;
+    }
+
+    /** Return the child index to route to; -1 sends to all. */
+    default int route(double[][] rows, List<String> names) { return -1; }
+
+    default double[][] aggregate(List<double[][]> rowsPerInput, List<List<String>> namesPerInput) {
+        return null;
+    }
+
+    default void sendFeedback(double[][] requestRows, List<String> names, double reward,
+                              double[][] truthRows, Map<String, Object> routing) {}
+
+    // -------------------------------------------------------------- metadata
+
+    /** Extra meta.tags merged into every response. */
+    default Map<String, Object> tags() { return null; }
+
+    /** Custom metrics: [{"key","type":COUNTER|GAUGE|TIMER,"value"}]. */
+    default List<Map<String, Object>> metrics() { return null; }
+
+    /** Output class names; defaults to t:0..t:n-1. */
+    default List<String> classNames() { return null; }
+
+    /** Body of GET /health/status. */
+    default Map<String, Object> healthStatus() { return null; }
+}

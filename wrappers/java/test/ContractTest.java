@@ -1,0 +1,162 @@
+import io.seldon.example.ExampleModel;
+import io.seldon.tpu.Codec;
+import io.seldon.tpu.Dispatch;
+import io.seldon.tpu.Json;
+import io.seldon.tpu.Microservice;
+
+import java.net.URI;
+import java.net.http.HttpClient;
+import java.net.http.HttpRequest;
+import java.net.http.HttpResponse;
+import java.util.ArrayList;
+import java.util.Arrays;
+import java.util.LinkedHashMap;
+import java.util.List;
+import java.util.Map;
+
+/**
+ * Contract tests for the Java wrapper — plain main() with asserts so
+ * no JUnit dependency is needed (zero-dependency rule).  Mirrors the
+ * tier-1 strategy of the Python suite (tests/test_runtime_rest.py;
+ * reference python/tests/test_model_microservice.py:212-717):
+ * in-process server, every payload dialect, meta propagation, error
+ * statuses.  Run: javac -d build src/io/seldon/tpu/*.java
+ * src/io/seldon/example/*.java test/ContractTest.java && java -cp
+ * build:test ContractTest   (driven by tests/test_wrappers.py when a
+ * JDK exists in the image).
+ */
+public final class ContractTest {
+
+    static int passed = 0;
+
+    static void check(boolean cond, String what) {
+        if (!cond) throw new AssertionError("FAILED: " + what);
+        passed++;
+    }
+
+    @SuppressWarnings("unchecked")
+    static Map<String, Object> obj(String json) {
+        return (Map<String, Object>) Json.parse(json);
+    }
+
+    @SuppressWarnings("unchecked")
+    static <T> T get(Object m, String... path) {
+        Object cur = m;
+        for (String k : path) cur = ((Map<String, Object>) cur).get(k);
+        return (T) cur;
+    }
+
+    public static void main(String[] args) throws Exception {
+        codecRoundTrips();
+        predictContract();
+        tensorDialectPreserved();
+        feedbackContract();
+        parameterContract();
+        httpSurface();
+        System.out.println("ok: " + passed + " checks passed");
+    }
+
+    static void codecRoundTrips() {
+        Codec.Decoded d = Codec.decode(get(obj(
+                "{\"data\":{\"tensor\":{\"shape\":[2,2],\"values\":[1,2,3,4]}}}"), "data"));
+        check(d.kind.equals("tensor"), "tensor kind detected");
+        double[][] m = d.matrix();
+        check(m[1][0] == 3.0, "unflatten row-major");
+        Map<String, Object> enc = Codec.encode(m, Arrays.asList("a", "b"), "tensor");
+        List<Object> values = get(enc, "tensor", "values");
+        check(values.size() == 4 && ((Number) values.get(3)).doubleValue() == 4.0,
+                "tensor re-encode round-trips");
+    }
+
+    static void predictContract() {
+        ExampleModel model = new ExampleModel();
+        model.init(new LinkedHashMap<>());
+        Map<String, Object> out = Dispatch.runMessage(model, "predict",
+                obj("{\"data\":{\"ndarray\":[[1,2,3]]},\"meta\":{\"puid\":\"abc\"}}"));
+        List<String> names = get(out, "data", "names");
+        check(names.get(0).equals("score"), "class names from component");
+        check("abc".equals(ContractTest.<Object>get(out, "meta", "puid")), "puid propagates");
+        check("java".equals(ContractTest.<Object>get(out, "meta", "tags", "wrapper")),
+                "tags merged into meta");
+        List<Map<String, Object>> metrics = get(out, "meta", "metrics");
+        check(metrics.get(0).get("type").equals("COUNTER"), "metrics merged into meta");
+    }
+
+    static void tensorDialectPreserved() {
+        ExampleModel model = new ExampleModel();
+        Map<String, Object> out = Dispatch.runMessage(model, "predict",
+                obj("{\"data\":{\"tensor\":{\"shape\":[1,2],\"values\":[4,6]}}}"));
+        List<Object> shape = get(out, "data", "tensor", "shape");
+        check(((Number) shape.get(1)).intValue() == 2,
+                "tensor dialect preserved in response");
+    }
+
+    static void feedbackContract() {
+        final double[] seen = {Double.NaN};
+        ExampleModel model = new ExampleModel() {
+            @Override
+            public void sendFeedback(double[][] rows, List<String> names, double reward,
+                                     double[][] truth, Map<String, Object> routing) {
+                seen[0] = reward + rows[0][0];
+            }
+        };
+        Dispatch.runFeedback(model,
+                obj("{\"request\":{\"data\":{\"ndarray\":[[1]]}},\"reward\":0.5}"));
+        check(seen[0] == 1.5, "feedback reaches sendFeedback with rows+reward");
+    }
+
+    static void parameterContract() {
+        Map<String, Object> p = Microservice.parseParameters(
+                "[{\"name\":\"k\",\"value\":\"3\",\"type\":\"INT\"},"
+                + "{\"name\":\"s\",\"value\":\"[4]\",\"type\":\"JSON\"},"
+                + "{\"name\":\"b\",\"value\":\"true\",\"type\":\"BOOL\"},"
+                + "{\"name\":\"f\",\"value\":\"1.5\",\"type\":\"FLOAT\"}]");
+        check(((Number) p.get("k")).intValue() == 3, "INT parameter casts");
+        check(((List<?>) p.get("s")).size() == 1, "JSON parameter parses");
+        check(Boolean.TRUE.equals(p.get("b")), "BOOL parameter casts");
+        check(((Number) p.get("f")).doubleValue() == 1.5, "FLOAT parameter casts");
+    }
+
+    @SuppressWarnings("unchecked")
+    static void httpSurface() throws Exception {
+        ExampleModel model = new ExampleModel();
+        model.init(new LinkedHashMap<>());
+        Microservice svc = new Microservice(model, "MODEL");
+        com.sun.net.httpserver.HttpServer server = svc.start("127.0.0.1", 0);
+        int port = server.getAddress().getPort();
+        HttpClient client = HttpClient.newHttpClient();
+        try {
+            HttpResponse<String> ping = client.send(HttpRequest.newBuilder(
+                            URI.create("http://127.0.0.1:" + port + "/health/ping")).GET().build(),
+                    HttpResponse.BodyHandlers.ofString());
+            check(ping.statusCode() == 200 && ping.body().equals("pong"), "/health/ping");
+
+            HttpResponse<String> pred = client.send(HttpRequest.newBuilder(
+                            URI.create("http://127.0.0.1:" + port + "/api/v0.1/predictions"))
+                    .POST(HttpRequest.BodyPublishers.ofString(
+                            "{\"data\":{\"ndarray\":[[2,4]]}}")).build(),
+                    HttpResponse.BodyHandlers.ofString());
+            check(pred.statusCode() == 200, "engine alias serves predict");
+            Map<String, Object> body = obj(pred.body());
+            List<Object> nd = get(body, "data", "ndarray");
+            List<Object> row = (List<Object>) nd.get(0);
+            check(((Number) row.get(0)).doubleValue() == 3.0, "prediction value correct");
+
+            HttpResponse<String> bad = client.send(HttpRequest.newBuilder(
+                            URI.create("http://127.0.0.1:" + port + "/predict"))
+                    .POST(HttpRequest.BodyPublishers.ofString("{nope")).build(),
+                    HttpResponse.BodyHandlers.ofString());
+            check(bad.statusCode() == 400, "bad JSON -> 400");
+            check("FAILURE".equals(ContractTest.<Object>get(obj(bad.body()), "status", "status")),
+                    "FAILURE envelope on error");
+
+            HttpResponse<String> metrics = client.send(HttpRequest.newBuilder(
+                            URI.create("http://127.0.0.1:" + port + "/metrics")).GET().build(),
+                    HttpResponse.BodyHandlers.ofString());
+            check(metrics.body().contains("seldon_api_wrapper_requests_total"),
+                    "prometheus metrics exposed");
+        } finally {
+            server.stop(0);
+        }
+    }
+}
