@@ -163,9 +163,12 @@ def _request_logger_from_annotations(annotations):
       collector (e.g. ``seldon-tpu-reqlog serve``)
     * ``seldon.io/request-log-jsonl`` — append to a local JSONL file
       (ingestable by ``seldon-tpu-reqlog ingest``)
+    * ``seldon.io/request-log-kafka`` — ``brokers/topic`` streamed via
+      KafkaPairLogger (reference: the kafka/ integration manifests)
     """
     url = str(annotations.get("seldon.io/request-log-url", "") or "")
     path = str(annotations.get("seldon.io/request-log-jsonl", "") or "")
+    kafka = str(annotations.get("seldon.io/request-log-kafka", "") or "")
     if url:
         from seldon_core_tpu.utils.reqlogger import HttpPairLogger
 
@@ -174,6 +177,15 @@ def _request_logger_from_annotations(annotations):
         from seldon_core_tpu.utils.reqlogger import JsonlPairLogger
 
         return JsonlPairLogger(path)
+    if kafka:
+        from seldon_core_tpu.utils.reqlogger import KafkaPairLogger
+
+        brokers, _, topic = kafka.rpartition("/")
+        if not brokers or not topic:
+            raise DeploymentSpecError(
+                "seldon.io/request-log-kafka must be 'brokers/topic', "
+                f"got {kafka!r}")
+        return KafkaPairLogger(bootstrap_servers=brokers, topic=topic)
     return None
 
 

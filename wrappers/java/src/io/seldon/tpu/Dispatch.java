@@ -98,19 +98,20 @@ public final class Dispatch {
             return out;
         }
 
+        double[][] rows = in.matrix();
         double[][] result;
         if (method.equals("transform_input")) {
-            result = model.transformInput(in.matrix(), in.names, meta);
+            result = model.transformInput(rows, in.names, meta);
             if (result == null) {
                 // MODEL used as input transformer passes through predict
-                result = model.predict(in.matrix(), in.names, meta);
+                result = model.predict(rows, in.names, meta);
             }
-            if (result == null) result = in.matrix();           // identity
+            if (result == null) result = rows;                  // identity
         } else if (method.equals("transform_output")) {
-            result = model.transformOutput(in.matrix(), in.names, meta);
-            if (result == null) result = in.matrix();           // identity
+            result = model.transformOutput(rows, in.names, meta);
+            if (result == null) result = rows;                  // identity
         } else {
-            result = model.predict(in.matrix(), in.names, meta);
+            result = model.predict(rows, in.names, meta);
             if (result == null) {
                 throw new ApiError(500, "MICROSERVICE_INTERNAL_ERROR",
                         "component has no predict()");

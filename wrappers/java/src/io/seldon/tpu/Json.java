@@ -37,8 +37,14 @@ public final class Json {
     }
 
     private static final class Parser {
+        // recursion bound: a pathological body of repeated '[' must hit
+        // JsonError, not StackOverflowError (which would escape the
+        // handler's catch and drop the connection)
+        static final int MAX_DEPTH = 512;
+
         final String s;
         int pos = 0;
+        int depth = 0;
 
         Parser(String s) { this.s = s; }
 
@@ -65,16 +71,23 @@ public final class Json {
         }
 
         Object value() {
-            skipWs();
-            char c = peek();
-            switch (c) {
-                case '{': return object();
-                case '[': return array();
-                case '"': return string();
-                case 't': literal("true"); return Boolean.TRUE;
-                case 'f': literal("false"); return Boolean.FALSE;
-                case 'n': literal("null"); return null;
-                default:  return number();
+            if (++depth > MAX_DEPTH) {
+                throw new JsonError("nesting deeper than " + MAX_DEPTH);
+            }
+            try {
+                skipWs();
+                char c = peek();
+                switch (c) {
+                    case '{': return object();
+                    case '[': return array();
+                    case '"': return string();
+                    case 't': literal("true"); return Boolean.TRUE;
+                    case 'f': literal("false"); return Boolean.FALSE;
+                    case 'n': literal("null"); return null;
+                    default:  return number();
+                }
+            } finally {
+                depth--;
             }
         }
 
