@@ -588,12 +588,16 @@ class JaxServer(TPUComponent):
             return jax.lax.fori_loop(0, n, body, jnp.zeros((), jnp.float32))
 
         run_jit = jax.jit(run)
-        run_jit(self.variables, data, iters_small).block_until_ready()  # compile
+        # completion barrier = fetch the scalar: on this harness's
+        # backend block_until_ready can return before execution
+        # finishes (docs/architecture.md "dispatch modes"); the fetch
+        # RTT is constant and cancels in the two-point subtraction
+        float(run_jit(self.variables, data, iters_small))  # compile
         t0 = time.perf_counter()
-        run_jit(self.variables, data, iters_small).block_until_ready()
+        float(run_jit(self.variables, data, iters_small))
         dt_small = time.perf_counter() - t0
         t0 = time.perf_counter()
-        run_jit(self.variables, data, iters_big).block_until_ready()
+        float(run_jit(self.variables, data, iters_big))
         dt_big = time.perf_counter() - t0
         compute = dt_big - dt_small
         if compute <= 1e-4:  # degenerate timing (clock noise): raw rate

@@ -89,7 +89,15 @@ def _build_modules():
 
             import os as _os
 
-            kernel_mode = _os.environ.get("SELDON_TPU_PAGED_KERNEL", "1")
+            # default OFF since r4's honest re-measurement: with
+            # value-fetch timing barriers and two-point marginal cost,
+            # XLA's gather path decodes at 1,127 us/step vs the pallas
+            # kernels' 1,345 (stream) / 1,604 (grid) at B=16 d512/L8,
+            # and the three are tied end-to-end at serving scale (3.4-
+            # 3.5k tok/s).  The kernels stay opt-in
+            # (SELDON_TPU_PAGED_KERNEL=1/force + *_IMPL=stream|grid)
+            # for toolchains where Mosaic's DMA issue overhead drops.
+            kernel_mode = _os.environ.get("SELDON_TPU_PAGED_KERNEL", "0")
             use_kernel = (
                 seg_len == 1
                 and self.decode_kernel

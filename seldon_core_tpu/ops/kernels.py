@@ -373,6 +373,132 @@ def _paged_decode_kernel(tables_ref, lens_ref, q_ref, k_ref, v_ref,
         acc_ref[0] = acc_ref[0] * alpha[:, None] + pv_dot
 
 
+def _paged_decode_kernel_stream(tables_ref, lens_ref, q_ref, pk_hbm, pv_hbm,
+                                acc_ref, m_ref, l_ref, *, page_size, heads,
+                                head_dim):
+    """One slot of streaming flash-decoding: grid=(B,), K/V stay in HBM
+    and each slot's live pages arrive via double-buffered manual DMA.
+
+    Why this beats the (B, P) grid kernel (measured on chip, see
+    docs/architecture.md): that kernel pays a Mosaic grid-step per
+    (slot, page) — B x P x layers ~ 1,000 grid steps per decode step —
+    and its BlockSpec fetches every page in the sliced table even past
+    ``length`` (pl.when skips the compute, not the DMA).  Here the page
+    loop is a fori_loop bounded by the slot's OWN page count, so short
+    streams stop paying max-length HBM traffic, and the next page's DMA
+    overlaps the current page's compute.
+
+    Everything stays in the pool's flattened (ps, h*hd) layout — Mosaic
+    supports neither value shape-casts nor batched dots, so the
+    per-head score/weighted-sum contractions are done as block-diagonal
+    MXU matmuls: ``s = k @ QB`` with QB[r, c] = q[c, r - c*hd] masked to
+    its head's block, and the weighted value sum via ``w @ E`` where
+    E[c, r] = [r // hd == c] expands per-head weights across lanes.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b = pl.program_id(0)
+    h, hd = heads, head_dim
+    D = h * hd
+    length = lens_ref[b]
+    n_pages = jax.lax.div(length + page_size - 1, page_size)
+
+    def body(k_scratch, v_scratch, sems):
+        def dma(pool, scratch, slot, i, which):
+            return pltpu.make_async_copy(
+                pool.at[tables_ref[b, i]], scratch.at[slot],
+                sems.at[slot, which],
+            )
+
+        @pl.when(n_pages > 0)
+        def _warmup():
+            dma(pk_hbm, k_scratch, 0, 0, 0).start()
+            dma(pv_hbm, v_scratch, 0, 0, 1).start()
+
+        qflat = q_ref[0, 0].astype(jnp.float32)       # (D,), pre-scaled
+        # block-diagonal projectors, built once per slot
+        r_over = jax.lax.broadcasted_iota(jnp.int32, (D, h), 0) // hd
+        c_idx = jax.lax.broadcasted_iota(jnp.int32, (D, h), 1)
+        qb = jnp.where(r_over == c_idx, qflat[:, None], 0.0)      # (D, h)
+        e_r = jax.lax.broadcasted_iota(jnp.int32, (h, D), 1) // hd
+        e_c = jax.lax.broadcasted_iota(jnp.int32, (h, D), 0)
+        expand = jnp.where(e_r == e_c, 1.0, 0.0)                  # (h, D)
+
+        max_pages = tables_ref.shape[1]
+
+        def loop(i, carry):
+            m_prev, l_prev, acc = carry               # (h,), (h,), (D,)
+            slot = jax.lax.rem(i, 2)
+            nxt = jax.lax.rem(i + 1, 2)
+
+            @pl.when(i + 1 < n_pages)
+            def _prefetch():
+                dma(pk_hbm, k_scratch, nxt, i + 1, 0).start()
+                dma(pv_hbm, v_scratch, nxt, i + 1, 1).start()
+
+            @pl.when(i < n_pages)
+            def _wait():
+                dma(pk_hbm, k_scratch, slot, i, 0).wait()
+                dma(pv_hbm, v_scratch, slot, i, 1).wait()
+
+            k = k_scratch[slot].astype(jnp.float32)   # (ps, D)
+            v = v_scratch[slot].astype(jnp.float32)
+            # HIGHEST: a default-precision f32 dot runs as bf16 MXU
+            # passes and costs ~0.05 absolute score error (measured
+            # against a float64 host reference; the grid kernel's VPU
+            # reduce is exact) — these dots are tiny, so full precision
+            # is free
+            s = jnp.dot(k, qb, preferred_element_type=jnp.float32,
+                        precision=jax.lax.Precision.HIGHEST)  # (ps, h)
+            pos = i * page_size + jax.lax.broadcasted_iota(
+                jnp.int32, (page_size, 1), 0)
+            s = jnp.where(pos < length, s, -jnp.inf)
+            m_new = jnp.maximum(m_prev, jnp.max(s, axis=0))         # (h,)
+            alpha = jnp.exp(m_prev - m_new)
+            w = jnp.exp(s - m_new[None, :])           # (ps, h); dead rows 0
+            l_new = l_prev * alpha + w.sum(axis=0)
+            w_exp = jnp.dot(w, expand, preferred_element_type=jnp.float32,
+                            precision=jax.lax.Precision.HIGHEST)
+            alpha_exp = jnp.dot(alpha[None, :], expand,
+                                preferred_element_type=jnp.float32,
+                                precision=jax.lax.Precision.HIGHEST)[0]
+            acc = acc * alpha_exp + (v * w_exp).sum(axis=0)         # (D,)
+            return m_new, l_new, acc
+
+        def guarded(i, carry):
+            # static trip count (Mosaic pipelines it far better than a
+            # data-dependent bound); masked iterations skip BOTH the
+            # DMA and the flash update
+            new = loop(i, carry)
+            keep = i < n_pages
+            return tuple(
+                jnp.where(keep, n, c) for n, c in zip(new, carry)
+            )
+
+        init = (
+            jnp.full((h,), -jnp.inf, jnp.float32),
+            jnp.zeros((h,), jnp.float32),
+            jnp.zeros((D,), jnp.float32),
+        )
+        m_fin, l_fin, acc_fin = jax.lax.fori_loop(0, max_pages, guarded, init)
+        acc_ref[0, 0] = acc_fin
+        # m/l lane-padded to (h, 128): Mosaic wants 128-divisible last
+        # block dims; every lane carries the same value
+        m_ref[0] = jnp.broadcast_to(m_fin[:, None], m_ref.shape[1:])
+        l_ref[0] = jnp.broadcast_to(l_fin[:, None], l_ref.shape[1:])
+
+    pool_dtype = pk_hbm.dtype
+    pl.run_scoped(
+        body,
+        k_scratch=pltpu.VMEM((2, page_size, D), pool_dtype),
+        v_scratch=pltpu.VMEM((2, page_size, D), pool_dtype),
+        sems=pltpu.SemaphoreType.DMA((2, 2)),
+    )
+
+
 def paged_attention_decode(q, pk, pv, block_tables, lengths, *, page_size):
     """Unnormalised flash state of decode attention over a paged pool.
 
@@ -384,11 +510,19 @@ def paged_attention_decode(q, pk, pv, block_tables, lengths, *, page_size):
     TPU-first replacement for the ``pk[block_tables]`` gather in
     ``PagedTransformerBlock`` (models/paged.py): the gather copies the
     whole live cache through HBM per layer per step; here pages stream
-    HBM->VMEM once, indexed by the scalar-prefetched block table
+    HBM->VMEM, indexed by the scalar-prefetched block table
     (the vLLM paged-attention idea recast in pallas; reference has no
     counterpart — it is pre-LLM).
+
+    Two implementations, selected by ``SELDON_TPU_PAGED_KERNEL_IMPL``:
+
+    * ``stream`` (default) — grid=(B,), double-buffered manual DMA,
+      page loop bounded by each slot's own length.
+    * ``grid`` — the original (B, P) grid with block-table BlockSpecs;
+      kept for A/B measurement (tools/profile_paged_step.py).
     """
     import functools
+    import os
 
     import jax
     import jax.numpy as jnp
@@ -403,6 +537,56 @@ def paged_attention_decode(q, pk, pv, block_tables, lengths, *, page_size):
             f"page_size={page_size} does not match the pool's page dim {ps}"
         )
 
+    impl = os.environ.get("SELDON_TPU_PAGED_KERNEL_IMPL", "stream")
+    if impl == "stream" and (h * hd) % 128 != 0 and not _use_interpret():
+        # the stream kernel DMAs (ps, h*hd) page slices and Mosaic
+        # requires a 128-aligned minor dim; tiny models (h*hd < 128)
+        # take the grid kernel instead
+        impl = "grid"
+
+    if impl == "stream":
+        D = h * hd
+        grid_spec = pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,  # tables, lengths
+            grid=(B,),
+            in_specs=[
+                # q/acc ride as (B, 1, D) with (1, 1, D) blocks: the
+                # (8, 128) divisibility rule applies to the LAST TWO
+                # dims, and the singleton middle dim satisfies it
+                pl.BlockSpec((1, 1, D), lambda b, tables, lens: (b, 0, 0)),
+                pl.BlockSpec(memory_space=pl.ANY),
+                pl.BlockSpec(memory_space=pl.ANY),
+            ],
+            out_specs=[
+                pl.BlockSpec((1, 1, D), lambda b, tables, lens: (b, 0, 0)),
+                pl.BlockSpec((1, h, 128), lambda b, tables, lens: (b, 0, 0)),
+                pl.BlockSpec((1, h, 128), lambda b, tables, lens: (b, 0, 0)),
+            ],
+        )
+        kernel = functools.partial(
+            _paged_decode_kernel_stream, page_size=ps, heads=h, head_dim=hd)
+        # the kernel works in the pool's flattened (ps, h*hd) layout:
+        # HBM page slices need a 128-aligned minor dim and Mosaic has no
+        # value shape-casts; these reshapes are free minor-dims collapses
+        q = q.reshape(B, 1, D)
+        pk = pk.reshape(pk.shape[0], ps, D)
+        pv = pv.reshape(pv.shape[0], ps, D)
+        acc, m, l = pl.pallas_call(
+            kernel,
+            grid_spec=grid_spec,
+            out_shape=[
+                jax.ShapeDtypeStruct((B, 1, D), jnp.float32),
+                jax.ShapeDtypeStruct((B, h, 128), jnp.float32),
+                jax.ShapeDtypeStruct((B, h, 128), jnp.float32),
+            ],
+            interpret=_use_interpret(),
+        )(block_tables, lengths, q, pk, pv)
+        return acc.reshape(B, h, hd), m[:, :, 0], l[:, :, 0]
+
+    if impl != "grid":
+        raise ValueError(
+            f"unknown SELDON_TPU_PAGED_KERNEL_IMPL {impl!r}: use 'stream' or 'grid'"
+        )
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,  # tables, lengths
         grid=(B, P),

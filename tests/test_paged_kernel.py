@@ -30,7 +30,9 @@ def _dense_reference(q, pk, pv, tables, lengths):
     return jnp.einsum("bhk,bkhd->bhd", w, gv), m, w.sum(-1)
 
 
-def test_kernel_matches_dense_flash_state():
+@pytest.mark.parametrize("impl", ["stream", "grid"])
+def test_kernel_matches_dense_flash_state(impl, monkeypatch):
+    monkeypatch.setenv("SELDON_TPU_PAGED_KERNEL_IMPL", impl)
     rng = np.random.default_rng(0)
     B, h, hd, ps, P, num_pages = 4, 8, 64, 16, 4, 32
     q = jnp.asarray(rng.normal(size=(B, h, hd)).astype(np.float32))
@@ -52,7 +54,9 @@ def test_kernel_matches_dense_flash_state():
     )
 
 
-def test_kernel_zero_length_lane_is_finite():
+@pytest.mark.parametrize("impl", ["stream", "grid"])
+def test_kernel_zero_length_lane_is_finite(impl, monkeypatch):
+    monkeypatch.setenv("SELDON_TPU_PAGED_KERNEL_IMPL", impl)
     rng = np.random.default_rng(1)
     B, h, hd, ps, P, num_pages = 2, 4, 32, 8, 2, 8
     q = jnp.asarray(rng.normal(size=(B, h, hd)).astype(np.float32))
@@ -69,6 +73,39 @@ def test_kernel_zero_length_lane_is_finite():
     assert np.all(np.isfinite(np.asarray(l[1])))
 
 
+@pytest.mark.parametrize("impl", ["stream", "grid"])
+def test_kernel_matches_float64_host_oracle(impl, monkeypatch):
+    """Adjudicate numerics against a HOST float64 oracle, not another
+    on-chip program: an on-TPU 'reference' einsum is itself bf16-rounded
+    (default matmul precision), which masked a bf16-precision bug in
+    the stream kernel's MXU dots on hardware (r4, docs/architecture.md
+    'Decode-step cost, decomposed honestly')."""
+    monkeypatch.setenv("SELDON_TPU_PAGED_KERNEL_IMPL", impl)
+    rng = np.random.default_rng(3)
+    B, h, hd, ps, P, num_pages = 4, 8, 64, 16, 4, 32
+    qn = rng.normal(size=(B, h, hd)).astype(np.float32)
+    pkn = rng.normal(size=(num_pages, ps, h, hd)).astype(np.float32)
+    pvn = rng.normal(size=(num_pages, ps, h, hd)).astype(np.float32)
+    tn = rng.integers(1, num_pages, size=(B, P)).astype(np.int32)
+    ln = np.array([5, 16, 37, 64], np.int32)
+
+    gk = pkn[tn].reshape(B, P * ps, h, hd).astype(np.float64)
+    gv = pvn[tn].reshape(B, P * ps, h, hd).astype(np.float64)
+    s = np.einsum("bhd,bkhd->bhk", qn.astype(np.float64), gk)
+    mask = np.arange(P * ps)[None, :] < ln[:, None]
+    s = np.where(mask[:, None, :], s, -np.inf)
+    m64 = s.max(-1)
+    w = np.exp(s - m64[..., None])
+    ref = np.einsum("bhk,bkhd->bhd", w, gv) / w.sum(-1)[..., None]
+
+    acc, m, l = jax.jit(
+        lambda *a: paged_attention_decode(*a, page_size=ps)
+    )(*map(jnp.asarray, (qn, pkn, pvn, tn, ln)))
+    out = np.asarray(acc / l[..., None], np.float64)
+    assert float(np.nanmax(np.abs(out - ref))) < 1e-4, impl
+    assert float(np.max(np.abs(np.asarray(m, np.float64) - m64))) < 1e-4, impl
+
+
 def test_engine_tokens_identical_kernel_vs_gather(monkeypatch):
     from seldon_core_tpu.models.paged import PagedEngine
     from seldon_core_tpu.models.transformer import TransformerLM
@@ -78,8 +115,9 @@ def test_engine_tokens_identical_kernel_vs_gather(monkeypatch):
     params = module.init(jax.random.key(0), jnp.zeros((1, 8), jnp.int32))["params"]
     prompts = [np.arange(5 + 7 * i, dtype=np.int32) % 256 for i in range(4)]
 
-    def run(mode):
+    def run(mode, impl="stream"):
         monkeypatch.setenv("SELDON_TPU_PAGED_KERNEL", mode)
+        monkeypatch.setenv("SELDON_TPU_PAGED_KERNEL_IMPL", impl)
         eng = PagedEngine(
             params, dtype=jnp.bfloat16, page_size=32, max_slots=4,
             steps_per_call=8, **cfg,
@@ -89,5 +127,5 @@ def test_engine_tokens_identical_kernel_vs_gather(monkeypatch):
         return np.stack([s.result for s in streams])
 
     gather = run("0")
-    kernel = run("force")  # interpret-mode pallas on CPU
-    assert np.array_equal(gather, kernel)
+    for impl in ("stream", "grid"):  # interpret-mode pallas on CPU
+        assert np.array_equal(gather, run("force", impl)), impl
