@@ -542,6 +542,8 @@ class JaxServer(TPUComponent):
         batch: Optional[int] = None,
         n_resident: int = 4,
         seed: int = 7,
+        target_seconds: float = 1.5,
+        max_iters: int = 20000,
     ) -> Dict[str, Any]:
         """True device forward rate: N forwards per SINGLE dispatch.
 
@@ -553,6 +555,13 @@ class JaxServer(TPUComponent):
         rooflines measure the link.  Two-point timing (t_big - t_small
         over the SAME compiled program at two trip counts) also cancels
         the one remaining dispatch+readback.
+
+        ``iters_big`` auto-calibrates so the measured span covers at
+        least ``target_seconds`` of device time: for small models the
+        default 40-iteration loop is milliseconds, and the dispatch
+        penalty's run-to-run variance (tens of ms on this harness) can
+        then dominate — or even produce a negative span (measured: the
+        QUICK tiny-model int8 ratio read 0.02x from exactly this).
 
         Inputs are generated on device (distinct per resident batch so
         no content-dedup anywhere can flatter the number; nothing is
@@ -599,6 +608,18 @@ class JaxServer(TPUComponent):
         t0 = time.perf_counter()
         float(run_jit(self.variables, data, iters_big))
         dt_big = time.perf_counter() - t0
+        # auto-calibrate: grow iters_big until the measured span covers
+        # target_seconds of pure loop time (pilot slope estimates the
+        # per-iteration cost without the dispatch constant)
+        slope = (dt_big - dt_small) / max(iters_big - iters_small, 1)
+        if slope * (iters_big - iters_small) < target_seconds and slope > 0:
+            iters_big = min(
+                max_iters,
+                iters_small + max(int(target_seconds / slope), iters_big),
+            )
+            t0 = time.perf_counter()
+            float(run_jit(self.variables, data, iters_big))
+            dt_big = time.perf_counter() - t0
         compute = dt_big - dt_small
         if compute <= 1e-4:  # degenerate timing (clock noise): raw rate
             compute = dt_big
