@@ -15,8 +15,14 @@ proto:
 native:
 	$(MAKE) -C native
 
+# fast tier (default; pyproject addopts excludes @slow): fits a CI
+# shell window on the 1-CPU bench host (~4-5 min)
 test:
 	python -m pytest tests/ -x -q
+
+# everything, including the compile-heavy @slow modules (~20 min here)
+test-all:
+	python -m pytest tests/ -x -q -m 'slow or not slow'
 
 bench:
 	python bench.py

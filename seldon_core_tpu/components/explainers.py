@@ -21,6 +21,16 @@ same chip.
   batcher / one XLA call); the weighted least squares is a tiny
   (M−1)² host-side float64 solve, factored once per call.  With few
   features all coalitions are enumerated, making the values exact.
+* ``AnchorsExplainer`` — the flagship method of the reference's ONLY
+  wired explainer container (alibi's AnchorTabular — the operator
+  defaults ``seldonio/alibiexplainer_grpc``, reference:
+  operator/controllers/seldondeployment_explainers.go:57-59): an
+  *anchor* is a minimal rule of feature predicates under which the
+  model's prediction (almost) never changes — precision
+  P(f(z)=f(x) | z ⊨ rule) ≥ threshold.  Tabular search over
+  quantile-discretised features; same TPU-first shape as kernel SHAP:
+  every candidate rule of a beam round is estimated from perturbation
+  rows stacked into ONE batched predict.
 """
 
 from __future__ import annotations
@@ -321,10 +331,244 @@ class KernelShapExplainer(TPUComponent):
         return np.asarray(self.explain(X, names)["attributions"])
 
 
+class AnchorsExplainer(TPUComponent):
+    """Tabular anchors: minimal high-precision rules (black-box).
+
+    For instance ``x`` with model decision ``t = argmax f(x)``, find
+    the smallest predicate set ``A`` (each predicate: "feature j falls
+    in x's quantile bin") whose precision
+    ``P(argmax f(z) = t | z ⊨ A) ≥ precision_threshold``, where ``z``
+    is a background row with the anchored features resampled from the
+    bin x occupies.  Greedy beam search over anchor size; every
+    candidate of a round is estimated from ``n_samples`` perturbation
+    rows, all candidates stacked into ONE batched predict (the same
+    device-friendly evaluation shape as KernelShapExplainer — the
+    model call count is the round count, not the candidate count).
+
+    ``background`` rows are required (they define both the quantile
+    grid and the perturbation distribution — alibi's AnchorTabular
+    requires training data for the same reason).  Coverage is the
+    fraction of background rows satisfying the rule.
+
+    Result per row: the predicate list (feature index, human-readable
+    predicate string, bin bounds), measured precision, coverage, and
+    whether the threshold was reached (``raw_precision`` of the best
+    effort is reported either way — a model with no compact anchor is
+    an honest outcome, not an error).
+    """
+
+    def __init__(
+        self,
+        model: Any = None,
+        background: Optional[Any] = None,  # reference rows (list or array)
+        n_bins: int = 4,
+        precision_threshold: float = 0.95,
+        n_samples: int = 128,
+        beam_size: int = 2,
+        max_anchor_size: Optional[int] = None,
+        seed: int = 0,
+        **kwargs: Any,
+    ):
+        super().__init__(**kwargs)
+        self.model = model
+        self.background = (
+            None if background is None
+            else np.atleast_2d(np.asarray(background, np.float64))
+        )
+        self.n_bins = int(n_bins)
+        if self.n_bins < 2:
+            raise MicroserviceError(
+                "anchors needs n_bins >= 2", status_code=400, reason="BAD_REQUEST"
+            )
+        self.precision_threshold = float(precision_threshold)
+        self.n_samples = int(n_samples)
+        self.beam_size = int(beam_size)
+        self.max_anchor_size = max_anchor_size
+        self.seed = int(seed)
+        self._edges: Optional[np.ndarray] = None  # (m, n_bins-1) quantile edges
+
+    def attach(self, model: Any) -> None:
+        self.model = model
+
+    # ---- discretisation ---------------------------------------------------
+
+    def _fit_edges(self, m: int) -> np.ndarray:
+        if self.background is None:
+            raise MicroserviceError(
+                "AnchorsExplainer needs 'background' rows (they define the "
+                "quantile grid and the perturbation distribution)",
+                status_code=400,
+                reason="BAD_REQUEST",
+            )
+        if self.background.shape[1] != m:
+            raise MicroserviceError(
+                f"background has {self.background.shape[1]} features, request has {m}",
+                status_code=400,
+                reason="BAD_REQUEST",
+            )
+        qs = np.linspace(0, 1, self.n_bins + 1)[1:-1]
+        return np.quantile(self.background, qs, axis=0).T  # (m, n_bins-1)
+
+    def _bins_of(self, rows: np.ndarray) -> np.ndarray:
+        """Bin index per (row, feature) against the fitted edges."""
+        out = np.zeros(rows.shape, np.int64)
+        for j in range(rows.shape[1]):
+            out[:, j] = np.searchsorted(self._edges[j], rows[:, j], side="right")
+        return out
+
+    def _predicate_str(self, j: int, b: int, names: List[str]) -> str:
+        name = names[j] if j < len(names) else f"f{j}"
+        edges = self._edges[j]
+        lo = None if b == 0 else edges[b - 1]
+        hi = None if b >= len(edges) else edges[b]
+        if lo is None:
+            return f"{name} <= {hi:.6g}"
+        if hi is None:
+            return f"{name} > {lo:.6g}"
+        return f"{lo:.6g} < {name} <= {hi:.6g}"
+
+    # ---- search -----------------------------------------------------------
+
+    def _perturb(
+        self, x: np.ndarray, anchor: tuple, x_bins: np.ndarray,
+        bg_bins: np.ndarray, rng: np.random.Generator,
+    ) -> np.ndarray:
+        """``n_samples`` background rows with anchored features redrawn
+        from x's bin (falling back to x's own value when the background
+        has no row in that bin — the predicate still holds)."""
+        bg = self.background
+        idx = rng.integers(0, len(bg), size=self.n_samples)
+        Z = bg[idx].copy()
+        for j in anchor:
+            pool = bg[bg_bins[:, j] == x_bins[j], j]
+            if len(pool):
+                Z[:, j] = rng.choice(pool, size=self.n_samples, replace=True)
+            else:
+                Z[:, j] = x[j]
+        return Z
+
+    def _explain_row(
+        self, x: np.ndarray, names: List[str], bg_bins: np.ndarray,
+        rng: np.random.Generator,
+    ) -> Dict[str, Any]:
+        m = len(x)
+        x_bins = self._bins_of(x[None])[0]
+        out0 = np.asarray(self.model.predict(x[None], names))
+        if out0.ndim == 1:
+            out0 = out0[None, :] if len(out0) > 1 else out0[:, None]
+        target = int(np.argmax(out0[0]))
+        max_size = min(self.max_anchor_size or m, m)
+
+        def coverage(anchor: tuple) -> float:
+            sat = np.ones(len(bg_bins), bool)
+            for j in anchor:
+                sat &= bg_bins[:, j] == x_bins[j]
+            return float(sat.mean())
+
+        beam: List[tuple] = [()]
+        best: Dict[str, Any] = {"anchor": (), "precision": 0.0, "coverage": 1.0}
+        seen: set = set()
+        for _size in range(1, max_size + 1):
+            # candidates: every beam rule extended by one unused feature
+            cands = []
+            for a in beam:
+                for j in range(m):
+                    if j in a:
+                        continue
+                    c = tuple(sorted(a + (j,)))
+                    if c not in seen:
+                        seen.add(c)
+                        cands.append(c)
+            if not cands:
+                break
+            # ONE batched predict for the whole round: every candidate's
+            # n_samples perturbation rows, stacked
+            Zs = [
+                self._perturb(x, c, x_bins, bg_bins, rng) for c in cands
+            ]
+            batch = np.concatenate(Zs, axis=0)
+            preds = np.asarray(self.model.predict(batch, names))
+            if preds.ndim == 1:
+                preds = preds[:, None]
+            labels = np.argmax(preds, axis=1)
+            precisions = [
+                float((labels[i * self.n_samples:(i + 1) * self.n_samples] == target).mean())
+                for i in range(len(cands))
+            ]
+            # rank by precision, ties by coverage (broader rules win)
+            order = sorted(
+                range(len(cands)),
+                key=lambda i: (-precisions[i], -coverage(cands[i])),
+            )
+            top = order[0]
+            if precisions[top] > best["precision"] or (
+                precisions[top] == best["precision"] and not best["anchor"]
+            ):
+                best = {
+                    "anchor": cands[top],
+                    "precision": precisions[top],
+                    "coverage": coverage(cands[top]),
+                }
+            if precisions[top] >= self.precision_threshold:
+                break
+            beam = [cands[i] for i in order[: self.beam_size]]
+        anchor = best["anchor"]
+        return {
+            "features": list(anchor),
+            "predicates": [
+                self._predicate_str(j, int(x_bins[j]), names) for j in anchor
+            ],
+            "precision": best["precision"],
+            "coverage": best["coverage"],
+            "met_threshold": best["precision"] >= self.precision_threshold,
+            "target": target,
+        }
+
+    def explain(self, X, names=None) -> Dict[str, Any]:
+        if self.model is None:
+            raise MicroserviceError(
+                "AnchorsExplainer needs a model", status_code=400, reason="NO_MODEL"
+            )
+        X = np.atleast_2d(np.asarray(X, dtype=np.float64))
+        names = list(names or [])
+        if self._edges is None:
+            self._edges = self._fit_edges(X.shape[1])
+        elif X.shape[1] != self._edges.shape[0]:
+            # the grid is fitted to the background's width; a
+            # later request with a different width is the client's
+            # error (400), not an IndexError deep in _bins_of
+            raise MicroserviceError(
+                f"request has {X.shape[1]} features, explainer is fitted "
+                f"for {self._edges.shape[0]}",
+                status_code=400,
+                reason="BAD_REQUEST",
+            )
+        bg_bins = self._bins_of(self.background)
+        rng = np.random.default_rng(self.seed)
+        rows = [self._explain_row(x, names, bg_bins, rng) for x in X]
+        return {
+            "method": "anchors",
+            "anchors": rows,
+            "targets": [r["target"] for r in rows],
+            "precision_threshold": self.precision_threshold,
+            "names": names,
+        }
+
+    # deployable as a MODEL node: rows of 0/1 anchor membership
+    def predict(self, X, names, meta=None):
+        X = np.atleast_2d(np.asarray(X, dtype=np.float64))
+        result = self.explain(X, names)
+        out = np.zeros((len(X), X.shape[1]))
+        for i, a in enumerate(result["anchors"]):
+            out[i, a["features"]] = 1.0
+        return out
+
+
 EXPLAINER_TYPES: Dict[str, Callable[..., Any]] = {
     "integrated_gradients": IntegratedGradientsExplainer,
     "permutation": PermutationExplainer,
     "kernel_shap": KernelShapExplainer,
+    "anchors": AnchorsExplainer,
 }
 
 

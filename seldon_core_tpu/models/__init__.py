@@ -21,24 +21,21 @@ def _register_optional() -> None:
         register_implementation("SKLEARN_SERVER", SKLearnServer)
     except ImportError:
         pass
-    try:
-        from seldon_core_tpu.models.xgboostserver import XGBoostServer
+    # xgboost/mlflow servers carry their own fallback lanes (JSON
+    # booster evaluator / MLmodel sklearn flavor) so they register —
+    # and RUN — regardless of the optional packages (VERDICT r4 #4)
+    from seldon_core_tpu.models.xgboostserver import XGBoostServer
 
-        register_implementation("XGBOOST_SERVER", XGBoostServer)
-    except ImportError:
-        pass
+    register_implementation("XGBOOST_SERVER", XGBoostServer)
     try:
         from seldon_core_tpu.models.torchserver import TorchServer
 
         register_implementation("TORCH_SERVER", TorchServer)
     except ImportError:
         pass
-    try:
-        from seldon_core_tpu.models.mlflowserver import MLFlowServer
+    from seldon_core_tpu.models.mlflowserver import MLFlowServer
 
-        register_implementation("MLFLOW_SERVER", MLFlowServer)
-    except ImportError:
-        pass
+    register_implementation("MLFLOW_SERVER", MLFlowServer)
     from seldon_core_tpu.models.proxyserver import (
         RestProxyServer,
         SageMakerProxy,

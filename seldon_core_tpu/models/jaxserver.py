@@ -612,7 +612,32 @@ class JaxServer(TPUComponent):
         # target_seconds of pure loop time (pilot slope estimates the
         # per-iteration cost without the dispatch constant)
         slope = (dt_big - dt_small) / max(iters_big - iters_small, 1)
-        if slope * (iters_big - iters_small) < target_seconds and slope > 0:
+        if slope <= 0:
+            # dispatch noise swallowed the pilot span (tiny models:
+            # dt_big < dt_small by tens of ms happens).  Re-measure the
+            # pilots rather than skip calibration — skipping fell
+            # through to the dispatch-INCLUSIVE raw rate, exactly the
+            # distortion calibration exists to remove.
+            for _ in range(3):
+                t0 = time.perf_counter()
+                float(run_jit(self.variables, data, iters_small))
+                dt_small = time.perf_counter() - t0
+                t0 = time.perf_counter()
+                float(run_jit(self.variables, data, iters_big))
+                dt_big = time.perf_counter() - t0
+                slope = (dt_big - dt_small) / max(iters_big - iters_small, 1)
+                if slope > 0:
+                    break
+        if slope <= 0:
+            # still noise-drowned: the per-iteration cost is far below
+            # the dispatch constant, so run the longest loop allowed and
+            # measure THAT span — the constant becomes marginal at
+            # max_iters scale
+            iters_big = max_iters
+            t0 = time.perf_counter()
+            float(run_jit(self.variables, data, iters_big))
+            dt_big = time.perf_counter() - t0
+        elif slope * (iters_big - iters_small) < target_seconds:
             iters_big = min(
                 max_iters,
                 iters_small + max(int(target_seconds / slope), iters_big),

@@ -96,25 +96,52 @@ class HttpPairLogger:
 class KafkaPairLogger:
     """Stream pairs to a Kafka topic (reference analogue: the kafka/
     integration for streaming request logging, reference: kafka/
-    kafka.json + zookeeper-k8s/).  Gated on a Kafka client package
-    being installed; raises a clear error otherwise."""
+    kafka.json:1-30 + zookeeper-k8s/).
 
-    def __init__(self, bootstrap_servers: str, topic: str = "seldon-request-pairs"):
-        try:
-            from kafka import KafkaProducer  # type: ignore
-        except ImportError as e:
-            raise RuntimeError(
-                "KafkaPairLogger needs the kafka-python package installed"
-            ) from e
+    Speaks the Kafka wire protocol directly via the in-repo
+    :class:`~seldon_core_tpu.utils.kafka.MiniKafkaProducer` — no client
+    package needed, so the lane RUNS in this image (contract-tested
+    against the in-repo fake broker, byte-level).  Pairs are keyed by
+    puid (stable partition per request id) and drained on a background
+    thread so the data plane never blocks on the broker; a full buffer
+    drops (counted), the HttpPairLogger discipline.
+    """
+
+    def __init__(self, bootstrap_servers: str, topic: str = "seldon-request-pairs",
+                 capacity: int = 1024, timeout_s: float = 5.0):
+        from seldon_core_tpu.utils.kafka import MiniKafkaProducer
+
         self.topic = topic
-        self._producer = KafkaProducer(
-            bootstrap_servers=bootstrap_servers,
-            value_serializer=lambda v: json.dumps(v).encode("utf-8"),
+        self._producer = MiniKafkaProducer(bootstrap_servers, timeout_s=timeout_s)
+        self._queue: "queue.Queue[Optional[Dict]]" = queue.Queue(maxsize=capacity)
+        self._thread = threading.Thread(
+            target=self._drain, daemon=True, name="seldon-tpu-kafkalog"
         )
+        self._thread.start()
+        self.dropped = 0
+        self.sent = 0
 
     def __call__(self, request: InternalMessage, response: InternalMessage) -> None:
-        self._producer.send(self.topic, build_pair(request, response))
+        try:
+            self._queue.put_nowait(build_pair(request, response))
+        except queue.Full:  # never block the data plane on the broker
+            self.dropped += 1
+
+    def _drain(self) -> None:
+        while True:
+            pair = self._queue.get()
+            if pair is None:
+                return
+            try:
+                key = (pair.get("puid") or "").encode() or None
+                self._producer.send(
+                    self.topic, json.dumps(pair).encode("utf-8"), key=key
+                )
+                self.sent += 1
+            except Exception as e:  # noqa: BLE001
+                logger.warning("kafka pair logger produce failed: %s", e)
 
     def close(self) -> None:
-        self._producer.flush()
+        self._queue.put(None)
+        self._thread.join(timeout=5.0)
         self._producer.close()

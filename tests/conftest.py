@@ -34,3 +34,8 @@ def rng():
 
 def pytest_configure(config):
     config.addinivalue_line("markers", "e2e: full-stack tests spawning real processes/ports")
+    config.addinivalue_line(
+        "markers",
+        "slow: compile-heavy tests excluded from the default fast tier "
+        "(pyproject addopts -m 'not slow'; `make test-all` runs everything)",
+    )

@@ -370,6 +370,109 @@ class TestKernelShap:
             e.explain(np.ones((1, 1)))
 
 
+class _StumpComponent(TPUComponent):
+    """Decision stump(s): class 1 iff every listed feature > its
+    threshold — the model whose TRUE anchor is known by construction
+    (the thresholded features, nothing else), the correctness oracle
+    the anchors search is verified against (VERDICT r4 next #5)."""
+
+    def __init__(self, thresholds):  # {feature_index: threshold}
+        self.thresholds = dict(thresholds)
+        self.calls = 0
+
+    def predict(self, X, names, meta=None):
+        self.calls += 1
+        X = np.atleast_2d(np.asarray(X))
+        hit = np.ones(len(X), bool)
+        for j, t in self.thresholds.items():
+            hit &= X[:, j] > t
+        return np.stack([~hit, hit], axis=1).astype(np.float64)
+
+
+class TestAnchors:
+    def _background(self, m=4, n=512, seed=3):
+        return np.random.default_rng(seed).uniform(0.0, 1.0, size=(n, m))
+
+    def test_stump_anchor_is_the_deciding_feature(self):
+        from seldon_core_tpu.components.explainers import AnchorsExplainer
+
+        bg = self._background()
+        model = _StumpComponent({0: 0.5})
+        e = AnchorsExplainer(model=model, background=bg, n_bins=4, seed=0)
+        # x0 = 0.9 sits in the top quantile bin (all values > 0.75 > 0.5)
+        out = e.explain(np.array([[0.9, 0.2, 0.4, 0.6]]))
+        a = out["anchors"][0]
+        assert a["features"] == [0]
+        assert a["precision"] == 1.0 and a["met_threshold"]
+        assert a["target"] == 1
+        assert out["method"] == "anchors"
+        # coverage of one quantile bin over its own background ~ 1/n_bins
+        assert 0.15 < a["coverage"] < 0.35
+        assert "f0" in a["predicates"][0]
+
+    def test_and_stump_needs_both_features(self):
+        from seldon_core_tpu.components.explainers import AnchorsExplainer
+
+        bg = self._background()
+        model = _StumpComponent({0: 0.5, 2: 0.5})
+        e = AnchorsExplainer(model=model, background=bg, n_bins=4, seed=0)
+        out = e.explain(np.array([[0.9, 0.1, 0.8, 0.3]]))
+        a = out["anchors"][0]
+        assert sorted(a["features"]) == [0, 2]
+        assert a["precision"] == 1.0 and a["met_threshold"]
+
+    def test_one_batched_predict_per_round(self):
+        """Every candidate of a beam round must share ONE predict call
+        (the TPU-first contract, same as kernel SHAP's coalitions)."""
+        from seldon_core_tpu.components.explainers import AnchorsExplainer
+
+        model = _StumpComponent({0: 0.5})
+        e = AnchorsExplainer(model=model, background=self._background(), seed=0)
+        e.explain(np.array([[0.9, 0.2, 0.4, 0.6]]))
+        # 1 target call + 1 round (the stump anchors in round one)
+        assert model.calls == 2
+
+    def test_no_compact_anchor_is_reported_not_errored(self):
+        from seldon_core_tpu.components.explainers import AnchorsExplainer
+
+        class Parity(TPUComponent):
+            # XOR-ish: no single-bin rule ever pins the class
+            def predict(self, X, names, meta=None):
+                X = np.atleast_2d(np.asarray(X))
+                h = ((X > 0.5).sum(axis=1) % 2).astype(bool)
+                return np.stack([~h, h], axis=1).astype(np.float64)
+
+        e = AnchorsExplainer(
+            model=Parity(), background=self._background(m=3),
+            max_anchor_size=1, seed=0,
+        )
+        out = e.explain(np.array([[0.9, 0.2, 0.4]]))
+        a = out["anchors"][0]
+        assert not a["met_threshold"]
+        assert 0.0 <= a["precision"] < 0.95
+
+    def test_registry_and_missing_background(self):
+        from seldon_core_tpu.components.explainers import AnchorsExplainer
+        from seldon_core_tpu.runtime.component import MicroserviceError
+
+        e = build_explainer({"type": "anchors", "n_bins": 4})
+        assert isinstance(e, AnchorsExplainer)
+        e.attach(_StumpComponent({0: 0.5}))
+        with pytest.raises(MicroserviceError, match="background"):
+            e.explain(np.ones((1, 4)))
+
+    def test_width_change_after_fit_is_400_not_indexerror(self):
+        from seldon_core_tpu.components.explainers import AnchorsExplainer
+        from seldon_core_tpu.runtime.component import MicroserviceError
+
+        e = AnchorsExplainer(
+            model=_StumpComponent({0: 0.5}), background=self._background(m=4)
+        )
+        e.explain(np.array([[0.9, 0.2, 0.4, 0.6]]))  # fits the 4-wide grid
+        with pytest.raises(MicroserviceError, match="features"):
+            e.explain(np.ones((1, 6)))
+
+
 class TestTorchServer:
     def test_torchscript_roundtrip(self, tmp_path):
         import torch

@@ -27,6 +27,9 @@ import pytest
 from seldon_core_tpu.controlplane import Deployer, TpuDeployment
 from seldon_core_tpu.runtime.message import InternalMessage
 
+pytestmark = pytest.mark.slow  # compile-heavy: excluded from the default fast tier (make test-all)
+
+
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 

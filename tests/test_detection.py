@@ -11,10 +11,14 @@ import jax
 import jax.numpy as jnp
 
 from seldon_core_tpu.models.detection import (
+
     Detector,
     decode_detections,
     make_detector,
 )
+
+
+pytestmark = pytest.mark.slow  # compile-heavy: excluded from the default fast tier (make test-all)
 
 
 class TestDecode:

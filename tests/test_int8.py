@@ -10,12 +10,16 @@ import numpy as np
 import pytest
 
 from seldon_core_tpu.ops.surgery import (
+
     QuantizedKernel,
     dequantize_params,
     quantize_kernel,
     quantize_params,
     tree_hbm_bytes,
 )
+
+
+pytestmark = pytest.mark.slow  # compile-heavy: excluded from the default fast tier (make test-all)
 
 
 class TestSurgery:

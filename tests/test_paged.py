@@ -19,6 +19,9 @@ from seldon_core_tpu.models.paged import PagedEngine, StreamingLM, get_paged_lm_
 from seldon_core_tpu.models.transformer import TransformerLM
 from seldon_core_tpu.runtime.component import MicroserviceError
 
+pytestmark = pytest.mark.slow  # compile-heavy: excluded from the default fast tier (make test-all)
+
+
 CFG = dict(vocab_size=64, d_model=32, num_layers=2, num_heads=4, max_len=64)
 
 

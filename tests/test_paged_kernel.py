@@ -16,6 +16,9 @@ import jax.numpy as jnp  # noqa: E402
 
 from seldon_core_tpu.ops.kernels import paged_attention_decode  # noqa: E402
 
+pytestmark = pytest.mark.slow  # compile-heavy: excluded from the default fast tier (make test-all)
+
+
 
 def _dense_reference(q, pk, pv, tables, lengths):
     B = q.shape[0]

@@ -10,10 +10,14 @@ import jax.numpy as jnp
 
 from seldon_core_tpu.parallel import create_mesh
 from seldon_core_tpu.parallel.ring_attention import (
+
     plain_attention,
     ring_attention,
     sequence_sharding,
 )
+
+
+pytestmark = pytest.mark.slow  # compile-heavy: excluded from the default fast tier (make test-all)
 
 
 def qkv(batch=2, seq=16, heads=2, dim=8, seed=0):

@@ -18,7 +18,12 @@ import pytest
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 NATIVE_DIR = os.path.join(REPO_ROOT, "native")
 
-pytestmark = pytest.mark.e2e
+pytestmark = [
+    pytest.mark.e2e,
+    # sanitizer builds + fuzz runs are ~100 s of g++ and load loops:
+    # excluded from the default fast tier (make test-all runs them)
+    pytest.mark.slow,
+]
 
 
 def _build(target: str, artifact: str) -> str:

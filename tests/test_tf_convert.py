@@ -15,6 +15,9 @@ import jax.numpy as jnp
 
 from seldon_core_tpu.utils.tf_convert import KERAS_STAGES, convert_tf_resnet
 
+pytestmark = pytest.mark.slow  # compile-heavy: excluded from the default fast tier (make test-all)
+
+
 
 def _flatten(tree, prefix=()):
     out = {}

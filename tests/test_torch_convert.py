@@ -14,9 +14,13 @@ import jax
 import jax.numpy as jnp
 
 from seldon_core_tpu.utils.torch_convert import (
+
     convert_torch_resnet,
     resnet_layout,
 )
+
+
+pytestmark = pytest.mark.slow  # compile-heavy: excluded from the default fast tier (make test-all)
 
 
 def _flatten(tree, prefix=()):

@@ -80,11 +80,22 @@ public final class Codec {
     static Object unflatten(List<Object> values, List<Object> shape) {
         long total = 1;
         for (Object d : shape) {
-            if (!(d instanceof Number) || ((Number) d).longValue() < 0) {
+            long dim = (d instanceof Number) ? ((Number) d).longValue() : -1;
+            // each dim must fit an int (subList/intValue below) and the
+            // product must not wrap: unchecked long multiplication of
+            // two ~2^32 dims wraps around, the values/shape check then
+            // passes spuriously, and intValue() clamping emits a
+            // silently malformed nested result instead of this 400
+            if (dim < 0 || dim > Integer.MAX_VALUE) {
                 throw new Dispatch.ApiError(400, "BAD_REQUEST",
                         "tensor shape entries must be non-negative integers: " + shape);
             }
-            total *= ((Number) d).longValue();
+            try {
+                total = Math.multiplyExact(total, dim);
+            } catch (ArithmeticException e) {
+                throw new Dispatch.ApiError(400, "BAD_REQUEST",
+                        "tensor shape product overflows: " + shape);
+            }
         }
         if (values.size() != total) {
             throw new Dispatch.ApiError(400, "BAD_REQUEST",

@@ -124,10 +124,31 @@ public final class Microservice {
         return (Map<String, Object>) parsed;
     }
 
+    // payload hardening twin of Json.MAX_DEPTH: the parser guards
+    // recursion, this guards materialisation — an uncapped readAllBytes
+    // would let one oversized POST OOM the wrapper JVM.  Same knob name
+    // as the gRPC max-message annotations (seldon.io/grpc-max-message-size).
+    static final int MAX_BODY_BYTES =
+            Integer.getInteger("seldon.tpu.max-body-bytes", 64 * 1024 * 1024);
+
+    static byte[] readBounded(InputStream in, int cap) throws IOException {
+        java.io.ByteArrayOutputStream buf = new java.io.ByteArrayOutputStream();
+        byte[] chunk = new byte[65536];
+        int n;
+        while ((n = in.read(chunk)) != -1) {
+            if (buf.size() + n > cap) {
+                throw new Dispatch.ApiError(413, "PAYLOAD_TOO_LARGE",
+                        "request body exceeds " + cap + " bytes");
+            }
+            buf.write(chunk, 0, n);
+        }
+        return buf.toByteArray();
+    }
+
     Map<String, Object> readMessage(HttpExchange ex) throws IOException {
         byte[] body;
         try (InputStream in = ex.getRequestBody()) {
-            body = in.readAllBytes();
+            body = readBounded(in, MAX_BODY_BYTES);
         }
         String text = new String(body, StandardCharsets.UTF_8);
         if (text.isEmpty()) {
