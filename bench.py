@@ -156,6 +156,7 @@ def _compact_result(full: dict) -> dict:
         ("gen_tok_s", ("generation", "decode_tokens_per_s")),
         ("paged_tok_s", ("generation", "paged_serving_tokens_per_s")),
         ("paged64_tok_s", ("generation", "paged_serving64_tokens_per_s")),
+        ("paged128_tok_s", ("generation", "paged_serving128_tokens_per_s")),
         ("paged_chunk_tok_s", ("generation", "paged_chunk_tokens_per_s")),
         # NOTE: the r3 micro-comparison artifact paged_decode_tokens_per_s
         # (one device call per token, a methodology contrast — NOT a
@@ -169,7 +170,10 @@ def _compact_result(full: dict) -> dict:
         ("spec_ngram_acc_arith_ctrl", ("generation", "spec_ngram_acceptance_arith")),
         ("native_img_s", ("native_model", "images_per_s")),
         ("native_grpc_img_s", ("native_model", "grpc_images_per_s")),
-        ("native_vs_py", ("native_model", "vs_python_lane")),
+        # same clients + payloads + protocol against the native ingress
+        # and the Python gRPC server; best-of-3 windows both sides
+        ("native_vs_py", ("native_vs_py_grpc",)),
+        ("py_grpc_img_s", ("python_grpc_images_per_s",)),
         ("h2_qps", ("native_grpc_qps",)),
         ("h2_vs_ref", ("native_grpc_vs_reference",)),
         ("stub_qps", ("stub_engine_qps",)),
@@ -702,15 +706,18 @@ async def native_model_phase(handle, shape, seconds: float = 6.0) -> dict:
         native_load, handle.port, one, min(seconds, 3.0), 1, 1
     )
     await quiesce()
-    # MATCHED offered load vs the Python gRPC lane: 8 connections,
-    # depth 1 (sync closed loop per connection) — byte-identical rows
-    # and the exact client pattern of throughput_phase's 8 threads, so
-    # native-vs-python is one subtraction (vs_python_lane, added by the
-    # caller once both phases exist)
-    matched = await asyncio.to_thread(
-        native_load, handle.port, payload, seconds / 2.0, 8, 1
-    )
-    await quiesce()
+    # MATCHED offered load (8 connections, depth 1 — the sync
+    # closed-loop pattern of the gRPC throughput clients), best-of-3
+    # windows: single windows on this harness swing with dispatch
+    # noise (the r4 number flipped from exactly that)
+    matched = None
+    for _ in range(3):
+        out = await asyncio.to_thread(
+            native_load, handle.port, payload, seconds / 3.0, 8, 1
+        )
+        await quiesce()
+        if out and (matched is None or out["qps"] > matched["qps"]):
+            matched = out
     best = dict(matched or {"qps": 0.0}, connections=8, depth=1)
     # then the architecture's own capability: deeper pipelines (still
     # modest — on a 1-CPU bench host the wire bytes compete with the
@@ -737,13 +744,24 @@ async def native_model_phase(handle, shape, seconds: float = 6.0) -> dict:
         gone.SerializeToString(), min(seconds, 3.0), 1, 1
     )
     await quiesce()
-    gbest = {"qps": 0.0}
-    for conns, depth in ((8, 1), (8, 4), (8, 8), (8, 12)):
+    # matched gRPC config (8, 1) gets best-of-3; deeper pipelines one
+    # window each (best-overall keeps the capability number honest)
+    gmatched = None
+    for _ in range(3):
+        gout = await asyncio.to_thread(
+            native_load_grpc, handle.port, "/seldon.protos.Seldon/Predict",
+            gbytes, seconds / 3.0, 8, 1
+        )
+        await quiesce()
+        if gout and (gmatched is None or gout["qps"] > gmatched["qps"]):
+            gmatched = gout
+    gbest = dict(gmatched or {"qps": 0.0}, connections=8, depth=1)
+    for conns, depth in ((8, 4), (8, 8), (8, 12)):
         gout = await asyncio.to_thread(
             native_load_grpc, handle.port, "/seldon.protos.Seldon/Predict",
             gbytes, seconds / 3.0, conns, depth
         )
-        if gout and (gout["qps"] > gbest["qps"] or "connections" not in gbest):
+        if gout and gout["qps"] > gbest["qps"]:
             gbest = dict(gout, connections=conns, depth=depth)
         await quiesce()
 
@@ -753,6 +771,7 @@ async def native_model_phase(handle, shape, seconds: float = 6.0) -> dict:
         "images_per_s": round(best["qps"] * rows, 1),
         "requests_per_s": round(best["qps"], 1),
         "matched_images_per_s": round((matched or {}).get("qps", 0.0) * rows, 1),
+        "grpc_matched_images_per_s": round((gmatched or {}).get("qps", 0.0) * rows, 1),
         "grpc_images_per_s": round(gbest["qps"] * rows, 1),
         "grpc_requests_per_s": round(gbest["qps"], 1),
         "grpc_p50_ms": round(1000.0 / max(glat["qps"], 1e-9), 2)
@@ -942,13 +961,37 @@ async def child_main() -> None:
         _checkpoint(status)
 
     # ---- phase 2: throughput (high concurrency, batched requests) --------
+    # best-of-3 windows (the r4 native_vs_py read backwards partly
+    # because single windows on this harness swing with dispatch noise
+    # — the same min-of-N discipline the decode timings adopted)
     tput_batch = int(os.environ.get("BENCH_CLIENT_BATCH", "32"))
-    tput, tput_errors = await measure_phase(port, shape, SECONDS, CONCURRENCY, client_batch=tput_batch)
-    # comparison lane: the same latency workload against the Python
-    # gRPC server (what r1/r2 measured), so the native-vs-python gap is
-    # certified in one run
+    tput_windows = []
+    tput: list = []
+    tput_errors: list = []
+    for _ in range(3):
+        w, werr = await measure_phase(
+            port, shape, SECONDS / 3.0, CONCURRENCY, client_batch=tput_batch
+        )
+        tput_windows.append(len(w) * tput_batch / (SECONDS / 3.0))
+        tput.extend(w)
+        tput_errors.extend(werr)
+    tput.sort()
+    # the MATCHED python-lane number: the SAME client mix (8 sync gRPC
+    # conns x batch-32, byte-identical payloads) against the Python
+    # gRPC server on its own port — protocol, clients, payloads and
+    # device path all held constant; only the serving stack differs
+    # (the reference bar: the engine exists to beat Python serving,
+    # doc/source/graph/svcorch.md:1-8).  Best-of-3 both sides.
     if native_handle is not None:
         try:
+            py_windows = []
+            for _ in range(3):
+                w, _werr = await measure_phase(
+                    python_port, shape, SECONDS / 3.0, CONCURRENCY,
+                    client_batch=tput_batch,
+                )
+                py_windows.append(len(w) * tput_batch / (SECONDS / 3.0))
+            status["extra"]["python_grpc_images_per_s"] = round(max(py_windows), 1)
             py_lat, _py_err = await measure_phase(
                 python_port, shape, max(SECONDS / 3.0, 2.0), 4, client_batch=1
             )
@@ -960,14 +1003,22 @@ async def child_main() -> None:
             status["extra"]["python_grpc_error"] = str(e)[:200]
     await grpc_server.stop(grace=None)
     if tput:
+        best_rate = round(max(tput_windows), 1)
         status["throughput_phase"] = {
             "concurrency": CONCURRENCY,
             "client_batch": tput_batch,
-            "images_per_s": round(len(tput) * tput_batch / SECONDS, 1),
-            "requests_per_s": round(len(tput) / SECONDS, 1),
+            "images_per_s": best_rate,
+            "windows_images_per_s": [round(r, 1) for r in tput_windows],
+            "requests_per_s": round(best_rate / tput_batch, 1),
             "p50_ms": round(statistics.median(tput), 3),
             "errors": len(tput_errors),
         }
+        py_best = status["extra"].get("python_grpc_images_per_s")
+        if py_best:
+            # THE native-vs-python number (compact key native_vs_py):
+            # same clients, same payloads, same protocol, best-of-3
+            # both sides; >= 1.0 = the native ingress earns its place
+            status["extra"]["native_vs_py_grpc"] = round(best_rate / py_best, 2)
         status["phase"] = "throughput_done"
         _checkpoint(status)
 
@@ -1044,12 +1095,16 @@ async def child_main() -> None:
             nm = status["extra"]["native_model"]
             if nm.get("images_per_s"):
                 status["extra"]["native_model_qps"] = nm["requests_per_s"]
-            # the r3 ask: native >= python at identical payload/offered
-            # load (matched = 8 sync connections x the same batch-32
-            # rows the Python throughput phase sends)
+            # context row, NOT the native-vs-python verdict: C++-client
+            # HTTP lane vs python-client gRPC lane mixes client stacks
+            # (the r4 vs_python_lane read backwards because of exactly
+            # that).  The certified ratio is native_vs_py_grpc above —
+            # same clients, same protocol, both serving stacks.
             tput = status.get("throughput_phase", {}).get("images_per_s")
             if tput and nm.get("matched_images_per_s"):
-                nm["vs_python_lane"] = round(nm["matched_images_per_s"] / tput, 2)
+                nm["http_lane_vs_python_clients"] = round(
+                    nm["matched_images_per_s"] / tput, 2
+                )
         except Exception as e:  # noqa: BLE001
             status["extra"]["native_model_error"] = str(e)[:200]
         _checkpoint(status)
@@ -1525,15 +1580,27 @@ def generation_phase() -> dict:
             return sum(int(s.result.shape[0]) for s in streams)
 
         serve_run()  # pays the compiles (prefill k, ladder sizes)
-        stats0 = serve_engine.engine_stats()
-        t0 = _time.perf_counter()
-        total = serve_run()
-        serve_dt = _time.perf_counter() - t0
-        stats1 = serve_engine.engine_stats()
-        result["paged_serving_tokens_per_s"] = round(total / serve_dt, 1)
+        # min-of-3 protocol (best rate of 3 runs): single-shot serving
+        # runs swing with the harness's per-dispatch noise (ADVICE r4);
+        # per-run stats deltas so chunks/chunk_wall describe the BEST
+        # run, not the sum of all three
+        best = None
+        for _ in range(3):
+            s0 = serve_engine.engine_stats()
+            t0 = _time.perf_counter()
+            n = serve_run()
+            dt = _time.perf_counter() - t0
+            s1 = serve_engine.engine_stats()
+            if best is None or n / dt > best["rate"]:
+                best = {
+                    "rate": n / dt, "total": n, "dt": dt,
+                    "chunks": s1["chunks"] - s0["chunks"],
+                    "chunk_wall": s1["chunk_wall_s"] - s0["chunk_wall_s"],
+                }
+        result["paged_serving_tokens_per_s"] = round(best["rate"], 1)
         result["paged_serving_streams"] = serve_slots
         result["paged_serving_max_new"] = serve_new
-        result["paged_serving_chunks"] = stats1["chunks"] - stats0["chunks"]
+        result["paged_serving_chunks"] = best["chunks"]
         result["paged_serving_vs_scan"] = round(
             result["paged_serving_tokens_per_s"]
             / max(result["decode_tokens_per_s"], 1e-9), 3
@@ -1541,50 +1608,56 @@ def generation_phase() -> dict:
         # decode-only rate (engine wall inside chunk calls): what the
         # decode path itself sustains, admission excluded — the number
         # comparable to the contiguous scan lane's decode rate
-        chunk_wall = stats1["chunk_wall_s"] - stats0["chunk_wall_s"]
-        if chunk_wall > 0:
-            result["paged_chunk_tokens_per_s"] = round(total / chunk_wall, 1)
+        if best["chunk_wall"] > 0:
+            result["paged_chunk_tokens_per_s"] = round(
+                best["total"] / best["chunk_wall"], 1
+            )
             result["paged_chunk_vs_scan"] = round(
                 result["paged_chunk_tokens_per_s"]
                 / max(result["decode_tokens_per_s"], 1e-9), 3
             )
         serve_engine.close()
 
-        # wider continuous batching: slots are the per-call-amortisation
-        # lever on this harness (measured sweep on chip: 16 -> 3.4k,
-        # 32 -> 4.1k, 64 -> 4.9k, 128 -> 3.4k tok/s — per-step attention
-        # cost overtakes the amortisation past ~64).  Full runs only:
-        # the 64-slot programs are fresh compiles the QUICK cap cannot
-        # absorb cold.
+        # wider continuous batching: slots amortise the per-call cost.
+        # The r4 sweep regressed past 64 streams (16 -> 3.4k, 64 ->
+        # 4.9k, 128 -> 3.4k tok/s) because the legacy chunk's per-step
+        # pool gather scaled superlinearly with slots; the r5 ring
+        # chunk gathers context once per chunk (VERDICT r4 #3 asks the
+        # sweep monotone through 128).  Min-of-3 each point (ADVICE
+        # r4).  Full runs only: the wide-slot programs are fresh
+        # compiles the QUICK cap cannot absorb cold.
         if not quick:
-            wide_slots = 64
-            wprompts = [
-                rng2.integers(
-                    0, cfg["vocab_size"], size=(plen_base + (i % 5) * 4,)
-                ).astype(np.int32)
-                for i in range(wide_slots)
-            ]
-            wide_engine = PagedEngine(
-                params, dtype=jnp.bfloat16, page_size=64,
-                max_slots=wide_slots, steps_per_call=8,
-                max_steps_per_call=256, **serve_cfg,
-            )
-
-            def wide_run():
-                streams = [
-                    wide_engine.submit(p, max_new_tokens=serve_new)
-                    for p in wprompts
+            for wide_slots in (64, 128):
+                wprompts = [
+                    rng2.integers(
+                        0, cfg["vocab_size"], size=(plen_base + (i % 5) * 4,)
+                    ).astype(np.int32)
+                    for i in range(wide_slots)
                 ]
-                wide_engine.run()
-                return sum(int(s.result.shape[0]) for s in streams)
+                wide_engine = PagedEngine(
+                    params, dtype=jnp.bfloat16, page_size=64,
+                    max_slots=wide_slots, steps_per_call=8,
+                    max_steps_per_call=256, **serve_cfg,
+                )
 
-            wide_run()  # pays the compiles
-            t0 = _time.perf_counter()
-            wtotal = wide_run()
-            wide_dt = _time.perf_counter() - t0
-            result["paged_serving64_tokens_per_s"] = round(wtotal / wide_dt, 1)
-            result["paged_serving64_streams"] = wide_slots
-            wide_engine.close()
+                def wide_run():
+                    streams = [
+                        wide_engine.submit(p, max_new_tokens=serve_new)
+                        for p in wprompts
+                    ]
+                    wide_engine.run()
+                    return sum(int(s.result.shape[0]) for s in streams)
+
+                wide_run()  # pays the compiles
+                wbest = 0.0
+                for _ in range(3):
+                    t0 = _time.perf_counter()
+                    wtotal = wide_run()
+                    wbest = max(wbest, wtotal / (_time.perf_counter() - t0))
+                key = f"paged_serving{wide_slots}_tokens_per_s"
+                result[key] = round(wbest, 1)
+                result[f"paged_serving{wide_slots}_streams"] = wide_slots
+                wide_engine.close()
     except Exception as e:  # noqa: BLE001
         result["paged_serving_error"] = str(e)[:200]
     return result

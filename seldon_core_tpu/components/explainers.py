@@ -429,6 +429,21 @@ class AnchorsExplainer(TPUComponent):
 
     # ---- search -----------------------------------------------------------
 
+    @staticmethod
+    def _labels(preds: np.ndarray) -> np.ndarray:
+        """Model outputs -> decision labels.  Multi-column outputs are
+        argmax'd; a SINGLE score column (binary probability / logistic
+        margin — e.g. the xgboost binary fallback returns (N,)) is
+        thresholded at 0.5.  Without this, a 1-wide output argmaxes to
+        class 0 for every row and EVERY rule reads precision 1.0 — an
+        arbitrary anchor reported as a perfect explanation."""
+        p = np.asarray(preds)
+        if p.ndim == 1:
+            p = p[:, None]
+        if p.shape[1] == 1:
+            return (p[:, 0] > 0.5).astype(np.int64)
+        return np.argmax(p, axis=1)
+
     def _perturb(
         self, x: np.ndarray, anchor: tuple, x_bins: np.ndarray,
         bg_bins: np.ndarray, rng: np.random.Generator,
@@ -453,10 +468,9 @@ class AnchorsExplainer(TPUComponent):
     ) -> Dict[str, Any]:
         m = len(x)
         x_bins = self._bins_of(x[None])[0]
-        out0 = np.asarray(self.model.predict(x[None], names))
-        if out0.ndim == 1:
-            out0 = out0[None, :] if len(out0) > 1 else out0[:, None]
-        target = int(np.argmax(out0[0]))
+        target = int(self._labels(
+            np.asarray(self.model.predict(x[None], names)).reshape(1, -1)
+        )[0])
         max_size = min(self.max_anchor_size or m, m)
 
         def coverage(anchor: tuple) -> float:
@@ -487,10 +501,7 @@ class AnchorsExplainer(TPUComponent):
                 self._perturb(x, c, x_bins, bg_bins, rng) for c in cands
             ]
             batch = np.concatenate(Zs, axis=0)
-            preds = np.asarray(self.model.predict(batch, names))
-            if preds.ndim == 1:
-                preds = preds[:, None]
-            labels = np.argmax(preds, axis=1)
+            labels = self._labels(np.asarray(self.model.predict(batch, names)))
             precisions = [
                 float((labels[i * self.n_samples:(i + 1) * self.n_samples] == target).mean())
                 for i in range(len(cands))

@@ -178,6 +178,110 @@ def _build_modules():
             x = x + nn.Dense(d_model, dtype=self.dtype, name="mlp_out")(y)
             return x, k, v
 
+    class ChunkTransformerBlock(nn.Module):
+        """TransformerBlock reading a pre-gathered contiguous context
+        plus a step-indexed in-chunk ring — the decode-chunk fast path.
+
+        The r5 slot-scaling probe showed the per-STEP pool gather is
+        the chunk's pathology: its cost scales superlinearly with
+        total gathered bytes (measured 3.2 ms/step at 64 slots ->
+        18.4 ms/step at 128, 13.7x the traffic floor), and the
+        gather+DUS read/write hazard on the pool adds several more
+        ms/step of scheduling overhead.  This block never touches the
+        pool: the caller gathers each slot's context ONCE per chunk
+        into ``ctx`` (amortised over steps) and accumulates the
+        chunk's own K/V in ``ring`` (written at column ``step`` —
+        uniform across slots, one DUS per step).  Attention is then
+        three dense einsums (ctx, ring, self) — the same token set,
+        masks, and dtypes as the pool gather path.
+        """
+
+        num_heads: int
+        mlp_ratio: int = 4
+        dtype: Any = jnp.bfloat16
+
+        @nn.compact
+        def __call__(self, x, ctx_k, ctx_v, ring_k, ring_v, step, len0):
+            # x: (B, 1, d)   ctx_k/v: (B, C, h, hd)   ring_k/v: (B, S, h, hd)
+            # step: scalar — ring columns < step are live
+            # len0: (B,) context lengths frozen at chunk start
+            d_model = x.shape[-1]
+            heads = self.num_heads
+            head_dim = d_model // heads
+            batch, seg_len = x.shape[:2]
+            y = nn.LayerNorm(dtype=jnp.float32)(x)
+            qkv = nn.Dense(3 * d_model, dtype=self.dtype, name="qkv")(y)
+            q, k, v = jnp.split(qkv, 3, axis=-1)
+            shape = (batch, seg_len, heads, head_dim)
+            q, k, v = q.reshape(shape), k.reshape(shape), v.reshape(shape)
+            scale = 1.0 / jnp.sqrt(head_dim).astype(q.dtype)
+
+            C = ctx_k.shape[1]
+            S = ring_k.shape[1]
+            sc = jnp.einsum("bqhd,bkhd->bhqk", q * scale, ctx_k)
+            sr = jnp.einsum("bqhd,bkhd->bhqk", q * scale, ring_k)
+            ss = jnp.einsum("bqhd,bkhd->bhqk", q * scale, k)
+            neg = jnp.finfo(sc.dtype).min
+            ctx_mask = jnp.arange(C)[None, :] < len0[:, None]  # (B, C)
+            sc = jnp.where(ctx_mask[:, None, None, :], sc, neg)
+            ring_mask = jnp.arange(S) < step  # (S,) cols written so far
+            sr = jnp.where(ring_mask[None, None, None, :], sr, neg)
+            scores = jnp.concatenate([sc, sr, ss], axis=-1).astype(jnp.float32)
+            weights = jax.nn.softmax(scores, axis=-1).astype(self.dtype)
+            wc = weights[..., :C]
+            wr = weights[..., C:C + S]
+            ws = weights[..., C + S:]
+            attn = (
+                jnp.einsum("bhqk,bkhd->bqhd", wc, ctx_v)
+                + jnp.einsum("bhqk,bkhd->bqhd", wr, ring_v)
+                + jnp.einsum("bhqk,bkhd->bqhd", ws, v)
+            )
+            attn = attn.reshape(batch, seg_len, d_model)
+            x = x + nn.Dense(d_model, dtype=self.dtype, name="attn_proj")(attn)
+            y = nn.LayerNorm(dtype=jnp.float32)(x)
+            y = nn.Dense(self.mlp_ratio * d_model, dtype=self.dtype, name="mlp_in")(y)
+            y = nn.gelu(y)
+            x = x + nn.Dense(d_model, dtype=self.dtype, name="mlp_out")(y)
+            return x, k, v
+
+    class ChunkTransformerLM(nn.Module):
+        """PagedTransformerLM's decode-chunk twin: identical parameter
+        tree (same module names per block), pool-free attention inputs.
+
+        ``__call__(tokens, positions, ctx_k, ctx_v, ring_k, ring_v,
+        step, len0)`` -> ``(logits, new_k, new_v)`` with ctx/ring
+        shaped ``(layers, B, C|S, heads, head_dim)``.
+        """
+
+        vocab_size: int = 32_000
+        d_model: int = 256
+        num_layers: int = 4
+        num_heads: int = 8
+        max_len: int = 2048
+        dtype: Any = jnp.bfloat16
+
+        @nn.compact
+        def __call__(self, tokens, positions, ctx_k, ctx_v, ring_k, ring_v,
+                     step, len0):
+            tokens = tokens.astype(jnp.int32)
+            x = nn.Embed(
+                self.vocab_size, self.d_model, dtype=self.dtype, name="tok_embed"
+            )(tokens)
+            pos = nn.Embed(
+                self.max_len, self.d_model, dtype=self.dtype, name="pos_embed"
+            )(positions)
+            x = x + pos
+            new_k, new_v = [], []
+            for i in range(self.num_layers):
+                x, k, v = ChunkTransformerBlock(
+                    num_heads=self.num_heads, dtype=self.dtype, name=f"block_{i}"
+                )(x, ctx_k[i], ctx_v[i], ring_k[i], ring_v[i], step, len0)
+                new_k.append(k)
+                new_v.append(v)
+            x = nn.LayerNorm(dtype=jnp.float32)(x)
+            logits = nn.Dense(self.vocab_size, dtype=self.dtype, name="head")(x)
+            return logits.astype(jnp.float32), jnp.stack(new_k), jnp.stack(new_v)
+
     class PagedTransformerLM(nn.Module):
         """TransformerLM forward against a paged pool.
 
@@ -216,10 +320,10 @@ def _build_modules():
             logits = nn.Dense(self.vocab_size, dtype=self.dtype, name="head")(x)
             return logits.astype(jnp.float32), jnp.stack(new_k), jnp.stack(new_v)
 
-    return PagedTransformerBlock, PagedTransformerLM
+    return PagedTransformerBlock, PagedTransformerLM, ChunkTransformerLM
 
 
-_MODULES: Optional[Tuple[Any, Any]] = None
+_MODULES: Optional[Tuple[Any, Any, Any]] = None
 
 
 def get_paged_lm_class():
@@ -227,6 +331,15 @@ def get_paged_lm_class():
     if _MODULES is None:
         _MODULES = _build_modules()
     return _MODULES[1]
+
+
+def get_chunk_lm_class():
+    """The decode-chunk twin (pool-free attention; shares the paged
+    LM's parameter tree — see ChunkTransformerBlock)."""
+    global _MODULES
+    if _MODULES is None:
+        _MODULES = _build_modules()
+    return _MODULES[2]
 
 
 def write_kv(pk, pv, new_k, new_v, block_tables, start, valid, *, page_size, max_len,
@@ -442,6 +555,18 @@ class PagedEngine:
             # all-gather the pool per layer per step
             decode_kernel=mesh is None,
         )
+        # decode-chunk twin: pool-free attention over a once-per-chunk
+        # gathered context + in-chunk ring (same parameter tree — the
+        # r5 fix for per-step gather cost scaling superlinearly with
+        # slots).  SELDON_TPU_CHUNK_IMPL=pool restores the legacy
+        # per-step-gather chunk for A/B.
+        import os as _os
+
+        self.chunk_module = get_chunk_lm_class()(
+            vocab_size=vocab_size, d_model=d_model, num_layers=num_layers,
+            num_heads=num_heads, max_len=max_len, dtype=dtype,
+        )
+        self._chunk_impl = _os.environ.get("SELDON_TPU_CHUNK_IMPL", "ring")
         pool_shape = (num_layers, self.num_pages, self.page_size, num_heads, head_dim)
         # tensor-parallel decode: megatron-style param shardings + the
         # pool sharded on its heads axis (created sharded, never
@@ -535,7 +660,9 @@ class PagedEngine:
                 self._draft_rollout = jax.jit(self._draft_rollout_fn)
 
         self._prefill_jit: Dict[Tuple[int, int], Any] = {}  # (bucket, k)
-        self._chunk_jit: Dict[int, Any] = {}  # steps -> compiled program
+        # (steps, ctx horizon pages) -> compiled chunk program (ring
+        # impl; the pool impl keys (steps, 0) — it has no ctx gather)
+        self._chunk_jit: Dict[Tuple[int, int], Any] = {}
         # one fixed-shape program deriving every slot's rng key data
         self._derive_keys = jax.jit(
             jax.vmap(lambda s: jax.random.key_data(jax.random.key(s)))
@@ -648,32 +775,81 @@ class PagedEngine:
             p *= 2
         return min(p, self.pages_per_stream)
 
-    def _get_chunk(self, steps: int):
-        """Compiled decode program for one ladder size (lazy, cached);
-        jit specialises per sliced block-table width on top."""
-        fn = self._chunk_jit.get(steps)
+    def _get_chunk(self, steps: int, h_ctx: int = 0):
+        """Compiled decode program for one (ladder size, ctx horizon)
+        pair (lazy, cached); jit specialises per sliced block-table
+        width on top.  ``h_ctx`` is the power-of-two page count the
+        ring implementation gathers as contiguous context (0 for the
+        legacy pool implementation, which needs no static ctx width)."""
+        # the pool body ignores h_ctx — key it as 0 so varying ctx
+        # horizons don't recompile byte-identical legacy programs
+        key = (steps, 0 if self._chunk_impl == "pool" else max(h_ctx, 1))
+        fn = self._chunk_jit.get(key)
         if fn is None:
             from functools import partial
 
-            fn = self._jax.jit(
-                partial(self._chunk_fn, steps), donate_argnums=(1, 2)
-            )
-            self._chunk_jit[steps] = fn
+            if self._chunk_impl == "pool":
+                body = partial(self._chunk_fn_pool, steps)
+            else:
+                body = partial(self._chunk_fn, steps, max(h_ctx, 1))
+            fn = self._jax.jit(body, donate_argnums=(1, 2))
+            self._chunk_jit[key] = fn
         return fn
 
     def _chunk_fn(
-        self, steps, params, pk, pv, logits, lengths, block_tables, keys,
-        done, emitted, max_new, temps, top_ks, eos_ids,
+        self, steps, h_ctx, params, pk, pv, logits, lengths, block_tables,
+        keys, done, emitted, max_new, temps, top_ks, eos_ids,
     ):
-        """``steps`` decode steps for all slots, on device."""
+        """``steps`` decode steps for all slots, on device — the ring
+        implementation (r5 default).
+
+        The legacy implementation gathered every slot's pages from the
+        pool EVERY step and DUS-wrote the pool every step; the r5
+        slot-scaling probe measured that per-step gather at 3.2 ms/step
+        (64 slots) -> 18.4 ms/step (128 slots, 13.7x its traffic
+        floor), plus several ms/step of pool read/write-hazard
+        overhead — the cause of the 64->128 stream throughput
+        regression.  Here the pool is touched exactly twice per chunk:
+
+        1. **ctx gather, once** — each slot's context K/V (positions
+           < len0, ``h_ctx`` pages) is gathered into a contiguous
+           ``(L, B, C, h, hd)`` buffer; amortised over ``steps``.
+        2. **page write-back, once** — the chunk's new K/V accumulate
+           in a step-indexed ring (column t at step t: ONE uniform DUS
+           per step, no per-slot raggedness) and land in their pages
+           in page-block DUS writes at chunk end (a lax.scan over
+           slots keeps the program small).
+
+        Per-step attention is therefore three dense einsums (ctx, ring,
+        self) — same token set, masks, and dtypes as the pool path, so
+        greedy outputs stay exact (asserted by the parity suite).
+        Memory cost: the ctx copy (≈ the live context's size) for the
+        chunk's duration — the classic paged-storage / contiguous-
+        working-set split.
+        """
         jax, jnp = self._jax, self._jnp
         # dequant ONCE per chunk, amortised over steps_per_call decode
         # steps (int8 halves resident weight HBM; measured on TPU,
         # per-step dequant does not fuse and ran 0.48x)
         params = self._materialize(params)
+        L = self.module.num_layers
+        B = self.max_slots
+        h = self.module.num_heads
+        hd = self.module.d_model // self.module.num_heads
+        ps = self.page_size
+        dtype = pk.dtype
 
-        def step(carry, _):
-            pk, pv, logits, lengths, keys, done, emitted = carry
+        len0 = lengths  # frozen at chunk start: ctx mask + write-back base
+        ctx_tables = block_tables[:, :h_ctx]
+        C = h_ctx * ps
+        # (L, B, P, ps, h, hd) -> (L, B, C, h, hd): the once-per-chunk gather
+        ctx_k = pk[:, ctx_tables].reshape(L, B, C, h, hd)
+        ctx_v = pv[:, ctx_tables].reshape(L, B, C, h, hd)
+        ring_k = jnp.zeros((L, B, steps, h, hd), dtype)
+        ring_v = jnp.zeros((L, B, steps, h, hd), dtype)
+
+        def step(carry, t):
+            logits, lengths, keys, done, emitted, ring_k, ring_v = carry
             typed = jax.random.wrap_key_data(keys)
             split = jax.vmap(jax.random.split)(typed)
             step_keys = split[:, 1]
@@ -689,6 +865,105 @@ class PagedEngine:
             emitted = emitted + active.astype(jnp.int32)
             done = done | (token == eos_ids) | (emitted >= max_new)
             positions = lengths[:, None]  # new token's absolute position
+            new_logits, nk, nv = self.chunk_module.apply(
+                {"params": params}, token[:, None],
+                jnp.minimum(positions, self.max_len - 1),
+                ctx_k, ctx_v, ring_k, ring_v, t, len0,
+            )
+            # ring col t <- this step's K/V: ONE uniform DUS (inactive
+            # lanes write garbage there; never written back — emitted
+            # caps the write-back, and lanes go inactive monotonically
+            # within a chunk so accepted ring cols are 0..emitted-1)
+            ring_k = jax.lax.dynamic_update_slice(ring_k, nk, (0, 0, t, 0, 0))
+            ring_v = jax.lax.dynamic_update_slice(ring_v, nv, (0, 0, t, 0, 0))
+            logits = jnp.where(active[:, None], new_logits[:, 0], logits)
+            lengths = lengths + active.astype(jnp.int32)
+            return (logits, lengths, keys, done, emitted, ring_k, ring_v), token
+
+        (logits, lengths, keys, done, emitted, ring_k, ring_v), toks = jax.lax.scan(
+            step, (logits, lengths, keys, done, emitted, ring_k, ring_v),
+            jnp.arange(steps),
+        )
+
+        # ---- write-back: ring -> pool pages, once per chunk ----------
+        # Page-aligned: per slot, shift the ring to page alignment
+        # (first partial page merged from ctx so full-page writes
+        # cannot clobber existing tokens), then DUS whole page blocks.
+        # A lax.scan over slots carries pk/pv in place and keeps the
+        # program ~20 ops per slot instead of B*steps token writes.
+        n_back = steps // ps + 2  # pages a slot's chunk tokens can span
+        W = n_back * ps
+        p0 = jnp.minimum(len0, self.max_len - 1) // ps  # (B,) first page idx
+        off0 = jnp.minimum(len0, self.max_len - 1) % ps
+
+        def write_slot(carry, s):
+            pk, pv = carry
+            ring_k_s = jax.lax.dynamic_index_in_dim(
+                ring_k, s, axis=1, keepdims=False)  # (L, S, h, hd)
+            ring_v_s = jax.lax.dynamic_index_in_dim(
+                ring_v, s, axis=1, keepdims=False)
+            ctx_k_s = jax.lax.dynamic_index_in_dim(
+                ctx_k, s, axis=1, keepdims=False)  # (L, C, h, hd)
+            ctx_v_s = jax.lax.dynamic_index_in_dim(
+                ctx_v, s, axis=1, keepdims=False)
+            off = off0[s]
+            first_k = jax.lax.dynamic_slice(
+                ctx_k_s, (0, p0[s] * ps, 0, 0), (L, ps, h, hd)
+            )
+            first_v = jax.lax.dynamic_slice(
+                ctx_v_s, (0, p0[s] * ps, 0, 0), (L, ps, h, hd)
+            )
+            aligned_k = jnp.zeros((L, W, h, hd), dtype)
+            aligned_v = jnp.zeros((L, W, h, hd), dtype)
+            aligned_k = jax.lax.dynamic_update_slice(aligned_k, first_k, (0, 0, 0, 0))
+            aligned_v = jax.lax.dynamic_update_slice(aligned_v, first_v, (0, 0, 0, 0))
+            aligned_k = jax.lax.dynamic_update_slice(aligned_k, ring_k_s, (0, off, 0, 0))
+            aligned_v = jax.lax.dynamic_update_slice(aligned_v, ring_v_s, (0, off, 0, 0))
+            table_s = jax.lax.dynamic_index_in_dim(block_tables, s, axis=0,
+                                                   keepdims=False)
+            em = jax.lax.dynamic_index_in_dim(emitted, s, axis=0, keepdims=False)
+            for j in range(n_back):
+                # page j holds accepted tokens iff its window starts
+                # before off0+emitted; inactive lanes (em==0) and pages
+                # past the accepted span are redirected to trash page 0
+                valid = (j * ps < off + em) & (em > 0)
+                page = jnp.where(valid, jnp.take(table_s, p0[s] + j, mode="clip"), 0)
+                pk = jax.lax.dynamic_update_slice(
+                    pk, aligned_k[:, None, j * ps:(j + 1) * ps], (0, page, 0, 0, 0)
+                )
+                pv = jax.lax.dynamic_update_slice(
+                    pv, aligned_v[:, None, j * ps:(j + 1) * ps], (0, page, 0, 0, 0)
+                )
+            return (pk, pv), ()
+
+        (pk, pv), _ = jax.lax.scan(write_slot, (pk, pv), jnp.arange(B))
+        return toks.T, pk, pv, logits, lengths, keys, done, emitted
+
+    def _chunk_fn_pool(
+        self, steps, params, pk, pv, logits, lengths, block_tables, keys,
+        done, emitted, max_new, temps, top_ks, eos_ids,
+    ):
+        """Legacy chunk implementation (SELDON_TPU_CHUNK_IMPL=pool):
+        per-step pool gather + per-slot DUS writes.  Kept selectable
+        for A/B measurement and as the fallback while the ring path
+        hardens; the pallas decode kernels only apply here."""
+        jax, jnp = self._jax, self._jnp
+        params = self._materialize(params)
+
+        def step(carry, _):
+            pk, pv, logits, lengths, keys, done, emitted = carry
+            typed = jax.random.wrap_key_data(keys)
+            split = jax.vmap(jax.random.split)(typed)
+            step_keys = split[:, 1]
+            token = self._sample_batch(logits, step_keys, temps, top_ks)
+            active = ~done
+            keys = jnp.where(
+                active[:, None], jax.random.key_data(split[:, 0]), keys
+            )
+            token = jnp.where(active, token, eos_ids)
+            emitted = emitted + active.astype(jnp.int32)
+            done = done | (token == eos_ids) | (emitted >= max_new)
+            positions = lengths[:, None]
             new_logits, nk, nv = self.module.apply(
                 {"params": params}, token[:, None],
                 jnp.minimum(positions, self.max_len - 1),
@@ -1181,9 +1456,16 @@ class PagedEngine:
                 temps[s] = stream.temperature
                 top_ks[s] = stream.top_k
                 eos_ids[s] = stream.eos_id
-            pages_h = self._pages_horizon(
-                [s for s in active if not stalled[s.slot]], steps
-            )
+            runnable_now = [s for s in active if not stalled[s.slot]]
+            pages_h = self._pages_horizon(runnable_now, steps)
+            # ctx horizon for the ring chunk: the pages holding tokens
+            # that EXIST at chunk start (no +steps — in-chunk tokens
+            # live in the ring, not the gathered context)
+            h_ctx = self._pages_pow2(
+                -(-max(int(self._lengths[s.slot]) for s in runnable_now)
+                  // self.page_size)
+            ) if runnable_now else 1
+            h_ctx = min(h_ctx, pages_h)
             tables = jnp.asarray(self._block_tables[:, :pages_h])
             lengths = jnp.asarray(self._lengths)
             emitted0 = jnp.zeros((self.max_slots,), jnp.int32)
@@ -1192,7 +1474,7 @@ class PagedEngine:
 
         t_chunk = _time.perf_counter()
         toks, self.pages_k, self.pages_v, self._logits, lengths_out, self._keys, _, emitted = (
-            self._get_chunk(steps)(
+            self._get_chunk(steps, h_ctx)(
                 self.params, self.pages_k, self.pages_v, self._logits,
                 lengths, tables, self._keys, jnp.asarray(done_in),
                 emitted0, jnp.asarray(max_new), jnp.asarray(temps),

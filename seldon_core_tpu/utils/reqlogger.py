@@ -118,7 +118,8 @@ class KafkaPairLogger:
             target=self._drain, daemon=True, name="seldon-tpu-kafkalog"
         )
         self._thread.start()
-        self.dropped = 0
+        self.dropped = 0  # queue-full drops (data plane never blocks)
+        self.failed = 0   # produce attempts the broker lost (outages)
         self.sent = 0
 
     def __call__(self, request: InternalMessage, response: InternalMessage) -> None:
@@ -139,6 +140,9 @@ class KafkaPairLogger:
                 )
                 self.sent += 1
             except Exception as e:  # noqa: BLE001
+                # counted: a broker outage's data loss must be visible
+                # in the counters, not only in a log line
+                self.failed += 1
                 logger.warning("kafka pair logger produce failed: %s", e)
 
     def close(self) -> None:

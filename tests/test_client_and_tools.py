@@ -461,6 +461,30 @@ class TestAnchors:
         with pytest.raises(MicroserviceError, match="background"):
             e.explain(np.ones((1, 4)))
 
+    def test_single_score_output_thresholds_not_degenerate(self):
+        """A 1-column score model (binary probability, e.g. the
+        xgboost logistic fallback) must threshold at 0.5 — argmaxing a
+        single column makes EVERY rule precision 1.0 and reports an
+        arbitrary anchor as perfect."""
+        from seldon_core_tpu.components.explainers import AnchorsExplainer
+
+        class ScoreStump(TPUComponent):
+            def predict(self, X, names, meta=None):
+                X = np.atleast_2d(np.asarray(X))
+                return np.where(X[:, 0] > 0.5, 0.9, 0.1)  # (N,)
+
+        bg = self._background()
+        e = AnchorsExplainer(model=ScoreStump(), background=bg, n_bins=4, seed=0)
+        out = e.explain(np.array([[0.9, 0.2, 0.4, 0.6]]))
+        a = out["anchors"][0]
+        assert a["target"] == 1  # 0.9 > 0.5 -> positive class
+        assert a["features"] == [0]  # the real anchor, not an arbitrary one
+        assert a["precision"] == 1.0
+        # and a feature-1 rule must NOT read precision 1.0: verify by
+        # probing the labels path directly
+        labels = e._labels(np.array([0.9, 0.1, 0.4, 0.8]))
+        assert labels.tolist() == [1, 0, 0, 1]
+
     def test_width_change_after_fit_is_400_not_indexerror(self):
         from seldon_core_tpu.components.explainers import AnchorsExplainer
         from seldon_core_tpu.runtime.component import MicroserviceError

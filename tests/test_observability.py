@@ -680,6 +680,27 @@ class TestKafkaPairLogger:
         finally:
             broker.close()
 
+    def test_broker_outage_is_counted_not_silent(self):
+        """Pairs lost to a dead broker must show in the counters, not
+        only in a warning log line.  (The outage is a never-listening
+        port: closing a FakeKafkaBroker mid-accept leaves CPython's
+        deferred-fd-close serving one more connection.)"""
+        from seldon_core_tpu.runtime.message import InternalMessage
+        from seldon_core_tpu.utils.reqlogger import KafkaPairLogger
+
+        import socket
+
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()  # nothing ever listens here
+        logger = KafkaPairLogger(f"127.0.0.1:{port}", topic="t", timeout_s=0.5)
+        req = InternalMessage(payload=np.asarray([[1.0]]), kind="ndarray")
+        req.meta.puid = "p"
+        logger(req, req.with_payload(np.asarray([[2.0]])))
+        logger.close()
+        assert logger.failed == 1 and logger.sent == 0
+
     def test_producer_roundtrip_primitives(self):
         """encode/decode of the v0 message set are inverses and CRC'd
         (the recorded-bytes half of the contract)."""
