@@ -153,6 +153,9 @@ def _compact_result(full: dict) -> dict:
         ("vs_a100_triton", ("device_loop", "vs_a100_triton")),
         ("int8_fwd_x", ("int8", "int8_vs_fp")),
         ("int8_decode_x", ("generation", "int8_vs_fp_decode")),
+        # the weight-stream-dominated adjudication point (d2048/L8):
+        # >1.2x proves the "large-model lever" claim, else it retires
+        ("int8_big_x", ("generation", "int8_vs_fp_decode_big")),
         ("gen_tok_s", ("generation", "decode_tokens_per_s")),
         ("paged_tok_s", ("generation", "paged_serving_tokens_per_s")),
         ("paged64_tok_s", ("generation", "paged_serving64_tokens_per_s")),
@@ -176,6 +179,10 @@ def _compact_result(full: dict) -> dict:
         ("py_grpc_img_s", ("python_grpc_images_per_s",)),
         ("h2_qps", ("native_grpc_qps",)),
         ("h2_vs_ref", ("native_grpc_vs_reference",)),
+        # serving-plane verdict, relay-free: native h2c stub vs
+        # grpc-python stub, SAME C++ client (reference methodology)
+        ("native_vs_py_stub", ("native_vs_py_stub",)),
+        ("py_stub_qps", ("python_grpc_stub_qps",)),
         ("stub_qps", ("stub_engine_qps",)),
         ("native_front_qps", ("native_front_qps",)),
         ("server_p99_ms", ("server_latency", "p99_ms")),
@@ -266,7 +273,11 @@ def supervise() -> None:
     # QUICK's 320: the generation phase alone (scan + int8 + spec
     # exactness + distilled draft + serving block) measured ~220 s of
     # compile-dominated wall on a cold cache; 180 cut it off every time
-    timeout_s = float(os.environ.get("BENCH_ATTEMPT_TIMEOUT_S", "320" if QUICK else "1200"))
+    # 1500 not 1200: the r5 additions (ring-chunk compiles per
+    # (steps, ctx-horizon) pair, the d2048 int8 adjudication point, the
+    # 64/128-stream sweep, best-of-3 windows) overran 1200 s on a COLD
+    # cache; warm attempts stay well inside
+    timeout_s = float(os.environ.get("BENCH_ATTEMPT_TIMEOUT_S", "320" if QUICK else "1500"))
     backoffs = [10.0, 30.0, 60.0]
     failures: list = []
     best_status: dict = {}  # most-complete partial across ALL attempts
@@ -854,6 +865,51 @@ def host_costs_phase(shape, out_dim: int = 1000, iters: int = 300) -> dict:
     return out
 
 
+async def python_grpc_stub_qps(seconds: float = 4.0):
+    """SIMPLE_MODEL behind the grpc-python sync server, driven by the
+    SAME C++ h2 load client that measures the native stub lane — the
+    robust native-vs-python serving-plane comparison, by the
+    reference's own methodology (stub model so the serving plane
+    itself is measured, benchmarking.md:19-36).  The model-payload
+    matched ratio (native_vs_py_grpc) is relay-bound and swings ±20%
+    run-to-run; this pair is relay-free and differs only in the
+    serving stack.  Requires the r5 load-client HPACK upgrade
+    (grpc-python dynamic-table response headers)."""
+    import asyncio
+
+    import numpy as np
+
+    from seldon_core_tpu.engine import PredictorService, UnitSpec
+    from seldon_core_tpu.engine.server import Gateway
+    from seldon_core_tpu.engine.sync_server import build_sync_seldon_server
+    from seldon_core_tpu.native.frontserver import native_load_grpc
+    from seldon_core_tpu.proto import pb
+
+    svc = PredictorService(UnitSpec(name="stub", type="MODEL", implementation="SIMPLE_MODEL"))
+    gateway = Gateway([(svc, 1.0)])
+    server = build_sync_seldon_server(
+        gateway, asyncio.get_running_loop(), max_message_bytes=16 * 1024 * 1024
+    )
+    port = server.add_insecure_port("127.0.0.1:0")
+    server.start()
+    try:
+        req = pb.SeldonMessage()
+        req.data.rawTensor.dtype = "float32"
+        req.data.rawTensor.shape.extend([1, 3])
+        req.data.rawTensor.data = np.ones((1, 3), np.float32).tobytes()
+        best = None
+        for conns, depth in ((8, 8), (8, 32), (16, 32)):
+            out = await asyncio.to_thread(
+                native_load_grpc, port, "/seldon.protos.Seldon/Predict",
+                req.SerializeToString(), seconds / 3.0, conns, depth,
+            )
+            if out and (best is None or out["qps"] > best["qps"]):
+                best = dict(out, connections=conns, depth=depth)
+        return best
+    finally:
+        server.stop(grace=None)
+
+
 async def stub_dataplane_qps(seconds: float = 2.0) -> float:
     """In-process stub-model executor throughput (reference-comparable
     data-plane number, no model compute, no wire)."""
@@ -1145,6 +1201,24 @@ async def child_main() -> None:
         status["extra"]["native_grpc_error"] = str(e)[:200]
     _checkpoint(status)
 
+    try:
+        pg = await python_grpc_stub_qps()
+        if pg is not None and pg.get("qps"):
+            status["extra"]["python_grpc_stub_qps"] = round(pg["qps"], 1)
+            ng = status["extra"].get("native_grpc_qps")
+            if ng:
+                # the serving-plane native-vs-python verdict, relay-free:
+                # same stub model, same C++ h2c client, only the stack
+                # differs (compact key native_vs_py_stub)
+                status["extra"]["native_vs_py_stub"] = round(ng / pg["qps"], 2)
+            if pg.get("non2xx") or pg.get("errors"):
+                status["extra"]["python_grpc_stub_errors"] = {
+                    "non2xx": pg.get("non2xx"), "conn_errors": pg.get("errors")
+                }
+    except Exception as e:  # noqa: BLE001
+        status["extra"]["python_grpc_stub_error"] = str(e)[:200]
+    _checkpoint(status)
+
     if os.environ.get("BENCH_INT8", "1") == "1":
         try:
             status["extra"]["int8"] = await int8_phase(shape)
@@ -1213,7 +1287,7 @@ def generation_phase() -> dict:
         0, cfg["vocab_size"], size=(batch, plen)
     ).astype(np.int32)
 
-    def measure(gen, repeats: int = 3):
+    def measure(gen, m_prompts=None, m_new=None, repeats: int = 3):
         """One shared timing protocol, so fp and int8 stay comparable:
         warm both programs, then the prefill-corrected decode rate —
         full call minus a prefill-plus-one-step call isolates the
@@ -1221,19 +1295,21 @@ def generation_phase() -> dict:
         device calls, and this harness's per-dispatch penalty varies by
         tens of ms run-to-run (the r4 int8 decode ratio swung
         0.65-1.24x from exactly this before the repeats)."""
-        gen.generate(prompts, max_new_tokens=max_new)  # pays the compiles
-        gen.generate(prompts, max_new_tokens=1)
+        m_prompts = prompts if m_prompts is None else m_prompts
+        m_new = max_new if m_new is None else m_new
+        gen.generate(m_prompts, max_new_tokens=m_new)  # pays the compiles
+        gen.generate(m_prompts, max_new_tokens=1)
         dt_prefill = float("inf")
         for _ in range(repeats):
             t0 = _time.perf_counter()
-            gen.generate(prompts, max_new_tokens=1)
+            gen.generate(m_prompts, max_new_tokens=1)
             dt_prefill = min(dt_prefill, _time.perf_counter() - t0)
         dt_full = float("inf")
         for _ in range(repeats):
             t0 = _time.perf_counter()
-            out = gen.generate(prompts, max_new_tokens=max_new)
+            out = gen.generate(m_prompts, max_new_tokens=m_new)
             dt_full = min(dt_full, _time.perf_counter() - t0)
-            assert out.shape == (batch, max_new)
+            assert out.shape == (m_prompts.shape[0], m_new)
         return dt_prefill, dt_full, max(dt_full - dt_prefill, 1e-9)
 
     dt_prefill, dt_full, decode_dt = measure(Generator(params, dtype=jnp.bfloat16, **cfg))
@@ -1252,6 +1328,58 @@ def generation_phase() -> dict:
         )
         result["int8_decode_tokens_per_s"] = round(batch * (max_new - 1) / q_decode, 1)
         result["int8_vs_fp_decode"] = round(decode_dt / q_decode, 2)
+
+    if os.environ.get("BENCH_INT8", "1") == "1" and not quick:
+        # THE int8 value-proposition point (VERDICT r4 #6): at d512 the
+        # min-of-3 protocol showed no reliable win (the 116 MB weight
+        # stream is ~20% of the step).  The surviving claim — "a
+        # large-model lever" — is adjudicated at a WEIGHT-STREAM-
+        # DOMINATED size: d2048/L8 is ~470M params = 940 MB bf16 per
+        # decode step at batch 8, where halving weight bytes is halving
+        # most of the step.  Same measure() protocol, min-of-3.
+        try:
+            # 256 decode steps, not 64: at d2048 the fp step is
+            # ~1.4 ms, so a 64-step span (~86 ms) sits INSIDE this
+            # harness's ±tens-of-ms dispatch noise and the prefill
+            # subtraction can go degenerate (one full run printed an
+            # impossible 8.67x / 30k tok/s from exactly that); a
+            # ~350 ms span resolves the ratio
+            big_new = 256
+            big_cfg = dict(vocab_size=16384, d_model=2048, num_layers=8,
+                           num_heads=16, max_len=512)
+            big_module = TransformerLM(dtype=jnp.bfloat16, **big_cfg)
+            big_params = big_module.init(
+                jax.random.key(1), jnp.zeros((1, 8), jnp.int32)
+            )["params"]
+            big_prompts = np.random.default_rng(2).integers(
+                0, big_cfg["vocab_size"], size=(batch, 64)
+            ).astype(np.int32)
+            _, big_fp_full, big_fp = measure(
+                Generator(big_params, dtype=jnp.bfloat16, **big_cfg),
+                m_prompts=big_prompts, m_new=big_new,
+            )
+            _, big_q_full, big_q = measure(
+                Generator(big_params, dtype=jnp.bfloat16, quantize="int8",
+                          **big_cfg),
+                m_prompts=big_prompts, m_new=big_new,
+            )
+            result["big_decode_tokens_per_s"] = round(
+                batch * (big_new - 1) / big_fp, 1
+            )
+            result["int8_big_decode_tokens_per_s"] = round(
+                batch * (big_new - 1) / big_q, 1
+            )
+            result["int8_vs_fp_decode_big"] = round(big_fp / big_q, 2)
+            # the raw spans, so a degenerate subtraction is visible in
+            # the full file instead of laundering into the ratio
+            result["big_spans_ms"] = {
+                "fp_full": round(big_fp_full * 1e3, 1),
+                "fp_decode": round(big_fp * 1e3, 1),
+                "int8_decode": round(big_q * 1e3, 1),
+            }
+            result["big_config"] = "d2048 L8 H16 v16384 (~470M params, 256 steps)"
+        except Exception as e:  # noqa: BLE001
+            result["int8_big_error"] = str(e)[:200]
 
     # speculative x continuous batching: same streams through the paged
     # engine plain vs with per-slot draft/verify — identical greedy

@@ -751,6 +751,16 @@ bool is_h2_preface(const std::string& in, bool* maybe) {
   return in.size() >= kPrefaceLen;
 }
 
+// HPACK state exports for the load client (see h2grpc.h)
+void* hpack_state_new() { return new Hpack(); }
+
+void hpack_state_free(void* st) { delete (Hpack*)st; }
+
+bool hpack_state_decode(void* st, const char* block, size_t len,
+                        std::vector<std::pair<std::string, std::string>>* out) {
+  return hpack_decode((Hpack*)st, std::string(block, len), out);
+}
+
 // ---------------------------------------------------------------------------
 // SeldonMessage proto codec (manual wire format)
 // ---------------------------------------------------------------------------

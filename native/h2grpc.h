@@ -70,6 +70,23 @@ class Conn {
 // preface (and they match).  `maybe` reports "could still become one".
 bool is_h2_preface(const std::string& in, bool* maybe);
 
+// --- HPACK decoding for the h2 load client -----------------------------
+//
+// The load client originally recognised trailers by memmem'ing for the
+// server's raw never-indexed "grpc-status" literals — enough for THIS
+// server, but a grpc-python peer Huffman-codes and dynamic-table-
+// indexes its response headers (the first response installs table
+// entries, every later one references them), so driving third-party
+// servers needs the real decoder.  These wrap the server-side HPACK
+// state (static+dynamic table, Huffman) for per-connection use.
+void* hpack_state_new();
+void hpack_state_free(void* st);
+// Decode one complete header block; appends (name, value) pairs.
+// Returns false on a malformed block (treat the connection as dead —
+// HPACK state is connection-scoped and now unsynchronised).
+bool hpack_state_decode(void* st, const char* block, size_t len,
+                        std::vector<std::pair<std::string, std::string>>* out);
+
 // --- minimal SeldonMessage proto codec (wire format, no protobuf lib) ---
 //
 // Parse a seldon.protos.SeldonMessage: extracts the numeric payload as

@@ -27,7 +27,10 @@
 #include <cstdint>
 #include <cstring>
 #include <string>
+#include <utility>
 #include <vector>
+
+#include "h2grpc.h"  // HPACK response decoding (hpack_state_*)
 
 namespace {
 
@@ -327,6 +330,11 @@ struct H2LoadConn {
   std::string outbuf;
   size_t out_off = 0;
   std::string inbuf;
+  // connection-scoped HPACK decode state (lazily created): required
+  // for third-party peers (grpc-python Huffman-codes and dynamic-
+  // table-indexes response headers; the old literal-scan classifier
+  // only understood THIS repo's stateless never-indexed encoding)
+  void* hp = nullptr;
 };
 
 void h2_frame_header(std::string* out, uint32_t len, uint8_t type,
@@ -361,25 +369,36 @@ void h2_append_request(std::string* out, const uint8_t* hdr_block,
   } while (off < data_len);
 }
 
-// returns 1 trailers-ok, 2 trailers-error, 0 not a completion
-int h2_classify_frame(uint8_t type, uint8_t flags, const char* payload,
-                      uint32_t len) {
+// returns 1 trailers-ok, 2 trailers-error, 0 not a completion,
+// -1 fatal (undecodable block: the connection's HPACK state is now
+// unsynchronised and every later block would misread — kill the conn)
+int h2_classify_frame(void** hp, uint8_t type, uint8_t flags,
+                      const char* payload, uint32_t len) {
   if (type == 0x3 /*RST*/) return 2;
-  if (type != 0x1 /*HEADERS*/ || !(flags & 0x1 /*END_STREAM*/)) return 0;
-  // server encodes trailers as raw never-indexed literals:
-  // 0x10 len("grpc-status") "grpc-status" len(v) v
-  static const char kKey[] = "grpc-status";
-  for (uint32_t i = 0; i + sizeof(kKey) - 1 + 2 <= len; i++) {
-    if (memcmp(payload + i, kKey, sizeof(kKey) - 1) == 0) {
-      uint32_t vpos = i + sizeof(kKey) - 1;
-      if (vpos + 1 < len) {
-        uint8_t vlen = (uint8_t)payload[vpos];
-        if (vlen >= 1 && vpos + 1 + vlen <= len)
-          return (vlen == 1 && payload[vpos + 1] == '0') ? 1 : 2;
-      }
-    }
+  if (type != 0x1 /*HEADERS*/) return 0;
+  size_t off = 0, end = len;
+  if (flags & 0x8 /*PADDED*/) {
+    if (end < 1) return -1;
+    uint8_t pad = (uint8_t)payload[0];
+    off = 1;
+    if (pad >= end - off) return -1;
+    end -= pad;
   }
-  return 2;  // trailers without a readable grpc-status: count as error
+  if (flags & 0x20 /*PRIORITY*/) {
+    if (end - off < 5) return -1;
+    off += 5;
+  }
+  if (!(flags & 0x4 /*END_HEADERS*/)) return -1;  // CONTINUATION: unsupported
+  if (*hp == nullptr) *hp = h2::hpack_state_new();
+  // EVERY block must be decoded — response headers too — or the
+  // connection's dynamic table desynchronises from the peer's encoder
+  std::vector<std::pair<std::string, std::string>> hdrs;
+  if (!h2::hpack_state_decode(*hp, payload + off, end - off, &hdrs)) return -1;
+  if (!(flags & 0x1 /*END_STREAM*/)) return 0;  // initial response headers
+  for (auto& kv : hdrs) {
+    if (kv.first == "grpc-status") return kv.second == "0" ? 1 : 2;
+  }
+  return 2;  // stream end without grpc-status: not a healthy gRPC reply
 }
 
 }  // namespace
@@ -425,6 +444,10 @@ int64_t lg_run_h2(const uint8_t* hdr_block, int64_t hdr_len,
       epoll_ctl(ep, EPOLL_CTL_DEL, conns[i].fd, nullptr);
       close(conns[i].fd);
       conns[i].fd = -1;
+    }
+    if (conns[i].hp != nullptr) {
+      h2::hpack_state_free(conns[i].hp);
+      conns[i].hp = nullptr;
     }
     if (!conns[i].dead && as_error) ++errors;
     conns[i].dead = true;
@@ -553,9 +576,17 @@ int64_t lg_run_h2(const uint8_t* hdr_block, int64_t hdr_len,
           } else if (type == 0x7 /*GOAWAY*/) {
             peer_closed = true;
           } else {
-            int cls = h2_classify_frame(type, flags,
+            int cls = h2_classify_frame(&c.hp, type, flags,
                                         c.inbuf.data() + pos + 9, flen);
-            if (cls != 0) {
+            if (cls < 0) {
+              // undecodable header block: the HPACK state is now
+              // desynchronised, so any LATER buffered frame would be
+              // classified against garbage — stop parsing this
+              // connection entirely, don't just mark it
+              peer_closed = true;
+              pos += 9 + flen;
+              break;
+            } else if (cls != 0) {
               c.in_flight--;
               completed_any = true;
               if (cls == 1) ++ok; else ++bad;
@@ -582,6 +613,7 @@ int64_t lg_run_h2(const uint8_t* hdr_block, int64_t hdr_len,
 
   for (size_t i = 0; i < conns.size(); ++i) {
     if (conns[i].fd >= 0) close(conns[i].fd);
+    if (conns[i].hp != nullptr) h2::hpack_state_free(conns[i].hp);
   }
   close(ep);
   if (non2xx_out) *non2xx_out = bad;

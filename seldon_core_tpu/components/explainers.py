@@ -432,11 +432,15 @@ class AnchorsExplainer(TPUComponent):
     @staticmethod
     def _labels(preds: np.ndarray) -> np.ndarray:
         """Model outputs -> decision labels.  Multi-column outputs are
-        argmax'd; a SINGLE score column (binary probability / logistic
-        margin — e.g. the xgboost binary fallback returns (N,)) is
-        thresholded at 0.5.  Without this, a 1-wide output argmaxes to
-        class 0 for every row and EVERY rule reads precision 1.0 — an
-        arbitrary anchor reported as a perfect explanation."""
+        argmax'd; a SINGLE column is treated as a binary PROBABILITY in
+        [0, 1] and thresholded at 0.5 (e.g. the xgboost
+        binary:logistic fallback returns (N,) probabilities).  Without
+        this, a 1-wide output argmaxes to class 0 for every row and
+        EVERY rule reads precision 1.0 — an arbitrary anchor reported
+        as a perfect explanation.  NOTE: a raw-MARGIN single column
+        (decision boundary 0, not 0.5) must be wrapped to probabilities
+        (or two columns) before anchoring — the explainer cannot guess
+        an arbitrary score's boundary."""
         p = np.asarray(preds)
         if p.ndim == 1:
             p = p[:, None]

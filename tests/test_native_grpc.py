@@ -439,3 +439,48 @@ def _ndarray_row(vals):
     for v in vals:
         lv.values.add().number_value = float(v)
     return lv
+
+
+class TestLoadClientAgainstGrpcPython:
+    """The C++ h2 load client drives THIRD-PARTY gRPC servers: the
+    r5 HPACK upgrade decodes dynamic-table/Huffman response headers
+    (grpc-python installs table entries with its first response and
+    indexes them afterwards — the old literal-scan classifier counted
+    every post-first response as an error).  This is what makes the
+    bench's relay-free native-vs-python stub comparison possible."""
+
+    def test_stub_load_against_grpc_python_server(self):
+        import asyncio
+
+        lib = get_lib()
+        if not hasattr(lib, "lg_run_h2"):
+            pytest.skip("lg_run_h2 not in native lib")
+        from seldon_core_tpu.engine import PredictorService, UnitSpec
+        from seldon_core_tpu.engine.server import Gateway
+        from seldon_core_tpu.engine.sync_server import build_sync_seldon_server
+        from seldon_core_tpu.native.frontserver import native_load_grpc
+
+        async def scenario():
+            svc = PredictorService(
+                UnitSpec(name="stub", type="MODEL", implementation="SIMPLE_MODEL")
+            )
+            gateway = Gateway([(svc, 1.0)])
+            server = build_sync_seldon_server(
+                gateway, asyncio.get_running_loop(),
+                max_message_bytes=16 * 1024 * 1024,
+            )
+            port = server.add_insecure_port("127.0.0.1:0")
+            server.start()
+            try:
+                return await asyncio.to_thread(
+                    native_load_grpc, port, "/seldon.protos.Seldon/Predict",
+                    _tensor_req([[1, 2, 3]]).SerializeToString(), 1.5, 2, 8,
+                )
+            finally:
+                server.stop(grace=None)
+
+        out = asyncio.run(scenario())
+        # many requests complete and NONE misclassify: the dynamic-table
+        # decode keeps working past the first response per connection
+        assert out["ok"] > 20
+        assert out["non2xx"] == 0 and out["errors"] == 0
