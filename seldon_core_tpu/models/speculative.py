@@ -80,7 +80,14 @@ class _PagedState:
         num_pages = max_len // page_size + 1  # + trash page 0
         cfg = module
         head_dim = cfg.d_model // cfg.num_heads
-        shape = (cfg.num_layers, num_pages, page_size, cfg.num_heads, head_dim)
+        from seldon_core_tpu.models.paged import pool_is_flat
+
+        # ONE shared layout decision with PagedEngine (cross-lane
+        # bit-equality depends on both lanes picking the same pool form)
+        if pool_is_flat(mesh):
+            shape = (cfg.num_layers, num_pages, page_size, cfg.d_model)
+        else:
+            shape = (cfg.num_layers, num_pages, page_size, cfg.num_heads, head_dim)
         # same tensor-parallel layout as PagedEngine (shared helper):
         # megatron param specs + pool sharded on heads, created sharded,
         # collectives inserted by XLA; mesh=None -> plain pools
@@ -89,6 +96,7 @@ class _PagedState:
         self.params, self.pk, self.pv = shard_decode_state(
             params, mesh, pool_shape=shape, dtype=dtype,
             model_axis=model_axis, min_weight_size=min_weight_size,
+            num_heads=cfg.num_heads,
         )
         # logical page p lives at pool page p+1 (0 is the trash page)
         self.table = jnp.arange(1, max_len // page_size + 1, dtype=jnp.int32)[None, :]

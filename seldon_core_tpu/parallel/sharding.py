@@ -110,10 +110,16 @@ def shard_decode_state(
     dtype,
     model_axis: str = MODEL_AXIS,
     min_weight_size: int = 16_384,
+    num_heads: Optional[int] = None,
 ):
     """Tensor-parallel layout for the paged-decode lanes: megatron param
-    specs + K/V pools sharded on their heads axis (dim 3 of
-    ``(layers, pages, page_size, heads, head_dim)``).
+    specs + K/V pools sharded on their heads axis — dim 3 of either
+    layout: split ``(layers, pages, page_size, heads, head_dim)`` or
+    flat ``(layers, pages, page_size, d_model)`` (d_model is head-major
+    contiguous, so a head-boundary-aligned partition of dim 3 is the
+    same sharding).  ``num_heads`` carries the divisibility constraint
+    for the flat layout (dim 3's size is d_model there, but shards must
+    align to head boundaries).
 
     Pools are created ALREADY SHARDED (jit with out_shardings) — a
     ``jnp.zeros`` then ``device_put`` would materialise the full pool
@@ -143,9 +149,12 @@ def shard_decode_state(
         params, mesh, model_axis=model_axis, min_weight_size=min_weight_size
     )
     axis_size = mesh_shape(mesh).get(model_axis, 1)
-    num_heads = pool_shape[3]
+    if num_heads is None:
+        num_heads = pool_shape[3]
     if axis_size > 1 and num_heads % axis_size == 0:
-        pool_spec = P(None, None, None, model_axis, None)
+        # trailing dims default to unsharded, so this spec covers both
+        # the rank-4 flat pool and the rank-5 split pool
+        pool_spec = P(None, None, None, model_axis)
     else:
         if axis_size > 1:
             import logging

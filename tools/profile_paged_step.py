@@ -66,7 +66,9 @@ def main():
     B, L = args.slots, args.layers
     h, hd = args.heads, args.d_model // args.heads
     params = eng.params
-    pk = jnp.zeros((L, args.pages, args.page_size, h, hd), jnp.bfloat16)
+    # match the engine's pool layout (flat (L, pages, ps, d) by default
+    # since r5; split (L, pages, ps, h, hd) under kernel mode)
+    pk = jnp.zeros(eng.pages_k.shape, jnp.bfloat16)
     pv = jnp.zeros_like(pk)
     logits = jnp.zeros((B, args.vocab), jnp.float32)
     # every slot mid-generation at a distinct length
