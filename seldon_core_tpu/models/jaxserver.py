@@ -167,6 +167,8 @@ class JaxServer(TPUComponent):
         warmup: bool = True,
         warmup_dtypes: Sequence[str] = ("float32", "uint8"),
         quantize: str = "",
+        precision: str = "",
+        calibration_batches: int = 4,
         normalize: bool = False,
         normalize_mean: Optional[Sequence[float]] = None,
         normalize_std: Optional[Sequence[float]] = None,
@@ -207,14 +209,25 @@ class JaxServer(TPUComponent):
         self.warmup_dtypes = tuple(warmup_dtypes)
         # quantize="int8": weight-only quantisation of the loaded
         # checkpoint (ops/surgery.py) — kernels live in HBM as int8,
-        # dequant fuses into the consuming matmul/conv inside the jit
-        from seldon_core_tpu.ops.surgery import validate_quantize_mode
+        # dequant fuses into the consuming matmul/conv inside the jit.
+        # precision widens the vocabulary: "int8w" is the same weight-
+        # only lane, "w8a8" additionally runs int8×int8 compute on the
+        # MXU (ops/w8a8.py) with activation scales calibrated at load.
+        from seldon_core_tpu.ops.surgery import (
+            quantize_mode_for,
+            validate_precision,
+            validate_quantize_mode,
+        )
 
         try:
             validate_quantize_mode(quantize)
+            validate_precision(precision)
         except ValueError as e:
             raise MicroserviceError(str(e), status_code=400, reason="BAD_QUANTIZE")
-        self.quantize = quantize
+        self.precision = precision
+        self.quantize = quantize or quantize_mode_for(precision)
+        self.calibration_batches = int(calibration_batches)
+        self.act_scales_calibrated = 0
         self.quantize_manifest: List[Dict[str, Any]] = []
         # normalize=True: uint8 image batches go through the fused
         # pallas cast+affine kernel (ops.fused_normalize) before the
@@ -248,10 +261,43 @@ class JaxServer(TPUComponent):
 
         dtype = _compute_dtype(self.dtype_name)
         registry = _model_registry()
+        model_kwargs = dict(self.model_kwargs)
+        if self.precision == "w8a8":
+            # the knob rides model_kwargs so any registry module with a
+            # ``precision`` field (the resnet family) picks it up with
+            # zero plumbing; dotted-path factories receive it explicitly
+            # below — both paths fail loudly if the model can't take it
+            mk_precision = model_kwargs.get("precision")
+            if mk_precision not in (None, "w8a8"):
+                # a conflicting model_kwargs value must not silently win
+                # over the server-level knob: /health/status would
+                # report w8a8 while the module computes something else
+                raise MicroserviceError(
+                    f"precision={self.precision!r} conflicts with "
+                    f"model_kwargs precision={mk_precision!r}",
+                    status_code=400,
+                    reason="BAD_PRECISION",
+                )
+            model_kwargs["precision"] = "w8a8"
         if self.model_name in registry:
-            module, default_shape = registry[self.model_name](
-                self.num_classes, dtype, **self.model_kwargs
-            )
+            try:
+                module, default_shape = registry[self.model_name](
+                    self.num_classes, dtype, **model_kwargs
+                )
+            except TypeError as e:
+                # only claim a precision problem when the TypeError IS
+                # about the precision kwarg — any other bad model_kwarg
+                # must surface as itself, not send the operator to
+                # debug the wrong knob
+                if self.precision == "w8a8" and "precision" in str(e):
+                    raise MicroserviceError(
+                        f"model {self.model_name!r} does not take a "
+                        f"precision kwarg (w8a8 is supported by the resnet "
+                        f"family and precision-aware custom factories): {e}",
+                        status_code=400,
+                        reason="BAD_PRECISION",
+                    ) from None
+                raise
         else:
             # dotted path to a factory: returns module or (module, shape)
             import importlib
@@ -264,7 +310,24 @@ class JaxServer(TPUComponent):
                     reason="UNKNOWN_MODEL",
                 )
             factory = getattr(importlib.import_module(module_name), attr)
-            built = factory(num_classes=self.num_classes, dtype=dtype)
+            factory_kwargs = dict(num_classes=self.num_classes, dtype=dtype)
+            if self.precision == "w8a8":
+                # the knob must reach the factory or fail loudly: a
+                # dotted factory that silently ignores it would serve
+                # bf16 compute under a w8a8 label — the wrong-lane
+                # failure mode the HLO audit exists to prevent
+                factory_kwargs["precision"] = "w8a8"
+            try:
+                built = factory(**factory_kwargs)
+            except TypeError as e:
+                if self.precision == "w8a8" and "precision" in str(e):
+                    raise MicroserviceError(
+                        f"model factory {self.model_name!r} does not take a "
+                        f"precision kwarg (required for w8a8): {e}",
+                        status_code=400,
+                        reason="BAD_PRECISION",
+                    ) from None
+                raise
             module, default_shape = built if isinstance(built, tuple) else (built, None)
         if self.input_shape is None:
             if default_shape is None:
@@ -281,6 +344,30 @@ class JaxServer(TPUComponent):
         import jax.numpy as jnp
 
         example = jnp.zeros((1, *self.input_shape), jnp.float32)
+
+        def split_act(template):
+            """Detach the act_scales collection from a restore template:
+            checkpoints were saved by precision-less modules, so the
+            w8a8 scales (calibrated at load, not stored) must not be
+            looked up in the checkpoint bytes."""
+            from flax.core import unfreeze
+
+            template = dict(unfreeze(template))
+            aux = {
+                k: template.pop(k) for k in ("act_scales",) if k in template
+            }
+            return template, aux
+
+        def concrete_aux(aux):
+            # eval_shape templates carry ShapeDtypeStructs; scales start
+            # at the uncalibrated zero either way
+            return {
+                k: jax.tree_util.tree_map(
+                    lambda s: jnp.zeros(getattr(s, "shape", ()), getattr(s, "dtype", jnp.float32)), v
+                )
+                for k, v in aux.items()
+            }
+
         if self.model_uri:
             from seldon_core_tpu.utils import storage
 
@@ -290,7 +377,9 @@ class JaxServer(TPUComponent):
 
                 ckptr = ocp.StandardCheckpointer()
                 template = jax.eval_shape(lambda: self.module.init(jax.random.key(0), example))
+                template, aux = split_act(template)
                 variables = ckptr.restore(os.path.abspath(path), template)
+                variables = {**dict(variables), **concrete_aux(aux)}
             else:
                 # flax msgpack file
                 from flax import serialization
@@ -303,8 +392,10 @@ class JaxServer(TPUComponent):
                         )
                     path = os.path.join(path, sorted(candidates)[0])
                 template = self.module.init(jax.random.key(0), example)
+                template, aux = split_act(template)
                 with open(path, "rb") as f:
                     variables = serialization.from_bytes(template, f.read())
+                variables = {**dict(variables), **concrete_aux(aux)}
             return variables
         # benchmark / smoke mode: random init
         return self.module.init(jax.random.key(self.seed), example)
@@ -330,18 +421,6 @@ class JaxServer(TPUComponent):
         compute_dtype = _compute_dtype(self.dtype_name)
         self.module = self._build_module()
         variables = self._init_or_load_params()
-        if self.quantize == "int8":
-            from seldon_core_tpu.ops.surgery import quantize_params, tree_hbm_bytes
-
-            bytes_fp = tree_hbm_bytes(variables)
-            variables, self.quantize_manifest = quantize_params(variables)
-            logger.info(
-                "int8 surgery: %d kernels quantized, params %.1f MB -> %.1f MB",
-                len(self.quantize_manifest),
-                bytes_fp / 1e6,
-                tree_hbm_bytes(variables) / 1e6,
-            )
-        self.variables = self._pin_params(variables)
 
         if self.normalize:
             from seldon_core_tpu.ops.kernels import imagenet_affine
@@ -357,13 +436,65 @@ class JaxServer(TPUComponent):
             else:
                 norm_scale, norm_shift = imagenet_affine()
 
+        if self.precision == "w8a8" and self.calibration_batches > 0:
+            # static PTQ calibration (Jacob et al. 2018): a few sample
+            # batches through the SAME preprocessing the serving path
+            # applies fix the per-tensor activation scales the int8
+            # programs read.  Runs on the fp tree BEFORE surgery (the
+            # capture pass needs plain kernels), host-side batches so
+            # no request ever sees an uncalibrated program.
+            from seldon_core_tpu.ops.w8a8 import calibrate_act_scales
+
+            crng = np.random.default_rng(self.seed + 101)
+            cb = min(8, self.max_batch_size)
+            batches = []
+            for _ in range(self.calibration_batches):
+                img = crng.integers(0, 256, size=(cb, *self.input_shape))
+                if self.normalize:
+                    x = img.astype(np.float32) * np.asarray(
+                        norm_scale, np.float32
+                    ) + np.asarray(norm_shift, np.float32)
+                else:
+                    x = img.astype(np.dtype(self.warmup_dtypes[0]))
+                batches.append(jnp.asarray(x))
+            variables, self.act_scales_calibrated = calibrate_act_scales(
+                self.module, variables, batches
+            )
+            logger.info(
+                "w8a8 calibration: %d activation scales fixed over %d batches",
+                self.act_scales_calibrated, len(batches),
+            )
+
+        if self.quantize == "int8":
+            from seldon_core_tpu.ops.surgery import quantize_params, tree_hbm_bytes
+
+            bytes_fp = tree_hbm_bytes(variables)
+            variables, self.quantize_manifest = quantize_params(variables)
+            logger.info(
+                "int8 surgery: %d kernels quantized, params %.1f MB -> %.1f MB",
+                len(self.quantize_manifest),
+                bytes_fp / 1e6,
+                tree_hbm_bytes(variables) / 1e6,
+            )
+        self.variables = self._pin_params(variables)
+
         self._apply_fn = None  # set below; used by loop_forward_rate
 
         def apply_fn(variables, x):
             if self.quantize == "int8":
                 from seldon_core_tpu.ops.surgery import dequantize_params
 
-                variables = dequantize_params(variables, compute_dtype)
+                # w8a8 dequantises to f32, not the compute dtype: the
+                # W8A8 layers RE-quantise the kernels in-graph, and a
+                # bf16 intermediate double-rounds — round(bf16(q*s)/s)
+                # can flip integers by ±1 vs the at-rest tensor.  The
+                # f32 tree is transient (fused into operand reads); the
+                # non-quantised layers (stem/head/BN) cast to their own
+                # dtype at compute exactly as before.
+                dequant_dtype = (
+                    jnp.float32 if self.precision == "w8a8" else compute_dtype
+                )
+                variables = dequantize_params(variables, dequant_dtype)
             if self.normalize and x.dtype == jnp.uint8:
                 from seldon_core_tpu.ops.kernels import fused_normalize
 
@@ -676,6 +807,8 @@ class JaxServer(TPUComponent):
         return {
             "model": self.model_name,
             "loaded": self._loaded,
+            "precision": self.precision or "bf16",
+            "quantize": self.quantize,
             "load_time_s": self._load_time_s,
             "buckets": list(self.batcher.buckets) if self.batcher else [],
             "signatures": [list(s) for s in self.accepted_shapes()] if self._loaded else [],

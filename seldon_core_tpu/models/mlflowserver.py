@@ -39,7 +39,19 @@ class _MiniPyfunc:
     """Load an MLmodel directory's sklearn flavor without mlflow."""
 
     def __init__(self, path: str):
-        import yaml
+        try:
+            # not declared dependencies of this package: a clean install
+            # without them must fail with an actionable serving error,
+            # not a raw ImportError from the data plane
+            import yaml
+        except ImportError as e:
+            raise MicroserviceError(
+                "the mlflow fallback lane needs pyyaml to parse the MLmodel "
+                "file — pip install pyyaml (or install mlflow itself for "
+                f"the full lane): {e}",
+                status_code=500,
+                reason="MISSING_DEPENDENCY",
+            ) from None
 
         mlmodel = os.path.join(path, "MLmodel")
         if not os.path.exists(mlmodel):
@@ -63,7 +75,16 @@ class _MiniPyfunc:
                 status_code=400,
                 reason="NEEDS_MLFLOW",
             )
-        import joblib
+        try:
+            import joblib
+        except ImportError as e:
+            raise MicroserviceError(
+                "the mlflow fallback lane needs joblib to load the sklearn "
+                "flavor — pip install joblib (or install mlflow itself for "
+                f"the full lane): {e}",
+                status_code=500,
+                reason="MISSING_DEPENDENCY",
+            ) from None
 
         self.model = joblib.load(os.path.join(path, rel))
 

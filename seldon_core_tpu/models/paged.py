@@ -35,11 +35,47 @@ need no dynamic control flow.
 
 from __future__ import annotations
 
+import logging
 import queue as _queue
 import threading
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
+
+logger = logging.getLogger(__name__)
+
+
+def paged_kernel_mode() -> str:
+    """The ``SELDON_TPU_PAGED_KERNEL`` env value ("0" | "1" | "force") —
+    the ONE place its vocabulary lives.  The block's kernel gate, the
+    pool-layout decision (:func:`pool_is_flat`) and the engine's
+    chunk-impl auto-select all read through here, so a new mode string
+    cannot leave the three silently disagreeing."""
+    import os
+
+    return os.environ.get("SELDON_TPU_PAGED_KERNEL", "0")
+
+
+def paged_kernel_requested(mode: Optional[str] = None) -> bool:
+    return (mode if mode is not None else paged_kernel_mode()) in ("1", "force")
+
+
+def paged_kernel_static_eligible(mode: str, mesh_absent: bool, dtype) -> bool:
+    """The STATIC half of the pallas decode-kernel gate, shared by the
+    block's trace-time ``use_kernel`` and the engine's chunk-impl
+    auto-select so the two cannot drift: requested by env, no TP mesh
+    (GSPMD can't partition the pallas call), bf16 pool, and a TPU
+    backend unless forced (interpret mode).  The block adds its
+    trace-local terms (decode step, split pool layout) on top."""
+    import jax
+    import jax.numpy as jnp
+
+    return (
+        paged_kernel_requested(mode)
+        and mesh_absent
+        and dtype == jnp.bfloat16
+        and (mode == "force" or jax.default_backend() == "tpu")
+    )
 
 from seldon_core_tpu.models.generate import _buckets_for
 from seldon_core_tpu.runtime.component import MicroserviceError, TPUComponent
@@ -55,6 +91,23 @@ def _build_modules():
     import jax
     import jax.numpy as jnp
 
+    def _dense(precision, features, dtype, name):
+        """Projection factory: ``precision="w8a8"`` swaps every decode
+        projection (qkv, attn_proj, mlp_in/out, the unembed head) for
+        the int8×int8 layer (ops/w8a8.py) — SAME params tree as
+        nn.Dense, so the TransformerLM checkpoint-parity invariant
+        holds across precisions.  The engine passes only ``params`` to
+        apply, so activation scales are dynamic PER-TOKEN (abs-max over
+        d only — never the slot axis, so one stream's quantisation grid
+        cannot depend on co-scheduled traffic, and the width-1 decode
+        and width-(k+1) speculative-verify programs quantise each token
+        identically: greedy exactness holds, tested)."""
+        if precision == "w8a8":
+            from seldon_core_tpu.ops.w8a8 import W8A8Dense
+
+            return W8A8Dense(features=features, dtype=dtype, name=name)
+        return nn.Dense(features, dtype=dtype, name=name)
+
     class PagedTransformerBlock(nn.Module):
         """TransformerBlock whose attention reads a paged K/V pool.
 
@@ -65,6 +118,7 @@ def _build_modules():
         num_heads: int
         mlp_ratio: int = 4
         dtype: Any = jnp.bfloat16
+        precision: str = "bf16"  # "w8a8": int8×int8 projections
         # decode fast path (pallas flash-decoding) — the engine turns
         # this off under tensor-parallel meshes: GSPMD cannot partition
         # a pallas_call whose BlockSpecs span the full heads axis, so a
@@ -83,14 +137,12 @@ def _build_modules():
             head_dim = d_model // heads
             batch, seg_len = x.shape[:2]
             y = nn.LayerNorm(dtype=jnp.float32)(x)
-            qkv = nn.Dense(3 * d_model, dtype=self.dtype, name="qkv")(y)
+            qkv = _dense(self.precision, 3 * d_model, self.dtype, "qkv")(y)
             q, k, v = jnp.split(qkv, 3, axis=-1)
             shape = (batch, seg_len, heads, head_dim)
             q, k, v = q.reshape(shape), k.reshape(shape), v.reshape(shape)
 
             scale = 1.0 / jnp.sqrt(head_dim).astype(q.dtype)
-
-            import os as _os
 
             # default OFF since r4's honest re-measurement: with
             # value-fetch timing barriers and two-point marginal cost,
@@ -100,18 +152,18 @@ def _build_modules():
             # 3.5k tok/s).  The kernels stay opt-in
             # (SELDON_TPU_PAGED_KERNEL=1/force + *_IMPL=stream|grid)
             # for toolchains where Mosaic's DMA issue overhead drops.
-            kernel_mode = _os.environ.get("SELDON_TPU_PAGED_KERNEL", "0")
             use_kernel = (
                 seg_len == 1
+                # decode_kernel=False is how the engine encodes a TP
+                # mesh; the static terms (env, dtype, backend) live in
+                # the shared predicate the chunk auto-select also uses
                 and self.decode_kernel
-                and self.dtype == jnp.bfloat16
                 # the kernels' BlockSpecs index the SPLIT (pages, ps,
                 # h, hd) layout — a flat pool (the r5 default) takes
                 # the gather path regardless of the env opt-in
                 and pk.ndim == 4
-                and (
-                    kernel_mode == "force"
-                    or (kernel_mode == "1" and jax.default_backend() == "tpu")
+                and paged_kernel_static_eligible(
+                    paged_kernel_mode(), True, self.dtype
                 )
             )
             if use_kernel:
@@ -178,11 +230,11 @@ def _build_modules():
                 )
                 attn = attn.reshape(batch, seg_len, d_model)
 
-            x = x + nn.Dense(d_model, dtype=self.dtype, name="attn_proj")(attn)
+            x = x + _dense(self.precision, d_model, self.dtype, "attn_proj")(attn)
             y = nn.LayerNorm(dtype=jnp.float32)(x)
-            y = nn.Dense(self.mlp_ratio * d_model, dtype=self.dtype, name="mlp_in")(y)
+            y = _dense(self.precision, self.mlp_ratio * d_model, self.dtype, "mlp_in")(y)
             y = nn.gelu(y)
-            x = x + nn.Dense(d_model, dtype=self.dtype, name="mlp_out")(y)
+            x = x + _dense(self.precision, d_model, self.dtype, "mlp_out")(y)
             return x, k, v
 
     class ChunkTransformerBlock(nn.Module):
@@ -206,6 +258,7 @@ def _build_modules():
         num_heads: int
         mlp_ratio: int = 4
         dtype: Any = jnp.bfloat16
+        precision: str = "bf16"  # "w8a8": int8×int8 projections
 
         @nn.compact
         def __call__(self, x, ctx_k, ctx_v, ring_k, ring_v, step, len0):
@@ -220,7 +273,7 @@ def _build_modules():
             head_dim = d_model // heads
             batch, seg_len = x.shape[:2]
             y = nn.LayerNorm(dtype=jnp.float32)(x)
-            qkv = nn.Dense(3 * d_model, dtype=self.dtype, name="qkv")(y)
+            qkv = _dense(self.precision, 3 * d_model, self.dtype, "qkv")(y)
             q, k, v = jnp.split(qkv, 3, axis=-1)
             shape = (batch, seg_len, heads, head_dim)
             q, k, v = q.reshape(shape), k.reshape(shape), v.reshape(shape)
@@ -247,11 +300,11 @@ def _build_modules():
                 + jnp.einsum("bhqk,bkhd->bqhd", ws, v)
             )
             attn = attn.reshape(batch, seg_len, d_model)
-            x = x + nn.Dense(d_model, dtype=self.dtype, name="attn_proj")(attn)
+            x = x + _dense(self.precision, d_model, self.dtype, "attn_proj")(attn)
             y = nn.LayerNorm(dtype=jnp.float32)(x)
-            y = nn.Dense(self.mlp_ratio * d_model, dtype=self.dtype, name="mlp_in")(y)
+            y = _dense(self.precision, self.mlp_ratio * d_model, self.dtype, "mlp_in")(y)
             y = nn.gelu(y)
-            x = x + nn.Dense(d_model, dtype=self.dtype, name="mlp_out")(y)
+            x = x + _dense(self.precision, d_model, self.dtype, "mlp_out")(y)
             return x, k, v
 
     class ChunkTransformerLM(nn.Module):
@@ -269,6 +322,7 @@ def _build_modules():
         num_heads: int = 8
         max_len: int = 2048
         dtype: Any = jnp.bfloat16
+        precision: str = "bf16"
 
         @nn.compact
         def __call__(self, tokens, positions, ctx_k, ctx_v, ring_k, ring_v,
@@ -284,12 +338,13 @@ def _build_modules():
             new_k, new_v = [], []
             for i in range(self.num_layers):
                 x, k, v = ChunkTransformerBlock(
-                    num_heads=self.num_heads, dtype=self.dtype, name=f"block_{i}"
+                    num_heads=self.num_heads, dtype=self.dtype,
+                    precision=self.precision, name=f"block_{i}"
                 )(x, ctx_k[i], ctx_v[i], ring_k[i], ring_v[i], step, len0)
                 new_k.append(k)
                 new_v.append(v)
             x = nn.LayerNorm(dtype=jnp.float32)(x)
-            logits = nn.Dense(self.vocab_size, dtype=self.dtype, name="head")(x)
+            logits = _dense(self.precision, self.vocab_size, self.dtype, "head")(x)
             return logits.astype(jnp.float32), jnp.stack(new_k), jnp.stack(new_v)
 
     class PagedTransformerLM(nn.Module):
@@ -306,6 +361,7 @@ def _build_modules():
         num_heads: int = 8
         max_len: int = 2048
         dtype: Any = jnp.bfloat16
+        precision: str = "bf16"
         decode_kernel: bool = True
 
         @nn.compact
@@ -322,12 +378,13 @@ def _build_modules():
             for i in range(self.num_layers):
                 x, k, v = PagedTransformerBlock(
                     num_heads=self.num_heads, dtype=self.dtype,
+                    precision=self.precision,
                     decode_kernel=self.decode_kernel, name=f"block_{i}"
                 )(x, pages_k[i], pages_v[i], block_tables, lengths)
                 new_k.append(k)
                 new_v.append(v)
             x = nn.LayerNorm(dtype=jnp.float32)(x)
-            logits = nn.Dense(self.vocab_size, dtype=self.dtype, name="head")(x)
+            logits = _dense(self.precision, self.vocab_size, self.dtype, "head")(x)
             return logits.astype(jnp.float32), jnp.stack(new_k), jnp.stack(new_v)
 
     return PagedTransformerBlock, PagedTransformerLM, ChunkTransformerLM
@@ -361,11 +418,9 @@ def pool_is_flat(mesh=None) -> bool:
     opt-in.  ONE shared decision for every lane (PagedEngine and the
     speculative _PagedState must agree, or cross-lane bit-equality
     breaks on layout)."""
-    import os
-
     if mesh is not None:
         return True
-    return os.environ.get("SELDON_TPU_PAGED_KERNEL", "0") not in ("1", "force")
+    return not paged_kernel_requested()
 
 
 def write_kv(pk, pv, new_k, new_v, block_tables, start, valid, *, page_size, max_len,
@@ -539,6 +594,7 @@ class PagedEngine:
         model_axis: str = "model",
         shard_min_weight_size: int = 16_384,
         quantize: str = "",
+        precision: str = "",
         speculative: Optional[Dict[str, Any]] = None,
     ):
         import jax
@@ -546,9 +602,19 @@ class PagedEngine:
 
         if max_len % page_size:
             raise ValueError(f"max_len {max_len} must be a multiple of page_size {page_size}")
-        from seldon_core_tpu.ops.surgery import validate_quantize_mode
+        from seldon_core_tpu.ops.surgery import (
+            quantize_mode_for,
+            validate_precision,
+            validate_quantize_mode,
+        )
 
         validate_quantize_mode(quantize)
+        # precision="w8a8": every decode projection runs int8×int8 with
+        # int32 accumulation (ops/w8a8.py, dynamic per-tensor activation
+        # scales) on top of the at-rest surgery; "int8w" is the
+        # weight-only lane under its serving-config name
+        self.precision = validate_precision(precision) or "bf16"
+        quantize = quantize or quantize_mode_for(self.precision)
         if quantize == "int8":
             # weight-only int8: weights rest in HBM at half the bytes
             # and dequantise once per chunk program (measured 1.38x
@@ -587,9 +653,11 @@ class PagedEngine:
         )
         self.prompt_buckets = sorted(set(prompt_buckets or _buckets_for(max_len)))
         head_dim = d_model // num_heads
+        module_precision = "w8a8" if self.precision == "w8a8" else "bf16"
         self.module = get_paged_lm_class()(
             vocab_size=vocab_size, d_model=d_model, num_layers=num_layers,
             num_heads=num_heads, max_len=max_len, dtype=dtype,
+            precision=module_precision,
             # pallas decode kernel and heads-sharded pools don't mix:
             # GSPMD can't partition the custom call, so a TP mesh would
             # all-gather the pool per layer per step
@@ -605,8 +673,49 @@ class PagedEngine:
         self.chunk_module = get_chunk_lm_class()(
             vocab_size=vocab_size, d_model=d_model, num_layers=num_layers,
             num_heads=num_heads, max_len=max_len, dtype=dtype,
+            precision=module_precision,
         )
-        self._chunk_impl = _os.environ.get("SELDON_TPU_CHUNK_IMPL", "ring")
+        # COUPLED ENV KNOBS: SELDON_TPU_PAGED_KERNEL opts into the
+        # pallas decode kernels, but those live in the POOL chunk's
+        # per-step attention — the default ring chunk never reads the
+        # pool per step, so with CHUNK_IMPL=ring the kernel opt-in
+        # would only buy the split-layout pool's 2x HBM padding
+        # (pool_is_flat keys on the kernel env) with ZERO speed effect.
+        # Unset CHUNK_IMPL therefore auto-selects the pool impl when
+        # the kernel opt-in can actually fire — same eligibility terms
+        # as the block's gate (bf16, no TP mesh, TPU backend unless
+        # forced); a requested-but-ineligible kernel keeps the ring
+        # chunk and says why.  An EXPLICIT ring choice wins but is
+        # warned about.
+        kernel_mode = paged_kernel_mode()
+        kernel_eligible = paged_kernel_static_eligible(
+            kernel_mode, mesh is None, dtype
+        )
+        self._chunk_impl = _os.environ.get("SELDON_TPU_CHUNK_IMPL", "")
+        if not self._chunk_impl:
+            self._chunk_impl = "pool" if kernel_eligible else "ring"
+            if kernel_eligible:
+                logger.info(
+                    "SELDON_TPU_PAGED_KERNEL is set: auto-selecting the pool "
+                    "chunk impl (the pallas decode kernel lives in its "
+                    "per-step attention; the ring chunk never reaches it)"
+                )
+            elif paged_kernel_requested(kernel_mode):
+                logger.warning(
+                    "SELDON_TPU_PAGED_KERNEL=%s requested but the kernel "
+                    "cannot run here (needs bf16, no TP mesh, and a TPU "
+                    "backend unless force) — keeping the ring chunk; note "
+                    "the env still selects the split pool layout",
+                    kernel_mode,
+                )
+        elif paged_kernel_requested(kernel_mode) and self._chunk_impl == "ring":
+            logger.warning(
+                "SELDON_TPU_PAGED_KERNEL is set but SELDON_TPU_CHUNK_IMPL="
+                "ring: the ring chunk never invokes the pallas decode "
+                "kernel, so this combination pays the split-layout pool's "
+                "2x HBM padding with no speed effect — set "
+                "SELDON_TPU_CHUNK_IMPL=pool to actually exercise the kernel"
+            )
         # pool storage layout (r5): FLAT (L, pages, ps, d_model) by
         # default — the split (h=8, hd=64) trailing dims pad 2x under
         # the TPU (8,128) tile (pool AND gathered-ctx buffers at 2.0x
@@ -739,10 +848,14 @@ class PagedEngine:
     def _materialize(self, params):
         """Once-per-program dequant of int8 weights (no-op for fp).
         Call at program ENTRY, never inside a scan step — per-step
-        dequant does not fuse and measured 0.48x on TPU."""
+        dequant does not fuse and measured 0.48x on TPU.  w8a8
+        dequantises to f32 so the W8A8 layers' in-graph re-quantisation
+        reproduces the at-rest integers exactly (a bf16 intermediate
+        double-rounds them by ±1)."""
         from seldon_core_tpu.ops.surgery import materialize
 
-        return materialize(params, self.quantize, self._dtype)
+        dtype = self._jnp.float32 if self.precision == "w8a8" else self._dtype
+        return materialize(params, self.quantize, dtype)
 
     def _build_prefill(self, bucket: int, k: int):
         """Prefill program for ``k`` same-bucket prompts in ONE call.
@@ -1755,6 +1868,7 @@ class StreamingLM(TPUComponent):
         max_steps_per_call: int = 0,
         mesh_axes: Optional[Dict[str, int]] = None,
         quantize: str = "",
+        precision: str = "",
         speculative: Optional[Dict[str, Any]] = None,
         **kwargs: Any,
     ):
@@ -1764,13 +1878,17 @@ class StreamingLM(TPUComponent):
             num_layers=int(num_layers), num_heads=int(num_heads),
             max_len=int(max_len),
         )
-        from seldon_core_tpu.ops.surgery import validate_quantize_mode
+        from seldon_core_tpu.ops.surgery import (
+            validate_precision,
+            validate_quantize_mode,
+        )
 
         self.engine_config = dict(
             page_size=int(page_size), num_pages=int(num_pages) or None,
             max_slots=int(max_slots), steps_per_call=int(steps_per_call),
             max_steps_per_call=int(max_steps_per_call),
             quantize=validate_quantize_mode(quantize),  # fail at construction
+            precision=validate_precision(precision),
             # speculative={"draft": "ngram", "draft_k": k, "ngram": n}:
             # per-slot draft/verify INSIDE the continuous-batching
             # engine — greedy-exact, one verify forward per chunk

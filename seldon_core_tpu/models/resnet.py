@@ -76,10 +76,31 @@ class ResNet(nn.Module):
     num_classes: int = 1000
     num_filters: int = 64
     dtype: Any = jnp.bfloat16
+    # "bf16" — every conv/dense in ``dtype`` (the default);
+    # "w8a8" — block convs run int8×int8 with int32 accumulation on the
+    # MXU (ops/w8a8.py: activation scales calibrated per-tensor via the
+    # act_scales collection, else dynamic per-sample; per-output-channel
+    # weight scales).  The 7×7 stem and the classifier head
+    # stay in ``dtype``: the standard PTQ per-layer fallback (first and
+    # last layers are the precision-sensitive ones, and the stem's
+    # 3-channel input is MXU-hostile anyway).  The params tree is
+    # IDENTICAL across precisions — checkpoints load unchanged.
+    precision: str = "bf16"
 
     @nn.compact
     def __call__(self, x, train: bool = False, capture_features: bool = False):
-        conv = partial(nn.Conv, use_bias=False, dtype=self.dtype)
+        if self.precision not in ("bf16", "w8a8"):
+            raise ValueError(
+                f"ResNet precision must be 'bf16' or 'w8a8', got {self.precision!r}"
+            )
+        if self.precision == "w8a8":
+            from seldon_core_tpu.ops.w8a8 import W8A8Conv
+
+            conv = partial(W8A8Conv, use_bias=False, dtype=self.dtype)
+        else:
+            conv = partial(nn.Conv, use_bias=False, dtype=self.dtype)
+        # stem: always full precision (per-layer bf16 fallback)
+        stem_conv = partial(nn.Conv, use_bias=False, dtype=self.dtype)
         norm = partial(
             nn.BatchNorm,
             use_running_average=not train,
@@ -88,7 +109,9 @@ class ResNet(nn.Module):
             dtype=jnp.float32,  # keep normalisation stats in f32
         )
         x = jnp.asarray(x, self.dtype)
-        x = conv(self.num_filters, (7, 7), (2, 2), padding=[(3, 3), (3, 3)], name="conv_init")(x)
+        x = stem_conv(
+            self.num_filters, (7, 7), (2, 2), padding=[(3, 3), (3, 3)], name="conv_init"
+        )(x)
         x = norm(name="bn_init")(x)
         x = nn.relu(x)
         x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")

@@ -97,9 +97,11 @@ class _MiniBooster:
         margin = np.full(len(X), self.base_margin)
         for t in self.trees:
             node = np.zeros(len(X), np.int64)
-            # all fixture/real trees are finite-depth; iterate until
-            # every row sits on a leaf (vectorised level stepping)
-            while True:
+            # vectorised level stepping, bounded: any FINITE tree routes
+            # every row to a leaf within node-count levels, so a longer
+            # walk means cyclic/malformed children — raise instead of
+            # wedging the serving thread in an unbounded loop
+            for _ in range(len(t["left"])):
                 internal = t["left"][node] != -1
                 if not internal.any():
                     break
@@ -111,6 +113,13 @@ class _MiniBooster:
                 )
                 nxt = np.where(go_left, t["left"][node], t["right"][node])
                 node = np.where(internal, nxt, node)
+            else:
+                raise MicroserviceError(
+                    "malformed tree: traversal did not reach a leaf within "
+                    "node-count levels (cyclic children?)",
+                    status_code=400,
+                    reason="BAD_MODEL",
+                )
             margin += t["cond"][node]
         if self.objective == "binary:logistic":
             return 1.0 / (1.0 + np.exp(-margin))

@@ -178,6 +178,35 @@ def validate_quantize_mode(quantize: str) -> str:
     return quantize
 
 
+PRECISIONS = ("", "bf16", "int8w", "w8a8")
+
+
+def validate_precision(precision: str) -> str:
+    """The serving precision lanes, one vocabulary for every server:
+
+    * ``bf16`` (or ``""``) — today's default: bf16 weights and compute;
+    * ``int8w`` — weight-only int8: kernels REST int8 in HBM (this
+      module's surgery), dequant fuses into the consumer, compute stays
+      bf16 — the at-rest-memory lane;
+    * ``w8a8`` — weight AND activation int8: at-rest surgery plus
+      int8×int8 compute with int32 accumulation (``ops/w8a8.py``) — the
+      MXU int8 lane mirroring the TensorRT INT8 serving path.
+    """
+    if precision not in PRECISIONS:
+        raise ValueError(
+            f"unknown precision {precision!r} (supported: "
+            + ", ".join(repr(p) for p in PRECISIONS if p) + ")"
+        )
+    return precision
+
+
+def quantize_mode_for(precision: str) -> str:
+    """At-rest storage mode a precision lane implies (int8w AND w8a8
+    both rest int8 — w8a8's in-compute requantisation reproduces the
+    surgery's integers exactly, so the two compose losslessly)."""
+    return "int8" if precision in ("int8w", "w8a8") else ""
+
+
 def materialize(params: Any, quantize: str, dtype=None) -> Any:
     """Inside-jit weight materialisation for a (possibly) quantized
     tree: the shared 'dequant if int8, else pass through' every serving

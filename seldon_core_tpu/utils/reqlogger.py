@@ -114,6 +114,8 @@ class KafkaPairLogger:
         self.topic = topic
         self._producer = MiniKafkaProducer(bootstrap_servers, timeout_s=timeout_s)
         self._queue: "queue.Queue[Optional[Dict]]" = queue.Queue(maxsize=capacity)
+        self._stopping = False  # close() sets it; the drain loop checks it
+        self._stop_deadline = float("inf")
         self._thread = threading.Thread(
             target=self._drain, daemon=True, name="seldon-tpu-kafkalog"
         )
@@ -130,8 +132,30 @@ class KafkaPairLogger:
 
     def _drain(self) -> None:
         while True:
-            pair = self._queue.get()
+            try:
+                # bounded get so the stop flag is observed even when the
+                # None sentinel could not be enqueued (queue full at
+                # close time)
+                pair = self._queue.get(timeout=0.25)
+            except queue.Empty:
+                if self._stopping:
+                    return
+                continue
             if pair is None:
+                return
+            if self._stopping and time.monotonic() > self._stop_deadline:
+                # deadline passed: remaining pairs are dropped (counted,
+                # excluding the None sentinel if queued), the same
+                # discipline as a full buffer — shutdown must not wait
+                # out a stuck broker
+                self.dropped += 1  # the pair in hand
+                while True:
+                    try:
+                        rest = self._queue.get_nowait()
+                    except queue.Empty:
+                        break
+                    if rest is not None:
+                        self.dropped += 1
                 return
             try:
                 key = (pair.get("puid") or "").encode() or None
@@ -145,7 +169,18 @@ class KafkaPairLogger:
                 self.failed += 1
                 logger.warning("kafka pair logger produce failed: %s", e)
 
-    def close(self) -> None:
-        self._queue.put(None)
-        self._thread.join(timeout=5.0)
+    def close(self, timeout_s: float = 5.0) -> None:
+        """Bounded shutdown: never blocks on a full queue or a stuck
+        broker.  Pending pairs still flush while the deadline allows
+        (the FIFO-sentinel behaviour of the old blocking ``put(None)``),
+        but the stop flag + deadline are the real signal — a blocking
+        put here could hang forever when the queue is full AND the
+        broker is wedged mid-send."""
+        self._stopping = True
+        self._stop_deadline = time.monotonic() + timeout_s
+        try:
+            self._queue.put_nowait(None)
+        except queue.Full:
+            pass  # drain loop's bounded get observes _stopping
+        self._thread.join(timeout=timeout_s)
         self._producer.close()

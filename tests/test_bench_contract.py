@@ -76,7 +76,22 @@ def _r3_like_full_result():
             "native_vs_reference_grpc": 3.969,
             "native_grpc_qps": 111044.0,
             "native_grpc_vs_reference": 3.93,
-            "int8": {"fp_images_per_s": 12839.8, "int8_images_per_s": 12758.9, "int8_vs_fp": 0.99},
+            "int8": {
+                "fp_images_per_s": 12839.8,
+                "int8_images_per_s": 12758.9,
+                "int8_vs_fp": 0.99,
+                "w8a8_images_per_s": 21000.0,
+                "w8a8_vs_fp": 1.64,
+                "fp_big_images_per_s": 13000.0,
+                "w8a8_big_images_per_s": 24000.0,
+                "w8a8_loop_vs_fp": 1.85,
+                "w8a8_top1_agree": 0.997,
+                "w8a8_mxu_lowered": True,
+                "w8a8_vs_a100_triton": 0.62,
+                "w8a8_hlo": {"verdict": "int8", "int8_ops": 49,
+                             "int_widened_ops": 0, "float_ops": 4,
+                             "evidence": ["%convolution = s32[...] convolution(s8[...], s8[...])"]},
+            },
             "generation": {
                 "decode_tokens_per_s": 8877.5,
                 "overall_tokens_per_s": 5149.1,
@@ -140,6 +155,13 @@ def test_compact_line_carries_judge_scalars(bench):
     # int8 + generation + native-model (the r2/r3 certification asks)
     assert e["int8_fwd_x"] == 0.99
     assert e["int8_decode_x"] == 1.1
+    # the w8a8 certification keys (r6 acceptance: the compact line must
+    # print the ratio pair + top-1 agreement + the upcast guard)
+    assert e["w8a8_fwd_x"] == 1.64
+    assert e["w8a8_loop_x"] == 1.85
+    assert e["w8a8_top1_agree"] == 0.997
+    assert e["w8a8_mxu"] is True
+    assert e["w8a8_vs_a100"] == 0.62
     assert e["gen_tok_s"] == 8877.5
     assert e["paged_tok_s"] == 4400.0
     assert e["native_img_s"] == 96.0

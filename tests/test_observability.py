@@ -701,6 +701,60 @@ class TestKafkaPairLogger:
         logger.close()
         assert logger.failed == 1 and logger.sent == 0
 
+    def test_close_is_bounded_with_full_queue_and_stuck_broker(self, monkeypatch):
+        """Shutdown must not hang when the queue is full AND the broker
+        is wedged mid-send: the old blocking put(None) waited for queue
+        room that a stuck drain thread would never free.  close() now
+        signals a stop flag with a deadline and returns."""
+        import time as _time
+
+        from seldon_core_tpu.runtime.message import InternalMessage
+        from seldon_core_tpu.utils.reqlogger import KafkaPairLogger
+
+        broker = FakeKafkaBroker(partitions=1)
+        try:
+            logger = KafkaPairLogger(
+                f"127.0.0.1:{broker.port}", topic="t", capacity=1
+            )
+            # wedge the producer: every send blocks far past the test
+            monkeypatch.setattr(
+                logger._producer, "send",
+                lambda *a, **k: _time.sleep(30),
+            )
+            req = InternalMessage(payload=np.asarray([[1.0]]), kind="ndarray")
+            req.meta.puid = "p"
+            # first pair occupies the drain thread inside the stuck
+            # send; the second fills the capacity-1 queue
+            logger(req, req.with_payload(np.asarray([[2.0]])))
+            deadline = _time.monotonic() + 2.0
+            while logger._queue.qsize() > 0 and _time.monotonic() < deadline:
+                _time.sleep(0.01)  # wait for the drain thread to pick up #1
+            logger(req, req.with_payload(np.asarray([[2.0]])))
+            assert logger._queue.full()
+            t0 = _time.monotonic()
+            logger.close(timeout_s=0.5)
+            assert _time.monotonic() - t0 < 5.0  # bounded, not wedged
+        finally:
+            broker.close()
+
+    def test_close_still_flushes_pending_pairs(self):
+        """The bounded close keeps the old flush semantics when the
+        broker is healthy: pairs enqueued before close() land."""
+        from seldon_core_tpu.runtime.message import InternalMessage
+        from seldon_core_tpu.utils.reqlogger import KafkaPairLogger
+
+        broker = FakeKafkaBroker(partitions=1)
+        try:
+            logger = KafkaPairLogger(f"127.0.0.1:{broker.port}", topic="t")
+            req = InternalMessage(payload=np.asarray([[1.0]]), kind="ndarray")
+            req.meta.puid = "p"
+            for _ in range(5):
+                logger(req, req.with_payload(np.asarray([[2.0]])))
+            logger.close()
+            assert logger.sent == 5 and len(broker.records) == 5
+        finally:
+            broker.close()
+
     def test_producer_roundtrip_primitives(self):
         """encode/decode of the v0 message set are inverses and CRC'd
         (the recorded-bytes half of the contract)."""

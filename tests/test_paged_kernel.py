@@ -109,26 +109,77 @@ def test_kernel_matches_float64_host_oracle(impl, monkeypatch):
     assert float(np.max(np.abs(np.asarray(m, np.float64) - m64))) < 1e-4, impl
 
 
-def test_engine_tokens_identical_kernel_vs_gather(monkeypatch):
-    from seldon_core_tpu.models.paged import PagedEngine
+def _lm_fixture():
     from seldon_core_tpu.models.transformer import TransformerLM
 
     cfg = dict(vocab_size=256, d_model=64, num_layers=2, num_heads=4, max_len=256)
     module = TransformerLM(dtype=jnp.bfloat16, **cfg)
     params = module.init(jax.random.key(0), jnp.zeros((1, 8), jnp.int32))["params"]
     prompts = [np.arange(5 + 7 * i, dtype=np.int32) % 256 for i in range(4)]
+    return cfg, params, prompts
+
+
+def _run_engine(cfg, params, prompts):
+    from seldon_core_tpu.models.paged import PagedEngine
+
+    eng = PagedEngine(
+        params, dtype=jnp.bfloat16, page_size=32, max_slots=4,
+        steps_per_call=8, **cfg,
+    )
+    streams = [eng.submit(p, max_new_tokens=24) for p in prompts]
+    eng.run()
+    return np.stack([s.result for s in streams]), eng
+
+
+def test_engine_tokens_identical_kernel_vs_gather(monkeypatch):
+    cfg, params, prompts = _lm_fixture()
 
     def run(mode, impl="stream"):
         monkeypatch.setenv("SELDON_TPU_PAGED_KERNEL", mode)
         monkeypatch.setenv("SELDON_TPU_PAGED_KERNEL_IMPL", impl)
-        eng = PagedEngine(
-            params, dtype=jnp.bfloat16, page_size=32, max_slots=4,
-            steps_per_call=8, **cfg,
-        )
-        streams = [eng.submit(p, max_new_tokens=24) for p in prompts]
-        eng.run()
-        return np.stack([s.result for s in streams])
+        # the decode kernel lives in the POOL chunk's per-step
+        # attention — the default ring chunk never reads the pool per
+        # step, so without this the kernel gate was never reached and
+        # the test compared the gather path to itself
+        monkeypatch.setenv("SELDON_TPU_CHUNK_IMPL", "pool")
+        toks, eng = _run_engine(cfg, params, prompts)
+        assert eng._chunk_impl == "pool"
+        return toks
 
-    gather = run("0")
+    monkeypatch.delenv("SELDON_TPU_CHUNK_IMPL", raising=False)
+    monkeypatch.setenv("SELDON_TPU_PAGED_KERNEL", "0")
+    gather, _ = _run_engine(cfg, params, prompts)
     for impl in ("stream", "grid"):  # interpret-mode pallas on CPU
         assert np.array_equal(gather, run("force", impl)), impl
+
+
+def test_kernel_optin_autoselects_pool_chunk(monkeypatch):
+    """The two env knobs are coupled: SELDON_TPU_PAGED_KERNEL opts into
+    kernels that only the pool chunk invokes.  With CHUNK_IMPL unset the
+    engine auto-selects the pool impl (otherwise the opt-in silently
+    pays the split-layout pool's 2x HBM padding with zero speed
+    effect); an explicit ring choice wins but is warned about."""
+    cfg, params, prompts = _lm_fixture()
+    monkeypatch.delenv("SELDON_TPU_CHUNK_IMPL", raising=False)
+    monkeypatch.setenv("SELDON_TPU_PAGED_KERNEL", "force")
+    _, eng = _run_engine(cfg, params, prompts)
+    assert eng._chunk_impl == "pool"
+    monkeypatch.setenv("SELDON_TPU_CHUNK_IMPL", "ring")
+    _, eng = _run_engine(cfg, params, prompts)
+    assert eng._chunk_impl == "ring"  # explicit choice respected
+
+
+def test_ring_vs_pool_chunk_token_parity(monkeypatch):
+    """A/B over the env-selectable chunk implementations (kernel OFF):
+    the ring chunk (r5 default) and the legacy per-step pool gather
+    must emit identical tokens — the fallback knob must be a pure
+    performance choice."""
+    cfg, params, prompts = _lm_fixture()
+    monkeypatch.setenv("SELDON_TPU_PAGED_KERNEL", "0")
+    monkeypatch.setenv("SELDON_TPU_CHUNK_IMPL", "ring")
+    ring, eng_ring = _run_engine(cfg, params, prompts)
+    assert eng_ring._chunk_impl == "ring"
+    monkeypatch.setenv("SELDON_TPU_CHUNK_IMPL", "pool")
+    pool, eng_pool = _run_engine(cfg, params, prompts)
+    assert eng_pool._chunk_impl == "pool"
+    assert np.array_equal(ring, pool)

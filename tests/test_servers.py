@@ -1178,6 +1178,26 @@ class TestXGBoostServerFallback:
         with pytest.raises(MicroserviceError, match="objective"):
             server.load()
 
+    def test_cyclic_tree_raises_instead_of_wedging(self, tmp_path):
+        """A malformed model whose children indices form a cycle must
+        raise a 400, not spin the serving thread forever: the level-
+        stepping loop is bounded by the tree's node count."""
+        from seldon_core_tpu.models.xgboostserver import XGBoostServer
+        from seldon_core_tpu.runtime.component import MicroserviceError
+
+        spec = self._booster_spec()
+        # node 0 -> node 1 -> node 0 -> ... : no row ever reaches a leaf
+        spec["learner"]["gradient_booster"]["model"]["trees"][0] = {
+            "left_children": [1, 0, -1],
+            "right_children": [1, 0, -1],
+            "split_indices": [0, 0, 0],
+            "split_conditions": [0.5, 0.5, 1.0],
+            "default_left": [1, 1, 0],
+        }
+        server = XGBoostServer(model_uri=self._write(tmp_path, spec))
+        with pytest.raises(MicroserviceError, match="malformed tree"):
+            server.predict(np.array([[0.2, 2.0]]), [])
+
 
 class TestMLFlowServerFallback:
     """The MLFLOW_SERVER lane executed for real: an MLmodel directory
@@ -1251,3 +1271,33 @@ class TestMLFlowServerFallback:
         server = MLFlowServer(model_uri=str(tmp_path))
         with pytest.raises(MicroserviceError, match="sklearn flavor"):
             server.load()
+
+    def test_missing_pyyaml_is_clear_error(self, tmp_path, monkeypatch):
+        """yaml/joblib are not declared dependencies: on an image
+        without them the fallback lane must raise a MicroserviceError
+        with an install hint, not a raw ImportError."""
+        import sys
+
+        from seldon_core_tpu.models.mlflowserver import MLFlowServer
+        from seldon_core_tpu.runtime.component import MicroserviceError
+
+        self._mlmodel_dir(tmp_path)
+        # None in sys.modules makes `import yaml` raise ImportError
+        monkeypatch.setitem(sys.modules, "yaml", None)
+        server = MLFlowServer(model_uri=str(tmp_path))
+        with pytest.raises(MicroserviceError, match="pyyaml") as e:
+            server.load()
+        assert e.value.reason == "MISSING_DEPENDENCY"
+
+    def test_missing_joblib_is_clear_error(self, tmp_path, monkeypatch):
+        import sys
+
+        from seldon_core_tpu.models.mlflowserver import MLFlowServer
+        from seldon_core_tpu.runtime.component import MicroserviceError
+
+        self._mlmodel_dir(tmp_path)
+        monkeypatch.setitem(sys.modules, "joblib", None)
+        server = MLFlowServer(model_uri=str(tmp_path))
+        with pytest.raises(MicroserviceError, match="joblib") as e:
+            server.load()
+        assert e.value.reason == "MISSING_DEPENDENCY"

@@ -1,0 +1,151 @@
+"""Is the int8 MXU path actually taken?  HLO evidence + measured ratio.
+
+The w8a8 lane's whole premise is the v5e's 394 TOPS int8 MXU path (2x
+its 197 TF/s bf16 peak) — but XLA is free to silently upcast an
+int8×int8 ``preferred_element_type=int32`` contraction, and a bf16
+program timed under an int8 label would fabricate the win.  This tool
+is the adjudicator the bench and docs cite:
+
+1. **Lowering audit** (`ops/w8a8.int8_lowering_report`): compile a
+   representative int8 matmul, an int8 conv at ResNet-50 shapes, and a
+   w8a8 ResNet forward; classify every dot/conv in the optimised HLO
+   by operand dtype — ``int8`` (s8 into the op: the MXU path),
+   ``int-widened`` (integer but s32 — CPU's exact-math fallback), or
+   ``float-upcast`` (the failure mode: quantised operands converted to
+   float before the op).  Evidence lines are printed verbatim.
+
+2. **Timing** (only meaningful on TPU): bf16-vs-int8 two-point chained
+   ``fori_loop`` matmul/conv — same honest-barrier methodology as
+   `tools/profile_conv.py` (value-fetch completion, seconds-scale
+   loops so the ~100 ms dispatch penalty cannot produce negative
+   slopes).  On the MXU the 4096² int8 matmul should approach 2x the
+   bf16 rate; ≈1.0x with an ``int8`` audit verdict means the MXU ran
+   int8 without a speed win (report it); ≈1.0x with ``float-upcast``
+   means the lane is a no-op (report THAT — no silent wins).
+
+Run:  python tools/profile_int8.py [--model resnet_tiny|resnet50]
+"""
+
+import argparse
+import os
+import sys
+import time
+
+# runnable as `python tools/profile_int8.py` from a checkout, like the
+# sibling profilers run with the package importable
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _audit(name, fn, *args):
+    from seldon_core_tpu.ops.w8a8 import int8_lowering_report
+
+    rep = int8_lowering_report(fn, *args)
+    print(f"[audit] {name}: verdict={rep['verdict']} "
+          f"int8_majority={rep['int8_majority']} "
+          f"(s8 ops={rep['int8_ops']}, int-widened={rep['int_widened_ops']}, "
+          f"float={rep['float_ops']}, backend={rep['backend']})")
+    for line in rep["evidence"][:4]:
+        print(f"        {line}")
+    return rep
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--model", default="resnet_tiny",
+                        help="resnet family model for the end-to-end audit")
+    parser.add_argument("--skip-timing", action="store_true")
+    args = parser.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from seldon_core_tpu.ops.w8a8 import w8a8_conv, w8a8_matmul
+
+    print(f"backend: {jax.default_backend()}  devices: {jax.devices()}")
+
+    # ---- lowering audits -------------------------------------------------
+    x = jnp.asarray(np.random.default_rng(0).normal(size=(256, 1024)), jnp.float32)
+    w = jnp.asarray(np.random.default_rng(1).normal(size=(1024, 1024)), jnp.float32)
+    _audit("w8a8 matmul 256x1024x1024", lambda a, b: w8a8_matmul(a, b), x, w)
+
+    xc = jnp.asarray(
+        np.random.default_rng(2).normal(size=(8, 14, 14, 256)), jnp.float32
+    )
+    wc = jnp.asarray(
+        np.random.default_rng(3).normal(size=(3, 3, 256, 256)), jnp.float32
+    )
+    _audit("w8a8 conv 3x3 c=256 @14",
+           lambda a, b: w8a8_conv(a, b, (1, 1), "SAME"), xc, wc)
+
+    # end-to-end: the served w8a8 ResNet program (stem/head stay bf16 by
+    # design, so float convs are EXPECTED — the verdict that matters is
+    # that s8/int ops exist at all alongside them)
+    from seldon_core_tpu.models.jaxserver import JaxServer
+
+    server = JaxServer(
+        model=args.model,
+        num_classes=10 if args.model == "resnet_tiny" else 1000,
+        input_shape=(32, 32, 3) if args.model == "resnet_tiny" else (224, 224, 3),
+        dtype="bfloat16" if jax.default_backend() == "tpu" else "float32",
+        max_batch_size=8, warmup=False, precision="w8a8",
+    )
+    server.load()
+    img = jnp.zeros((8, *server.input_shape), jnp.uint8)
+    rep = _audit(f"w8a8 {args.model} forward",
+                 server._apply_fn, server.variables, img)
+    server.unload()
+    if rep["int8_ops"] == 0 and rep["int_widened_ops"] == 0:
+        print("[audit] !! the w8a8 model lowered to float ops only — "
+              "the int8 lane is a silent upcast on this backend")
+
+    if args.skip_timing:
+        return
+
+    # ---- timing: bf16 vs int8, chained fori_loop, value-fetch barrier ----
+    def probe_matmul_pair(n=4096, iters=64):
+        key = jax.random.key(0)
+        a16 = jax.random.normal(key, (n, n), jnp.bfloat16) * 0.01
+        b16 = jax.random.normal(jax.random.key(1), (n, n), jnp.bfloat16) * 0.01
+        a8 = jnp.clip(jnp.round(a16.astype(jnp.float32) * 100), -127, 127).astype(jnp.int8)
+        b8 = jnp.clip(jnp.round(b16.astype(jnp.float32) * 100), -127, 127).astype(jnp.int8)
+
+        def run_bf16(a, b, it):
+            def body(i, x):
+                return (x @ b) * (1.0 / n)
+
+            return jax.lax.fori_loop(0, it, body, a)[0, 0].astype(jnp.float32)
+
+        def run_int8(a, b, it):
+            # chained int8: requantise the int32 accumulator back to
+            # int8 each step so every iteration feeds the int8 op
+            def body(i, x):
+                acc = jax.lax.dot_general(
+                    x, b, (((1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.int32,
+                )
+                return jnp.clip(acc // n, -127, 127).astype(jnp.int8)
+
+            return jax.lax.fori_loop(0, it, body, a)[0, 0].astype(jnp.float32)
+
+        results = {}
+        for tag, fn, ops in (("bf16", run_bf16, (a16, b16)),
+                             ("int8", run_int8, (a8, b8))):
+            rj = jax.jit(fn)
+            float(rj(*ops, 4))  # compile
+            t0 = time.perf_counter(); float(rj(*ops, 4)); d1 = time.perf_counter() - t0
+            t0 = time.perf_counter(); float(rj(*ops, 4 + iters)); d2 = time.perf_counter() - t0
+            dt = max((d2 - d1) / iters, 1e-9)
+            tops = 2 * n ** 3 / dt / 1e12
+            results[tag] = dt
+            print(f"[time] matmul {n}² {tag}: {dt*1e3:7.3f} ms  {tops:6.1f} T(FL)OP/s")
+        print(f"[time] int8-vs-bf16 matmul ratio: "
+              f"{results['bf16'] / results['int8']:.2f}x "
+              f"(MXU int8 target ≈2x; ≈1x = no win; <1x = int8 slower)")
+
+    probe_matmul_pair(4096 if jax.default_backend() == "tpu" else 512,
+                      64 if jax.default_backend() == "tpu" else 8)
+
+
+if __name__ == "__main__":
+    main()
