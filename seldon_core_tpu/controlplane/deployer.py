@@ -215,6 +215,21 @@ def _spawn_remote_workers(spec: TpuDeployment):
     if not remote_units:
         return None
 
+    # worker boot covers interpreter + framework import + model load;
+    # compile-heavy components (generation engines) can exceed the 30 s
+    # default on slow hosts — the annotation mirrors the reference's
+    # readiness-gate tunables (initialDelaySeconds on the engine pod)
+    try:
+        ready_s = float(
+            spec.annotations.get("seldon.io/worker-ready-timeout-s", "30")
+        )
+    except (TypeError, ValueError):
+        ready_s = float("nan")
+    if not ready_s > 0:  # catches 0 (skips the gate), negatives, NaN
+        raise DeploymentSpecError(
+            "seldon.io/worker-ready-timeout-s must be a positive number, "
+            f"got {spec.annotations.get('seldon.io/worker-ready-timeout-s')!r}"
+        )
     supervisor = Supervisor()
     try:
         for p, unit in remote_units:
@@ -240,7 +255,8 @@ def _spawn_remote_workers(spec: TpuDeployment):
                     # edges dial plaintext (the reference's in-cluster
                     # model), so workers must not inherit SELDON_TLS_*
                     env={"SELDON_TLS_CERT": "", "SELDON_TLS_KEY": "", "SELDON_TLS_CA": ""},
-                )
+                ),
+                wait_ready_s=ready_s,
             )
             unit.endpoint = Endpoint(host="127.0.0.1", port=grpc_port, transport=GRPC)
     except BaseException:

@@ -131,7 +131,17 @@ def _build_modules():
             # r5-default flat (num_pages, ps, d) — the gather below
             # reshapes either to (B, cache_len, h, hd), and the kernel
             # gate keys on pk.ndim (the pallas BlockSpecs need split)
-            # block_tables: (B, P) int32   lengths: (B,) tokens in cache
+            # block_tables: (B, P) int32, or a TUPLE of per-bucket
+            # tables ((B0, P0), (B1, P1), ...) with sum(Bb) == B — the
+            # r6 length-bucketed gather: lanes arrive bucket-sorted and
+            # each bucket gathers/attends at its own static page
+            # horizon (dense projections stay full-batch)
+            # lengths: (B,) tokens in cache
+            tables = (
+                tuple(block_tables)
+                if isinstance(block_tables, (tuple, list))
+                else (block_tables,)
+            )
             d_model = x.shape[-1]
             heads = self.num_heads
             head_dim = d_model // heads
@@ -172,61 +182,89 @@ def _build_modules():
                 # HBM->VMEM indexed by the block table; the
                 # (B, P, ps, h, hd) gathered copy below never
                 # materialises.  The current token merges via the flash
-                # rule.  NUMERIC REGIME: the kernel scores in f32 where
-                # the gather path scores in bf16, so on hardware a
-                # kernel-decode engine and a gather-path engine (e.g. a
-                # speculative verify program) can break argmax ties
-                # differently — each lane is deterministic, the f32
-                # exactness lanes always use the gather path, and
-                # SELDON_TPU_PAGED_KERNEL=0 restores one regime when
-                # cross-lane bit-equality matters more than speed.
+                # rule.  Under the bucketed gather each bucket is one
+                # kernel call at its own table width — the kernel's
+                # per-lane page loop is already length-bounded, so
+                # bucketing only trims the BlockSpec grid.  NUMERIC
+                # REGIME: the kernel scores in f32 where the gather path
+                # scores in bf16, so on hardware a kernel-decode engine
+                # and a gather-path engine (e.g. a speculative verify
+                # program) can break argmax ties differently — each lane
+                # is deterministic, the f32 exactness lanes always use
+                # the gather path, and SELDON_TPU_PAGED_KERNEL=0
+                # restores one regime when cross-lane bit-equality
+                # matters more than speed.
                 from seldon_core_tpu.ops.kernels import paged_attention_decode
 
-                q1 = (q * scale)[:, 0]  # (B, h, hd)
-                acc, m, l = paged_attention_decode(
-                    q1, pk, pv, block_tables, lengths,
-                    page_size=pk.shape[1],
-                )
-                s_self = jnp.einsum(
-                    "bhd,bhd->bh",
-                    q1.astype(jnp.float32), k[:, 0].astype(jnp.float32),
-                )
-                m2 = jnp.maximum(m, s_self)
-                alpha = jnp.exp(m - m2)
-                w_self = jnp.exp(s_self - m2)
-                l2 = l * alpha + w_self
+                outs = []
+                off = 0
+                for tb in tables:
+                    nb = tb.shape[0]
+                    sl = slice(off, off + nb)
+                    q1 = (q[sl] * scale)[:, 0]  # (nb, h, hd)
+                    acc, m, l = paged_attention_decode(
+                        q1, pk, pv, tb, lengths[sl],
+                        page_size=pk.shape[1],
+                    )
+                    s_self = jnp.einsum(
+                        "bhd,bhd->bh",
+                        q1.astype(jnp.float32),
+                        k[sl][:, 0].astype(jnp.float32),
+                    )
+                    m2 = jnp.maximum(m, s_self)
+                    alpha = jnp.exp(m - m2)
+                    w_self = jnp.exp(s_self - m2)
+                    l2 = l * alpha + w_self
+                    out_b = (
+                        acc * alpha[..., None]
+                        + v[sl][:, 0].astype(jnp.float32) * w_self[..., None]
+                    ) / l2[..., None]
+                    outs.append(out_b[:, None].astype(self.dtype))
+                    off += nb
                 attn = (
-                    acc * alpha[..., None]
-                    + v[:, 0].astype(jnp.float32) * w_self[..., None]
-                ) / l2[..., None]
-                attn = attn[:, None].astype(self.dtype)
+                    outs[0] if len(outs) == 1 else jnp.concatenate(outs, axis=0)
+                )
                 attn = attn.reshape(batch, seg_len, d_model)
             else:
                 # gather path — same arithmetic as
                 # TransformerBlock._cached_attention: bf16 scores
-                # masked with finfo.min, f32 softmax
-                gk = pk[block_tables]  # (B, P, ps, h, hd)
-                pages_per, page_size = gk.shape[1], gk.shape[2]
-                cache_len = pages_per * page_size
-                gk = gk.reshape(batch, cache_len, heads, head_dim)
-                gv = pv[block_tables].reshape(batch, cache_len, heads, head_dim)
+                # masked with finfo.min, f32 softmax; one gather +
+                # attention per bucket, each at its own static width
+                outs = []
+                off = 0
+                for tb in tables:
+                    nb = tb.shape[0]
+                    sl = slice(off, off + nb)
+                    gk = pk[tb]  # (nb, P, ps, h, hd) split / (nb, P, ps, d) flat
+                    pages_per, page_size = gk.shape[1], gk.shape[2]
+                    cache_len = pages_per * page_size
+                    gk = gk.reshape(nb, cache_len, heads, head_dim)
+                    gv = pv[tb].reshape(nb, cache_len, heads, head_dim)
 
-                sc = jnp.einsum("bqhd,bkhd->bhqk", q * scale, gk)
-                ss = jnp.einsum("bqhd,bkhd->bhqk", q * scale, k)
-                neg = jnp.finfo(sc.dtype).min
-                cache_mask = (
-                    jnp.arange(cache_len)[None, :] < lengths[:, None]
-                )  # (B, cache_len)
-                sc = jnp.where(cache_mask[:, None, None, :], sc, neg)
-                seg_mask = (
-                    jnp.arange(seg_len)[None, :] <= jnp.arange(seg_len)[:, None]
-                )  # (L, L) causal within this segment
-                ss = jnp.where(seg_mask[None, None], ss, neg)
-                scores = jnp.concatenate([sc, ss], axis=-1).astype(jnp.float32)
-                weights = jax.nn.softmax(scores, axis=-1).astype(self.dtype)
-                wc, ws = weights[..., :cache_len], weights[..., cache_len:]
-                attn = jnp.einsum("bhqk,bkhd->bqhd", wc, gv) + jnp.einsum(
-                    "bhqk,bkhd->bqhd", ws, v
+                    sc = jnp.einsum("bqhd,bkhd->bhqk", q[sl] * scale, gk)
+                    ss = jnp.einsum("bqhd,bkhd->bhqk", q[sl] * scale, k[sl])
+                    neg = jnp.finfo(sc.dtype).min
+                    cache_mask = (
+                        jnp.arange(cache_len)[None, :] < lengths[sl][:, None]
+                    )  # (nb, cache_len)
+                    sc = jnp.where(cache_mask[:, None, None, :], sc, neg)
+                    seg_mask = (
+                        jnp.arange(seg_len)[None, :]
+                        <= jnp.arange(seg_len)[:, None]
+                    )  # (L, L) causal within this segment
+                    ss = jnp.where(seg_mask[None, None], ss, neg)
+                    scores = jnp.concatenate(
+                        [sc, ss], axis=-1
+                    ).astype(jnp.float32)
+                    weights = jax.nn.softmax(scores, axis=-1).astype(self.dtype)
+                    wc, ws = weights[..., :cache_len], weights[..., cache_len:]
+                    outs.append(
+                        jnp.einsum("bhqk,bkhd->bqhd", wc, gv)
+                        + jnp.einsum("bhqk,bkhd->bqhd", ws, v[sl])
+                    )
+                    off += nb
+                attn = (
+                    outs[0] if len(outs) == 1 else jnp.concatenate(outs, axis=0)
                 )
                 attn = attn.reshape(batch, seg_len, d_model)
 
@@ -262,12 +300,23 @@ def _build_modules():
 
         @nn.compact
         def __call__(self, x, ctx_k, ctx_v, ring_k, ring_v, step, len0):
-            # x: (B, 1, d)   ctx_k/v: (B, C, h, hd)   ring_k/v: (B, S, h, hd)
+            # x: (B, 1, d)   ring_k/v: (B, S, h, hd)
+            # ctx_k/v: (B, C, h, hd), or a TUPLE of per-bucket buffers
+            # ((B0, C0, h, hd), (B1, C1, h, hd), ...) with sum(Bb) == B —
+            # the r6 length-bucketed gather: lanes arrive bucket-sorted
+            # (shortest contexts first), so each bucket's context einsums
+            # run at ITS OWN static width instead of every lane paying
+            # the longest stream's C.  Dense work (projections, MLP,
+            # embed/head in the LM) stays full-batch — only the per-lane
+            # context attention splits, so there is no extra weight
+            # traffic and no extra dispatch.
             # — the engine materialises the working set SPLIT even over
             # a flat-at-rest pool ("flat at rest, split in flight"; the
             # split form is what the per-step dense reads want)
             # step: scalar — ring columns < step are live
             # len0: (B,) context lengths frozen at chunk start
+            if not isinstance(ctx_k, (tuple, list)):
+                ctx_k, ctx_v = (ctx_k,), (ctx_v,)
             d_model = x.shape[-1]
             heads = self.num_heads
             head_dim = d_model // heads
@@ -279,26 +328,35 @@ def _build_modules():
             q, k, v = q.reshape(shape), k.reshape(shape), v.reshape(shape)
             scale = 1.0 / jnp.sqrt(head_dim).astype(q.dtype)
 
-            C = ctx_k.shape[1]
             S = ring_k.shape[1]
-            sc = jnp.einsum("bqhd,bkhd->bhqk", q * scale, ctx_k)
-            sr = jnp.einsum("bqhd,bkhd->bhqk", q * scale, ring_k)
-            ss = jnp.einsum("bqhd,bkhd->bhqk", q * scale, k)
-            neg = jnp.finfo(sc.dtype).min
-            ctx_mask = jnp.arange(C)[None, :] < len0[:, None]  # (B, C)
-            sc = jnp.where(ctx_mask[:, None, None, :], sc, neg)
             ring_mask = jnp.arange(S) < step  # (S,) cols written so far
-            sr = jnp.where(ring_mask[None, None, None, :], sr, neg)
-            scores = jnp.concatenate([sc, sr, ss], axis=-1).astype(jnp.float32)
-            weights = jax.nn.softmax(scores, axis=-1).astype(self.dtype)
-            wc = weights[..., :C]
-            wr = weights[..., C:C + S]
-            ws = weights[..., C + S:]
-            attn = (
-                jnp.einsum("bhqk,bkhd->bqhd", wc, ctx_v)
-                + jnp.einsum("bhqk,bkhd->bqhd", wr, ring_v)
-                + jnp.einsum("bhqk,bkhd->bqhd", ws, v)
-            )
+            neg = jnp.finfo(q.dtype).min
+            outs = []
+            off = 0
+            for ck, cv in zip(ctx_k, ctx_v):
+                nb, C = ck.shape[0], ck.shape[1]
+                sl = slice(off, off + nb)
+                q_b = q[sl] * scale
+                sc = jnp.einsum("bqhd,bkhd->bhqk", q_b, ck)
+                sr = jnp.einsum("bqhd,bkhd->bhqk", q_b, ring_k[sl])
+                ss = jnp.einsum("bqhd,bkhd->bhqk", q_b, k[sl])
+                ctx_mask = jnp.arange(C)[None, :] < len0[sl][:, None]  # (nb, C)
+                sc = jnp.where(ctx_mask[:, None, None, :], sc, neg)
+                sr = jnp.where(ring_mask[None, None, None, :], sr, neg)
+                scores = jnp.concatenate(
+                    [sc, sr, ss], axis=-1
+                ).astype(jnp.float32)
+                weights = jax.nn.softmax(scores, axis=-1).astype(self.dtype)
+                wc = weights[..., :C]
+                wr = weights[..., C:C + S]
+                ws = weights[..., C + S:]
+                outs.append(
+                    jnp.einsum("bhqk,bkhd->bqhd", wc, cv)
+                    + jnp.einsum("bhqk,bkhd->bqhd", wr, ring_v[sl])
+                    + jnp.einsum("bhqk,bkhd->bqhd", ws, v[sl])
+                )
+                off += nb
+            attn = outs[0] if len(outs) == 1 else jnp.concatenate(outs, axis=0)
             attn = attn.reshape(batch, seg_len, d_model)
             x = x + _dense(self.precision, d_model, self.dtype, "attn_proj")(attn)
             y = nn.LayerNorm(dtype=jnp.float32)(x)
@@ -313,7 +371,9 @@ def _build_modules():
 
         ``__call__(tokens, positions, ctx_k, ctx_v, ring_k, ring_v,
         step, len0)`` -> ``(logits, new_k, new_v)`` with ctx/ring
-        shaped ``(layers, B, C|S, heads, head_dim)``.
+        shaped ``(layers, B, C|S, heads, head_dim)``; ``ctx_k``/
+        ``ctx_v`` may instead be tuples of per-bucket buffers (the
+        length-bucketed gather — see ChunkTransformerBlock).
         """
 
         vocab_size: int = 32_000
@@ -335,12 +395,19 @@ def _build_modules():
                 self.max_len, self.d_model, dtype=self.dtype, name="pos_embed"
             )(positions)
             x = x + pos
+            bucketed = isinstance(ctx_k, (tuple, list))
             new_k, new_v = [], []
             for i in range(self.num_layers):
+                layer_ck = (
+                    tuple(c[i] for c in ctx_k) if bucketed else ctx_k[i]
+                )
+                layer_cv = (
+                    tuple(c[i] for c in ctx_v) if bucketed else ctx_v[i]
+                )
                 x, k, v = ChunkTransformerBlock(
                     num_heads=self.num_heads, dtype=self.dtype,
                     precision=self.precision, name=f"block_{i}"
-                )(x, ctx_k[i], ctx_v[i], ring_k[i], ring_v[i], step, len0)
+                )(x, layer_ck, layer_cv, ring_k[i], ring_v[i], step, len0)
                 new_k.append(k)
                 new_v.append(v)
             x = nn.LayerNorm(dtype=jnp.float32)(x)
@@ -516,6 +583,74 @@ def write_kv(pk, pv, new_k, new_v, block_tables, start, valid, *, page_size, max
                 pv, new_v[:, s, t][:, None, None], (0, page, offs[s, t]) + tail0
             )
     return pk, pv
+
+
+def paged_hbm_accounting(
+    *,
+    streams: int,
+    ctx_len: int,
+    d_model: int,
+    num_layers: int,
+    page_size: int = 64,
+    steps_per_call: int = 8,
+    dtype_bytes: int = 2,
+    flat_pool: bool = True,
+    chunk_impl: str = "ring",
+    donated: bool = True,
+    split_tile_pad: float = 2.0,
+) -> Dict[str, int]:
+    """Pool-HBM bytes for ``streams`` concurrent streams at ``ctx_len``
+    tokens — the capacity model the bench certifies (VERDICT r5 #3/#5).
+
+    Terms, each measured in earlier rounds rather than assumed:
+
+    * **pool (at rest)** — pages x page_size x d_model x 2 (K+V) x
+      layers.  The flat layout stores logical bytes; the split
+      (heads, head_dim) layout physically pads ``split_tile_pad``
+      (2.0x measured under the TPU (8,128) tile — §10b r5b).
+    * **donated vs copied** — the chunk program donates pk/pv
+      (``donate_argnums``), so exactly ONE pool copy is live during a
+      chunk; without donation XLA keeps input AND output pools and the
+      at-rest term doubles.  ``donated=False`` prices that world — the
+      accounting the capacity claim must state.
+    * **working set (ring impl only)** — the once-per-chunk ctx copy
+      (split in flight: pays the tile pad) plus the step-indexed ring;
+      the pool impl reads the pool per step and carries no copy.
+      Under the r6 length-bucketed gather this is the WORST case
+      (uniform ctx_len); mixed traffic gathers less.
+
+    Weights, activations, and the host runtime are out of scope: this
+    prices the KV side, which is what scales with streams.
+    """
+    pages = -(-ctx_len // page_size)
+    tok_bytes = num_layers * d_model * 2 * dtype_bytes
+    pool_pad = 1.0 if flat_pool else split_tile_pad
+    pool = int(streams * pages * page_size * tok_bytes * pool_pad)
+    ws = 0
+    if chunk_impl == "ring":
+        ws = int(
+            streams * (pages * page_size + steps_per_call)
+            * tok_bytes * split_tile_pad
+        )
+    at_rest = pool if donated else 2 * pool
+    return {
+        "pool_bytes": pool,
+        "working_set_bytes": ws,
+        "peak_bytes": at_rest + ws,
+        "per_stream_bytes": (at_rest + ws) // max(1, streams),
+    }
+
+
+def paged_capacity_streams(
+    budget_bytes: int, ctx_len: int, *, donated: bool = True, **model_kw
+) -> int:
+    """Max concurrent streams whose paged KV peak fits ``budget_bytes``
+    at ``ctx_len`` tokens each (per-stream cost is linear in streams,
+    so this is one division over the single-stream accounting)."""
+    one = paged_hbm_accounting(
+        streams=1, ctx_len=ctx_len, donated=donated, **model_kw
+    )
+    return int(budget_bytes // max(1, one["peak_bytes"]))
 
 
 # ---------------------------------------------------------------------------
@@ -716,6 +851,23 @@ class PagedEngine:
                 "2x HBM padding with no speed effect — set "
                 "SELDON_TPU_CHUNK_IMPL=pool to actually exercise the kernel"
             )
+        # r6 length-bucketed context gather: inside ONE chunk program,
+        # lanes are permuted bucket-sorted (shortest contexts first) and
+        # split into 2 static buckets, each gathering/attending at its
+        # own power-of-two page horizon — mixed-length traffic stops
+        # paying the longest stream's context cost on every step, with
+        # no extra dispatch (the constraint that killed per-group
+        # CALLS).  "1" disables (the A/B + parity knob); uniform
+        # traffic degenerates to one bucket automatically (identical
+        # horizons), so the uniform-load programs are byte-identical
+        # with the knob on.
+        buckets_env = _os.environ.get("SELDON_TPU_CTX_BUCKETS", "") or "2"
+        if buckets_env not in ("1", "2"):
+            raise ValueError(
+                f"SELDON_TPU_CTX_BUCKETS={buckets_env!r}: supported values "
+                "are '1' (disable) and '2' (default)"
+            )
+        self._ctx_buckets = int(buckets_env)
         # pool storage layout (r5): FLAT (L, pages, ps, d_model) by
         # default — the split (h=8, hd=64) trailing dims pad 2x under
         # the TPU (8,128) tile (pool AND gathered-ctx buffers at 2.0x
@@ -762,6 +914,7 @@ class PagedEngine:
         # updated under _lock)
         self._counters = {"chunks": 0, "tokens": 0, "evictions": 0,
                           "stalls": 0, "prefills": 0, "completed": 0,
+                          "bucketed_chunks": 0,
                           "spec_drafted": 0, "spec_accepted": 0,
                           # wall seconds inside device calls + readback,
                           # split by phase: decode-rate observability
@@ -824,9 +977,10 @@ class PagedEngine:
                 self._draft_rollout = jax.jit(self._draft_rollout_fn)
 
         self._prefill_jit: Dict[Tuple[int, int], Any] = {}  # (bucket, k)
-        # (steps, ctx horizon pages) -> compiled chunk program (ring
-        # impl; the pool impl keys (steps, 0) — it has no ctx gather)
-        self._chunk_jit: Dict[Tuple[int, int], Any] = {}
+        # (steps, bucket spec) -> compiled chunk program, where the
+        # bucket spec is a static tuple of (lane_count, ctx_pages)
+        # pairs (one entry = uniform, two = the length-bucketed gather)
+        self._chunk_jit: Dict[Tuple[int, Tuple[Tuple[int, int], ...]], Any] = {}
         # one fixed-shape program deriving every slot's rng key data
         self._derive_keys = jax.jit(
             jax.vmap(lambda s: jax.random.key_data(jax.random.key(s)))
@@ -943,30 +1097,91 @@ class PagedEngine:
             p *= 2
         return min(p, self.pages_per_stream)
 
-    def _get_chunk(self, steps: int, h_ctx: int = 0):
-        """Compiled decode program for one (ladder size, ctx horizon)
-        pair (lazy, cached); jit specialises per sliced block-table
-        width on top.  ``h_ctx`` is the power-of-two page count the
-        ring implementation gathers as contiguous context (0 for the
-        legacy pool implementation, which needs no static ctx width)."""
-        # the pool body ignores h_ctx — key it as 0 so varying ctx
-        # horizons don't recompile byte-identical legacy programs
-        key = (steps, 0 if self._chunk_impl == "pool" else max(h_ctx, 1))
+    def _plan_buckets(
+        self, runnable: List[_Stream], steps: int, pages_h: int
+    ) -> Tuple[Tuple[Tuple[int, int], ...], np.ndarray]:
+        """Static bucket spec + lane permutation for the next chunk.
+
+        Splits the slot array in half (bucket sizes are STATIC —
+        max_slots//2 — so the compile count stays bounded by the two
+        horizon ladders; membership moves between chunks via the traced
+        permutation).  The split point among LIVE streams is their own
+        midpoint: the shorter half of the runnable lanes anchors bucket
+        0, the longer half bucket 1, and idle/stalled lanes (whose
+        compute is discarded either way) are FILLER for the remaining
+        capacity of each bucket — under partial occupancy the live
+        short streams therefore still get the short horizon instead of
+        being displaced into the long bucket by idle lanes, and a
+        bucketed chunk always means some live lane actually runs
+        cheaper (the ``bucketed_chunks`` counter cannot overstate
+        engagement).  Horizons are per-bucket power-of-two page counts
+        over the bucket's RUNNABLE lanes (ring impl: pages existing at
+        chunk start; pool impl: + this chunk's growth, since in-chunk
+        tokens are read back from the pool).  Degenerates to one bucket
+        — the exact pre-bucketing program — whenever both horizons
+        agree (uniform traffic), bucketing is disabled, or fewer than 2
+        lanes run.
+        """
+        B = self.max_slots
+        ident = np.arange(B, dtype=np.int32)
+        grow = steps if self._chunk_impl == "pool" else 0
+
+        def h_of(ctx_tokens: int) -> int:
+            need = ctx_tokens + grow
+            return min(
+                self._pages_pow2(max(1, -(-need // self.page_size))), pages_h
+            )
+
+        if not runnable:
+            return ((B, 1),), ident
+        h_all = h_of(max(int(self._lengths[s.slot]) for s in runnable))
+        if self._ctx_buckets < 2 or B < 2 or len(runnable) < 2:
+            return ((B, h_all),), ident
+        B0 = B // 2
+        run_lanes = sorted(
+            (int(self._lengths[s.slot]), s.slot) for s in runnable
+        )
+        k0 = min(len(run_lanes) // 2, B0)
+        h0 = h_of(run_lanes[k0 - 1][0]) if k0 else 1
+        h1 = h_of(run_lanes[-1][0])
+        if h0 == h1:
+            return ((B, h_all),), ident
+        live = {g for _, g in run_lanes}
+        idle = [g for g in range(B) if g not in live]
+        fill0 = B0 - k0  # >= 0, and len(idle) >= fill0 (B1 >= ceil(n_r/2))
+        order = np.asarray(
+            [g for _, g in run_lanes[:k0]] + idle[:fill0]
+            + [g for _, g in run_lanes[k0:]] + idle[fill0:],
+            np.int32,
+        )
+        return ((B0, h0), (B - B0, h1)), order
+
+    def _get_chunk(self, steps: int, buckets: Tuple[Tuple[int, int], ...]):
+        """Compiled decode program for one (ladder size, bucket spec)
+        pair (lazy, cached).  ``buckets`` is a static tuple of
+        ``(lane_count, ctx_pages)`` pairs summing to ``max_slots`` —
+        one entry for the uniform case, two for the length-bucketed
+        gather (lanes arrive bucket-sorted via the chunk's ``perm``
+        argument).  For the ring impl ``ctx_pages`` is the bucket's
+        gathered-context horizon; for the pool impl it is the per-step
+        table width (context + this chunk's growth).  Both axes are
+        power-of-two-bounded, so the compile count stays logarithmic."""
+        key = (steps, buckets)
         fn = self._chunk_jit.get(key)
         if fn is None:
             from functools import partial
 
             if self._chunk_impl == "pool":
-                body = partial(self._chunk_fn_pool, steps)
+                body = partial(self._chunk_fn_pool, steps, buckets)
             else:
-                body = partial(self._chunk_fn, steps, max(h_ctx, 1))
+                body = partial(self._chunk_fn, steps, buckets)
             fn = self._jax.jit(body, donate_argnums=(1, 2))
             self._chunk_jit[key] = fn
         return fn
 
     def _chunk_fn(
-        self, steps, h_ctx, params, pk, pv, logits, lengths, block_tables,
-        keys, done, emitted, max_new, temps, top_ks, eos_ids,
+        self, steps, buckets, params, pk, pv, logits, lengths, block_tables,
+        keys, done, emitted, max_new, temps, top_ks, eos_ids, perm,
     ):
         """``steps`` decode steps for all slots, on device — the ring
         implementation (r5 default).
@@ -980,20 +1195,26 @@ class PagedEngine:
         regression.  Here the pool is touched exactly twice per chunk:
 
         1. **ctx gather, once** — each slot's context K/V (positions
-           < len0, ``h_ctx`` pages) is gathered into a contiguous
-           ``(L, B, C, h, hd)`` buffer; amortised over ``steps``.
+           < len0) is gathered into a contiguous ``(L, Bb, Cb, h, hd)``
+           buffer PER LENGTH BUCKET (``buckets`` — r6): lanes arrive
+           permuted bucket-sorted via ``perm`` and each bucket gathers
+           only ITS horizon's pages, so under mixed-length traffic the
+           short streams stop paying the longest stream's gather AND
+           per-step ctx-einsum cost.  Amortised over ``steps``.
         2. **page write-back, once** — the chunk's new K/V accumulate
            in a step-indexed ring (column t at step t: ONE uniform DUS
            per step, no per-slot raggedness) and land in their pages
            in page-block DUS writes at chunk end (a lax.scan over
-           slots keeps the program small).
+           each bucket's slots keeps the program small).
 
         Per-step attention is therefore three dense einsums (ctx, ring,
-        self) — same token set, masks, and dtypes as the pool path, so
-        greedy outputs stay exact (asserted by the parity suite).
-        Memory cost: the ctx copy (≈ the live context's size) for the
-        chunk's duration — the classic paged-storage / contiguous-
-        working-set split.
+        self) per bucket — same token set, masks, and dtypes as the
+        pool path, so greedy outputs stay exact (asserted by the parity
+        suite; a lane's attention never depends on which bucket its
+        co-batch landed in).  Memory cost: the ctx copy (≈ the live
+        context's size, now right-sized per bucket) for the chunk's
+        duration — the classic paged-storage / contiguous-working-set
+        split.
         """
         jax, jnp = self._jax, self._jnp
         # dequant ONCE per chunk, amortised over steps_per_call decode
@@ -1007,9 +1228,22 @@ class PagedEngine:
         ps = self.page_size
         dtype = pk.dtype
 
+        multi = len(buckets) > 1
+        if multi:
+            # bucket-sort every per-slot carry; outputs un-permute at
+            # exit so the engine's state stays slot-major.  perm is a
+            # TRACED argument — bucket membership changes chunk to
+            # chunk without recompiling (only the static (lanes,
+            # horizon) spec keys the program).
+            inv_perm = jnp.argsort(perm)
+            (logits, lengths, block_tables, keys, done, emitted, max_new,
+             temps, top_ks, eos_ids) = (
+                a[perm] for a in (
+                    logits, lengths, block_tables, keys, done, emitted,
+                    max_new, temps, top_ks, eos_ids)
+            )
+
         len0 = lengths  # frozen at chunk start: ctx mask + write-back base
-        ctx_tables = block_tables[:, :h_ctx]
-        C = h_ctx * ps
         # POOL layout: flat (L, pages, ps, d) by default (halves HBM —
         # the split trailing dims pad 2x under the TPU tile) or split
         # (L, pages, ps, h, hd) in kernel mode.  WORKING-SET layout:
@@ -1020,9 +1254,18 @@ class PagedEngine:
         # matters for the once-per-chunk gather and write-back.  So:
         # flat at rest, split in flight.
         tail = tuple(pk.shape[3:])
-        # (L, B, P, ps, *tail) -> split (L, B, C, h, hd) working set
-        ctx_k = pk[:, ctx_tables].reshape(L, B, C, h, hd)
-        ctx_v = pv[:, ctx_tables].reshape(L, B, C, h, hd)
+        # per bucket: (L, Bb, Pb, ps, *tail) -> split (L, Bb, Cb, h, hd)
+        ctx_k, ctx_v = [], []
+        off = 0
+        for nb, hb in buckets:
+            tb = block_tables[off:off + nb, :hb]
+            Cb = hb * ps
+            ctx_k.append(pk[:, tb].reshape(L, nb, Cb, h, hd))
+            ctx_v.append(pv[:, tb].reshape(L, nb, Cb, h, hd))
+            off += nb
+        ctx_k, ctx_v = tuple(ctx_k), tuple(ctx_v)
+        if not multi:
+            ctx_k, ctx_v = ctx_k[0], ctx_v[0]
         ring_k = jnp.zeros((L, B, steps, h, hd), dtype)
         ring_v = jnp.zeros((L, B, steps, h, hd), dtype)
 
@@ -1067,8 +1310,12 @@ class PagedEngine:
         # Page-aligned: per slot, shift the ring to page alignment
         # (first partial page merged from ctx so full-page writes
         # cannot clobber existing tokens), then DUS whole page blocks.
-        # A lax.scan over slots carries pk/pv in place and keeps the
-        # program ~20 ops per slot instead of B*steps token writes.
+        # A lax.scan over each bucket's slots carries pk/pv in place
+        # and keeps the program ~20 ops per slot instead of B*steps
+        # token writes.  A runnable lane's first-page read is always in
+        # range (its bucket's horizon covers ceil(len0/ps); at exact
+        # page boundaries off0==0 and nothing needs preserving), and
+        # non-runnable lanes (em==0) redirect every page to trash 0.
         n_back = steps // ps + 2  # pages a slot's chunk tokens can span
         W = n_back * ps
         p0 = jnp.minimum(len0, self.max_len - 1) // ps  # (B,) first page idx
@@ -1076,60 +1323,109 @@ class PagedEngine:
 
         tail0 = (0,) * len(tail)  # pool-rank index padding
 
-        def write_slot(carry, s):
-            pk, pv = carry
-            ring_k_s = jax.lax.dynamic_index_in_dim(
-                ring_k, s, axis=1, keepdims=False)  # (L, S, h, hd)
-            ring_v_s = jax.lax.dynamic_index_in_dim(
-                ring_v, s, axis=1, keepdims=False)
-            ctx_k_s = jax.lax.dynamic_index_in_dim(
-                ctx_k, s, axis=1, keepdims=False)  # (L, C, h, hd)
-            ctx_v_s = jax.lax.dynamic_index_in_dim(
-                ctx_v, s, axis=1, keepdims=False)
-            off = off0[s]
-            first_k = jax.lax.dynamic_slice(
-                ctx_k_s, (0, p0[s] * ps, 0, 0), (L, ps, h, hd)
-            )
-            first_v = jax.lax.dynamic_slice(
-                ctx_v_s, (0, p0[s] * ps, 0, 0), (L, ps, h, hd)
-            )
-            aligned_k = jnp.zeros((L, W, h, hd), dtype)
-            aligned_v = jnp.zeros((L, W, h, hd), dtype)
-            aligned_k = jax.lax.dynamic_update_slice(aligned_k, first_k, (0, 0, 0, 0))
-            aligned_v = jax.lax.dynamic_update_slice(aligned_v, first_v, (0, 0, 0, 0))
-            aligned_k = jax.lax.dynamic_update_slice(aligned_k, ring_k_s, (0, off, 0, 0))
-            aligned_v = jax.lax.dynamic_update_slice(aligned_v, ring_v_s, (0, off, 0, 0))
-            table_s = jax.lax.dynamic_index_in_dim(block_tables, s, axis=0,
-                                                   keepdims=False)
-            em = jax.lax.dynamic_index_in_dim(emitted, s, axis=0, keepdims=False)
-            for j in range(n_back):
-                # page j holds accepted tokens iff its window starts
-                # before off0+emitted; inactive lanes (em==0) and pages
-                # past the accepted span are redirected to trash page 0
-                valid = (j * ps < off + em) & (em > 0)
-                page = jnp.where(valid, jnp.take(table_s, p0[s] + j, mode="clip"), 0)
-                win_k = aligned_k[:, None, j * ps:(j + 1) * ps]  # (L,1,ps,h,hd)
-                win_v = aligned_v[:, None, j * ps:(j + 1) * ps]
-                if len(tail) == 1:  # flat pool: merge h x hd (contiguous)
-                    win_k = win_k.reshape(L, 1, ps, -1)
-                    win_v = win_v.reshape(L, 1, ps, -1)
-                pk = jax.lax.dynamic_update_slice(pk, win_k, (0, page, 0) + tail0)
-                pv = jax.lax.dynamic_update_slice(pv, win_v, (0, page, 0) + tail0)
-            return (pk, pv), ()
+        ctx_ks = ctx_k if multi else (ctx_k,)
+        ctx_vs = ctx_v if multi else (ctx_v,)
+        off_b = 0
+        for b, (nb, _hb) in enumerate(buckets):
+            ctx_k_b, ctx_v_b = ctx_ks[b], ctx_vs[b]
+            base = off_b  # this bucket's first lane (static)
 
-        (pk, pv), _ = jax.lax.scan(write_slot, (pk, pv), jnp.arange(B))
+            def write_slot(carry, s, ctx_k_b=ctx_k_b, ctx_v_b=ctx_v_b,
+                           base=base):
+                pk, pv = carry
+                g = base + s  # global lane index
+                ring_k_s = jax.lax.dynamic_index_in_dim(
+                    ring_k, g, axis=1, keepdims=False)  # (L, S, h, hd)
+                ring_v_s = jax.lax.dynamic_index_in_dim(
+                    ring_v, g, axis=1, keepdims=False)
+                ctx_k_s = jax.lax.dynamic_index_in_dim(
+                    ctx_k_b, s, axis=1, keepdims=False)  # (L, Cb, h, hd)
+                ctx_v_s = jax.lax.dynamic_index_in_dim(
+                    ctx_v_b, s, axis=1, keepdims=False)
+                off = off0[g]
+                first_k = jax.lax.dynamic_slice(
+                    ctx_k_s, (0, p0[g] * ps, 0, 0), (L, ps, h, hd)
+                )
+                first_v = jax.lax.dynamic_slice(
+                    ctx_v_s, (0, p0[g] * ps, 0, 0), (L, ps, h, hd)
+                )
+                aligned_k = jnp.zeros((L, W, h, hd), dtype)
+                aligned_v = jnp.zeros((L, W, h, hd), dtype)
+                aligned_k = jax.lax.dynamic_update_slice(
+                    aligned_k, first_k, (0, 0, 0, 0))
+                aligned_v = jax.lax.dynamic_update_slice(
+                    aligned_v, first_v, (0, 0, 0, 0))
+                aligned_k = jax.lax.dynamic_update_slice(
+                    aligned_k, ring_k_s, (0, off, 0, 0))
+                aligned_v = jax.lax.dynamic_update_slice(
+                    aligned_v, ring_v_s, (0, off, 0, 0))
+                table_s = jax.lax.dynamic_index_in_dim(
+                    block_tables, g, axis=0, keepdims=False)
+                em = jax.lax.dynamic_index_in_dim(
+                    emitted, g, axis=0, keepdims=False)
+                for j in range(n_back):
+                    # page j holds accepted tokens iff its window starts
+                    # before off0+emitted; inactive lanes (em==0) and
+                    # pages past the accepted span are redirected to
+                    # trash page 0
+                    valid = (j * ps < off + em) & (em > 0)
+                    page = jnp.where(
+                        valid, jnp.take(table_s, p0[g] + j, mode="clip"), 0)
+                    win_k = aligned_k[:, None, j * ps:(j + 1) * ps]  # (L,1,ps,h,hd)
+                    win_v = aligned_v[:, None, j * ps:(j + 1) * ps]
+                    if len(tail) == 1:  # flat pool: merge h x hd (contiguous)
+                        win_k = win_k.reshape(L, 1, ps, -1)
+                        win_v = win_v.reshape(L, 1, ps, -1)
+                    pk = jax.lax.dynamic_update_slice(
+                        pk, win_k, (0, page, 0) + tail0)
+                    pv = jax.lax.dynamic_update_slice(
+                        pv, win_v, (0, page, 0) + tail0)
+                return (pk, pv), ()
+
+            (pk, pv), _ = jax.lax.scan(write_slot, (pk, pv), jnp.arange(nb))
+            off_b += nb
+
+        if multi:
+            toks_out = toks.T[inv_perm]
+            (logits, lengths, keys, done, emitted) = (
+                a[inv_perm] for a in (logits, lengths, keys, done, emitted)
+            )
+            return toks_out, pk, pv, logits, lengths, keys, done, emitted
         return toks.T, pk, pv, logits, lengths, keys, done, emitted
 
     def _chunk_fn_pool(
-        self, steps, params, pk, pv, logits, lengths, block_tables, keys,
-        done, emitted, max_new, temps, top_ks, eos_ids,
+        self, steps, buckets, params, pk, pv, logits, lengths, block_tables,
+        keys, done, emitted, max_new, temps, top_ks, eos_ids, perm,
     ):
         """Legacy chunk implementation (SELDON_TPU_CHUNK_IMPL=pool):
         per-step pool gather + per-slot DUS writes.  Kept selectable
         for A/B measurement and as the fallback while the ring path
-        hardens; the pallas decode kernels only apply here."""
+        hardens; the pallas decode kernels only apply here.  The r6
+        length-bucketed gather applies here too: lanes arrive permuted
+        bucket-sorted and the per-step attention gathers each bucket's
+        tables at its own static width (which must cover this chunk's
+        growth — in-chunk tokens live in the pool, unlike the ring
+        impl); writes use the full-width tables either way."""
         jax, jnp = self._jax, self._jnp
         params = self._materialize(params)
+
+        multi = len(buckets) > 1
+        if multi:
+            inv_perm = jnp.argsort(perm)
+            (logits, lengths, block_tables, keys, done, emitted, max_new,
+             temps, top_ks, eos_ids) = (
+                a[perm] for a in (
+                    logits, lengths, block_tables, keys, done, emitted,
+                    max_new, temps, top_ks, eos_ids)
+            )
+            split_tables = []
+            off = 0
+            for nb, hb in buckets:
+                split_tables.append(block_tables[off:off + nb, :hb])
+                off += nb
+            attn_tables = tuple(split_tables)
+        else:
+            attn_tables = block_tables
 
         def step(carry, _):
             pk, pv, logits, lengths, keys, done, emitted = carry
@@ -1148,7 +1444,7 @@ class PagedEngine:
             new_logits, nk, nv = self.module.apply(
                 {"params": params}, token[:, None],
                 jnp.minimum(positions, self.max_len - 1),
-                pk, pv, block_tables, lengths,
+                pk, pv, attn_tables, lengths,
             )
             pk, pv = self._write_kv(
                 pk, pv, nk, nv, block_tables, lengths, active[:, None]
@@ -1161,6 +1457,12 @@ class PagedEngine:
             step, (pk, pv, logits, lengths, keys, done, emitted),
             None, length=steps,
         )
+        if multi:
+            toks_out = toks.T[inv_perm]
+            (logits, lengths, keys, done, emitted) = (
+                a[inv_perm] for a in (logits, lengths, keys, done, emitted)
+            )
+            return toks_out, pk, pv, logits, lengths, keys, done, emitted
         return toks.T, pk, pv, logits, lengths, keys, done, emitted
 
     def _draft_rollout_fn(self, params, windows, lens):
@@ -1639,14 +1941,11 @@ class PagedEngine:
                 eos_ids[s] = stream.eos_id
             runnable_now = [s for s in active if not stalled[s.slot]]
             pages_h = self._pages_horizon(runnable_now, steps)
-            # ctx horizon for the ring chunk: the pages holding tokens
-            # that EXIST at chunk start (no +steps — in-chunk tokens
-            # live in the ring, not the gathered context)
-            h_ctx = self._pages_pow2(
-                -(-max(int(self._lengths[s.slot]) for s in runnable_now)
-                  // self.page_size)
-            ) if runnable_now else 1
-            h_ctx = min(h_ctx, pages_h)
+            # ctx horizons for the chunk: per length bucket (the ring
+            # impl gathers only pages holding tokens that EXIST at
+            # chunk start — in-chunk tokens live in the ring; the pool
+            # impl's per-step tables add this chunk's growth)
+            buckets, perm = self._plan_buckets(runnable_now, steps, pages_h)
             tables = jnp.asarray(self._block_tables[:, :pages_h])
             lengths = jnp.asarray(self._lengths)
             emitted0 = jnp.zeros((self.max_slots,), jnp.int32)
@@ -1655,11 +1954,11 @@ class PagedEngine:
 
         t_chunk = _time.perf_counter()
         toks, self.pages_k, self.pages_v, self._logits, lengths_out, self._keys, _, emitted = (
-            self._get_chunk(steps, h_ctx)(
+            self._get_chunk(steps, buckets)(
                 self.params, self.pages_k, self.pages_v, self._logits,
                 lengths, tables, self._keys, jnp.asarray(done_in),
                 emitted0, jnp.asarray(max_new), jnp.asarray(temps),
-                jnp.asarray(top_ks), jnp.asarray(eos_ids),
+                jnp.asarray(top_ks), jnp.asarray(eos_ids), jnp.asarray(perm),
             )
         )
         toks_np = np.asarray(toks)
@@ -1669,6 +1968,7 @@ class PagedEngine:
 
         with self._lock:
             self._counters["chunks"] += 1
+            self._counters["bucketed_chunks"] += int(len(buckets) > 1)
             self._counters["chunk_wall_s"] += chunk_wall
             for stream in active:
                 s = stream.slot

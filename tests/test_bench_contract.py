@@ -50,7 +50,17 @@ def _r3_like_full_result():
                 "mfu_pct": 8.82,
             },
             "device_loop": {"images_per_s": 21000.0, "mfu_pct": 43.7, "iters": 64},
-            "server_latency": {"p50_ms": 3.1, "p99_ms": 9.8, "count": 4000},
+            "server_latency": {
+                "p50_ms": 3.1, "p99_ms": 9.8, "count": 4000,
+                "attached_p50_bound_ms": 4.333,
+                "attached_p99_bound_ms": 14.048,
+                "attached_p99_terms_ms": {
+                    "parse": 0.0057, "decode": 0.023, "pad": 0.1458,
+                    "queue_wait": 13.45, "forward": 0.213,
+                    "serialise": 0.0257,
+                },
+                "p99_dominant": "queue_wait",
+            },
             "inprocess_vs_distinct_roofline": 0.84,
             "native_model": {
                 "payload_content": "constant",
@@ -104,6 +114,15 @@ def _r3_like_full_result():
                 "int8_vs_fp_decode": 1.1,
                 "paged_decode_tokens_per_s": 89.8,
                 "paged_serving_tokens_per_s": 4400.0,
+                "paged_serving64_tokens_per_s": 16015.6,
+                "paged_serving128_tokens_per_s": 28831.6,
+                "paged_serving256_tokens_per_s": 30784.0,
+                "paged_bimodal_tokens_per_s": 13500.0,
+                "paged_bimodal_mix": "64 streams, prompts 32/448 alternating, 384 new tokens each",
+                "paged_capacity": {
+                    "streams": 220, "ctx_len": 512, "budget_gib": 8.0,
+                    "accounting": "donated", "streams_if_copied": 150,
+                },
                 "paged_tokenwise_tokens_per_s": 12.7,
                 "paged_spec_oracle_tokens_per_s": 56.1,
                 "spec_oracle_vs_tokenwise": 4.4,
@@ -169,6 +188,52 @@ def test_compact_line_carries_judge_scalars(bench):
     assert e["loop_mfu_pct"] == 43.7
     assert e["server_p50_ms"] == 3.1
     assert e["full"] == os.path.basename(bench.FULL_RESULT_FILE)
+
+
+def test_compact_line_carries_capacity_story(bench):
+    """r6 certification keys (VERDICT r5 #2/#3/#5): the bimodal
+    mixed-length point, the 256-stream point (previously uncertified
+    prose), the capacity field, and the p99-dominant term — with the
+    types/units the glossary promises (rates are floats in tok/s,
+    capacity is an integer stream count, p99_dominant names a
+    component)."""
+    compact = bench._compact_result(_r3_like_full_result())
+    e = compact["extra"]
+    assert isinstance(e["paged_bimodal_tok_s"], float)
+    assert e["paged_bimodal_tok_s"] == 13500.0
+    assert isinstance(e["paged256_tok_s"], float)
+    assert e["paged256_tok_s"] == 30784.0
+    assert isinstance(e["paged_cap_streams"], int)
+    assert e["paged_cap_streams"] == 220
+    assert e["p99_dominant"] in (
+        "parse", "decode", "pad", "queue_wait", "forward", "serialise"
+    )
+    assert e["attached_p99_bound_ms"] == 14.048
+
+
+def test_capacity_accounting_donated_vs_copied():
+    """The capacity model prices donation correctly: the chunk donates
+    pk/pv so ONE pool copy is live; pricing the copied world must
+    strictly shrink capacity, and capacity scales ~linearly with the
+    budget."""
+    from seldon_core_tpu.models.paged import (
+        paged_capacity_streams,
+        paged_hbm_accounting,
+    )
+
+    kw = dict(d_model=512, num_layers=8, page_size=64, steps_per_call=8,
+              dtype_bytes=2, flat_pool=True, chunk_impl="ring")
+    budget = 8 << 30
+    donated = paged_capacity_streams(budget, 512, donated=True, **kw)
+    copied = paged_capacity_streams(budget, 512, donated=False, **kw)
+    assert donated > copied > 0
+    assert paged_capacity_streams(2 * budget, 512, donated=True, **kw) >= 2 * donated - 1
+    one = paged_hbm_accounting(streams=1, ctx_len=512, donated=True, **kw)
+    # flat pool stores logical bytes: 8 pages x 64 x (512 d_model x 2B
+    # x 2 kv x 8 layers) = 8 MiB; ring working set adds the split
+    # (2.0x-padded) ctx copy + ring
+    assert one["pool_bytes"] == 8 * 64 * (512 * 2 * 2 * 8)
+    assert one["peak_bytes"] == one["pool_bytes"] + one["working_set_bytes"]
 
 
 def test_compact_drops_low_priority_on_overflow(bench):

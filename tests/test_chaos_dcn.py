@@ -1,0 +1,158 @@
+"""Chaos test for the paged DCN edge (VERDICT r5 #8).
+
+A StreamingLM (paged continuous-batching engine) runs as a
+``remote: true`` graph node in a supervisor-spawned worker process —
+the DCN-edge deployment shape.  Mid-request, the worker is SIGKILLed:
+
+* the in-flight paged stream must fail CLEANLY — a clear upstream
+  error (or FAILURE status) within a bounded wait, never a hang;
+* the supervisor's restart loop must respawn the worker on the same
+  endpoint, and the retried request must return the CORRECT answer —
+  bit-identical to the pre-kill greedy result (params are
+  seed-deterministic, greedy decode ignores sampling seeds).
+
+Reference analogue: InternalPredictionService.java:439-467 (engine
+retry semantics against microservice pods k8s restarts) and the
+reference's rolling-update disruption test.  Fast tier: tiny model,
+one worker spawn + one respawn.
+"""
+
+import asyncio
+import time
+
+import numpy as np
+import pytest
+
+from seldon_core_tpu.controlplane import TpuDeployment
+from seldon_core_tpu.controlplane.deployer import build_generation
+from seldon_core_tpu.runtime.component import MicroserviceError
+from seldon_core_tpu.runtime.message import InternalMessage
+
+
+def _chaos_spec() -> TpuDeployment:
+    params = [
+        # big enough that 240 one-step chunks span seconds even on a
+        # fast CPU (the kill must land mid-stream), small enough that
+        # the worker's compiles stay in the readiness budget
+        {"name": "vocab_size", "value": "2048", "type": "INT"},
+        {"name": "d_model", "value": "64", "type": "INT"},
+        {"name": "num_layers", "value": "2", "type": "INT"},
+        {"name": "num_heads", "value": "4", "type": "INT"},
+        {"name": "max_len", "value": "256", "type": "INT"},
+        {"name": "max_new_tokens", "value": "240", "type": "INT"},
+        {"name": "page_size", "value": "8", "type": "INT"},
+        {"name": "max_slots", "value": "2", "type": "INT"},
+        # steps_per_call=1 -> one compiled chunk per token: the request
+        # spans many engine steps, so the kill reliably lands mid-stream
+        {"name": "steps_per_call", "value": "1", "type": "INT"},
+        {"name": "seed", "value": "0", "type": "INT"},
+    ]
+    return TpuDeployment.from_dict(
+        {
+            "name": "paged-chaos",
+            "annotations": {
+                # the long decode (and its first-request compiles on a
+                # loaded CI host) must not trip the default 5 s gRPC
+                # deadline before the chaos does its work
+                "seldon.io/grpc-read-timeout": "180000",
+                # worker boot = interpreter + jax import + engine build;
+                # ~45 s cold on the 1-CPU CI host
+                "seldon.io/worker-ready-timeout-s": "120",
+            },
+            "predictors": [
+                {
+                    "name": "main",
+                    "traffic": 100,
+                    "graph": {
+                        "name": "paged-lm",
+                        "type": "MODEL",
+                        "component_class":
+                            "seldon_core_tpu.models.paged.StreamingLM",
+                        "parameters": params,
+                        "remote": True,
+                    },
+                }
+            ],
+        }
+    )
+
+
+@pytest.mark.e2e
+def test_worker_killed_mid_request_fails_cleanly_then_restart_recovers():
+    spec = _chaos_spec()
+    prompt = (np.arange(6, dtype=np.int32) % 64)[None, :]
+
+    async def scenario():
+        gen = await asyncio.to_thread(build_generation, spec)
+        try:
+            assert gen.supervisor is not None
+            worker = list(gen.supervisor.processes.values())[0]
+            assert worker.alive() and worker.ready()
+
+            # ---- 1. baseline: a full request against the live worker
+            # (pays the worker's compiles; greedy + seed-deterministic
+            # params make this THE correct answer for every retry)
+            out = await gen.gateway.predict(InternalMessage(payload=prompt))
+            assert out.status is None or out.status.get("status") != "FAILURE"
+            expected = np.asarray(out.array())
+            assert expected.shape[-1] == 240  # the full decode ran
+
+            # ---- 2. kill the worker MID-REQUEST: the in-flight paged
+            # stream must fail cleanly within a bounded wait, not hang.
+            # Shrinking sleeps per attempt: on a host fast enough to
+            # finish 240 warm chunks inside the window, retry with a
+            # tighter one (killing at 0 s — mid-connection — is still a
+            # valid chaos shape; the assertions below don't change).
+            inflight = None
+            for delay in (0.15, 0.05, 0.0):
+                inflight = asyncio.ensure_future(
+                    gen.gateway.predict(InternalMessage(payload=prompt))
+                )
+                if delay:
+                    await asyncio.sleep(delay)
+                if not inflight.done():
+                    break
+            assert not inflight.done(), (
+                "request finished before every kill window — decode too "
+                "fast for the chaos; raise max_new_tokens"
+            )
+            worker.proc.kill()  # SIGKILL, no grace — the chaos
+            t0 = time.monotonic()
+            failed_cleanly = False
+            try:
+                res = await asyncio.wait_for(inflight, timeout=30.0)
+                status = (res.status or {}).get("status")
+                failure_reason = str(res.status)
+                failed_cleanly = status == "FAILURE"
+            except MicroserviceError as e:
+                failure_reason = str(e)
+                failed_cleanly = True
+            elapsed = time.monotonic() - t0
+            assert failed_cleanly, (
+                "in-flight stream on a killed worker must surface an "
+                f"error, got a success payload ({failure_reason})"
+            )
+            assert elapsed < 30.0  # bounded: wait_for would have thrown
+
+            # ---- 3. the supervisor restart path: same spec, same
+            # endpoint; readiness returns once the respawned process
+            # serves (restart backoff starts at 0.5 s)
+            deadline = time.monotonic() + 120.0
+            while time.monotonic() < deadline:
+                if worker.alive() and worker.ready():
+                    break
+                await asyncio.sleep(0.25)
+            else:
+                raise AssertionError("supervisor never respawned the worker")
+            assert worker.restarts >= 1
+
+            # ---- 4. correctness after recovery: the retried request
+            # returns the exact pre-kill greedy answer
+            out2 = await gen.gateway.predict(InternalMessage(payload=prompt))
+            assert out2.status is None or out2.status.get("status") != "FAILURE"
+            np.testing.assert_array_equal(np.asarray(out2.array()), expected)
+        finally:
+            await gen.gateway.close()
+            await asyncio.to_thread(gen.stop_scaling)
+
+    asyncio.run(scenario())

@@ -1,0 +1,110 @@
+"""The §10b glossary is under contract (VERDICT r5 #6).
+
+Round 5 shipped a glossary that contradicted the certified line it
+glosses (`int8_big_x` hard-coded as 0.76× while `BENCH_r05.json`
+printed 0.99), and three divergent paged-sweep citations with no run
+stamps.  These tests make that class of drift a CI failure:
+
+1. every compact-line key (`bench.py COMPACT_PICKS`) has a glossary
+   row — a new bench field cannot ship undocumented;
+2. any measured value a glossary row quotes must name its certified
+   artifact (``BENCH_rNN``) or an external source ("sourced" /
+   "reference") — no unstamped constants;
+3. where a row stamps a value as ``certified **X** (BENCH_rNN.json)``
+   for its own key, X must EQUAL that artifact's parsed value — the
+   exact 0.76-vs-0.99 failure mode, now checked mechanically.
+"""
+
+import json
+import os
+import re
+
+import pytest
+
+_DOCS = os.path.join(
+    os.path.dirname(__file__), os.pardir, "docs", "architecture.md"
+)
+_REPO = os.path.join(os.path.dirname(__file__), os.pardir)
+
+
+def _glossary_rows():
+    """Table rows of §10b, header to the first non-table paragraph."""
+    with open(_DOCS) as f:
+        text = f.read()
+    start = text.index("### 10b.")
+    block = text[start:]
+    rows = []
+    in_table = False
+    for line in block.splitlines():
+        if line.startswith("|"):
+            in_table = True
+            rows.append(line)
+        elif in_table and line.strip():
+            break  # first prose line after the table ends the glossary
+    assert len(rows) > 10, "glossary table not found under §10b"
+    # drop the header + separator rows
+    return [r for r in rows if not re.match(r"^\|\s*(key|[-| ]+)\s*\|", r)]
+
+
+# a number wearing a rate unit, a measured ratio (1.41×-style), or an
+# explicit "certified" claim — the signals that a row QUOTES a result
+# (thresholds like ">=1.5" and config like "batch 32" don't match)
+_MEASURED = re.compile(
+    r"\d[\d,]*(?:\.\d+)?\s*(?:tok/s|img/s|req/s)|\d(?:\.\d+)?×|\bcertified\b"
+)
+_SOURCED = re.compile(r"BENCH_r\d+|sourced|reference", re.IGNORECASE)
+
+
+def test_every_compact_key_has_a_glossary_row():
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "bench", os.path.join(_REPO, "bench.py")
+    )
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+    table = "\n".join(_glossary_rows())
+    missing = [
+        key for key, _ in bench.COMPACT_PICKS if f"`{key}`" not in table
+    ]
+    assert not missing, f"compact-line keys with no §10b glossary row: {missing}"
+
+
+def test_no_unstamped_measured_constants():
+    offenders = [
+        row for row in _glossary_rows()
+        if _MEASURED.search(row) and not _SOURCED.search(row)
+    ]
+    assert not offenders, (
+        "glossary rows quote measured values without a BENCH_rNN stamp "
+        f"or source marker: {offenders}"
+    )
+
+
+def test_stamped_values_match_their_artifact():
+    """``certified **X** (`BENCH_rNN.json`)`` in a row whose first cell
+    names exactly one compact key: X must equal that run's value."""
+    checked = 0
+    for row in _glossary_rows():
+        m = re.search(
+            r"certified \*\*([0-9][\d,]*(?:\.\d+)?)[^*]*\*\*\s*\(`(BENCH_r\d+)\.json`\)",
+            row,
+        )
+        if not m:
+            continue
+        quoted, artifact = m.group(1).replace(",", ""), m.group(2)
+        keys = re.findall(r"`(\w+)`", row.split("|")[1])
+        path = os.path.join(_REPO, f"{artifact}.json")
+        if len(keys) != 1 or not os.path.exists(path):
+            continue
+        with open(path) as f:
+            extra = (json.load(f).get("parsed") or {}).get("extra") or {}
+        if keys[0] not in extra:
+            continue
+        assert float(quoted) == pytest.approx(float(extra[keys[0]])), (
+            f"glossary stamps {keys[0]} as {quoted} but {artifact}.json "
+            f"prints {extra[keys[0]]}"
+        )
+        checked += 1
+    # the int8_big_x row is the motivating case and must stay covered
+    assert checked >= 1, "no stamped glossary value was cross-checked"

@@ -710,14 +710,16 @@ class TestPageAccounting:
         eng.generate(np.array([1, 2, 3], np.int32), max_new_tokens=4)
         eng.generate(np.arange(20, dtype=np.int32), max_new_tokens=9)
         # prefill ladder: (bucket, k) programs with buckets from the
-        # ladder; decode: one chunk program per (ladder size, ctx
-        # horizon) pair actually used — steps has no ladder here (no
-        # max_steps_per_call -> only the base size) and ctx horizons
-        # are power-of-two page counts, so the compile count stays
-        # log-bounded in both axes
+        # ladder; decode: one chunk program per (ladder size, bucket
+        # spec) pair actually used — steps has no ladder here (no
+        # max_steps_per_call -> only the base size), each bucket's ctx
+        # horizon is a power-of-two page count, and lane counts sum to
+        # max_slots, so the compile count stays log-bounded in both axes
         assert {b for (b, _k) in eng._prefill_jit} <= set(eng.prompt_buckets)
-        assert {s for (s, _h) in eng._chunk_jit} == {eng.steps_per_call}
-        assert all(h >= 1 and (h & (h - 1)) == 0 for (_s, h) in eng._chunk_jit)
+        assert {s for (s, _spec) in eng._chunk_jit} == {eng.steps_per_call}
+        for (_s, spec) in eng._chunk_jit:
+            assert sum(nb for (nb, _h) in spec) == eng.max_slots
+            assert all(h >= 1 and (h & (h - 1)) == 0 for (_nb, h) in spec)
 
 
 class TestMeshShardedDecode:

@@ -1,0 +1,256 @@
+"""Length-bucketed context gather (r6): exactness + engagement.
+
+The bucketed chunk sorts lanes into 2 static length buckets INSIDE one
+compiled program (``SELDON_TPU_CTX_BUCKETS``, default on) so short
+streams stop paying the longest stream's gather/ctx-einsum cost.  The
+contract these tests pin: bucketing is a pure PERFORMANCE choice —
+greedy tokens are bit-identical bucketed vs unbucketed, ring vs pool
+chunk impl, and under the w8a8 int8 lane; and a lane's output never
+depends on which bucket its co-batch landed in.
+
+The fast-tier half is one lean smoke (bimodal parity + uniform
+degeneracy on the ring impl, single-layer model — the default tier
+must catch a broken bucket path without paying the full matrix); the
+@slow half runs every combination plus the real 32/448-token bimodal
+shape the bench certifies.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from seldon_core_tpu.models.transformer import TransformerLM
+
+
+CFG = dict(vocab_size=64, d_model=32, num_layers=2, num_heads=4, max_len=128)
+# single-layer twin for the fast tier: compile cost is per layer
+CFG_FAST = dict(CFG, num_layers=1)
+
+
+@pytest.fixture(scope="module")
+def lm():
+    module = TransformerLM(dtype=jnp.float32, **CFG)
+    params = module.init(jax.random.key(0), jnp.zeros((1, 8), jnp.int32))["params"]
+    return module, params
+
+
+def _bimodal_prompts(short, long, n, vocab=64):
+    rng = np.random.default_rng(7)
+    return [
+        rng.integers(0, vocab, size=(short if i % 2 == 0 else long,)).astype(
+            np.int32
+        )
+        for i in range(n)
+    ]
+
+
+def _engine(params, monkeypatch, *, buckets, impl, n_slots, cfg=None,
+            **engine_kw):
+    monkeypatch.setenv("SELDON_TPU_CTX_BUCKETS", buckets)
+    monkeypatch.setenv("SELDON_TPU_CHUNK_IMPL", impl)
+    from seldon_core_tpu.models.paged import PagedEngine
+
+    kw = dict(dtype=jnp.float32, page_size=8, max_slots=n_slots,
+              steps_per_call=4)
+    kw.update(engine_kw)
+    return PagedEngine(params, **(cfg or CFG), **kw)
+
+
+def _serve(eng, prompts, max_new=10):
+    streams = [eng.submit(p, max_new_tokens=max_new) for p in prompts]
+    eng.run()
+    return np.stack([s.result for s in streams])
+
+
+def _run(params, prompts, monkeypatch, *, buckets, impl, max_new=10,
+         cfg=None, **engine_kw):
+    eng = _engine(params, monkeypatch, buckets=buckets, impl=impl,
+                  n_slots=len(prompts), cfg=cfg, **engine_kw)
+    toks = _serve(eng, prompts, max_new=max_new)
+    return toks, eng.engine_stats()
+
+
+def _greedy_uncached(module, params, prompt, n):
+    tokens = np.asarray(prompt, np.int32)[None, :]
+    out = []
+    for _ in range(n):
+        logits = module.apply({"params": params}, jnp.asarray(tokens))
+        nxt = int(jnp.argmax(logits[0, -1]))
+        out.append(nxt)
+        tokens = np.concatenate([tokens, [[nxt]]], axis=1)
+    return out
+
+
+class TestBucketedGatherFastTier:
+    def test_bimodal_parity_and_uniform_degeneracy(self, monkeypatch):
+        """One bucketed and one unbucketed ring engine serve the SAME
+        bimodal then uniform batches: bimodal tokens identical with the
+        2-bucket program engaged; uniform traffic degenerates to one
+        bucket (equal horizons) and stays identical — the knob is a
+        pure performance choice, pinned in the default tier."""
+        module = TransformerLM(dtype=jnp.float32, **CFG_FAST)
+        params = module.init(
+            jax.random.key(0), jnp.zeros((1, 8), jnp.int32)
+        )["params"]
+        bimodal = _bimodal_prompts(4, 40, 4)
+        rng = np.random.default_rng(3)
+        uniform = [
+            rng.integers(0, 64, size=(9,)).astype(np.int32) for _ in range(4)
+        ]
+        eng2 = _engine(params, monkeypatch, buckets="2", impl="ring",
+                       n_slots=4, cfg=CFG_FAST)
+        eng1 = _engine(params, monkeypatch, buckets="1", impl="ring",
+                       n_slots=4, cfg=CFG_FAST)
+        bi2, bi1 = _serve(eng2, bimodal), _serve(eng1, bimodal)
+        assert eng2.engine_stats()["bucketed_chunks"] > 0
+        assert eng1.engine_stats()["bucketed_chunks"] == 0
+        assert np.array_equal(bi2, bi1)
+        # ground truth for one short and one long stream, so the A/B
+        # can't both be wrong the same way
+        for i in (0, 1):
+            want = _greedy_uncached(module, params, bimodal[i], 10)
+            assert bi2[i].tolist() == want, i
+        marker = eng2.engine_stats()["bucketed_chunks"]
+        un2, un1 = _serve(eng2, uniform), _serve(eng1, uniform)
+        assert eng2.engine_stats()["bucketed_chunks"] == marker  # degenerated
+        assert np.array_equal(un2, un1)
+
+    def test_partial_occupancy_splits_live_lanes_not_idle(self, monkeypatch):
+        """Host-level planning contract (no compiles): with most slots
+        idle, the live streams split at THEIR midpoint — idle lanes are
+        filler, they must not displace short live streams into the long
+        bucket (the drain/low-occupancy case), and a 2-bucket plan
+        implies some live lane actually runs at the cheaper horizon."""
+        from types import SimpleNamespace
+
+        module = TransformerLM(dtype=jnp.float32, **CFG_FAST)
+        params = module.init(
+            jax.random.key(2), jnp.zeros((1, 8), jnp.int32)
+        )["params"]
+        eng = _engine(params, monkeypatch, buckets="2", impl="ring",
+                      n_slots=16, cfg=CFG_FAST)
+        # 4 live streams (2 short, 2 long) in a 16-slot engine
+        live = {1: 6, 5: 7, 9: 60, 13: 58}
+        for slot, length in live.items():
+            eng._lengths[slot] = length
+        runnable = [SimpleNamespace(slot=s) for s in live]
+        buckets, perm = eng._plan_buckets(runnable, steps=4, pages_h=16)
+        assert len(buckets) == 2
+        (b0, h0), (b1, h1) = buckets
+        assert b0 + b1 == 16 and h0 < h1
+        short_bucket = set(perm[:b0].tolist())
+        assert {1, 5} <= short_bucket          # short live lanes stay cheap
+        assert not ({9, 13} & short_bucket)    # long live lanes in bucket 1
+        # short bucket's horizon covers only the short lanes: 7 tokens
+        # at page_size 8 -> 1 page
+        assert h0 == 1
+        assert sorted(perm.tolist()) == list(range(16))  # a permutation
+
+    def test_invalid_buckets_env_rejected(self, monkeypatch):
+        monkeypatch.setenv("SELDON_TPU_CTX_BUCKETS", "3")
+        from seldon_core_tpu.models.paged import PagedEngine
+
+        module = TransformerLM(dtype=jnp.float32, **CFG_FAST)
+        params = module.init(
+            jax.random.key(1), jnp.zeros((1, 8), jnp.int32)
+        )["params"]
+        with pytest.raises(ValueError, match="SELDON_TPU_CTX_BUCKETS"):
+            PagedEngine(params, dtype=jnp.float32, page_size=8,
+                        max_slots=2, steps_per_call=4, **CFG_FAST)
+
+
+@pytest.mark.slow
+class TestBucketedGatherMatrix:
+    def test_bimodal_parity_all_combinations(self, lm, monkeypatch):
+        """One bimodal batch through {ring,pool} x {bucketed,unbucketed}:
+        four identical token matrices, and the bucketed runs must have
+        actually engaged the 2-bucket program (not degenerated)."""
+        _, params = lm
+        prompts = _bimodal_prompts(4, 40, 8)
+        ref = None
+        for impl in ("ring", "pool"):
+            for buckets in ("1", "2"):
+                got, stats = _run(
+                    params, prompts, monkeypatch, buckets=buckets,
+                    impl=impl, max_new=20,
+                )
+                if buckets == "2":
+                    assert stats["bucketed_chunks"] > 0, impl
+                else:
+                    assert stats["bucketed_chunks"] == 0, impl
+                if ref is None:
+                    ref = got
+                else:
+                    assert np.array_equal(ref, got), (impl, buckets)
+
+    def test_bucketed_matches_uncached_recompute(self, lm, monkeypatch):
+        """Absolute ground truth, not just A/B: bucketed greedy equals
+        the full uncached forward re-run token by token."""
+        module, params = lm
+        prompts = _bimodal_prompts(5, 33, 4)
+        got, stats = _run(params, prompts, monkeypatch, buckets="2",
+                          impl="ring", max_new=12)
+        assert stats["bucketed_chunks"] > 0
+        for i, p in enumerate(prompts):
+            assert got[i].tolist() == _greedy_uncached(module, params, p, 12), i
+
+    def test_lane_output_independent_of_co_batch_bucket(self, lm, monkeypatch):
+        """The short stream decodes the same tokens whether its
+        co-batch is short (one bucket) or long (two buckets) — the
+        per-stream determinism continuous batching promises, now also
+        across bucket shapes."""
+        _, params = lm
+        short = np.arange(6, dtype=np.int32) % 64
+        alone, _ = _run(params, [short, short + 1], monkeypatch,
+                        buckets="2", impl="ring")
+        longp = (np.arange(40, dtype=np.int32) * 5) % 64
+        mixed, stats = _run(params, [short, longp], monkeypatch,
+                            buckets="2", impl="ring")
+        assert stats["bucketed_chunks"] > 0
+        assert np.array_equal(alone[0], mixed[0])
+
+    def test_w8a8_bucketed_cross_parity(self, lm, monkeypatch):
+        """The PR-1 int8 lane must stay exact under the new gather:
+        w8a8 bucketed == w8a8 unbucketed (per-token activation scales
+        are lane-order-blind), and bucketing engages."""
+        _, params = lm
+        f32 = jax.tree.map(
+            lambda a: a.astype(jnp.float32) if hasattr(a, "astype") else a,
+            params,
+        )
+        prompts = _bimodal_prompts(4, 36, 4)
+        ref, _ = _run(f32, prompts, monkeypatch, buckets="1", impl="ring",
+                      precision="w8a8")
+        for impl in ("ring", "pool"):
+            got, stats = _run(f32, prompts, monkeypatch, buckets="2",
+                              impl=impl, precision="w8a8")
+            assert stats["bucketed_chunks"] > 0, impl
+            assert np.array_equal(ref, got), impl
+
+    def test_bimodal_32_448_parity_ring_pool_bucketed(self, monkeypatch):
+        """The bench-certified shape: 32/448-token bimodal prompts (the
+        ISSUE r6 acceptance workload), at test-sized width/stream
+        count."""
+        cfg = dict(vocab_size=128, d_model=32, num_layers=2, num_heads=4,
+                   max_len=512)
+        module = TransformerLM(dtype=jnp.float32, **cfg)
+        params = module.init(
+            jax.random.key(1), jnp.zeros((1, 8), jnp.int32)
+        )["params"]
+        prompts = _bimodal_prompts(32, 448, 8, vocab=128)
+        ref = None
+        for impl in ("ring", "pool"):
+            for buckets in ("2", "1"):
+                got, stats = _run(
+                    params, prompts, monkeypatch, buckets=buckets,
+                    impl=impl, max_new=16, cfg=cfg,
+                    page_size=64, steps_per_call=8,
+                )
+                if buckets == "2":
+                    assert stats["bucketed_chunks"] > 0, impl
+                if ref is None:
+                    ref = got
+                else:
+                    assert np.array_equal(ref, got), (impl, buckets)

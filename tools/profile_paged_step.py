@@ -151,7 +151,8 @@ def main():
             step, (logits, lengths, done, emitted), None, length=args.steps)
         return toks.sum() + lengths.sum()
 
-    full = eng._get_chunk(args.steps)
+    ident_perm = jnp.arange(args.slots, dtype=jnp.int32)
+    full = eng._get_chunk(args.steps, ((args.slots, horizon),))
 
     def barrier(out):
         # block_until_ready on the axon relay backend returns BEFORE
@@ -184,7 +185,7 @@ def main():
     def full_fresh():
         return full(params, jnp.copy(pk), jnp.copy(pv), logits, lengths,
                     block_tables, keys, done, emitted, max_new, temps,
-                    top_ks, eos_ids)
+                    top_ks, eos_ids, ident_perm)
     timed("full", full_fresh)
 
     # -------- two-point slope: the session degrades to a fixed
@@ -222,12 +223,12 @@ def main():
         return jax.jit(fo), params, pk, pv, lengths
 
     def build_full(n):
-        fn = eng._get_chunk(n)
+        fn = eng._get_chunk(n, ((args.slots, horizon),))
 
         def run():
             return fn(params, jnp.copy(pk), jnp.copy(pv), logits, lengths,
                       block_tables, keys, done, emitted, max_new, temps,
-                      top_ks, eos_ids)
+                      top_ks, eos_ids, ident_perm)
         return (run,)
 
     slope("forward", build_forward)
