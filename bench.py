@@ -174,6 +174,13 @@ COMPACT_PICKS = [
     ("paged_bimodal_tok_s", ("generation", "paged_bimodal_tokens_per_s")),
     ("paged256_tok_s", ("generation", "paged_serving256_tokens_per_s")),
     ("paged_cap_streams", ("generation", "paged_capacity", "streams")),
+    # r7 observability certification: paged throughput cost of the FULL
+    # observability stack (lifecycle spans + per-chunk flight recorder)
+    # vs everything disabled, same 16-stream protocol both sides.
+    # Positive = slower with observability on; the always-on-recorder
+    # posture requires < 2 (raw on/off rates in bench_full.json
+    # obs_on/off_tokens_per_s)
+    ("obs_overhead_pct", ("generation", "obs_overhead_pct")),
     ("paged_chunk_tok_s", ("generation", "paged_chunk_tokens_per_s")),
     # NOTE: the r3 micro-comparison artifact paged_decode_tokens_per_s
     # (one device call per token, a methodology contrast — NOT a
@@ -1818,6 +1825,44 @@ def generation_phase() -> dict:
                 result["paged_chunk_tokens_per_s"]
                 / max(result["decode_tokens_per_s"], 1e-9), 3
             )
+
+        # ---- observability overhead certification (r7): the same
+        # 16-stream point with the FULL observability stack on (an
+        # installed tracer, so every stream emits its gen.* lifecycle
+        # spans, + the per-chunk flight recorder) vs everything off.
+        # The recorder ships enabled by default, so this ratio is the
+        # price production pays; the acceptance gate is < 2%.
+        from seldon_core_tpu.utils import tracing as _tracing
+
+        def obs_point(enabled: bool):
+            # in-memory Tracer only (no exporter): measures the span
+            # emission + recorder cost, not a collector's network
+            os.environ["SELDON_TPU_FLIGHT_RECORDER"] = (
+                "512" if enabled else "0"
+            )
+            _tracing._tracer = _tracing.Tracer(capacity=8192) if enabled else None
+            try:
+                return measure_point(
+                    PagedEngine(
+                        params, dtype=jnp.bfloat16, page_size=64,
+                        max_slots=serve_slots, steps_per_call=8,
+                        max_steps_per_call=64 if quick else 256,
+                        **serve_cfg,
+                    ),
+                    sprompts,
+                )
+            finally:
+                _tracing._tracer = None
+                os.environ.pop("SELDON_TPU_FLIGHT_RECORDER", None)
+
+        obs_on = obs_point(True)
+        obs_off = obs_point(False)
+        result["obs_on_tokens_per_s"] = round(obs_on["rate"], 1)
+        result["obs_off_tokens_per_s"] = round(obs_off["rate"], 1)
+        result["obs_overhead_pct"] = round(
+            (obs_off["rate"] - obs_on["rate"])
+            / max(obs_off["rate"], 1e-9) * 100.0, 2
+        )
 
         # wider continuous batching: slots amortise the per-call cost.
         # The r4 sweep regressed past 64 streams (16 -> 3.4k, 64 ->

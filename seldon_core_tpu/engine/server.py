@@ -409,6 +409,57 @@ def build_gateway_app(gateway: Gateway, auth=None) -> web.Application:
 
         return web.Response(body=generate_latest(), content_type=CONTENT_TYPE_LATEST.split(";")[0])
 
+    async def debug_engine(request: web.Request) -> web.Response:
+        """Generation-engine stats for every local component that runs a
+        paged engine, keyed predictor -> node.  ``?detail=1`` adds the
+        flight recorder's per-chunk ring (the post-incident forensics
+        payload; see docs/architecture.md §Generation observability)."""
+        detail = request.query.get("detail", "") in ("1", "true", "yes")
+        out: Dict[str, Dict[str, object]] = {}
+        for svc in gateway.predictors:
+            nodes = {}
+            for unit in svc.graph.walk():
+                component = svc.executor.component(unit.name)
+                engine = getattr(component, "engine", None)
+                stats_fn = getattr(engine, "engine_stats", None)
+                if stats_fn is None:
+                    continue
+                try:
+                    nodes[unit.name] = stats_fn(detail=detail)
+                except TypeError:  # engines predating the detail arg
+                    nodes[unit.name] = stats_fn()
+            if nodes:
+                out[svc.name] = nodes
+        return web.json_response(out)
+
+    async def debug_traces(request: web.Request) -> web.Response:
+        """Spans from the in-process tracer ring: ``?trace_id=<puid>``
+        for one trace (the engine request span + its gen.* lifecycle
+        spans), else the newest ``?limit=`` spans — the debug surface
+        the tracing module promises."""
+        from seldon_core_tpu.utils.tracing import get_tracer
+
+        tracer = get_tracer()
+        if tracer is None:
+            return web.json_response(
+                {"enabled": False, "spans": [],
+                 "info": "tracing not set up (call setup_tracing / set "
+                         "OTEL_EXPORTER_OTLP_ENDPOINT)"},
+            )
+        trace_id = request.query.get("trace_id", "")
+        try:
+            limit = max(1, min(int(request.query.get("limit", "256")), 4096))
+        except ValueError:
+            limit = 256
+        if trace_id:
+            spans = tracer.find(trace_id)
+        else:
+            with tracer._lock:  # noqa: SLF001 — same package, read-only copy
+                spans = list(tracer.spans)
+        return web.json_response(
+            {"enabled": True, "spans": [s.to_dict() for s in spans[-limit:]]}
+        )
+
     async def openapi_endpoint(_r: web.Request) -> web.Response:
         from seldon_core_tpu.runtime.openapi import gateway_openapi
 
@@ -427,6 +478,8 @@ def build_gateway_app(gateway: Gateway, auth=None) -> web.Application:
     app.router.add_route("*", "/pause", pause)
     app.router.add_route("*", "/unpause", unpause)
     app.router.add_get("/metrics", metrics_endpoint)
+    app.router.add_get("/debug/engine", debug_engine)
+    app.router.add_get("/debug/traces", debug_traces)
     return app
 
 

@@ -665,6 +665,8 @@ class _Stream:
         "req_id", "prompt", "max_new", "temperature", "top_k", "eos_id",
         "seed", "tokens", "event", "result", "error", "slot", "pages",
         "pending", "draft_hint", "token_queue", "streamed", "cancelled",
+        "trace_id", "parent_span_id", "t_submit", "t_decode_start",
+        "queue_depth_at_submit",
     )
 
     def __init__(self, req_id, prompt, max_new, temperature, top_k, eos_id, seed):
@@ -694,6 +696,15 @@ class _Stream:
         self.token_queue: Optional["_queue.Queue"] = None
         self.streamed = 0
         self.cancelled = False
+        # lifecycle-trace linkage (set by submit()): the request puid and
+        # the submitter's span — gen.* spans emitted from the decode-loop
+        # thread link by these explicitly (contextvars don't cross
+        # threads).  Zeros/None when tracing is off: no per-stream cost.
+        self.trace_id = ""
+        self.parent_span_id: Optional[str] = None
+        self.t_submit = 0.0
+        self.t_decode_start = 0.0
+        self.queue_depth_at_submit = 0
 
 
 class PagedEngine:
@@ -910,6 +921,11 @@ class PagedEngine:
         self._lengths = np.zeros((self.max_slots,), np.int32)
         self._next_id = 0
         self._closed = False
+        # gen.* spans whose emission points sit inside _lock-held code
+        # (finish/evict): queued here and flushed by step() AFTER the
+        # lock drops — Tracer.record can write+flush a JSONL file, and
+        # disk I/O must never run under the engine lock
+        self._pending_spans: List[Tuple[_Stream, str, float, float, Dict[str, Any]]] = []
         # observability counters (exported by StreamingLM.metrics();
         # updated under _lock)
         self._counters = {"chunks": 0, "tokens": 0, "evictions": 0,
@@ -921,6 +937,40 @@ class PagedEngine:
                           # (tokens / chunk_wall_s) independent of
                           # admission cost
                           "chunk_wall_s": 0.0, "prefill_wall_s": 0.0}
+
+        # ---- observability: flight recorder + profiler hook (r7) ----
+        # Per-chunk ring buffer (near-zero overhead: one dict append per
+        # CHUNK, not per step) exposed via engine_stats(detail=True) and
+        # the gateway's /debug/engine; SELDON_TPU_FLIGHT_RECORDER=0
+        # disables (the bench's obs-off arm), any other value sets the
+        # ring capacity.  SELDON_TPU_DUMP_P99_MS breached by the ring's
+        # chunk-wall p99 auto-dumps the ring to JSONL under
+        # SELDON_TPU_DUMP_DIR — post-incident forensics with no profiler
+        # attached.
+        rec_env = _os.environ.get("SELDON_TPU_FLIGHT_RECORDER", "")
+        self.recorder = None
+        if rec_env != "0":
+            from seldon_core_tpu.utils.flightrec import FlightRecorder
+
+            self.recorder = FlightRecorder(
+                capacity=int(rec_env) if rec_env.isdigit() and rec_env != "0"
+                else 512,
+                dump_p99_ms=float(
+                    _os.environ.get("SELDON_TPU_DUMP_P99_MS", "0") or 0
+                ),
+                dump_dir=_os.environ.get("SELDON_TPU_DUMP_DIR") or None,
+            )
+        # opt-in XLA-level inspection: the first N decode chunks run
+        # inside jax.profiler.trace (N = SELDON_TPU_PROFILE_CHUNKS,
+        # default 4) writing to SELDON_TPU_PROFILE_DIR — enough to catch
+        # the compiled chunk program's timeline without profiling the
+        # whole serving lifetime
+        self._profile_dir = _os.environ.get("SELDON_TPU_PROFILE_DIR") or None
+        self._profile_chunks_left = (
+            int(_os.environ.get("SELDON_TPU_PROFILE_CHUNKS", "4"))
+            if self._profile_dir else 0
+        )
+        self._profile_started = False
 
         # speculative mode: per-slot draft/verify INSIDE the batched
         # engine — each chunk is ONE verify forward of width draft_k+1
@@ -1544,6 +1594,72 @@ class PagedEngine:
         lengths = lengths + counts
         return out, counts, pk, pv, lengths
 
+    # ---- observability helpers -------------------------------------------
+
+    def _gen_span(self, stream: _Stream, name: str, start_s: float,
+                  duration_s: float, **tags: Any) -> None:
+        """One gen.* lifecycle span for a stream, linked to the
+        submitter's request span by the (trace_id=puid, parent_span_id)
+        pair captured at submit — the decode loop runs on its own
+        thread, so contextvar nesting cannot do it.  No-op (no tracer or
+        untraced stream) costs one attribute read."""
+        if not stream.trace_id:
+            return
+        from seldon_core_tpu.utils.tracing import record_span
+
+        record_span(
+            name, stream.trace_id, start_s, duration_s,
+            parent_span_id=stream.parent_span_id,
+            puid=stream.trace_id, req_id=stream.req_id, **tags,
+        )
+
+    def _gen_span_deferred(self, stream: _Stream, name: str, start_s: float,
+                           duration_s: float, **tags: Any) -> None:
+        """Queue a span from _lock-held code; step() flushes after the
+        lock drops.  Caller must hold self._lock."""
+        if stream.trace_id:
+            self._pending_spans.append((stream, name, start_s, duration_s, tags))
+
+    def _flush_spans(self) -> None:
+        if not self._pending_spans:  # benign unlocked read: step() always re-runs
+            return
+        with self._lock:
+            pending, self._pending_spans = self._pending_spans, []
+        for stream, name, start_s, duration_s, tags in pending:
+            self._gen_span(stream, name, start_s, duration_s, **tags)
+
+    def _record_chunk(self, rec: Dict[str, Any]) -> None:
+        if self.recorder is not None:
+            self.recorder.record(rec)
+
+    def _profile_before_chunk(self) -> None:
+        """SELDON_TPU_PROFILE_DIR hook: the first N chunk programs run
+        inside one jax.profiler.trace for XLA-level inspection; profiler
+        failures disable the hook, never decoding."""
+        if self._profile_chunks_left <= 0 or self._profile_started:
+            return
+        try:
+            self._jax.profiler.start_trace(self._profile_dir)
+            self._profile_started = True
+            logger.info(
+                "profiling the next %d decode chunks to %s",
+                self._profile_chunks_left, self._profile_dir,
+            )
+        except Exception:  # noqa: BLE001
+            logger.exception("jax profiler start failed; hook disabled")
+            self._profile_chunks_left = 0
+
+    def _profile_after_chunk(self) -> None:
+        if not self._profile_started:
+            return
+        self._profile_chunks_left -= 1
+        if self._profile_chunks_left <= 0:
+            try:
+                self._jax.profiler.stop_trace()
+            except Exception:  # noqa: BLE001
+                logger.exception("jax profiler stop failed")
+            self._profile_started = False
+
     # ---- host control -----------------------------------------------------
 
     def submit(
@@ -1556,12 +1672,21 @@ class PagedEngine:
         seed: int = 0,
         draft_hint: Optional[np.ndarray] = None,
         stream_tokens: bool = False,
+        trace_id: str = "",
+        parent_span_id: Optional[str] = None,
     ) -> _Stream:
         """Queue one prompt (1-D int array). Returns a stream handle whose
         ``event`` fires when ``result`` (``(max_new,)`` ids) is ready.
 
         ``draft_hint`` (speculative draft='oracle' only): the expected
-        continuation, drafted verbatim — the acceptance-ceiling lane."""
+        continuation, drafted verbatim — the acceptance-ceiling lane.
+
+        ``trace_id``/``parent_span_id`` link this stream's ``gen.*``
+        lifecycle spans into the submitter's trace (StreamingLM passes
+        the request puid + its microservice span).  When omitted and a
+        tracer is installed, the caller's active span is captured here —
+        the decode loop runs on another thread, so the linkage must be
+        pinned at submit time."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         plen = len(prompt)
         if plen < 1:
@@ -1608,6 +1733,21 @@ class PagedEngine:
             if stream_tokens:
                 stream.token_queue = _queue.Queue()
             self._next_id += 1
+            from seldon_core_tpu.utils import tracing as _tracing
+
+            if _tracing.get_tracer() is not None:  # one global read when off
+                import time as _time
+
+                enclosing = _tracing.current_span()
+                stream.trace_id = trace_id or (
+                    enclosing.trace_id if enclosing is not None
+                    else f"gen-{stream.req_id}"
+                )
+                stream.parent_span_id = parent_span_id or (
+                    enclosing.span_id if enclosing is not None else None
+                )
+                stream.t_submit = _time.time()
+                stream.queue_depth_at_submit = len(self._queue)
             self._queue.append(stream)
         return stream
 
@@ -1650,6 +1790,18 @@ class PagedEngine:
         import time as _time
 
         t_start = _time.perf_counter()
+        t_admit = _time.time()
+        for stream in streams:
+            # queue-wait is the irreducible tail term (§10a): give it a
+            # span of its own so one trace decomposes it per request
+            if stream.trace_id:
+                self._gen_span(
+                    stream, "gen.queued", stream.t_submit or t_admit,
+                    max(0.0, t_admit - stream.t_submit)
+                    if stream.t_submit else 0.0,
+                    slot=stream.slot,
+                    queue_depth=stream.queue_depth_at_submit,
+                )
         jnp = self._jnp
         by_bucket: Dict[int, List[_Stream]] = {}
         for stream in streams:
@@ -1657,6 +1809,7 @@ class PagedEngine:
             bucket = next(b for b in self.prompt_buckets if b >= plen)
             by_bucket.setdefault(bucket, []).append(stream)
         for bucket, group in by_bucket.items():
+            t_group = _time.time()
             k = 1
             while k < len(group):
                 k *= 2
@@ -1705,6 +1858,20 @@ class PagedEngine:
                 pending = np.asarray(jnp.argmax(last[:g], axis=-1))
                 for i, stream in enumerate(group):
                     stream.pending = int(pending[i])
+            t_done = _time.time()
+            for stream in group:
+                stream.t_decode_start = t_done
+                if stream.trace_id:
+                    # the group prefills in ONE device call, so every
+                    # member's span carries the group wall (tagged with
+                    # the group size so a reader knows it is shared)
+                    self._gen_span(
+                        stream, "gen.prefill", t_group, t_done - t_group,
+                        slot=stream.slot, bucket=bucket,
+                        prompt_len=len(stream.prompt),
+                        pages_held=len(stream.pages),
+                        group_size=len(group),
+                    )
         if streams:
             with self._lock:
                 self._counters["prefill_wall_s"] += _time.perf_counter() - t_start
@@ -1751,6 +1918,7 @@ class PagedEngine:
     def _finish_locked(self, stream: _Stream) -> None:
         slot = stream.slot
         toks = stream.tokens[: stream.max_new]
+        emitted_n = len(toks)
         eos = stream.eos_id
         if eos in toks:
             cut = toks.index(eos) + 1
@@ -1760,6 +1928,28 @@ class PagedEngine:
         self._stream_push(stream)
         if stream.token_queue is not None:
             stream.token_queue.put(None)  # end-of-stream
+        if stream.trace_id:
+            import time as _time
+
+            now = _time.time()
+            if stream.t_decode_start:
+                self._gen_span_deferred(
+                    stream, "gen.decode", stream.t_decode_start,
+                    max(0.0, now - stream.t_decode_start),
+                    slot=slot, tokens=emitted_n,
+                )
+            finish_tags: Dict[str, Any] = dict(
+                slot=slot, tokens=emitted_n,
+                pages_held=len(stream.pages),
+                cancelled=stream.cancelled,
+            )
+            if self.speculative is not None:
+                drafted = self._counters["spec_drafted"]
+                finish_tags["spec_accept_rate"] = (
+                    round(self._counters["spec_accepted"] / drafted, 3)
+                    if drafted else 0.0
+                )
+            self._gen_span_deferred(stream, "gen.finish", now, 0.0, **finish_tags)
         self._slots[slot] = None
         self._free(stream.pages)
         stream.pages = []
@@ -1771,6 +1961,22 @@ class PagedEngine:
         """Kick a stream out of its slot back to the queue head; it will
         re-prefill from scratch on re-admission."""
         slot = stream.slot
+        if stream.trace_id:
+            import time as _time
+
+            now = _time.time()
+            self._gen_span_deferred(
+                stream, "gen.evict", now, 0.0,
+                slot=slot, tokens_discarded=len(stream.tokens),
+                pages_freed=len(stream.pages),
+            )
+            # restart the lifecycle clock: the re-admitted run's
+            # gen.queued must measure the RE-queue wait, not the first
+            # service attempt — otherwise the decomposition blames
+            # served time on the queue-wait term it exists to isolate
+            stream.t_submit = now
+            stream.t_decode_start = 0.0
+            stream.queue_depth_at_submit = len(self._queue)
         self._slots[slot] = None
         self._free(stream.pages)
         stream.pages = []
@@ -1817,17 +2023,33 @@ class PagedEngine:
         with self._lock:
             return bool(self._queue) or any(s is not None for s in self._slots)
 
-    def engine_stats(self) -> Dict[str, Any]:
+    def engine_stats(self, detail: bool = False) -> Dict[str, Any]:
         """Counters + live occupancy, the generation observability
-        surface (jaxserver's batcher stats equivalent)."""
+        surface (jaxserver's batcher stats equivalent).
+
+        The DEFAULT key set is under contract: every key is either
+        mapped to a canonical Prometheus metric by
+        ``GenerationPrometheusBridge`` or listed in its explicit
+        exclusion set (tests/test_gen_observability.py), so a new
+        counter cannot silently skip export.  ``detail=True`` adds the
+        flight recorder's ring (per-chunk records) and its aggregates —
+        the /debug/engine payload."""
         with self._lock:
-            return {
+            out = {
                 **self._counters,
                 "active_slots": sum(s is not None for s in self._slots),
                 "queued_streams": len(self._queue),
                 "pool_pages_used": self.num_pages - 1 - len(self._free_pages),
                 "pool_pages_total": self.num_pages - 1,
             }
+        if detail:
+            if self.recorder is not None:
+                out["recorder"] = self.recorder.snapshot()
+                out["recorder_stats"] = self.recorder.stats()
+            else:
+                out["recorder"] = []
+                out["recorder_stats"] = {"records": 0, "seq": 0}
+        return out
 
     def close(self, exc: Optional[Exception] = None) -> None:
         """Permanently shut the engine: future submits are rejected with
@@ -1864,8 +2086,17 @@ class PagedEngine:
 
         Returns True while there is (or may be) more work.
         """
-        if self.speculative is not None:
-            return self._step_speculative()
+        try:
+            if self.speculative is not None:
+                return self._step_speculative()
+            return self._step_decode()
+        finally:
+            # spans queued inside _lock-held retire/evict code emit here,
+            # after every lock has dropped (a JSONL-exporting tracer does
+            # disk I/O) — including on the early-return paths
+            self._flush_spans()
+
+    def _step_decode(self) -> bool:
         jnp = self._jnp
         with self._lock:
             admitted = self._admit_locked()
@@ -1952,6 +2183,7 @@ class PagedEngine:
 
         import time as _time
 
+        self._profile_before_chunk()
         t_chunk = _time.perf_counter()
         toks, self.pages_k, self.pages_v, self._logits, lengths_out, self._keys, _, emitted = (
             self._get_chunk(steps, buckets)(
@@ -1965,17 +2197,20 @@ class PagedEngine:
         emitted_np = np.asarray(emitted)
         self._lengths = np.array(lengths_out)  # copy: jax views are read-only
         chunk_wall = _time.perf_counter() - t_chunk
+        self._profile_after_chunk()
 
         with self._lock:
             self._counters["chunks"] += 1
             self._counters["bucketed_chunks"] += int(len(buckets) > 1)
             self._counters["chunk_wall_s"] += chunk_wall
+            chunk_tokens = 0
             for stream in active:
                 s = stream.slot
                 if stalled[s]:
                     continue
                 n = int(emitted_np[s])
                 self._counters["tokens"] += n
+                chunk_tokens += n
                 got = toks_np[s, :n].tolist()
                 stream.tokens.extend(got)
                 hit_eos = stream.eos_id in got
@@ -1983,7 +2218,20 @@ class PagedEngine:
                     self._finish_locked(stream)
                 else:
                     self._stream_push(stream)
-            return bool(self._queue) or any(s is not None for s in self._slots)
+            more = bool(self._queue) or any(s is not None for s in self._slots)
+            queue_depth = len(self._queue)
+        self._record_chunk({
+            "phase": "decode",
+            "wall_ms": round(chunk_wall * 1000.0, 3),
+            "steps": steps,
+            "buckets": [list(b) for b in buckets],
+            "occupancy": len(active),
+            "admissions": len(admitted),
+            "stalls": int(stalled.sum()),
+            "queue_depth": queue_depth,
+            "tokens": chunk_tokens,
+        })
+        return more
 
     def _step_speculative(self) -> bool:
         """One draft/verify round for every active slot.
@@ -2094,6 +2342,10 @@ class PagedEngine:
 
         if not runnable:
             return True
+        import time as _time
+
+        self._profile_before_chunk()
+        t_chunk = _time.perf_counter()
         out, counts, self.pages_k, self.pages_v, lengths_out = self._spec_chunk(
             self.params, self.pages_k, self.pages_v, jnp.asarray(segs),
             jnp.asarray(n_drafts), jnp.asarray(active_mask), tables, lengths,
@@ -2101,14 +2353,19 @@ class PagedEngine:
         out_np = np.asarray(out)
         counts_np = np.asarray(counts)
         self._lengths = np.array(lengths_out)
+        chunk_wall = _time.perf_counter() - t_chunk
+        self._profile_after_chunk()
 
         with self._lock:
             self._counters["chunks"] += 1
+            self._counters["chunk_wall_s"] += chunk_wall
+            chunk_tokens = 0
             for stream in runnable:
                 s = stream.slot
                 n = int(counts_np[s])
                 got = out_np[s, :n].tolist()
                 self._counters["tokens"] += n
+                chunk_tokens += n
                 self._counters["spec_accepted"] += max(0, n - 1)
                 stream.tokens.extend(got)
                 stream.pending = int(got[-1]) if got else stream.pending
@@ -2117,7 +2374,20 @@ class PagedEngine:
                     self._finish_locked(stream)
                 else:
                     self._stream_push(stream)
-            return bool(self._queue) or any(s is not None for s in self._slots)
+            more = bool(self._queue) or any(s is not None for s in self._slots)
+            queue_depth = len(self._queue)
+        self._record_chunk({
+            "phase": "spec_verify",
+            "wall_ms": round(chunk_wall * 1000.0, 3),
+            "steps": self.draft_k + 1,
+            "buckets": [],
+            "occupancy": len(active),
+            "admissions": len(admitted),
+            "stalls": int(stalled.sum()),
+            "queue_depth": queue_depth,
+            "tokens": chunk_tokens,
+        })
+        return more
 
     def run(self) -> None:
         """Drain everything synchronously (test / batch-job entrypoint)."""
@@ -2131,6 +2401,12 @@ class PagedEngine:
         if stream.error:
             raise stream.error
         return stream.result
+
+
+# process-wide id source for bridge labels: each engine gets a distinct
+# model_name so shared-registry timeseries never merge across engines
+_BRIDGE_SEQ = 0
+_BRIDGE_SEQ_LOCK = threading.Lock()
 
 
 class StreamingLM(TPUComponent):
@@ -2202,6 +2478,7 @@ class StreamingLM(TPUComponent):
         self.model_uri = model_uri
         self.seed = int(seed)
         self.engine: Optional[PagedEngine] = None
+        self._prom_bridge = None
         self._loop_thread: Optional[threading.Thread] = None
         self._wake = threading.Event()
         self._stop = False
@@ -2231,6 +2508,33 @@ class StreamingLM(TPUComponent):
                 params, dtype=jnp.bfloat16, mesh=mesh,
                 **self.config, **self.engine_config,
             )
+            # canonical seldon_tpu_engine_* metrics on the process
+            # registry (the gateway's /metrics endpoint serves it);
+            # collected from the decode loop.  SELDON_TPU_PROM_BRIDGE=0
+            # opts out; a missing prometheus_client degrades to none.
+            import os as _os
+
+            if _os.environ.get("SELDON_TPU_PROM_BRIDGE", "1") != "0":
+                try:
+                    from seldon_core_tpu.utils.metrics import (
+                        GenerationPrometheusBridge,
+                    )
+
+                    # distinct model_name per engine: two StreamingLMs
+                    # in one process (multi-model graph, rolling
+                    # re-apply overlap) must not merge into one
+                    # timeseries — gauges would flap between engines
+                    # and the model_name-keyed dashboards would group
+                    # everything under ""
+                    global _BRIDGE_SEQ
+                    with _BRIDGE_SEQ_LOCK:
+                        seq = _BRIDGE_SEQ
+                        _BRIDGE_SEQ += 1
+                    self._prom_bridge = GenerationPrometheusBridge(
+                        engine, model_name=f"streaminglm-{seq}",
+                    )
+                except Exception:  # noqa: BLE001 — metrics never block serving
+                    logger.exception("prometheus bridge unavailable")
             self._loop_thread = threading.Thread(
                 target=self._loop, name="streaminglm-decode", daemon=True
             )
@@ -2240,6 +2544,23 @@ class StreamingLM(TPUComponent):
             self._loop_thread.start()
 
     def _loop(self) -> None:
+        import time as _time
+
+        last_collect = 0.0
+
+        def collect(min_interval_s: float) -> None:
+            # throttled INSIDE the drain loop too: under sustained load
+            # has_work() never goes false, and metrics that only update
+            # at idle would freeze during exactly the backlog the
+            # queue-depth alert exists for
+            nonlocal last_collect
+            if self._prom_bridge is None:
+                return
+            now = _time.monotonic()
+            if now - last_collect >= min_interval_s:
+                last_collect = now
+                self._prom_bridge.collect()  # internally exception-safe
+
         while not self._stop:
             self._wake.wait(timeout=0.5)
             self._wake.clear()
@@ -2248,8 +2569,10 @@ class StreamingLM(TPUComponent):
                     if self._stop:
                         break
                     self.engine.step()
+                    collect(2.0)
             except Exception as exc:  # surface to all waiters, don't die silently
                 self.engine.fail_all(exc)
+            collect(0.5)
         # loop stopped: nothing will ever step streams again — reject
         # future submits and unblock every current waiter
         if self.engine is not None:

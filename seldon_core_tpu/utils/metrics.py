@@ -216,6 +216,13 @@ class HistogramQuantileSampler:
             return 0.0
         deltas = [c - p for c, p in zip(cum, self._last)]
         self._last = cum
+        if any(d < 0 for d in deltas):
+            # counter reset (histogram re-registered / process-level
+            # restart observed mid-window): negative deltas would make
+            # the interpolation below nonsense — treat this sample as a
+            # fresh baseline and report no traffic, like the first call
+            # (PromQL's rate() makes the same choice on resets)
+            return 0.0
         total = deltas[-1]
         if total <= 0:
             return 0.0
@@ -231,6 +238,128 @@ class HistogramQuantileSampler:
                 return prev_bound + frac * (bound - prev_bound)
             prev_bound, prev_cum = bound, c
         return prev_bound
+
+
+# ---------------------------------------------------------------------------
+# generation-engine bridge (the TPU data plane's canonical metrics)
+# ---------------------------------------------------------------------------
+
+# PagedEngine.engine_stats() key -> (kind, canonical metric name, doc).
+# COMPLETE BY CONTRACT: every engine_stats() key must appear here or in
+# ENGINE_STATS_EXCLUDED (tests/test_gen_observability.py), so a new
+# engine counter cannot silently skip Prometheus export.
+ENGINE_STATS_METRICS: Dict[str, Tuple[str, str, str]] = {
+    "chunks": ("counter", "seldon_tpu_engine_chunks_total",
+               "decode/verify chunk programs executed"),
+    "bucketed_chunks": ("counter", "seldon_tpu_engine_bucketed_chunks_total",
+                        "chunks that ran the length-bucketed ctx gather"),
+    "tokens": ("counter", "seldon_tpu_engine_tokens_total",
+               "tokens emitted by the generation engine"),
+    "evictions": ("counter", "seldon_tpu_engine_evictions_total",
+                  "streams evicted to the queue under pool pressure"),
+    "stalls": ("counter", "seldon_tpu_engine_stalls_total",
+               "stream-chunk stalls on pool pressure"),
+    "prefills": ("counter", "seldon_tpu_engine_prefills_total",
+                 "streams admitted and prefilled"),
+    "completed": ("counter", "seldon_tpu_engine_streams_completed_total",
+                  "streams finished (result delivered)"),
+    "spec_drafted": ("counter", "seldon_tpu_engine_spec_drafted_total",
+                     "speculative tokens drafted"),
+    "spec_accepted": ("counter", "seldon_tpu_engine_spec_accepted_total",
+                      "speculative tokens accepted by verify"),
+    "active_slots": ("gauge", "seldon_tpu_engine_slot_occupancy",
+                     "slots holding a live stream"),
+    "queued_streams": ("gauge", "seldon_tpu_engine_queue_depth",
+                       "streams waiting for a slot"),
+    "pool_pages_used": ("gauge", "seldon_tpu_engine_pool_pages_used",
+                        "KV pool pages in use"),
+    "pool_pages_total": ("gauge", "seldon_tpu_engine_pool_pages_total",
+                         "KV pool pages available"),
+}
+
+# keys intentionally NOT exported as their own series: the wall-clock
+# accumulators feed the chunk-duration HISTOGRAM (via the flight
+# recorder's per-chunk records) — exporting the sums next to it would
+# double-count the same signal under a non-canonical name
+ENGINE_STATS_EXCLUDED = {"chunk_wall_s", "prefill_wall_s"}
+
+CHUNK_DURATION_METRIC = "seldon_tpu_engine_chunk_duration_seconds"
+
+
+class GenerationPrometheusBridge:
+    """PagedEngine stats + flight-recorder records -> canonical
+    Prometheus metrics, through the same ``_MetricCache`` machinery the
+    graph-layer observer uses (shared registry safe: two engines in one
+    process share metric objects and differ only in label values).
+
+    Call :meth:`collect` periodically (StreamingLM's decode loop does);
+    cumulative engine counters are exported as true Prometheus counters
+    by diffing against the previous collect (an engine replacement /
+    counter reset re-baselines instead of inc()-ing garbage), gauges are
+    set directly, and the recorder's per-chunk wall times feed the
+    ``seldon_tpu_engine_chunk_duration_seconds`` histogram incrementally
+    by record seq — each chunk is observed exactly once.
+    """
+
+    def __init__(
+        self,
+        engine,
+        deployment_name: str = "",
+        predictor_name: str = "",
+        model_name: str = "",
+        registry=None,
+    ):
+        self.engine = engine
+        self._labels = {
+            "deployment_name": deployment_name,
+            "predictor_name": predictor_name,
+            "model_name": model_name,
+        }
+        self._names = tuple(sorted(self._labels))
+        self._cache = _cache_for(registry)
+        self._last: Dict[str, float] = {}
+        self._last_seq = 0
+
+    def _metric(self, kind: str, name: str, doc: str = ""):
+        return self._cache.get(kind, name, self._names, doc).labels(**self._labels)
+
+    def collect(self) -> None:
+        """Never raises — the bridge must not take the decode loop down."""
+        try:
+            self._collect()
+        except Exception:  # noqa: BLE001
+            logger.exception("generation prometheus bridge collect failed")
+
+    def _collect(self) -> None:
+        stats = self.engine.engine_stats()
+        for key, value in stats.items():
+            spec = ENGINE_STATS_METRICS.get(key)
+            if spec is None:
+                continue  # contract-tested: unmapped => in the exclusion set
+            kind, name, doc = spec
+            metric = self._metric(kind, name, doc)
+            if kind == "gauge":
+                metric.set(float(value))
+            else:
+                prev = self._last.get(key, 0.0)
+                cur = float(value)
+                delta = cur - prev if cur >= prev else cur  # reset -> rebase
+                self._last[key] = cur
+                if delta > 0:
+                    metric.inc(delta)
+        recorder = getattr(self.engine, "recorder", None)
+        if recorder is not None:
+            hist = self._metric(
+                "histogram", CHUNK_DURATION_METRIC,
+                "wall time of one decode/verify chunk program",
+            )
+            for rec in recorder.since(self._last_seq):
+                self._last_seq = max(self._last_seq, rec["seq"])
+                hist.observe(float(rec.get("wall_ms", 0.0)) / 1000.0)
+            self._metric(
+                "gauge", "seldon_tpu_engine_chunk_p99_ms",
+                "chunk-wall p99 over the flight recorder window",
+            ).set(float(recorder.stats()["chunk_p99_ms"]))
 
 
 def api_latency_sampler(

@@ -58,8 +58,14 @@ class Span:
     parent_span_id: Optional[str] = None
 
     def to_dict(self) -> Dict[str, Any]:
+        # spanId/parentSpanId ride along so the JSONL file exporter
+        # keeps the same parent linkage the OTLP exporter ships — a
+        # trace reassembled from the file must not lose its tree shape
+        # (parentSpanId is None for roots, mirroring OTLP's omission)
         return {
             "traceId": self.trace_id,
+            "spanId": self.span_id,
+            "parentSpanId": self.parent_span_id,
             "name": self.name,
             "startTimeUnixNano": int(self.start_s * 1e9),
             "durationNano": int(self.duration_s * 1e9),
@@ -82,6 +88,7 @@ class OtlpHttpExporter:
         service_name: str = "seldon-tpu",
         batch_size: int = 64,
         timeout_s: float = 5.0,
+        max_queue_batches: int = 64,
     ):
         import queue
 
@@ -91,12 +98,20 @@ class OtlpHttpExporter:
         self.timeout_s = float(timeout_s)
         self.exported = 0
         self.failures = 0
+        self.dropped = 0  # spans shed because the export queue was full
         self._buffer: List[Span] = []
         self._lock = threading.Lock()
         # exports happen on a worker thread: record() is called from the
         # serving event loop, and a slow/blackholed collector must not
-        # stall requests (same pattern as reqlogger's HTTP worker)
-        self._queue: "queue.Queue[Optional[List[Span]]]" = queue.Queue()
+        # stall requests (same pattern as reqlogger's HTTP worker).
+        # BOUNDED: a blackholed collector makes every export pay its
+        # timeout while spans keep arriving, so an unbounded queue grows
+        # without limit; at the cap the OLDEST batch is shed (the newest
+        # spans are the ones an operator debugging the outage needs) and
+        # the loss is counted in `dropped`, never silent.
+        self._queue: "queue.Queue[Optional[List[Span]]]" = queue.Queue(
+            maxsize=max(1, int(max_queue_batches))
+        )
         self._worker = threading.Thread(target=self._drain, daemon=True, name="otlp-export")
         self._worker.start()
 
@@ -176,25 +191,56 @@ class OtlpHttpExporter:
             self.failures += 1
         return ok
 
+    def _offer(self, batch: List[Span]) -> None:
+        """Non-blocking enqueue with drop-oldest overflow: the caller is
+        the serving path and must never wait on a wedged exporter."""
+        import queue
+
+        while True:
+            try:
+                self._queue.put_nowait(batch)
+                return
+            except queue.Full:
+                try:
+                    old = self._queue.get_nowait()
+                except queue.Empty:
+                    continue  # raced the worker; retry the put
+                self._queue.task_done()
+                if old is None:
+                    # shutdown sentinel: keep it (the worker must still
+                    # exit) and shed the NEW batch instead
+                    try:
+                        self._queue.put_nowait(None)
+                    except queue.Full:
+                        pass  # worker is wedged; close() joins with timeout
+                    self.dropped += len(batch)
+                    return
+                self.dropped += len(old)
+
     def __call__(self, span: Span) -> None:
         with self._lock:
             self._buffer.append(span)
             if len(self._buffer) < self.batch_size:
                 return
             batch, self._buffer = self._buffer, []
-        self._queue.put(batch)  # non-blocking hand-off to the worker
+        self._offer(batch)  # non-blocking hand-off to the worker
 
     def flush(self) -> None:
         """Hand any partial batch to the worker and wait for it."""
         with self._lock:
             batch, self._buffer = self._buffer, []
         if batch:
-            self._queue.put(batch)
+            self._offer(batch)
         self._queue.join()
 
     def close(self) -> None:
+        import queue
+
         self.flush()
-        self._queue.put(None)
+        try:  # queue is empty post-flush; bounded put only for safety
+            self._queue.put(None, timeout=self.timeout_s)
+        except queue.Full:
+            pass
         self._worker.join(timeout=self.timeout_s)
 
 
@@ -265,6 +311,7 @@ def setup_tracing(
     service_name: str = "seldon-tpu",
     export_path: Optional[str] = None,
     otlp_endpoint: Optional[str] = None,
+    capacity: int = 4096,
 ) -> Tracer:
     """Install the global tracer (reference: setup_tracing env-driven
     init, microservice.py:124-155).  ``OTEL_EXPORTER_OTLP_ENDPOINT``
@@ -280,12 +327,48 @@ def setup_tracing(
         if not endpoint.rstrip("/").endswith("/v1/traces"):
             endpoint = endpoint.rstrip("/") + "/v1/traces"
         exporter = OtlpHttpExporter(endpoint=endpoint, service_name=service_name)
-    _tracer = Tracer(service_name=service_name, export_path=export_path, exporter=exporter)
+    _tracer = Tracer(
+        service_name=service_name, capacity=capacity,
+        export_path=export_path, exporter=exporter,
+    )
     return _tracer
 
 
 def get_tracer() -> Optional[Tracer]:
     return _tracer
+
+
+def current_span() -> Optional[Span]:
+    """The active span of the calling thread/task, if any.  Components
+    whose work continues on ANOTHER thread (e.g. the paged engine's
+    decode loop) capture this at submit time and link their spans by
+    explicit (trace_id, parent_span_id) — the contextvar itself does
+    not cross threads."""
+    return _current_span.get()
+
+
+def record_span(
+    name: str,
+    trace_id: str,
+    start_s: float,
+    duration_s: float,
+    parent_span_id: Optional[str] = None,
+    **tags: Any,
+) -> Optional[Span]:
+    """Record a completed span with EXPLICIT timing and linkage — the
+    lane for work measured outside a ``with tracer.span(...)`` scope
+    (the engine's decode loop times phases itself and emits spans after
+    the fact).  One global read when tracing is off."""
+    tracer = get_tracer()
+    if tracer is None:
+        return None
+    s = Span(
+        trace_id=trace_id, name=name, start_s=start_s,
+        duration_s=duration_s, tags=dict(tags),
+        parent_span_id=parent_span_id,
+    )
+    tracer.record(s)
+    return s
 
 
 @contextmanager

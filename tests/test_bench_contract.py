@@ -133,6 +133,9 @@ def _r3_like_full_result():
                 "spec_draft_acceptance": 0.87,
                 "spec_oracle_chunks": 13,
                 "plain_chunks": 8,
+                "obs_overhead_pct": 0.84,
+                "obs_on_tokens_per_s": 4363.0,
+                "obs_off_tokens_per_s": 4400.0,
             },
             "mean_batch_rows": 26.69,
             "device_batches": 1106,
@@ -209,6 +212,19 @@ def test_compact_line_carries_capacity_story(bench):
         "parse", "decode", "pad", "queue_wait", "forward", "serialise"
     )
     assert e["attached_p99_bound_ms"] == 14.048
+
+
+def test_compact_line_carries_observability_overhead(bench):
+    """r7 certification key: the compact line prints the paged
+    throughput cost of full observability (spans + flight recorder) as
+    a float percentage — the <2% always-on-recorder gate; the raw
+    on/off rates stay in bench_full.json."""
+    compact = bench._compact_result(_r3_like_full_result())
+    e = compact["extra"]
+    assert isinstance(e["obs_overhead_pct"], float)
+    assert e["obs_overhead_pct"] == 0.84
+    # raw rates are full-blob-only: the compact line stays lean
+    assert "obs_on_tokens_per_s" not in e
 
 
 def test_capacity_accounting_donated_vs_copied():

@@ -137,6 +137,31 @@ class TestTracing:
             tracer.close()
             tracing._tracer = None
 
+    def test_jsonl_export_keeps_parent_linkage(self, tmp_path):
+        """Span.to_dict carries spanId/parentSpanId, so a trace
+        reassembled from the JSONL file keeps the same tree the OTLP
+        exporter ships — the file lane must not lose linkage."""
+        path = str(tmp_path / "spans.jsonl")
+        tracer = tracing.setup_tracing("test-svc", export_path=path)
+        try:
+            with tracer.span("parent", trace_id="t1") as parent:
+                with tracer.span("child"):
+                    pass
+            by_name = {
+                line["name"]: line
+                for line in (json.loads(l) for l in open(path))
+            }
+            assert by_name["parent"]["spanId"] == parent.span_id
+            assert by_name["parent"]["parentSpanId"] is None  # root
+            # round-trip linkage: the child's parentSpanId resolves to
+            # the parent's spanId within the same trace
+            assert by_name["child"]["parentSpanId"] == by_name["parent"]["spanId"]
+            assert by_name["child"]["traceId"] == by_name["parent"]["traceId"]
+            assert by_name["child"]["spanId"] != by_name["parent"]["spanId"]
+        finally:
+            tracer.close()
+            tracing._tracer = None
+
 
 class TestRequestLogger:
     def test_pair_logged(self, tmp_path):
@@ -429,6 +454,58 @@ class TestOtlpExporter:
         assert exporter.export([Span(trace_id="t", name="n", start_s=0.0)]) is False
         assert exporter.failures == 1
         exporter.close()
+
+    def test_full_queue_drops_oldest_and_counts(self):
+        """A blackholed collector must not grow memory without limit:
+        the export queue is bounded, overflow sheds the OLDEST batch,
+        and the loss lands in the `dropped` counter."""
+        import threading
+
+        from seldon_core_tpu.utils.tracing import OtlpHttpExporter, Span
+
+        release = threading.Event()
+        exporter = OtlpHttpExporter(
+            endpoint="http://127.0.0.1:1/v1/traces",
+            batch_size=1, max_queue_batches=2, timeout_s=0.2,
+        )
+        # wedge the worker inside its current batch: every batch after
+        # the in-flight one piles into the bounded queue
+        orig_export = exporter.export
+        first = threading.Event()
+
+        def blocked_export(spans):
+            first.set()
+            release.wait(timeout=10)
+            return orig_export(spans)
+
+        exporter.export = blocked_export
+        try:
+            exporter(Span(trace_id="t", name="s0", start_s=0.0))
+            assert first.wait(timeout=5)  # worker is now wedged
+            for i in range(1, 8):  # 7 more batches into a queue of 2
+                exporter(Span(trace_id="t", name=f"s{i}", start_s=0.0))
+            assert exporter._queue.qsize() <= 2  # bounded under load
+            assert exporter.dropped == 5  # 7 offered - 2 retained
+        finally:
+            release.set()
+            exporter.close()
+
+    def test_unwedged_exporter_drops_nothing(self):
+        from seldon_core_tpu.utils.tracing import OtlpHttpExporter, Span
+
+        srv, received = self._collector()
+        try:
+            exporter = OtlpHttpExporter(
+                endpoint=f"http://127.0.0.1:{srv.server_port}/v1/traces",
+                batch_size=1,  # default queue bound: 8 batches fit easily
+            )
+            for i in range(8):
+                exporter(Span(trace_id="t", name=f"s{i}", start_s=0.0))
+            exporter.flush()
+            assert exporter.dropped == 0
+            assert exporter.exported == 8
+        finally:
+            srv.shutdown()
 
     def test_setup_tracing_env_wiring(self, monkeypatch):
         from seldon_core_tpu.utils import tracing
@@ -765,6 +842,58 @@ class TestKafkaPairLogger:
         corrupted = mset[:-1] + bytes([mset[-1] ^ 0xFF])
         with pytest.raises(ValueError, match="CRC"):
             decode_message_set(corrupted)
+
+
+class TestHistogramQuantileSamplerEdges:
+    """Edge cases of the windowed-quantile estimate the autoscaler
+    consumes: a counter reset must not interpolate garbage from
+    negative deltas, and all-traffic-in-+Inf must return the last
+    finite bound rather than inf/nonsense."""
+
+    def _sampler(self, quantile=0.95):
+        import prometheus_client as prom
+
+        from seldon_core_tpu.utils.metrics import HistogramQuantileSampler
+
+        registry = prom.CollectorRegistry()
+        hist = prom.Histogram(
+            "edge_hist", "t", registry=registry,
+            buckets=(0.1, 1.0, 10.0),
+        )
+        return hist, HistogramQuantileSampler(hist, quantile=quantile)
+
+    def test_counter_reset_returns_zero_then_recovers(self):
+        hist, sampler = self._sampler()
+        for _ in range(20):
+            hist.observe(0.05)
+        sampler()  # prime the window
+        for _ in range(10):
+            hist.observe(0.05)
+        assert sampler() > 0.0
+        # counter reset: the previous sample claims MORE cumulative
+        # traffic than the live histogram now shows (process restart /
+        # histogram re-registration) -> negative deltas
+        sampler._last = [c + 1000.0 for c in sampler._last]
+        got = sampler()
+        assert got == 0.0  # no garbage (pre-guard this interpolated junk)
+        # and the very next window is healthy again
+        for _ in range(10):
+            hist.observe(0.05)
+        recovered = sampler()
+        assert 0.0 < recovered <= 0.1
+
+    def test_all_traffic_in_inf_bucket_returns_last_finite_bound(self):
+        hist, sampler = self._sampler()
+        sampler()  # prime
+        for _ in range(50):
+            hist.observe(99.0)  # beyond every finite bucket bound
+        got = sampler()
+        assert got == 10.0  # the last finite bound, never inf or 0
+
+    def test_empty_window_stays_zero(self):
+        _hist, sampler = self._sampler()
+        assert sampler() == 0.0
+        assert sampler() == 0.0
 
 
 class TestSharedRegistryObservers:

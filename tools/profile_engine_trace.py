@@ -1,0 +1,154 @@
+"""Drive synthetic mixed-length traffic at a local PagedEngine and
+print the per-request lifecycle decomposition the flight recorder +
+tracing layers exist for.
+
+What it does, end to end (the same three observability layers a
+production deployment gets, exercised standalone):
+
+1. installs the in-memory tracer, builds a local engine, and submits a
+   bimodal prompt mix (short/long alternating — the traffic shape the
+   length-bucketed gather serves) with more streams than slots, so the
+   queue-wait term is actually nonzero;
+2. collects the flight-recorder ring and dumps it to JSONL
+   (``--out``), alongside a JSONL of every gen.* span;
+3. prints the per-request queue-wait / prefill / decode decomposition
+   table from the lifecycle spans, plus the chunk-wall summary from
+   the recorder — the table that answers "where did this request's
+   latency go" without a profiler attached.
+
+Run:  python tools/profile_engine_trace.py [--slots 8] [--streams 24]
+      [--short 16] [--long 192] [--new 64] [--out /tmp/engine-trace]
+
+Set SELDON_TPU_PROFILE_DIR to additionally wrap the first chunks in
+``jax.profiler.trace`` for XLA-level inspection.
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+from collections import defaultdict
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--slots", type=int, default=8)
+    ap.add_argument("--streams", type=int, default=24)
+    ap.add_argument("--short", type=int, default=16)
+    ap.add_argument("--long", type=int, default=192)
+    ap.add_argument("--new", type=int, default=64)
+    ap.add_argument("--d-model", type=int, default=256)
+    ap.add_argument("--layers", type=int, default=4)
+    ap.add_argument("--heads", type=int, default=8)
+    ap.add_argument("--vocab", type=int, default=2048)
+    ap.add_argument("--page-size", type=int, default=64)
+    ap.add_argument("--max-len", type=int, default=512)
+    ap.add_argument("--out", default="/tmp/engine-trace")
+    args = ap.parse_args()
+
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    from seldon_core_tpu.models.paged import PagedEngine
+    from seldon_core_tpu.models.transformer import TransformerLM
+    from seldon_core_tpu.utils import tracing
+
+    tracer = tracing.setup_tracing("profile-engine-trace", capacity=65536)
+
+    lm = TransformerLM(
+        vocab_size=args.vocab, d_model=args.d_model,
+        num_layers=args.layers, num_heads=args.heads,
+        max_len=args.max_len, dtype=jnp.bfloat16)
+    params = lm.init(jax.random.key(0), jnp.zeros((1, 8), jnp.int32))["params"]
+
+    eng = PagedEngine(
+        params, vocab_size=args.vocab, d_model=args.d_model,
+        num_layers=args.layers, num_heads=args.heads,
+        max_len=args.max_len, page_size=args.page_size,
+        max_slots=args.slots, steps_per_call=8,
+        dtype=jnp.bfloat16,
+    )
+
+    rng = np.random.default_rng(7)
+    prompts = [
+        rng.integers(
+            0, args.vocab,
+            size=(args.short if i % 2 == 0 else args.long,),
+        ).astype(np.int32)
+        for i in range(args.streams)
+    ]
+
+    print(f"submitting {args.streams} streams ({args.short}/{args.long} "
+          f"bimodal prompts, {args.new} new tokens) at {args.slots} slots")
+    t0 = time.perf_counter()
+    streams = [
+        eng.submit(p, max_new_tokens=args.new, trace_id=f"req-{i:03d}")
+        for i, p in enumerate(prompts)
+    ]
+    eng.run()
+    wall = time.perf_counter() - t0
+    total = sum(int(s.result.shape[0]) for s in streams)
+    print(f"done: {total} tokens in {wall:.2f}s = {total / wall:.0f} tok/s\n")
+
+    # ---- artifacts --------------------------------------------------------
+    os.makedirs(args.out, exist_ok=True)
+    rec_path = os.path.join(args.out, "flightrec.jsonl")
+    if eng.recorder is not None:
+        eng.recorder.dump_jsonl(rec_path)
+    span_path = os.path.join(args.out, "spans.jsonl")
+    with tracer._lock:  # noqa: SLF001 — read-only snapshot
+        spans = list(tracer.spans)
+    with open(span_path, "w") as f:
+        for s in spans:
+            f.write(json.dumps(s.to_dict()) + "\n")
+    print(f"flight recorder -> {rec_path}\nspans          -> {span_path}\n")
+
+    # ---- per-request decomposition ---------------------------------------
+    by_req = defaultdict(dict)
+    for s in spans:
+        if s.name.startswith("gen."):
+            by_req[s.trace_id][s.name] = s
+    print(f"{'request':<10} {'queue ms':>9} {'prefill ms':>11} "
+          f"{'decode ms':>10} {'tokens':>7} {'slot':>5} {'evicted':>8}")
+    agg = defaultdict(float)
+    for rid in sorted(by_req):
+        phases = by_req[rid]
+        q = phases.get("gen.queued")
+        p = phases.get("gen.prefill")
+        d = phases.get("gen.decode")
+        fin = phases.get("gen.finish")
+        row = [
+            q.duration_s * 1000 if q else 0.0,
+            p.duration_s * 1000 if p else 0.0,
+            d.duration_s * 1000 if d else 0.0,
+        ]
+        agg["queue"] += row[0]
+        agg["prefill"] += row[1]
+        agg["decode"] += row[2]
+        print(f"{rid:<10} {row[0]:>9.1f} {row[1]:>11.1f} {row[2]:>10.1f} "
+              f"{(fin.tags.get('tokens') if fin else 0):>7} "
+              f"{(fin.tags.get('slot') if fin else '-'):>5} "
+              f"{'yes' if 'gen.evict' in phases else 'no':>8}")
+    n = max(1, len(by_req))
+    print(f"\nmeans: queue {agg['queue'] / n:.1f} ms, "
+          f"prefill {agg['prefill'] / n:.1f} ms, "
+          f"decode {agg['decode'] / n:.1f} ms over {len(by_req)} requests")
+
+    if eng.recorder is not None:
+        rs = eng.recorder.stats()
+        recs = eng.recorder.snapshot()
+        stalls = sum(r.get("stalls", 0) for r in recs)
+        print(f"chunks recorded {rs['records']}, chunk p99 "
+              f"{rs['chunk_p99_ms']:.1f} ms, stalls {stalls}, "
+              f"last queue depth {rs['last_queue_depth']}")
+    eng.close()
+    tracing._tracer = None
+
+
+if __name__ == "__main__":
+    main()
