@@ -181,6 +181,12 @@ COMPACT_PICKS = [
     # posture requires < 2 (raw on/off rates in bench_full.json
     # obs_on/off_tokens_per_s)
     ("obs_overhead_pct", ("generation", "obs_overhead_pct")),
+    # r8 propagation certification: serving (tok/s) cost of full W3C
+    # context propagation + per-hop transport telemetry vs both off
+    # (same best-of-3 discipline; raw on/off tok/s in bench_full.json
+    # trace_prop.trace_on/off_tok_s).  Positive = slower with
+    # propagation on; the always-on posture requires < 2
+    ("trace_prop_overhead_pct", ("trace_prop", "trace_prop_overhead_pct")),
     ("paged_chunk_tok_s", ("generation", "paged_chunk_tokens_per_s")),
     # NOTE: the r3 micro-comparison artifact paged_decode_tokens_per_s
     # (one device call per token, a methodology contrast — NOT a
@@ -1298,6 +1304,13 @@ async def child_main() -> None:
             status["extra"]["generation_error"] = str(e)[:200]
         _checkpoint(status)
 
+    if os.environ.get("BENCH_TRACE_PROP", "1") == "1":
+        try:
+            status["extra"]["trace_prop"] = await trace_prop_phase()
+        except Exception as e:  # noqa: BLE001
+            status["extra"]["trace_prop_error"] = str(e)[:200]
+        _checkpoint(status)
+
     status["extra"]["mean_batch_rows"] = round(server.batcher.stats.mean_batch_rows, 2)
     status["extra"]["device_batches"] = server.batcher.stats.batches
     if native_handle is not None:
@@ -1322,6 +1335,99 @@ async def child_main() -> None:
         "vs_baseline": round(P50_TARGET_MS / p50, 3),
         "extra": extra,
     })
+
+
+async def trace_prop_phase() -> dict:
+    """Cost of FULL cross-process trace propagation + per-hop transport
+    telemetry on the serving path (r8): W3C context injection on every
+    NodeClient call, contextvar copies into the dispatch pool, span
+    emission through gateway -> node -> engine (including the gen.*
+    lifecycle spans the propagated parent now links), and the
+    seldon_tpu_transport_* recording.
+
+    Protocol mirrors PR 3's obs_overhead_pct: the SAME 16-way
+    generation serving point (a StreamingLM node driven through the
+    full PredictorService graph path — the production shape, where
+    decode compute sets the denominator), in-memory tracer only (no
+    exporter — this measures our code, not a collector's network),
+    best-of-3 windows per side.  The acceptance gate is < 2%."""
+    import asyncio
+
+    import numpy as np
+
+    from seldon_core_tpu.engine import PredictorService
+    from seldon_core_tpu.engine.graph import UnitSpec
+    from seldon_core_tpu.models.paged import StreamingLM
+    from seldon_core_tpu.runtime.message import InternalMessage
+    from seldon_core_tpu.utils import tracing as _tracing
+
+    concurrency = 16
+    per_worker = 2 if QUICK else 4
+    max_new = 32
+    prompts = [
+        np.random.default_rng(100 + i).integers(0, 2048, size=(1, 16)).astype(np.int32)
+        for i in range(concurrency)
+    ]
+
+    async def measure_point(enabled: bool) -> float:
+        # save/restore the operator's own telemetry setting — deleting
+        # it would force-enable telemetry for every later phase
+        prior_telemetry = os.environ.get("SELDON_TPU_TRANSPORT_TELEMETRY")
+        if enabled:
+            os.environ.pop("SELDON_TPU_TRANSPORT_TELEMETRY", None)
+            _tracing._tracer = _tracing.Tracer(capacity=16384)
+        else:
+            os.environ["SELDON_TPU_TRANSPORT_TELEMETRY"] = "0"
+            _tracing._tracer = None
+        component = StreamingLM(
+            vocab_size=2048, d_model=256, num_layers=4, num_heads=8,
+            max_len=256, max_new_tokens=max_new, max_slots=concurrency,
+            steps_per_call=8, seed=0,
+        )
+        svc = PredictorService(
+            UnitSpec(name="lm", type="MODEL", component=component),
+            name="trace-prop-bench",
+        )
+
+        async def worker(i: int):
+            for _ in range(per_worker):
+                out = await svc.predict(
+                    InternalMessage(payload=prompts[i], kind="ndarray")
+                )
+                assert out.status["status"] == "SUCCESS", out.status
+
+        try:
+            await worker(0)  # warm: compiles prefill + chunk programs
+            best = 0.0
+            tokens = concurrency * per_worker * max_new
+            for _ in range(3):
+                t0 = time.perf_counter()
+                await asyncio.gather(*(worker(i) for i in range(concurrency)))
+                best = max(best, tokens / (time.perf_counter() - t0))
+            return best
+        finally:
+            await svc.close()
+            component.shutdown()
+            if component.engine is not None:
+                component.engine.close()
+            _tracing._tracer = None
+            if prior_telemetry is None:
+                os.environ.pop("SELDON_TPU_TRANSPORT_TELEMETRY", None)
+            else:
+                os.environ["SELDON_TPU_TRANSPORT_TELEMETRY"] = prior_telemetry
+
+    on = await measure_point(True)
+    off = await measure_point(False)
+    return {
+        "trace_on_tok_s": round(on, 1),
+        "trace_off_tok_s": round(off, 1),
+        "trace_prop_overhead_pct": round((off - on) / max(off, 1e-9) * 100.0, 2),
+        "protocol": (
+            f"16-way StreamingLM graph serving, {per_worker} req/worker x "
+            f"{max_new} new tokens, best-of-3 windows, full propagation + "
+            "transport telemetry vs both disabled"
+        ),
+    }
 
 
 def generation_phase() -> dict:

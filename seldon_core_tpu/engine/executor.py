@@ -81,7 +81,8 @@ def build_client(unit: UnitSpec, annotations: Optional[Dict[str, str]] = None) -
     remote transports honour the reference's timeout/retry annotations
     (reference: InternalPredictionService.java:80-98):
     seldon.io/rest-connection-timeout (ms), seldon.io/rest-read-timeout
-    (ms), seldon.io/rest-retries, seldon.io/grpc-read-timeout (ms).
+    (ms), seldon.io/rest-retries, seldon.io/grpc-read-timeout (ms),
+    seldon.io/grpc-retries (attempt budget for transient statuses).
     """
     ann = annotations or {}
 
@@ -119,7 +120,15 @@ def build_client(unit: UnitSpec, annotations: Optional[Dict[str, str]] = None) -
                 read_timeout_s=_ms("seldon.io/rest-read-timeout", 5.0),
                 retries=retries,
             )
-        return GrpcClient(unit, deadline_s=_ms("seldon.io/grpc-read-timeout", 5.0))
+        try:
+            grpc_retries = int(ann.get("seldon.io/grpc-retries", 3))
+        except ValueError:
+            grpc_retries = 3
+        return GrpcClient(
+            unit,
+            deadline_s=_ms("seldon.io/grpc-read-timeout", 5.0),
+            retries=grpc_retries,
+        )
     return None
 
 

@@ -279,10 +279,17 @@ def build_gateway_app(gateway: Gateway, auth=None) -> web.Application:
         app = web.Application(client_max_size=1024 * 1024 * 512)
 
     async def predictions(request: web.Request) -> web.Response:
+        from seldon_core_tpu.runtime.rest import _remote_ctx
+        from seldon_core_tpu.utils.tracing import activate_context
+
         try:
             body = await _request_body(request)
             msg = InternalMessage.from_json(body)
-            out = await gateway.predict(msg, predictor=request.query.get("predictor"))
+            # an external caller's traceparent makes the gateway's
+            # predictor.predict span a child of ITS trace — the whole
+            # graph then stitches under the caller's root
+            with activate_context(_remote_ctx(request)):
+                out = await gateway.predict(msg, predictor=request.query.get("predictor"))
             return web.json_response(out.to_json(), status=_http_status(out))
         except Exception as e:  # noqa: BLE001
             return _error_response(e)
@@ -500,8 +507,12 @@ def add_seldon_service(server: grpc.aio.Server, gateway: Gateway, auth=None) -> 
 
     async def predict(request: pb.SeldonMessage, context) -> pb.SeldonMessage:
         await check_auth(context)
+        from seldon_core_tpu.runtime.grpc_server import _grpc_remote_ctx
+        from seldon_core_tpu.utils.tracing import activate_context
+
         msg = InternalMessage.from_proto(request)
-        out = await gateway.predict(msg)
+        with activate_context(_grpc_remote_ctx(context)):
+            out = await gateway.predict(msg)
         return out.to_proto()
 
     async def send_feedback(request: pb.Feedback, context) -> pb.SeldonMessage:

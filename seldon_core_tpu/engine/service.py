@@ -10,9 +10,7 @@ graceful drain).
 from __future__ import annotations
 
 import asyncio
-import itertools
 import logging
-import secrets
 import threading
 import time
 from typing import Any, Callable, Dict, List, Optional
@@ -22,20 +20,12 @@ from seldon_core_tpu.engine.graph import UnitSpec
 from seldon_core_tpu.runtime.component import MicroserviceError
 from seldon_core_tpu.runtime.message import InternalFeedback, InternalMessage
 
+# re-exported for callers that import it from here; the implementation
+# lives in runtime/puid.py (fork/respawn-safe) so standalone
+# microservices mint from the same collision-safe generator
+from seldon_core_tpu.runtime.puid import new_puid  # noqa: F401
+
 logger = logging.getLogger(__name__)
-
-
-_PUID_PREFIX = secrets.token_hex(6)
-_puid_counter = itertools.count()
-
-
-def new_puid() -> str:
-    """Unique request id (reference: PredictionService.java:72-78).
-
-    Random per-process prefix + atomic counter: collision-safe across
-    processes without an entropy syscall per request (urandom showed
-    up in the serving-path profile)."""
-    return f"{_PUID_PREFIX}{next(_puid_counter):012x}"
 
 
 def failure_message(error: Exception, puid: str = "") -> InternalMessage:

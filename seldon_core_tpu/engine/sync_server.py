@@ -52,15 +52,28 @@ class SyncSeldonService:
 
     def predict(self, request: pb.SeldonMessage, context) -> pb.SeldonMessage:
         self._check_auth(context)
+        from seldon_core_tpu.runtime.grpc_server import _grpc_remote_ctx
+        from seldon_core_tpu.utils.tracing import activate_context
+
         msg = InternalMessage.from_proto(request)
         svc = self.gateway.pick()
         for shadow in self.gateway.shadows:
             # isolated copy: primary and shadow both mutate meta
             asyncio.run_coroutine_threadsafe(shadow.predict(msg.copy()), self.loop)
+        # extraction happens on the handler thread; the bridged lane
+        # re-activates INSIDE the coroutine because
+        # run_coroutine_threadsafe does not carry the submitting
+        # thread's contextvars into the loop task
+        ctx = _grpc_remote_ctx(context)
         if svc.single_local_model() is not None:
-            out = svc.predict_sync(msg)
+            with activate_context(ctx):
+                out = svc.predict_sync(msg)
         else:
-            out = self._bridge(svc.predict(msg))
+            async def _predict_with_ctx():
+                with activate_context(ctx):
+                    return await svc.predict(msg)
+
+            out = self._bridge(_predict_with_ctx())
         return self.gateway.finalize_response(out, msg, svc).to_proto()
 
     def send_feedback(self, request: pb.Feedback, context) -> pb.SeldonMessage:

@@ -16,13 +16,28 @@ Three client kinds behind one async interface:
 * ``RestClient`` — remote node over REST/JSON with connect/read timeouts
   and bounded retries (reference: InternalPredictionService.java:80-98,
   439-467).
+
+Every client is a tracing hop: the current span's W3C context is
+injected on the way out (REST headers, gRPC metadata, and
+``InternalMessage.meta.trace_context`` for the local/native lanes), so
+the receiving runtime parents its spans under the caller's — the role
+the reference's opentracing RestTemplate/channel interceptors play
+(reference: InternalPredictionService.java:145-149).  Each call also
+records per-hop transport telemetry (payload bytes, codec-vs-network
+time split, retries, in-flight) into the canonical
+``seldon_tpu_transport_*`` metrics (utils/metrics.py) and tags the
+enclosing node span with the same numbers for per-request hop tables
+(tools/profile_trace_stitch.py).
 """
 
 from __future__ import annotations
 
 import asyncio
+import json
 import logging
-from typing import Any, Dict, List, Optional
+import time
+from contextlib import contextmanager
+from typing import Any, Callable, Dict, List, Optional
 
 from seldon_core_tpu.engine.graph import (
     AGGREGATE,
@@ -36,8 +51,70 @@ from seldon_core_tpu.engine.graph import (
 from seldon_core_tpu.runtime import dispatch
 from seldon_core_tpu.runtime.component import MicroserviceError
 from seldon_core_tpu.runtime.message import InternalFeedback, InternalMessage
+from seldon_core_tpu.utils import metrics as _metrics
+from seldon_core_tpu.utils import tracing as _tracing
 
 logger = logging.getLogger(__name__)
+
+
+class _Hop:
+    """Meters one NodeClient call: in-flight gauge around the await,
+    codec-vs-network wall split, byte counts, retry count.  ``finish``
+    folds everything into the ``seldon_tpu_transport_*`` metrics and
+    tags the enclosing (node) span so stitched traces carry the hop
+    decomposition.  Constructing one is cheap when telemetry is off."""
+
+    __slots__ = (
+        "unit", "method", "transport", "t0",
+        "serialize_s", "request_bytes", "response_bytes", "retries", "_gauge",
+    )
+
+    def __init__(self, unit: str, method: str, transport: str):
+        self.unit, self.method, self.transport = unit, method, transport
+        self.serialize_s = 0.0
+        self.request_bytes = 0
+        self.response_bytes = 0
+        self.retries = 0
+        self._gauge = _metrics.transport_inflight(unit, method, transport)
+        if self._gauge is not None:
+            self._gauge.inc()
+        self.t0 = time.perf_counter()
+
+    @contextmanager
+    def codec(self):
+        """Time one encode/decode section (the serialization share)."""
+        t = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.serialize_s += time.perf_counter() - t
+
+    def finish(self, error: bool = False) -> None:
+        total = time.perf_counter() - self.t0
+        if self._gauge is not None:
+            self._gauge.dec()
+        network_s = max(0.0, total - self.serialize_s)
+        _metrics.record_transport_hop(
+            self.unit, self.method, self.transport,
+            request_bytes=self.request_bytes,
+            response_bytes=self.response_bytes,
+            serialize_seconds=self.serialize_s,
+            network_seconds=network_s,
+            retries=self.retries,
+            error=error,
+        )
+        span = _tracing.current_span()
+        if span is not None and not span.remote:
+            span.tags["transport"] = self.transport
+            if self.transport != "local":
+                span.tags["request_bytes"] = self.request_bytes
+                span.tags["response_bytes"] = self.response_bytes
+                span.tags["serialize_ms"] = round(self.serialize_s * 1000.0, 3)
+                span.tags["network_ms"] = round(network_s * 1000.0, 3)
+            if self.retries:
+                span.tags["retries"] = self.retries
+            if error:
+                span.tags["error"] = True
 
 
 class NodeClient:
@@ -66,7 +143,14 @@ class NodeClient:
 
 
 class LocalClient(NodeClient):
-    """In-process node: direct dispatch, device arrays pass by handle."""
+    """In-process node: direct dispatch, device arrays pass by handle.
+
+    The tracing hop still exists: the caller's span context propagates
+    BOTH through contextvars (run_dispatch copies the caller's context
+    onto the pool thread) and explicitly via ``meta.trace_context`` —
+    the in-memory lane of the same contract the remote clients put on
+    the wire, so dispatch parents identically whichever path survived
+    (a queue hand-off loses the contextvar; the meta doesn't)."""
 
     def __init__(self, unit: UnitSpec, component: Any):
         self.unit = unit
@@ -77,24 +161,65 @@ class LocalClient(NodeClient):
 
         return await run_dispatch(fn, *args)
 
+    @staticmethod
+    def _inject_meta(msg: Any) -> None:
+        first = msg[0] if isinstance(msg, list) and msg else msg
+        meta = getattr(first, "meta", None) or getattr(
+            getattr(first, "request", None), "meta", None
+        )
+        if meta is not None:
+            _tracing.inject(meta.trace_context)
+
+    async def _invoke(self, method: str, factory: Callable[[], Any]):
+        hop = _Hop(self.unit.name, method, "local")
+        ok = False
+        try:
+            out = await factory()
+            ok = True
+            return out
+        finally:
+            hop.finish(error=not ok)
+
     async def transform_input(self, msg: InternalMessage) -> InternalMessage:
+        self._inject_meta(msg)
         # A MODEL node's input transform IS its predict
         # (reference: InternalPredictionService.java transformInput routing).
         if self.unit.type == MODEL:
-            return await dispatch.predict_async(self.component, msg)
-        return await self._run(dispatch.transform_input, self.component, msg)
+            return await self._invoke(
+                "predict", lambda: dispatch.predict_async(self.component, msg)
+            )
+        return await self._invoke(
+            "transform_input",
+            lambda: self._run(dispatch.transform_input, self.component, msg),
+        )
 
     async def transform_output(self, msg: InternalMessage) -> InternalMessage:
-        return await self._run(dispatch.transform_output, self.component, msg)
+        self._inject_meta(msg)
+        return await self._invoke(
+            "transform_output",
+            lambda: self._run(dispatch.transform_output, self.component, msg),
+        )
 
     async def route(self, msg: InternalMessage) -> InternalMessage:
-        return await self._run(dispatch.route, self.component, msg)
+        self._inject_meta(msg)
+        return await self._invoke(
+            "route", lambda: self._run(dispatch.route, self.component, msg)
+        )
 
     async def aggregate(self, msgs: List[InternalMessage]) -> InternalMessage:
-        return await self._run(dispatch.aggregate, self.component, msgs)
+        self._inject_meta(msgs)
+        return await self._invoke(
+            "aggregate", lambda: self._run(dispatch.aggregate, self.component, msgs)
+        )
 
     async def send_feedback(self, feedback: InternalFeedback) -> InternalMessage:
-        return await self._run(dispatch.send_feedback, self.component, feedback, self.unit.name)
+        self._inject_meta(feedback)
+        return await self._invoke(
+            "send_feedback",
+            lambda: self._run(
+                dispatch.send_feedback, self.component, feedback, self.unit.name
+            ),
+        )
 
     async def ready(self) -> bool:
         return True
@@ -111,67 +236,183 @@ _METHOD_TO_SERVICE = {
 }
 
 
+def _grpc_status_name(e: Exception) -> Optional[str]:
+    """The status-code name of a grpc error, or None for non-grpc."""
+    code = getattr(e, "code", None)
+    try:
+        got = code() if callable(code) else code
+        return got.name if got is not None else None
+    except Exception:  # noqa: BLE001 — anything weird is "not grpc"
+        return None
+
+
+def _grpc_retryable(e: Exception) -> bool:
+    """Transient statuses worth another attempt within the call budget
+    (the reference's RestTemplate retries the analogous REST faults)."""
+    return _grpc_status_name(e) in (
+        "UNAVAILABLE", "DEADLINE_EXCEEDED", "RESOURCE_EXHAUSTED",
+    )
+
+
 class GrpcClient(NodeClient):
-    """Remote node over gRPC (channel cached per endpoint)."""
+    """Remote node over gRPC (channel cached per endpoint), with
+    bounded retries on transient statuses.  An exhausted call raises a
+    ``MicroserviceError`` carrying the FULL per-attempt history
+    (status code + elapsed per attempt) on ``.attempts`` and in the
+    message — post-mortems see every retry, not just the last error."""
 
     _channels: Dict[str, Any] = {}
+    # strong refs to the deferred channel-close tasks: the event loop
+    # holds tasks only weakly, so a fire-and-forget ensure_future could
+    # be garbage-collected mid-sleep and leak the channel's sockets
+    _closers: set = set()
 
-    def __init__(self, unit: UnitSpec, deadline_s: float = 5.0):
+    def __init__(self, unit: UnitSpec, deadline_s: float = 5.0, retries: int = 3):
         if unit.endpoint is None:
             raise ValueError(f"GrpcClient for {unit.name!r} needs an endpoint")
         self.unit = unit
         self.addr = f"{unit.endpoint.host}:{unit.endpoint.port}"
         self.deadline_s = deadline_s
+        self.retries = max(1, int(retries))
 
     def _channel(self):
         import grpc
 
         chan = GrpcClient._channels.get(self.addr)
         if chan is None:
-            chan = grpc.aio.insecure_channel(self.addr)
+            # local subchannel pool: without it grpc-core SHARES
+            # subchannels globally per target, so the fresh channel
+            # _reset_channel creates would inherit the old, backed-off
+            # subchannel and keep failing fast (we hold one channel per
+            # address anyway — cross-channel sharing buys nothing here)
+            chan = grpc.aio.insecure_channel(
+                self.addr, options=[("grpc.use_local_subchannel_pool", 1)]
+            )
             GrpcClient._channels[self.addr] = chan
         return chan
 
-    async def _call(self, method: str, request_proto, service_override: Optional[str] = None):
+    async def _reset_channel(self) -> None:
+        """Drop the cached channel after UNAVAILABLE: a channel whose
+        subchannel is in reconnect backoff fails new RPCs FAST without
+        attempting a connection (wait_for_ready is off), so a retry on
+        the same channel — or the first call after the worker respawns
+        — would keep failing for the whole backoff window.  A fresh
+        channel attempts to connect immediately.
+
+        The old channel is closed LAZILY, one deadline later: closing
+        immediately would cancel every sibling RPC still in flight on
+        it (grpc.aio close semantics), amplifying one transient fault
+        into N CANCELLED requests; by deadline+1s every such RPC has
+        completed or timed out on its own."""
+        chan = GrpcClient._channels.pop(self.addr, None)
+        if chan is None:
+            return
+
+        async def close_later(delay: float) -> None:
+            await asyncio.sleep(delay)
+            try:
+                await chan.close()
+            except Exception as e:  # noqa: BLE001
+                logger.debug("closing backed-off channel failed: %s", e)
+
+        task = asyncio.ensure_future(close_later(self.deadline_s + 1.0))
+        GrpcClient._closers.add(task)
+        task.add_done_callback(GrpcClient._closers.discard)
+
+    async def _call(
+        self,
+        method: str,
+        build: Callable[[], Any],
+        service_override: Optional[str] = None,
+        idempotent: bool = True,
+    ) -> InternalMessage:
         from seldon_core_tpu.proto import services
 
         service, rpc, _ = _METHOD_TO_SERVICE[method]
         if service_override:
             service = service_override
-        callable_ = services.unary_callable(self._channel(), service, rpc)
+        hop = _Hop(self.unit.name, method, "grpc")
+        ok = False
         try:
-            return await callable_(request_proto, timeout=self.deadline_s)
-        except Exception as e:  # grpc.aio.AioRpcError and friends
-            raise MicroserviceError(
-                f"gRPC call {method} to {self.addr} failed: {e}",
+            with hop.codec():
+                request_proto = build()
+                hop.request_bytes = request_proto.ByteSize()
+            metadata = _tracing.inject_metadata()
+            attempts: List[Dict[str, Any]] = []
+            last: Optional[Exception] = None
+            budget = self.retries if idempotent else 1
+            for attempt in range(budget):
+                if attempt:
+                    hop.retries += 1
+                callable_ = services.unary_callable(self._channel(), service, rpc)
+                t_attempt = time.perf_counter()
+                try:
+                    resp = await callable_(
+                        request_proto, timeout=self.deadline_s, metadata=metadata
+                    )
+                    hop.response_bytes = resp.ByteSize()
+                    with hop.codec():
+                        out = InternalMessage.from_proto(resp)
+                    ok = True
+                    return out
+                except Exception as e:  # grpc.aio.AioRpcError and friends
+                    last = e
+                    attempts.append({
+                        "attempt": attempt + 1,
+                        "status": _grpc_status_name(e) or type(e).__name__,
+                        "elapsed_ms": round(
+                            (time.perf_counter() - t_attempt) * 1000.0, 3
+                        ),
+                    })
+                    if _grpc_status_name(e) == "UNAVAILABLE":
+                        # fresh channel for the next attempt (or the
+                        # next CALL): the old one is in reconnect
+                        # backoff and would fail fast for its duration
+                        await self._reset_channel()
+                    if not _grpc_retryable(e) or attempt + 1 >= budget:
+                        break
+                    logger.warning(
+                        "gRPC %s to %s attempt %d/%d failed: %s",
+                        method, self.addr, attempt + 1, budget, e,
+                    )
+                    await asyncio.sleep(0.05 * (attempt + 1))
+            err = MicroserviceError(
+                f"gRPC call {method} to {self.addr} failed: {last} "
+                f"(attempts: {json.dumps(attempts)})",
                 status_code=502,
                 reason="UPSTREAM_GRPC_ERROR",
-            ) from e
+            )
+            err.attempts = attempts  # machine-readable per-attempt history
+            raise err from last
+        finally:
+            hop.finish(error=not ok)
 
     async def transform_input(self, msg: InternalMessage) -> InternalMessage:
         method = "predict" if self.unit.type == MODEL else "transform_input"
-        resp = await self._call(method, msg.to_proto())
-        return InternalMessage.from_proto(resp)
+        return await self._call(method, msg.to_proto)
 
     async def transform_output(self, msg: InternalMessage) -> InternalMessage:
-        resp = await self._call("transform_output", msg.to_proto())
-        return InternalMessage.from_proto(resp)
+        return await self._call("transform_output", msg.to_proto)
 
     async def route(self, msg: InternalMessage) -> InternalMessage:
-        resp = await self._call("route", msg.to_proto())
-        return InternalMessage.from_proto(resp)
+        return await self._call("route", msg.to_proto)
 
     async def aggregate(self, msgs: List[InternalMessage]) -> InternalMessage:
-        from seldon_core_tpu.proto import pb
+        def build():
+            from seldon_core_tpu.proto import pb
 
-        msg_list = pb.SeldonMessageList(seldonMessages=[m.to_proto() for m in msgs])
-        resp = await self._call("aggregate", msg_list)
-        return InternalMessage.from_proto(resp)
+            return pb.SeldonMessageList(seldonMessages=[m.to_proto() for m in msgs])
+
+        return await self._call("aggregate", build)
 
     async def send_feedback(self, feedback: InternalFeedback) -> InternalMessage:
+        # not idempotent: a deadline after the reward was applied must
+        # not replay it (same rule as BalancedClient's failover)
         service = "Router" if self.unit.type == "ROUTER" else "Model"
-        resp = await self._call("send_feedback", feedback.to_proto(), service_override=service)
-        return InternalMessage.from_proto(resp)
+        return await self._call(
+            "send_feedback", feedback.to_proto, service_override=service,
+            idempotent=False,
+        )
 
     async def ready(self) -> bool:
         try:
@@ -225,48 +466,72 @@ class RestClient(NodeClient):
             self._session = aiohttp.ClientSession(timeout=timeout)
         return self._session
 
-    async def _post(self, path: str, body: Dict[str, Any]) -> Dict[str, Any]:
-        last_err: Optional[Exception] = None
-        for attempt in range(self.retries):
-            try:
-                session = self._get_session()
-                async with session.post(self.base + path, json=body) as resp:
-                    payload = await resp.json(content_type=None)
-                    if resp.status >= 400:
-                        raise MicroserviceError(
-                            f"REST call {path} to {self.base} returned {resp.status}: {payload}",
-                            status_code=502,
-                            reason="UPSTREAM_REST_ERROR",
-                        )
-                    return payload
-            except MicroserviceError:
-                raise
-            except Exception as e:
-                last_err = e
-                logger.warning("REST %s attempt %d/%d failed: %s", path, attempt + 1, self.retries, e)
-                await asyncio.sleep(0.05 * (attempt + 1))
-        raise MicroserviceError(
-            f"REST call {path} to {self.base} failed after {self.retries} tries: {last_err}",
-            status_code=502,
-            reason="UPSTREAM_REST_ERROR",
-        )
+    async def _post(
+        self, path: str, method: str, encode: Callable[[], Dict[str, Any]]
+    ) -> InternalMessage:
+        hop = _Hop(self.unit.name, method, "rest")
+        ok = False
+        try:
+            with hop.codec():
+                data = json.dumps(encode()).encode()
+                hop.request_bytes = len(data)
+            headers = _tracing.inject({"Content-Type": "application/json"})
+            last_err: Optional[Exception] = None
+            for attempt in range(self.retries):
+                if attempt:
+                    hop.retries += 1
+                try:
+                    session = self._get_session()
+                    async with session.post(
+                        self.base + path, data=data, headers=headers
+                    ) as resp:
+                        raw = await resp.read()
+                        hop.response_bytes = len(raw)
+                        with hop.codec():
+                            payload = json.loads(raw)
+                        if resp.status >= 400:
+                            raise MicroserviceError(
+                                f"REST call {path} to {self.base} returned {resp.status}: {payload}",
+                                status_code=502,
+                                reason="UPSTREAM_REST_ERROR",
+                            )
+                        with hop.codec():
+                            out = InternalMessage.from_json(payload)
+                        ok = True
+                        return out
+                except MicroserviceError:
+                    raise
+                except Exception as e:
+                    last_err = e
+                    logger.warning("REST %s attempt %d/%d failed: %s", path, attempt + 1, self.retries, e)
+                    await asyncio.sleep(0.05 * (attempt + 1))
+            raise MicroserviceError(
+                f"REST call {path} to {self.base} failed after {self.retries} tries: {last_err}",
+                status_code=502,
+                reason="UPSTREAM_REST_ERROR",
+            )
+        finally:
+            hop.finish(error=not ok)
 
     async def transform_input(self, msg: InternalMessage) -> InternalMessage:
-        path = "/predict" if self.unit.type == MODEL else "/transform-input"
-        return InternalMessage.from_json(await self._post(path, msg.to_json()))
+        if self.unit.type == MODEL:
+            return await self._post("/predict", "predict", msg.to_json)
+        return await self._post("/transform-input", "transform_input", msg.to_json)
 
     async def transform_output(self, msg: InternalMessage) -> InternalMessage:
-        return InternalMessage.from_json(await self._post("/transform-output", msg.to_json()))
+        return await self._post("/transform-output", "transform_output", msg.to_json)
 
     async def route(self, msg: InternalMessage) -> InternalMessage:
-        return InternalMessage.from_json(await self._post("/route", msg.to_json()))
+        return await self._post("/route", "route", msg.to_json)
 
     async def aggregate(self, msgs: List[InternalMessage]) -> InternalMessage:
-        body = {"seldonMessages": [m.to_json() for m in msgs]}
-        return InternalMessage.from_json(await self._post("/aggregate", body))
+        def encode():
+            return {"seldonMessages": [m.to_json() for m in msgs]}
+
+        return await self._post("/aggregate", "aggregate", encode)
 
     async def send_feedback(self, feedback: InternalFeedback) -> InternalMessage:
-        return InternalMessage.from_json(await self._post("/send-feedback", feedback.to_json()))
+        return await self._post("/send-feedback", "send_feedback", feedback.to_json)
 
     async def ready(self) -> bool:
         try:
@@ -353,13 +618,20 @@ class BalancedClient(NodeClient):
                 last = e
                 if not failover:
                     raise
+                self._count_failover(client, method)
                 logger.warning("replica call %s failed, failing over: %s", method, e)
             except Exception as e:  # noqa: BLE001 — fail over to next replica
                 last = e
                 if not failover:
                     raise
+                self._count_failover(client, method)
                 logger.warning("replica call %s failed, failing over: %s", method, e)
         raise last  # type: ignore[misc]
+
+    @staticmethod
+    def _count_failover(client: NodeClient, method: str) -> None:
+        unit = getattr(getattr(client, "unit", None), "name", "") or "balanced"
+        _metrics.record_transport_failover(unit, method)
 
     async def transform_input(self, msg: InternalMessage) -> InternalMessage:
         return await self._call("transform_input", msg)

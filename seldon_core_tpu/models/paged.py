@@ -1024,7 +1024,23 @@ class PagedEngine:
                     )
                 self._draft_module = TransformerLM(dtype=dtype, **dc)
                 self._draft_params = self.speculative["draft_params"]
-                self._draft_rollout = jax.jit(self._draft_rollout_fn)
+
+        # recompilation sentinels: every engine jit entry point reports
+        # compile events to seldon_tpu_jit_compiles_total{program=} +
+        # a WARN naming the triggering shape signature — a silent
+        # under-traffic recompile is the classic invisible TPU tail
+        # (utils/jitwatch.py; SELDON_TPU_JIT_SENTINEL=0 disables)
+        from seldon_core_tpu.utils.jitwatch import JitSentinel
+
+        self._sentinels = {
+            name: JitSentinel(name)
+            for name in ("paged_chunk", "paged_prefill", "paged_spec_chunk",
+                         "paged_draft_rollout")
+        }
+        if self.speculative is not None and draft == "model":
+            self._draft_rollout = self._sentinels["paged_draft_rollout"].wrap(
+                jax.jit(self._draft_rollout_fn)
+            )
 
         self._prefill_jit: Dict[Tuple[int, int], Any] = {}  # (bucket, k)
         # (steps, bucket spec) -> compiled chunk program, where the
@@ -1036,7 +1052,9 @@ class PagedEngine:
             jax.vmap(lambda s: jax.random.key_data(jax.random.key(s)))
         )
         self._spec_chunk = (
-            jax.jit(self._spec_chunk_fn, donate_argnums=(1, 2))
+            self._sentinels["paged_spec_chunk"].wrap(
+                jax.jit(self._spec_chunk_fn, donate_argnums=(1, 2))
+            )
             if self.speculative is not None else None
         )
 
@@ -1087,7 +1105,9 @@ class PagedEngine:
             last = logits[jnp.arange(k), true_lens - 1]  # (k, vocab)
             return last, pk, pv
 
-        return jax.jit(prefill, donate_argnums=(1, 2))
+        return self._sentinels["paged_prefill"].wrap(
+            jax.jit(prefill, donate_argnums=(1, 2)), static=f"bucket={bucket},k={k}"
+        )
 
     def _sample_batch(self, logits, keys, temps, top_ks):
         """All-slot sampling — same per-slot semantics as
@@ -1225,7 +1245,10 @@ class PagedEngine:
                 body = partial(self._chunk_fn_pool, steps, buckets)
             else:
                 body = partial(self._chunk_fn, steps, buckets)
-            fn = self._jax.jit(body, donate_argnums=(1, 2))
+            fn = self._sentinels["paged_chunk"].wrap(
+                self._jax.jit(body, donate_argnums=(1, 2)),
+                static=f"steps={steps},buckets={buckets}",
+            )
             self._chunk_jit[key] = fn
         return fn
 
@@ -2041,6 +2064,10 @@ class PagedEngine:
                 "queued_streams": len(self._queue),
                 "pool_pages_used": self.num_pages - 1 - len(self._free_pages),
                 "pool_pages_total": self.num_pages - 1,
+                # distinct compiled signatures seen by the jit sentinels
+                # (prometheus gets the per-program split directly from
+                # jitwatch — bridge-excluded to avoid double export)
+                "jit_compiles": sum(s.compiles for s in self._sentinels.values()),
             }
         if detail:
             if self.recorder is not None:

@@ -43,8 +43,13 @@ def _construct_response(
 ) -> InternalMessage:
     """Wrap a user-method result (reference: utils.py:426-498)."""
     if isinstance(result, InternalMessage):
+        result.meta.trace_context = {}
         return result
     out = msg.with_payload(result)
+    # hop state never rides a response: the carrier was consumed at
+    # dispatch, but a concurrent sibling hop may have re-injected into
+    # the shared request meta this copy inherits
+    out.meta.trace_context = {}
     if isinstance(result, (bytes, str, dict)):
         out.names = []
     else:
@@ -88,20 +93,60 @@ def _ensure_puid(msg) -> str:
     return meta.puid
 
 
+def _consume_trace_context(msg):
+    """Pop the W3C trace-context carrier off the message meta (and, for
+    lists, every member) so responses never echo the caller's context
+    downstream, and parse it into a SpanContext (or None).
+
+    Consumption happens even when tracing is off: the carrier is hop
+    state, not payload."""
+    first = msg
+    if isinstance(msg, list):
+        if not msg:
+            return None
+        first = msg[0]
+        for other in msg[1:]:
+            meta = getattr(other, "meta", None)
+            if meta is not None:
+                meta.trace_context = {}
+    meta = getattr(first, "meta", None) or getattr(
+        getattr(first, "request", None), "meta", None
+    )
+    if meta is None or not meta.trace_context:
+        return None
+    carrier, meta.trace_context = meta.trace_context, {}
+    from seldon_core_tpu.utils.tracing import extract
+
+    return extract(carrier)
+
+
 def _traced(method_name: str):
     """Span per microservice method call — the wrapper-level tracing the
     reference does around its endpoints (microservice.py:124-155).
-    No-op (one global read) when tracing is not set up."""
+    No-op (one global read) when tracing is not set up.
+
+    Cross-process parenting: a remote context extracted from the
+    message meta (or already activated by the REST/gRPC server from
+    headers/metadata) makes this span a CHILD of the caller's span —
+    never a fresh root.  An ambient in-process span wins over the meta
+    carrier (they agree when both exist; the ambient one carries more
+    structure)."""
     import functools
 
     def deco(fn):
         @functools.wraps(fn)
         def wrapper(user_model, msg, *args, **kwargs):
-            from seldon_core_tpu.utils.tracing import maybe_span
+            from seldon_core_tpu.utils import tracing
 
             puid = _ensure_puid(msg)
-            with maybe_span(f"microservice.{method_name}", trace_id=puid):
+            ctx = _consume_trace_context(msg)
+            if tracing.get_tracer() is None:
                 return fn(user_model, msg, *args, **kwargs)
+            if tracing.current_span() is not None:
+                ctx = None
+            with tracing.activate_context(ctx):
+                with tracing.maybe_span(f"microservice.{method_name}", trace_id=puid):
+                    return fn(user_model, msg, *args, **kwargs)
 
         return wrapper
 
@@ -127,11 +172,16 @@ async def predict_async(user_model: Any, msg: InternalMessage) -> InternalMessag
         from seldon_core_tpu.runtime.executor_pool import run_dispatch
 
         return await run_dispatch(predict, user_model, msg)
-    from seldon_core_tpu.utils.tracing import maybe_span
+    from seldon_core_tpu.utils import tracing
 
-    with maybe_span("microservice.predict", trace_id=_ensure_puid(msg)):
-        features = _features_for(user_model, msg)
-        result = await fn(features, msg.names, meta=msg.meta.to_dict())
+    puid = _ensure_puid(msg)
+    ctx = _consume_trace_context(msg)
+    if tracing.current_span() is not None:
+        ctx = None
+    with tracing.activate_context(ctx if tracing.get_tracer() is not None else None):
+        with tracing.maybe_span("microservice.predict", trace_id=puid):
+            features = _features_for(user_model, msg)
+            result = await fn(features, msg.names, meta=msg.meta.to_dict())
     return _construct_response(user_model, msg, result)
 
 

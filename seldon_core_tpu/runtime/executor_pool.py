@@ -12,6 +12,7 @@ so a much larger pool costs little and restores concurrency.
 from __future__ import annotations
 
 import asyncio
+import contextvars
 import os
 from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Callable
@@ -28,6 +29,12 @@ def dispatch_pool() -> ThreadPoolExecutor:
 
 
 async def run_dispatch(fn: Callable, *args: Any):
-    """Run a sync dispatch call on the shared pool."""
+    """Run a sync dispatch call on the shared pool.
+
+    The caller's contextvars (in particular the active tracing span /
+    an extracted remote span context) are copied onto the pool thread —
+    ``run_in_executor`` alone would drop them, making every dispatch
+    span a fresh root (asyncio.to_thread does the same copy)."""
     loop = asyncio.get_running_loop()
-    return await loop.run_in_executor(dispatch_pool(), fn, *args)
+    ctx = contextvars.copy_context()
+    return await loop.run_in_executor(dispatch_pool(), lambda: ctx.run(fn, *args))

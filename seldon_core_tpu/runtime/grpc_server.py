@@ -25,23 +25,39 @@ logger = logging.getLogger(__name__)
 DEFAULT_MAX_MSG_BYTES = 512 * 1024 * 1024
 
 
+def _grpc_remote_ctx(context):
+    """The caller's W3C span context from the invocation metadata
+    (GrpcClient injects traceparent/tracestate there — the proto has
+    no meta field for it)."""
+    from seldon_core_tpu.utils.tracing import extract, get_tracer
+
+    if get_tracer() is None:
+        return None
+    try:
+        return extract(context.invocation_metadata() or ())
+    except Exception:  # noqa: BLE001 — bad metadata must not fail the call
+        return None
+
+
 def _wrap_unary(user_model: Any, fn, unit_id: str = ""):
     async def handler(request, context):
         from seldon_core_tpu.runtime.executor_pool import run_dispatch
+        from seldon_core_tpu.utils.tracing import activate_context
 
         try:
-            if isinstance(request, pb.Feedback):
-                arg = InternalFeedback.from_proto(request)
-                out = await run_dispatch(fn, user_model, arg, unit_id)
-            elif isinstance(request, pb.SeldonMessageList):
-                msgs = [InternalMessage.from_proto(m) for m in request.seldonMessages]
-                out = await run_dispatch(fn, user_model, msgs)
-            else:
-                msg = InternalMessage.from_proto(request)
-                if fn is dispatch.predict:  # async fast path for batched models
-                    out = await dispatch.predict_async(user_model, msg)
+            with activate_context(_grpc_remote_ctx(context)):
+                if isinstance(request, pb.Feedback):
+                    arg = InternalFeedback.from_proto(request)
+                    out = await run_dispatch(fn, user_model, arg, unit_id)
+                elif isinstance(request, pb.SeldonMessageList):
+                    msgs = [InternalMessage.from_proto(m) for m in request.seldonMessages]
+                    out = await run_dispatch(fn, user_model, msgs)
                 else:
-                    out = await run_dispatch(fn, user_model, msg)
+                    msg = InternalMessage.from_proto(request)
+                    if fn is dispatch.predict:  # async fast path for batched models
+                        out = await dispatch.predict_async(user_model, msg)
+                    else:
+                        out = await run_dispatch(fn, user_model, msg)
             return out.to_proto()
         except MicroserviceError as e:
             resp = pb.SeldonMessage()

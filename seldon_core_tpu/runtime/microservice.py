@@ -175,7 +175,14 @@ def main(argv: Optional[List[str]] = None) -> None:
     if args.tracing:
         from seldon_core_tpu.utils.tracing import setup_tracing
 
-        setup_tracing(service_name=args.unit_id or args.component)
+        # SELDON_TPU_TRACE_EXPORT: JSONL span sink for this process —
+        # the per-process artifact tools/profile_trace_stitch.py reads
+        # to reassemble one cross-process trace (OTLP export rides the
+        # standard OTEL_EXPORTER_OTLP_ENDPOINT env either way)
+        setup_tracing(
+            service_name=args.unit_id or args.component,
+            export_path=os.environ.get("SELDON_TPU_TRACE_EXPORT") or None,
+        )
 
     persistence_thread = None
     if args.persistence:

@@ -151,15 +151,32 @@ def _custom_endpoint(user_handler: Callable) -> Callable:
     return handler
 
 
+def _remote_ctx(request: web.Request):
+    """The caller's W3C span context from the HTTP headers, if tracing
+    is on (the body-meta carrier is handled at dispatch).  One global
+    read + a header probe when off/absent."""
+    from seldon_core_tpu.utils.tracing import extract, get_tracer
+
+    if get_tracer() is None:
+        return None
+    return extract(request.headers)
+
+
 def _message_endpoint(user_model: Any, fn: Callable) -> Callable:
     async def handler(request: web.Request) -> web.Response:
+        from seldon_core_tpu.utils.tracing import activate_context
+
         try:
             body = await _request_body(request)
             msg = InternalMessage.from_json(body)
-            if fn is dispatch.predict:  # async fast path for batched models
-                out = await dispatch.predict_async(user_model, msg)
-            else:
-                out = await run_dispatch(fn, user_model, msg)
+            # headers carry the caller's span context; activating it
+            # here makes the dispatch span a child of the caller's
+            # (run_dispatch copies the context onto the pool thread)
+            with activate_context(_remote_ctx(request)):
+                if fn is dispatch.predict:  # async fast path for batched models
+                    out = await dispatch.predict_async(user_model, msg)
+                else:
+                    out = await run_dispatch(fn, user_model, msg)
             return web.json_response(out.to_json())
         except Exception as e:  # noqa: BLE001 — every error must map to a Status
             return _error_response(e)
@@ -175,20 +192,26 @@ def build_app(
     app = web.Application(client_max_size=1024 * 1024 * 512)
 
     async def aggregate_handler(request: web.Request) -> web.Response:
+        from seldon_core_tpu.utils.tracing import activate_context
+
         try:
             body = await _request_body(request)
             raw_list = body.get("seldonMessages", body if isinstance(body, list) else [])
             msgs = [InternalMessage.from_json(b) for b in raw_list]
-            out = await run_dispatch(dispatch.aggregate, user_model, msgs)
+            with activate_context(_remote_ctx(request)):
+                out = await run_dispatch(dispatch.aggregate, user_model, msgs)
             return web.json_response(out.to_json())
         except Exception as e:  # noqa: BLE001
             return _error_response(e)
 
     async def feedback_handler(request: web.Request) -> web.Response:
+        from seldon_core_tpu.utils.tracing import activate_context
+
         try:
             body = await _request_body(request)
             fb = InternalFeedback.from_json(body)
-            out = await run_dispatch(dispatch.send_feedback, user_model, fb, unit_id)
+            with activate_context(_remote_ctx(request)):
+                out = await run_dispatch(dispatch.send_feedback, user_model, fb, unit_id)
             return web.json_response(out.to_json())
         except Exception as e:  # noqa: BLE001
             return _error_response(e)

@@ -280,8 +280,11 @@ ENGINE_STATS_METRICS: Dict[str, Tuple[str, str, str]] = {
 # keys intentionally NOT exported as their own series: the wall-clock
 # accumulators feed the chunk-duration HISTOGRAM (via the flight
 # recorder's per-chunk records) — exporting the sums next to it would
-# double-count the same signal under a non-canonical name
-ENGINE_STATS_EXCLUDED = {"chunk_wall_s", "prefill_wall_s"}
+# double-count the same signal under a non-canonical name;
+# jit_compiles is exported by utils/jitwatch.py itself as
+# seldon_tpu_jit_compiles_total{program=...} (per-program labels the
+# summed stat can't carry)
+ENGINE_STATS_EXCLUDED = {"chunk_wall_s", "prefill_wall_s", "jit_compiles"}
 
 CHUNK_DURATION_METRIC = "seldon_tpu_engine_chunk_duration_seconds"
 
@@ -360,6 +363,155 @@ class GenerationPrometheusBridge:
                 "gauge", "seldon_tpu_engine_chunk_p99_ms",
                 "chunk-wall p99 over the flight recorder window",
             ).set(float(recorder.stats()["chunk_p99_ms"]))
+
+
+# ---------------------------------------------------------------------------
+# per-hop transport telemetry (engine -> node clients)
+# ---------------------------------------------------------------------------
+
+TRANSPORT_LABELS = ("unit", "method", "transport")
+
+# HopRecord field -> (kind, canonical metric name, doc).  COMPLETE BY
+# CONTRACT like the engine bridge: every quantitative HopRecord field
+# must appear here or in TRANSPORT_RECORD_EXCLUDED
+# (tests/test_trace_propagation.py), so a new per-hop measurement
+# cannot silently skip Prometheus export.
+TRANSPORT_METRICS: Dict[str, Tuple[str, str, str]] = {
+    "requests": ("counter", "seldon_tpu_transport_requests_total",
+                 "node-client calls issued (one per NodeClient method call)"),
+    "errors": ("counter", "seldon_tpu_transport_errors_total",
+               "node-client calls that raised after exhausting retries"),
+    "retries": ("counter", "seldon_tpu_transport_retries_total",
+                "extra attempts beyond the first (REST/gRPC retry loops)"),
+    "failovers": ("counter", "seldon_tpu_transport_failovers_total",
+                  "replica failovers by BalancedClient"),
+    "request_bytes": ("counter", "seldon_tpu_transport_request_bytes_total",
+                      "serialized request payload bytes put on the wire"),
+    "response_bytes": ("counter", "seldon_tpu_transport_response_bytes_total",
+                       "serialized response payload bytes read off the wire"),
+    "serialize_seconds": ("histogram", "seldon_tpu_transport_serialize_seconds",
+                          "encode+decode (codec) share of one hop"),
+    "network_seconds": ("histogram", "seldon_tpu_transport_network_seconds",
+                        "on-the-wire share of one hop (total - codec)"),
+}
+
+# label-shaped fields of HopRecord, not exported as their own series
+TRANSPORT_RECORD_EXCLUDED = {"unit", "method", "transport", "error"}
+
+TRANSPORT_INFLIGHT_METRIC = "seldon_tpu_transport_inflight"
+
+
+def transport_telemetry_enabled() -> bool:
+    """SELDON_TPU_TRANSPORT_TELEMETRY=0 turns the per-hop metrics off
+    (the bench's trace_prop on/off contrast flips this)."""
+    import os
+
+    return os.environ.get("SELDON_TPU_TRANSPORT_TELEMETRY", "1") != "0"
+
+
+class _BoundHop:
+    """Pre-bound metric children for one (unit, method, transport) —
+    the label resolution (two lock hops per metric in
+    prometheus_client) happens once per hop identity, not once per
+    request; a hop record is then a handful of plain inc()/observe()s."""
+
+    __slots__ = tuple(TRANSPORT_METRICS) + ("inflight",)
+
+    def __init__(self, unit: str, method: str, transport: str, registry=None):
+        cache = _cache_for(registry)
+        labels = {"unit": unit, "method": method, "transport": transport}
+        for field, (kind, name, doc) in TRANSPORT_METRICS.items():
+            setattr(
+                self, field,
+                cache.get(kind, name, TRANSPORT_LABELS, doc).labels(**labels),
+            )
+        self.inflight = cache.get(
+            "gauge", TRANSPORT_INFLIGHT_METRIC, TRANSPORT_LABELS,
+            "node-client calls currently awaiting a response",
+        ).labels(**labels)
+
+
+_BOUND_HOPS: Dict[Tuple[str, str, str, int], _BoundHop] = {}
+_BOUND_HOPS_LOCK = threading.Lock()
+
+
+def _bound_hop(unit: str, method: str, transport: str, registry=None) -> _BoundHop:
+    key = (unit, method, transport, id(registry))
+    hop = _BOUND_HOPS.get(key)
+    if hop is None:
+        with _BOUND_HOPS_LOCK:
+            hop = _BOUND_HOPS.get(key)
+            if hop is None:
+                hop = _BoundHop(unit, method, transport, registry)
+                _BOUND_HOPS[key] = hop
+    return hop
+
+
+def record_transport_hop(
+    unit: str,
+    method: str,
+    transport: str,
+    *,
+    request_bytes: int = 0,
+    response_bytes: int = 0,
+    serialize_seconds: float = 0.0,
+    network_seconds: float = 0.0,
+    retries: int = 0,
+    error: bool = False,
+    registry=None,
+) -> None:
+    """Record one completed NodeClient hop.  Never raises — transport
+    telemetry must not take the data plane down."""
+    if not transport_telemetry_enabled():
+        return
+    try:
+        hop = _bound_hop(unit, method, transport, registry)
+        hop.requests.inc()
+        if error:
+            hop.errors.inc()
+        if retries > 0:
+            hop.retries.inc(retries)
+        if request_bytes > 0:
+            hop.request_bytes.inc(request_bytes)
+        if response_bytes > 0:
+            hop.response_bytes.inc(response_bytes)
+        if transport != "local":
+            # the local transport has no codec or wire share by design
+            # (device payloads pass by handle); observing constant 0.0
+            # would poison the histograms' lower buckets
+            hop.serialize_seconds.observe(max(0.0, serialize_seconds))
+            hop.network_seconds.observe(max(0.0, network_seconds))
+    except Exception:  # noqa: BLE001
+        logger.exception("transport telemetry failed for %s/%s", unit, method)
+
+
+def record_transport_failover(
+    unit: str, method: str, transport: str = "balanced", registry=None
+) -> None:
+    """One replica failover (BalancedClient) — counted separately from
+    requests: the failed underlying call already recorded its own hop."""
+    if not transport_telemetry_enabled():
+        return
+    try:
+        kind, name, doc = TRANSPORT_METRICS["failovers"]
+        _cache_for(registry).get(kind, name, TRANSPORT_LABELS, doc).labels(
+            unit=unit, method=method, transport=transport
+        ).inc()
+    except Exception:  # noqa: BLE001
+        logger.exception("transport failover counter failed for %s/%s", unit, method)
+
+
+def transport_inflight(unit: str, method: str, transport: str, registry=None):
+    """The in-flight gauge child for one (unit, method, transport), or
+    None when telemetry is off/broken.  Callers inc()/dec() around the
+    await so a wedged upstream is visible as a stuck positive gauge."""
+    if not transport_telemetry_enabled():
+        return None
+    try:
+        return _bound_hop(unit, method, transport, registry).inflight
+    except Exception:  # noqa: BLE001
+        logger.exception("transport inflight gauge failed for %s/%s", unit, method)
+        return None
 
 
 def api_latency_sampler(

@@ -20,19 +20,35 @@ duration, tags, parent linkage via puid) and pluggable export:
 
 Spans cover the same cut points as the reference: one span per external
 request, one per graph-node method call.
+
+Cross-process propagation is W3C trace-context (the contract Jaeger,
+Zipkin and every OTel SDK speak): ``inject``/``extract`` carry a
+``SpanContext`` over HTTP headers, gRPC metadata, or an
+``InternalMessage.meta`` dict, so a span created in the gateway is the
+real parent of the microservice span in a remote worker — the role the
+reference's jaeger_client/opentracing interceptors play on every
+REST/gRPC hop (reference: microservice.py:124-155,
+RestClientController.java:134-145).  The logical trace id (the puid)
+rides in ``tracestate`` under the ``seldon-tpu`` vendor key; the
+``traceparent`` carries its 32-hex derivation — the same derivation
+``OtlpHttpExporter`` ships, so stitched-by-puid and stitched-by-OTLP
+views agree.
 """
 
 from __future__ import annotations
 
 import contextvars
 import json
+import os
+import random
+import re
 import threading
 import time
 import uuid
 from collections import deque
 from contextlib import contextmanager
 from dataclasses import dataclass, field
-from typing import Any, Deque, Dict, List, Optional
+from typing import Any, Deque, Dict, List, Optional, Tuple
 
 _tracer: Optional["Tracer"] = None
 # the active span of the current task/thread; contextvars propagate
@@ -41,9 +57,35 @@ _current_span: "contextvars.ContextVar[Optional[Span]]" = contextvars.ContextVar
     "seldon_tpu_current_span", default=None
 )
 
+# span/trace id generator: a urandom-seeded PRNG, not uuid4 — an id is
+# minted per SPAN, and the per-call urandom syscall was the top line of
+# the traced serving profile (same reasoning as runtime/puid.py).  The
+# pid guard reseeds after fork so two processes never share a stream.
+_ids_lock = threading.Lock()
+_ids_pid: Optional[int] = None
+_ids_rng = random.Random()
+
 
 def _new_id(nbytes: int) -> str:
-    return uuid.uuid4().hex[: nbytes * 2]
+    global _ids_pid
+    with _ids_lock:
+        if _ids_pid != os.getpid():
+            _ids_rng.seed(uuid.uuid4().int)
+            _ids_pid = os.getpid()
+        return f"{_ids_rng.getrandbits(nbytes * 8):0{nbytes * 2}x}"
+
+
+def w3c_trace_id(trace_id: str) -> str:
+    """The 32-hex W3C trace id of a logical trace id (usually a puid).
+
+    A value that already IS a 32-hex id passes through; anything else
+    hashes — the SAME derivation ``OtlpHttpExporter`` uses, so the id
+    on the wire matches the id in the collector."""
+    if len(trace_id) == 32 and all(c in "0123456789abcdef" for c in trace_id):
+        return trace_id
+    import hashlib
+
+    return hashlib.sha256(trace_id.encode()).hexdigest()[:32]
 
 
 @dataclass
@@ -56,6 +98,16 @@ class Span:
     parent: Optional[str] = None  # parent span NAME (informational)
     span_id: str = field(default_factory=lambda: _new_id(8))
     parent_span_id: Optional[str] = None
+    # True for the placeholder a remote SpanContext activates: it is
+    # never recorded, and its trace id overrides a child's explicit
+    # trace_id arg — the caller process owns the trace identity
+    remote: bool = False
+    # propagation state inherited down the tree and re-injected on the
+    # next hop: an upstream's do-not-sample decision and any foreign
+    # vendors' tracestate members survive verbatim (not serialized in
+    # to_dict — they are hop state, not span data)
+    sampled: bool = True
+    tracestate: str = ""
 
     def to_dict(self) -> Dict[str, Any]:
         # spanId/parentSpanId ride along so the JSONL file exporter
@@ -72,6 +124,166 @@ class Span:
             "tags": self.tags,
             "parent": self.parent,
         }
+
+
+# ---------------------------------------------------------------------------
+# W3C trace-context propagation
+# ---------------------------------------------------------------------------
+
+TRACEPARENT_HEADER = "traceparent"
+TRACESTATE_HEADER = "tracestate"
+_TRACESTATE_VENDOR = "seldon-tpu"  # carries the logical trace id (puid)
+_TRACEPARENT_RE = re.compile(
+    r"^([0-9a-f]{2})-([0-9a-f]{32})-([0-9a-f]{16})-([0-9a-f]{2})$"
+)
+
+
+@dataclass(frozen=True)
+class SpanContext:
+    """The cross-process identity of a span: what survives
+    serialization over any transport hop.
+
+    ``trace_id`` is the LOGICAL id (the puid for requests born at our
+    gateway); ``hex_trace_id`` its 32-hex wire form.  A context parsed
+    from a foreign caller (no ``seldon-tpu`` tracestate member) uses
+    the wire id as the logical id."""
+
+    trace_id: str
+    span_id: str  # 16-hex id of the (remote) parent span
+    sampled: bool = True
+    tracestate: str = ""
+
+    @property
+    def hex_trace_id(self) -> str:
+        return w3c_trace_id(self.trace_id)
+
+    def to_traceparent(self) -> str:
+        return (
+            f"00-{self.hex_trace_id}-{self.span_id}-"
+            f"{'01' if self.sampled else '00'}"
+        )
+
+    def to_tracestate(self) -> str:
+        """tracestate with our vendor member prepended (W3C §3.3.1:
+        mutating vendors re-list themselves first)."""
+        members = [
+            m for m in (self.tracestate or "").split(",")
+            if m.strip() and not m.strip().startswith(f"{_TRACESTATE_VENDOR}=")
+        ]
+        own = f"{_TRACESTATE_VENDOR}={self.trace_id}"
+        return ",".join([own] + members[:31])  # W3C caps at 32 members
+
+
+def span_context(span: Optional[Span] = None) -> Optional[SpanContext]:
+    """The propagatable context of ``span`` (default: the active span)."""
+    s = span if span is not None else _current_span.get()
+    if s is None:
+        return None
+    # pad/trim to the 16-hex W3C span id (ours are 16-hex already)
+    sid = (s.span_id + "0" * 16)[:16]
+    return SpanContext(
+        trace_id=s.trace_id, span_id=sid,
+        sampled=s.sampled, tracestate=s.tracestate,
+    )
+
+
+def _carrier_get(carrier: Any, key: str) -> Optional[str]:
+    """Case-insensitive lookup over dicts, header multidicts, and
+    (key, value) tuple lists (gRPC invocation metadata)."""
+    if carrier is None:
+        return None
+    getter = getattr(carrier, "get", None)
+    if getter is not None:
+        val = getter(key)
+        if val is None:
+            val = getter(key.title())  # plain dicts with Traceparent
+        if val is not None:
+            return str(val)
+    try:
+        items = carrier.items() if hasattr(carrier, "items") else carrier
+        for k, v in items:
+            if str(k).lower() == key:
+                return str(v)
+    except (TypeError, ValueError):
+        return None
+    return None
+
+
+def extract(carrier: Any) -> Optional[SpanContext]:
+    """Parse a ``SpanContext`` out of any carrier — HTTP headers, gRPC
+    metadata tuples, or a plain dict (``InternalMessage.meta``'s
+    traceContext).  Returns None (never raises) on absent or malformed
+    context — a bad header must not fail the request."""
+    try:
+        header = _carrier_get(carrier, TRACEPARENT_HEADER)
+        if not header:
+            return None
+        m = _TRACEPARENT_RE.match(header.strip().lower())
+        if m is None:
+            return None
+        version, hex_tid, span_id, flags = m.groups()
+        if version == "ff" or hex_tid == "0" * 32 or span_id == "0" * 16:
+            return None  # forbidden version / all-zero ids (W3C §3.2.2)
+        state = _carrier_get(carrier, TRACESTATE_HEADER) or ""
+        trace_id = hex_tid
+        for member in state.split(","):
+            k, _, v = member.strip().partition("=")
+            if k == _TRACESTATE_VENDOR and v:
+                trace_id = v
+                break
+        return SpanContext(
+            trace_id=trace_id,
+            span_id=span_id,
+            sampled=bool(int(flags, 16) & 1),
+            tracestate=state,
+        )
+    except Exception:  # noqa: BLE001 — malformed context is not an error
+        return None
+
+
+def inject(carrier: Dict[str, str], span: Optional[Span] = None) -> Dict[str, str]:
+    """Write the active (or given) span's context into a mutable
+    mapping — HTTP headers dict, ``meta.trace_context`` dict.  No-op
+    when nothing is being traced; always returns the carrier."""
+    ctx = span_context(span)
+    if ctx is not None:
+        carrier[TRACEPARENT_HEADER] = ctx.to_traceparent()
+        carrier[TRACESTATE_HEADER] = ctx.to_tracestate()
+    return carrier
+
+
+def inject_metadata(
+    metadata: Optional[List[Tuple[str, str]]] = None, span: Optional[Span] = None
+) -> List[Tuple[str, str]]:
+    """gRPC flavour of ``inject``: (key, value) tuples."""
+    md = list(metadata or [])
+    ctx = span_context(span)
+    if ctx is not None:
+        md.append((TRACEPARENT_HEADER, ctx.to_traceparent()))
+        md.append((TRACESTATE_HEADER, ctx.to_tracestate()))
+    return md
+
+
+@contextmanager
+def activate_context(ctx: Optional[SpanContext]):
+    """Make a remote ``SpanContext`` the ambient parent: spans created
+    inside become its children and ADOPT its trace id (the caller owns
+    trace identity — that is what makes the microservice's ``_traced``
+    spans children of the gateway's span instead of fresh roots).
+    ``None`` is a no-op, so call sites don't branch."""
+    if ctx is None:
+        yield None
+        return
+    placeholder = Span(
+        trace_id=ctx.trace_id, name="<remote>", start_s=time.time(),
+        span_id=ctx.span_id, remote=True,
+        sampled=ctx.sampled, tracestate=ctx.tracestate,
+    )
+    token = _current_span.set(placeholder)
+    try:
+        yield placeholder
+    finally:
+        _current_span.reset(token)
 
 
 class OtlpHttpExporter:
@@ -126,15 +338,18 @@ class OtlpHttpExporter:
 
     @staticmethod
     def _hex_id(seed: str, nbytes: int) -> str:
+        if nbytes == 16:
+            return w3c_trace_id(seed)  # the shared wire-id derivation
         import hashlib
 
         return hashlib.sha256(seed.encode()).hexdigest()[: nbytes * 2]
 
     def _otlp_span(self, s: Span) -> Dict[str, Any]:
         start = int(s.start_s * 1e9)
-        # trace id derives from the puid; span ids are real per-span
-        # uuids assigned at creation, parent links resolved via the
-        # contextvar span stack — unique even for repeated span names
+        # trace id derives from the puid via w3c_trace_id — the same
+        # derivation inject() puts on the wire, so spans shipped from
+        # different processes of one request join one OTLP trace; span
+        # ids are real per-span uuids assigned at creation
         return {
             "traceId": self._hex_id(s.trace_id, 16) if s.trace_id else _new_id(16),  # fallback for hand-built spans
             "spanId": s.span_id,
@@ -265,10 +480,23 @@ class Tracer:
         enclosing = _current_span.get()
         if enclosing is not None:
             s.parent_span_id = enclosing.span_id
-            if s.parent is None:
+            if s.parent is None and not enclosing.remote:
                 s.parent = enclosing.name
-            if not s.trace_id:
-                s.trace_id = enclosing.trace_id
+            # trace identity flows DOWN from the root: a child always
+            # joins its parent's trace, whatever trace_id it was called
+            # with — otherwise a root that adopted an external caller's
+            # traceparent would split the tree the moment a node span
+            # passed the local puid.  The two are equal except in that
+            # adoption case; the puid survives as a tag when they differ
+            # so /debug/traces?trace_id=<puid> stays answerable.
+            if s.trace_id and s.trace_id != enclosing.trace_id:
+                s.tags.setdefault("puid", s.trace_id)
+            s.trace_id = enclosing.trace_id
+            # propagation state rides the tree too, so the NEXT hop's
+            # inject() re-emits the upstream's sampling decision and
+            # foreign tracestate members verbatim
+            s.sampled = enclosing.sampled
+            s.tracestate = enclosing.tracestate
         if not s.trace_id:
             # root span without a puid: mint the trace id here, once,
             # so children (and the exporter) all see the same trace
@@ -295,8 +523,14 @@ class Tracer:
                 pass
 
     def find(self, trace_id: str) -> List[Span]:
+        """Spans of one trace, matched by trace id OR by the ``puid``
+        tag (a trace that adopted an external caller's id keeps its
+        puid there, so puid lookups keep working)."""
         with self._lock:
-            return [s for s in self.spans if s.trace_id == trace_id]
+            return [
+                s for s in self.spans
+                if s.trace_id == trace_id or s.tags.get("puid") == trace_id
+            ]
 
     def close(self) -> None:
         with self._lock:  # record() writes under this lock — no close race

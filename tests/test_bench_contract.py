@@ -137,6 +137,12 @@ def _r3_like_full_result():
                 "obs_on_tokens_per_s": 4363.0,
                 "obs_off_tokens_per_s": 4400.0,
             },
+            "trace_prop": {
+                "trace_on_tok_s": 4360.0,
+                "trace_off_tok_s": 4440.0,
+                "trace_prop_overhead_pct": 1.8,
+                "protocol": "16-way StreamingLM graph serving, best-of-3",
+            },
             "mean_batch_rows": 26.69,
             "device_batches": 1106,
             "latency_phase": {
@@ -225,6 +231,19 @@ def test_compact_line_carries_observability_overhead(bench):
     assert e["obs_overhead_pct"] == 0.84
     # raw rates are full-blob-only: the compact line stays lean
     assert "obs_on_tokens_per_s" not in e
+
+
+def test_compact_line_carries_trace_prop_overhead(bench):
+    """r8 certification key: the serving cost of full cross-process
+    trace propagation + per-hop transport telemetry, as a float
+    percentage gated < 2 (same posture as obs_overhead_pct); the raw
+    on/off rates stay in bench_full.json under trace_prop."""
+    compact = bench._compact_result(_r3_like_full_result())
+    e = compact["extra"]
+    assert isinstance(e["trace_prop_overhead_pct"], float)
+    assert e["trace_prop_overhead_pct"] == 1.8
+    assert "trace_on_tok_s" not in e
+    assert "protocol" not in e
 
 
 def test_capacity_accounting_donated_vs_copied():

@@ -672,17 +672,27 @@ class TestProtocolEdges:
                 except Exception as e:  # noqa: BLE001
                     errs.append(e)
 
+            # best-of-3 windows: a single window on a loaded shared CI
+            # host swings with scheduler noise (the same min-of-N
+            # discipline the bench adopted, ADVICE r4) — the floor is
+            # about the ingress, not about this minute's neighbors
             n, nthreads = 500, 8
-            threads = [threading.Thread(target=worker, args=(n,)) for _ in range(nthreads)]
-            t0 = time.perf_counter()
-            for t in threads:
-                t.start()
-            for t in threads:
-                t.join()
-            dt = time.perf_counter() - t0
-            assert not errs
-            qps = n * nthreads / dt
-            assert qps > 2000, f"native ingress too slow: {qps:.0f} req/s"
+            best = 0.0
+            for _ in range(3):
+                threads = [
+                    threading.Thread(target=worker, args=(n,)) for _ in range(nthreads)
+                ]
+                t0 = time.perf_counter()
+                for t in threads:
+                    t.start()
+                for t in threads:
+                    t.join()
+                dt = time.perf_counter() - t0
+                assert not errs
+                best = max(best, n * nthreads / dt)
+                if best > 2000:
+                    break
+            assert best > 2000, f"native ingress too slow: {best:.0f} req/s"
 
 
 class TestHardeningRound2:

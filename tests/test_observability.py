@@ -331,8 +331,16 @@ class TestMonitoringAssets:
             "paged_pool_utilization",
             "paged_evictions",
             "speculative_acceptance_rate",
+            # per-hop transport telemetry (engine -> node clients, r8)
+            "seldon_tpu_transport_errors_total",
+            "seldon_tpu_transport_requests_total",
+            "seldon_tpu_transport_retries_total",
+            # the recompile sentinel (utils/jitwatch.py)
+            "seldon_tpu_jit_compiles_total",
         ):
             assert metric in exprs, f"alert rules no longer cover {metric}"
+        names = {r["alert"] for g in rules["groups"] for r in g["rules"]}
+        assert "TransportErrorBudgetBurn" in names
         for g in rules["groups"]:
             for r in g["rules"]:
                 assert r["labels"]["severity"] in ("info", "warning", "critical")
@@ -368,6 +376,26 @@ class TestMonitoringAssets:
                 t["expr"] for p in dash["panels"] for t in p.get("targets", [])
             )
             assert any(fam in exprs for fam in emitted_families), name
+
+    def test_predictions_dashboard_covers_transport_telemetry(self):
+        import json
+
+        with open(os.path.join(self.MONITORING, "grafana", "predictions-dashboard.json")) as f:
+            dash = json.load(f)
+        exprs = " ".join(
+            t["expr"] for p in dash["panels"] for t in p.get("targets", [])
+        )
+        for metric in (
+            "seldon_tpu_transport_requests_total",
+            "seldon_tpu_transport_errors_total",
+            "seldon_tpu_transport_network_seconds",
+            "seldon_tpu_transport_serialize_seconds",
+            "seldon_tpu_transport_request_bytes_total",
+            "seldon_tpu_transport_inflight",
+            "seldon_tpu_transport_retries_total",
+            "seldon_tpu_jit_compiles_total",
+        ):
+            assert metric in exprs, f"predictions dashboard lost {metric}"
 
     def test_generation_dashboard_covers_engine_stats(self):
         import json
@@ -894,6 +922,94 @@ class TestHistogramQuantileSamplerEdges:
         _hist, sampler = self._sampler()
         assert sampler() == 0.0
         assert sampler() == 0.0
+
+
+class TestJitSentinel:
+    """utils/jitwatch.py: the first call per distinct argument-shape
+    signature is a compile event — counted and WARNed; repeat shapes
+    are free of both."""
+
+    def test_counts_once_per_signature_and_warns(self, caplog):
+        import logging
+
+        import prometheus_client as prom
+
+        from seldon_core_tpu.utils.jitwatch import JitSentinel
+
+        import jax
+        import jax.numpy as jnp
+
+        sentinel = JitSentinel("test_prog_sig")
+        fn = sentinel.wrap(jax.jit(lambda x: x * 2), static="variant=a")
+        before = prom.REGISTRY.get_sample_value(
+            "seldon_tpu_jit_compiles_total", {"program": "test_prog_sig"}
+        ) or 0.0
+        with caplog.at_level(logging.WARNING, logger="seldon_core_tpu.utils.jitwatch"):
+            fn(jnp.zeros((2, 2)))
+            fn(jnp.ones((2, 2)))   # same signature: no new compile
+            fn(jnp.zeros((4, 4)))  # new shape: compile event
+        assert sentinel.compiles == 2
+        after = prom.REGISTRY.get_sample_value(
+            "seldon_tpu_jit_compiles_total", {"program": "test_prog_sig"}
+        )
+        assert after - before == 2.0
+        warns = [r for r in caplog.records if "jit compile" in r.getMessage()]
+        assert len(warns) == 2
+        # the WARN names the program AND the triggering signature
+        assert "test_prog_sig" in warns[0].getMessage()
+        assert "(2, 2)" in warns[0].getMessage()
+        assert "(4, 4)" in warns[1].getMessage()
+
+    def test_static_key_separates_variants(self):
+        from seldon_core_tpu.utils.jitwatch import JitSentinel
+
+        import jax
+        import jax.numpy as jnp
+
+        sentinel = JitSentinel("test_prog_static")
+        a = sentinel.wrap(jax.jit(lambda x: x + 1), static="steps=8")
+        b = sentinel.wrap(jax.jit(lambda x: x + 2), static="steps=16")
+        a(jnp.zeros((2,)))
+        b(jnp.zeros((2,)))  # same array shape, distinct static key
+        assert sentinel.compiles == 2
+
+    def test_kill_switch_returns_fn_unwrapped(self, monkeypatch):
+        from seldon_core_tpu.utils.jitwatch import JitSentinel
+
+        monkeypatch.setenv("SELDON_TPU_JIT_SENTINEL", "0")
+        sentinel = JitSentinel("test_prog_off")
+        fn = lambda x: x  # noqa: E731
+        assert sentinel.wrap(fn) is fn
+
+    def test_engine_stats_exposes_summed_compiles(self):
+        """PagedEngine wires sentinels on its chunk/prefill programs and
+        engine_stats carries the sum (bridge-excluded: jitwatch exports
+        the per-program split itself)."""
+        import numpy as np
+
+        import jax
+        import jax.numpy as jnp
+
+        from seldon_core_tpu.models.paged import PagedEngine
+        from seldon_core_tpu.models.transformer import TransformerLM
+
+        lm = TransformerLM(vocab_size=256, d_model=64, num_layers=1,
+                           num_heads=4, max_len=128, dtype=jnp.float32)
+        params = lm.init(jax.random.key(0), jnp.zeros((1, 8), jnp.int32))["params"]
+        eng = PagedEngine(
+            params, vocab_size=256, d_model=64, num_layers=1, num_heads=4,
+            max_len=128, page_size=16, max_slots=2, steps_per_call=4,
+            dtype=jnp.float32,
+        )
+        try:
+            assert eng.engine_stats()["jit_compiles"] == 0
+            eng.submit(np.arange(8, dtype=np.int32), max_new_tokens=4)
+            while eng.has_work():
+                eng.step()
+            # at least the prefill + one chunk program compiled
+            assert eng.engine_stats()["jit_compiles"] >= 2
+        finally:
+            eng.close()
 
 
 class TestSharedRegistryObservers:

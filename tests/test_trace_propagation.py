@@ -1,0 +1,744 @@
+"""Cross-process trace propagation as a CONTRACT.
+
+Every ``NodeClient`` (local / REST / gRPC / the meta-carrier native
+lane) must carry the caller's span context on every method, and the
+microservice runtime must parent its ``_traced`` dispatch spans under
+it — a span created in the gateway may never become a fresh root in a
+worker (reference: the Jaeger interceptors on every hop,
+microservice.py:124-155; PAPERS.md: Dapper).  Also under contract
+here: the per-hop ``seldon_tpu_transport_*`` telemetry (complete by
+contract like the engine bridge), the GrpcClient per-attempt failure
+history, and puid uniqueness across process generations.
+"""
+
+import asyncio
+import json
+import os
+import socket
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from seldon_core_tpu.engine.graph import Endpoint, UnitSpec
+from seldon_core_tpu.engine.transport import GrpcClient, LocalClient, RestClient
+from seldon_core_tpu.runtime import dispatch, grpc_server, rest
+from seldon_core_tpu.runtime.component import MicroserviceError, TPUComponent
+from seldon_core_tpu.runtime.message import InternalFeedback, InternalMessage
+from seldon_core_tpu.utils import tracing
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def msg(arr=((1.0, 2.0),), puid="puid-prop"):
+    m = InternalMessage(payload=np.asarray(arr, dtype=np.float64), kind="tensor")
+    m.meta.puid = puid
+    return m
+
+
+class Omni(TPUComponent):
+    """Implements every node method so one component serves all five
+    NodeClient calls."""
+
+    def predict(self, X, names, meta=None):
+        return np.asarray(X) * 2
+
+    def transform_input(self, X, names, meta=None):
+        return np.asarray(X) + 1
+
+    def transform_output(self, X, names, meta=None):
+        return np.asarray(X) - 1
+
+    def route(self, X, names):
+        return 0
+
+    def aggregate(self, features_list, names_list):
+        return np.mean(np.asarray(features_list), axis=0)
+
+    def send_feedback(self, features, names, reward, truth, routing=None):
+        return None
+
+
+# ---------------------------------------------------------------------------
+# W3C primitives
+# ---------------------------------------------------------------------------
+
+
+class TestW3CPrimitives:
+    def test_inject_extract_roundtrip_preserves_identity(self):
+        ctx = tracing.SpanContext(trace_id="puid-42", span_id="ab" * 8)
+        carrier = {}
+        carrier[tracing.TRACEPARENT_HEADER] = ctx.to_traceparent()
+        carrier[tracing.TRACESTATE_HEADER] = ctx.to_tracestate()
+        got = tracing.extract(carrier)
+        assert got is not None
+        assert got.trace_id == "puid-42"  # logical id survives via tracestate
+        assert got.span_id == "ab" * 8
+        assert got.sampled is True
+        # the wire id is the puid's stable 32-hex derivation — the same
+        # one the OTLP exporter ships
+        assert got.hex_trace_id == tracing.w3c_trace_id("puid-42")
+        assert len(got.hex_trace_id) == 32
+
+    def test_traceparent_shape_is_w3c(self):
+        ctx = tracing.SpanContext(trace_id="p", span_id="12" * 8)
+        tp = ctx.to_traceparent()
+        version, tid, sid, flags = tp.split("-")
+        assert version == "00" and len(tid) == 32 and len(sid) == 16 and flags == "01"
+
+    def test_foreign_caller_without_tracestate_adopts_hex_id(self):
+        got = tracing.extract({
+            "traceparent": f"00-{'1a' * 16}-{'2b' * 8}-01",
+        })
+        assert got is not None and got.trace_id == "1a" * 16
+
+    @pytest.mark.parametrize("bad", [
+        "", "garbage", "00-zz-xx-01",
+        f"00-{'0' * 32}-{'2b' * 8}-01",       # all-zero trace id forbidden
+        f"00-{'1a' * 16}-{'0' * 16}-01",      # all-zero span id forbidden
+        f"ff-{'1a' * 16}-{'2b' * 8}-01",      # forbidden version
+    ])
+    def test_malformed_traceparent_is_ignored_not_fatal(self, bad):
+        assert tracing.extract({"traceparent": bad}) is None
+
+    def test_extract_reads_grpc_metadata_tuples_case_insensitively(self):
+        md = [("Traceparent", f"00-{'1a' * 16}-{'2b' * 8}-01")]
+        got = tracing.extract(md)
+        assert got is not None and got.span_id == "2b" * 8
+
+    def test_inject_without_active_span_is_noop(self):
+        assert tracing.inject({}) == {}
+        assert tracing.inject_metadata() == []
+
+    def test_sampled_flag_and_foreign_tracestate_survive_the_hop(self):
+        """An upstream's do-not-sample decision (flags=00) and other
+        vendors' tracestate members must be re-emitted verbatim by the
+        NEXT hop's inject — the caller owns the sampling decision."""
+        tracer = tracing.setup_tracing("flag-carry")
+        try:
+            incoming = {
+                "traceparent": f"00-{'1a' * 16}-{'2b' * 8}-00",
+                "tracestate": "congo=t61rcWkgMzE,rojo=00f067aa0ba902b7",
+            }
+            ctx = tracing.extract(incoming)
+            assert ctx is not None and ctx.sampled is False
+            with tracing.activate_context(ctx):
+                with tracer.span("node.hop", trace_id="local-puid"):
+                    outgoing = tracing.inject({})
+            assert outgoing["traceparent"].endswith("-00"), outgoing
+            state = outgoing["tracestate"].split(",")
+            assert state[0].startswith("seldon-tpu=")
+            assert "congo=t61rcWkgMzE" in state
+            assert "rojo=00f067aa0ba902b7" in state
+        finally:
+            tracing._tracer = None
+
+
+# ---------------------------------------------------------------------------
+# the propagation contract, per transport, per NodeClient method
+# ---------------------------------------------------------------------------
+
+METHODS = ["transform_input", "transform_output", "route", "aggregate", "send_feedback"]
+# what microservice-level span name each method lands as when the unit
+# is a non-MODEL type (we use UNKNOWN so transform_input stays itself)
+_ARG_OF = {
+    "transform_input": lambda: msg(),
+    "transform_output": lambda: msg(),
+    "route": lambda: msg(),
+    "aggregate": lambda: [msg(), msg()],
+    "send_feedback": lambda: InternalFeedback(request=msg(), reward=1.0),
+}
+
+
+def _unit(name="n", type_="MODEL_ROUTER_COMBO", endpoint=None):
+    # a type that is not MODEL, so transform_input dispatches as itself
+    u = UnitSpec(name=name, type="TRANSFORMER")
+    u.endpoint = endpoint
+    return u
+
+
+async def _serve_rest(component):
+    from aiohttp.test_utils import TestServer
+
+    app = rest.build_app(component, unit_id="n")
+    server = TestServer(app)
+    await server.start_server()
+    return server, Endpoint(host="127.0.0.1", port=server.port, transport="REST")
+
+
+async def _serve_grpc(component):
+    server = grpc_server.build_server(component, unit_id="n")
+    port = server.add_insecure_port("127.0.0.1:0")
+    await server.start()
+    return server, Endpoint(host="127.0.0.1", port=port, transport="GRPC")
+
+
+class TestNodeClientPropagationContract:
+    """Every NodeClient method, every transport: the dispatch span must
+    share the caller's trace id and link the caller's span as parent."""
+
+    @pytest.fixture(autouse=True)
+    def _tracer(self):
+        self.tracer = tracing.setup_tracing("prop-contract")
+        yield
+        tracing._tracer = None
+
+    def _assert_linked(self, root, method):
+        name = f"microservice.{method}"
+        spans = [s for s in self.tracer.spans if s.name == name]
+        assert spans, f"no {name} span recorded"
+        child = spans[-1]
+        assert child.trace_id == root.trace_id, (
+            f"{name} started a fresh trace {child.trace_id!r} "
+            f"instead of joining {root.trace_id!r}"
+        )
+        assert child.parent_span_id == root.span_id, (
+            f"{name} is an orphan root (parent {child.parent_span_id!r}, "
+            f"expected {root.span_id!r})"
+        )
+
+    @pytest.mark.parametrize("method", METHODS)
+    def test_local_client(self, method):
+        client = LocalClient(_unit(), Omni())
+
+        async def scenario():
+            with self.tracer.span("node.hop", trace_id="puid-prop") as root:
+                await getattr(client, method)(_ARG_OF[method]())
+            return root
+
+        self._assert_linked(run(scenario()), method)
+
+    @pytest.mark.parametrize("method", METHODS)
+    def test_rest_client(self, method):
+        async def scenario():
+            server, endpoint = await _serve_rest(Omni())
+            client = RestClient(_unit(endpoint=endpoint))
+            try:
+                with self.tracer.span("node.hop", trace_id="puid-prop") as root:
+                    await getattr(client, method)(_ARG_OF[method]())
+            finally:
+                await client.close()
+                await server.close()
+            return root
+
+        self._assert_linked(run(scenario()), method)
+
+    @pytest.mark.parametrize("method", METHODS)
+    def test_grpc_client(self, method):
+        async def scenario():
+            server, endpoint = await _serve_grpc(Omni())
+            client = GrpcClient(_unit(endpoint=endpoint))
+            try:
+                with self.tracer.span("node.hop", trace_id="puid-prop") as root:
+                    await getattr(client, method)(_ARG_OF[method]())
+            finally:
+                await client.close()
+                await server.stop(grace=None)
+            return root
+
+        self._assert_linked(run(scenario()), method)
+
+    @pytest.mark.parametrize("method", METHODS)
+    def test_meta_carrier_native_lane(self, method):
+        """The InternalMessage.meta carrier alone (no ambient
+        contextvar, no headers — the native-ingress / queue-hand-off
+        shape) must parent dispatch identically."""
+        with self.tracer.span("node.hop", trace_id="puid-prop") as root:
+            carrier = tracing.inject({})
+        arg = _ARG_OF[method]()
+        first = arg[0] if isinstance(arg, list) else arg
+        meta = getattr(first, "meta", None) or first.request.meta
+        meta.trace_context = dict(carrier)
+        args = (Omni(), arg) + (("n",) if method == "send_feedback" else ())
+        getattr(dispatch, method)(*args)
+        self._assert_linked(root, method)
+
+    def test_meta_carrier_is_consumed_not_echoed(self):
+        with self.tracer.span("node.hop", trace_id="puid-prop"):
+            carrier = tracing.inject({})
+        m = msg()
+        m.meta.trace_context = dict(carrier)
+        out = dispatch.predict(Omni(), m)
+        assert m.meta.trace_context == {}
+        assert out.meta.trace_context == {}
+        assert "traceContext" not in out.to_json().get("meta", {})
+
+
+class TestExternalCallerAdoption:
+    """A foreign caller's traceparent at the gateway: the WHOLE graph
+    joins the caller's trace (trace identity flows down from the root),
+    and puid lookups still work via the puid tag."""
+
+    def test_graph_joins_external_trace_and_puid_stays_findable(self):
+        from seldon_core_tpu.engine import PredictorService
+
+        tracer = tracing.setup_tracing("ext-adopt")
+        try:
+            svc = PredictorService(
+                UnitSpec(name="m", type="MODEL", component=Omni()), name="main"
+            )
+            ext = tracing.SpanContext(trace_id="ext-trace-99", span_id="c3" * 8)
+
+            async def scenario():
+                with tracing.activate_context(ext):
+                    return await svc.predict(msg(puid=""))
+
+            out = run(scenario())
+            puid = out.meta.puid
+            spans = list(tracer.spans)
+            assert spans and all(s.trace_id == "ext-trace-99" for s in spans), (
+                "a node span split off the external trace: "
+                f"{[(s.name, s.trace_id) for s in spans]}"
+            )
+            pred = [s for s in spans if s.name == "predictor.predict"][0]
+            assert pred.parent_span_id == "c3" * 8
+            # the puid survives as a tag and find() answers by it
+            assert pred.tags["puid"] == puid
+            assert {s.name for s in tracer.find(puid)} >= {
+                "predictor.predict", "node.m.transform_input",
+            }
+        finally:
+            tracing._tracer = None
+
+
+class TestGraphHasNoOrphanRoots:
+    """A full in-process graph run: exactly ONE root (the predictor
+    span); every other span parents into the tree."""
+
+    def test_single_root_full_chain(self):
+        from seldon_core_tpu.engine import PredictorService
+
+        tracer = tracing.setup_tracing("orphan-check")
+        try:
+            graph = UnitSpec(
+                name="combiner", type="COMBINER",
+                implementation="AVERAGE_COMBINER",
+                children=[
+                    UnitSpec(name="a", type="MODEL", component=Omni()),
+                    UnitSpec(name="b", type="MODEL", component=Omni()),
+                ],
+            )
+            svc = PredictorService(graph, name="main")
+            out = run(svc.predict(msg()))
+            assert out.status["status"] == "SUCCESS"
+            spans = tracer.find(out.meta.puid)
+            roots = [s for s in spans if s.parent_span_id is None]
+            assert len(spans) >= 6  # predictor + 3 node hops + dispatches
+            assert [r.name for r in roots] == ["predictor.predict"]
+            by_id = {s.span_id: s for s in spans}
+            for s in spans:
+                if s.parent_span_id is not None:
+                    assert s.parent_span_id in by_id, f"{s.name} dangles"
+        finally:
+            tracing._tracer = None
+
+
+# ---------------------------------------------------------------------------
+# per-hop transport telemetry
+# ---------------------------------------------------------------------------
+
+
+class TestTransportTelemetry:
+    def test_contract_is_complete(self):
+        """Every quantitative hop measurement maps to a canonical
+        metric — the same completeness rule the engine bridge enforces."""
+        from seldon_core_tpu.utils import metrics as m
+
+        hop_fields = {
+            "unit", "method", "transport", "request_bytes",
+            "response_bytes", "serialize_seconds", "network_seconds",
+            "retries", "error", "requests", "failovers",
+        }
+        mapped = set(m.TRANSPORT_METRICS) | m.TRANSPORT_RECORD_EXCLUDED
+        unmapped = hop_fields - mapped - {
+            "serialize_s",  # _Hop internal names land as *_seconds
+        }
+        assert not unmapped, f"hop fields with no metric mapping: {unmapped}"
+        for kind, name, doc in m.TRANSPORT_METRICS.values():
+            assert name.startswith("seldon_tpu_transport_")
+            assert kind in ("counter", "gauge", "histogram") and doc
+
+    def test_rest_hop_records_bytes_split_and_inflight(self):
+        import prometheus_client as prom
+
+        from seldon_core_tpu.utils import metrics as m
+
+        async def scenario():
+            server, endpoint = await _serve_rest(Omni())
+            unit = UnitSpec(name="telem-rest", type="MODEL")
+            unit.endpoint = endpoint
+            client = RestClient(unit)
+            try:
+                await client.transform_input(msg())
+            finally:
+                await client.close()
+                await server.close()
+
+        run(scenario())
+        labels = {"unit": "telem-rest", "method": "predict", "transport": "rest"}
+        g = prom.REGISTRY.get_sample_value
+        assert g("seldon_tpu_transport_requests_total", labels) == 1.0
+        assert g("seldon_tpu_transport_request_bytes_total", labels) > 0
+        assert g("seldon_tpu_transport_response_bytes_total", labels) > 0
+        assert g("seldon_tpu_transport_serialize_seconds_count", labels) == 1.0
+        assert g("seldon_tpu_transport_network_seconds_count", labels) == 1.0
+        # the split is a decomposition: codec + network <= total elapsed,
+        # and the in-flight gauge returned to zero
+        assert g("seldon_tpu_transport_inflight", labels) == 0.0
+        # children are pre-bound, so the error counter exists at zero
+        assert (g("seldon_tpu_transport_errors_total", labels) or 0.0) == 0.0
+
+    def test_grpc_error_hop_counts_error_and_retries(self):
+        import prometheus_client as prom
+
+        async def scenario():
+            unit = UnitSpec(name="telem-grpc-err", type="MODEL")
+            unit.endpoint = Endpoint(host="127.0.0.1", port=_free_port(), transport="GRPC")
+            client = GrpcClient(unit, deadline_s=0.4, retries=2)
+            with pytest.raises(MicroserviceError):
+                await client.transform_input(msg())
+            await client.close()
+
+        run(scenario())
+        labels = {"unit": "telem-grpc-err", "method": "predict", "transport": "grpc"}
+        g = prom.REGISTRY.get_sample_value
+        assert g("seldon_tpu_transport_errors_total", labels) == 1.0
+        assert g("seldon_tpu_transport_retries_total", labels) == 1.0
+        assert g("seldon_tpu_transport_inflight", labels) == 0.0
+
+    def test_kill_switch_disables_recording(self, monkeypatch):
+        import prometheus_client as prom
+
+        from seldon_core_tpu.utils import metrics as m
+
+        monkeypatch.setenv("SELDON_TPU_TRANSPORT_TELEMETRY", "0")
+        m.record_transport_hop("off-unit", "predict", "rest", request_bytes=10)
+        assert prom.REGISTRY.get_sample_value(
+            "seldon_tpu_transport_requests_total",
+            {"unit": "off-unit", "method": "predict", "transport": "rest"},
+        ) is None
+
+    def test_hop_tags_land_on_the_node_span(self):
+        tracer = tracing.setup_tracing("hop-tags")
+        try:
+            async def scenario():
+                server, endpoint = await _serve_rest(Omni())
+                unit = UnitSpec(name="tagged", type="MODEL")
+                unit.endpoint = endpoint
+                client = RestClient(unit)
+                try:
+                    with tracer.span("node.tagged.predict", trace_id="p-tag") as hop:
+                        await client.transform_input(msg())
+                finally:
+                    await client.close()
+                    await server.close()
+                return hop
+
+            hop = run(scenario())
+            assert hop.tags["transport"] == "rest"
+            assert hop.tags["request_bytes"] > 0
+            assert hop.tags["response_bytes"] > 0
+            assert hop.tags["serialize_ms"] >= 0
+            assert hop.tags["network_ms"] >= 0
+        finally:
+            tracing._tracer = None
+
+
+# ---------------------------------------------------------------------------
+# GrpcClient per-attempt failure history (post-mortem diagnosability)
+# ---------------------------------------------------------------------------
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+class TestGrpcAttemptHistory:
+    def test_exhausted_call_carries_full_attempt_history(self):
+        unit = UnitSpec(name="dead", type="MODEL")
+        unit.endpoint = Endpoint(host="127.0.0.1", port=_free_port(), transport="GRPC")
+        client = GrpcClient(unit, deadline_s=0.4, retries=3)
+
+        async def scenario():
+            try:
+                await client.transform_input(msg())
+            except MicroserviceError as e:
+                return e
+            finally:
+                await client.close()
+            return None
+
+        err = run(scenario())
+        assert err is not None and err.reason == "UPSTREAM_GRPC_ERROR"
+        # machine-readable history: one entry per attempt, each with a
+        # status name and its elapsed time
+        assert len(err.attempts) == 3
+        for i, att in enumerate(err.attempts, start=1):
+            assert att["attempt"] == i
+            assert att["status"] == "UNAVAILABLE"
+            assert att["elapsed_ms"] >= 0
+        # and the human-readable message names every attempt too
+        assert "attempts" in err.message and "UNAVAILABLE" in err.message
+
+    def test_channel_recovers_after_endpoint_respawn(self):
+        """An UNAVAILABLE call drops the cached channel, so a later
+        call to a RESPAWNED worker at the same address connects
+        immediately instead of failing fast from inside the old
+        subchannel's reconnect backoff (the chaos-test regression:
+        kill -> retries poison the channel -> recovery request fails)."""
+        port = _free_port()
+        unit = UnitSpec(name="respawn", type="MODEL")
+        unit.endpoint = Endpoint(host="127.0.0.1", port=port, transport="GRPC")
+        client = GrpcClient(unit, deadline_s=2.0, retries=2)
+
+        async def scenario():
+            # 1. endpoint down: exhausted retries, channel reset
+            with pytest.raises(MicroserviceError):
+                await client.transform_input(msg())
+            # 2. "respawn" a worker on the SAME port
+            server = grpc_server.build_server(Omni())
+            bound = server.add_insecure_port(f"127.0.0.1:{port}")
+            assert bound == port
+            await server.start()
+            try:
+                out = await client.transform_input(msg())
+                return out
+            finally:
+                await client.close()
+                await server.stop(grace=None)
+
+        out = run(scenario())
+        np.testing.assert_allclose(out.array(), np.asarray([[2.0, 4.0]]))
+
+    def test_non_retryable_status_fails_fast_with_single_attempt(self):
+        """A server that answers with a non-transient failure must not
+        burn the retry budget."""
+
+        class Boom(TPUComponent):
+            def predict(self, X, names, meta=None):
+                raise MicroserviceError("bad input", status_code=400, reason="BAD")
+
+        async def scenario():
+            server = grpc_server.build_server(Boom())
+            port = server.add_insecure_port("127.0.0.1:0")
+            await server.start()
+            unit = UnitSpec(name="boom", type="MODEL")
+            unit.endpoint = Endpoint(host="127.0.0.1", port=port, transport="GRPC")
+            client = GrpcClient(unit, retries=3)
+            try:
+                out = await client.transform_input(msg())
+                return out
+            finally:
+                await client.close()
+                await server.stop(grace=None)
+
+        # component errors come back as FAILURE payloads (status carried
+        # in-band), so transport-level retries never fire for them
+        out = run(scenario())
+        assert out.status["status"] == "FAILURE"
+
+
+# ---------------------------------------------------------------------------
+# puid hardening: unique across processes, respawns, and forks
+# ---------------------------------------------------------------------------
+
+
+class TestPuidHardening:
+    def test_multiprocess_uniqueness(self):
+        """Three process generations each minting puids: zero
+        collisions (the pre-hardening counter restarted at 0 with a
+        process-lifetime prefix, so respawned workers collided)."""
+        code = (
+            "from seldon_core_tpu.runtime.puid import new_puid\n"
+            "print('\\n'.join(new_puid() for _ in range(200)))\n"
+        )
+        batches = []
+        for _ in range(3):
+            out = subprocess.run(
+                [sys.executable, "-c", code], cwd=REPO_ROOT,
+                capture_output=True, text=True, timeout=60, check=True,
+            )
+            batches.append(out.stdout.split())
+        all_puids = [p for b in batches for p in b]
+        assert len(all_puids) == 600
+        assert len(set(all_puids)) == 600, "puids collided across process generations"
+
+    def test_fork_reseeds_prefix(self):
+        """A fork after import must not duplicate the generator state
+        into the child (pre-fork supervisors would otherwise mint the
+        parent's puids again).  Exercised in a clean subprocess: this
+        test process has jax (and its threads) loaded, where a raw
+        fork is unsafe."""
+        code = (
+            "import os, sys\n"
+            "from seldon_core_tpu.runtime.puid import new_puid\n"
+            "parent = {new_puid() for _ in range(50)}\n"
+            "r, w = os.pipe()\n"
+            "pids = []\n"
+            "for _ in range(2):\n"
+            "    pid = os.fork()\n"
+            "    if pid == 0:\n"
+            "        os.close(r)\n"
+            "        out = '\\n'.join(new_puid() for _ in range(50))\n"
+            "        os.write(w, (out + '\\n').encode())\n"
+            "        os._exit(0)\n"
+            "    pids.append(pid)\n"
+            "os.close(w)\n"
+            "data = b''\n"
+            "while True:\n"
+            "    chunk = os.read(r, 65536)\n"
+            "    if not chunk: break\n"
+            "    data += chunk\n"
+            "for pid in pids: os.waitpid(pid, 0)\n"
+            "children = data.decode().split()\n"
+            "assert len(children) == 100, len(children)\n"
+            "everything = parent | set(children)\n"
+            "assert len(everything) == 150, 'fork duplicated puid state'\n"
+            "print('OK')\n"
+        )
+        out = subprocess.run(
+            [sys.executable, "-c", code], cwd=REPO_ROOT,
+            capture_output=True, text=True, timeout=60,
+        )
+        assert out.returncode == 0, out.stderr
+        assert "OK" in out.stdout
+
+    def test_new_puid_format_and_local_uniqueness(self):
+        from seldon_core_tpu.runtime.puid import new_puid
+
+        got = {new_puid() for _ in range(1000)}
+        assert len(got) == 1000
+        assert all(len(p) == 24 for p in got)
+
+
+# ---------------------------------------------------------------------------
+# the acceptance scenario: a REAL multi-process graph (REST + gRPC hops
+# into a spawned worker) produces ONE stitched trace
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.e2e
+class TestMultiProcessStitchedTrace:
+    def test_gateway_to_worker_trace_is_single_tree(self, tmp_path):
+        worker_spans_path = str(tmp_path / "worker-spans.jsonl")
+        worker_log_path = str(tmp_path / "worker.log")
+        http_port, grpc_port = _free_port(), _free_port()
+        env = dict(
+            os.environ,
+            TRACING="1",
+            SELDON_TPU_TRACE_EXPORT=worker_spans_path,
+            JAX_PLATFORMS="cpu",
+        )
+        # worker output to a FILE: an undrained stdout pipe would wedge
+        # a chatty worker once the 64 KB buffer fills
+        with open(worker_log_path, "wb") as worker_log:
+            proc = subprocess.Popen(
+                [
+                    sys.executable, "-m", "seldon_core_tpu.runtime.microservice",
+                    "seldon_core_tpu.engine.units.StubModel",
+                    "--api", "BOTH", "--http-port", str(http_port),
+                    "--grpc-port", str(grpc_port), "--host", "127.0.0.1",
+                    "--unit-id", "worker",
+                ],
+                cwd=REPO_ROOT, env=env,
+                stdout=worker_log, stderr=subprocess.STDOUT,
+            )
+        try:
+            self._await_ready(proc, http_port, worker_log_path)
+            tracer = tracing.setup_tracing("stitch-gateway")
+            try:
+                from seldon_core_tpu.engine import PredictorService
+
+                graph = UnitSpec(
+                    name="combiner", type="COMBINER",
+                    implementation="AVERAGE_COMBINER",
+                    children=[
+                        UnitSpec(
+                            name="rest-leg", type="MODEL", remote=True,
+                            endpoint=Endpoint("127.0.0.1", http_port, "REST"),
+                        ),
+                        UnitSpec(
+                            name="grpc-leg", type="MODEL", remote=True,
+                            endpoint=Endpoint("127.0.0.1", grpc_port, "GRPC"),
+                        ),
+                    ],
+                )
+                svc = PredictorService(graph, name="main")
+                out = run(self._predict_and_close(svc))
+                assert out.status["status"] == "SUCCESS"
+                puid = out.meta.puid
+                local_spans = [s.to_dict() for s in tracer.spans]
+            finally:
+                tracing._tracer = None
+        finally:
+            proc.terminate()
+            proc.wait(timeout=20)
+
+        deadline = time.time() + 10
+        worker_spans = []
+        while time.time() < deadline:
+            if os.path.exists(worker_spans_path):
+                with open(worker_spans_path) as f:
+                    worker_spans = [json.loads(l) for l in f if l.strip()]
+                if len(worker_spans) >= 2:
+                    break
+            time.sleep(0.2)
+        assert len(worker_spans) >= 2, "worker exported no dispatch spans"
+
+        spans = local_spans + worker_spans
+        # ---- the acceptance criterion: one stitched trace ----
+        shared = [s for s in spans if s["traceId"] == puid]
+        assert len(shared) / len(spans) >= 0.99
+        # zero orphan roots from microservice dispatch: every worker
+        # span links a parent that exists on the gateway side
+        local_ids = {s["spanId"] for s in local_spans}
+        micro = [s for s in worker_spans if s["name"].startswith("microservice.")]
+        assert micro and all(s["parentSpanId"] for s in micro), (
+            "microservice dispatch minted orphan root spans"
+        )
+        for s in micro:
+            assert s["parentSpanId"] in local_ids, (
+                f"worker span {s['name']} parents {s['parentSpanId']!r}, "
+                "which is not a gateway span"
+            )
+        # both transports actually hopped
+        hops = {s["name"] for s in local_spans}
+        assert "node.rest-leg.transform_input" in hops
+        assert "node.grpc-leg.transform_input" in hops
+
+    @staticmethod
+    async def _predict_and_close(svc):
+        try:
+            return await svc.predict(msg(puid=""))
+        finally:
+            await svc.close()
+
+    @staticmethod
+    def _await_ready(proc, http_port, log_path, timeout_s=60):
+        import urllib.request
+
+        deadline = time.time() + timeout_s
+        while time.time() < deadline:
+            if proc.poll() is not None:
+                with open(log_path, errors="replace") as f:
+                    out = f.read()
+                raise AssertionError(f"worker died at startup:\n{out[-4000:]}")
+            try:
+                with urllib.request.urlopen(
+                    f"http://127.0.0.1:{http_port}/health/ping", timeout=1
+                ) as resp:
+                    if resp.status < 400:
+                        return
+            except Exception:
+                time.sleep(0.2)
+        raise AssertionError("worker never became ready")
