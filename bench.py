@@ -174,6 +174,14 @@ COMPACT_PICKS = [
     ("paged_bimodal_tok_s", ("generation", "paged_bimodal_tokens_per_s")),
     ("paged256_tok_s", ("generation", "paged_serving256_tokens_per_s")),
     ("paged_cap_streams", ("generation", "paged_capacity", "streams")),
+    # r9 prefix-cache certification: shared-system-prompt workload
+    # (16 streams, one 256-token prefix, distinct suffixes) with
+    # page-granular automatic prefix caching on — gate is >=1.3x the
+    # cache-off arm (prefix_off_tokens_per_s in bench_full.json) while
+    # the distinct-prompt paged_tok_s stays within noise; hit pct is
+    # the best timed run's admission hit rate (steady state: 100)
+    ("prefix_hit_pct", ("generation", "prefix_hit_pct")),
+    ("prefix_shared_tok_s", ("generation", "prefix_shared_tokens_per_s")),
     # r7 observability certification: paged throughput cost of the FULL
     # observability stack (lifecycle spans + per-chunk flight recorder)
     # vs everything disabled, same 16-stream protocol both sides.
@@ -1865,7 +1873,7 @@ def generation_phase() -> dict:
             ).astype(np.int32)
             for i in range(serve_slots)
         ]
-        def measure_point(engine, prompts):
+        def measure_point(engine, prompts, max_new=None):
             """ONE serving-point protocol for every stream-count/mix
             (ADVICE r4; the r6 review asked for one copy): warm pass
             pays the compiles, then best-of-3 rates with per-run stats
@@ -1874,10 +1882,11 @@ def generation_phase() -> dict:
             harness's per-dispatch noise).  Always closes the engine —
             a failed point must not leave a KV pool resident in HBM for
             the phases after it."""
+            mn = serve_new if max_new is None else max_new
             try:
                 def go():
                     streams = [
-                        engine.submit(p, max_new_tokens=serve_new)
+                        engine.submit(p, max_new_tokens=mn)
                         for p in prompts
                     ]
                     engine.run()
@@ -1899,6 +1908,15 @@ def generation_phase() -> dict:
                             - s0["bucketed_chunks"],
                             "chunk_wall": s1["chunk_wall_s"]
                             - s0["chunk_wall_s"],
+                            # prefix-cache engagement of the BEST run
+                            # (r9): hit/miss/saved deltas certify the
+                            # shared-prefix phase actually reused pages
+                            "prefix_hits": s1["prefix_hits"]
+                            - s0["prefix_hits"],
+                            "prefix_misses": s1["prefix_misses"]
+                            - s0["prefix_misses"],
+                            "prefix_tokens_saved": s1["prefix_tokens_saved"]
+                            - s0["prefix_tokens_saved"],
                         }
                 return best
             finally:
@@ -1968,6 +1986,67 @@ def generation_phase() -> dict:
         result["obs_overhead_pct"] = round(
             (obs_off["rate"] - obs_on["rate"])
             / max(obs_off["rate"], 1e-9) * 100.0, 2
+        )
+
+        # ---- shared-prefix serving (r9): the "millions of users, one
+        # system prompt" traffic shape the ROADMAP names — 16 streams
+        # share one 256-token system prompt with distinct user
+        # suffixes.  Automatic prefix caching maps the shared pages
+        # into every follower's block table and prefills only the
+        # suffix, so admission pays O(suffix) instead of O(prompt).
+        # Same measure_point protocol cache-on vs cache-off; the warm
+        # pass populates the cache, so the timed runs measure the
+        # steady state a resident system prompt serves from.  Gates:
+        # prefix_speedup_x >= 1.3 on this workload, and the distinct-
+        # prompt paged_tok_s above (which runs cache-ON: every
+        # admission misses, pricing the lookup overhead) within noise
+        # of its previous certified value.  max_new is deliberately
+        # modest: the win under certification is admission/prefill
+        # cost, and a decode-dominated run would dilute it below
+        # anything the gate could resolve.
+        shared_len = 128 if quick else 256
+        prefix_new = 16 if quick else 64
+        rng3 = np.random.default_rng(7)
+        sys_prompt = rng3.integers(
+            0, cfg["vocab_size"], size=(shared_len,)
+        ).astype(np.int32)
+        pprompts = [
+            np.concatenate([
+                sys_prompt,
+                rng3.integers(
+                    0, cfg["vocab_size"],
+                    size=((4 if quick else 8) + (i % 5) * 4,),
+                ).astype(np.int32),
+            ])
+            for i in range(serve_slots)
+        ]
+
+        def prefix_point(on: bool):
+            return measure_point(
+                PagedEngine(
+                    params, dtype=jnp.bfloat16, page_size=64,
+                    max_slots=serve_slots, steps_per_call=8,
+                    max_steps_per_call=64 if quick else 256,
+                    prefix_cache=on, **serve_cfg,
+                ),
+                pprompts, max_new=prefix_new,
+            )
+
+        pon = prefix_point(True)
+        poff = prefix_point(False)
+        admissions = max(1, pon["prefix_hits"] + pon["prefix_misses"])
+        result["prefix_shared_tokens_per_s"] = round(pon["rate"], 1)
+        result["prefix_off_tokens_per_s"] = round(poff["rate"], 1)
+        result["prefix_speedup_x"] = round(
+            pon["rate"] / max(poff["rate"], 1e-9), 2
+        )
+        result["prefix_hit_pct"] = round(
+            100.0 * pon["prefix_hits"] / admissions, 1
+        )
+        result["prefix_tokens_saved"] = pon["prefix_tokens_saved"]
+        result["prefix_shared_mix"] = (
+            f"{serve_slots} streams, {shared_len}-token shared system "
+            f"prompt + distinct suffixes, {prefix_new} new tokens each"
         )
 
         # wider continuous batching: slots amortise the per-call cost.

@@ -38,7 +38,8 @@ from __future__ import annotations
 import logging
 import queue as _queue
 import threading
-from typing import Any, Dict, List, Optional, Sequence, Tuple
+from collections import OrderedDict, deque
+from typing import Any, Deque, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -598,6 +599,7 @@ def paged_hbm_accounting(
     chunk_impl: str = "ring",
     donated: bool = True,
     split_tile_pad: float = 2.0,
+    cached_prefix_pages: int = 0,
 ) -> Dict[str, int]:
     """Pool-HBM bytes for ``streams`` concurrent streams at ``ctx_len``
     tokens — the capacity model the bench certifies (VERDICT r5 #3/#5).
@@ -619,6 +621,13 @@ def paged_hbm_accounting(
       Under the r6 length-bucketed gather this is the WORST case
       (uniform ctx_len); mixed traffic gathers less.
 
+    * **cached prefix pages (r9)** — LRU-parked prefix-cache pages are
+      RECLAIMABLE: allocation evicts them on demand, so they never
+      reduce admissible capacity.  ``cached_prefix_pages`` prices the
+      bytes they occupy *between* reclaims (``reclaimable_bytes``)
+      without adding to ``peak_bytes`` — the accounting the admission
+      guard and ``paged_capacity_streams`` rely on.
+
     Weights, activations, and the host runtime are out of scope: this
     prices the KV side, which is what scales with streams.
     """
@@ -638,6 +647,9 @@ def paged_hbm_accounting(
         "working_set_bytes": ws,
         "peak_bytes": at_rest + ws,
         "per_stream_bytes": (at_rest + ws) // max(1, streams),
+        "reclaimable_bytes": int(
+            cached_prefix_pages * page_size * tok_bytes * pool_pad
+        ),
     }
 
 
@@ -646,7 +658,12 @@ def paged_capacity_streams(
 ) -> int:
     """Max concurrent streams whose paged KV peak fits ``budget_bytes``
     at ``ctx_len`` tokens each (per-stream cost is linear in streams,
-    so this is one division over the single-stream accounting)."""
+    so this is one division over the single-stream accounting).
+
+    Prefix-cache residue never prices into this: LRU-cached pages are
+    reclaimable on demand (``cached_prefix_pages`` above contributes
+    ``reclaimable_bytes``, not ``peak_bytes``), so a warm cache holds
+    the same number of admissible streams as a cold pool."""
     one = paged_hbm_accounting(
         streams=1, ctx_len=ctx_len, donated=donated, **model_kw
     )
@@ -658,6 +675,42 @@ def paged_capacity_streams(
 # ---------------------------------------------------------------------------
 
 
+# Chain root for the prefix index: page i's key is
+# ``prefix_chain_key(key_{i-1}, page_tokens)`` with key_0 chained off
+# this constant, so one key identifies the ENTIRE token prefix up to
+# and including its page (vLLM's hash-chained block keying).  Lookup
+# walks root -> leaf and stops at the first miss, which is what makes
+# an evicted interior page safely sever its (now unreachable)
+# descendants instead of corrupting them.
+_PREFIX_ROOT = 0x9E3779B97F4A7C15
+
+
+def prefix_chain_key(parent: int, tokens: Tuple[int, ...]) -> int:
+    """Key of the prefix ending at a full page: ``parent`` is the key of
+    the preceding page (``_PREFIX_ROOT`` for page 0), ``tokens`` the
+    page's token ids.  Module-level so tests can monkeypatch it into a
+    colliding hash — entries verify token equality before sharing, so a
+    collision must degrade to a private prefill, never to cross-stream
+    KV contamination."""
+    return hash((parent, tokens))
+
+
+class _CachedPrefix:
+    """One registered full prompt page in the prefix index.
+
+    The page's KV bytes are a pure function of the token chain the key
+    encodes (greedy prefill is deterministic), which is why any stream
+    whose prompt starts with that chain can map the page read-only."""
+
+    __slots__ = ("key", "page", "tokens", "parent")
+
+    def __init__(self, key: int, page: int, tokens: Tuple[int, ...], parent: int):
+        self.key = key
+        self.page = page
+        self.tokens = tokens
+        self.parent = parent
+
+
 class _Stream:
     """One in-flight generation request bound to a slot."""
 
@@ -666,7 +719,7 @@ class _Stream:
         "seed", "tokens", "event", "result", "error", "slot", "pages",
         "pending", "draft_hint", "token_queue", "streamed", "cancelled",
         "trace_id", "parent_span_id", "t_submit", "t_decode_start",
-        "queue_depth_at_submit",
+        "queue_depth_at_submit", "cached_len",
     )
 
     def __init__(self, req_id, prompt, max_new, temperature, top_k, eos_id, seed):
@@ -683,6 +736,9 @@ class _Stream:
         self.error: Optional[Exception] = None
         self.slot: Optional[int] = None
         self.pages: List[int] = []
+        # tokens already resident in shared prefix-cache pages at
+        # admission (page-aligned); prefill runs only past this point
+        self.cached_len = 0
         # speculative mode: the next greedy token (argmax of the last
         # verified logits), decided on host between verify rounds
         self.pending: Optional[int] = None
@@ -742,6 +798,7 @@ class PagedEngine:
         quantize: str = "",
         precision: str = "",
         speculative: Optional[Dict[str, Any]] = None,
+        prefix_cache: Optional[bool] = None,
     ):
         import jax
         import jax.numpy as jnp
@@ -914,7 +971,38 @@ class PagedEngine:
 
         # host bookkeeping — guarded by _lock
         self._lock = threading.Lock()
-        self._free_pages: List[int] = list(range(1, self.num_pages))  # 0 = trash
+        # refcounted page allocator (r9).  The free list is a deque —
+        # _alloc/_free are popleft/append (the old list-slice free list
+        # was O(n) per alloc).  Page states (docs §5d state machine):
+        #   free   — on _free_pages, refcount 0
+        #   mapped — refcount == number of live streams whose block
+        #            table points at it (shared prompt pages count once
+        #            per stream)
+        #   cached — refcount 0 BUT registered in the prefix index:
+        #            parked on the _lru OrderedDict (oldest first) and
+        #            reclaimed by _alloc under pressure instead of
+        #            being freed eagerly on stream finish
+        self._free_pages: Deque[int] = deque(range(1, self.num_pages))  # 0 = trash
+        self._page_ref = np.zeros((self.num_pages,), np.int32)
+        # prefix index: chain key -> _CachedPrefix (page registered as
+        # the canonical holder of that token prefix; may be mapped or
+        # LRU-cached), plus the reverse page -> entry map the release
+        # path and the invariant checker need
+        self._prefix_index: Dict[int, _CachedPrefix] = {}
+        self._page_entry: Dict[int, _CachedPrefix] = {}
+        self._lru: "OrderedDict[int, _CachedPrefix]" = OrderedDict()
+        # SELDON_TPU_PREFIX_CACHE=0 disables (constructor arg wins);
+        # default ON — automatic prefix reuse costs one hash walk per
+        # admission and nothing on the decode hot loop
+        if prefix_cache is None:
+            prefix_cache = _os.environ.get("SELDON_TPU_PREFIX_CACHE", "1") != "0"
+        self._prefix_cache_enabled = bool(prefix_cache)
+        # SELDON_TPU_PAGED_DEBUG=1: allocator state-machine audit at
+        # every chunk boundary (no page simultaneously free/cached/
+        # mapped; refcounts match live block tables)
+        self._debug_invariants = (
+            _os.environ.get("SELDON_TPU_PAGED_DEBUG", "") == "1"
+        )
         self._queue: List[_Stream] = []
         self._slots: List[Optional[_Stream]] = [None] * self.max_slots
         self._block_tables = np.zeros((self.max_slots, self.pages_per_stream), np.int32)
@@ -932,6 +1020,11 @@ class PagedEngine:
                           "stalls": 0, "prefills": 0, "completed": 0,
                           "bucketed_chunks": 0,
                           "spec_drafted": 0, "spec_accepted": 0,
+                          # prefix cache (r9): per-admission hit/miss,
+                          # cached pages reclaimed under pressure, and
+                          # prompt tokens whose prefill was skipped
+                          "prefix_hits": 0, "prefix_misses": 0,
+                          "prefix_evictions": 0, "prefix_tokens_saved": 0,
                           # wall seconds inside device calls + readback,
                           # split by phase: decode-rate observability
                           # (tokens / chunk_wall_s) independent of
@@ -1043,6 +1136,8 @@ class PagedEngine:
             )
 
         self._prefill_jit: Dict[Tuple[int, int], Any] = {}  # (bucket, k)
+        # cached-prefix suffix prefill: (suffix bucket, k, read pages)
+        self._prefill_cached_jit: Dict[Tuple[int, int, int], Any] = {}
         # (steps, bucket spec) -> compiled chunk program, where the
         # bucket spec is a static tuple of (lane_count, ctx_pages)
         # pairs (one entry = uniform, two = the length-bucketed gather)
@@ -1107,6 +1202,49 @@ class PagedEngine:
 
         return self._sentinels["paged_prefill"].wrap(
             jax.jit(prefill, donate_argnums=(1, 2)), static=f"bucket={bucket},k={k}"
+        )
+
+    def _build_prefill_cached(self, bucket: int, k: int, rp: int):
+        """Suffix prefill for ``k`` streams whose leading prompt pages
+        were matched in the prefix cache: only the UNCACHED tail
+        prefills (``bucket`` covers the longest suffix in the group),
+        attending over the shared prefix pages through the same
+        block-table gather decode already uses.
+
+        ``rp`` is the static read-table width (pages covering the
+        group's longest cached prefix, power-of-two so the compile
+        count stays logarithmic like every other shape axis here).
+        Writes go through a SHIFTED table — row ``j`` of ``write_rows``
+        is the page the suffix's j-th block lands in — so the page-block
+        DUS fast path applies unchanged: cached lengths are page-aligned
+        by construction, so every suffix write starts at page offset 0.
+        Pad rows (``true_lens`` 1, ``cached_lens`` 0, zero tables) write
+        only the trash page, exactly like the plain prefill."""
+        jax, jnp = self._jax, self._jnp
+
+        def prefill(params, pk, pv, tokens, true_lens, cached_lens,
+                    read_rows, write_rows):
+            # tokens: (k, bucket) suffix tokens  true_lens: (k,) suffix
+            # lengths  cached_lens: (k,) tokens already resident in
+            # shared pages  read_rows: (k, rp)  write_rows: (k, wp)
+            params = self._materialize(params)
+            positions = cached_lens[:, None] + jnp.arange(bucket)[None, :]
+            logits, nk, nv = self.module.apply(
+                {"params": params}, tokens,
+                jnp.minimum(positions, self.max_len - 1),
+                pk, pv, read_rows, cached_lens,
+            )
+            valid = jnp.arange(bucket)[None, :] < true_lens[:, None]
+            pk, pv = self._write_kv(
+                pk, pv, nk, nv, write_rows, jnp.zeros((k,), jnp.int32), valid,
+                from_zero=True,
+            )
+            last = logits[jnp.arange(k), true_lens - 1]  # (k, vocab)
+            return last, pk, pv
+
+        return self._sentinels["paged_prefill"].wrap(
+            jax.jit(prefill, donate_argnums=(1, 2)),
+            static=f"cached,bucket={bucket},k={k},rp={rp}",
         )
 
     def _sample_batch(self, logits, keys, temps, top_ks):
@@ -1737,6 +1875,11 @@ class PagedEngine:
                 status_code=400, reason="SEQUENCE_TOO_LONG",
             )
         need = -(-(plen + max_new_tokens + headroom) // self.page_size)
+        # capacity ceiling = the whole non-trash pool: LRU-cached prefix
+        # pages are RECLAIMABLE (allocation evicts them on demand), so a
+        # request is rejected only when it cannot fit even after every
+        # cached page is reclaimed — a warm cache never shrinks the
+        # admissible request size
         if need > self.num_pages - 1:
             raise MicroserviceError(
                 f"request needs {need} pages but the pool holds {self.num_pages - 1}",
@@ -1774,33 +1917,213 @@ class PagedEngine:
             self._queue.append(stream)
         return stream
 
+    # ---- refcounted page allocator + prefix cache (r9) --------------------
+
+    def _allocatable_locked(self) -> int:
+        """Pages available right now: the free list plus the LRU-cached
+        set (refcount-0 prefix pages are reclaimable on demand, so
+        capacity accounting must count them as available)."""
+        return len(self._free_pages) + len(self._lru)
+
+    def _evict_cached_locked(self) -> None:
+        """Reclaim the least-recently-used cached page: unregister it
+        from the prefix index and return it to the free list."""
+        page, entry = self._lru.popitem(last=False)  # oldest first
+        self._prefix_index.pop(entry.key, None)
+        self._page_entry.pop(page, None)
+        self._free_pages.append(page)
+        self._counters["prefix_evictions"] += 1
+
     def _alloc(self, n: int) -> Optional[List[int]]:
-        if len(self._free_pages) < n:
+        """Take ``n`` fresh pages (refcount 1 each), evicting LRU-cached
+        pages under pressure.  Stack-discipline deque: O(1) per page."""
+        if self._allocatable_locked() < n:
             return None
-        out = self._free_pages[:n]
-        del self._free_pages[:n]
+        while len(self._free_pages) < n:
+            self._evict_cached_locked()
+        out = [self._free_pages.popleft() for _ in range(n)]
+        for p in out:
+            self._page_ref[p] = 1
         return out
 
     def _free(self, pages: List[int]) -> None:
-        self._free_pages.extend(pages)
+        """Release one stream's mapping of ``pages``.  A page whose
+        refcount drops to zero either parks on the LRU cached set (it
+        is a registered prefix page — its KV stays valid and a later
+        admission can remap it) or returns to the free list.  Reversed
+        iteration inserts a stream's DEEPEST prefix pages into the LRU
+        first (oldest), so under pressure leaves evict before the
+        parents their chain lookups walk through."""
+        for p in reversed(pages):
+            r = int(self._page_ref[p]) - 1
+            self._page_ref[p] = max(r, 0)
+            if r > 0:
+                continue
+            entry = self._page_entry.get(p)
+            if entry is not None and self._prefix_cache_enabled:
+                self._lru[p] = entry  # most-recent end
+            else:
+                if entry is not None:  # registered but caching disabled
+                    self._prefix_index.pop(entry.key, None)
+                    self._page_entry.pop(p, None)
+                self._free_pages.append(p)
+
+    def _match_prefix_locked(self, prompt: np.ndarray) -> List[_CachedPrefix]:
+        """Longest cached prefix of FULL prompt pages, walked root →
+        leaf through the chain-keyed index in O(pages).  The last
+        prompt page is always private — even when the prompt is an
+        exact page multiple — so the suffix prefill always has at least
+        one token to produce the next-token logits from.  Colliding
+        keys verify token equality before sharing: a hash collision
+        degrades to a miss, never to foreign KV.  No LRU touching
+        here: the caller pops every matched refcount-0 page off the
+        LRU when it maps them (and its rollback re-inserts deepest
+        first), so the leaves-evict-before-parents ordering is
+        maintained entirely by insertion discipline."""
+        if not self._prefix_cache_enabled:
+            return []
+        ps = self.page_size
+        n_full = (len(prompt) - 1) // ps
+        matched: List[_CachedPrefix] = []
+        parent = _PREFIX_ROOT
+        for i in range(n_full):
+            toks = tuple(int(t) for t in prompt[i * ps:(i + 1) * ps])
+            key = prefix_chain_key(parent, toks)
+            entry = self._prefix_index.get(key)
+            if entry is None or entry.tokens != toks:
+                break
+            matched.append(entry)
+            parent = key
+        return matched
+
+    def _register_prefix_locked(self, stream: _Stream) -> None:
+        """Publish a prefilled stream's full prompt pages into the
+        prefix index (called once the prefill device call owning their
+        KV has been issued — later programs read the pool through the
+        threaded pages_k/pages_v arrays, so the data dependency orders
+        any shared read after this write).  Pages whose key is already
+        registered stay private: either they ARE the registered page
+        (matched at admission), a concurrent identical prompt got there
+        first (its page is canonical, ours frees normally), or the key
+        collides with different tokens (never share unverified
+        content — and stop, since lookups cannot walk past a collision
+        either)."""
+        if not self._prefix_cache_enabled or stream.slot is None:
+            return
+        if self._slots[stream.slot] is not stream:
+            # the stream lost its slot between admission and here
+            # (fail_all/close from another thread, cancel retirement):
+            # its pages are already released — nothing to publish
+            return
+        ps = self.page_size
+        prompt = stream.prompt
+        n_full = (len(prompt) - 1) // ps
+        parent = _PREFIX_ROOT
+        for i in range(n_full):
+            toks = tuple(int(t) for t in prompt[i * ps:(i + 1) * ps])
+            key = prefix_chain_key(parent, toks)
+            entry = self._prefix_index.get(key)
+            if entry is None:
+                page = stream.pages[i]
+                if page not in self._page_entry:
+                    e = _CachedPrefix(key, page, toks, parent)
+                    self._prefix_index[key] = e
+                    self._page_entry[page] = e
+            elif entry.tokens != toks:
+                break  # collision: descendants are unreachable anyway
+            parent = key
+
+    def _check_invariants_locked(self) -> None:
+        """SELDON_TPU_PAGED_DEBUG=1 audit (chunk boundaries): the
+        non-trash pages partition into free ∪ cached ∪ mapped, refcounts
+        equal the number of live block tables holding each page, and
+        every LRU entry is consistent with the prefix index."""
+        problems: List[str] = []
+        free = list(self._free_pages)
+        free_set = set(free)
+        if len(free_set) != len(free):
+            problems.append("duplicate pages on the free list")
+        cached = set(self._lru)
+        mapped: Dict[int, int] = {}
+        for s in self._slots:
+            if s is None:
+                continue
+            for i, p in enumerate(s.pages):
+                mapped[p] = mapped.get(p, 0) + 1
+                if int(self._block_tables[s.slot, i]) != p:
+                    problems.append(
+                        f"slot {s.slot} block table col {i} != stream page {p}"
+                    )
+        for a, b, name in (
+            (free_set, cached, "free∩cached"),
+            (free_set, set(mapped), "free∩mapped"),
+            (cached, set(mapped), "cached∩mapped"),
+        ):
+            if a & b:
+                problems.append(f"pages simultaneously {name}: {sorted(a & b)}")
+        every = free_set | cached | set(mapped)
+        want = set(range(1, self.num_pages))
+        if every != want:
+            problems.append(
+                f"leaked pages {sorted(want - every)} / phantom {sorted(every - want)}"
+            )
+        for p in want:
+            if int(self._page_ref[p]) != mapped.get(p, 0):
+                problems.append(
+                    f"page {p} refcount {int(self._page_ref[p])} != "
+                    f"{mapped.get(p, 0)} live mappings"
+                )
+        for p, entry in self._lru.items():
+            if entry.page != p or self._prefix_index.get(entry.key) is not entry \
+                    or self._page_entry.get(p) is not entry:
+                problems.append(f"LRU entry for page {p} inconsistent with index")
+        if problems:
+            raise RuntimeError(
+                "paged allocator invariant violation: " + "; ".join(problems)
+            )
 
     def _admit_locked(self) -> List[Tuple[_Stream, int]]:
-        """Move queued streams into free slots (FIFO); returns admissions."""
+        """Move queued streams into free slots (FIFO); returns admissions.
+
+        Prefix-cache lookup happens here: the longest chain of cached
+        full prompt pages maps into the new stream's block table with
+        ``refcount += 1`` and only the remainder allocates fresh pages —
+        prefill then runs over the uncached suffix alone.  Matched refs
+        bump BEFORE the fresh alloc so the alloc's own LRU eviction can
+        never reclaim the pages being matched; on alloc failure the
+        bumps roll back (deepest page re-parked first, preserving the
+        leaves-evict-first LRU discipline)."""
         admitted = []
         for slot in range(self.max_slots):
             if self._slots[slot] is not None or not self._queue:
                 continue
             stream = self._queue[0]
             plen = len(stream.prompt)
-            pages = self._alloc(-(-plen // self.page_size))
-            if pages is None:
+            matched = self._match_prefix_locked(stream.prompt)
+            for e in matched:
+                if int(self._page_ref[e.page]) == 0:
+                    self._lru.pop(e.page, None)
+                self._page_ref[e.page] += 1
+            fresh = self._alloc(-(-plen // self.page_size) - len(matched))
+            if fresh is None:
+                for e in reversed(matched):
+                    self._page_ref[e.page] -= 1
+                    if int(self._page_ref[e.page]) == 0:
+                        self._lru[e.page] = e
                 break  # FIFO: don't let a short request starve the head
             self._queue.pop(0)
             stream.slot = slot
-            stream.pages = pages
+            stream.pages = [e.page for e in matched] + fresh
+            stream.cached_len = len(matched) * self.page_size
+            if self._prefix_cache_enabled:
+                if matched:
+                    self._counters["prefix_hits"] += 1
+                    self._counters["prefix_tokens_saved"] += stream.cached_len
+                else:
+                    self._counters["prefix_misses"] += 1
             self._slots[slot] = stream
             row = np.zeros((self.pages_per_stream,), np.int32)
-            row[: len(pages)] = pages
+            row[: len(stream.pages)] = stream.pages
             self._block_tables[slot] = row
             self._lengths[slot] = plen
             admitted.append((stream, plen))
@@ -1825,20 +2148,85 @@ class PagedEngine:
                     slot=stream.slot,
                     queue_depth=stream.queue_depth_at_submit,
                 )
-        jnp = self._jnp
-        by_bucket: Dict[int, List[_Stream]] = {}
+        # group by the bucket covering what actually prefills: the full
+        # prompt for cache misses, only the uncached SUFFIX for streams
+        # whose leading pages matched the prefix cache — the cached-
+        # prefill skip, where a shared 256-token system prompt costs
+        # each follower a suffix-sized program instead of a full one
+        plain: Dict[int, List[_Stream]] = {}
+        cached: Dict[int, List[_Stream]] = {}
         for stream in streams:
-            plen = len(stream.prompt)
-            bucket = next(b for b in self.prompt_buckets if b >= plen)
-            by_bucket.setdefault(bucket, []).append(stream)
-        for bucket, group in by_bucket.items():
-            t_group = _time.time()
-            k = 1
-            while k < len(group):
-                k *= 2
-            key = (bucket, k)
-            if key not in self._prefill_jit:
-                self._prefill_jit[key] = self._build_prefill(bucket, k)
+            slen = len(stream.prompt) - stream.cached_len
+            bucket = next(b for b in self.prompt_buckets if b >= slen)
+            target = cached if stream.cached_len else plain
+            target.setdefault(bucket, []).append(stream)
+        for bucket, group in plain.items():
+            self._prefill_group(bucket, group, use_cache=False)
+        for bucket, group in cached.items():
+            self._prefill_group(bucket, group, use_cache=True)
+        if self._prefix_cache_enabled and streams:
+            # publish the full prompt pages for reuse: the device calls
+            # that write their KV have been issued, and any later shared
+            # read is ordered after them by the threaded pool arrays
+            with self._lock:
+                for stream in streams:
+                    self._register_prefix_locked(stream)
+        if streams:
+            with self._lock:
+                self._counters["prefill_wall_s"] += _time.perf_counter() - t_start
+
+    def _prefill_group(
+        self, bucket: int, group: List[_Stream], use_cache: bool
+    ) -> None:
+        """One batched prefill device call for ``group`` (all same
+        bucket; ``use_cache`` selects the suffix program attending over
+        shared prefix pages vs the classic from-zero program, which
+        stays byte-identical to the pre-cache engine so the cache-off
+        lane keeps its compiled shapes)."""
+        import time as _time
+
+        jnp = self._jnp
+        t_group = _time.time()
+        k = 1
+        while k < len(group):
+            k *= 2
+        if use_cache:
+            ps = self.page_size
+            rp = self._pages_pow2(max(s.cached_len // ps for s in group))
+            wp = -(-bucket // ps)
+            key3 = (bucket, k, rp)
+            if key3 not in self._prefill_cached_jit:
+                self._prefill_cached_jit[key3] = self._build_prefill_cached(
+                    bucket, k, rp
+                )
+            padded = np.zeros((k, bucket), np.int32)
+            true_lens = np.ones((k,), np.int32)  # pad rows: 1 token -> trash
+            cached_lens = np.zeros((k,), np.int32)
+            read_rows = np.zeros((k, rp), np.int32)
+            write_rows = np.zeros((k, wp), np.int32)
+            for i, stream in enumerate(group):
+                cl = stream.cached_len
+                suffix = stream.prompt[cl:]
+                padded[i, : len(suffix)] = suffix
+                true_lens[i] = len(suffix)
+                cached_lens[i] = cl
+                read_rows[i] = self._block_tables[stream.slot, :rp]
+                # shifted write table: suffix block j lands in the page
+                # AFTER the cached prefix (cl is page-aligned, so every
+                # write starts at offset 0 — the from_zero fast path)
+                cp = cl // ps
+                row = self._block_tables[stream.slot, cp : cp + wp]
+                write_rows[i, : len(row)] = row
+            last, self.pages_k, self.pages_v = self._prefill_cached_jit[key3](
+                self.params, self.pages_k, self.pages_v,
+                jnp.asarray(padded), jnp.asarray(true_lens),
+                jnp.asarray(cached_lens), jnp.asarray(read_rows),
+                jnp.asarray(write_rows),
+            )
+        else:
+            key2 = (bucket, k)
+            if key2 not in self._prefill_jit:
+                self._prefill_jit[key2] = self._build_prefill(bucket, k)
             # slice block rows to the bucket's page span: prefill reads
             # no cache (lengths 0) and writes at most `bucket` tokens,
             # so gathering the full worst-case table would be pure
@@ -1852,52 +2240,50 @@ class PagedEngine:
                 padded[i, :plen] = stream.prompt
                 true_lens[i] = plen
                 block_rows[i] = self._block_tables[stream.slot, :pages_h]
-            last, self.pages_k, self.pages_v = self._prefill_jit[key](
+            last, self.pages_k, self.pages_v = self._prefill_jit[key2](
                 self.params, self.pages_k, self.pages_v,
                 jnp.asarray(padded), jnp.asarray(true_lens),
                 jnp.asarray(block_rows),
             )
-            g = len(group)
-            # batched tail: per-stream .at[].set / key() calls are tiny
-            # device dispatches, and ~3 per stream serialised through a
-            # relayed dispatch stream measured as a large share of
-            # admission wall time at 16 joiners.  Three dispatches total
-            # instead: one fixed-shape key derivation, two scatters.
-            slots = jnp.asarray(np.array([s.slot for s in group], np.int32))
-            # deterministic per submit(seed=...): same seed -> same
-            # sample path (per-request variation is the component
-            # layer's job, as in GenerativeLM's puid/counter folding).
-            # Seeds fold into [0, 2^63) — same key for any practical
-            # seed (component layers derive seeds well below 2^63)
-            seeds = np.zeros((self.max_slots,), np.uint64)
+        g = len(group)
+        # batched tail: per-stream .at[].set / key() calls are tiny
+        # device dispatches, and ~3 per stream serialised through a
+        # relayed dispatch stream measured as a large share of
+        # admission wall time at 16 joiners.  Three dispatches total
+        # instead: one fixed-shape key derivation, two scatters.
+        slots = jnp.asarray(np.array([s.slot for s in group], np.int32))
+        # deterministic per submit(seed=...): same seed -> same
+        # sample path (per-request variation is the component
+        # layer's job, as in GenerativeLM's puid/counter folding).
+        # Seeds fold into [0, 2^63) — same key for any practical
+        # seed (component layers derive seeds well below 2^63)
+        seeds = np.zeros((self.max_slots,), np.uint64)
+        for i, stream in enumerate(group):
+            seeds[i] = stream.seed % (1 << 63)
+        all_keys = self._derive_keys(jnp.asarray(seeds))
+        self._keys = self._keys.at[slots].set(all_keys[:g])
+        self._logits = self._logits.at[slots].set(last[:g])
+        if self.speculative is not None:
+            # host decides the next greedy token between verify
+            # rounds — ONE blocking readback for the whole group
+            pending = np.asarray(jnp.argmax(last[:g], axis=-1))
             for i, stream in enumerate(group):
-                seeds[i] = stream.seed % (1 << 63)
-            all_keys = self._derive_keys(jnp.asarray(seeds))
-            self._keys = self._keys.at[slots].set(all_keys[:g])
-            self._logits = self._logits.at[slots].set(last[:g])
-            if self.speculative is not None:
-                # host decides the next greedy token between verify
-                # rounds — ONE blocking readback for the whole group
-                pending = np.asarray(jnp.argmax(last[:g], axis=-1))
-                for i, stream in enumerate(group):
-                    stream.pending = int(pending[i])
-            t_done = _time.time()
-            for stream in group:
-                stream.t_decode_start = t_done
-                if stream.trace_id:
-                    # the group prefills in ONE device call, so every
-                    # member's span carries the group wall (tagged with
-                    # the group size so a reader knows it is shared)
-                    self._gen_span(
-                        stream, "gen.prefill", t_group, t_done - t_group,
-                        slot=stream.slot, bucket=bucket,
-                        prompt_len=len(stream.prompt),
-                        pages_held=len(stream.pages),
-                        group_size=len(group),
-                    )
-        if streams:
-            with self._lock:
-                self._counters["prefill_wall_s"] += _time.perf_counter() - t_start
+                stream.pending = int(pending[i])
+        t_done = _time.time()
+        for stream in group:
+            stream.t_decode_start = t_done
+            if stream.trace_id:
+                # the group prefills in ONE device call, so every
+                # member's span carries the group wall (tagged with
+                # the group size so a reader knows it is shared)
+                self._gen_span(
+                    stream, "gen.prefill", t_group, t_done - t_group,
+                    slot=stream.slot, bucket=bucket,
+                    prompt_len=len(stream.prompt),
+                    cached_tokens=stream.cached_len,
+                    pages_held=len(stream.pages),
+                    group_size=len(group),
+                )
 
     def _ensure_pages_locked(self, stream: _Stream, per_chunk: Optional[int] = None) -> bool:
         """Grow the stream's block table to cover the next chunk."""
@@ -2005,6 +2391,7 @@ class PagedEngine:
         stream.pages = []
         stream.tokens = []
         stream.slot = None
+        stream.cached_len = 0  # re-admission re-matches the prefix index
         self._lengths[slot] = 0
         self._counters["evictions"] += 1
         self._queue.insert(0, stream)
@@ -2062,8 +2449,13 @@ class PagedEngine:
                 **self._counters,
                 "active_slots": sum(s is not None for s in self._slots),
                 "queued_streams": len(self._queue),
-                "pool_pages_used": self.num_pages - 1 - len(self._free_pages),
+                # mapped pages only: LRU-cached pages are reclaimable
+                # capacity, reported under their own gauge below
+                "pool_pages_used": (
+                    self.num_pages - 1 - len(self._free_pages) - len(self._lru)
+                ),
                 "pool_pages_total": self.num_pages - 1,
+                "prefix_pages_cached": len(self._lru),
                 # distinct compiled signatures seen by the jit sentinels
                 # (prometheus gets the per-program split directly from
                 # jitwatch — bridge-excluded to avoid double export)
@@ -2126,6 +2518,10 @@ class PagedEngine:
     def _step_decode(self) -> bool:
         jnp = self._jnp
         with self._lock:
+            # pre-admission prefix counters: the chunk record carries
+            # this wave's hit/saved deltas (flight-recorder contract)
+            pre_hits = self._counters["prefix_hits"]
+            pre_saved = self._counters["prefix_tokens_saved"]
             admitted = self._admit_locked()
         self._prefill_streams([s for s, _ in admitted])
 
@@ -2147,7 +2543,7 @@ class PagedEngine:
             steps = self.steps_per_call
             if not self._queue:
                 most = max(s.max_new - len(s.tokens) for s in active)
-                free = len(self._free_pages)
+                free = self._allocatable_locked()  # LRU-cached pages reclaim on demand
                 while steps * 2 <= self.max_steps and steps < most:
                     nxt = steps * 2
                     need = 0
@@ -2245,8 +2641,13 @@ class PagedEngine:
                     self._finish_locked(stream)
                 else:
                     self._stream_push(stream)
+            if self._debug_invariants:  # chunk-boundary allocator audit
+                self._check_invariants_locked()
             more = bool(self._queue) or any(s is not None for s in self._slots)
             queue_depth = len(self._queue)
+            prefix_hits_d = self._counters["prefix_hits"] - pre_hits
+            prefix_saved_d = self._counters["prefix_tokens_saved"] - pre_saved
+            pages_cached = len(self._lru)
         self._record_chunk({
             "phase": "decode",
             "wall_ms": round(chunk_wall * 1000.0, 3),
@@ -2257,6 +2658,9 @@ class PagedEngine:
             "stalls": int(stalled.sum()),
             "queue_depth": queue_depth,
             "tokens": chunk_tokens,
+            "prefix_hits": prefix_hits_d,
+            "prefix_tokens_saved": prefix_saved_d,
+            "prefix_pages_cached": pages_cached,
         })
         return more
 
@@ -2272,6 +2676,8 @@ class PagedEngine:
 
         jnp = self._jnp
         with self._lock:
+            pre_hits = self._counters["prefix_hits"]
+            pre_saved = self._counters["prefix_tokens_saved"]
             admitted = self._admit_locked()
         self._prefill_streams([s for s, _ in admitted])
 
@@ -2401,8 +2807,13 @@ class PagedEngine:
                     self._finish_locked(stream)
                 else:
                     self._stream_push(stream)
+            if self._debug_invariants:  # chunk-boundary allocator audit
+                self._check_invariants_locked()
             more = bool(self._queue) or any(s is not None for s in self._slots)
             queue_depth = len(self._queue)
+            prefix_hits_d = self._counters["prefix_hits"] - pre_hits
+            prefix_saved_d = self._counters["prefix_tokens_saved"] - pre_saved
+            pages_cached = len(self._lru)
         self._record_chunk({
             "phase": "spec_verify",
             "wall_ms": round(chunk_wall * 1000.0, 3),
@@ -2413,6 +2824,9 @@ class PagedEngine:
             "stalls": int(stalled.sum()),
             "queue_depth": queue_depth,
             "tokens": chunk_tokens,
+            "prefix_hits": prefix_hits_d,
+            "prefix_tokens_saved": prefix_saved_d,
+            "prefix_pages_cached": pages_cached,
         })
         return more
 
@@ -2473,6 +2887,7 @@ class StreamingLM(TPUComponent):
         quantize: str = "",
         precision: str = "",
         speculative: Optional[Dict[str, Any]] = None,
+        prefix_cache: Optional[bool] = None,
         **kwargs: Any,
     ):
         super().__init__(**kwargs)
@@ -2496,6 +2911,9 @@ class StreamingLM(TPUComponent):
             # per-slot draft/verify INSIDE the continuous-batching
             # engine — greedy-exact, one verify forward per chunk
             speculative=dict(speculative) if speculative else None,
+            # page-granular automatic prefix caching: None defers to
+            # SELDON_TPU_PREFIX_CACHE (default on; "0" disables)
+            prefix_cache=prefix_cache,
         )
         self.mesh_axes = dict(mesh_axes) if mesh_axes else None
         self.max_new_tokens = int(max_new_tokens)
@@ -2729,6 +3147,13 @@ class StreamingLM(TPUComponent):
             {"type": "GAUGE", "key": "paged_chunks", "value": s["chunks"]},
             {"type": "GAUGE", "key": "paged_tokens_emitted", "value": s["tokens"]},
             {"type": "GAUGE", "key": "paged_streams_completed", "value": s["completed"]},
+            {"type": "GAUGE", "key": "paged_prefix_hit_rate",
+             "value": s["prefix_hits"]
+             / max(1, s["prefix_hits"] + s["prefix_misses"])},
+            {"type": "GAUGE", "key": "paged_prefix_pages_cached",
+             "value": s["prefix_pages_cached"]},
+            {"type": "GAUGE", "key": "paged_prefix_tokens_saved",
+             "value": s["prefix_tokens_saved"]},
         ] + (
             [
                 {"type": "GAUGE", "key": "speculative_acceptance_rate",

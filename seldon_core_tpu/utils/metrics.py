@@ -267,6 +267,17 @@ ENGINE_STATS_METRICS: Dict[str, Tuple[str, str, str]] = {
                      "speculative tokens drafted"),
     "spec_accepted": ("counter", "seldon_tpu_engine_spec_accepted_total",
                       "speculative tokens accepted by verify"),
+    "prefix_hits": ("counter", "seldon_tpu_engine_prefix_cache_hits_total",
+                    "admissions that mapped >=1 cached prefix page"),
+    "prefix_misses": ("counter", "seldon_tpu_engine_prefix_cache_misses_total",
+                      "admissions with no cached prefix to reuse"),
+    "prefix_evictions": ("counter",
+                         "seldon_tpu_engine_prefix_cache_evictions_total",
+                         "LRU-cached prefix pages reclaimed under pool pressure"),
+    "prefix_tokens_saved": ("counter",
+                            "seldon_tpu_engine_prefix_cache_tokens_saved_total",
+                            "prompt tokens whose prefill was skipped via "
+                            "cached prefix pages"),
     "active_slots": ("gauge", "seldon_tpu_engine_slot_occupancy",
                      "slots holding a live stream"),
     "queued_streams": ("gauge", "seldon_tpu_engine_queue_depth",
@@ -275,6 +286,10 @@ ENGINE_STATS_METRICS: Dict[str, Tuple[str, str, str]] = {
                         "KV pool pages in use"),
     "pool_pages_total": ("gauge", "seldon_tpu_engine_pool_pages_total",
                          "KV pool pages available"),
+    "prefix_pages_cached": ("gauge",
+                            "seldon_tpu_engine_prefix_cache_pages_cached",
+                            "pages parked on the LRU prefix cache "
+                            "(refcount 0, reclaimable on demand)"),
 }
 
 # keys intentionally NOT exported as their own series: the wall-clock

@@ -136,6 +136,12 @@ def _r3_like_full_result():
                 "obs_overhead_pct": 0.84,
                 "obs_on_tokens_per_s": 4363.0,
                 "obs_off_tokens_per_s": 4400.0,
+                "prefix_shared_tokens_per_s": 7300.0,
+                "prefix_off_tokens_per_s": 4400.0,
+                "prefix_speedup_x": 1.66,
+                "prefix_hit_pct": 100.0,
+                "prefix_tokens_saved": 12288,
+                "prefix_shared_mix": "16 streams, 256-token shared system prompt + distinct suffixes, 64 new tokens each",
             },
             "trace_prop": {
                 "trace_on_tok_s": 4360.0,
@@ -244,6 +250,46 @@ def test_compact_line_carries_trace_prop_overhead(bench):
     assert e["trace_prop_overhead_pct"] == 1.8
     assert "trace_on_tok_s" not in e
     assert "protocol" not in e
+
+
+def test_compact_line_carries_prefix_cache_story(bench):
+    """r9 certification keys: the shared-system-prompt workload's
+    throughput with automatic prefix caching on (gate: >=1.3x the
+    cache-off arm) and its admission hit rate; the cache-off rate and
+    the speedup ratio stay in bench_full.json."""
+    compact = bench._compact_result(_r3_like_full_result())
+    e = compact["extra"]
+    assert isinstance(e["prefix_shared_tok_s"], float)
+    assert e["prefix_shared_tok_s"] == 7300.0
+    assert isinstance(e["prefix_hit_pct"], float)
+    assert e["prefix_hit_pct"] == 100.0
+    # raw contrast arm + ratio are full-blob-only
+    assert "prefix_off_tokens_per_s" not in e
+    assert "prefix_speedup_x" not in e
+    assert "prefix_shared_mix" not in e
+
+
+def test_prefix_capacity_accounting_reclaimable():
+    """LRU-cached prefix pages never shrink admissible capacity: they
+    price as reclaimable_bytes, not peak_bytes."""
+    from seldon_core_tpu.models.paged import (
+        paged_capacity_streams,
+        paged_hbm_accounting,
+    )
+
+    kw = dict(d_model=512, num_layers=8, page_size=64, steps_per_call=8,
+              dtype_bytes=2, flat_pool=True, chunk_impl="ring")
+    cold = paged_hbm_accounting(streams=1, ctx_len=512, **kw)
+    warm = paged_hbm_accounting(
+        streams=1, ctx_len=512, cached_prefix_pages=64, **kw
+    )
+    assert warm["peak_bytes"] == cold["peak_bytes"]
+    assert warm["reclaimable_bytes"] == 64 * 64 * (512 * 2 * 2 * 8)
+    assert cold["reclaimable_bytes"] == 0
+    budget = 8 << 30
+    assert paged_capacity_streams(budget, 512, **kw) == paged_capacity_streams(
+        budget, 512, cached_prefix_pages=64, **kw
+    )
 
 
 def test_capacity_accounting_donated_vs_copied():
