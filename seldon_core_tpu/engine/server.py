@@ -279,16 +279,27 @@ def build_gateway_app(gateway: Gateway, auth=None) -> web.Application:
         app = web.Application(client_max_size=1024 * 1024 * 512)
 
     async def predictions(request: web.Request) -> web.Response:
-        from seldon_core_tpu.runtime.rest import _remote_ctx
+        from seldon_core_tpu.runtime.rest import _remote_ctx, _remote_deadline_ms
+        from seldon_core_tpu.utils import deadlines as _deadlines
         from seldon_core_tpu.utils.tracing import activate_context
 
         try:
             body = await _request_body(request)
             msg = InternalMessage.from_json(body)
+            # SLO ingress: X-Seldon-Deadline-Ms mints the end-to-end
+            # budget (carried by contextvar through every hop below);
+            # X-Seldon-Priority lands in meta.tags so the generation
+            # engine's admission/shedding sees it.  An explicit tag in
+            # the body wins over the header.
+            prio = _deadlines.extract_priority(request.headers)
+            if prio is not None and "priority" not in msg.meta.tags:
+                msg.meta.tags["priority"] = prio
             # an external caller's traceparent makes the gateway's
             # predictor.predict span a child of ITS trace — the whole
             # graph then stitches under the caller's root
-            with activate_context(_remote_ctx(request)):
+            with activate_context(_remote_ctx(request)), \
+                    _deadlines.activate_ms(_remote_deadline_ms(request)):
+                _deadlines.check("gateway ingress /api/v0.1/predictions")
                 out = await gateway.predict(msg, predictor=request.query.get("predictor"))
             return web.json_response(out.to_json(), status=_http_status(out))
         except Exception as e:  # noqa: BLE001
@@ -335,6 +346,25 @@ def build_gateway_app(gateway: Gateway, auth=None) -> web.Application:
                 status=501,
             )
         meta = {"tags": dict(msg.meta.tags), "puid": msg.meta.puid}
+        # the streaming generator runs on plain executor threads (no
+        # contextvar copy), so the SLO headers ride meta.tags instead
+        # of the ambient budget (tags in the body win).  The expiry is
+        # minted ABSOLUTE here, at ingress: a relative deadline_ms tag
+        # re-minted at submit would silently refund the executor
+        # queueing time (this lane calls the local model in-process,
+        # so a monotonic timestamp is a valid carrier)
+        import time as _mono_time
+
+        from seldon_core_tpu.utils import deadlines as _deadlines
+
+        sse_ms = _deadlines.extract_ms(request.headers)
+        if sse_ms is not None:
+            meta["tags"].setdefault(
+                "deadline_at_monotonic", _mono_time.monotonic() + sse_ms / 1000.0
+            )
+        sse_prio = _deadlines.extract_priority(request.headers)
+        if sse_prio is not None:
+            meta["tags"].setdefault("priority", sse_prio)
         loop = _asyncio.get_running_loop()
         sentinel = object()
         # pull the FIRST chunk before sending headers: bad prompts /
@@ -507,12 +537,24 @@ def add_seldon_service(server: grpc.aio.Server, gateway: Gateway, auth=None) -> 
 
     async def predict(request: pb.SeldonMessage, context) -> pb.SeldonMessage:
         await check_auth(context)
-        from seldon_core_tpu.runtime.grpc_server import _grpc_remote_ctx
+        from seldon_core_tpu.runtime.grpc_server import (
+            _grpc_deadline_ms,
+            _grpc_remote_ctx,
+        )
+        from seldon_core_tpu.utils import deadlines as _deadlines
         from seldon_core_tpu.utils.tracing import activate_context
 
         msg = InternalMessage.from_proto(request)
-        with activate_context(_grpc_remote_ctx(context)):
-            out = await gateway.predict(msg)
+        prio = _deadlines.extract_priority(context.invocation_metadata() or ())
+        if prio is not None and "priority" not in msg.meta.tags:
+            msg.meta.tags["priority"] = prio
+        try:
+            with activate_context(_grpc_remote_ctx(context)), \
+                    _deadlines.activate_ms(_grpc_deadline_ms(context)):
+                _deadlines.check("gateway grpc ingress Seldon/Predict")
+                out = await gateway.predict(msg)
+        except MicroserviceError as e:  # ingress fast-fail (DEADLINE_EXCEEDED)
+            out = failure_message(e, msg.meta.puid)
         return out.to_proto()
 
     async def send_feedback(request: pb.Feedback, context) -> pb.SeldonMessage:

@@ -52,10 +52,19 @@ class SyncSeldonService:
 
     def predict(self, request: pb.SeldonMessage, context) -> pb.SeldonMessage:
         self._check_auth(context)
-        from seldon_core_tpu.runtime.grpc_server import _grpc_remote_ctx
+        from seldon_core_tpu.engine.service import failure_message
+        from seldon_core_tpu.runtime.component import MicroserviceError
+        from seldon_core_tpu.runtime.grpc_server import (
+            _grpc_deadline_ms,
+            _grpc_remote_ctx,
+        )
+        from seldon_core_tpu.utils import deadlines as _deadlines
         from seldon_core_tpu.utils.tracing import activate_context
 
         msg = InternalMessage.from_proto(request)
+        prio = _deadlines.extract_priority(context.invocation_metadata() or ())
+        if prio is not None and "priority" not in msg.meta.tags:
+            msg.meta.tags["priority"] = prio
         svc = self.gateway.pick()
         for shadow in self.gateway.shadows:
             # isolated copy: primary and shadow both mutate meta
@@ -63,17 +72,28 @@ class SyncSeldonService:
         # extraction happens on the handler thread; the bridged lane
         # re-activates INSIDE the coroutine because
         # run_coroutine_threadsafe does not carry the submitting
-        # thread's contextvars into the loop task
+        # thread's contextvars into the loop task (the deadline budget
+        # rides the same re-activation)
         ctx = _grpc_remote_ctx(context)
-        if svc.single_local_model() is not None:
-            with activate_context(ctx):
-                out = svc.predict_sync(msg)
-        else:
-            async def _predict_with_ctx():
-                with activate_context(ctx):
-                    return await svc.predict(msg)
+        budget_ms = _grpc_deadline_ms(context)
+        # mint the ABSOLUTE expiry here, once: the bridged lane crosses
+        # a thread hand-off, and re-minting from a duration there would
+        # silently refund the queueing time
+        budget = _deadlines.Deadline.after_ms(budget_ms) if budget_ms is not None else None
+        try:
+            if svc.single_local_model() is not None:
+                with activate_context(ctx), _deadlines.activate(budget):
+                    _deadlines.check("gateway grpc ingress Seldon/Predict")
+                    out = svc.predict_sync(msg)
+            else:
+                async def _predict_with_ctx():
+                    with activate_context(ctx), _deadlines.activate(budget):
+                        _deadlines.check("gateway grpc ingress Seldon/Predict")
+                        return await svc.predict(msg)
 
-            out = self._bridge(_predict_with_ctx())
+                out = self._bridge(_predict_with_ctx())
+        except MicroserviceError as e:  # ingress fast-fail (DEADLINE_EXCEEDED)
+            out = failure_message(e, msg.meta.puid)
         return self.gateway.finalize_response(out, msg, svc).to_proto()
 
     def send_feedback(self, request: pb.Feedback, context) -> pb.SeldonMessage:
@@ -105,6 +125,22 @@ class SyncSeldonService:
                 "component implements predict_stream (e.g. STREAMING_LM)",
             )
         meta = {"tags": dict(msg.meta.tags), "puid": msg.meta.puid}
+        import time as _mono_time
+
+        from seldon_core_tpu.utils import deadlines as _deadlines
+
+        md = context.invocation_metadata() or ()
+        # absolute expiry minted AT ingress (in-process lane, monotonic
+        # is a valid carrier): a relative tag re-minted at submit would
+        # refund the hand-off/queueing time
+        stream_ms = _deadlines.extract_ms(md)
+        if stream_ms is not None:
+            meta["tags"].setdefault(
+                "deadline_at_monotonic", _mono_time.monotonic() + stream_ms / 1000.0
+            )
+        stream_prio = _deadlines.extract_priority(md)
+        if stream_prio is not None:
+            meta["tags"].setdefault("priority", stream_prio)
         it = gen_fn(msg.array(), [], meta=meta)
         try:
             for chunk in it:

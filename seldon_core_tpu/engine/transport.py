@@ -17,6 +17,16 @@ Three client kinds behind one async interface:
   and bounded retries (reference: InternalPredictionService.java:80-98,
   439-467).
 
+Every client is a deadline hop: the ambient end-to-end budget
+(utils/deadlines contextvar, minted at ingress from
+``X-Seldon-Deadline-Ms`` / gRPC metadata / the native gRPC deadline)
+fast-fails the call with ``DEADLINE_EXCEEDED`` *before* dispatch when
+it is already spent — naming the exhausted hop — and the REMAINING
+budget is re-injected downstream (REST header, gRPC metadata, and the
+native gRPC ``timeout`` clamped to it), the per-hop decrement the
+reference applies to its internal timeouts
+(reference: InternalPredictionService.java:80-98).
+
 Every client is a tracing hop: the current span's W3C context is
 injected on the way out (REST headers, gRPC metadata, and
 ``InternalMessage.meta.trace_context`` for the local/native lanes), so
@@ -51,6 +61,8 @@ from seldon_core_tpu.engine.graph import (
 from seldon_core_tpu.runtime import dispatch
 from seldon_core_tpu.runtime.component import MicroserviceError
 from seldon_core_tpu.runtime.message import InternalFeedback, InternalMessage
+from seldon_core_tpu.utils import deadlines as _deadlines
+from seldon_core_tpu.utils import faults as _faults
 from seldon_core_tpu.utils import metrics as _metrics
 from seldon_core_tpu.utils import tracing as _tracing
 
@@ -171,6 +183,9 @@ class LocalClient(NodeClient):
             _tracing.inject(meta.trace_context)
 
     async def _invoke(self, method: str, factory: Callable[[], Any]):
+        # spent budget: fail before dispatch — the model must never see
+        # a request its caller has already abandoned
+        _deadlines.check(f"node {self.unit.name!r} {method} (local)")
         hop = _Hop(self.unit.name, method, "local")
         ok = False
         try:
@@ -331,24 +346,45 @@ class GrpcClient(NodeClient):
         service, rpc, _ = _METHOD_TO_SERVICE[method]
         if service_override:
             service = service_override
+        _deadlines.check(f"node {self.unit.name!r} {method} (grpc {self.addr})")
         hop = _Hop(self.unit.name, method, "grpc")
         ok = False
         try:
             with hop.codec():
                 request_proto = build()
                 hop.request_bytes = request_proto.ByteSize()
-            metadata = _tracing.inject_metadata()
+            base_metadata = _tracing.inject_metadata()
             attempts: List[Dict[str, Any]] = []
             last: Optional[Exception] = None
             budget = self.retries if idempotent else 1
             for attempt in range(budget):
                 if attempt:
                     hop.retries += 1
+                    # retries respect the end-to-end budget too: a dead
+                    # upstream must not eat the caller's whole deadline
+                    _deadlines.check(
+                        f"node {self.unit.name!r} {method} retry "
+                        f"{attempt + 1} (grpc {self.addr})"
+                    )
+                # re-inject PER ATTEMPT: the remaining budget shrank by
+                # whatever the failed attempt burned — resending the
+                # pre-attempt value would refund it downstream
+                metadata = _deadlines.inject_metadata(list(base_metadata))
                 callable_ = services.unary_callable(self._channel(), service, rpc)
+                # native gRPC deadline clamped to the remaining
+                # end-to-end budget: the hop decrement on the wire
+                timeout_s = self.deadline_s
+                ambient = _deadlines.current_deadline()
+                if ambient is not None:
+                    timeout_s = max(0.001, min(timeout_s, ambient.remaining_s()))
                 t_attempt = time.perf_counter()
                 try:
+                    delay = _faults.delay_s("transport.delay")
+                    if delay:
+                        await asyncio.sleep(delay)
+                    _faults.raise_if("transport.drop")
                     resp = await callable_(
-                        request_proto, timeout=self.deadline_s, metadata=metadata
+                        request_proto, timeout=timeout_s, metadata=metadata
                     )
                     hop.response_bytes = resp.ByteSize()
                     with hop.codec():
@@ -437,8 +473,21 @@ class GrpcClient(NodeClient):
         cls._channels.clear()
 
 
+# HTTP statuses worth another attempt within the call budget: the
+# upstream is overloaded or mid-restart, not wrong (the reference's
+# RestTemplate retries the same class of faults,
+# reference: InternalPredictionService.java:80-98).  Everything else —
+# 4xx, plain 500 — would fail identically on every attempt.
+_REST_RETRYABLE_STATUSES = (502, 503, 504)
+
+
 class RestClient(NodeClient):
-    """Remote node over REST/JSON with retries."""
+    """Remote node over REST/JSON with bounded retries on transient
+    faults, matching ``GrpcClient``'s semantics: exponential backoff,
+    the FULL per-attempt history (status + elapsed per attempt) on
+    ``MicroserviceError.attempts`` and in the message, retries metered
+    into the hop telemetry, and ``send_feedback`` exempt (non-idempotent
+    — a timeout after the reward was applied must not replay it)."""
 
     def __init__(
         self,
@@ -453,7 +502,7 @@ class RestClient(NodeClient):
         self.base = f"http://{unit.endpoint.host}:{unit.endpoint.port}"
         self.connect_timeout_s = connect_timeout_s
         self.read_timeout_s = read_timeout_s
-        self.retries = retries
+        self.retries = max(1, int(retries))
         self._session = None
 
     def _get_session(self):
@@ -467,20 +516,40 @@ class RestClient(NodeClient):
         return self._session
 
     async def _post(
-        self, path: str, method: str, encode: Callable[[], Dict[str, Any]]
+        self,
+        path: str,
+        method: str,
+        encode: Callable[[], Dict[str, Any]],
+        idempotent: bool = True,
     ) -> InternalMessage:
+        _deadlines.check(f"node {self.unit.name!r} {method} (rest {self.base})")
         hop = _Hop(self.unit.name, method, "rest")
         ok = False
         try:
             with hop.codec():
                 data = json.dumps(encode()).encode()
                 hop.request_bytes = len(data)
-            headers = _tracing.inject({"Content-Type": "application/json"})
+            base_headers = _tracing.inject({"Content-Type": "application/json"})
+            attempts: List[Dict[str, Any]] = []
             last_err: Optional[Exception] = None
-            for attempt in range(self.retries):
+            budget = self.retries if idempotent else 1
+            for attempt in range(budget):
                 if attempt:
                     hop.retries += 1
+                    _deadlines.check(
+                        f"node {self.unit.name!r} {method} retry "
+                        f"{attempt + 1} (rest {self.base})"
+                    )
+                # re-inject PER ATTEMPT: the remaining budget shrank by
+                # whatever the failed attempt burned — resending the
+                # pre-attempt value would refund it downstream
+                headers = _deadlines.inject(dict(base_headers))
+                t_attempt = time.perf_counter()
                 try:
+                    delay = _faults.delay_s("transport.delay")
+                    if delay:
+                        await asyncio.sleep(delay)
+                    _faults.raise_if("transport.drop")
                     session = self._get_session()
                     async with session.post(
                         self.base + path, data=data, headers=headers
@@ -490,26 +559,63 @@ class RestClient(NodeClient):
                         with hop.codec():
                             payload = json.loads(raw)
                         if resp.status >= 400:
-                            raise MicroserviceError(
-                                f"REST call {path} to {self.base} returned {resp.status}: {payload}",
+                            attempts.append({
+                                "attempt": attempt + 1,
+                                "status": str(resp.status),
+                                "elapsed_ms": round(
+                                    (time.perf_counter() - t_attempt) * 1000.0, 3
+                                ),
+                            })
+                            err = MicroserviceError(
+                                f"REST call {path} to {self.base} returned "
+                                f"{resp.status}: {payload} "
+                                f"(attempts: {json.dumps(attempts)})",
                                 status_code=502,
                                 reason="UPSTREAM_REST_ERROR",
                             )
+                            if (
+                                resp.status in _REST_RETRYABLE_STATUSES
+                                and attempt + 1 < budget
+                            ):
+                                last_err = err
+                                logger.warning(
+                                    "REST %s to %s attempt %d/%d got %d, retrying",
+                                    path, self.base, attempt + 1, budget, resp.status,
+                                )
+                                await asyncio.sleep(0.05 * (2 ** attempt))
+                                continue
+                            err.attempts = attempts
+                            raise err
                         with hop.codec():
                             out = InternalMessage.from_json(payload)
                         ok = True
                         return out
                 except MicroserviceError:
                     raise
-                except Exception as e:
+                except Exception as e:  # connection faults: transient by class
                     last_err = e
-                    logger.warning("REST %s attempt %d/%d failed: %s", path, attempt + 1, self.retries, e)
-                    await asyncio.sleep(0.05 * (attempt + 1))
-            raise MicroserviceError(
-                f"REST call {path} to {self.base} failed after {self.retries} tries: {last_err}",
+                    attempts.append({
+                        "attempt": attempt + 1,
+                        "status": type(e).__name__,
+                        "elapsed_ms": round(
+                            (time.perf_counter() - t_attempt) * 1000.0, 3
+                        ),
+                    })
+                    if attempt + 1 >= budget:
+                        break
+                    logger.warning(
+                        "REST %s to %s attempt %d/%d failed: %s",
+                        path, self.base, attempt + 1, budget, e,
+                    )
+                    await asyncio.sleep(0.05 * (2 ** attempt))
+            err = MicroserviceError(
+                f"REST call {path} to {self.base} failed: {last_err} "
+                f"(attempts: {json.dumps(attempts)})",
                 status_code=502,
                 reason="UPSTREAM_REST_ERROR",
             )
+            err.attempts = attempts  # machine-readable per-attempt history
+            raise err from last_err
         finally:
             hop.finish(error=not ok)
 
@@ -531,7 +637,12 @@ class RestClient(NodeClient):
         return await self._post("/aggregate", "aggregate", encode)
 
     async def send_feedback(self, feedback: InternalFeedback) -> InternalMessage:
-        return await self._post("/send-feedback", "send_feedback", feedback.to_json)
+        # not idempotent: a timeout after the reward was applied must
+        # not replay it (same rule as GrpcClient / BalancedClient)
+        return await self._post(
+            "/send-feedback", "send_feedback", feedback.to_json,
+            idempotent=False,
+        )
 
     async def ready(self) -> bool:
         try:

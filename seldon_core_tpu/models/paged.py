@@ -80,6 +80,8 @@ def paged_kernel_static_eligible(mode: str, mesh_absent: bool, dtype) -> bool:
 
 from seldon_core_tpu.models.generate import _buckets_for
 from seldon_core_tpu.runtime.component import MicroserviceError, TPUComponent
+from seldon_core_tpu.utils import faults as _faults
+from seldon_core_tpu.utils.deadlines import deadline_exceeded
 
 
 # ---------------------------------------------------------------------------
@@ -711,6 +713,11 @@ class _CachedPrefix:
         self.parent = parent
 
 
+# SLO lifecycle counters threaded engine_stats -> flight-recorder chunk
+# records (per-wave deltas) -> GenerationPrometheusBridge -> dashboards
+_SLO_COUNTER_KEYS = ("shed", "expired", "preempted", "restored")
+
+
 class _Stream:
     """One in-flight generation request bound to a slot."""
 
@@ -719,7 +726,8 @@ class _Stream:
         "seed", "tokens", "event", "result", "error", "slot", "pages",
         "pending", "draft_hint", "token_queue", "streamed", "cancelled",
         "trace_id", "parent_span_id", "t_submit", "t_decode_start",
-        "queue_depth_at_submit", "cached_len",
+        "queue_depth_at_submit", "cached_len", "priority", "deadline",
+        "preempted",
     )
 
     def __init__(self, req_id, prompt, max_new, temperature, top_k, eos_id, seed):
@@ -761,6 +769,13 @@ class _Stream:
         self.t_submit = 0.0
         self.t_decode_start = 0.0
         self.queue_depth_at_submit = 0
+        # SLO lifecycle (r10): admission/shedding order (higher wins),
+        # absolute time.monotonic() expiry (None = no deadline), and
+        # whether this stream was preemptively evicted (its eventual
+        # re-admission counts as a restore)
+        self.priority = 0
+        self.deadline: Optional[float] = None
+        self.preempted = False
 
 
 class PagedEngine:
@@ -799,6 +814,7 @@ class PagedEngine:
         precision: str = "",
         speculative: Optional[Dict[str, Any]] = None,
         prefix_cache: Optional[bool] = None,
+        max_queue: int = 0,
     ):
         import jax
         import jax.numpy as jnp
@@ -1003,7 +1019,24 @@ class PagedEngine:
         self._debug_invariants = (
             _os.environ.get("SELDON_TPU_PAGED_DEBUG", "") == "1"
         )
-        self._queue: List[_Stream] = []
+        # run queue: deque + identity membership set — O(1) end ops
+        # (submit append / evict appendleft, where the old list paid
+        # pop(0)/insert(0)) and O(1) membership tests (cancel's old
+        # `in self._queue` scan).  Priority selection and mid-queue
+        # removal still scan — O(queue) per admission, bounded by
+        # max_queue in SLO mode and a head hit (first maximal element)
+        # when every priority is 0, so the historical FIFO path stays
+        # effectively O(1) per admission.
+        # Bounded when max_queue > 0 (ctor arg wins over
+        # SELDON_TPU_MAX_QUEUE; 0 = unbounded, the historical default):
+        # an overflowing submit sheds already-expired queued streams
+        # first, then the lowest-priority one — goodput over FIFO
+        # fairness exactly when the queue is the p99 term (§10a).
+        if not max_queue:
+            max_queue = int(_os.environ.get("SELDON_TPU_MAX_QUEUE", "0") or 0)
+        self.max_queue = max(0, int(max_queue))
+        self._queue: Deque[_Stream] = deque()
+        self._queued: set = set()  # identity membership (streams are unhashable-by-value)
         self._slots: List[Optional[_Stream]] = [None] * self.max_slots
         self._block_tables = np.zeros((self.max_slots, self.pages_per_stream), np.int32)
         self._lengths = np.zeros((self.max_slots,), np.int32)
@@ -1025,6 +1058,16 @@ class PagedEngine:
                           # prompt tokens whose prefill was skipped
                           "prefix_hits": 0, "prefix_misses": 0,
                           "prefix_evictions": 0, "prefix_tokens_saved": 0,
+                          # SLO lifecycle (r10): streams dropped by the
+                          # bounded queue's shedding policy, streams
+                          # whose deadline expired (queued or mid-
+                          # decode), preemptive evictions for a higher-
+                          # priority admission, and re-admissions of
+                          # preempted streams; chunk_faults counts
+                          # injected/contained chunk failures handled
+                          # without fail_all
+                          "shed": 0, "expired": 0, "preempted": 0,
+                          "restored": 0, "chunk_faults": 0,
                           # wall seconds inside device calls + readback,
                           # split by phase: decode-rate observability
                           # (tokens / chunk_wall_s) independent of
@@ -1835,6 +1878,8 @@ class PagedEngine:
         stream_tokens: bool = False,
         trace_id: str = "",
         parent_span_id: Optional[str] = None,
+        priority: int = 0,
+        deadline: Optional[float] = None,
     ) -> _Stream:
         """Queue one prompt (1-D int array). Returns a stream handle whose
         ``event`` fires when ``result`` (``(max_new,)`` ids) is ready.
@@ -1847,7 +1892,16 @@ class PagedEngine:
         the request puid + its microservice span).  When omitted and a
         tracer is installed, the caller's active span is captured here —
         the decode loop runs on another thread, so the linkage must be
-        pinned at submit time."""
+        pinned at submit time.
+
+        ``priority`` (higher wins) orders admission, shedding and
+        preemption; ``deadline`` is an absolute ``time.monotonic()``
+        expiry — an already-expired submit fast-fails with 504, a
+        queued stream whose budget dies is shed before it touches the
+        device, and mid-decode expiry cancels the stream at the next
+        chunk boundary.  Both default to the pre-SLO behaviour (every
+        stream equal, no expiry), which keeps greedy decode bit-exact
+        with the historical engine."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         plen = len(prompt)
         if plen < 1:
@@ -1885,15 +1939,25 @@ class PagedEngine:
                 f"request needs {need} pages but the pool holds {self.num_pages - 1}",
                 status_code=400, reason="SEQUENCE_TOO_LONG",
             )
+        import time as _time
+
+        if deadline is not None and _time.monotonic() >= deadline:
+            # fast-fail before queueing: a spent budget must not burn a
+            # queue slot, an admission wave, or a single decode step
+            raise deadline_exceeded("paged-engine submit")
         with self._lock:
             if self._closed:
                 raise MicroserviceError(
                     "engine closed", status_code=503, reason="SHUTTING_DOWN"
                 )
+            if self.max_queue and len(self._queue) >= self.max_queue:
+                self._shed_for_admission_locked(int(priority))
             stream = _Stream(
                 self._next_id, prompt, max_new_tokens,
                 float(temperature), int(top_k), int(eos_id), int(seed),
             )
+            stream.priority = int(priority)
+            stream.deadline = float(deadline) if deadline is not None else None
             if draft_hint is not None:
                 stream.draft_hint = np.asarray(draft_hint, np.int32).reshape(-1)
             if stream_tokens:
@@ -1915,6 +1979,7 @@ class PagedEngine:
                 stream.t_submit = _time.time()
                 stream.queue_depth_at_submit = len(self._queue)
             self._queue.append(stream)
+            self._queued.add(stream)
         return stream
 
     # ---- refcounted page allocator + prefix cache (r9) --------------------
@@ -1936,7 +2001,13 @@ class PagedEngine:
 
     def _alloc(self, n: int) -> Optional[List[int]]:
         """Take ``n`` fresh pages (refcount 1 each), evicting LRU-cached
-        pages under pressure.  Stack-discipline deque: O(1) per page."""
+        pages under pressure.  Stack-discipline deque: O(1) per page.
+
+        Fault point ``paged.alloc`` (utils/faults.py): an armed
+        injection reports exhaustion exactly as a genuinely full pool
+        would, driving the caller's stall/evict/rollback machinery."""
+        if _faults.fire("paged.alloc"):
+            return None
         if self._allocatable_locked() < n:
             return None
         while len(self._free_pages) < n:
@@ -2082,51 +2153,213 @@ class PagedEngine:
                 "paged allocator invariant violation: " + "; ".join(problems)
             )
 
-    def _admit_locked(self) -> List[Tuple[_Stream, int]]:
-        """Move queued streams into free slots (FIFO); returns admissions.
+    # ---- SLO lifecycle: shed / expire / preempt (r10) ---------------------
 
-        Prefix-cache lookup happens here: the longest chain of cached
-        full prompt pages maps into the new stream's block table with
-        ``refcount += 1`` and only the remainder allocates fresh pages —
-        prefill then runs over the uncached suffix alone.  Matched refs
-        bump BEFORE the fresh alloc so the alloc's own LRU eviction can
-        never reclaim the pages being matched; on alloc failure the
-        bumps roll back (deepest page re-parked first, preserving the
-        leaves-evict-first LRU discipline)."""
-        admitted = []
-        for slot in range(self.max_slots):
-            if self._slots[slot] is not None or not self._queue:
-                continue
-            stream = self._queue[0]
-            plen = len(stream.prompt)
-            matched = self._match_prefix_locked(stream.prompt)
-            for e in matched:
+    def _remove_queued_locked(self, stream: _Stream) -> None:
+        if stream in self._queued:
+            self._queue.remove(stream)
+            self._queued.discard(stream)
+
+    def _fail_stream_locked(self, stream: _Stream, exc: Exception) -> None:
+        """Error-terminate one stream (shed, expiry, contained chunk
+        fault): slot and pages released, waiter unblocked with ``exc``
+        — the SLO/chaos twin of ``_finish_locked``, which delivers a
+        result.  Works for queued (no slot) and in-slot streams."""
+        slot = stream.slot
+        stream.error = exc
+        if stream.trace_id:
+            import time as _time
+
+            self._gen_span_deferred(
+                stream, "gen.finish", _time.time(), 0.0,
+                slot=slot, tokens=len(stream.tokens), error=True,
+                reason=getattr(exc, "reason", type(exc).__name__),
+            )
+        if slot is not None and self._slots[slot] is stream:
+            self._slots[slot] = None
+            self._lengths[slot] = 0
+        if stream.pages:
+            self._free(stream.pages)
+            stream.pages = []
+        stream.slot = None
+        if stream.token_queue is not None:
+            stream.token_queue.put(None)
+        stream.event.set()
+
+    def _shed_expired_queued_locked(self) -> int:
+        """Drop queued streams whose budget is already spent — they
+        must never reach the device (the scheduler's 'skip expired'
+        rule).  Returns the number dropped."""
+        if not self._queue:
+            return 0
+        import time as _time
+
+        now = _time.monotonic()
+        victims = [
+            s for s in self._queue
+            if s.deadline is not None and now >= s.deadline
+        ]
+        for s in victims:
+            self._remove_queued_locked(s)
+            self._counters["expired"] += 1
+            self._fail_stream_locked(
+                s, deadline_exceeded(f"paged-engine queue (req {s.req_id})")
+            )
+        return len(victims)
+
+    def _shed_for_admission_locked(self, priority: int) -> None:
+        """Make room for an arriving submit when the bounded queue is
+        full.  Policy (docs/operations.md runbook): already-expired
+        queued streams are dropped first; if the queue is still full the
+        lowest-priority queued stream sheds — but only when it ranks
+        strictly BELOW the newcomer (ties shed the newcomer: arrival
+        order breaks ties, or admission would livelock under uniform
+        load).  Shedding raises/errors 503 ``SHED`` so callers can
+        retry elsewhere."""
+        self._shed_expired_queued_locked()
+        if len(self._queue) < self.max_queue:
+            return
+        # lowest class first; within a class the NEWEST sheds (oldest
+        # are closest to service — dropping them maximises wasted wait)
+        victim = min(self._queue, key=lambda s: (s.priority, -s.req_id))
+        self._counters["shed"] += 1
+        if victim.priority >= priority:
+            raise MicroserviceError(
+                f"queue full ({self.max_queue}) and every queued stream has "
+                f"priority >= {priority}: request shed under overload",
+                status_code=503, reason="SHED",
+            )
+        self._remove_queued_locked(victim)
+        self._fail_stream_locked(
+            victim,
+            MicroserviceError(
+                f"shed under overload: queue full ({self.max_queue}) and a "
+                f"priority-{priority} request arrived "
+                f"(this stream: priority {victim.priority})",
+                status_code=503, reason="SHED",
+            ),
+        )
+
+    def _preempt_victim_locked(self, stream: _Stream) -> Optional[_Stream]:
+        """The in-flight stream a pages-starved ``stream`` may evict: a
+        strictly lower-priority one (least priority, then least decoded
+        progress, ties to the youngest).  None = no preemption — equal
+        classes never preempt each other, so the default (all priority
+        0) engine behaves exactly as before."""
+        candidates = [
+            s for s in self._slots
+            if s is not None and s.priority < stream.priority
+        ]
+        if not candidates:
+            return None
+        return min(
+            candidates, key=lambda s: (s.priority, len(s.tokens), -s.req_id)
+        )
+
+    def _try_admit_locked(self, slot: int, stream: _Stream) -> bool:
+        """One admission attempt for ``stream`` into ``slot``: prefix
+        match + refcount bumps + fresh alloc; False rolls every bump
+        back (deepest page re-parked first, preserving the leaves-
+        evict-first LRU discipline)."""
+        plen = len(stream.prompt)
+        matched = self._match_prefix_locked(stream.prompt)
+        for e in matched:
+            if int(self._page_ref[e.page]) == 0:
+                self._lru.pop(e.page, None)
+            self._page_ref[e.page] += 1
+        fresh = self._alloc(-(-plen // self.page_size) - len(matched))
+        if fresh is None:
+            for e in reversed(matched):
+                self._page_ref[e.page] -= 1
                 if int(self._page_ref[e.page]) == 0:
-                    self._lru.pop(e.page, None)
-                self._page_ref[e.page] += 1
-            fresh = self._alloc(-(-plen // self.page_size) - len(matched))
-            if fresh is None:
-                for e in reversed(matched):
-                    self._page_ref[e.page] -= 1
-                    if int(self._page_ref[e.page]) == 0:
-                        self._lru[e.page] = e
-                break  # FIFO: don't let a short request starve the head
-            self._queue.pop(0)
-            stream.slot = slot
-            stream.pages = [e.page for e in matched] + fresh
-            stream.cached_len = len(matched) * self.page_size
-            if self._prefix_cache_enabled:
-                if matched:
-                    self._counters["prefix_hits"] += 1
-                    self._counters["prefix_tokens_saved"] += stream.cached_len
-                else:
-                    self._counters["prefix_misses"] += 1
-            self._slots[slot] = stream
-            row = np.zeros((self.pages_per_stream,), np.int32)
-            row[: len(stream.pages)] = stream.pages
-            self._block_tables[slot] = row
-            self._lengths[slot] = plen
-            admitted.append((stream, plen))
+                    self._lru[e.page] = e
+            return False
+        self._remove_queued_locked(stream)
+        stream.slot = slot
+        stream.pages = [e.page for e in matched] + fresh
+        stream.cached_len = len(matched) * self.page_size
+        if self._prefix_cache_enabled:
+            if matched:
+                self._counters["prefix_hits"] += 1
+                self._counters["prefix_tokens_saved"] += stream.cached_len
+            else:
+                self._counters["prefix_misses"] += 1
+        if stream.preempted:
+            # a preemptively-evicted stream coming back: its decoded
+            # progress re-derives deterministically and any still-cached
+            # prompt pages just re-matched above — the restore half of
+            # evict/restore
+            stream.preempted = False
+            self._counters["restored"] += 1
+        self._slots[slot] = stream
+        row = np.zeros((self.pages_per_stream,), np.int32)
+        row[: len(stream.pages)] = stream.pages
+        self._block_tables[slot] = row
+        self._lengths[slot] = plen
+        return True
+
+    def _preempt_locked(self, stream: _Stream) -> Optional[int]:
+        """Preempt the best victim for ``stream`` (strictly lower
+        priority only); returns the freed slot, or None when nothing is
+        preemptible.  The victim goes through the ordinary evict path:
+        re-queued at the head, progress re-derived deterministically on
+        restore, prompt pages usually surviving in the prefix cache."""
+        victim = self._preempt_victim_locked(stream)
+        if victim is None:
+            return None
+        slot = victim.slot
+        self._counters["preempted"] += 1
+        victim.preempted = True
+        self._evict_locked(victim)
+        return slot
+
+    def _admit_locked(self) -> List[Tuple[_Stream, int]]:
+        """Move queued streams into slots; returns admissions.
+
+        Order: expired queued streams are dropped first (they must not
+        cost an admission wave), then the highest-priority queued
+        stream takes the next slot — FIFO within a class (``max``
+        returns the first maximal element, and evict/restore re-queues
+        at the head), which is EXACTLY the historical FIFO when every
+        priority is 0.  An admission that cannot get a SLOT (all busy)
+        or PAGES (pool exhausted) may preempt a strictly lower-priority
+        in-flight stream through the ordinary evict path, so long
+        low-priority prompts can never starve interactive traffic;
+        equal classes never preempt each other, keeping the default
+        engine bit-exact with its pre-SLO behaviour.
+
+        Prefix-cache lookup happens inside ``_try_admit_locked``: the
+        longest chain of cached full prompt pages maps into the new
+        stream's block table with ``refcount += 1`` and only the
+        remainder allocates fresh pages — prefill then runs over the
+        uncached suffix alone."""
+        admitted: List[Tuple[_Stream, int]] = []
+        self._shed_expired_queued_locked()
+        free_slots: Deque[int] = deque(
+            slot for slot in range(self.max_slots)
+            if self._slots[slot] is None
+        )
+        while self._queue:
+            stream = max(self._queue, key=lambda s: s.priority)
+            if not free_slots:
+                # slot starvation: a higher-priority arrival may evict
+                # a lower-priority in-flight stream for its slot
+                slot = self._preempt_locked(stream)
+                if slot is None:
+                    break
+                free_slots.append(slot)
+                continue  # re-select: the preemptor still ranks first
+            if self._try_admit_locked(free_slots[0], stream):
+                admitted.append((stream, len(stream.prompt)))
+                free_slots.popleft()
+                continue
+            # pages exhausted with a slot in hand: preempt for pages,
+            # else stop the whole wave (don't let a short request
+            # starve the head — the historical FIFO discipline)
+            slot = self._preempt_locked(stream)
+            if slot is None:
+                break
+            free_slots.append(slot)
         return admitted
 
     def _prefill_streams(self, streams: List[_Stream]) -> None:
@@ -2394,7 +2627,8 @@ class PagedEngine:
         stream.cached_len = 0  # re-admission re-matches the prefix index
         self._lengths[slot] = 0
         self._counters["evictions"] += 1
-        self._queue.insert(0, stream)
+        self._queue.appendleft(stream)
+        self._queued.add(stream)
 
     def cancel(self, stream: _Stream) -> None:
         """Abandon a stream (consumer disconnected): a queued stream is
@@ -2405,8 +2639,8 @@ class PagedEngine:
         with self._lock:
             if stream.result is not None or stream.error is not None:
                 return
-            if stream in self._queue:
-                self._queue.remove(stream)
+            if stream in self._queued:
+                self._remove_queued_locked(stream)
                 toks = stream.tokens[: stream.max_new]
                 stream.result = np.asarray(
                     toks + [stream.eos_id] * (stream.max_new - len(toks)),
@@ -2420,14 +2654,49 @@ class PagedEngine:
 
     def _retire_cancelled_locked(self, active: List[_Stream]) -> List[_Stream]:
         """Finish flagged streams before the next chunk; returns the
-        still-live subset."""
+        still-live subset.  Mid-decode deadline expiry retires here too
+        — the same bookkeeping point the cancel path uses, so slot and
+        page state can never race an in-flight device chunk."""
+        import time as _time
+
         live = []
+        now = None
         for stream in active:
             if stream.cancelled:
                 self._finish_locked(stream)
-            else:
-                live.append(stream)
+                continue
+            if stream.deadline is not None:
+                now = _time.monotonic() if now is None else now
+                if now >= stream.deadline:
+                    self._counters["expired"] += 1
+                    self._fail_stream_locked(
+                        stream,
+                        deadline_exceeded(
+                            f"paged-engine decode (req {stream.req_id}, "
+                            f"{len(stream.tokens)} tokens in)"
+                        ),
+                    )
+                    continue
+            live.append(stream)
         return live
+
+    def _contain_chunk_fault(self, streams: List[_Stream], exc: Exception) -> bool:
+        """Graceful degradation for an injected chunk failure: error out
+        ONLY the streams that would have run this chunk (clean upstream
+        503s), keep every other slot and the queue alive, and leave the
+        allocator consistent — the chaos invariant is that ``fail_all``
+        is never needed.  Returns step()'s has-more-work value."""
+        err = MicroserviceError(
+            f"decode chunk failed: {exc}",
+            status_code=503, reason="ENGINE_CHUNK_FAULT",
+        )
+        with self._lock:
+            self._counters["chunk_faults"] += 1
+            for stream in streams:
+                self._fail_stream_locked(stream, err)
+            if self._debug_invariants:
+                self._check_invariants_locked()
+            return bool(self._queue) or any(s is not None for s in self._slots)
 
     def has_work(self) -> bool:
         with self._lock:
@@ -2486,8 +2755,9 @@ class PagedEngine:
         """Error out every queued and in-flight stream, returning their
         pages to the pool — the engine stays usable afterwards."""
         with self._lock:
-            victims = [s for s in self._slots if s is not None] + self._queue
-            self._queue = []
+            victims = [s for s in self._slots if s is not None] + list(self._queue)
+            self._queue.clear()
+            self._queued.clear()
             for i in range(self.max_slots):
                 self._slots[i] = None
             self._lengths[:] = 0
@@ -2518,10 +2788,11 @@ class PagedEngine:
     def _step_decode(self) -> bool:
         jnp = self._jnp
         with self._lock:
-            # pre-admission prefix counters: the chunk record carries
-            # this wave's hit/saved deltas (flight-recorder contract)
+            # pre-admission prefix + SLO counters: the chunk record
+            # carries this wave's deltas (flight-recorder contract)
             pre_hits = self._counters["prefix_hits"]
             pre_saved = self._counters["prefix_tokens_saved"]
+            pre_slo = {k: self._counters[k] for k in _SLO_COUNTER_KEYS}
             admitted = self._admit_locked()
         self._prefill_streams([s for s, _ in admitted])
 
@@ -2606,6 +2877,16 @@ class PagedEngine:
 
         import time as _time
 
+        try:
+            # fault point paged.chunk fires BEFORE the device call is
+            # issued, so pool buffers stay valid and only this chunk's
+            # runnable streams fail — graceful containment, never
+            # fail_all (a REAL device error later in this function
+            # still escalates through the loop's fail_all path, since
+            # donated buffers may be gone by then)
+            _faults.raise_if("paged.chunk")
+        except _faults.InjectedFault as exc:
+            return self._contain_chunk_fault(runnable_now, exc)
         self._profile_before_chunk()
         t_chunk = _time.perf_counter()
         toks, self.pages_k, self.pages_v, self._logits, lengths_out, self._keys, _, emitted = (
@@ -2647,6 +2928,7 @@ class PagedEngine:
             queue_depth = len(self._queue)
             prefix_hits_d = self._counters["prefix_hits"] - pre_hits
             prefix_saved_d = self._counters["prefix_tokens_saved"] - pre_saved
+            slo_d = {k: self._counters[k] - pre_slo[k] for k in _SLO_COUNTER_KEYS}
             pages_cached = len(self._lru)
         self._record_chunk({
             "phase": "decode",
@@ -2661,6 +2943,7 @@ class PagedEngine:
             "prefix_hits": prefix_hits_d,
             "prefix_tokens_saved": prefix_saved_d,
             "prefix_pages_cached": pages_cached,
+            **slo_d,
         })
         return more
 
@@ -2678,6 +2961,7 @@ class PagedEngine:
         with self._lock:
             pre_hits = self._counters["prefix_hits"]
             pre_saved = self._counters["prefix_tokens_saved"]
+            pre_slo = {k: self._counters[k] for k in _SLO_COUNTER_KEYS}
             admitted = self._admit_locked()
         self._prefill_streams([s for s, _ in admitted])
 
@@ -2777,6 +3061,10 @@ class PagedEngine:
             return True
         import time as _time
 
+        try:  # same pre-device-call containment as the decode path
+            _faults.raise_if("paged.chunk")
+        except _faults.InjectedFault as exc:
+            return self._contain_chunk_fault(runnable, exc)
         self._profile_before_chunk()
         t_chunk = _time.perf_counter()
         out, counts, self.pages_k, self.pages_v, lengths_out = self._spec_chunk(
@@ -2813,6 +3101,7 @@ class PagedEngine:
             queue_depth = len(self._queue)
             prefix_hits_d = self._counters["prefix_hits"] - pre_hits
             prefix_saved_d = self._counters["prefix_tokens_saved"] - pre_saved
+            slo_d = {k: self._counters[k] - pre_slo[k] for k in _SLO_COUNTER_KEYS}
             pages_cached = len(self._lru)
         self._record_chunk({
             "phase": "spec_verify",
@@ -2827,6 +3116,7 @@ class PagedEngine:
             "prefix_hits": prefix_hits_d,
             "prefix_tokens_saved": prefix_saved_d,
             "prefix_pages_cached": pages_cached,
+            **slo_d,
         })
         return more
 
@@ -2888,6 +3178,7 @@ class StreamingLM(TPUComponent):
         precision: str = "",
         speculative: Optional[Dict[str, Any]] = None,
         prefix_cache: Optional[bool] = None,
+        max_queue: int = 0,
         **kwargs: Any,
     ):
         super().__init__(**kwargs)
@@ -2914,6 +3205,9 @@ class StreamingLM(TPUComponent):
             # page-granular automatic prefix caching: None defers to
             # SELDON_TPU_PREFIX_CACHE (default on; "0" disables)
             prefix_cache=prefix_cache,
+            # bounded run queue with priority shedding (0 defers to
+            # SELDON_TPU_MAX_QUEUE; 0 = unbounded)
+            max_queue=int(max_queue),
         )
         self.mesh_axes = dict(mesh_axes) if mesh_axes else None
         self.max_new_tokens = int(max_new_tokens)
@@ -3030,6 +3324,49 @@ class StreamingLM(TPUComponent):
         self._stop = True
         self._wake.set()
 
+    @staticmethod
+    def _slo_terms(tags) -> Tuple[int, Optional[float]]:
+        """Per-request SLO terms: the ``priority`` tag (higher wins,
+        clamped like the ingress header — an unauthenticated tag must
+        not be an unbounded preemption weapon) and the TIGHTEST of the
+        ``deadline_at_monotonic`` tag (absolute expiry the in-process
+        streaming lanes mint at ingress), the ``deadline_ms`` tag
+        (relative, minted here), and the ambient transport budget
+        (utils/deadlines contextvar — run_dispatch copies contextvars
+        onto this thread, the same hand-off the trace context rides),
+        as an absolute monotonic expiry."""
+        import time as _time
+
+        from seldon_core_tpu.utils import deadlines as _deadlines
+
+        try:
+            priority = _deadlines.clamp_priority(
+                int(float(tags.get("priority", 0)))
+            )
+        except (TypeError, ValueError):
+            priority = 0
+        deadline = None
+        raw_abs = tags.get("deadline_at_monotonic")
+        if raw_abs is not None:
+            try:
+                deadline = float(raw_abs)
+            except (TypeError, ValueError):
+                deadline = None
+        raw = tags.get("deadline_ms")
+        if raw is not None:
+            try:
+                rel = _time.monotonic() + max(0.0, float(raw)) / 1000.0
+                deadline = rel if deadline is None else min(deadline, rel)
+            except (TypeError, ValueError):
+                pass
+        ambient = _deadlines.current_deadline()
+        if ambient is not None:
+            deadline = (
+                ambient.expires_at if deadline is None
+                else min(deadline, ambient.expires_at)
+            )
+        return priority, deadline
+
     def predict(self, X, names, meta=None):
         if self.engine is None:
             self.load()  # idempotent + internally locked
@@ -3052,23 +3389,33 @@ class StreamingLM(TPUComponent):
                 with self._counter_lock:
                     self._counter += 1
                     request_seed = self._counter
+        priority, deadline = self._slo_terms(tags)
         X = np.atleast_2d(np.asarray(X, np.int32))
-        streams = [
-            # multiplicative row spread: (seed ^ c) + i style additive
-            # mixing collides across neighbouring requests
-            self.engine.submit(
-                row, max_new_tokens=max_new, temperature=temperature,
-                top_k=top_k, eos_id=self.eos_id,
-                seed=self.seed ^ (request_seed * 1000003 + i),
-            )
-            for i, row in enumerate(X)
-        ]
-        self._wake.set()
-        for stream in streams:
-            stream.event.wait()
-            if stream.error:
-                raise stream.error
-        return np.stack([s.result for s in streams])
+        streams = []
+        try:
+            for i, row in enumerate(X):
+                # multiplicative row spread: (seed ^ c) + i style
+                # additive mixing collides across neighbouring requests
+                streams.append(self.engine.submit(
+                    row, max_new_tokens=max_new, temperature=temperature,
+                    top_k=top_k, eos_id=self.eos_id,
+                    seed=self.seed ^ (request_seed * 1000003 + i),
+                    priority=priority, deadline=deadline,
+                ))
+            self._wake.set()
+            for stream in streams:
+                stream.event.wait()
+                if stream.error:
+                    raise stream.error
+            return np.stack([s.result for s in streams])
+        except BaseException:
+            # one row shed/expired/errored: the siblings must not keep
+            # decoding unread — they hold slots and KV pages exactly
+            # when the engine is overloaded enough to shed
+            for s in streams:
+                if s.result is None and s.error is None:
+                    self.engine.cancel(s)
+            raise
 
     def predict_stream(self, X, names=None, meta=None):
         """Token streaming for ONE prompt: a generator yielding int32
@@ -3108,11 +3455,13 @@ class StreamingLM(TPUComponent):
                 "separately (predict() batches them)",
                 status_code=400, reason="BAD_REQUEST",
             )
+        priority, deadline = self._slo_terms(tags)
         stream = self.engine.submit(
             X[0], max_new_tokens=max_new, temperature=temperature,
             top_k=top_k, eos_id=self.eos_id,
             seed=self.seed ^ (request_seed * 1000003),
             stream_tokens=True,
+            priority=priority, deadline=deadline,
         )
         self._wake.set()
         try:

@@ -39,13 +39,42 @@ def _grpc_remote_ctx(context):
         return None
 
 
+def _grpc_deadline_ms(context):
+    """The caller's remaining budget: the tighter of the
+    ``x-seldon-deadline-ms`` metadata entry and the native gRPC
+    deadline (``context.time_remaining()``), in milliseconds; None when
+    neither is set."""
+    from seldon_core_tpu.utils import deadlines
+
+    md_ms = None
+    try:
+        md_ms = deadlines.extract_ms(context.invocation_metadata() or ())
+    except Exception:  # noqa: BLE001 — bad metadata must not fail the call
+        md_ms = None
+    native_ms = None
+    try:
+        remaining = context.time_remaining()
+        if remaining is not None:
+            native_ms = max(0.0, float(remaining) * 1000.0)
+    except Exception:  # noqa: BLE001
+        native_ms = None
+    if md_ms is None:
+        return native_ms
+    if native_ms is None:
+        return md_ms
+    return min(md_ms, native_ms)
+
+
 def _wrap_unary(user_model: Any, fn, unit_id: str = ""):
     async def handler(request, context):
         from seldon_core_tpu.runtime.executor_pool import run_dispatch
+        from seldon_core_tpu.utils import deadlines as _deadlines
         from seldon_core_tpu.utils.tracing import activate_context
 
         try:
-            with activate_context(_grpc_remote_ctx(context)):
+            with activate_context(_grpc_remote_ctx(context)), \
+                    _deadlines.activate_ms(_grpc_deadline_ms(context)):
+                _deadlines.check(f"microservice grpc ingress {fn.__name__}")
                 if isinstance(request, pb.Feedback):
                     arg = InternalFeedback.from_proto(request)
                     out = await run_dispatch(fn, user_model, arg, unit_id)

@@ -162,8 +162,17 @@ def _remote_ctx(request: web.Request):
     return extract(request.headers)
 
 
+def _remote_deadline_ms(request: web.Request):
+    """The caller's remaining end-to-end budget from the
+    ``X-Seldon-Deadline-Ms`` header (None when absent/malformed)."""
+    from seldon_core_tpu.utils import deadlines
+
+    return deadlines.extract_ms(request.headers)
+
+
 def _message_endpoint(user_model: Any, fn: Callable) -> Callable:
     async def handler(request: web.Request) -> web.Response:
+        from seldon_core_tpu.utils import deadlines as _deadlines
         from seldon_core_tpu.utils.tracing import activate_context
 
         try:
@@ -171,8 +180,12 @@ def _message_endpoint(user_model: Any, fn: Callable) -> Callable:
             msg = InternalMessage.from_json(body)
             # headers carry the caller's span context; activating it
             # here makes the dispatch span a child of the caller's
-            # (run_dispatch copies the context onto the pool thread)
-            with activate_context(_remote_ctx(request)):
+            # (run_dispatch copies the context onto the pool thread).
+            # The deadline budget rides the same way — and an already-
+            # spent budget fails HERE, before the model sees anything.
+            with activate_context(_remote_ctx(request)), \
+                    _deadlines.activate_ms(_remote_deadline_ms(request)):
+                _deadlines.check(f"microservice ingress {request.path}")
                 if fn is dispatch.predict:  # async fast path for batched models
                     out = await dispatch.predict_async(user_model, msg)
                 else:
@@ -192,25 +205,31 @@ def build_app(
     app = web.Application(client_max_size=1024 * 1024 * 512)
 
     async def aggregate_handler(request: web.Request) -> web.Response:
+        from seldon_core_tpu.utils import deadlines as _deadlines
         from seldon_core_tpu.utils.tracing import activate_context
 
         try:
             body = await _request_body(request)
             raw_list = body.get("seldonMessages", body if isinstance(body, list) else [])
             msgs = [InternalMessage.from_json(b) for b in raw_list]
-            with activate_context(_remote_ctx(request)):
+            with activate_context(_remote_ctx(request)), \
+                    _deadlines.activate_ms(_remote_deadline_ms(request)):
+                _deadlines.check("microservice ingress /aggregate")
                 out = await run_dispatch(dispatch.aggregate, user_model, msgs)
             return web.json_response(out.to_json())
         except Exception as e:  # noqa: BLE001
             return _error_response(e)
 
     async def feedback_handler(request: web.Request) -> web.Response:
+        from seldon_core_tpu.utils import deadlines as _deadlines
         from seldon_core_tpu.utils.tracing import activate_context
 
         try:
             body = await _request_body(request)
             fb = InternalFeedback.from_json(body)
-            with activate_context(_remote_ctx(request)):
+            with activate_context(_remote_ctx(request)), \
+                    _deadlines.activate_ms(_remote_deadline_ms(request)):
+                _deadlines.check("microservice ingress /send-feedback")
                 out = await run_dispatch(dispatch.send_feedback, user_model, fb, unit_id)
             return web.json_response(out.to_json())
         except Exception as e:  # noqa: BLE001

@@ -278,6 +278,22 @@ ENGINE_STATS_METRICS: Dict[str, Tuple[str, str, str]] = {
                             "seldon_tpu_engine_prefix_cache_tokens_saved_total",
                             "prompt tokens whose prefill was skipped via "
                             "cached prefix pages"),
+    # SLO lifecycle (r10): the overload/degradation observability —
+    # GoodputCollapse alerts and the generation dashboard's SLO panel
+    # read these
+    "shed": ("counter", "seldon_tpu_engine_shed_total",
+             "streams dropped by the bounded queue's shedding policy"),
+    "expired": ("counter", "seldon_tpu_engine_expired_total",
+                "streams whose end-to-end deadline expired "
+                "(queued or mid-decode)"),
+    "preempted": ("counter", "seldon_tpu_engine_preempted_total",
+                  "streams preemptively evicted for a higher-priority "
+                  "admission"),
+    "restored": ("counter", "seldon_tpu_engine_restored_total",
+                 "preempted streams re-admitted (progress restored)"),
+    "chunk_faults": ("counter", "seldon_tpu_engine_chunk_faults_total",
+                     "chunk failures contained without fail_all "
+                     "(fault injection / graceful degradation)"),
     "active_slots": ("gauge", "seldon_tpu_engine_slot_occupancy",
                      "slots holding a live stream"),
     "queued_streams": ("gauge", "seldon_tpu_engine_queue_depth",

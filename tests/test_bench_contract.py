@@ -142,6 +142,13 @@ def _r3_like_full_result():
                 "prefix_hit_pct": 100.0,
                 "prefix_tokens_saved": 12288,
                 "prefix_shared_mix": "16 streams, 256-token shared system prompt + distinct suffixes, 64 new tokens each",
+                "goodput_pct": 97.2,
+                "shed_pct": 33.3,
+                "interactive_p99_ms": 240.5,
+                "interactive_unloaded_p99_ms": 180.1,
+                "interactive_p99_x": 1.34,
+                "overload_expired_streams": 0,
+                "overload_mix": "24 batch (prio 0, 128 new) + 8 interactive (prio 2, 16 new, 60s deadline) into 8 slots, queue bound 16",
             },
             "trace_prop": {
                 "trace_on_tok_s": 4360.0,
@@ -267,6 +274,25 @@ def test_compact_line_carries_prefix_cache_story(bench):
     assert "prefix_off_tokens_per_s" not in e
     assert "prefix_speedup_x" not in e
     assert "prefix_shared_mix" not in e
+
+
+def test_compact_line_carries_overload_story(bench):
+    """r10 certification keys: the 2x-offered-load phase's goodput
+    (in-deadline tokens / decoded tokens, gate >= 90), shed share, and
+    the interactive class's loaded p99 (gate <= 1.5x unloaded — the
+    ratio and mix stay in bench_full.json)."""
+    compact = bench._compact_result(_r3_like_full_result())
+    e = compact["extra"]
+    assert isinstance(e["goodput_pct"], float)
+    assert e["goodput_pct"] == 97.2
+    assert isinstance(e["shed_pct"], float)
+    assert e["shed_pct"] == 33.3
+    assert isinstance(e["interactive_p99_ms"], float)
+    assert e["interactive_p99_ms"] == 240.5
+    # the ratio arm + mix description are full-blob-only
+    assert "interactive_p99_x" not in e
+    assert "interactive_unloaded_p99_ms" not in e
+    assert "overload_mix" not in e
 
 
 def test_prefix_capacity_accounting_reclaimable():
