@@ -24,11 +24,14 @@ NATIVE_DIR = os.path.join(REPO_ROOT, "native")
 BINARY = os.path.join(NATIVE_DIR, "remote_node")
 
 
-def _spawn_node():
-    proc = subprocess.Popen(
-        [BINARY, "0"], stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
-        text=True, bufsize=1,
-    )
+def _spawn_node(binary=BINARY):
+    try:
+        proc = subprocess.Popen(
+            [binary, "0"], stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+            text=True, bufsize=1,
+        )
+    except OSError:  # e.g. exec-format error on a foreign-arch prebuilt
+        return None, None
     line = proc.stdout.readline()
     m = re.search(r"listening on (\d+)", line)
     if m is None:  # binary didn't come up (e.g. glibc mismatch)
@@ -40,21 +43,32 @@ def _spawn_node():
 
 @pytest.fixture(scope="module")
 def cpp_node():
-    if shutil.which("g++") is None and not os.path.exists(BINARY):
+    have_gxx = shutil.which("g++") is not None
+    if not have_gxx and not os.path.exists(BINARY):
         pytest.skip("no g++ toolchain and no prebuilt remote_node")
-    if shutil.which("g++") is not None:
-        subprocess.run(["make", "-C", NATIVE_DIR, "remote_node"], check=True, capture_output=True)
-    proc, port = _spawn_node()
-    if proc is None and shutil.which("g++") is not None:
-        # a PREBUILT binary can be stale for this host (built against a
-        # newer glibc than the container ships) yet newer than its
-        # sources, so the plain make above was a no-op — force the
-        # rebuild and try once more
+    src = os.path.join(NATIVE_DIR, "remote_node.cc")
+    proc = port = None
+    if os.path.exists(BINARY) and (
+        not have_gxx or os.path.getmtime(BINARY) >= os.path.getmtime(src)
+    ):
+        proc, port = _spawn_node()
+    if proc is None and have_gxx:
+        # the tracked PREBUILT binary can be outdated for this run: built
+        # against a newer glibc than the container ships, a foreign arch,
+        # or older than an edited remote_node.cc.  Build a host-local copy
+        # with the Makefile's own recipe in a git-ignored scratch dir
+        # beside the sources (same filesystem as the canonical binary, so
+        # no noexec-tmpfs surprises) without ever overwriting the tracked
+        # binary.
+        build = os.path.join(NATIVE_DIR, ".pytest_build")
+        os.makedirs(build, exist_ok=True)
+        for name in ("Makefile", "remote_node.cc"):
+            shutil.copy(os.path.join(NATIVE_DIR, name), os.path.join(build, name))
         subprocess.run(
-            ["make", "-B", "-C", NATIVE_DIR, "remote_node"],
+            ["make", "-C", build, "remote_node"],
             check=True, capture_output=True,
         )
-        proc, port = _spawn_node()
+        proc, port = _spawn_node(os.path.join(build, "remote_node"))
     if proc is None:
         pytest.skip("remote_node binary does not run on this host")
     # readiness: the probe endpoint answers
