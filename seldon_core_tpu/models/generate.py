@@ -75,6 +75,8 @@ class Generator:
         max_len: int = 2048,
         dtype: Any = None,
         prompt_buckets: Optional[Sequence[int]] = None,
+        mesh: Any = None,
+        tp: Optional[int] = None,
         quantize: str = "",
     ):
         import jax
@@ -98,9 +100,30 @@ class Generator:
 
             params, self.quantize_manifest = quantize_params(params)
         self._compute_dtype = dtype
-        # pin on device: surgery/msgpack trees are host numpy, and numpy
-        # args to jit re-upload every call
-        self.params = jax.device_put(params)
+        # tensor-parallel knob (r11), same precedence as PagedEngine: an
+        # explicit mesh wins; otherwise tp= / SELDON_TPU_TP builds the
+        # {"model": tp} mesh (degrading to single-chip with a WARN on
+        # small hosts).  Megatron-sharded params pin the layout; the
+        # mutable flax cache is created inside the compiled programs, so
+        # GSPMD propagates the head sharding through it and inserts the
+        # collectives — mesh=None keeps the historical single-chip path
+        # byte-identical.
+        if mesh is None:
+            from seldon_core_tpu.parallel.mesh import tp_mesh
+
+            mesh = tp_mesh(tp)
+        self._mesh = mesh
+        if mesh is not None:
+            from seldon_core_tpu.parallel.mesh import mesh_shape
+            from seldon_core_tpu.parallel.sharding import shard_params
+
+            self.params = shard_params(params, mesh)
+            self.tp_degree = int(mesh_shape(mesh).get("model", 1))
+        else:
+            # pin on device: surgery/msgpack trees are host numpy, and
+            # numpy args to jit re-upload every call
+            self.params = jax.device_put(params)
+            self.tp_degree = 1
         self.module = TransformerLM(
             vocab_size=vocab_size, d_model=d_model, num_layers=num_layers,
             num_heads=num_heads, max_len=max_len, dtype=dtype, decode=True,
@@ -302,6 +325,7 @@ class GenerativeLM(TPUComponent):
         eos_id: int = -1,
         model_uri: str = "",
         seed: int = 0,
+        tp: int = 0,
         quantize: str = "",
         **kwargs: Any,
     ):
@@ -311,6 +335,9 @@ class GenerativeLM(TPUComponent):
             num_layers=int(num_layers), num_heads=int(num_heads),
             max_len=int(max_len),
         )
+        # tensor-parallel serving degree (r11): 0 defers to
+        # SELDON_TPU_TP, degrading to single-chip on small hosts
+        self.tp = int(tp)
         self.max_new_tokens = int(max_new_tokens)
         self.temperature = float(temperature)
         self.top_k = int(top_k)
@@ -336,7 +363,10 @@ class GenerativeLM(TPUComponent):
             if self.generator is not None:
                 return
             params = load_lm_params(self.model_uri, self.config, self.seed)
-            self.generator = Generator(params, quantize=self.quantize, **self.config)
+            self.generator = Generator(
+                params, quantize=self.quantize, tp=self.tp or None,
+                **self.config,
+            )
 
     def predict(self, X, names, meta=None):
         if self.generator is None:

@@ -602,6 +602,8 @@ def paged_hbm_accounting(
     donated: bool = True,
     split_tile_pad: float = 2.0,
     cached_prefix_pages: int = 0,
+    tp_degree: int = 1,
+    num_heads: Optional[int] = None,
 ) -> Dict[str, int]:
     """Pool-HBM bytes for ``streams`` concurrent streams at ``ctx_len``
     tokens — the capacity model the bench certifies (VERDICT r5 #3/#5).
@@ -630,19 +632,38 @@ def paged_hbm_accounting(
       without adding to ``peak_bytes`` — the accounting the admission
       guard and ``paged_capacity_streams`` rely on.
 
+    * **tensor parallelism (r11)** — ``tp_degree > 1`` prices the
+      PER-SHARD bytes one device holds: the pool and the in-flight
+      working set are sharded over heads on the ``model`` axis, so
+      every KV term divides by the degree (tables/lengths replicate
+      but are KBs against the pool's GBs and stay out of scope like
+      the host runtime).  Capacity under a fixed per-chip budget
+      therefore SCALES with the degree — the accounting
+      ``paged_capacity_streams`` certifies.  Pass ``num_heads`` to
+      carry the head-sharding constraint: an indivisible head count
+      leaves the pool REPLICATED at engine load
+      (``shard_decode_state``'s WARN fallback), so the accounting
+      prices FULL bytes rather than certifying capacity the fallback
+      cannot deliver.
+
     Weights, activations, and the host runtime are out of scope: this
     prices the KV side, which is what scales with streams.
     """
+    shard = max(1, int(tp_degree))
+    if num_heads is not None and num_heads % shard:
+        # mirror shard_decode_state: this configuration serves with a
+        # replicated pool, so one device really holds the full bytes
+        shard = 1
     pages = -(-ctx_len // page_size)
     tok_bytes = num_layers * d_model * 2 * dtype_bytes
     pool_pad = 1.0 if flat_pool else split_tile_pad
-    pool = int(streams * pages * page_size * tok_bytes * pool_pad)
+    pool = int(streams * pages * page_size * tok_bytes * pool_pad) // shard
     ws = 0
     if chunk_impl == "ring":
         ws = int(
             streams * (pages * page_size + steps_per_call)
             * tok_bytes * split_tile_pad
-        )
+        ) // shard
     at_rest = pool if donated else 2 * pool
     return {
         "pool_bytes": pool,
@@ -651,7 +672,8 @@ def paged_hbm_accounting(
         "per_stream_bytes": (at_rest + ws) // max(1, streams),
         "reclaimable_bytes": int(
             cached_prefix_pages * page_size * tok_bytes * pool_pad
-        ),
+        ) // shard,
+        "tp_degree": shard,
     }
 
 
@@ -808,6 +830,7 @@ class PagedEngine:
         prompt_buckets: Optional[Sequence[int]] = None,
         dtype: Any = None,
         mesh: Any = None,
+        tp: Optional[int] = None,
         model_axis: str = "model",
         shard_min_weight_size: int = 16_384,
         quantize: str = "",
@@ -821,6 +844,15 @@ class PagedEngine:
 
         if max_len % page_size:
             raise ValueError(f"max_len {max_len} must be a multiple of page_size {page_size}")
+        # tensor-parallel knob (r11): an explicit mesh wins; otherwise
+        # `tp=` (constructor) / SELDON_TPU_TP (env) builds the {"model":
+        # tp} serving mesh, degrading to single-chip with a WARN when
+        # the host exposes fewer devices — one deployment config rolls
+        # out across pod and dev hosts unchanged
+        if mesh is None:
+            from seldon_core_tpu.parallel.mesh import tp_mesh
+
+            mesh = tp_mesh(tp, axis=model_axis)
         from seldon_core_tpu.ops.surgery import (
             quantize_mode_for,
             validate_precision,
@@ -979,6 +1011,22 @@ class PagedEngine:
             model_axis=model_axis, min_weight_size=shard_min_weight_size,
             num_heads=num_heads,
         )
+        # TP bookkeeping: the degree this engine actually runs at and
+        # the PER-SHARD bytes one device holds for the K+V pool (the
+        # number HBM planning cares about — the global pool is sliced
+        # over heads, so per-device residency shrinks with the degree;
+        # an unshardable pool reports full bytes honestly)
+        self._mesh = mesh
+        self._model_axis = model_axis
+        if mesh is not None:
+            from seldon_core_tpu.parallel.mesh import mesh_shape
+
+            self.tp_degree = int(mesh_shape(mesh).get(model_axis, 1))
+            shard = self.pages_k.addressable_shards[0].data
+            self._pool_shard_bytes = 2 * int(shard.nbytes)
+        else:
+            self.tp_degree = 1
+            self._pool_shard_bytes = 2 * int(self.pages_k.nbytes)
         self._logits = jnp.zeros((self.max_slots, self.vocab_size), jnp.float32)
         # rng state kept as raw key data so masked carries can jnp.where it
         self._keys = jax.random.key_data(
@@ -1191,7 +1239,10 @@ class PagedEngine:
         )
         self._spec_chunk = (
             self._sentinels["paged_spec_chunk"].wrap(
-                jax.jit(self._spec_chunk_fn, donate_argnums=(1, 2))
+                self._tp_jit(
+                    self._spec_chunk_fn, n_rep_in=5,
+                    out_spec=("rep", "rep", "pool", "pool", "rep"),
+                )
             )
             if self.speculative is not None else None
         )
@@ -1216,6 +1267,49 @@ class PagedEngine:
 
         dtype = self._jnp.float32 if self.precision == "w8a8" else self._dtype
         return materialize(params, self.quantize, dtype)
+
+    def _tp_jit(self, fn, *, n_rep_in: int, out_spec: Sequence[str],
+                donate_argnums: Tuple[int, ...] = (1, 2)):
+        """jit an engine program, annotated for GSPMD under a TP mesh.
+
+        Every engine program shares one argument convention — ``(params,
+        pk, pv, *host_arrays)`` — so one helper covers the prefill, the
+        cached-suffix prefill, the bucketed chunk, and the speculative
+        verify: params pin their megatron specs, pools pin the
+        heads-sharded layout (in AND out, so the donated buffers round-
+        trip without a resharding copy per call), and everything else
+        (tokens, block tables, lengths, rng keys, sampling knobs) is
+        explicitly replicated — block tables stay replicated because
+        every shard gathers its own head-slice of every page, and the
+        tables are KBs against the pool's GBs.  Pinning the whole
+        signature keeps the partitioner deterministic: one GSPMD
+        program, collectives inserted by XLA, no propagation choices
+        left to vary run-to-run.
+
+        ``mesh=None`` returns the EXACT historical ``jax.jit`` call —
+        no annotation objects are even constructed — so TP=1 programs
+        stay byte-identical to the pre-TP engine (asserted by the
+        no-collectives lowering test)."""
+        jax = self._jax
+        if self._mesh is None:
+            return jax.jit(fn, donate_argnums=donate_argnums)
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        rep = NamedSharding(self._mesh, P())
+        pool = self.pages_k.sharding
+        # leaves the shard_params guard left host-side have no sharding:
+        # replicate them explicitly
+        param_sh = jax.tree.map(
+            lambda x: getattr(x, "sharding", rep), self.params
+        )
+        return jax.jit(
+            fn,
+            donate_argnums=donate_argnums,
+            in_shardings=(param_sh, pool, pool) + (rep,) * n_rep_in,
+            out_shardings=tuple(
+                pool if o == "pool" else rep for o in out_spec
+            ),
+        )
 
     def _build_prefill(self, bucket: int, k: int):
         """Prefill program for ``k`` same-bucket prompts in ONE call.
@@ -1244,7 +1338,8 @@ class PagedEngine:
             return last, pk, pv
 
         return self._sentinels["paged_prefill"].wrap(
-            jax.jit(prefill, donate_argnums=(1, 2)), static=f"bucket={bucket},k={k}"
+            self._tp_jit(prefill, n_rep_in=3, out_spec=("rep", "pool", "pool")),
+            static=f"bucket={bucket},k={k}",
         )
 
     def _build_prefill_cached(self, bucket: int, k: int, rp: int):
@@ -1286,7 +1381,7 @@ class PagedEngine:
             return last, pk, pv
 
         return self._sentinels["paged_prefill"].wrap(
-            jax.jit(prefill, donate_argnums=(1, 2)),
+            self._tp_jit(prefill, n_rep_in=5, out_spec=("rep", "pool", "pool")),
             static=f"cached,bucket={bucket},k={k},rp={rp}",
         )
 
@@ -1420,18 +1515,74 @@ class PagedEngine:
         key = (steps, buckets)
         fn = self._chunk_jit.get(key)
         if fn is None:
-            from functools import partial
-
-            if self._chunk_impl == "pool":
-                body = partial(self._chunk_fn_pool, steps, buckets)
-            else:
-                body = partial(self._chunk_fn, steps, buckets)
             fn = self._sentinels["paged_chunk"].wrap(
-                self._jax.jit(body, donate_argnums=(1, 2)),
+                self._chunk_program(steps, buckets),
                 static=f"steps={steps},buckets={buckets}",
             )
             self._chunk_jit[key] = fn
         return fn
+
+    def _chunk_program(self, steps: int, buckets: Tuple[Tuple[int, int], ...]):
+        """The jitted (un-sentineled) decode chunk for one static spec —
+        body selection + the TP annotation spelling live HERE only,
+        shared by the serving path (`_get_chunk`) and the audit surface
+        (`lower_chunk`)."""
+        from functools import partial
+
+        if self._chunk_impl == "pool":
+            body = partial(self._chunk_fn_pool, steps, buckets)
+        else:
+            body = partial(self._chunk_fn, steps, buckets)
+        return self._tp_jit(
+            body, n_rep_in=11,
+            out_spec=("rep", "pool", "pool", "rep", "rep", "rep",
+                      "rep", "rep"),
+        )
+
+    def lower_chunk(self, steps: int, buckets: Tuple[Tuple[int, int], ...]):
+        """Lower the decode chunk through the serving path's own
+        program builder (same body selection, same ``_tp_jit``
+        annotation via ``_chunk_program``) against representative
+        arguments — the audit surface ``tools/profile_paged_tp.py`` and
+        the TP=1 byte-identical / no-collectives lowering tests share,
+        so the audited annotation spelling can never drift from the
+        served program.  The block-table width is the max bucket
+        horizon — representative, not necessarily a specialization the
+        scheduler has compiled (serving slices tables to its own pow2
+        page horizon per call)."""
+        jax, jnp = self._jax, self._jnp
+        B = self.max_slots
+        horizon = max(h for _, h in buckets)
+
+        def pool_arg(p):
+            # ABSTRACT pool args: lowering must never allocate a second
+            # full pool next to the live one (and under TP a concrete
+            # jnp.zeros would materialise it unsharded on one device —
+            # exactly what shard_decode_state exists to prevent)
+            if self._mesh is not None:
+                return jax.ShapeDtypeStruct(p.shape, p.dtype,
+                                            sharding=p.sharding)
+            return jax.ShapeDtypeStruct(p.shape, p.dtype)
+
+        ex = (
+            self.params,
+            pool_arg(self.pages_k),
+            pool_arg(self.pages_v),
+            jnp.zeros((B, self.vocab_size), jnp.float32),
+            jnp.zeros((B,), jnp.int32),
+            jnp.zeros((B, horizon), jnp.int32),
+            jax.random.key_data(
+                jax.vmap(jax.random.PRNGKey)(
+                    jnp.arange(B, dtype=jnp.uint32))),
+            jnp.zeros((B,), bool),
+            jnp.zeros((B,), jnp.int32),
+            jnp.full((B,), 8, jnp.int32),
+            jnp.zeros((B,), jnp.float32),
+            jnp.zeros((B,), jnp.int32),
+            jnp.full((B,), -1, jnp.int32),
+            jnp.arange(B, dtype=jnp.int32),
+        )
+        return self._chunk_program(steps, buckets).lower(*ex)
 
     def _chunk_fn(
         self, steps, buckets, params, pk, pv, logits, lengths, block_tables,
@@ -2725,6 +2876,14 @@ class PagedEngine:
                 ),
                 "pool_pages_total": self.num_pages - 1,
                 "prefix_pages_cached": len(self._lru),
+                # tensor-parallel lane (r11): the degree this engine
+                # runs at (1 = single-chip) and the PER-SHARD K+V pool
+                # bytes one device actually holds — heads-sharded pools
+                # shrink per-device residency by the degree, which is
+                # what capacity planning prices (paged_hbm_accounting's
+                # tp_degree term)
+                "tp_degree": self.tp_degree,
+                "pool_shard_bytes": self._pool_shard_bytes,
                 # distinct compiled signatures seen by the jit sentinels
                 # (prometheus gets the per-program split directly from
                 # jitwatch — bridge-excluded to avoid double export)
@@ -2933,6 +3092,7 @@ class PagedEngine:
         self._record_chunk({
             "phase": "decode",
             "wall_ms": round(chunk_wall * 1000.0, 3),
+            "tp_degree": self.tp_degree,
             "steps": steps,
             "buckets": [list(b) for b in buckets],
             "occupancy": len(active),
@@ -3106,6 +3266,7 @@ class PagedEngine:
         self._record_chunk({
             "phase": "spec_verify",
             "wall_ms": round(chunk_wall * 1000.0, 3),
+            "tp_degree": self.tp_degree,
             "steps": self.draft_k + 1,
             "buckets": [],
             "occupancy": len(active),
@@ -3174,6 +3335,7 @@ class StreamingLM(TPUComponent):
         steps_per_call: int = 8,
         max_steps_per_call: int = 0,
         mesh_axes: Optional[Dict[str, int]] = None,
+        tp: int = 0,
         quantize: str = "",
         precision: str = "",
         speculative: Optional[Dict[str, Any]] = None,
@@ -3210,6 +3372,11 @@ class StreamingLM(TPUComponent):
             max_queue=int(max_queue),
         )
         self.mesh_axes = dict(mesh_axes) if mesh_axes else None
+        # tensor-parallel serving degree (r11): `tp=N` (or SELDON_TPU_TP
+        # when 0) is the deployment-facing spelling of mesh_axes=
+        # {"model": N}; an explicit mesh_axes wins.  Degrades to
+        # single-chip with a WARN on hosts with fewer devices.
+        self.tp = int(tp)
         self.max_new_tokens = int(max_new_tokens)
         self.temperature = float(temperature)
         self.top_k = int(top_k)
@@ -3243,8 +3410,11 @@ class StreamingLM(TPUComponent):
             from seldon_core_tpu.parallel.mesh import mesh_from_axes
 
             mesh = mesh_from_axes(self.mesh_axes)
+            # tp passed THROUGH so the engine resolves the knob exactly
+            # once: an explicit tp=1 here must force single-chip even
+            # with SELDON_TPU_TP exported (mesh_axes still wins)
             engine = PagedEngine(
-                params, dtype=jnp.bfloat16, mesh=mesh,
+                params, dtype=jnp.bfloat16, mesh=mesh, tp=self.tp or None,
                 **self.config, **self.engine_config,
             )
             # canonical seldon_tpu_engine_* metrics on the process
@@ -3503,6 +3673,8 @@ class StreamingLM(TPUComponent):
              "value": s["prefix_pages_cached"]},
             {"type": "GAUGE", "key": "paged_prefix_tokens_saved",
              "value": s["prefix_tokens_saved"]},
+            {"type": "GAUGE", "key": "paged_tp_degree",
+             "value": s["tp_degree"]},
         ] + (
             [
                 {"type": "GAUGE", "key": "speculative_acceptance_rate",

@@ -130,6 +130,7 @@ class SpeculativeGenerator:
         prompt_buckets: Optional[Sequence[int]] = None,
         dtype: Any = None,
         mesh: Any = None,
+        tp: Optional[int] = None,
         model_axis: str = "model",
         shard_min_weight_size: int = 16_384,
         quantize: str = "",
@@ -137,6 +138,14 @@ class SpeculativeGenerator:
         import jax
         import jax.numpy as jnp
 
+        # tensor-parallel knob (r11), same precedence as PagedEngine:
+        # an explicit mesh wins; otherwise tp= / SELDON_TPU_TP builds
+        # the {"model": tp} serving mesh, degrading to single-chip
+        # with a WARN when the host exposes fewer devices
+        if mesh is None:
+            from seldon_core_tpu.parallel.mesh import tp_mesh
+
+            mesh = tp_mesh(tp, axis=model_axis)
         if max_len % page_size:
             raise ValueError(f"max_len {max_len} must be a multiple of page_size {page_size}")
         if draft not in ("ngram", "model"):
@@ -350,6 +359,7 @@ class SpeculativeLM(TPUComponent):
         page_size: int = 64,
         seed: int = 0,
         mesh_axes: Optional[Dict[str, int]] = None,
+        tp: int = 0,
         quantize: str = "",
         **kwargs: Any,
     ):
@@ -369,8 +379,11 @@ class SpeculativeLM(TPUComponent):
         self.draft_config = dict(draft_config or {})
         self.page_size = int(page_size)
         self.seed = int(seed)
-        # same knob as StreamingLM: {"model": N} -> tensor-parallel decode
+        # same knob as StreamingLM: {"model": N} -> tensor-parallel decode;
+        # tp=N (or SELDON_TPU_TP when 0) is the deployment-facing
+        # spelling of mesh_axes={"model": N} — an explicit mesh_axes wins
         self.mesh_axes = dict(mesh_axes) if mesh_axes else None
+        self.tp = int(tp)
         from seldon_core_tpu.ops.surgery import validate_quantize_mode
 
         self.quantize = validate_quantize_mode(quantize)  # fail at construction
@@ -408,11 +421,15 @@ class SpeculativeLM(TPUComponent):
         from seldon_core_tpu.parallel.mesh import mesh_from_axes
 
         mesh = mesh_from_axes(self.mesh_axes)
+        # tp passed THROUGH so the generator resolves the knob exactly
+        # once: an explicit tp=1 here must force single-chip even with
+        # SELDON_TPU_TP exported (mesh_axes still wins)
         self.generator = SpeculativeGenerator(
             params, dtype=jnp.bfloat16, page_size=self.page_size,
             draft=self.draft, draft_k=self.draft_k, ngram=self.ngram,
             draft_params=draft_params, draft_config=self.draft_config,
-            mesh=mesh, quantize=self.quantize, **self.config,
+            mesh=mesh, tp=self.tp or None, quantize=self.quantize,
+            **self.config,
         )
 
     def predict(self, X, names, meta=None):

@@ -66,5 +66,62 @@ def mesh_from_axes(mesh_axes):
     return create_mesh(dict(mesh_axes)) if mesh_axes else None
 
 
+def resolve_tp(tp: Optional[int] = None) -> int:
+    """Tensor-parallel degree for the serving lanes: an explicit
+    ``tp`` argument wins (``1`` forces single-chip even with the env
+    var exported); ``None``/``0`` defers to ``SELDON_TPU_TP``, where
+    unset/empty/``0`` all spell OFF (= 1), matching every other
+    ``SELDON_TPU_*=0``-disables knob.  The ONE place the knob's
+    precedence lives, so the paged engine, the contiguous generator,
+    and the speculative lane cannot disagree about what a deployment
+    asked for."""
+    import os
+
+    if tp is None or int(tp) == 0:
+        raw = os.environ.get("SELDON_TPU_TP", "").strip()
+        tp = int(raw) if raw else 1
+        if tp == 0:
+            tp = 1
+    tp = int(tp)
+    if tp < 1:
+        raise ValueError(f"tensor-parallel degree must be >= 1, got {tp}")
+    return tp
+
+
+def tp_mesh(
+    tp: Optional[int] = None,
+    *,
+    axis: str = MODEL_AXIS,
+    strict: bool = False,
+):
+    """``{"model": tp}`` serving mesh, or ``None`` when TP is off.
+
+    ``tp=None``/``0`` defers to ``SELDON_TPU_TP`` (:func:`resolve_tp`).
+    When the host exposes fewer devices than the requested degree the
+    knob DEGRADES to single-chip (returns ``None``) with a WARN instead
+    of failing engine load — one serving config can roll out across
+    v5e-8 pods and single-chip dev hosts unchanged.  ``strict=True``
+    raises instead (the multichip dry-run / bench lanes, where a silent
+    degrade would certify the wrong thing)."""
+    tp = resolve_tp(tp)
+    if tp <= 1:
+        return None
+    import jax
+
+    devices = jax.devices()
+    if len(devices) < tp:
+        msg = (
+            f"tensor-parallel degree {tp} needs {tp} devices but the host "
+            f"exposes {len(devices)} — degrading to single-chip (tp=1)"
+        )
+        if strict:
+            raise ValueError(msg)
+        import logging
+
+        logging.getLogger(__name__).warning(msg)
+        return None
+    return create_mesh({axis: tp}, devices=devices[:tp])
+
+
 def mesh_shape(mesh) -> Dict[str, int]:
     return dict(zip(mesh.axis_names, mesh.devices.shape))

@@ -91,15 +91,50 @@ def shard_params(
     model_axis: str = MODEL_AXIS,
     min_weight_size: int = 16_384,
 ):
-    """device_put a parameter tree with tensor-parallel shardings."""
-    import jax
-    from jax.sharding import NamedSharding
+    """device_put a parameter tree with tensor-parallel shardings.
 
+    Un-annotatable leaves DEGRADE instead of failing engine load: a
+    leaf whose device_put rejects its inferred spec falls back to
+    replicated with a WARN, and a leaf that cannot be placed at all
+    passes through host-side (the jit tracing it will replicate it).
+    A checkpoint with one odd auxiliary leaf must not take the whole
+    serving engine down."""
+    import logging
+
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    log = logging.getLogger(__name__)
     if specs is None:
         specs = infer_param_specs(params, mesh, model_axis=model_axis, min_weight_size=min_weight_size)
-    return jax.tree.map(
-        lambda x, spec: jax.device_put(x, NamedSharding(mesh, spec)), params, specs
-    )
+
+    def put(x, spec):
+        # ONLY spec/placement rejections (ValueError: rank mismatch,
+        # indivisible dim; TypeError: non-array leaf) degrade — a
+        # device OOM (RESOURCE_EXHAUSTED RuntimeError) must propagate:
+        # retrying it replicated needs MORE memory, and a host-side
+        # fallback would hide a fatal capacity misconfiguration behind
+        # a per-call re-upload cliff
+        try:
+            return jax.device_put(x, NamedSharding(mesh, spec))
+        except (TypeError, ValueError):
+            if tuple(spec) != ():
+                log.warning(
+                    "parameter leaf %s (shape %s) rejected spec %s — "
+                    "falling back to replicated",
+                    type(x).__name__, getattr(x, "shape", "?"), spec,
+                )
+                try:
+                    return jax.device_put(x, NamedSharding(mesh, P()))
+                except (TypeError, ValueError):
+                    pass
+            log.warning(
+                "parameter leaf %s is not device-placeable — leaving it "
+                "host-side (jit will replicate it)", type(x).__name__,
+            )
+            return x
+
+    return jax.tree.map(put, params, specs)
 
 
 def shard_decode_state(

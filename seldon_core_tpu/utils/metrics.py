@@ -306,6 +306,15 @@ ENGINE_STATS_METRICS: Dict[str, Tuple[str, str, str]] = {
                             "seldon_tpu_engine_prefix_cache_pages_cached",
                             "pages parked on the LRU prefix cache "
                             "(refcount 0, reclaimable on demand)"),
+    # tensor-parallel lane (r11): capacity planning reads the PER-SHARD
+    # pool residency (the global pool is sliced over heads on the
+    # `model` axis, so per-device bytes shrink with the degree)
+    "tp_degree": ("gauge", "seldon_tpu_engine_tp_degree",
+                  "tensor-parallel degree the engine runs at "
+                  "(1 = single-chip)"),
+    "pool_shard_bytes": ("gauge", "seldon_tpu_engine_pool_shard_bytes",
+                         "K+V pool bytes ONE device holds (per-shard "
+                         "under tensor parallelism, full pool at tp=1)"),
 }
 
 # keys intentionally NOT exported as their own series: the wall-clock

@@ -142,6 +142,9 @@ def _r3_like_full_result():
                 "prefix_hit_pct": 100.0,
                 "prefix_tokens_saved": 12288,
                 "prefix_shared_mix": "16 streams, 256-token shared system prompt + distinct suffixes, 64 new tokens each",
+                "paged_tp_tokens_per_s": 8100.0,
+                "paged_tp_degree": 4,
+                "paged_tp_eff_pct": 46.0,
                 "goodput_pct": 97.2,
                 "shed_pct": 33.3,
                 "interactive_p99_ms": 240.5,
@@ -293,6 +296,65 @@ def test_compact_line_carries_overload_story(bench):
     assert "interactive_p99_x" not in e
     assert "interactive_unloaded_p99_ms" not in e
     assert "overload_mix" not in e
+
+
+def test_compact_line_carries_tp_story(bench):
+    """r11 certification keys: the tensor-parallel 16-stream serving
+    point and its per-chip efficiency vs the TP=1 ideal; the degree
+    itself stays in bench_full.json (`paged_tp_degree`)."""
+    compact = bench._compact_result(_r3_like_full_result())
+    e = compact["extra"]
+    assert isinstance(e["paged_tp_tok_s"], float)
+    assert e["paged_tp_tok_s"] == 8100.0
+    assert isinstance(e["paged_tp_eff_pct"], float)
+    assert e["paged_tp_eff_pct"] == 46.0
+    assert "paged_tp_degree" not in e
+
+
+def test_compact_line_tp_na_on_single_chip(bench):
+    """Single-chip hosts emit the literal "n/a" for the tp keys — the
+    compact line stays schema-stable everywhere (a missing key would
+    read as a phase crash, a 0.0 as a collapsed lane)."""
+    full = _r3_like_full_result()
+    full["extra"]["generation"]["paged_tp_tokens_per_s"] = "n/a"
+    full["extra"]["generation"]["paged_tp_eff_pct"] = "n/a"
+    full["extra"]["generation"]["paged_tp_degree"] = 1
+    compact = bench._compact_result(full)
+    assert compact["extra"]["paged_tp_tok_s"] == "n/a"
+    assert compact["extra"]["paged_tp_eff_pct"] == "n/a"
+
+
+def test_tp_hbm_accounting_per_shard():
+    """tp_degree > 1 prices the PER-SHARD bytes one device holds: every
+    KV term divides by the degree, so capacity under a fixed per-chip
+    budget SCALES with it."""
+    from seldon_core_tpu.models.paged import (
+        paged_capacity_streams,
+        paged_hbm_accounting,
+    )
+
+    kw = dict(d_model=512, num_layers=8, page_size=64, steps_per_call=8,
+              dtype_bytes=2, flat_pool=True, chunk_impl="ring")
+    one = paged_hbm_accounting(streams=4, ctx_len=512, **kw)
+    four = paged_hbm_accounting(streams=4, ctx_len=512, tp_degree=4, **kw)
+    assert four["pool_bytes"] == one["pool_bytes"] // 4
+    assert four["working_set_bytes"] == one["working_set_bytes"] // 4
+    assert four["tp_degree"] == 4 and one["tp_degree"] == 1
+    # an indivisible head count serves with a REPLICATED pool
+    # (shard_decode_state's fallback) — the accounting must price the
+    # full bytes, never certify capacity that config cannot deliver
+    rep = paged_hbm_accounting(
+        streams=4, ctx_len=512, tp_degree=4, num_heads=6, **kw
+    )
+    assert rep["pool_bytes"] == one["pool_bytes"] and rep["tp_degree"] == 1
+    ok = paged_hbm_accounting(
+        streams=4, ctx_len=512, tp_degree=4, num_heads=8, **kw
+    )
+    assert ok["pool_bytes"] == one["pool_bytes"] // 4
+    budget = 8 << 30
+    assert paged_capacity_streams(
+        budget, 512, tp_degree=4, **kw
+    ) >= 4 * paged_capacity_streams(budget, 512, **kw) - 4
 
 
 def test_prefix_capacity_accounting_reclaimable():

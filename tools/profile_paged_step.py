@@ -18,9 +18,13 @@ Run:  python tools/profile_paged_step.py [--steps 64] [--slots 16]
 """
 
 import argparse
+import os
+import sys
 import time
 
 import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def main():
@@ -35,6 +39,9 @@ def main():
     ap.add_argument("--pages", type=int, default=128)
     ap.add_argument("--max-len", type=int, default=2048)
     ap.add_argument("--repeats", type=int, default=5)
+    ap.add_argument("--tp", type=int, default=0,
+                    help="tensor-parallel degree (0 = SELDON_TPU_TP "
+                    "default, 1 = force single-chip)")
     args = ap.parse_args()
 
     import jax
@@ -61,15 +68,27 @@ def main():
         num_pages=args.pages,
         max_slots=args.slots,
         steps_per_call=args.steps,
+        tp=args.tp or None,
     )
 
     B, L = args.slots, args.layers
     h, hd = args.heads, args.d_model // args.heads
     params = eng.params
     # match the engine's pool layout (flat (L, pages, ps, d) by default
-    # since r5; split (L, pages, ps, h, hd) under kernel mode)
-    pk = jnp.zeros(eng.pages_k.shape, jnp.bfloat16)
-    pv = jnp.zeros_like(pk)
+    # since r5; split (L, pages, ps, h, hd) under kernel mode) AND its
+    # sharding — under a TP mesh the chunk program pins heads-sharded
+    # pools on its signature, so replicated zeros would pay a reshard
+    # copy every timed call.  Created ALREADY sharded (jit with
+    # out_shardings, same pattern as shard_decode_state): an eager
+    # jnp.zeros would materialise the full pool on one device first.
+    def _make_pool(ref):
+        return jax.jit(
+            lambda: jnp.zeros(ref.shape, ref.dtype),
+            out_shardings=ref.sharding,
+        )()
+
+    pk = _make_pool(eng.pages_k)
+    pv = _make_pool(eng.pages_v)
     logits = jnp.zeros((B, args.vocab), jnp.float32)
     # every slot mid-generation at a distinct length
     lengths = jnp.asarray(
@@ -176,7 +195,7 @@ def main():
         return best
 
     print(f"B={B} L={L} d={args.d_model} steps={args.steps} "
-          f"(one dispatch per timing; relay excluded)")
+          f"tp={eng.tp_degree} (one dispatch per timing; relay excluded)")
     timed("forward", jax.jit(forward_only), params, pk, pv, lengths)
     timed("write", jax.jit(write_only), pk, pv, lengths)
     timed("sample", jax.jit(sample_only), logits, keys)

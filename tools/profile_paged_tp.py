@@ -1,0 +1,216 @@
+"""Decompose the tensor-parallel paged decode chunk: which collectives
+GSPMD inserted, where the per-layer budget goes, and what the TP lane
+actually buys over single-chip.
+
+The TP engine (`PagedEngine(tp=N)`) pins megatron param specs and a
+heads-sharded KV pool on every program signature (`_tp_jit`), then lets
+XLA insert the collectives.  This harness makes that visible:
+
+1. **HLO collective audit** — lowers the TP chunk/prefill programs with
+   the engine's own annotation helper and counts the collective ops XLA
+   actually inserted (`all-reduce`, `all-gather`, `reduce-scatter`,
+   `collective-permute`), printed per program and divided per layer.
+   The expected shape for a megatron block is ONE all-reduce per
+   attention out-projection + ONE per MLP down-projection = 2/layer
+   in the forward; a higher count means the partitioner fell back to
+   resharding an activation (a spec bug worth chasing).
+2. **cost split** — XLA's compiled cost analysis (flops, bytes
+   accessed) for the TP program vs the TP=1 program: per-chip flops
+   must shrink ~1/N while collective bytes appear on the TP side.
+3. **measured contrast** (``--measure``) — the bench's min-of-3
+   serving protocol TP=N vs TP=1 on the same prompts, reporting
+   per-chip efficiency (`paged_tp_eff_pct`'s formula: per-chip tok/s
+   vs the TP=1 rate).
+
+Run:  python tools/profile_paged_tp.py [--tp 2] [--slots 8] [--steps 8]
+      [--measure] [--d-model 512] [--layers 8]
+
+Single-chip hosts degrade honestly: without ``--tp`` devices the tool
+prints the TP=1 audit (zero collectives — the byte-identical-program
+claim, checkable) instead of crashing.
+"""
+
+import argparse
+import os
+import sys
+import time
+from collections import Counter
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+COLLECTIVES = (
+    "all-reduce", "all-gather", "reduce-scatter", "collective-permute",
+    "all-to-all",
+)
+
+
+def collective_counts(hlo_text: str) -> Counter:
+    """Count collective instructions in HLO text (start/done pairs for
+    async collectives count once via the -start spelling)."""
+    counts: Counter = Counter()
+    for line in hlo_text.splitlines():
+        s = line.strip()
+        # instruction lines look like "%x = ... all-reduce(...)" or
+        # "... all-reduce-start(..."; match the op name at its call site
+        for op in COLLECTIVES:
+            if f" {op}(" in s or f" {op}-start(" in s:
+                counts[op] += 1
+    return counts
+
+
+def audit_program(name: str, lowered, num_layers: int):
+    compiled = lowered.compile()
+    try:
+        hlo = compiled.as_text()
+    except Exception:  # noqa: BLE001 — older jax spelling
+        hlo = "\n".join(
+            m.to_string() for m in compiled.runtime_executable().hlo_modules()
+        )
+    counts = collective_counts(hlo)
+    total = sum(counts.values())
+    cost = {}
+    try:
+        analyses = compiled.cost_analysis()
+        cost = analyses[0] if isinstance(analyses, (list, tuple)) else analyses
+    except Exception:  # noqa: BLE001 — cost analysis is backend-optional
+        pass
+    flops = float(cost.get("flops", 0.0)) if cost else 0.0
+    bytes_acc = float(cost.get("bytes accessed", 0.0)) if cost else 0.0
+    print(f"\n{name}:")
+    if total == 0:
+        print("  collectives: none (single-chip program)")
+    else:
+        per_layer = ", ".join(
+            f"{op}={n} ({n / num_layers:.1f}/layer)"
+            for op, n in sorted(counts.items())
+        )
+        print(f"  collectives: {total} total — {per_layer}")
+    if flops:
+        print(f"  per-chip cost: {flops / 1e9:.3f} GFLOP, "
+              f"{bytes_acc / 1e6:.1f} MB accessed")
+    return counts, flops
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--tp", type=int, default=0,
+                    help="TP degree (0 = largest of 4/2 the host fits)")
+    ap.add_argument("--slots", type=int, default=8)
+    ap.add_argument("--steps", type=int, default=8)
+    ap.add_argument("--d-model", type=int, default=512)
+    ap.add_argument("--layers", type=int, default=8)
+    ap.add_argument("--heads", type=int, default=8)
+    ap.add_argument("--vocab", type=int, default=32000)
+    ap.add_argument("--page-size", type=int, default=64)
+    ap.add_argument("--max-len", type=int, default=1024)
+    ap.add_argument("--new", type=int, default=64)
+    ap.add_argument("--measure", action="store_true",
+                    help="also time serving TP=N vs TP=1 (min-of-3)")
+    args = ap.parse_args()
+
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    from seldon_core_tpu.models.paged import PagedEngine
+    from seldon_core_tpu.models.transformer import TransformerLM
+
+    n_dev = len(jax.devices())
+    tp = args.tp or max((d for d in (4, 2) if d <= n_dev), default=1)
+    if tp > n_dev:
+        raise SystemExit(
+            f"--tp {tp} needs {tp} devices, host exposes {n_dev}"
+        )
+
+    cfg = dict(
+        vocab_size=args.vocab, d_model=args.d_model,
+        num_layers=args.layers, num_heads=args.heads, max_len=args.max_len,
+    )
+    lm = TransformerLM(dtype=jnp.bfloat16, **cfg)
+    params = lm.init(jax.random.key(0), jnp.zeros((1, 8), jnp.int32))["params"]
+
+    def build(tp_n):
+        # tp=1 passed EXPLICITLY: it forces single-chip even when
+        # SELDON_TPU_TP is exported in the shell — the tp=1 reference
+        # audit must never silently come up tensor-parallel
+        return PagedEngine(
+            params, dtype=jnp.bfloat16, page_size=args.page_size,
+            max_slots=args.slots, steps_per_call=args.steps,
+            tp=tp_n, **cfg,
+        )
+
+    pages = -(-args.max_len // args.page_size)
+    horizon = 1 << max(0, (pages - 1).bit_length())  # pow2 pages/slot
+
+    def lowered_chunk(eng):
+        """The engine's REAL chunk program, lowered through its own
+        shared audit surface (same body + annotation as serving)."""
+        return eng.lower_chunk(args.steps, ((args.slots, horizon),))
+
+    print(f"host devices={n_dev}  auditing tp={tp} vs tp=1  "
+          f"(d{args.d_model}/L{args.layers}, {args.slots} slots, "
+          f"{args.steps}-step chunk)")
+
+    eng1 = build(1)
+    c1, flops1 = audit_program(
+        f"chunk tp=1 ({args.steps} steps)", lowered_chunk(eng1), args.layers)
+    eng1.close()
+
+    if tp > 1:
+        engN = build(tp)
+        assert engN.tp_degree == tp, (
+            f"engine degraded to tp={engN.tp_degree} — host mesh too small"
+        )
+        cN, flopsN = audit_program(
+            f"chunk tp={tp} ({args.steps} steps)", lowered_chunk(engN),
+            args.layers)
+        engN.close()
+        assert sum(c1.values()) == 0, "tp=1 program must carry no collectives"
+        if flops1 and flopsN:
+            print(f"\nper-chip flops ratio tp{tp}/tp1: {flopsN / flops1:.3f} "
+                  f"(ideal {1 / tp:.3f})")
+
+    if args.measure:
+        rng = np.random.default_rng(0)
+        plen = max(8, min(64, (args.max_len - args.new) // 2))
+        prompts = [
+            rng.integers(0, args.vocab, size=(plen + (i % 5) * 2,)).astype(
+                np.int32)
+            for i in range(args.slots)
+        ]
+
+        def serve(tp_n):
+            eng = build(tp_n)
+            try:
+                def go():
+                    streams = [
+                        eng.submit(p, max_new_tokens=args.new)
+                        for p in prompts
+                    ]
+                    eng.run()
+                    return sum(int(s.result.shape[0]) for s in streams)
+
+                go()  # compiles
+                best = 0.0
+                for _ in range(3):
+                    t0 = time.perf_counter()
+                    n = go()
+                    best = max(best, n / (time.perf_counter() - t0))
+                return best
+            finally:
+                eng.close()
+
+        r1 = serve(1)
+        print(f"\nserving tp=1: {r1:,.0f} tok/s")
+        if tp > 1:
+            rN = serve(tp)
+            eff = 100.0 * (rN / tp) / max(r1, 1e-9)
+            print(f"serving tp={tp}: {rN:,.0f} tok/s "
+                  f"({rN / tp:,.0f} tok/s/chip, {eff:.1f}% per-chip "
+                  f"efficiency vs tp=1)")
+
+
+if __name__ == "__main__":
+    main()

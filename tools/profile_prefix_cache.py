@@ -64,6 +64,11 @@ def main():
     ap.add_argument("--dtype", choices=("f32", "bf16"), default="f32",
                     help="f32 (default): hard bit-exactness assert; "
                     "bf16: serving regime, argmax agreement reported")
+    ap.add_argument("--tp", type=int, default=0,
+                    help="tensor-parallel degree (0 = SELDON_TPU_TP "
+                    "default, 1 = force single-chip); cached pages are "
+                    "heads-sharded like the pool, so reuse works "
+                    "identically TP-on")
     args = ap.parse_args()
 
     import numpy as np
@@ -113,7 +118,8 @@ def main():
         eng = PagedEngine(
             params, dtype=dtype, page_size=args.page_size,
             max_slots=args.slots, steps_per_call=8, num_pages=num_pages,
-            prefix_cache=prefix_cache, **cfg,
+            prefix_cache=prefix_cache,
+            tp=args.tp or None, **cfg,
         )
         rows, outs = [], []
         t0 = time.perf_counter()
