@@ -203,6 +203,17 @@ COMPACT_PICKS = [
     ("goodput_pct", ("generation", "goodput_pct")),
     ("shed_pct", ("generation", "shed_pct")),
     ("interactive_p99_ms", ("generation", "interactive_p99_ms")),
+    # r12 self-healing certification: 2 remote workers, one SIGKILLed
+    # mid-load (no respawn) under transport.slow stragglers.
+    # chaos_goodput_pct = served/offered (gate >= 80 with half the
+    # fleet dead — breaker fast-fail + replica failover is what holds
+    # it); breaker_fastfail_pct = open-circuit pre-dial rejections /
+    # all transient touches of the dead endpoint (high = post-trip
+    # calls skip the retry+backoff ladder); hedge_win_pct = hedge wins
+    # / hedges fired (details in bench_full.json chaos)
+    ("chaos_goodput_pct", ("chaos", "chaos_goodput_pct")),
+    ("breaker_fastfail_pct", ("chaos", "breaker_fastfail_pct")),
+    ("hedge_win_pct", ("chaos", "hedge_win_pct")),
     # r7 observability certification: paged throughput cost of the FULL
     # observability stack (lifecycle spans + per-chunk flight recorder)
     # vs everything disabled, same 16-stream protocol both sides.
@@ -1340,6 +1351,13 @@ async def child_main() -> None:
             status["extra"]["trace_prop_error"] = str(e)[:200]
         _checkpoint(status)
 
+    if os.environ.get("BENCH_CHAOS", "1") == "1":
+        try:
+            status["extra"]["chaos"] = await chaos_phase()
+        except Exception as e:  # noqa: BLE001
+            status["extra"]["chaos_error"] = str(e)[:200]
+        _checkpoint(status)
+
     status["extra"]["mean_batch_rows"] = round(server.batcher.stats.mean_batch_rows, 2)
     status["extra"]["device_batches"] = server.batcher.stats.batches
     if native_handle is not None:
@@ -1457,6 +1475,175 @@ async def trace_prop_phase() -> dict:
             "transport telemetry vs both disabled"
         ),
     }
+
+
+async def chaos_phase() -> dict:
+    """Self-healing containment certification (r12): two remote
+    StreamingLM workers behind one BalancedClient graph edge, with
+    per-endpoint circuit breakers and hedged requests armed.  Load runs
+    in three acts:
+
+    1. straggler act — ``transport.slow`` (utils/faults.py) randomly
+       delays client attempts past the hedge delay, so hedges fire and
+       (usually) win;
+    2. kill act — one worker is SIGKILLed mid-load (its supervisor watch
+       is stopped first so it STAYS dead: this measures containment,
+       not respawn);
+    3. containment act — the dead endpoint's breaker trips after its
+       `failures` budget, every later rotation onto it fast-fails
+       pre-dial, and the BalancedClient failover keeps answering from
+       the survivor.
+
+    Compact keys: ``chaos_goodput_pct`` (served / offered, gate >= 80
+    with half the fleet dead), ``breaker_fastfail_pct`` (open-circuit
+    rejections / all transient touches of the dead endpoint — high
+    means post-trip calls skipped the retry+backoff ladder), and
+    ``hedge_win_pct`` (hedge wins / hedges fired).  Workers run on CPU
+    deliberately: the phase prices the containment plane, not decode,
+    and a TPU host must not have two child processes fighting for the
+    chip.
+    """
+    import asyncio
+
+    import numpy as np
+
+    from seldon_core_tpu.controlplane.autoscaler import _free_port
+    from seldon_core_tpu.controlplane.supervisor import ProcessSpec, Supervisor
+    from seldon_core_tpu.engine.graph import GRPC, Endpoint, UnitSpec
+    from seldon_core_tpu.engine.transport import (
+        BalancedClient,
+        CircuitBreaker,
+        GrpcClient,
+    )
+    from seldon_core_tpu.runtime.message import InternalMessage
+    from seldon_core_tpu.utils import faults as _faults
+
+    n_requests = 24 if QUICK else 48
+    hedge_ms = 150.0
+    worker_params = json.dumps([
+        {"name": "vocab_size", "value": "2048", "type": "INT"},
+        {"name": "d_model", "value": "64", "type": "INT"},
+        {"name": "num_layers", "value": "2", "type": "INT"},
+        {"name": "num_heads", "value": "4", "type": "INT"},
+        {"name": "max_len", "value": "128", "type": "INT"},
+        {"name": "max_new_tokens", "value": "16", "type": "INT"},
+        {"name": "page_size", "value": "16", "type": "INT"},
+        {"name": "max_slots", "value": "4", "type": "INT"},
+        {"name": "steps_per_call", "value": "4", "type": "INT"},
+        {"name": "seed", "value": "0", "type": "INT"},
+    ])
+    sup = Supervisor()
+    clients = []
+    balanced = None
+    prior_faults = os.environ.get(_faults.ENV_VAR)
+    CircuitBreaker.reset_all()
+    try:
+        grpc_ports = []
+        for i in range(2):
+            gp = _free_port()
+            await asyncio.to_thread(
+                sup.add,
+                ProcessSpec(
+                    name=f"chaos-lm-{i}",
+                    component="seldon_core_tpu.models.paged.StreamingLM",
+                    http_port=_free_port(),
+                    grpc_port=gp,
+                    parameters_json=worker_params,
+                    api="BOTH",
+                    # CPU on purpose (see docstring); clear TLS like the
+                    # deployer's DCN edges
+                    env={"JAX_PLATFORMS": "cpu", "SELDON_TPU_PLATFORM": "cpu",
+                         "SELDON_TLS_CERT": "", "SELDON_TLS_KEY": "",
+                         "SELDON_TLS_CA": ""},
+                ),
+                240.0,
+            )
+            grpc_ports.append(gp)
+        for gp in grpc_ports:
+            unit = UnitSpec(name="chaos-lm", type="MODEL")
+            unit.endpoint = Endpoint(host="127.0.0.1", port=gp, transport=GRPC)
+            clients.append(GrpcClient(
+                unit, deadline_s=30.0, retries=2,
+                breaker=CircuitBreaker.for_endpoint(
+                    f"127.0.0.1:{gp}", failures=3, reset_s=1.0, probes=1,
+                ),
+                hedge_ms=hedge_ms,
+            ))
+        balanced = BalancedClient(clients)
+        prompt_rng = np.random.default_rng(17)
+        prompts = [
+            prompt_rng.integers(0, 2048, size=(1, 12)).astype(np.int32)
+            for _ in range(4)
+        ]
+
+        async def one(i: int) -> bool:
+            msg = InternalMessage(payload=prompts[i % len(prompts)], kind="ndarray")
+            try:
+                out = await asyncio.wait_for(balanced.transform_input(msg), 60.0)
+                return out.status is None or out.status.get("status") != "FAILURE"
+            except Exception:  # noqa: BLE001 — a failed request is lost goodput
+                return False
+
+        # warm both workers directly (pays their first-request compiles
+        # outside the timed window)
+        for c in clients:
+            await c.transform_input(
+                InternalMessage(payload=prompts[0], kind="ndarray")
+            )
+        # act 1+: stragglers for the whole run — latency, not errors
+        _faults.inject("transport.slow", times=float("inf"), prob=0.25,
+                       delay_ms=2.5 * hedge_ms)
+        victim = sup.processes["chaos-lm-0"]
+        ok = 0
+        offered = 0
+        kill_at = n_requests // 3
+        t0 = time.perf_counter()
+        for i in range(n_requests):
+            if i == kill_at:
+                # stop the watch loop FIRST so the worker stays dead
+                # (containment, not respawn, is under test), then
+                # SIGKILL — no drain, no goodbye
+                victim._stop.set()
+                victim.proc.kill()
+            offered += 1
+            ok += bool(await one(i))
+        wall_s = time.perf_counter() - t0
+        dead = clients[0].breaker.stats()
+        hedges = sum(c.hedges_fired for c in clients)
+        wins = sum(c.hedge_wins for c in clients)
+        # of every transient touch of the dead endpoint after the kill,
+        # how many were pre-dial fast-fails instead of dial+retry
+        # ladders?  (the acceptance property: an open circuit costs one
+        # cheap rejection per rotation, not a backoff ladder)
+        touches = dead["fastfails"] + dead["transient_failures"]
+        return {
+            "chaos_goodput_pct": round(100.0 * ok / max(1, offered), 1),
+            "breaker_fastfail_pct": round(
+                100.0 * dead["fastfails"] / max(1, touches), 1
+            ),
+            "hedge_win_pct": round(100.0 * wins / max(1, hedges), 1),
+            "offered": offered,
+            "served": ok,
+            "wall_s": round(wall_s, 2),
+            "hedges_fired": hedges,
+            "hedge_wins": wins,
+            "dead_endpoint_breaker": dead,
+            "mix": (
+                f"{n_requests} unary requests round-robined over 2 remote "
+                f"StreamingLM workers; worker 0 SIGKILLed (no respawn) at "
+                f"request {kill_at}; transport.slow 25% x {2.5 * hedge_ms:.0f}ms; "
+                f"hedge {hedge_ms:.0f}ms; breaker failures=3 reset=1s"
+            ),
+        }
+    finally:
+        _faults.configure(prior_faults or "")
+        if balanced is not None:
+            try:
+                await balanced.close()
+            except Exception:  # noqa: BLE001
+                pass
+        await asyncio.to_thread(sup.stop_all)
+        CircuitBreaker.reset_all()
 
 
 def generation_phase() -> dict:

@@ -146,7 +146,7 @@ def build_generation(spec: TpuDeployment, device_ids: Optional[List[int]] = None
         raise
     return Generation(
         spec=spec,
-        gateway=Gateway(weighted, shadows=shadows),
+        gateway=Gateway(weighted, shadows=shadows, supervisor=supervisor),
         plan=plan,
         autoscalers=autoscalers,
         replicasets=replicasets,

@@ -40,14 +40,39 @@ class ProcessSpec:
     cwd: Optional[str] = None
 
 
+def _default_journal_path(spec: ProcessSpec) -> str:
+    """Stable-per-worker drain-journal path (r12): the SAME path across
+    respawns of one worker — a SIGTERM'd process drains its live
+    generation streams here and the respawned process replays them — but
+    distinct per (name, port) so two deployments' workers never read
+    each other's journals."""
+    import tempfile
+
+    return os.path.join(
+        tempfile.gettempdir(),
+        f"seldon-tpu-journal-{spec.name}-{spec.http_port}.jsonl",
+    )
+
+
 class SupervisedProcess:
     def __init__(self, spec: ProcessSpec, max_restarts: int = 5):
         self.spec = spec
         self.max_restarts = max_restarts
         self.restarts = 0
+        # restart budget spent and the process is gone: the worker is
+        # DEAD until redeployed.  Surfaced (not just logged) because the
+        # alert/breaker layer must be able to tell "restarting" from
+        # "the supervisor gave up" — the silent-dead state.
+        self.exhausted = False
         self.proc: Optional[subprocess.Popen] = None
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        # pin the drain/handoff journal path for every respawn of this
+        # worker (an explicit env wins — operators can point workers at
+        # persistent storage)
+        self.spec.env.setdefault(
+            "SELDON_TPU_DRAIN_JOURNAL", _default_journal_path(spec)
+        )
 
     def _command(self) -> List[str]:
         return [
@@ -78,6 +103,17 @@ class SupervisedProcess:
         self._thread = threading.Thread(target=self._watch, daemon=True, name=f"supervise-{self.spec.name}")
         self._thread.start()
 
+    def _record_health(self) -> None:
+        """Worker lifecycle → Prometheus (WorkerRestartsExhausted alerts
+        on the exhausted gauge).  Best-effort: a missing
+        prometheus_client must not take the watch loop down."""
+        try:
+            from seldon_core_tpu.utils.metrics import record_worker_health
+
+            record_worker_health(self.spec.name, self.restarts, self.exhausted)
+        except Exception:  # noqa: BLE001
+            logger.debug("worker health metric unavailable", exc_info=True)
+
     def _watch(self) -> None:
         backoff = 0.5
         while not self._stop.is_set():
@@ -86,9 +122,21 @@ class SupervisedProcess:
                 if self._stop.is_set():
                     return
                 if self.restarts >= self.max_restarts:
-                    logger.error("node %s exceeded restart budget (rc=%s)", self.spec.name, code)
+                    # NOT silent: the exhausted state is queryable
+                    # (Supervisor.health → gateway /debug/workers) and
+                    # exported, so the alert/breaker layer sees a dead
+                    # worker instead of inferring it from absence
+                    self.exhausted = True
+                    self._record_health()
+                    logger.error(
+                        "node %s exceeded restart budget (rc=%s) — worker is "
+                        "DEAD until redeployed (restarts=%d/%d); "
+                        "/debug/workers reports exhausted=true",
+                        self.spec.name, code, self.restarts, self.max_restarts,
+                    )
                     return
                 self.restarts += 1
+                self._record_health()
                 logger.warning(
                     "node %s exited rc=%s; restart %d/%d in %.1fs",
                     self.spec.name, code, self.restarts, self.max_restarts, backoff,
@@ -125,6 +173,12 @@ class SupervisedProcess:
         return False
 
     def stop(self, grace_s: float = 10.0) -> None:
+        """Deliberate teardown: SIGTERM (the worker drains its live
+        streams to the journal and exits — drain-then-exit), escalate to
+        SIGKILL after the grace window.  The journal is removed
+        afterwards: handoff exists for RESPAWN (crash / rolling
+        restart), not final teardown — a stale journal must not leak
+        into the next deployment that reuses the name+port."""
         self._stop.set()
         if self.proc is not None and self.proc.poll() is None:
             self.proc.terminate()
@@ -133,6 +187,12 @@ class SupervisedProcess:
             except subprocess.TimeoutExpired:
                 self.proc.kill()
                 self.proc.wait()
+        journal = self.spec.env.get("SELDON_TPU_DRAIN_JOURNAL")
+        if journal:
+            try:
+                os.unlink(journal)
+            except OSError:
+                pass  # never written / already consumed
 
 
 class Supervisor:
@@ -156,7 +216,29 @@ class Supervisor:
         self.processes.clear()
 
     def health(self) -> Dict[str, Dict]:
-        return {
-            name: {"alive": sp.alive(), "ready": sp.ready(), "restarts": sp.restarts}
-            for name, sp in self.processes.items()
-        }
+        """Per-worker lifecycle state.  ``exhausted`` is the
+        load-bearing new bit (r12): True means the restart budget is
+        spent and the worker is dead until redeployed — the state the
+        breaker/alert layer must distinguish from "restarting".
+        ``state`` summarises: running | restarting | exhausted |
+        stopped."""
+        out: Dict[str, Dict] = {}
+        for name, sp in self.processes.items():
+            alive = sp.alive()
+            if sp.exhausted:
+                state = "exhausted"
+            elif alive:
+                state = "running"
+            elif sp._stop.is_set():  # noqa: SLF001 — own class
+                state = "stopped"
+            else:
+                state = "restarting"
+            out[name] = {
+                "alive": alive,
+                "ready": sp.ready(),
+                "restarts": sp.restarts,
+                "max_restarts": sp.max_restarts,
+                "exhausted": sp.exhausted,
+                "state": state,
+            }
+        return out

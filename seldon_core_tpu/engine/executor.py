@@ -48,7 +48,14 @@ from seldon_core_tpu.engine.graph import (
     UnitSpec,
     validate_graph,
 )
-from seldon_core_tpu.engine.transport import GrpcClient, LocalClient, NodeClient, RestClient
+from seldon_core_tpu.engine.transport import (
+    CircuitBreaker,
+    GrpcClient,
+    LocalClient,
+    NodeClient,
+    RestClient,
+    breakers_enabled,
+)
 from seldon_core_tpu.runtime.component import MicroserviceError
 from seldon_core_tpu.runtime.message import InternalFeedback, InternalMessage, MsgMeta
 from seldon_core_tpu.runtime.params import parse_parameters
@@ -83,6 +90,14 @@ def build_client(unit: UnitSpec, annotations: Optional[Dict[str, str]] = None) -
     seldon.io/rest-connection-timeout (ms), seldon.io/rest-read-timeout
     (ms), seldon.io/rest-retries, seldon.io/grpc-read-timeout (ms),
     seldon.io/grpc-retries (attempt budget for transient statuses).
+
+    Failure containment (r12): seldon.io/breaker ("0"/"off" disables
+    circuit breaking for this deployment), seldon.io/breaker-failures
+    (consecutive transient failures to trip, default 5),
+    seldon.io/breaker-reset-ms (open→half-open cooldown, default 1000),
+    seldon.io/breaker-probes (concurrent half-open probes, default 2),
+    and seldon.io/hedge-ms (idempotent unary hedging delay; unset/0 =
+    off).
     """
     ann = annotations or {}
 
@@ -92,6 +107,28 @@ def build_client(unit: UnitSpec, annotations: Optional[Dict[str, str]] = None) -
         except (KeyError, ValueError):
             return default_s
 
+    def _int(key: str, default: int) -> int:
+        try:
+            return int(ann[key])
+        except (KeyError, ValueError):
+            return default
+
+    def _breaker(endpoint_key: str):
+        """The annotation-configured shared breaker for an endpoint, or
+        False (= off) when disabled by annotation or env."""
+        if not breakers_enabled() or str(
+            ann.get("seldon.io/breaker", "1")
+        ).lower() in ("0", "off", "false"):
+            return False
+        return CircuitBreaker.for_endpoint(
+            endpoint_key,
+            failures=_int("seldon.io/breaker-failures", 5),
+            reset_s=_ms("seldon.io/breaker-reset-ms", 1.0),
+            probes=_int("seldon.io/breaker-probes", 2),
+        )
+
+    hedge_ms = _ms("seldon.io/hedge-ms", 0.0) * 1000.0
+
     if not unit.remote:
         # in-process beats remote — unless the node is declared remote,
         # in which case implementation/component_class describe what the
@@ -100,7 +137,7 @@ def build_client(unit: UnitSpec, annotations: Optional[Dict[str, str]] = None) -
         if component is not None:
             if hasattr(component, "load"):
                 component.load()
-            return LocalClient(unit, component)
+            return LocalClient(unit, component, breaker=_breaker(f"local:{unit.name}"))
     elif unit.endpoint is None:
         raise MicroserviceError(
             f"node {unit.name!r} is remote but has no endpoint — deploy "
@@ -109,6 +146,7 @@ def build_client(unit: UnitSpec, annotations: Optional[Dict[str, str]] = None) -
             reason="BAD_GRAPH",
         )
     if unit.endpoint is not None:
+        endpoint_key = f"{unit.endpoint.host}:{unit.endpoint.port}"
         if unit.endpoint.transport == REST:
             try:
                 retries = int(ann.get("seldon.io/rest-retries", 3))
@@ -119,6 +157,8 @@ def build_client(unit: UnitSpec, annotations: Optional[Dict[str, str]] = None) -
                 connect_timeout_s=_ms("seldon.io/rest-connection-timeout", 2.0),
                 read_timeout_s=_ms("seldon.io/rest-read-timeout", 5.0),
                 retries=retries,
+                breaker=_breaker(endpoint_key),
+                hedge_ms=hedge_ms,
             )
         try:
             grpc_retries = int(ann.get("seldon.io/grpc-retries", 3))
@@ -128,6 +168,8 @@ def build_client(unit: UnitSpec, annotations: Optional[Dict[str, str]] = None) -
             unit,
             deadline_s=_ms("seldon.io/grpc-read-timeout", 5.0),
             retries=grpc_retries,
+            breaker=_breaker(endpoint_key),
+            hedge_ms=hedge_ms,
         )
     return None
 
@@ -245,7 +287,67 @@ class GraphExecutor:
         response.meta.puid = puid
         return response
 
+    @staticmethod
+    def _fallback_worthy(e: Exception) -> bool:
+        """Failures a fallback route may absorb: the primary's breaker
+        is open (CIRCUIT_OPEN), its retries exhausted transiently (502),
+        or it shed/refused transiently (503).  Deterministic errors
+        would fail identically on the fallback — that includes remote
+        4xx/plain-500 replies the transports re-raise as 502
+        UPSTREAM_*_ERROR, which is why the transports tag ``transient``
+        on the error (absent = transient: a bare component 503 like SHED
+        is still worth a degraded answer).  A spent deadline (504) has
+        no budget left to spend on a second subtree."""
+        if not isinstance(e, MicroserviceError):
+            return False
+        if e.reason == "DEADLINE_EXCEEDED":
+            return False
+        if e.status_code not in (502, 503):
+            return False
+        return getattr(e, "transient", True)
+
     async def _execute(
+        self,
+        unit: UnitSpec,
+        msg: InternalMessage,
+        puid: str,
+        routing: Dict[str, int],
+        request_path: Dict[str, str],
+        metrics: Dict[str, List[Dict]],
+    ) -> InternalMessage:
+        if unit.fallback is None:
+            return await self._execute_primary(
+                unit, msg, puid, routing, request_path, metrics
+            )
+        try:
+            return await self._execute_primary(
+                unit, msg, puid, routing, request_path, metrics
+            )
+        except MicroserviceError as e:
+            if not self._fallback_worthy(e):
+                raise
+            logger.warning(
+                "node %s failed (%s: %s) — taking fallback route %s",
+                unit.name, e.reason, e, unit.fallback.name,
+            )
+            self._emit("node_fallback", unit.name, e.reason)
+            from seldon_core_tpu.utils.metrics import increment_counter
+
+            increment_counter(
+                "seldon_tpu_graph_fallbacks_total",
+                "requests answered by a fallback route because the "
+                "primary's breaker was open or its retries exhausted",
+            )
+            out = await self._execute(
+                unit.fallback, msg, puid, routing, request_path, metrics
+            )
+            # tag the degraded answer: callers (and the bench) must be
+            # able to distinguish a fallback result from a primary one
+            out.meta.tags["degraded"] = True
+            out.meta.tags["fallback_for"] = unit.name
+            return out
+
+    async def _execute_primary(
         self,
         unit: UnitSpec,
         msg: InternalMessage,

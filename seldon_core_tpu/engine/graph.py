@@ -84,6 +84,13 @@ class UnitSpec:
     # microservice worker and fills in `endpoint` (the DCN edge — the
     # reference's engine->microservice pod-network hop)
     remote: bool = False
+    # degraded answer path (r12): a whole alternate subtree the executor
+    # runs INSTEAD of this node when this node's circuit breaker is open
+    # or its transport retries exhaust (502/503) — the reference's
+    # service-orchestrator failover idea made declarative.  The fallback
+    # result is tagged in meta (`degraded`/`fallback_for`) so callers
+    # and the bench can distinguish it from a primary answer.
+    fallback: Optional["UnitSpec"] = None
 
     def node_methods(self) -> List[str]:
         if self.type == UNKNOWN_TYPE:
@@ -94,9 +101,16 @@ class UnitSpec:
         return method in self.node_methods()
 
     def walk(self):
+        """Every node of the subtree, INCLUDING fallback subtrees — so
+        validation, client construction, placement and remote-worker
+        spawning all see fallback nodes exactly like primaries (a
+        fallback that was never built would fail at the worst moment:
+        while its primary is down)."""
         yield self
         for child in self.children:
             yield from child.walk()
+        if self.fallback is not None:
+            yield from self.fallback.walk()
 
     def clone(self) -> "UnitSpec":
         """Structural copy: fresh UnitSpec nodes, shared leaf values.
@@ -116,6 +130,7 @@ class UnitSpec:
             device_ids=list(self.device_ids),
             # endpoints are mutated by defaulting (port fill) — copy them
             endpoint=dataclasses.replace(self.endpoint) if self.endpoint else None,
+            fallback=self.fallback.clone() if self.fallback else None,
         )
 
     @classmethod
@@ -148,6 +163,7 @@ class UnitSpec:
             device_ids=list(d.get("deviceIds", d.get("device_ids", []))),
             sharding=d.get("sharding"),
             remote=bool(d.get("remote", False)),
+            fallback=cls.from_dict(d["fallback"]) if d.get("fallback") else None,
         )
 
     def to_dict(self) -> Dict[str, Any]:
@@ -174,16 +190,22 @@ class UnitSpec:
             out["remote"] = True
         if self.children:
             out["children"] = [c.to_dict() for c in self.children]
+        if self.fallback is not None:
+            out["fallback"] = self.fallback.to_dict()
         return out
 
 
 def validate_graph(root: UnitSpec) -> None:
     """Structural validation (reference: seldondeployment_webhook.go:358-446).
 
-    * node names unique
+    * node names unique (fallback subtrees included — `walk` yields them)
     * COMBINER needs >= 1 child; ROUTER needs >= 1 child
     * every node must be executable: a component, component_class,
       endpoint, or builtin implementation (or be a no-method pass-through)
+    * a fallback must be able to stand in for its primary: it (or its
+      subtree) must itself be executable, and a fallback node may not
+      declare its own fallback (one degradation step — a chain would
+      hide how degraded an answer actually is)
     """
     seen = set()
     for unit in root.walk():
@@ -194,6 +216,11 @@ def validate_graph(root: UnitSpec) -> None:
             raise GraphSpecError(f"COMBINER {unit.name!r} has no children")
         if unit.type == ROUTER and not unit.children:
             raise GraphSpecError(f"ROUTER {unit.name!r} has no children")
+        if unit.fallback is not None and unit.fallback.fallback is not None:
+            raise GraphSpecError(
+                f"fallback {unit.fallback.name!r} of {unit.name!r} declares "
+                "its own fallback: only one degradation step is allowed"
+            )
         executable = (
             unit.component is not None
             or unit.component_class

@@ -45,9 +45,15 @@ class Gateway:
         predictors: Sequence[Tuple[PredictorService, float]],
         shadows: Sequence[PredictorService] = (),
         seed: Optional[int] = None,
+        supervisor=None,
     ):
         if not predictors:
             raise ValueError("gateway needs at least one predictor")
+        # the Supervisor owning this deployment's remote workers (None
+        # when every node is in-process): /debug/workers reads through
+        # it so the breaker/alert layer can see a restart-exhausted
+        # (silently dead) worker instead of inferring it from absence
+        self.supervisor = supervisor
         self.entries: List[Tuple[PredictorService, float]] = list(predictors)
         total = sum(w for _, w in self.entries)
         if total <= 0:  # all-zero weights -> uniform
@@ -469,6 +475,21 @@ def build_gateway_app(gateway: Gateway, auth=None) -> web.Application:
                 out[svc.name] = nodes
         return web.json_response(out)
 
+    async def debug_workers(_r: web.Request) -> web.Response:
+        """Supervised-worker lifecycle (r12): alive/ready/restarts plus
+        the ``exhausted`` flag — a worker whose restart budget is spent
+        is DEAD until redeployed, and this endpoint is where the
+        breaker/alert layer (and operators) see that instead of
+        inferring it from connection refusals."""
+        sup = gateway.supervisor
+        health = sup.health() if sup is not None else {}
+        return web.json_response({
+            "workers": health,
+            "exhausted": sorted(
+                name for name, h in health.items() if h.get("exhausted")
+            ),
+        })
+
     async def debug_traces(request: web.Request) -> web.Response:
         """Spans from the in-process tracer ring: ``?trace_id=<puid>``
         for one trace (the engine request span + its gen.* lifecycle
@@ -516,6 +537,7 @@ def build_gateway_app(gateway: Gateway, auth=None) -> web.Application:
     app.router.add_route("*", "/unpause", unpause)
     app.router.add_get("/metrics", metrics_endpoint)
     app.router.add_get("/debug/engine", debug_engine)
+    app.router.add_get("/debug/workers", debug_workers)
     app.router.add_get("/debug/traces", debug_traces)
     return app
 

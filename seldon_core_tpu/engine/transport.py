@@ -27,6 +27,24 @@ native gRPC ``timeout`` clamped to it), the per-hop decrement the
 reference applies to its internal timeouts
 (reference: InternalPredictionService.java:80-98).
 
+Every client is a failure-containment hop (r12): a per-ENDPOINT
+:class:`CircuitBreaker` — shared by every caller that dials the
+endpoint, across all three lanes — fast-fails calls with a 503
+``CIRCUIT_OPEN`` *before* any dial/retry work while the endpoint is
+tripped (closed → open on consecutive transient failures → half-open
+probe trickle after the cooldown → closed on a probe success), so a
+flapping child costs its callers one cheap rejection instead of a full
+retry+backoff ladder per request.  Idempotent unary calls can opt into
+**hedging** (``seldon.io/hedge-ms``): a duplicate fired to the same
+endpoint after the delay races the original first-wins with loser
+cancellation — suppressed while the breaker is half-open and when the
+remaining deadline budget cannot cover a second attempt.  Retry
+backoff is full-jitter (:func:`backoff_s`): deterministic backoff
+synchronises callers into the retry storm ``TransportRetryStorm``
+alerts on.  ``SELDON_TPU_BREAKER=0`` disables breaking globally; with
+breakers off, hedging unset, and no fallback routes the transport is
+behaviour-identical to the pre-r12 engine.
+
 Every client is a tracing hop: the current span's W3C context is
 injected on the way out (REST headers, gRPC metadata, and
 ``InternalMessage.meta.trace_context`` for the local/native lanes), so
@@ -45,9 +63,12 @@ from __future__ import annotations
 import asyncio
 import json
 import logging
+import os
+import random
+import threading
 import time
 from contextlib import contextmanager
-from typing import Any, Callable, Dict, List, Optional
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from seldon_core_tpu.engine.graph import (
     AGGREGATE,
@@ -129,6 +150,253 @@ class _Hop:
                 span.tags["error"] = True
 
 
+def backoff_s(attempt: int, base_s: float = 0.05, cap_s: float = 2.0) -> float:
+    """Full-jitter exponential backoff for attempt ``attempt`` (0-based
+    retry index): uniform over [0, min(cap, base * 2^attempt)].
+
+    Deterministic backoff synchronises callers: every client that saw
+    the same failure retries at the same instant, so a restarting
+    upstream takes the whole herd again at once — the exact storm the
+    ``TransportRetryStorm`` alert pages on.  Full jitter (AWS
+    architecture-blog discipline) spreads the herd over the window."""
+    return random.uniform(0.0, min(cap_s, base_s * (2 ** max(0, attempt))))
+
+
+# ---------------------------------------------------------------------------
+# per-endpoint circuit breakers
+# ---------------------------------------------------------------------------
+
+BREAKER_CLOSED = "closed"
+BREAKER_OPEN = "open"
+BREAKER_HALF_OPEN = "half_open"
+
+
+def breakers_enabled() -> bool:
+    """SELDON_TPU_BREAKER=0 disables circuit breaking globally (the
+    parity lane: breaker-off behaviour is byte-identical to the
+    pre-breaker engine)."""
+    return os.environ.get("SELDON_TPU_BREAKER", "1") != "0"
+
+
+class CircuitBreaker:
+    """One endpoint's failure-containment state machine, SHARED by every
+    client that dials the endpoint (keyed by endpoint, not caller: a
+    flapping child must fail fast for all of its callers at once, not be
+    re-probed by each on every request).
+
+    closed --[``failures`` consecutive transient failures]--> open
+    open   --[``reset_s`` cooldown elapsed]-->                half-open
+    half-open --[a probe succeeds]-->                         closed
+    half-open --[a probe fails transiently]-->                open
+
+    While open, :meth:`acquire` raises a 503 ``CIRCUIT_OPEN``
+    *before* any dial/retry ladder — the same pre-dispatch fast-fail
+    discipline as the deadline check.  While half-open, at most
+    ``probes`` concurrent calls pass through as probes; the rest keep
+    fast-failing so a recovering upstream is not re-stampeded.
+
+    Only *transient* outcomes (the retry classifier's set: UNAVAILABLE /
+    DEADLINE_EXCEEDED / RESOURCE_EXHAUSTED statuses, REST 502/503/504,
+    connection faults) count toward a trip; a deterministic reply (4xx,
+    plain 500) proves the endpoint is alive and RESETS the streak.
+    """
+
+    _registry: Dict[str, "CircuitBreaker"] = {}
+    _registry_lock = threading.Lock()
+
+    def __init__(self, key: str, failures: int = 5, reset_s: float = 1.0,
+                 probes: int = 2):
+        self.key = key
+        self.failures = max(1, int(failures))
+        self.reset_s = float(reset_s)
+        self.probes = max(1, int(probes))
+        self._lock = threading.Lock()
+        self._state = BREAKER_CLOSED
+        self._streak = 0  # consecutive transient failures while closed
+        self._open_until = 0.0
+        self._probes_inflight = 0
+        # incident counters (bench + tests read these; prometheus gets
+        # transitions/fastfails through utils.metrics)
+        self.counters = {
+            "trips": 0, "reopens": 0, "closes": 0,
+            "fastfails": 0, "probes": 0, "transient_failures": 0,
+        }
+
+    # ---- registry ---------------------------------------------------------
+
+    @classmethod
+    def for_endpoint(cls, key: str, failures: int = 5, reset_s: float = 1.0,
+                     probes: int = 2) -> "CircuitBreaker":
+        """The shared breaker for ``key`` (created on first use;
+        first-creator's config wins — per-endpoint knobs come from ONE
+        deployment's annotations, so racing configs don't happen in
+        practice)."""
+        with cls._registry_lock:
+            b = cls._registry.get(key)
+            if b is None:
+                b = cls(key, failures=failures, reset_s=reset_s, probes=probes)
+                cls._registry[key] = b
+            return b
+
+    @classmethod
+    def discard(cls, key: str) -> None:
+        """Evict one endpoint's breaker (replica retirement: autoscaled
+        replicas use fresh ephemeral ports, so without eviction the
+        registry — and the per-endpoint breaker-state label series —
+        would grow monotonically with every scale event, the same leak
+        the gRPC channel cache eviction exists for)."""
+        with cls._registry_lock:
+            cls._registry.pop(key, None)
+
+    @classmethod
+    def reset_all(cls) -> None:
+        """Drop every registered breaker (test isolation; a fresh
+        deployment starts every endpoint closed)."""
+        with cls._registry_lock:
+            cls._registry.clear()
+
+    # ---- state machine ----------------------------------------------------
+
+    @property
+    def state(self) -> str:
+        with self._lock:
+            return self._effective_state_locked()
+
+    def _effective_state_locked(self) -> str:
+        """OPEN lazily decays to HALF_OPEN when the cooldown elapsed —
+        computed on read so no timer thread is needed."""
+        if self._state == BREAKER_OPEN and \
+                time.monotonic() >= self._open_until:
+            self._state = BREAKER_HALF_OPEN
+            self._probes_inflight = 0
+            self._note_transition(BREAKER_HALF_OPEN)
+        return self._state
+
+    def _note_transition(self, to_state: str) -> None:
+        _metrics.record_breaker_state(self.key, to_state)
+
+    def acquire(self, unit: str, method: str, transport: str) -> bool:
+        """Admission decision for one call: returns True when the call
+        is a half-open PROBE (the caller must report its outcome), False
+        on the ordinary closed path — or raises the 503 ``CIRCUIT_OPEN``
+        fast-fail before any dispatch work happens."""
+        with self._lock:
+            state = self._effective_state_locked()
+            if state == BREAKER_CLOSED:
+                return False
+            if state == BREAKER_HALF_OPEN and \
+                    self._probes_inflight < self.probes:
+                self._probes_inflight += 1
+                self.counters["probes"] += 1
+                return True
+            self.counters["fastfails"] += 1
+            remaining = max(0.0, self._open_until - time.monotonic())
+        _metrics.record_breaker_fastfail(unit, method, transport)
+        raise MicroserviceError(
+            f"circuit open for {self.key}: {self.failures} consecutive "
+            f"transient failures tripped the breaker (node {unit!r} "
+            f"{method}; next probe in {remaining:.2f}s)",
+            status_code=503, reason="CIRCUIT_OPEN",
+        )
+
+    def on_transient(self) -> None:
+        """One transient failure ATTEMPT (counts toward the trip
+        threshold; any transient failure while half-open reopens
+        immediately).  Probe-slot release is separate (:meth:`release`)
+        so a multi-attempt call reports per-attempt evidence but
+        settles exactly once."""
+        with self._lock:
+            self.counters["transient_failures"] += 1
+            state = self._effective_state_locked()
+            if state == BREAKER_HALF_OPEN:
+                self._state = BREAKER_OPEN
+                self._open_until = time.monotonic() + self.reset_s
+                self._streak = 0
+                self.counters["reopens"] += 1
+                self._note_transition(BREAKER_OPEN)
+                return
+            if state == BREAKER_CLOSED:
+                self._streak += 1
+                if self._streak >= self.failures:
+                    self._state = BREAKER_OPEN
+                    self._open_until = time.monotonic() + self.reset_s
+                    self._streak = 0
+                    self.counters["trips"] += 1
+                    self._note_transition(BREAKER_OPEN)
+
+    def release(self, probe: bool, healthy: Optional[bool]) -> None:
+        """Settle one admitted call.  ``healthy=True`` (a reply came
+        back — success OR a deterministic error: the endpoint answered)
+        resets the streak and closes a half-open breaker; ``False``
+        (transient exhaustion — the attempts already counted) and
+        ``None`` (cancelled, no evidence) only release the probe slot."""
+        with self._lock:
+            if probe:
+                self._probes_inflight = max(0, self._probes_inflight - 1)
+            if healthy:
+                self._streak = 0
+                if self._state == BREAKER_HALF_OPEN:
+                    self._state = BREAKER_CLOSED
+                    self.counters["closes"] += 1
+                    self._note_transition(BREAKER_CLOSED)
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            return {"state": self._effective_state_locked(),
+                    "streak": self._streak, **self.counters}
+
+
+class _BreakerCall:
+    """Pairs one breaker acquire with exactly one settle.  The clients
+    thread it through their try/except/finally so every exit path
+    (success, transient exhaustion, deterministic error, hedge-loser
+    cancellation) releases the probe slot exactly once, while
+    per-attempt transient evidence feeds the trip threshold as it
+    happens."""
+
+    __slots__ = ("breaker", "probe", "_settled")
+
+    def __init__(self, breaker: Optional["CircuitBreaker"],
+                 unit: str, method: str, transport: str):
+        self.breaker = breaker
+        self.probe = (
+            breaker.acquire(unit, method, transport)
+            if breaker is not None else False
+        )
+        self._settled = breaker is None
+
+    def attempt_transient(self) -> None:
+        """One transient failure attempt (mid- or end-of-ladder)."""
+        if self.breaker is not None:
+            self.breaker.on_transient()
+
+    def settle(self, healthy: Optional[bool]) -> None:
+        if not self._settled:
+            self._settled = True
+            self.breaker.release(self.probe, healthy)
+
+    def open_now(self) -> bool:
+        """True when the breaker is no longer closed — the retry ladder
+        reads this between attempts so an open circuit stops the ladder
+        instead of burning the remaining backoff budget."""
+        return (
+            self.breaker is not None
+            and self.breaker.state != BREAKER_CLOSED
+        )
+
+
+def _resolve_breaker(key: str, breaker) -> Optional[CircuitBreaker]:
+    """Ctor-argument convention shared by the three client lanes:
+    ``None`` = the endpoint's shared default breaker (unless globally
+    disabled), ``False`` = breaker off for this client, an instance =
+    use it (build_client passes annotation-configured ones)."""
+    if breaker is False:
+        return None
+    if isinstance(breaker, CircuitBreaker):
+        return breaker
+    return CircuitBreaker.for_endpoint(key) if breakers_enabled() else None
+
+
 class NodeClient:
     """Async invocation surface for one graph node."""
 
@@ -164,9 +432,16 @@ class LocalClient(NodeClient):
     the wire, so dispatch parents identically whichever path survived
     (a queue hand-off loses the contextvar; the meta doesn't)."""
 
-    def __init__(self, unit: UnitSpec, component: Any):
+    def __init__(self, unit: UnitSpec, component: Any, breaker=None):
         self.unit = unit
         self.component = component
+        # local lane breaker: keyed by unit (there is no endpoint), and
+        # tripped ONLY by crash-shaped errors (non-MicroserviceError
+        # exceptions).  A well-formed MicroserviceError — 4xx, SHED, an
+        # engine's contained chunk fault — is the component SPEAKING,
+        # not dead; counting those would turn load shedding into a
+        # self-inflicted outage.
+        self.breaker = _resolve_breaker(f"local:{unit.name}", breaker)
 
     async def _run(self, fn, *args):
         from seldon_core_tpu.runtime.executor_pool import run_dispatch
@@ -186,13 +461,28 @@ class LocalClient(NodeClient):
         # spent budget: fail before dispatch — the model must never see
         # a request its caller has already abandoned
         _deadlines.check(f"node {self.unit.name!r} {method} (local)")
+        # open breaker: fail before dispatch too (same discipline; the
+        # acquire raises the 503 CIRCUIT_OPEN fast-fail itself)
+        call = _BreakerCall(self.breaker, self.unit.name, method, "local")
         hop = _Hop(self.unit.name, method, "local")
         ok = False
+        healthy: Optional[bool] = False
         try:
             out = await factory()
             ok = True
+            healthy = True
             return out
+        except MicroserviceError:
+            healthy = True  # a well-formed error is the component speaking
+            raise
+        except asyncio.CancelledError:
+            healthy = None
+            raise
+        except Exception:
+            call.attempt_transient()  # crash-shaped: counts toward the trip
+            raise
         finally:
+            call.settle(healthy)
             hop.finish(error=not ok)
 
     async def transform_input(self, msg: InternalMessage) -> InternalMessage:
@@ -240,6 +530,75 @@ class LocalClient(NodeClient):
         return True
 
 
+async def _hedged_call(client, method: str, transport: str, factory):
+    """First-wins hedging for one idempotent unary call (opt-in via the
+    per-node ``seldon.io/hedge-ms`` annotation): when the primary has
+    not answered within ``hedge_ms``, fire ONE duplicate of the same
+    call to the same endpoint and return whichever finishes first,
+    cancelling the loser.  A straggler then costs ~hedge_ms + a median
+    service time instead of a full tail quantile.
+
+    Suppressed (plain single call) when:
+    * hedging is off for this client (``hedge_ms <= 0``),
+    * the endpoint's breaker is not CLOSED — a half-open upstream is
+      being probed at a deliberate trickle, and doubling traffic into
+      it is exactly how recovering services get re-killed,
+    * the remaining end-to-end budget cannot cover a second attempt
+      (``remaining <= hedge_ms``: by the time the hedge would fire the
+      deadline is spent — the duplicate could never win).
+
+    Error semantics: the FIRST completed success wins; if one lane
+    errors the other's outcome is awaited; when both error, the
+    primary's error surfaces (it carries the fuller attempt history).
+    """
+    if client.hedge_ms <= 0:
+        return await factory()
+    breaker = client.breaker
+    if breaker is not None and breaker.state != BREAKER_CLOSED:
+        return await factory()
+    ambient = _deadlines.current_deadline()
+    if ambient is not None and ambient.remaining_ms() <= client.hedge_ms:
+        return await factory()
+    primary = asyncio.ensure_future(factory())
+    await asyncio.wait({primary}, timeout=client.hedge_ms / 1000.0)
+    if primary.done():
+        return primary.result()  # raises the primary's error unchanged
+    client.hedges_fired += 1
+    _metrics.record_transport_hedge(client.unit.name, method, transport)
+    hedge = asyncio.ensure_future(factory())
+    pending = {primary, hedge}
+    errors: List[Tuple[Any, BaseException]] = []
+    try:
+        while pending:
+            done, pending = await asyncio.wait(
+                pending, return_when=asyncio.FIRST_COMPLETED
+            )
+            for task in done:
+                if task.cancelled():
+                    continue
+                exc = task.exception()
+                if exc is not None:
+                    errors.append((task, exc))
+                    continue
+                if task is hedge:
+                    client.hedge_wins += 1
+                    _metrics.record_transport_hedge(
+                        client.unit.name, method, transport, won=True
+                    )
+                return task.result()
+    finally:
+        # loser cancellation — and on any exit, never leak a task
+        for task in (primary, hedge):
+            if not task.done():
+                task.cancel()
+        await asyncio.gather(primary, hedge, return_exceptions=True)
+    # both lanes failed: surface the primary's error (fuller history)
+    for task, exc in errors:
+        if task is primary:
+            raise exc
+    raise errors[0][1]
+
+
 _METHOD_TO_SERVICE = {
     # method -> (service, rpc, REST path)
     "predict": ("Model", "Predict", "/predict"),
@@ -282,13 +641,23 @@ class GrpcClient(NodeClient):
     # be garbage-collected mid-sleep and leak the channel's sockets
     _closers: set = set()
 
-    def __init__(self, unit: UnitSpec, deadline_s: float = 5.0, retries: int = 3):
+    def __init__(self, unit: UnitSpec, deadline_s: float = 5.0, retries: int = 3,
+                 breaker=None, hedge_ms: float = 0.0):
         if unit.endpoint is None:
             raise ValueError(f"GrpcClient for {unit.name!r} needs an endpoint")
         self.unit = unit
         self.addr = f"{unit.endpoint.host}:{unit.endpoint.port}"
         self.deadline_s = deadline_s
         self.retries = max(1, int(retries))
+        # per-endpoint breaker, SHARED with every other client dialling
+        # this address (None = registry default, False = off, instance =
+        # annotation-configured by build_client)
+        self.breaker = _resolve_breaker(self.addr, breaker)
+        # hedging (seldon.io/hedge-ms): after hedge_ms with no reply, a
+        # duplicate of the same idempotent call races the original
+        self.hedge_ms = float(hedge_ms)
+        self.hedges_fired = 0
+        self.hedge_wins = 0
 
     def _channel(self):
         import grpc
@@ -347,8 +716,12 @@ class GrpcClient(NodeClient):
         if service_override:
             service = service_override
         _deadlines.check(f"node {self.unit.name!r} {method} (grpc {self.addr})")
+        # open breaker: fast-fail BEFORE the codec/dial work, like the
+        # deadline check above (acquire raises the 503 CIRCUIT_OPEN)
+        call = _BreakerCall(self.breaker, self.unit.name, method, "grpc")
         hop = _Hop(self.unit.name, method, "grpc")
         ok = False
+        healthy: Optional[bool] = False
         try:
             with hop.codec():
                 request_proto = build()
@@ -366,6 +739,12 @@ class GrpcClient(NodeClient):
                         f"node {self.unit.name!r} {method} retry "
                         f"{attempt + 1} (grpc {self.addr})"
                     )
+                    if call.open_now():
+                        # the circuit opened mid-ladder (this call's own
+                        # failures crossed the threshold, or a sibling's
+                        # did): stop burning the retry/backoff budget —
+                        # the accumulated error surfaces below
+                        break
                 # re-inject PER ATTEMPT: the remaining budget shrank by
                 # whatever the failed attempt burned — resending the
                 # pre-attempt value would refund it downstream
@@ -379,7 +758,10 @@ class GrpcClient(NodeClient):
                     timeout_s = max(0.001, min(timeout_s, ambient.remaining_s()))
                 t_attempt = time.perf_counter()
                 try:
-                    delay = _faults.delay_s("transport.delay")
+                    delay = (
+                        _faults.delay_s("transport.delay")
+                        + _faults.delay_s("transport.slow")
+                    )
                     if delay:
                         await asyncio.sleep(delay)
                     _faults.raise_if("transport.drop")
@@ -390,6 +772,7 @@ class GrpcClient(NodeClient):
                     with hop.codec():
                         out = InternalMessage.from_proto(resp)
                     ok = True
+                    healthy = True
                     return out
                 except Exception as e:  # grpc.aio.AioRpcError and friends
                     last = e
@@ -400,18 +783,27 @@ class GrpcClient(NodeClient):
                             (time.perf_counter() - t_attempt) * 1000.0, 3
                         ),
                     })
+                    retryable = _grpc_retryable(e)
+                    if retryable:
+                        call.attempt_transient()
+                    else:
+                        # a deterministic reply proves the endpoint is
+                        # alive — it must not count toward a trip
+                        healthy = True
                     if _grpc_status_name(e) == "UNAVAILABLE":
                         # fresh channel for the next attempt (or the
                         # next CALL): the old one is in reconnect
                         # backoff and would fail fast for its duration
                         await self._reset_channel()
-                    if not _grpc_retryable(e) or attempt + 1 >= budget:
+                    if not retryable or attempt + 1 >= budget:
                         break
                     logger.warning(
                         "gRPC %s to %s attempt %d/%d failed: %s",
                         method, self.addr, attempt + 1, budget, e,
                     )
-                    await asyncio.sleep(0.05 * (attempt + 1))
+                    # full jitter: synchronized deterministic backoff is
+                    # the retry-storm shape (TransportRetryStorm)
+                    await asyncio.sleep(backoff_s(attempt))
             err = MicroserviceError(
                 f"gRPC call {method} to {self.addr} failed: {last} "
                 f"(attempts: {json.dumps(attempts)})",
@@ -419,19 +811,35 @@ class GrpcClient(NodeClient):
                 reason="UPSTREAM_GRPC_ERROR",
             )
             err.attempts = attempts  # machine-readable per-attempt history
+            # transience classification for the fallback layer: a
+            # deterministic upstream reply (INVALID_ARGUMENT, ...) would
+            # fail identically on a fallback route — only transient
+            # exhaustion is worth a degraded answer
+            err.transient = last is None or _grpc_retryable(last)
             raise err from last
+        except asyncio.CancelledError:
+            healthy = None  # hedge loser / caller gone: no evidence
+            raise
         finally:
+            call.settle(healthy)
             hop.finish(error=not ok)
 
     async def transform_input(self, msg: InternalMessage) -> InternalMessage:
         method = "predict" if self.unit.type == MODEL else "transform_input"
-        return await self._call(method, msg.to_proto)
+        return await _hedged_call(
+            self, method, "grpc", lambda: self._call(method, msg.to_proto)
+        )
 
     async def transform_output(self, msg: InternalMessage) -> InternalMessage:
-        return await self._call("transform_output", msg.to_proto)
+        return await _hedged_call(
+            self, "transform_output", "grpc",
+            lambda: self._call("transform_output", msg.to_proto),
+        )
 
     async def route(self, msg: InternalMessage) -> InternalMessage:
-        return await self._call("route", msg.to_proto)
+        return await _hedged_call(
+            self, "route", "grpc", lambda: self._call("route", msg.to_proto)
+        )
 
     async def aggregate(self, msgs: List[InternalMessage]) -> InternalMessage:
         def build():
@@ -439,7 +847,9 @@ class GrpcClient(NodeClient):
 
             return pb.SeldonMessageList(seldonMessages=[m.to_proto() for m in msgs])
 
-        return await self._call("aggregate", build)
+        return await _hedged_call(
+            self, "aggregate", "grpc", lambda: self._call("aggregate", build)
+        )
 
     async def send_feedback(self, feedback: InternalFeedback) -> InternalMessage:
         # not idempotent: a deadline after the reward was applied must
@@ -459,12 +869,13 @@ class GrpcClient(NodeClient):
             return False
 
     async def close(self) -> None:
-        """Close and evict this endpoint's cached channel (replica
-        retirement: the address is never reused, so the cache entry
-        would otherwise leak forever)."""
+        """Close and evict this endpoint's cached channel AND its
+        registry breaker (replica retirement: the address is never
+        reused, so both entries would otherwise leak forever)."""
         chan = GrpcClient._channels.pop(self.addr, None)
         if chan is not None:
             await chan.close()
+        CircuitBreaker.discard(self.addr)
 
     @classmethod
     async def close_all(cls) -> None:
@@ -495,6 +906,8 @@ class RestClient(NodeClient):
         connect_timeout_s: float = 2.0,
         read_timeout_s: float = 5.0,
         retries: int = 3,
+        breaker=None,
+        hedge_ms: float = 0.0,
     ):
         if unit.endpoint is None:
             raise ValueError(f"RestClient for {unit.name!r} needs an endpoint")
@@ -503,6 +916,14 @@ class RestClient(NodeClient):
         self.connect_timeout_s = connect_timeout_s
         self.read_timeout_s = read_timeout_s
         self.retries = max(1, int(retries))
+        # shared per-endpoint breaker + opt-in hedging: same semantics
+        # as GrpcClient (the two remote lanes must not drift)
+        self.breaker = _resolve_breaker(
+            f"{unit.endpoint.host}:{unit.endpoint.port}", breaker
+        )
+        self.hedge_ms = float(hedge_ms)
+        self.hedges_fired = 0
+        self.hedge_wins = 0
         self._session = None
 
     def _get_session(self):
@@ -523,8 +944,12 @@ class RestClient(NodeClient):
         idempotent: bool = True,
     ) -> InternalMessage:
         _deadlines.check(f"node {self.unit.name!r} {method} (rest {self.base})")
+        # open breaker: fast-fail BEFORE the codec/dial work (the
+        # acquire raises the 503 CIRCUIT_OPEN)
+        call = _BreakerCall(self.breaker, self.unit.name, method, "rest")
         hop = _Hop(self.unit.name, method, "rest")
         ok = False
+        healthy: Optional[bool] = False
         try:
             with hop.codec():
                 data = json.dumps(encode()).encode()
@@ -540,13 +965,21 @@ class RestClient(NodeClient):
                         f"node {self.unit.name!r} {method} retry "
                         f"{attempt + 1} (rest {self.base})"
                     )
+                    if call.open_now():
+                        # circuit opened mid-ladder: stop burning the
+                        # retry/backoff budget, surface the accumulated
+                        # error below
+                        break
                 # re-inject PER ATTEMPT: the remaining budget shrank by
                 # whatever the failed attempt burned — resending the
                 # pre-attempt value would refund it downstream
                 headers = _deadlines.inject(dict(base_headers))
                 t_attempt = time.perf_counter()
                 try:
-                    delay = _faults.delay_s("transport.delay")
+                    delay = (
+                        _faults.delay_s("transport.delay")
+                        + _faults.delay_s("transport.slow")
+                    )
                     if delay:
                         await asyncio.sleep(delay)
                     _faults.raise_if("transport.drop")
@@ -573,24 +1006,38 @@ class RestClient(NodeClient):
                                 status_code=502,
                                 reason="UPSTREAM_REST_ERROR",
                             )
-                            if (
+                            # deterministic upstream replies (4xx, plain
+                            # 500) must not be retried here NOR absorbed
+                            # by a fallback route upstream
+                            err.transient = (
                                 resp.status in _REST_RETRYABLE_STATUSES
-                                and attempt + 1 < budget
-                            ):
-                                last_err = err
-                                logger.warning(
-                                    "REST %s to %s attempt %d/%d got %d, retrying",
-                                    path, self.base, attempt + 1, budget, resp.status,
-                                )
-                                await asyncio.sleep(0.05 * (2 ** attempt))
-                                continue
+                            )
+                            if resp.status in _REST_RETRYABLE_STATUSES:
+                                # overloaded/mid-restart: breaker-transient
+                                call.attempt_transient()
+                                if attempt + 1 < budget:
+                                    last_err = err
+                                    logger.warning(
+                                        "REST %s to %s attempt %d/%d got %d, retrying",
+                                        path, self.base, attempt + 1, budget, resp.status,
+                                    )
+                                    await asyncio.sleep(backoff_s(attempt))
+                                    continue
+                            else:
+                                # deterministic reply: the endpoint is
+                                # alive — never counts toward a trip
+                                healthy = True
                             err.attempts = attempts
                             raise err
                         with hop.codec():
                             out = InternalMessage.from_json(payload)
                         ok = True
+                        healthy = True
                         return out
                 except MicroserviceError:
+                    raise
+                except asyncio.CancelledError:
+                    healthy = None  # hedge loser / caller gone
                     raise
                 except Exception as e:  # connection faults: transient by class
                     last_err = e
@@ -601,13 +1048,16 @@ class RestClient(NodeClient):
                             (time.perf_counter() - t_attempt) * 1000.0, 3
                         ),
                     })
+                    call.attempt_transient()
                     if attempt + 1 >= budget:
                         break
                     logger.warning(
                         "REST %s to %s attempt %d/%d failed: %s",
                         path, self.base, attempt + 1, budget, e,
                     )
-                    await asyncio.sleep(0.05 * (2 ** attempt))
+                    # full jitter (see backoff_s): deterministic backoff
+                    # synchronises the herd into a retry storm
+                    await asyncio.sleep(backoff_s(attempt))
             err = MicroserviceError(
                 f"REST call {path} to {self.base} failed: {last_err} "
                 f"(attempts: {json.dumps(attempts)})",
@@ -615,26 +1065,43 @@ class RestClient(NodeClient):
                 reason="UPSTREAM_REST_ERROR",
             )
             err.attempts = attempts  # machine-readable per-attempt history
+            err.transient = True  # connection faults: transient by class
             raise err from last_err
         finally:
+            call.settle(healthy)
             hop.finish(error=not ok)
 
     async def transform_input(self, msg: InternalMessage) -> InternalMessage:
         if self.unit.type == MODEL:
-            return await self._post("/predict", "predict", msg.to_json)
-        return await self._post("/transform-input", "transform_input", msg.to_json)
+            return await _hedged_call(
+                self, "predict", "rest",
+                lambda: self._post("/predict", "predict", msg.to_json),
+            )
+        return await _hedged_call(
+            self, "transform_input", "rest",
+            lambda: self._post("/transform-input", "transform_input", msg.to_json),
+        )
 
     async def transform_output(self, msg: InternalMessage) -> InternalMessage:
-        return await self._post("/transform-output", "transform_output", msg.to_json)
+        return await _hedged_call(
+            self, "transform_output", "rest",
+            lambda: self._post("/transform-output", "transform_output", msg.to_json),
+        )
 
     async def route(self, msg: InternalMessage) -> InternalMessage:
-        return await self._post("/route", "route", msg.to_json)
+        return await _hedged_call(
+            self, "route", "rest",
+            lambda: self._post("/route", "route", msg.to_json),
+        )
 
     async def aggregate(self, msgs: List[InternalMessage]) -> InternalMessage:
         def encode():
             return {"seldonMessages": [m.to_json() for m in msgs]}
 
-        return await self._post("/aggregate", "aggregate", encode)
+        return await _hedged_call(
+            self, "aggregate", "rest",
+            lambda: self._post("/aggregate", "aggregate", encode),
+        )
 
     async def send_feedback(self, feedback: InternalFeedback) -> InternalMessage:
         # not idempotent: a timeout after the reward was applied must
@@ -655,6 +1122,11 @@ class RestClient(NodeClient):
     async def close(self) -> None:
         if self._session is not None and not self._session.closed:
             await self._session.close()
+        # replica retirement: evict the endpoint's registry breaker
+        # (fresh ports per scale event would leak entries forever)
+        CircuitBreaker.discard(
+            f"{self.unit.endpoint.host}:{self.unit.endpoint.port}"
+        )
 
 
 class BalancedClient(NodeClient):

@@ -737,7 +737,8 @@ class _CachedPrefix:
 
 # SLO lifecycle counters threaded engine_stats -> flight-recorder chunk
 # records (per-wave deltas) -> GenerationPrometheusBridge -> dashboards
-_SLO_COUNTER_KEYS = ("shed", "expired", "preempted", "restored")
+_SLO_COUNTER_KEYS = ("shed", "expired", "preempted", "restored",
+                     "drained", "replayed")
 
 
 class _Stream:
@@ -1116,6 +1117,10 @@ class PagedEngine:
                           # without fail_all
                           "shed": 0, "expired": 0, "preempted": 0,
                           "restored": 0, "chunk_faults": 0,
+                          # drain/handoff (r12): live streams journaled
+                          # by drain() for a respawned engine, and
+                          # journal entries replay() re-submitted here
+                          "drained": 0, "replayed": 0,
                           # wall seconds inside device calls + readback,
                           # split by phase: decode-rate observability
                           # (tokens / chunk_wall_s) independent of
@@ -2898,6 +2903,127 @@ class PagedEngine:
                 out["recorder_stats"] = {"records": 0, "seq": 0}
         return out
 
+    def drain(self) -> List[Dict[str, Any]]:
+        """Drain for handoff (r12): stop admission, then serialize every
+        live stream's RE-DERIVATION RECIPE — prompt, sampling knobs,
+        seed, priority, remaining deadline, and the streaming cursor —
+        to journal entries a respawned engine feeds to :meth:`replay`
+        through the ordinary submit path.  Decoded tokens are NOT
+        serialized: seeds are deterministic per stream, so the replay
+        re-derives them bit-exactly (the same discipline the
+        evict/restore path relies on), and the prompt pages usually come
+        back for free through the prefix cache.
+
+        Each journaled stream's local waiter is error-terminated with a
+        503 ``DRAINING`` (the process is exiting; upstream callers retry
+        through the normal transport path while the respawned engine
+        re-derives proactively).  Call with the step loop quiesced — no
+        chunk may be in flight (StreamingLM.drain joins the decode loop
+        first; ``run()``-style callers are between steps by
+        construction).  The engine is closed afterwards: admission
+        never reopens on a drained engine."""
+        import time as _time
+
+        with self._lock:
+            self._closed = True  # stops admission: submits now 503
+            victims = [s for s in self._slots if s is not None] + list(self._queue)
+            now = _time.monotonic()
+            entries: List[Dict[str, Any]] = []
+            for s in victims:
+                entries.append({
+                    "req_id": s.req_id,
+                    "prompt": [int(t) for t in s.prompt],
+                    "max_new_tokens": int(s.max_new),
+                    "temperature": float(s.temperature),
+                    "top_k": int(s.top_k),
+                    "eos_id": int(s.eos_id),
+                    "seed": int(s.seed),
+                    "priority": int(s.priority),
+                    # absolute monotonic deadlines don't survive a
+                    # process: serialize the REMAINING budget and re-mint
+                    # on replay (wall time spent respawning decrements it
+                    # implicitly on neither side — acceptable: the
+                    # respawn window is the handoff's price)
+                    "deadline_remaining_ms": (
+                        max(0.0, (s.deadline - now) * 1000.0)
+                        if s.deadline is not None else None
+                    ),
+                    # streaming resume: tokens the consumer already saw —
+                    # the replayed stream pushes only past this cursor,
+                    # so a reconnecting SSE consumer sees an exact
+                    # continuation, never a repeat
+                    "streamed": int(s.streamed),
+                    "stream_tokens": s.token_queue is not None,
+                    "tokens_decoded": len(s.tokens),  # diagnostics only
+                })
+            self._queue.clear()
+            self._queued.clear()
+            err = MicroserviceError(
+                "engine draining: stream journaled for handoff to the "
+                "respawned engine",
+                status_code=503, reason="DRAINING",
+            )
+            for s in victims:
+                self._fail_stream_locked(s, err)
+            self._counters["drained"] += len(victims)
+        self._flush_spans()
+        return entries
+
+    def replay(
+        self,
+        entries: Sequence[Dict[str, Any]],
+        stream_tokens: Optional[bool] = None,
+    ) -> List[_Stream]:
+        """Re-submit journaled streams (the restore half of
+        drain/handoff).  ``stream_tokens=None`` honours each entry's
+        original streaming mode and resumes its cursor; ``False`` forces
+        unary replay (the respawn path uses this — the original
+        consumers are gone, and an unread token queue would grow
+        unbounded).  Entries whose remaining deadline is already spent
+        are skipped (counted as ``expired``) — replaying them would burn
+        the fresh engine's first admission wave on dead work.  Call
+        before the step loop starts consuming (the streaming cursor must
+        be in place before the first push)."""
+        import time as _time
+
+        out: List[_Stream] = []
+        for e in entries:
+            deadline = None
+            rem = e.get("deadline_remaining_ms")
+            if rem is not None:
+                deadline = _time.monotonic() + max(0.0, float(rem)) / 1000.0
+            want_stream = (
+                bool(e.get("stream_tokens"))
+                if stream_tokens is None else bool(stream_tokens)
+            )
+            try:
+                s = self.submit(
+                    np.asarray(e["prompt"], np.int32),
+                    max_new_tokens=int(e.get("max_new_tokens", 32)),
+                    temperature=float(e.get("temperature", 0.0)),
+                    top_k=int(e.get("top_k", 0)),
+                    eos_id=int(e.get("eos_id", -1)),
+                    seed=int(e.get("seed", 0)),
+                    priority=int(e.get("priority", 0)),
+                    deadline=deadline,
+                    stream_tokens=want_stream,
+                )
+            except MicroserviceError as exc:
+                logger.warning(
+                    "journal replay skipped req %s: %s", e.get("req_id"), exc
+                )
+                continue
+            if want_stream and e.get("streamed"):
+                # resume exactly where the consumer left off: the
+                # deterministic re-derivation regenerates the same
+                # tokens, and the cursor suppresses the already-seen
+                # prefix (no step loop has run yet — see docstring)
+                s.streamed = int(e["streamed"])
+            with self._lock:
+                self._counters["replayed"] += 1
+            out.append(s)
+        return out
+
     def close(self, exc: Optional[Exception] = None) -> None:
         """Permanently shut the engine: future submits are rejected with
         503 and every pending stream is errored out (a submit that hangs
@@ -3388,6 +3514,10 @@ class StreamingLM(TPUComponent):
         self._loop_thread: Optional[threading.Thread] = None
         self._wake = threading.Event()
         self._stop = False
+        # drain/handoff (r12): set by drain() so the exiting decode loop
+        # leaves the engine alone (drain serializes the live streams;
+        # the loop's usual close() would error them out uselessly first)
+        self._draining = False
         self._load_lock = threading.Lock()
         self._counter = 0
         self._counter_lock = threading.Lock()
@@ -3444,6 +3574,35 @@ class StreamingLM(TPUComponent):
                     )
                 except Exception:  # noqa: BLE001 — metrics never block serving
                     logger.exception("prometheus bridge unavailable")
+            # drain/handoff replay (r12): a journal left by a drained
+            # predecessor (SIGTERM → drain → exit; the supervisor keeps
+            # the path stable across respawns) re-submits its live
+            # streams BEFORE the decode loop starts — by first chunk the
+            # respawned engine is already re-deriving, and the prompts'
+            # prefix pages re-enter the cache where the original
+            # callers' retries find them warm.  Unary replay: the
+            # original streaming consumers died with the old process.
+            journal = _os.environ.get("SELDON_TPU_DRAIN_JOURNAL", "")
+            if journal and _os.path.exists(journal):
+                try:
+                    import json as _json
+
+                    with open(journal) as f:
+                        entries = [
+                            _json.loads(line)
+                            for line in f if line.strip()
+                        ]
+                    _os.unlink(journal)  # consumed: never replay twice
+                    if entries:
+                        replayed = engine.replay(entries, stream_tokens=False)
+                        logger.info(
+                            "drain journal %s: replayed %d/%d streams",
+                            journal, len(replayed), len(entries),
+                        )
+                except Exception:  # noqa: BLE001 — a corrupt journal
+                    # must never block serving; the streams it described
+                    # are re-derived by caller retries instead
+                    logger.exception("drain-journal replay failed (%s)", journal)
             self._loop_thread = threading.Thread(
                 target=self._loop, name="streaminglm-decode", daemon=True
             )
@@ -3483,8 +3642,12 @@ class StreamingLM(TPUComponent):
                 self.engine.fail_all(exc)
             collect(0.5)
         # loop stopped: nothing will ever step streams again — reject
-        # future submits and unblock every current waiter
-        if self.engine is not None:
+        # future submits and unblock every current waiter.  EXCEPT when
+        # a drain is in progress: drain() owns the live streams (it
+        # journals them for the respawned engine before erroring the
+        # waiters with DRAINING), so closing here would destroy the
+        # handoff payload.
+        if self.engine is not None and not self._draining:
             self.engine.close(
                 MicroserviceError("component shut down", status_code=503,
                                   reason="SHUTTING_DOWN")
@@ -3493,6 +3656,52 @@ class StreamingLM(TPUComponent):
     def shutdown(self) -> None:
         self._stop = True
         self._wake.set()
+
+    def drain(self, journal_path: Optional[str] = None,
+              timeout_s: float = 30.0) -> List[Dict[str, Any]]:
+        """Drain-then-exit (r12): stop the decode loop at the next chunk
+        boundary, journal every live stream's re-derivation recipe, and
+        error their local waiters with a clean 503 ``DRAINING``.  The
+        journal is written (JSONL, atomic rename) to ``journal_path`` or
+        ``SELDON_TPU_DRAIN_JOURNAL`` — the path the supervisor pins per
+        worker, so the respawned process replays it on load.  Wired to
+        SIGTERM by the microservice runtime; idempotent and safe on a
+        never-loaded component (returns [])."""
+        import os as _os
+
+        path = journal_path if journal_path is not None else \
+            _os.environ.get("SELDON_TPU_DRAIN_JOURNAL", "")
+        if self.engine is None:
+            return []
+        self._draining = True
+        self._stop = True
+        self._wake.set()
+        if self._loop_thread is not None and self._loop_thread.is_alive():
+            # the loop finishes its in-flight chunk then exits — drain
+            # must never serialize state a device call is still mutating
+            self._loop_thread.join(timeout=timeout_s)
+            if self._loop_thread.is_alive():
+                logger.error(
+                    "decode loop still running after %.0fs drain wait — "
+                    "journaling anyway (chunk results for this wave may "
+                    "be lost, re-derivation covers them)", timeout_s,
+                )
+        entries = self.engine.drain()
+        if path and entries:
+            try:
+                import json as _json
+
+                tmp = f"{path}.tmp"
+                with open(tmp, "w") as f:
+                    for e in entries:
+                        f.write(_json.dumps(e) + "\n")
+                _os.replace(tmp, path)  # atomic: a respawn never reads half
+                logger.info(
+                    "drained %d live streams to %s", len(entries), path
+                )
+            except OSError:
+                logger.exception("drain journal write failed (%s)", path)
+        return entries
 
     @staticmethod
     def _slo_terms(tags) -> Tuple[int, Optional[float]]:

@@ -138,6 +138,19 @@ async def run_servers(
                 pass
     await shutdown_event.wait()
 
+    # drain-then-exit (r12): components exposing drain() — StreamingLM's
+    # generation engine — journal their live streams FIRST, so in-flight
+    # handlers unblock with a clean 503 DRAINING immediately (instead of
+    # hanging into the gRPC grace window) and the respawned worker
+    # replays the journal (SELDON_TPU_DRAIN_JOURNAL, pinned per worker
+    # by the supervisor) through the ordinary submit path.
+    drain_fn = getattr(user_model, "drain", None)
+    if callable(drain_fn):
+        try:
+            await asyncio.get_running_loop().run_in_executor(None, drain_fn)
+        except Exception:  # noqa: BLE001 — drain is best-effort; exit anyway
+            logger.exception("component drain failed during shutdown")
+
     if server is not None:
         await server.stop(grace=20.0)
     if runner is not None:

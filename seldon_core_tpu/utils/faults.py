@@ -31,6 +31,12 @@ Registered injection points:
 * ``transport.drop`` — NodeClient REST/gRPC attempts raise a transient
   connection error (gRPC-shaped: carries an UNAVAILABLE status so the
   retry classifier treats it exactly like a dead upstream).
+* ``transport.slow`` — a SECOND, independent latency point with the
+  same semantics as ``transport.delay``.  Exists so straggler chaos
+  (hedging, breaker-vs-tail tests) can be armed *simultaneously* with
+  a drop or deadline fault at its own times/prob budget: a straggler
+  is latency without an error, and sharing ``transport.delay``'s one
+  budget would make the two scenarios indistinguishable.
 
 Everything is a no-op (one module-level bool read) when no fault is
 configured — serving never pays for the harness.
@@ -53,6 +59,7 @@ KNOWN_POINTS = (
     "paged.chunk",
     "transport.delay",
     "transport.drop",
+    "transport.slow",
 )
 
 

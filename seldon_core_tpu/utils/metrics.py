@@ -278,6 +278,15 @@ ENGINE_STATS_METRICS: Dict[str, Tuple[str, str, str]] = {
                             "seldon_tpu_engine_prefix_cache_tokens_saved_total",
                             "prompt tokens whose prefill was skipped via "
                             "cached prefix pages"),
+    # self-healing lifecycle (r12): drain/handoff observability — a
+    # drained engine journals its live streams for a respawned engine
+    # to replay through the ordinary submit path
+    "drained": ("counter", "seldon_tpu_engine_drained_total",
+                "live streams journaled (and error-terminated) by an "
+                "engine drain for handoff to a respawned engine"),
+    "replayed": ("counter", "seldon_tpu_engine_replayed_total",
+                 "journaled streams re-submitted into this engine "
+                 "(the restore half of drain/handoff)"),
     # SLO lifecycle (r10): the overload/degradation observability —
     # GoodputCollapse alerts and the generation dashboard's SLO panel
     # read these
@@ -552,6 +561,101 @@ def transport_inflight(unit: str, method: str, transport: str, registry=None):
     except Exception:  # noqa: BLE001
         logger.exception("transport inflight gauge failed for %s/%s", unit, method)
         return None
+
+
+# ---------------------------------------------------------------------------
+# self-healing telemetry: circuit breakers, hedged requests, workers
+# ---------------------------------------------------------------------------
+
+# breaker state encoding for the gauge (alert rules key on it):
+# 0 = closed, 1 = half-open, 2 = open
+BREAKER_STATE_CODES = {"closed": 0, "half_open": 1, "open": 2}
+
+BREAKER_STATE_METRIC = "seldon_tpu_transport_breaker_state"
+BREAKER_TRANSITIONS_METRIC = "seldon_tpu_transport_breaker_transitions_total"
+BREAKER_FASTFAIL_METRIC = "seldon_tpu_transport_breaker_fastfail_total"
+HEDGES_METRIC = "seldon_tpu_transport_hedges_total"
+HEDGE_WINS_METRIC = "seldon_tpu_transport_hedge_wins_total"
+
+
+def record_breaker_state(endpoint: str, state: str, registry=None) -> None:
+    """Set the per-endpoint breaker state gauge + count the transition.
+    Called on every state CHANGE (not per call), so the cost is tied to
+    incidents, not traffic.  Never raises."""
+    if not transport_telemetry_enabled():
+        return
+    try:
+        cache = _cache_for(registry)
+        cache.get(
+            "gauge", BREAKER_STATE_METRIC, ("endpoint",),
+            "circuit-breaker state per endpoint (0 closed, 1 half-open, 2 open)",
+        ).labels(endpoint=endpoint).set(BREAKER_STATE_CODES.get(state, 0))
+        cache.get(
+            "counter", BREAKER_TRANSITIONS_METRIC, ("endpoint", "to"),
+            "circuit-breaker state transitions",
+        ).labels(endpoint=endpoint, to=state).inc()
+    except Exception:  # noqa: BLE001
+        logger.exception("breaker state metric failed for %s", endpoint)
+
+
+def record_breaker_fastfail(
+    unit: str, method: str, transport: str, registry=None
+) -> None:
+    """One call rejected BEFORE dispatch because its endpoint's breaker
+    was open (or half-open past the probe budget).  Never raises."""
+    if not transport_telemetry_enabled():
+        return
+    try:
+        _cache_for(registry).get(
+            "counter", BREAKER_FASTFAIL_METRIC, TRANSPORT_LABELS,
+            "calls fast-failed by an open circuit breaker before dispatch",
+        ).labels(unit=unit, method=method, transport=transport).inc()
+    except Exception:  # noqa: BLE001
+        logger.exception("breaker fastfail counter failed for %s/%s", unit, method)
+
+
+def record_transport_hedge(
+    unit: str, method: str, transport: str, won: bool = False, registry=None
+) -> None:
+    """One hedge duplicate fired (``won=False``) or one hedge winning
+    the race (``won=True`` — counted separately so win rate is a plain
+    ratio of two counters).  Never raises."""
+    if not transport_telemetry_enabled():
+        return
+    try:
+        cache = _cache_for(registry)
+        name, doc = (
+            (HEDGE_WINS_METRIC, "hedged duplicates that returned first")
+            if won else
+            (HEDGES_METRIC, "hedged duplicate requests fired after the "
+                            "per-node hedge delay")
+        )
+        cache.get("counter", name, TRANSPORT_LABELS, doc).labels(
+            unit=unit, method=method, transport=transport
+        ).inc()
+    except Exception:  # noqa: BLE001
+        logger.exception("hedge counter failed for %s/%s", unit, method)
+
+
+def record_worker_health(
+    worker: str, restarts: int, exhausted: bool, registry=None
+) -> None:
+    """Supervised-worker lifecycle for the alert layer: cumulative
+    restart count and the restart-budget-exhausted flag (the silent-dead
+    state ``WorkerRestartsExhausted`` alerts on).  Never raises."""
+    try:
+        cache = _cache_for(registry)
+        cache.get(
+            "gauge", "seldon_tpu_worker_restarts", ("worker",),
+            "restarts performed by the supervisor for this worker",
+        ).labels(worker=worker).set(float(restarts))
+        cache.get(
+            "gauge", "seldon_tpu_worker_exhausted", ("worker",),
+            "1 when the worker exceeded its restart budget and the "
+            "supervisor gave up (the worker is dead until redeployed)",
+        ).labels(worker=worker).set(1.0 if exhausted else 0.0)
+    except Exception:  # noqa: BLE001
+        logger.exception("worker health metric failed for %s", worker)
 
 
 def api_latency_sampler(

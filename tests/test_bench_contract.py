@@ -159,6 +159,23 @@ def _r3_like_full_result():
                 "trace_prop_overhead_pct": 1.8,
                 "protocol": "16-way StreamingLM graph serving, best-of-3",
             },
+            "chaos": {
+                "chaos_goodput_pct": 95.8,
+                "breaker_fastfail_pct": 87.5,
+                "hedge_win_pct": 66.7,
+                "offered": 48,
+                "served": 46,
+                "wall_s": 21.4,
+                "hedges_fired": 9,
+                "hedge_wins": 6,
+                "dead_endpoint_breaker": {
+                    "state": "open", "streak": 0, "trips": 1, "reopens": 4,
+                    "closes": 0, "fastfails": 21, "probes": 4,
+                    "transient_failures": 3,
+                },
+                "mix": "48 unary requests round-robined over 2 remote "
+                       "StreamingLM workers; worker 0 SIGKILLed at request 16",
+            },
             "mean_batch_rows": 26.69,
             "device_batches": 1106,
             "latency_phase": {
@@ -296,6 +313,27 @@ def test_compact_line_carries_overload_story(bench):
     assert "interactive_p99_x" not in e
     assert "interactive_unloaded_p99_ms" not in e
     assert "overload_mix" not in e
+
+
+def test_compact_line_carries_chaos_story(bench):
+    """r12 certification keys: the kill-one-of-two-workers phase's
+    goodput (served/offered, gate >= 80 with half the fleet dead), the
+    dead endpoint's open-circuit fast-fail share (high = post-trip
+    calls skip the retry+backoff ladder), and the hedge win rate — all
+    floats; the raw counts, breaker counter dump, and mix string stay
+    in bench_full.json."""
+    compact = bench._compact_result(_r3_like_full_result())
+    e = compact["extra"]
+    assert isinstance(e["chaos_goodput_pct"], float)
+    assert e["chaos_goodput_pct"] == 95.8
+    assert isinstance(e["breaker_fastfail_pct"], float)
+    assert e["breaker_fastfail_pct"] == 87.5
+    assert isinstance(e["hedge_win_pct"], float)
+    assert e["hedge_win_pct"] == 66.7
+    # raw counters + breaker dump + mix are full-blob-only
+    assert "hedges_fired" not in e
+    assert "dead_endpoint_breaker" not in e
+    assert "mix" not in e
 
 
 def test_compact_line_carries_tp_story(bench):

@@ -320,6 +320,65 @@ class TestTransportFaults:
         assert elapsed >= 0.05
         assert faults.stats()["transport.delay"] >= 1
 
+    def test_transport_slow_is_latency_not_error_with_its_own_budget(self):
+        """The straggler point (r12): `transport.slow` delays an
+        attempt WITHOUT failing it, and its times/prob budget is
+        independent of `transport.delay`/`transport.drop` — so a chaos
+        scenario can arm stragglers and drops simultaneously and tell
+        the effects apart."""
+        from aiohttp import web
+        from aiohttp.test_utils import TestClient, TestServer
+
+        from seldon_core_tpu.engine.graph import Endpoint, UnitSpec
+        from seldon_core_tpu.engine.transport import RestClient
+        from seldon_core_tpu.runtime.message import InternalMessage
+
+        calls = {"n": 0}
+
+        async def ok(request):
+            calls["n"] += 1
+            return web.json_response({"data": {"ndarray": [[9.0]]}})
+
+        before = faults.stats()  # _fired_total is cumulative per process
+
+        async def scenario():
+            app = web.Application()
+            app.router.add_post("/predict", ok)
+            server = TestServer(app)
+            tc = TestClient(server)
+            await tc.start_server()
+            unit = UnitSpec(
+                name="m", type="MODEL",
+                endpoint=Endpoint(host=server.host, port=server.port,
+                                  transport="REST"),
+            )
+            client = RestClient(unit, retries=3)
+            # both latency points armed with SEPARATE budgets, plus one
+            # drop: every budget must fire independently
+            faults.configure(
+                "transport.slow:times=1,ms=80;"
+                "transport.delay:times=1,ms=40;"
+                "transport.drop:times=1"
+            )
+            msg = InternalMessage(payload=np.array([[1.0]]), kind="ndarray")
+            t0 = time.perf_counter()
+            out = await client.transform_input(msg)
+            elapsed = time.perf_counter() - t0
+            await client.close()
+            await tc.close()
+            return out, elapsed
+
+        out, elapsed = _run(scenario())
+        assert out.array().tolist() == [[9.0]]
+        # slow fired (latency, no error): total covers both delays
+        assert elapsed >= 0.08
+        stats = faults.stats()
+        assert stats["transport.slow"] - before.get("transport.slow", 0) == 1
+        assert stats["transport.delay"] - before.get("transport.delay", 0) == 1
+        # the drop still dropped — each budget independent of the others
+        assert stats["transport.drop"] - before.get("transport.drop", 0) == 1
+        assert calls["n"] == 1  # exactly one attempt reached the wire
+
     def test_grpc_drop_recovers_via_retry(self):
         async def scenario():
             import grpc

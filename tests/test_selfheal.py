@@ -1,0 +1,1105 @@
+"""Self-healing inference graph (r12) as a CONTRACT.
+
+Four coordinated containment layers, each pinned here:
+
+* **circuit breakers** — per-ENDPOINT (shared across callers and
+  lanes), closed → open on consecutive transient failures → half-open
+  probe trickle → closed; open circuits fast-fail BEFORE any
+  dial/retry work (the pre-dispatch discipline deadline checks set);
+* **hedged requests** — opt-in first-wins duplicates for idempotent
+  unary calls, suppressed while half-open and when the deadline budget
+  cannot cover a second attempt, losers cancelled;
+* **fallback routes** — `UnitSpec.fallback` subtrees the executor runs
+  when the primary's breaker is open or its retries exhaust, tagged
+  `degraded` in meta so nobody mistakes a degraded answer for a
+  primary one;
+* **drain/handoff** — `PagedEngine.drain()` journals live streams'
+  re-derivation recipes; `replay()` re-submits them bit-exactly into a
+  respawned engine (deterministic seeds — the evict/restore
+  discipline, now across process generations).
+
+Plus the satellites: full-jitter backoff spread, `transport.slow`
+straggler injection, supervisor `exhausted` surfacing, and the gateway
+`/debug/workers` endpoint.
+"""
+
+import asyncio
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+from seldon_core_tpu.engine.graph import (
+    Endpoint,
+    GraphSpecError,
+    UnitSpec,
+    validate_graph,
+)
+from seldon_core_tpu.engine.transport import (
+    BREAKER_CLOSED,
+    BREAKER_HALF_OPEN,
+    BREAKER_OPEN,
+    BalancedClient,
+    CircuitBreaker,
+    GrpcClient,
+    LocalClient,
+    RestClient,
+    backoff_s,
+    breakers_enabled,
+)
+from seldon_core_tpu.runtime.component import MicroserviceError, TPUComponent
+from seldon_core_tpu.runtime.message import InternalMessage
+from seldon_core_tpu.utils import faults
+
+
+def _run(coro):
+    return asyncio.run(coro)
+
+
+def _msg(arr=((1.0, 2.0),)):
+    return InternalMessage(payload=np.asarray(arr, dtype=np.float64), kind="tensor")
+
+
+@pytest.fixture(autouse=True)
+def _fresh_breakers():
+    """Breakers are a process-wide per-endpoint registry by design —
+    tests must not leak tripped state into each other."""
+    CircuitBreaker.reset_all()
+    faults.clear()
+    yield
+    CircuitBreaker.reset_all()
+    faults.clear()
+
+
+class Doubler(TPUComponent):
+    def predict(self, X, names, meta=None):
+        return np.asarray(X) * 2
+
+
+# ---------------------------------------------------------------------------
+# breaker state machine (pure unit matrix)
+# ---------------------------------------------------------------------------
+
+
+class TestBreakerStateMachine:
+    def test_trips_after_consecutive_transient_failures(self):
+        b = CircuitBreaker("ep:1", failures=3, reset_s=60.0)
+        for _ in range(2):
+            b.on_transient()
+            assert b.state == BREAKER_CLOSED
+        b.on_transient()
+        assert b.state == BREAKER_OPEN
+        assert b.counters["trips"] == 1
+
+    def test_deterministic_reply_resets_the_streak(self):
+        b = CircuitBreaker("ep:2", failures=3, reset_s=60.0)
+        b.on_transient()
+        b.on_transient()
+        probe = b.acquire("u", "m", "grpc")
+        b.release(probe, healthy=True)  # a 4xx reply: endpoint is alive
+        b.on_transient()
+        b.on_transient()
+        assert b.state == BREAKER_CLOSED  # streak restarted from zero
+
+    def test_open_fast_fails_naming_endpoint_and_reason(self):
+        b = CircuitBreaker("ep:3", failures=1, reset_s=60.0)
+        b.on_transient()
+        with pytest.raises(MicroserviceError) as ei:
+            b.acquire("node-a", "predict", "grpc")
+        assert ei.value.reason == "CIRCUIT_OPEN"
+        assert ei.value.status_code == 503
+        assert "ep:3" in str(ei.value)
+        assert b.counters["fastfails"] == 1
+
+    def test_cooldown_half_opens_with_probe_budget(self):
+        b = CircuitBreaker("ep:4", failures=1, reset_s=0.05, probes=2)
+        b.on_transient()
+        assert b.state == BREAKER_OPEN
+        time.sleep(0.06)
+        assert b.state == BREAKER_HALF_OPEN
+        # concurrent half-open: exactly `probes` pass, the rest fast-fail
+        p1 = b.acquire("u", "m", "grpc")
+        p2 = b.acquire("u", "m", "grpc")
+        assert p1 is True and p2 is True
+        with pytest.raises(MicroserviceError):
+            b.acquire("u", "m", "grpc")
+        b.release(p1, healthy=None)
+        b.release(p2, healthy=None)
+
+    def test_probe_success_closes(self):
+        b = CircuitBreaker("ep:5", failures=1, reset_s=0.05, probes=1)
+        b.on_transient()
+        time.sleep(0.06)
+        probe = b.acquire("u", "m", "grpc")
+        b.release(probe, healthy=True)
+        assert b.state == BREAKER_CLOSED
+        assert b.counters["closes"] == 1
+
+    def test_probe_failure_reopens_immediately(self):
+        b = CircuitBreaker("ep:6", failures=3, reset_s=0.05, probes=1)
+        for _ in range(3):
+            b.on_transient()
+        time.sleep(0.06)
+        probe = b.acquire("u", "m", "grpc")
+        b.on_transient()  # ONE failure while half-open, not `failures`
+        b.release(probe, healthy=False)
+        assert b.state == BREAKER_OPEN
+        assert b.counters["reopens"] == 1
+
+    def test_registry_shares_one_breaker_per_endpoint(self):
+        a = CircuitBreaker.for_endpoint("host:9000", failures=7)
+        b = CircuitBreaker.for_endpoint("host:9000", failures=3)
+        assert a is b and a.failures == 7  # first-creator config wins
+        assert CircuitBreaker.for_endpoint("host:9001") is not a
+        CircuitBreaker.reset_all()
+        assert CircuitBreaker.for_endpoint("host:9000") is not a
+
+    def test_env_kill_switch_disables_breakers(self, monkeypatch):
+        monkeypatch.setenv("SELDON_TPU_BREAKER", "0")
+        assert not breakers_enabled()
+        unit = UnitSpec(name="m", type="MODEL",
+                        endpoint=Endpoint(host="h", port=1, transport="REST"))
+        assert RestClient(unit).breaker is None
+        assert GrpcClient(unit).breaker is None
+        assert LocalClient(unit, Doubler()).breaker is None
+
+
+# ---------------------------------------------------------------------------
+# breaker wired through the transports
+# ---------------------------------------------------------------------------
+
+
+def _free_port() -> int:
+    import socket
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+class TestBreakerTransport:
+    def test_grpc_ladder_stops_when_breaker_trips_mid_call(self):
+        """retries=5 against a dead endpoint with failures=2: the trip
+        lands mid-ladder and the remaining attempts are NOT dialed —
+        the attempt history shows exactly the pre-trip dials."""
+        unit = UnitSpec(name="dead", type="MODEL")
+        unit.endpoint = Endpoint(host="127.0.0.1", port=_free_port(), transport="GRPC")
+        client = GrpcClient(
+            unit, deadline_s=0.4, retries=5,
+            breaker=CircuitBreaker("grpc-ladder", failures=2, reset_s=60.0),
+        )
+
+        async def scenario():
+            try:
+                await client.transform_input(_msg())
+            except MicroserviceError as e:
+                return e
+            finally:
+                await client.close()
+
+        err = _run(scenario())
+        assert err.reason == "UPSTREAM_GRPC_ERROR"
+        assert len(err.attempts) == 2  # 5 budgeted, 2 dialed, trip stopped it
+        assert client.breaker.state == BREAKER_OPEN
+
+    def test_grpc_open_circuit_fast_fails_before_dial(self):
+        unit = UnitSpec(name="dead", type="MODEL")
+        unit.endpoint = Endpoint(host="127.0.0.1", port=_free_port(), transport="GRPC")
+        breaker = CircuitBreaker("grpc-ff", failures=2, reset_s=60.0)
+        client = GrpcClient(unit, deadline_s=0.4, retries=3, breaker=breaker)
+
+        async def scenario():
+            with pytest.raises(MicroserviceError):
+                await client.transform_input(_msg())  # trips
+            t0 = time.perf_counter()
+            with pytest.raises(MicroserviceError) as ei:
+                await client.transform_input(_msg())
+            elapsed_ms = (time.perf_counter() - t0) * 1000.0
+            await client.close()
+            return ei.value, elapsed_ms
+
+        err, elapsed_ms = _run(scenario())
+        assert err.reason == "CIRCUIT_OPEN"
+        assert not hasattr(err, "attempts")  # nothing was dialed
+        assert elapsed_ms < 50.0  # no ladder, no backoff sleeps
+        assert breaker.counters["fastfails"] == 1
+
+    def test_grpc_recovers_through_half_open_probe(self):
+        """Dead endpoint trips the breaker; a worker appears on the
+        same port; after the cooldown ONE probe dials and success
+        closes the circuit — the respawn story end to end."""
+        from seldon_core_tpu.runtime import grpc_server
+
+        port = _free_port()
+        unit = UnitSpec(name="respawn", type="MODEL")
+        unit.endpoint = Endpoint(host="127.0.0.1", port=port, transport="GRPC")
+        breaker = CircuitBreaker("grpc-probe", failures=2, reset_s=0.2, probes=1)
+        client = GrpcClient(unit, deadline_s=2.0, retries=2, breaker=breaker)
+
+        async def scenario():
+            with pytest.raises(MicroserviceError):
+                await client.transform_input(_msg())
+            assert breaker.state == BREAKER_OPEN
+            server = grpc_server.build_server(Doubler())
+            assert server.add_insecure_port(f"127.0.0.1:{port}") == port
+            await server.start()
+            try:
+                await asyncio.sleep(0.25)  # past the cooldown
+                out = await client.transform_input(_msg())
+                return out
+            finally:
+                await client.close()
+                await server.stop(grace=None)
+
+        out = _run(scenario())
+        np.testing.assert_allclose(out.array(), np.asarray([[2.0, 4.0]]))
+        assert breaker.state == BREAKER_CLOSED
+        assert breaker.counters["probes"] == 1
+        assert breaker.counters["closes"] == 1
+
+    def test_rest_5xx_trips_and_4xx_does_not(self):
+        from aiohttp import web
+        from aiohttp.test_utils import TestServer
+
+        async def unavailable(_r):
+            return web.json_response({"oops": True}, status=503)
+
+        async def bad_request(_r):
+            return web.json_response({"bad": True}, status=400)
+
+        async def scenario():
+            app = web.Application()
+            app.router.add_post("/predict", unavailable)
+            app.router.add_post("/transform-output", bad_request)
+            server = TestServer(app)
+            await server.start_server()
+            unit = UnitSpec(
+                name="m", type="MODEL",
+                endpoint=Endpoint(host="127.0.0.1", port=server.port,
+                                  transport="REST"),
+            )
+            breaker = CircuitBreaker("rest-5xx", failures=2, reset_s=60.0)
+            client = RestClient(unit, retries=1, breaker=breaker)
+            try:
+                for _ in range(2):  # two 503s = the trip threshold
+                    with pytest.raises(MicroserviceError):
+                        await client.transform_input(_msg())
+                assert breaker.state == BREAKER_OPEN
+                with pytest.raises(MicroserviceError) as ei:
+                    await client.transform_input(_msg())
+                assert ei.value.reason == "CIRCUIT_OPEN"
+                # 4xx lane: deterministic replies never trip
+                b2 = CircuitBreaker("rest-4xx", failures=2, reset_s=60.0)
+                client2 = RestClient(unit, retries=1, breaker=b2)
+                for _ in range(4):
+                    with pytest.raises(MicroserviceError):
+                        await client2.transform_output(_msg())
+                assert b2.state == BREAKER_CLOSED
+                await client2.close()
+            finally:
+                await client.close()
+                await server.close()
+
+        _run(scenario())
+
+    def test_local_crash_trips_but_clean_errors_do_not(self):
+        class Crasher(TPUComponent):
+            def predict(self, X, names, meta=None):
+                raise RuntimeError("segfault-adjacent")
+
+        class Shedder(TPUComponent):
+            def predict(self, X, names, meta=None):
+                raise MicroserviceError("shed", status_code=503, reason="SHED")
+
+        async def scenario():
+            crash_unit = UnitSpec(name="crash", type="MODEL")
+            cb = CircuitBreaker("local-crash", failures=2, reset_s=60.0)
+            crash = LocalClient(crash_unit, Crasher(), breaker=cb)
+            for _ in range(2):
+                with pytest.raises(RuntimeError):
+                    await crash.transform_input(_msg())
+            assert cb.state == BREAKER_OPEN
+            with pytest.raises(MicroserviceError) as ei:
+                await crash.transform_input(_msg())
+            assert ei.value.reason == "CIRCUIT_OPEN"
+            # well-formed application errors (SHED!) never trip: a
+            # breaker on top of load shedding would amplify overload
+            # into a self-inflicted outage
+            shed_unit = UnitSpec(name="shedder", type="MODEL")
+            sb = CircuitBreaker("local-shed", failures=2, reset_s=60.0)
+            shed = LocalClient(shed_unit, Shedder(), breaker=sb)
+            for _ in range(5):
+                with pytest.raises(MicroserviceError) as ei:
+                    await shed.transform_input(_msg())
+                assert ei.value.reason == "SHED"
+            assert sb.state == BREAKER_CLOSED
+
+        _run(scenario())
+
+    def test_balanced_client_fails_over_an_open_circuit_fast(self):
+        """A replica whose breaker is open costs its callers one cheap
+        CIRCUIT_OPEN rejection (503 -> failover), not a dial ladder."""
+        async def scenario():
+            dead_unit = UnitSpec(name="lm", type="MODEL")
+            dead_unit.endpoint = Endpoint(host="127.0.0.1", port=_free_port(),
+                                          transport="GRPC")
+            dead_breaker = CircuitBreaker("bal-dead", failures=1, reset_s=60.0)
+            dead_breaker.on_transient()  # pre-tripped
+            dead = GrpcClient(dead_unit, retries=3, breaker=dead_breaker)
+            live_unit = UnitSpec(name="lm", type="MODEL")
+            live = LocalClient(live_unit, Doubler(), breaker=False)
+            balanced = BalancedClient([dead, live])
+            t0 = time.perf_counter()
+            outs = [await balanced.transform_input(_msg()) for _ in range(4)]
+            elapsed_ms = (time.perf_counter() - t0) * 1000.0
+            await dead.close()
+            return outs, elapsed_ms, dead_breaker
+
+        outs, elapsed_ms, breaker = _run(scenario())
+        for out in outs:
+            np.testing.assert_allclose(out.array(), np.asarray([[2.0, 4.0]]))
+        assert elapsed_ms < 200.0  # 4 requests, ~2 fastfail+failover hops
+        assert breaker.counters["fastfails"] >= 1
+        assert breaker.counters["transient_failures"] == 1  # only the pre-trip
+
+
+# ---------------------------------------------------------------------------
+# hedged requests
+# ---------------------------------------------------------------------------
+
+
+def _rest_ok_app():
+    from aiohttp import web
+
+    served = {"n": 0}
+
+    async def ok(_r):
+        served["n"] += 1
+        return web.json_response({"data": {"ndarray": [[9.0]]}})
+
+    app = web.Application()
+    app.router.add_post("/predict", ok)
+    app.router.add_post("/send-feedback", ok)
+    return app, served
+
+
+class TestHedging:
+    def test_hedge_fires_on_straggler_and_wins(self):
+        from aiohttp.test_utils import TestServer
+
+        async def scenario():
+            app, served = _rest_ok_app()
+            server = TestServer(app)
+            await server.start_server()
+            unit = UnitSpec(
+                name="m", type="MODEL",
+                endpoint=Endpoint(host="127.0.0.1", port=server.port,
+                                  transport="REST"),
+            )
+            client = RestClient(unit, retries=1, breaker=False, hedge_ms=60.0)
+            # ONE straggling attempt: the primary sleeps 500 ms, the
+            # hedge (fired at 60 ms) finds the budget spent and returns
+            faults.inject("transport.slow", times=1, delay_ms=500)
+            t0 = time.perf_counter()
+            out = await client.transform_input(_msg())
+            elapsed = time.perf_counter() - t0
+            await client.close()
+            await server.close()
+            return out, elapsed, client
+
+        out, elapsed, client = _run(scenario())
+        assert out.array().tolist() == [[9.0]]
+        assert elapsed < 0.45  # beat the 500 ms straggler
+        assert client.hedges_fired == 1
+        assert client.hedge_wins == 1
+
+    def test_no_hedge_when_primary_answers_in_time(self):
+        from aiohttp.test_utils import TestServer
+
+        async def scenario():
+            app, served = _rest_ok_app()
+            server = TestServer(app)
+            await server.start_server()
+            unit = UnitSpec(
+                name="m", type="MODEL",
+                endpoint=Endpoint(host="127.0.0.1", port=server.port,
+                                  transport="REST"),
+            )
+            client = RestClient(unit, breaker=False, hedge_ms=5000.0)
+            out = await client.transform_input(_msg())
+            await client.close()
+            await server.close()
+            return out, served, client
+
+        out, served, client = _run(scenario())
+        assert out.array().tolist() == [[9.0]]
+        assert served["n"] == 1
+        assert client.hedges_fired == 0
+
+    def test_hedge_suppressed_when_budget_cannot_cover_it(self):
+        from aiohttp.test_utils import TestServer
+
+        from seldon_core_tpu.utils import deadlines
+
+        async def scenario():
+            app, served = _rest_ok_app()
+            server = TestServer(app)
+            await server.start_server()
+            unit = UnitSpec(
+                name="m", type="MODEL",
+                endpoint=Endpoint(host="127.0.0.1", port=server.port,
+                                  transport="REST"),
+            )
+            # remaining budget (2 s) < hedge delay (10 s): the hedge
+            # could never fire before the deadline — suppressed
+            client = RestClient(unit, breaker=False, hedge_ms=10_000.0)
+            with deadlines.activate_ms(2000):
+                out = await client.transform_input(_msg())
+            await client.close()
+            await server.close()
+            return out, client
+
+        out, client = _run(scenario())
+        assert out.array().tolist() == [[9.0]]
+        assert client.hedges_fired == 0
+
+    def test_hedge_suppressed_while_half_open(self):
+        from aiohttp.test_utils import TestServer
+
+        async def scenario():
+            app, served = _rest_ok_app()
+            server = TestServer(app)
+            await server.start_server()
+            unit = UnitSpec(
+                name="m", type="MODEL",
+                endpoint=Endpoint(host="127.0.0.1", port=server.port,
+                                  transport="REST"),
+            )
+            breaker = CircuitBreaker("hedge-half", failures=1, reset_s=0.05,
+                                     probes=1)
+            breaker.on_transient()  # open
+            await asyncio.sleep(0.06)  # ... half-open
+            client = RestClient(unit, retries=1, breaker=breaker, hedge_ms=1.0)
+            # a straggling probe would normally hedge at 1 ms — but a
+            # recovering upstream must see a trickle, not double load
+            faults.inject("transport.slow", times=1, delay_ms=120)
+            out = await client.transform_input(_msg())
+            await client.close()
+            await server.close()
+            return out, client, breaker
+
+        out, client, breaker = _run(scenario())
+        assert out.array().tolist() == [[9.0]]
+        assert client.hedges_fired == 0
+        assert breaker.state == BREAKER_CLOSED  # the probe closed it
+
+    def test_send_feedback_never_hedges(self):
+        from aiohttp.test_utils import TestServer
+
+        from seldon_core_tpu.runtime.message import InternalFeedback
+
+        async def scenario():
+            app, served = _rest_ok_app()
+            server = TestServer(app)
+            await server.start_server()
+            unit = UnitSpec(
+                name="m", type="MODEL",
+                endpoint=Endpoint(host="127.0.0.1", port=server.port,
+                                  transport="REST"),
+            )
+            client = RestClient(unit, breaker=False, hedge_ms=10.0)
+            faults.inject("transport.slow", times=1, delay_ms=150)
+            fb = InternalFeedback(request=_msg(), reward=1.0)
+            t0 = time.perf_counter()
+            await client.send_feedback(fb)
+            elapsed = time.perf_counter() - t0
+            await client.close()
+            await server.close()
+            return elapsed, served, client
+
+        elapsed, served, client = _run(scenario())
+        # the straggler was WAITED OUT (a duplicated reward would be
+        # double-counted — the same non-idempotency rule as retries)
+        assert elapsed >= 0.15
+        assert served["n"] == 1
+        assert client.hedges_fired == 0
+
+
+# ---------------------------------------------------------------------------
+# fallback routes
+# ---------------------------------------------------------------------------
+
+
+class TestFallbackRoutes:
+    def test_validation_catches_duplicate_names_and_chains(self):
+        dup = UnitSpec(name="a", type="MODEL", component=Doubler())
+        dup.fallback = UnitSpec(name="a", type="MODEL", component=Doubler())
+        with pytest.raises(GraphSpecError, match="duplicate"):
+            validate_graph(dup)
+        chain = UnitSpec(name="a", type="MODEL", component=Doubler())
+        chain.fallback = UnitSpec(name="b", type="MODEL", component=Doubler())
+        chain.fallback.fallback = UnitSpec(name="c", type="MODEL",
+                                           component=Doubler())
+        with pytest.raises(GraphSpecError, match="degradation"):
+            validate_graph(chain)
+        # an unexecutable fallback fails like any unexecutable node
+        bad = UnitSpec(name="a", type="MODEL", component=Doubler())
+        bad.fallback = UnitSpec(name="b", type="MODEL")
+        with pytest.raises(GraphSpecError, match="no component"):
+            validate_graph(bad)
+
+    def test_serde_and_clone_round_trip_fallback(self):
+        spec = UnitSpec.from_dict({
+            "name": "big", "type": "MODEL",
+            "endpoint": {"host": "h", "port": 9000, "transport": "GRPC"},
+            "fallback": {"name": "small", "type": "MODEL",
+                         "implementation": "IDENTITY"},
+        })
+        assert spec.fallback is not None and spec.fallback.name == "small"
+        assert [u.name for u in spec.walk()] == ["big", "small"]
+        d = spec.to_dict()
+        assert d["fallback"]["name"] == "small"
+        clone = spec.clone()
+        assert clone.fallback is not spec.fallback
+        assert clone.fallback.name == "small"
+
+    def test_fallback_taken_on_open_circuit_with_degraded_tag(self):
+        from seldon_core_tpu.engine.executor import GraphExecutor
+
+        primary = UnitSpec(name="big", type="MODEL")
+        primary.endpoint = Endpoint(host="127.0.0.1", port=_free_port(),
+                                    transport="GRPC")
+        primary.fallback = UnitSpec(name="small", type="MODEL",
+                                    component=Doubler())
+        events = []
+        ex = GraphExecutor(
+            primary,
+            observer=lambda ev, unit, payload: events.append((ev, unit, payload)),
+            annotations={"seldon.io/breaker-failures": "2",
+                         "seldon.io/grpc-retries": "2",
+                         "seldon.io/grpc-read-timeout": "400"},
+        )
+
+        async def scenario():
+            m = _msg()
+            m.meta.puid = "fb-1"
+            out1 = await ex.predict(m)  # retries exhaust -> fallback
+            m2 = _msg()
+            m2.meta.puid = "fb-2"
+            t0 = time.perf_counter()
+            out2 = await ex.predict(m2)  # breaker open -> instant fallback
+            fast_ms = (time.perf_counter() - t0) * 1000.0
+            await ex.close()
+            return out1, out2, fast_ms
+
+        out1, out2, fast_ms = _run(scenario())
+        for out in (out1, out2):
+            np.testing.assert_allclose(out.array(), np.asarray([[2.0, 4.0]]))
+            assert out.meta.tags["degraded"] is True
+            assert out.meta.tags["fallback_for"] == "big"
+            assert out.meta.request_path.get("small") is not None
+        assert fast_ms < 100.0
+        reasons = [p for ev, unit, p in events if ev == "node_fallback"]
+        assert reasons == ["UPSTREAM_GRPC_ERROR", "CIRCUIT_OPEN"]
+
+    def test_fallback_not_taken_for_deterministic_errors(self):
+        from seldon_core_tpu.engine.executor import GraphExecutor
+
+        class Rejecter(TPUComponent):
+            def predict(self, X, names, meta=None):
+                raise MicroserviceError("bad input", status_code=400,
+                                        reason="BAD_REQUEST")
+
+        primary = UnitSpec(name="big", type="MODEL", component=Rejecter())
+        primary.fallback = UnitSpec(name="small", type="MODEL",
+                                    component=Doubler())
+        ex = GraphExecutor(primary)
+
+        async def scenario():
+            with pytest.raises(MicroserviceError) as ei:
+                await ex.predict(_msg())
+            await ex.close()
+            return ei.value
+
+        err = _run(scenario())
+        assert err.reason == "BAD_REQUEST"  # 4xx surfaces, no degradation
+
+    def test_fallback_not_taken_for_remote_deterministic_4xx(self):
+        """The remote lanes re-raise a deterministic upstream 4xx as a
+        502 UPSTREAM_REST_ERROR — the transports tag ``transient=False``
+        on it so the fallback layer still refuses it (a malformed
+        payload would fail identically on the fallback, and a degraded
+        tag would mask the caller's real 400)."""
+        from aiohttp import web
+        from aiohttp.test_utils import TestServer
+
+        from seldon_core_tpu.engine.executor import GraphExecutor
+
+        async def bad_request(_r):
+            return web.json_response({"bad": True}, status=400)
+
+        async def scenario():
+            app = web.Application()
+            app.router.add_post("/predict", bad_request)
+            server = TestServer(app)
+            await server.start_server()
+            primary = UnitSpec(name="big", type="MODEL")
+            primary.endpoint = Endpoint(host="127.0.0.1", port=server.port,
+                                        transport="REST")
+            primary.fallback = UnitSpec(name="small", type="MODEL",
+                                        component=Doubler())
+            ex = GraphExecutor(primary)
+            try:
+                with pytest.raises(MicroserviceError) as ei:
+                    await ex.predict(_msg())
+            finally:
+                await ex.close()
+                await server.close()
+            return ei.value
+
+        err = _run(scenario())
+        assert err.reason == "UPSTREAM_REST_ERROR"
+        assert err.transient is False
+        assert "400" in str(err)  # the real status surfaces, undegraded
+
+    def test_executor_builds_clients_for_fallback_subtree(self):
+        from seldon_core_tpu.engine.executor import GraphExecutor
+
+        primary = UnitSpec(name="big", type="MODEL", component=Doubler())
+        primary.fallback = UnitSpec(name="small", type="MODEL",
+                                    component=Doubler())
+        ex = GraphExecutor(primary)
+        assert "small" in ex.clients  # built at graph build, not on failure
+
+
+# ---------------------------------------------------------------------------
+# drain / handoff
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def tiny_engine_factory():
+    import jax.numpy as jnp
+
+    from seldon_core_tpu.models.generate import load_lm_params
+    from seldon_core_tpu.models.paged import PagedEngine
+
+    cfg = dict(vocab_size=128, d_model=32, num_layers=2, num_heads=4, max_len=64)
+    params = load_lm_params("", cfg, 0)
+
+    def make(**kw):
+        kw.setdefault("page_size", 8)
+        kw.setdefault("max_slots", 2)
+        kw.setdefault("steps_per_call", 2)
+        return PagedEngine(params, dtype=jnp.float32, **cfg, **kw)
+
+    return make
+
+
+class TestDrainHandoff:
+    def test_drain_then_replay_is_bit_exact(self, tiny_engine_factory):
+        prompts = [np.arange(5, dtype=np.int32) + i for i in range(3)]
+        baseline = tiny_engine_factory()
+        expected = [
+            baseline.generate(p, max_new_tokens=10, seed=i)
+            for i, p in enumerate(prompts)
+        ]
+        baseline.close()
+
+        a = tiny_engine_factory()
+        streams = [
+            a.submit(p, max_new_tokens=10, seed=i, priority=i % 2)
+            for i, p in enumerate(prompts)
+        ]
+        a.step()  # partial progress: some decoded tokens will be discarded
+        entries = a.drain()
+        assert len(entries) == 3
+        assert a.engine_stats()["drained"] == 3
+        for s in streams:  # local waiters got a clean DRAINING error
+            assert s.error is not None and s.error.reason == "DRAINING"
+        # recipes serialize the lifecycle terms, never decoded tokens
+        by_id = {e["req_id"]: e for e in entries}
+        assert by_id[streams[1].req_id]["priority"] == 1
+        assert all("tokens" not in e or isinstance(e["tokens_decoded"], int)
+                   for e in entries)
+        # admission is stopped: a drained engine never serves again
+        with pytest.raises(MicroserviceError):
+            a.submit(prompts[0], max_new_tokens=4)
+
+        b = tiny_engine_factory()
+        replayed = b.replay(entries)
+        b.run()
+        assert b.engine_stats()["replayed"] == 3
+        # entries order follows a's PRIORITY admission order, not submit
+        # order — pair results by journaled req_id
+        expected_by_req = {streams[i].req_id: expected[i] for i in range(3)}
+        for e, s in zip(entries, replayed):
+            np.testing.assert_array_equal(s.result, expected_by_req[e["req_id"]])
+        a.close()
+        b.close()
+
+    def test_replay_skips_spent_deadlines(self, tiny_engine_factory):
+        eng = tiny_engine_factory()
+        entries = [
+            {"req_id": 0, "prompt": [1, 2, 3], "max_new_tokens": 4,
+             "seed": 0, "deadline_remaining_ms": 0.0},
+            {"req_id": 1, "prompt": [1, 2, 3], "max_new_tokens": 4,
+             "seed": 0, "deadline_remaining_ms": None},
+        ]
+        replayed = eng.replay(entries)
+        assert len(replayed) == 1  # the spent one was skipped, not queued
+        assert eng.engine_stats()["replayed"] == 1
+        eng.close()
+
+    def test_streaming_cursor_resumes_without_repeats(self, tiny_engine_factory):
+        prompt = np.arange(6, dtype=np.int32)
+        baseline = tiny_engine_factory()
+        expected = baseline.generate(prompt, max_new_tokens=12, seed=7)
+        baseline.close()
+
+        a = tiny_engine_factory()
+        s = a.submit(prompt, max_new_tokens=12, seed=7, stream_tokens=True)
+        a.step()
+        a.step()
+        seen = []
+        while s.token_queue is not None and not s.token_queue.empty():
+            got = s.token_queue.get_nowait()
+            if got is not None:
+                seen.extend(got)
+        assert seen, "test needs some streamed progress before the drain"
+        entries = a.drain()
+        assert entries[0]["streamed"] == len(seen)
+        assert entries[0]["stream_tokens"] is True
+
+        b = tiny_engine_factory()
+        (rs,) = b.replay(entries)  # honours streaming + cursor
+        b.run()
+        resumed = []
+        while True:
+            got = rs.token_queue.get_nowait()
+            if got is None:
+                break
+            resumed.extend(got)
+        # exact continuation: no repeats, no gaps
+        np.testing.assert_array_equal(
+            np.asarray(seen + resumed, np.int32), expected
+        )
+        a.close()
+        b.close()
+
+    def test_streaminglm_journal_round_trip(self, tmp_path, monkeypatch):
+        """A journal on disk is replayed (and consumed) by the next
+        load — the respawn half of drain/handoff, in-process."""
+        from seldon_core_tpu.models.paged import StreamingLM
+
+        journal = tmp_path / "handoff.jsonl"
+        entries = [
+            {"req_id": 5, "prompt": [1, 2, 3, 4], "max_new_tokens": 6,
+             "temperature": 0.0, "top_k": 0, "eos_id": -1, "seed": 3,
+             "priority": 2, "deadline_remaining_ms": None,
+             "streamed": 0, "stream_tokens": True, "tokens_decoded": 2},
+        ]
+        with open(journal, "w") as f:
+            for e in entries:
+                f.write(json.dumps(e) + "\n")
+        monkeypatch.setenv("SELDON_TPU_DRAIN_JOURNAL", str(journal))
+        lm = StreamingLM(vocab_size=128, d_model=32, num_layers=2,
+                         num_heads=4, max_len=64, max_new_tokens=8,
+                         page_size=8, max_slots=2, steps_per_call=2, seed=0)
+        try:
+            lm.load()
+            assert not journal.exists()  # consumed: never replayed twice
+            assert lm.engine.engine_stats()["replayed"] == 1
+            # the decode loop re-derives the replayed stream to the end
+            deadline = time.monotonic() + 60.0
+            while time.monotonic() < deadline and (
+                lm.engine.has_work()
+                or lm.engine.engine_stats()["completed"] < 1
+            ):
+                lm._wake.set()
+                time.sleep(0.05)
+            assert lm.engine.engine_stats()["completed"] == 1
+            # nothing live anymore: a drain now journals nothing
+            assert lm.drain() == []
+        finally:
+            lm.shutdown()
+            if lm.engine is not None:
+                lm.engine.close()
+
+    def test_streaminglm_drain_journals_live_streams(self, tmp_path):
+        from seldon_core_tpu.models.paged import StreamingLM
+
+        journal = tmp_path / "drain.jsonl"
+        lm = StreamingLM(vocab_size=128, d_model=32, num_layers=2,
+                         num_heads=4, max_len=64, max_new_tokens=8,
+                         page_size=8, max_slots=2, steps_per_call=2, seed=0)
+        lm.load()
+        # park live streams by submitting with the loop stalled: flag
+        # the drain FIRST (so the exiting loop leaves the engine open —
+        # the drain-owns-the-streams rule), then stop the loop
+        lm._draining = True
+        lm.shutdown()
+        lm._loop_thread.join(timeout=10.0)
+        s1 = lm.engine.submit(np.arange(5, dtype=np.int32), max_new_tokens=8)
+        s2 = lm.engine.submit(np.arange(4, dtype=np.int32), max_new_tokens=8,
+                              stream_tokens=True)
+        entries = lm.drain(journal_path=str(journal))
+        assert len(entries) == 2
+        assert journal.exists()
+        with open(journal) as f:
+            on_disk = [json.loads(line) for line in f if line.strip()]
+        assert {e["req_id"] for e in on_disk} == {s1.req_id, s2.req_id}
+        assert s1.error.reason == "DRAINING"
+        assert s2.error.reason == "DRAINING"
+        lm.engine.close()
+
+
+# ---------------------------------------------------------------------------
+# supervisor exhaustion + /debug/workers
+# ---------------------------------------------------------------------------
+
+
+class TestWorkerExhaustion:
+    def test_exhausted_state_is_surfaced_not_silent(self):
+        from seldon_core_tpu.controlplane.supervisor import (
+            ProcessSpec,
+            SupervisedProcess,
+            Supervisor,
+        )
+
+        spec = ProcessSpec(
+            name="doomed", component="definitely.not.a.Component",
+            http_port=_free_port(), grpc_port=_free_port(),
+        )
+        sp = SupervisedProcess(spec, max_restarts=0)
+        # the drain journal path is pinned per worker at construction
+        assert "SELDON_TPU_DRAIN_JOURNAL" in spec.env
+        sp.start()
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline and not sp.exhausted:
+            time.sleep(0.1)
+        assert sp.exhausted, "restart-budget exhaustion never surfaced"
+        sup = Supervisor()
+        sup.processes["doomed"] = sp
+        health = sup.health()
+        assert health["doomed"]["exhausted"] is True
+        assert health["doomed"]["state"] == "exhausted"
+        assert health["doomed"]["alive"] is False
+        sp.stop()
+
+    def test_debug_workers_endpoint_reports_exhausted(self):
+        from aiohttp.test_utils import TestClient, TestServer
+
+        from seldon_core_tpu.engine.server import Gateway, build_gateway_app
+        from seldon_core_tpu.engine.service import PredictorService
+
+        class StubSupervisor:
+            def health(self):
+                return {
+                    "w-ok": {"alive": True, "ready": True, "restarts": 0,
+                             "max_restarts": 5, "exhausted": False,
+                             "state": "running"},
+                    "w-dead": {"alive": False, "ready": False, "restarts": 5,
+                               "max_restarts": 5, "exhausted": True,
+                               "state": "exhausted"},
+                }
+
+        svc = PredictorService(
+            UnitSpec(name="m", type="MODEL", component=Doubler()), name="p"
+        )
+        gateway = Gateway([(svc, 1.0)], supervisor=StubSupervisor())
+
+        async def scenario():
+            app = build_gateway_app(gateway)
+            server = TestServer(app)
+            client = TestClient(server)
+            await client.start_server()
+            try:
+                out = await (await client.get("/debug/workers")).json()
+            finally:
+                await client.close()
+            return out
+
+        out = _run(scenario())
+        assert out["exhausted"] == ["w-dead"]
+        assert out["workers"]["w-dead"]["state"] == "exhausted"
+        assert out["workers"]["w-ok"]["state"] == "running"
+
+    def test_debug_workers_empty_without_supervisor(self):
+        from aiohttp.test_utils import TestClient, TestServer
+
+        from seldon_core_tpu.engine.server import Gateway, build_gateway_app
+        from seldon_core_tpu.engine.service import PredictorService
+
+        svc = PredictorService(
+            UnitSpec(name="m", type="MODEL", component=Doubler()), name="p"
+        )
+        gateway = Gateway([(svc, 1.0)])
+
+        async def scenario():
+            app = build_gateway_app(gateway)
+            server = TestServer(app)
+            client = TestClient(server)
+            await client.start_server()
+            try:
+                return await (await client.get("/debug/workers")).json()
+            finally:
+                await client.close()
+
+        out = _run(scenario())
+        assert out == {"workers": {}, "exhausted": []}
+
+
+# ---------------------------------------------------------------------------
+# slow chaos: SIGTERM a live worker -> drain journal -> respawn -> replay
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+@pytest.mark.e2e
+def test_sigterm_drains_journal_and_respawn_replays_bit_exact():
+    """The full drain/handoff loop across real processes: a supervised
+    StreamingLM worker is SIGTERMed MID-REQUEST; the dying process
+    journals the in-flight stream (microservice SIGTERM → drain), the
+    supervisor respawns it on the same endpoint + journal path, the
+    fresh engine replays the journal through submit, and the retried
+    request returns the exact pre-kill greedy answer."""
+    import urllib.request
+
+    from seldon_core_tpu.controlplane.supervisor import ProcessSpec, Supervisor
+
+    params = json.dumps([
+        {"name": "vocab_size", "value": "2048", "type": "INT"},
+        {"name": "d_model", "value": "64", "type": "INT"},
+        {"name": "num_layers", "value": "2", "type": "INT"},
+        {"name": "num_heads", "value": "4", "type": "INT"},
+        {"name": "max_len", "value": "256", "type": "INT"},
+        {"name": "max_new_tokens", "value": "240", "type": "INT"},
+        {"name": "page_size", "value": "8", "type": "INT"},
+        {"name": "max_slots", "value": "2", "type": "INT"},
+        # one compiled chunk per token: the SIGTERM lands mid-stream
+        {"name": "steps_per_call", "value": "1", "type": "INT"},
+        {"name": "seed", "value": "0", "type": "INT"},
+    ])
+    http_port, grpc_port = _free_port(), _free_port()
+    sup = Supervisor()
+    prompt = (np.arange(6, dtype=np.int32) % 64)[None, :]
+
+    async def scenario():
+        await asyncio.to_thread(
+            sup.add,
+            ProcessSpec(
+                name="drain-chaos", component="seldon_core_tpu.models.paged.StreamingLM",
+                http_port=http_port, grpc_port=grpc_port,
+                parameters_json=params,
+                env={"JAX_PLATFORMS": "cpu", "SELDON_TPU_PLATFORM": "cpu"},
+            ),
+            240.0,
+        )
+        worker = sup.processes["drain-chaos"]
+        journal = worker.spec.env["SELDON_TPU_DRAIN_JOURNAL"]
+        unit = UnitSpec(name="lm", type="MODEL")
+        unit.endpoint = Endpoint(host="127.0.0.1", port=grpc_port,
+                                 transport="GRPC")
+        client = GrpcClient(unit, deadline_s=180.0, retries=1, breaker=False)
+        try:
+            # baseline: greedy + seed-deterministic = THE answer
+            out = await client.transform_input(
+                InternalMessage(payload=prompt, kind="ndarray")
+            )
+            expected = np.asarray(out.array())
+            assert expected.shape[-1] == 240
+
+            # in-flight request, then SIGTERM (graceful — unlike the
+            # SIGKILL chaos test, the worker gets to drain)
+            inflight = asyncio.ensure_future(client.transform_input(
+                InternalMessage(payload=prompt, kind="ndarray")
+            ))
+            await asyncio.sleep(0.3)
+            assert not inflight.done(), "decode too fast for the chaos"
+            first_pid = worker.proc.pid
+            worker.proc.terminate()
+            # the dying worker journals the stream; its waiter fails
+            # cleanly — as the in-band DRAINING FAILURE when the reply
+            # flushes before the listener stops, or as a transport
+            # error when the connection dies first (both are clean:
+            # bounded, never a hang)
+            try:
+                res = await asyncio.wait_for(inflight, timeout=60.0)
+                status = res.status or {}
+                assert status.get("status") == "FAILURE", status
+            except MicroserviceError:
+                pass
+
+            # journal written by the OLD process, consumed by the NEW:
+            # poll until the respawn's load replays+unlinks it (the
+            # window where it exists on disk can be very short)
+            deadline = time.monotonic() + 180.0
+            saw_journal = os.path.exists(journal)
+            while time.monotonic() < deadline:
+                saw_journal = saw_journal or os.path.exists(journal)
+                if (worker.alive() and worker.proc.pid != first_pid
+                        and worker.ready() and not os.path.exists(journal)):
+                    break
+                await asyncio.sleep(0.25)
+            assert worker.restarts >= 1 and worker.ready()
+            assert saw_journal, "drain never wrote the handoff journal"
+            assert not os.path.exists(journal), "respawn never consumed it"
+
+            # the respawned engine REPLAYED the journaled stream (the
+            # bridge exports on the decode loop's cadence — poll until
+            # its first collect lands)
+            def replay_count() -> float:
+                metrics = urllib.request.urlopen(
+                    f"http://127.0.0.1:{http_port}/metrics", timeout=10
+                ).read().decode()
+                return sum(
+                    float(line.rsplit(" ", 1)[1])
+                    for line in metrics.splitlines()
+                    if line.startswith("seldon_tpu_engine_replayed_total")
+                )
+
+            deadline = time.monotonic() + 60.0
+            replayed_total = 0.0
+            while time.monotonic() < deadline:
+                replayed_total = await asyncio.to_thread(replay_count)
+                if replayed_total >= 1.0:
+                    break
+                await asyncio.sleep(0.5)
+            assert replayed_total >= 1.0, (
+                "respawned engine reports no replayed streams"
+            )
+
+            # and the retried request is bit-exact with the baseline
+            out2 = await client.transform_input(
+                InternalMessage(payload=prompt, kind="ndarray")
+            )
+            np.testing.assert_array_equal(np.asarray(out2.array()), expected)
+        finally:
+            await client.close()
+            await asyncio.to_thread(sup.stop_all)
+
+    _run(scenario())
+
+
+# ---------------------------------------------------------------------------
+# full-jitter backoff
+# ---------------------------------------------------------------------------
+
+
+class TestBackoffJitter:
+    def test_backoff_spreads_instead_of_synchronising(self):
+        """The satellite's point: two callers that saw the same failure
+        must NOT sleep the same amount (lockstep retries are the storm
+        TransportRetryStorm alerts on)."""
+        samples = [backoff_s(3) for _ in range(64)]
+        assert len({round(s, 6) for s in samples}) > 16  # spread, not a constant
+        assert all(0.0 <= s <= 0.4 for s in samples)  # 0.05 * 2^3
+
+    def test_backoff_is_capped(self):
+        assert all(backoff_s(30) <= 2.0 for _ in range(16))
+        assert all(backoff_s(0) <= 0.05 for _ in range(16))
