@@ -1,6 +1,6 @@
 PROTOC ?= protoc
 
-.PHONY: proto test native bench clean
+.PHONY: proto test native bench lint clean
 
 proto:
 	$(PROTOC) -Iseldon_core_tpu/proto --python_out=seldon_core_tpu/proto \
@@ -26,6 +26,13 @@ test-all:
 
 bench:
 	python bench.py
+
+# static-invariant suite (tools/graftlint): jit purity, knob registry,
+# lock discipline, metrics contract, propagation, exception hygiene.
+# Also runs inside tier-1 (tests/test_graftlint.py) and stamps
+# lint_violations on the bench compact line.
+lint:
+	python -m tools.graftlint
 
 clean:
 	find . -name __pycache__ -type d -exec rm -rf {} + 2>/dev/null || true

@@ -214,6 +214,12 @@ COMPACT_PICKS = [
     ("chaos_goodput_pct", ("chaos", "chaos_goodput_pct")),
     ("breaker_fastfail_pct", ("chaos", "breaker_fastfail_pct")),
     ("hedge_win_pct", ("chaos", "hedge_win_pct")),
+    # r13 static-invariant certification: unsuppressed tools/graftlint
+    # violations over the whole tree (jit purity, knob registry, lock
+    # discipline, metrics contract, propagation, exception hygiene).
+    # MUST be 0 — per-checker counts + allowlist burn-down size in
+    # bench_full.json lint
+    ("lint_violations", ("lint", "violations")),
     # r7 observability certification: paged throughput cost of the FULL
     # observability stack (lifecycle spans + per-chunk flight recorder)
     # vs everything disabled, same 16-stream protocol both sides.
@@ -1358,6 +1364,13 @@ async def child_main() -> None:
             status["extra"]["chaos_error"] = str(e)[:200]
         _checkpoint(status)
 
+    if os.environ.get("BENCH_LINT", "1") == "1":
+        try:
+            status["extra"]["lint"] = lint_phase()
+        except Exception as e:  # noqa: BLE001
+            status["extra"]["lint_error"] = str(e)[:200]
+        _checkpoint(status)
+
     status["extra"]["mean_batch_rows"] = round(server.batcher.stats.mean_batch_rows, 2)
     status["extra"]["device_batches"] = server.batcher.stats.batches
     if native_handle is not None:
@@ -1382,6 +1395,26 @@ async def child_main() -> None:
         "vs_baseline": round(P50_TARGET_MS / p50, 3),
         "extra": extra,
     })
+
+
+def lint_phase() -> dict:
+    """Static-invariant certification (r13): run the full
+    tools/graftlint suite over the tree and stamp the violation count
+    on the line.  lint_violations MUST be 0 — a certified perf number
+    on a tree that violates its own invariants (undeclared knobs,
+    unmapped counters, lock-discipline drift) is not a certification.
+    Costs ~1-2 s of AST parsing; per-checker counts and the allowlist
+    burn-down size land in bench_full.json."""
+    from tools.graftlint.core import run_suite
+
+    res = run_suite(os.path.dirname(os.path.abspath(__file__)))
+    return {
+        "violations": len(res["violations"]),
+        "counts": res["counts"],
+        "allowlisted": len(res["suppressed"]),
+        "files_scanned": res["files_scanned"],
+        "checkers": len(res["checkers"]),
+    }
 
 
 async def trace_prop_phase() -> dict:

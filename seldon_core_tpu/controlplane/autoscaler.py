@@ -211,7 +211,8 @@ class ReplicaSet:
             while len(self._replicas) < n:
                 try:
                     sp = self._spawn_one()
-                except Exception as e:  # noqa: BLE001
+                except Exception as e:  # noqa: BLE001 — spawn failure is
+                    # surfaced as reconcile-degraded, not a dead autoscaler
                     spawn_error = e
                     break
                 self._replicas.append(sp)

@@ -287,7 +287,8 @@ def _reject_device_exclusive_root(predictor: str, component: str, hpa) -> None:
     module, _, cls = component.rpartition(".")
     try:
         klass = getattr(importlib.import_module(module), cls)
-    except Exception:  # noqa: BLE001
+    except Exception:  # noqa: BLE001 — unimportable component: the
+        # device-exclusivity probe is advisory; load reports the real error
         return
     if getattr(klass, "device_exclusive", False):
         raise DeploymentSpecError(

@@ -111,7 +111,7 @@ class SupervisedProcess:
             from seldon_core_tpu.utils.metrics import record_worker_health
 
             record_worker_health(self.spec.name, self.restarts, self.exhausted)
-        except Exception:  # noqa: BLE001
+        except Exception:  # noqa: BLE001 — metrics must not break supervision
             logger.debug("worker health metric unavailable", exc_info=True)
 
     def _watch(self) -> None:
@@ -159,7 +159,7 @@ class SupervisedProcess:
                 f"http://127.0.0.1:{self.spec.http_port}/health/ping", timeout=timeout_s
             ) as resp:
                 return resp.status < 400
-        except Exception:
+        except Exception:  # any probe failure reads as not-ready
             return False
 
     def wait_ready(self, timeout_s: float = 30.0) -> bool:

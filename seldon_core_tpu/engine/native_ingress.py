@@ -60,7 +60,7 @@ def fast_lane_for(gateway) -> Optional[dict]:
         cn = component.class_names()
         if cn and len(cn) == out_dim:
             names = [str(n) for n in cn]
-    except Exception:  # noqa: BLE001
+    except Exception:  # noqa: BLE001 — class_names is an optional probe
         pass
     buckets = None
     batcher = getattr(component, "batcher", None)
@@ -306,13 +306,15 @@ async def serve_native_ingress(
         gateway, lambda: server_box[0]
     )
     lane = fast_lane_for(gateway)
+    from seldon_core_tpu.runtime import knobs
+
     if batch_threads is None:
-        batch_threads = int(os.environ.get("SELDON_TPU_NATIVE_BATCH_THREADS", "4"))
+        batch_threads = int(knobs.raw("SELDON_TPU_NATIVE_BATCH_THREADS", "4"))
     # the raw-worker pool now also carries the gRPC fallback lanes
     # (unary SendFeedback/Predict block in fut.result; stream accepts
     # must never queue behind them) — default well above the bare
     # HTTP-fallback sizing of 2
-    raw_workers = int(os.environ.get("SELDON_TPU_NATIVE_RAW_WORKERS", "8"))
+    raw_workers = int(knobs.raw("SELDON_TPU_NATIVE_RAW_WORKERS", "8"))
     kwargs = dict(port=http_port, raw_handler=handler, grpc_handler=grpc_handler,
                   grpc_stream_handler=grpc_stream_handler,
                   max_wait_ms=max_wait_ms, host=host,
@@ -342,7 +344,7 @@ async def serve_native_ingress(
             try:
                 ok = await gateway.ready()
                 server.set_ready(bool(ok))
-            except Exception:  # noqa: BLE001
+            except Exception:  # noqa: BLE001 — readiness poll failure = not ready
                 server.set_ready(False)
             await asyncio.sleep(0.5)
 

@@ -312,11 +312,20 @@ def build_gateway_app(gateway: Gateway, auth=None) -> web.Application:
             return _error_response(e)
 
     async def explanations(request: web.Request) -> web.Response:
+        from seldon_core_tpu.runtime.rest import _remote_ctx, _remote_deadline_ms
+        from seldon_core_tpu.utils import deadlines as _deadlines
+        from seldon_core_tpu.utils.tracing import activate_context
+
         try:
             body = await _request_body(request)
             msg = InternalMessage.from_json(body)
             svc = gateway.by_name(request.query.get("predictor", "")) or gateway.pick()
-            out = await svc.explain(msg)
+            # every ingress mints the deadline and adopts the caller's
+            # trace (graftlint: propagation) — explanations included
+            with activate_context(_remote_ctx(request)), \
+                    _deadlines.activate_ms(_remote_deadline_ms(request)):
+                _deadlines.check("gateway ingress /api/v0.1/explanations")
+                out = await svc.explain(msg)
             return web.json_response(out.to_json(), status=_http_status(out))
         except Exception as e:  # noqa: BLE001
             return _error_response(e)
@@ -421,10 +430,20 @@ def build_gateway_app(gateway: Gateway, auth=None) -> web.Application:
         return resp
 
     async def feedback(request: web.Request) -> web.Response:
+        from seldon_core_tpu.runtime.rest import _remote_ctx, _remote_deadline_ms
+        from seldon_core_tpu.utils import deadlines as _deadlines
+        from seldon_core_tpu.utils.tracing import activate_context
+
         try:
             body = await _request_body(request)
             fb = InternalFeedback.from_json(body)
-            out = await gateway.send_feedback(fb)
+            # feedback is exempt from RETRIES/hedging, not from the
+            # ingress contract: the budget still rides (and fast-fails)
+            # and reward spans still stitch under the caller's trace
+            with activate_context(_remote_ctx(request)), \
+                    _deadlines.activate_ms(_remote_deadline_ms(request)):
+                _deadlines.check("gateway ingress /api/v0.1/feedback")
+                out = await gateway.send_feedback(fb)
             return web.json_response(out.to_json(), status=_http_status(out))
         except Exception as e:  # noqa: BLE001
             return _error_response(e)
@@ -518,6 +537,19 @@ def build_gateway_app(gateway: Gateway, auth=None) -> web.Application:
             {"enabled": True, "spans": [s.to_dict() for s in spans[-limit:]]}
         )
 
+    async def debug_knobs(_r: web.Request) -> web.Response:
+        """The central knob registry (runtime/knobs.py) with this
+        process's effective values: "what is this gateway actually
+        running with" as one curl instead of a grep through env dumps.
+        Declared metadata only — no secrets live in SELDON_TPU_*."""
+        from seldon_core_tpu.runtime import knobs as _knobs
+
+        snap = _knobs.snapshot()
+        return web.json_response({
+            "knobs": snap,
+            "set": sorted(k["name"] for k in snap if k["set"]),
+        })
+
     async def openapi_endpoint(_r: web.Request) -> web.Response:
         from seldon_core_tpu.runtime.openapi import gateway_openapi
 
@@ -539,6 +571,7 @@ def build_gateway_app(gateway: Gateway, auth=None) -> web.Application:
     app.router.add_get("/debug/engine", debug_engine)
     app.router.add_get("/debug/workers", debug_workers)
     app.router.add_get("/debug/traces", debug_traces)
+    app.router.add_get("/debug/knobs", debug_knobs)
     return app
 
 
@@ -581,8 +614,21 @@ def add_seldon_service(server: grpc.aio.Server, gateway: Gateway, auth=None) -> 
 
     async def send_feedback(request: pb.Feedback, context) -> pb.SeldonMessage:
         await check_auth(context)
+        from seldon_core_tpu.runtime.grpc_server import (
+            _grpc_deadline_ms,
+            _grpc_remote_ctx,
+        )
+        from seldon_core_tpu.utils import deadlines as _deadlines
+        from seldon_core_tpu.utils.tracing import activate_context
+
         fb = InternalFeedback.from_proto(request)
-        out = await gateway.send_feedback(fb)
+        try:
+            with activate_context(_grpc_remote_ctx(context)), \
+                    _deadlines.activate_ms(_grpc_deadline_ms(context)):
+                _deadlines.check("gateway grpc ingress Seldon/SendFeedback")
+                out = await gateway.send_feedback(fb)
+        except MicroserviceError as e:  # ingress fast-fail (DEADLINE_EXCEEDED)
+            out = failure_message(e, fb.request.meta.puid if fb.request else "")
         return out.to_proto()
 
     async def generate_stream(request: pb.SeldonMessage, context):
@@ -608,6 +654,24 @@ def add_seldon_service(server: grpc.aio.Server, gateway: Gateway, auth=None) -> 
                 "component implements predict_stream (e.g. STREAMING_LM)",
             )
         meta = {"tags": dict(msg.meta.tags), "puid": msg.meta.puid}
+        # SLO parity with the SSE twin: the streaming generator runs on
+        # plain executor threads (no contextvar copy), so the deadline
+        # and priority ride meta.tags as an ABSOLUTE monotonic expiry
+        # minted here at ingress (tags in the body win).  Without this
+        # the gRPC stream lane silently ignored x-seldon-deadline-ms.
+        import time as _mono_time
+
+        from seldon_core_tpu.runtime.grpc_server import _grpc_deadline_ms
+        from seldon_core_tpu.utils import deadlines as _deadlines
+
+        md_ms = _grpc_deadline_ms(context)
+        if md_ms is not None:
+            meta["tags"].setdefault(
+                "deadline_at_monotonic", _mono_time.monotonic() + md_ms / 1000.0
+            )
+        md_prio = _deadlines.extract_priority(context.invocation_metadata() or ())
+        if md_prio is not None:
+            meta["tags"].setdefault("priority", md_prio)
         loop = asyncio.get_running_loop()
         it = gen_fn(msg.array(), [], meta=meta)
         sentinel = object()
@@ -651,7 +715,25 @@ def add_seldon_service(server: grpc.aio.Server, gateway: Gateway, auth=None) -> 
                 )
             parts.append(chunk.data)
         request = pb.SeldonMessage.FromString(b"".join(parts))
-        out = await gateway.predict(InternalMessage.from_proto(request))
+        from seldon_core_tpu.runtime.grpc_server import (
+            _grpc_deadline_ms,
+            _grpc_remote_ctx,
+        )
+        from seldon_core_tpu.utils import deadlines as _deadlines
+        from seldon_core_tpu.utils.tracing import activate_context
+
+        # chunked predict is a unary call once reassembled: the
+        # standard ingress contract applies (deadline minted AFTER the
+        # stream is buffered — reassembly time counts against the
+        # caller's budget only if they set the native gRPC deadline)
+        msg = InternalMessage.from_proto(request)
+        try:
+            with activate_context(_grpc_remote_ctx(context)), \
+                    _deadlines.activate_ms(_grpc_deadline_ms(context)):
+                _deadlines.check("gateway grpc ingress Seldon/PredictStream")
+                out = await gateway.predict(msg)
+        except MicroserviceError as e:  # ingress fast-fail (DEADLINE_EXCEEDED)
+            out = failure_message(e, msg.meta.puid)
         for chunk in services.chunk_message(out.to_proto()):
             yield chunk
 

@@ -160,7 +160,7 @@ class PredictorService:
             if self.request_logger is not None:
                 try:
                     self.request_logger(request, response)
-                except Exception:
+                except Exception:  # logging must never fail the data plane
                     logger.exception("request logger failed")
             return response
         except Exception as e:
@@ -223,7 +223,7 @@ class PredictorService:
             if self.request_logger is not None:
                 try:
                     self.request_logger(request, response)
-                except Exception:
+                except Exception:  # logging must never fail the data plane
                     logger.exception("request logger failed")
             return response
         except Exception as e:  # noqa: BLE001

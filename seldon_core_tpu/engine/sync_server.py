@@ -98,8 +98,34 @@ class SyncSeldonService:
 
     def send_feedback(self, request: pb.Feedback, context) -> pb.SeldonMessage:
         self._check_auth(context)
+        from seldon_core_tpu.engine.service import failure_message
+        from seldon_core_tpu.runtime.component import MicroserviceError
+        from seldon_core_tpu.runtime.grpc_server import (
+            _grpc_deadline_ms,
+            _grpc_remote_ctx,
+        )
+        from seldon_core_tpu.utils import deadlines as _deadlines
+        from seldon_core_tpu.utils.tracing import activate_context
+
         fb = InternalFeedback.from_proto(request)
-        out = self._bridge(self.gateway.send_feedback(fb))
+        # same ingress contract as predict: absolute expiry minted on
+        # the handler thread, re-activated inside the bridged coroutine
+        # (run_coroutine_threadsafe drops contextvars)
+        ctx = _grpc_remote_ctx(context)
+        budget_ms = _grpc_deadline_ms(context)
+        budget = _deadlines.Deadline.after_ms(budget_ms) if budget_ms is not None else None
+
+        async def _feedback_with_ctx():
+            with activate_context(ctx), _deadlines.activate(budget):
+                _deadlines.check("gateway grpc ingress Seldon/SendFeedback")
+                return await self.gateway.send_feedback(fb)
+
+        try:
+            out = self._bridge(_feedback_with_ctx())
+        except MicroserviceError as e:  # ingress fast-fail (DEADLINE_EXCEEDED)
+            out = failure_message(
+                e, fb.request.meta.puid if fb.request else ""
+            )
         return out.to_proto()
 
     def generate_stream(self, request: pb.SeldonMessage, context):

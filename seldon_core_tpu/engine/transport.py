@@ -175,7 +175,9 @@ def breakers_enabled() -> bool:
     """SELDON_TPU_BREAKER=0 disables circuit breaking globally (the
     parity lane: breaker-off behaviour is byte-identical to the
     pre-breaker engine)."""
-    return os.environ.get("SELDON_TPU_BREAKER", "1") != "0"
+    from seldon_core_tpu.runtime import knobs
+
+    return knobs.flag("SELDON_TPU_BREAKER")
 
 
 class CircuitBreaker:
@@ -696,7 +698,7 @@ class GrpcClient(NodeClient):
             await asyncio.sleep(delay)
             try:
                 await chan.close()
-            except Exception as e:  # noqa: BLE001
+            except Exception as e:  # noqa: BLE001 — best-effort channel cleanup
                 logger.debug("closing backed-off channel failed: %s", e)
 
         task = asyncio.ensure_future(close_later(self.deadline_s + 1.0))
@@ -865,7 +867,7 @@ class GrpcClient(NodeClient):
             chan = self._channel()
             await asyncio.wait_for(chan.channel_ready(), timeout=self.deadline_s)
             return True
-        except Exception:
+        except Exception:  # any dial failure reads as not-ready
             return False
 
     async def close(self) -> None:
@@ -1116,7 +1118,7 @@ class RestClient(NodeClient):
             session = self._get_session()
             async with session.get(self.base + "/health/ping") as resp:
                 return resp.status < 400
-        except Exception:
+        except Exception:  # any probe failure reads as not-ready
             return False
 
     async def close(self) -> None:
@@ -1166,7 +1168,7 @@ class BalancedClient(NodeClient):
         for client in retired:
             try:
                 await client.close()
-            except Exception as e:  # noqa: BLE001
+            except Exception as e:  # noqa: BLE001 — best-effort client cleanup
                 logger.debug("closing retired replica client failed: %s", e)
 
     @property

@@ -52,9 +52,7 @@ def paged_kernel_mode() -> str:
     pool-layout decision (:func:`pool_is_flat`) and the engine's
     chunk-impl auto-select all read through here, so a new mode string
     cannot leave the three silently disagreeing."""
-    import os
-
-    return os.environ.get("SELDON_TPU_PAGED_KERNEL", "0")
+    return _knobs.raw("SELDON_TPU_PAGED_KERNEL", "0")
 
 
 def paged_kernel_requested(mode: Optional[str] = None) -> bool:
@@ -79,6 +77,7 @@ def paged_kernel_static_eligible(mode: str, mesh_absent: bool, dtype) -> bool:
     )
 
 from seldon_core_tpu.models.generate import _buckets_for
+from seldon_core_tpu.runtime import knobs as _knobs
 from seldon_core_tpu.runtime.component import MicroserviceError, TPUComponent
 from seldon_core_tpu.utils import faults as _faults
 from seldon_core_tpu.utils.deadlines import deadline_exceeded
@@ -943,7 +942,7 @@ class PagedEngine:
         kernel_eligible = paged_kernel_static_eligible(
             kernel_mode, mesh is None, dtype
         )
-        self._chunk_impl = _os.environ.get("SELDON_TPU_CHUNK_IMPL", "")
+        self._chunk_impl = _knobs.raw("SELDON_TPU_CHUNK_IMPL", "")
         if not self._chunk_impl:
             self._chunk_impl = "pool" if kernel_eligible else "ring"
             if kernel_eligible:
@@ -978,7 +977,7 @@ class PagedEngine:
         # traffic degenerates to one bucket automatically (identical
         # horizons), so the uniform-load programs are byte-identical
         # with the knob on.
-        buckets_env = _os.environ.get("SELDON_TPU_CTX_BUCKETS", "") or "2"
+        buckets_env = _knobs.raw("SELDON_TPU_CTX_BUCKETS", "") or "2"
         if buckets_env not in ("1", "2"):
             raise ValueError(
                 f"SELDON_TPU_CTX_BUCKETS={buckets_env!r}: supported values "
@@ -1060,13 +1059,13 @@ class PagedEngine:
         # default ON — automatic prefix reuse costs one hash walk per
         # admission and nothing on the decode hot loop
         if prefix_cache is None:
-            prefix_cache = _os.environ.get("SELDON_TPU_PREFIX_CACHE", "1") != "0"
+            prefix_cache = _knobs.flag("SELDON_TPU_PREFIX_CACHE")
         self._prefix_cache_enabled = bool(prefix_cache)
         # SELDON_TPU_PAGED_DEBUG=1: allocator state-machine audit at
         # every chunk boundary (no page simultaneously free/cached/
         # mapped; refcounts match live block tables)
         self._debug_invariants = (
-            _os.environ.get("SELDON_TPU_PAGED_DEBUG", "") == "1"
+            _knobs.flag("SELDON_TPU_PAGED_DEBUG")
         )
         # run queue: deque + identity membership set — O(1) end ops
         # (submit append / evict appendleft, where the old list paid
@@ -1082,7 +1081,7 @@ class PagedEngine:
         # first, then the lowest-priority one — goodput over FIFO
         # fairness exactly when the queue is the p99 term (§10a).
         if not max_queue:
-            max_queue = int(_os.environ.get("SELDON_TPU_MAX_QUEUE", "0") or 0)
+            max_queue = int(_knobs.raw("SELDON_TPU_MAX_QUEUE", "0") or 0)
         self.max_queue = max(0, int(max_queue))
         self._queue: Deque[_Stream] = deque()
         self._queued: set = set()  # identity membership (streams are unhashable-by-value)
@@ -1136,7 +1135,7 @@ class PagedEngine:
         # chunk-wall p99 auto-dumps the ring to JSONL under
         # SELDON_TPU_DUMP_DIR — post-incident forensics with no profiler
         # attached.
-        rec_env = _os.environ.get("SELDON_TPU_FLIGHT_RECORDER", "")
+        rec_env = _knobs.raw("SELDON_TPU_FLIGHT_RECORDER", "")
         self.recorder = None
         if rec_env != "0":
             from seldon_core_tpu.utils.flightrec import FlightRecorder
@@ -1145,18 +1144,18 @@ class PagedEngine:
                 capacity=int(rec_env) if rec_env.isdigit() and rec_env != "0"
                 else 512,
                 dump_p99_ms=float(
-                    _os.environ.get("SELDON_TPU_DUMP_P99_MS", "0") or 0
+                    _knobs.raw("SELDON_TPU_DUMP_P99_MS", "0") or 0
                 ),
-                dump_dir=_os.environ.get("SELDON_TPU_DUMP_DIR") or None,
+                dump_dir=_knobs.raw("SELDON_TPU_DUMP_DIR") or None,
             )
         # opt-in XLA-level inspection: the first N decode chunks run
         # inside jax.profiler.trace (N = SELDON_TPU_PROFILE_CHUNKS,
         # default 4) writing to SELDON_TPU_PROFILE_DIR — enough to catch
         # the compiled chunk program's timeline without profiling the
         # whole serving lifetime
-        self._profile_dir = _os.environ.get("SELDON_TPU_PROFILE_DIR") or None
+        self._profile_dir = _knobs.raw("SELDON_TPU_PROFILE_DIR") or None
         self._profile_chunks_left = (
-            int(_os.environ.get("SELDON_TPU_PROFILE_CHUNKS", "4"))
+            int(_knobs.raw("SELDON_TPU_PROFILE_CHUNKS", "4"))
             if self._profile_dir else 0
         )
         self._profile_started = False
@@ -2005,7 +2004,8 @@ class PagedEngine:
                 "profiling the next %d decode chunks to %s",
                 self._profile_chunks_left, self._profile_dir,
             )
-        except Exception:  # noqa: BLE001
+        except Exception:  # noqa: BLE001 — profiler failures disable the
+            # hook, never decoding
             logger.exception("jax profiler start failed; hook disabled")
             self._profile_chunks_left = 0
 
@@ -2016,7 +2016,7 @@ class PagedEngine:
         if self._profile_chunks_left <= 0:
             try:
                 self._jax.profiler.stop_trace()
-            except Exception:  # noqa: BLE001
+            except Exception:  # noqa: BLE001 — profiler failures never stop decoding
                 logger.exception("jax profiler stop failed")
             self._profile_started = False
 
@@ -2155,7 +2155,7 @@ class PagedEngine:
         self._free_pages.append(page)
         self._counters["prefix_evictions"] += 1
 
-    def _alloc(self, n: int) -> Optional[List[int]]:
+    def _alloc_locked(self, n: int) -> Optional[List[int]]:
         """Take ``n`` fresh pages (refcount 1 each), evicting LRU-cached
         pages under pressure.  Stack-discipline deque: O(1) per page.
 
@@ -2173,7 +2173,7 @@ class PagedEngine:
             self._page_ref[p] = 1
         return out
 
-    def _free(self, pages: List[int]) -> None:
+    def _free_locked(self, pages: List[int]) -> None:
         """Release one stream's mapping of ``pages``.  A page whose
         refcount drops to zero either parks on the LRU cached set (it
         is a registered prefix page — its KV stays valid and a later
@@ -2335,7 +2335,7 @@ class PagedEngine:
             self._slots[slot] = None
             self._lengths[slot] = 0
         if stream.pages:
-            self._free(stream.pages)
+            self._free_locked(stream.pages)
             stream.pages = []
         stream.slot = None
         if stream.token_queue is not None:
@@ -2423,7 +2423,7 @@ class PagedEngine:
             if int(self._page_ref[e.page]) == 0:
                 self._lru.pop(e.page, None)
             self._page_ref[e.page] += 1
-        fresh = self._alloc(-(-plen // self.page_size) - len(matched))
+        fresh = self._alloc_locked(-(-plen // self.page_size) - len(matched))
         if fresh is None:
             for e in reversed(matched):
                 self._page_ref[e.page] -= 1
@@ -2691,7 +2691,7 @@ class PagedEngine:
         )
         need = -(-horizon // self.page_size)
         while len(stream.pages) < need:
-            got = self._alloc(1)
+            got = self._alloc_locked(1)
             if got is None:
                 return False
             self._block_tables[slot, len(stream.pages)] = got[0]
@@ -2749,7 +2749,7 @@ class PagedEngine:
                 )
             self._gen_span_deferred(stream, "gen.finish", now, 0.0, **finish_tags)
         self._slots[slot] = None
-        self._free(stream.pages)
+        self._free_locked(stream.pages)
         stream.pages = []
         self._lengths[slot] = 0
         self._counters["completed"] += 1
@@ -2776,7 +2776,7 @@ class PagedEngine:
             stream.t_decode_start = 0.0
             stream.queue_depth_at_submit = len(self._queue)
         self._slots[slot] = None
-        self._free(stream.pages)
+        self._free_locked(stream.pages)
         stream.pages = []
         stream.tokens = []
         stream.slot = None
@@ -3048,7 +3048,7 @@ class PagedEngine:
             self._lengths[:] = 0
             for stream in victims:
                 if stream.pages:
-                    self._free(stream.pages)
+                    self._free_locked(stream.pages)
                     stream.pages = []
                 stream.error = exc
                 if stream.token_queue is not None:
@@ -3184,6 +3184,9 @@ class PagedEngine:
         )
         toks_np = np.asarray(toks)
         emitted_np = np.asarray(emitted)
+        # single-writer window: the chunk runs with its streams pinned
+        # and admission only mutates lengths between chunks under the lock
+        # graftlint: allow[lock-discipline] — single-writer chunk window
         self._lengths = np.array(lengths_out)  # copy: jax views are read-only
         chunk_wall = _time.perf_counter() - t_chunk
         self._profile_after_chunk()
@@ -3359,6 +3362,9 @@ class PagedEngine:
         )
         out_np = np.asarray(out)
         counts_np = np.asarray(counts)
+        # same single-writer window as the decode chunk: streams
+        # pinned, admission between chunks
+        # graftlint: allow[lock-discipline] — single-writer chunk window
         self._lengths = np.array(lengths_out)
         chunk_wall = _time.perf_counter() - t_chunk
         self._profile_after_chunk()
@@ -3553,7 +3559,7 @@ class StreamingLM(TPUComponent):
             # opts out; a missing prometheus_client degrades to none.
             import os as _os
 
-            if _os.environ.get("SELDON_TPU_PROM_BRIDGE", "1") != "0":
+            if _knobs.flag("SELDON_TPU_PROM_BRIDGE"):
                 try:
                     from seldon_core_tpu.utils.metrics import (
                         GenerationPrometheusBridge,
@@ -3582,7 +3588,7 @@ class StreamingLM(TPUComponent):
             # prefix pages re-enter the cache where the original
             # callers' retries find them warm.  Unary replay: the
             # original streaming consumers died with the old process.
-            journal = _os.environ.get("SELDON_TPU_DRAIN_JOURNAL", "")
+            journal = _knobs.raw("SELDON_TPU_DRAIN_JOURNAL", "")
             if journal and _os.path.exists(journal):
                 try:
                     import json as _json
@@ -3670,7 +3676,7 @@ class StreamingLM(TPUComponent):
         import os as _os
 
         path = journal_path if journal_path is not None else \
-            _os.environ.get("SELDON_TPU_DRAIN_JOURNAL", "")
+            _knobs.raw("SELDON_TPU_DRAIN_JOURNAL", "")
         if self.engine is None:
             return []
         self._draining = True

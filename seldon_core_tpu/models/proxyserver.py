@@ -78,7 +78,8 @@ class RestProxyServer(TPUComponent):
                 return resp
             except MicroserviceError:
                 raise
-            except Exception as e:  # noqa: BLE001
+            except Exception as e:  # noqa: BLE001 — retried; exhaustion
+                # converts to 502 UPSTREAM_UNREACHABLE below
                 last = e
         raise MicroserviceError(
             f"upstream {self.url} unreachable: {last}", status_code=502, reason="UPSTREAM_UNREACHABLE"

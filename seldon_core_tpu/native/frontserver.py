@@ -262,7 +262,7 @@ class NativeFrontServer:
             out = np.ctypeslib.as_array(out_ptr, shape=(rows, out_cols))
             out[:] = result.reshape(rows, out_cols)
             return 0
-        except Exception:
+        except Exception:  # a raised callback would abort the C++ worker
             logger.exception("native front server batch callback failed")
             return 1
 
@@ -282,7 +282,7 @@ class NativeFrontServer:
             ct = content_type.encode()[:63]
             ctypes.memmove(ctype_buf, ct + b"\x00", len(ct) + 1)
             return 0
-        except Exception:
+        except Exception:  # a raised callback would abort the C++ worker
             logger.exception("native front server raw callback failed")
             return 1
 
@@ -300,7 +300,7 @@ class NativeFrontServer:
             m = message.encode()[:255]
             ctypes.memmove(msg_buf, m + b"\x00", len(m) + 1)
             return 0
-        except Exception:
+        except Exception:  # a raised callback would abort the C++ worker
             logger.exception("native front server grpc callback failed")
             return 1
 
@@ -308,7 +308,7 @@ class NativeFrontServer:
         try:
             body = ctypes.string_at(msg_ptr, msg_len) if msg_len else b""
             return int(self.grpc_stream_handler(path.decode(), body, int(handle)))
-        except Exception:
+        except Exception:  # a raised callback would abort the C++ worker
             logger.exception("native front server grpc stream callback failed")
             return 1
 

@@ -541,7 +541,9 @@ def paged_attention_decode(q, pk, pv, block_tables, lengths, *, page_size):
             f"page_size={page_size} does not match the pool's page dim {ps}"
         )
 
-    impl = os.environ.get("SELDON_TPU_PAGED_KERNEL_IMPL", "stream")
+    from seldon_core_tpu.runtime import knobs
+
+    impl = knobs.raw("SELDON_TPU_PAGED_KERNEL_IMPL", "stream")
     if impl == "stream" and (h * hd) % 128 != 0 and not _use_interpret():
         # the stream kernel DMAs (ps, h*hd) page slices and Mosaic
         # requires a 128-aligned minor dim; tiny models (h*hd < 128)

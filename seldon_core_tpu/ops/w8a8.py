@@ -376,7 +376,7 @@ def calibrate_act_scales(module, variables, batches, margin: float = 1.0,
         _, mutated = module.apply(variables, x, mutable=[ACT_STATS], **apply_kwargs)
         try:
             mutated = unfreeze(mutated)
-        except Exception:  # noqa: BLE001
+        except Exception:  # noqa: BLE001 — plain dicts have no unfreeze
             mutated = dict(mutated)
         batch_stats = mutated.get(ACT_STATS)
         if not batch_stats:

@@ -78,7 +78,9 @@ def resolve_tp(tp: Optional[int] = None) -> int:
     import os
 
     if tp is None or int(tp) == 0:
-        raw = os.environ.get("SELDON_TPU_TP", "").strip()
+        from seldon_core_tpu.runtime import knobs
+
+        raw = (knobs.raw("SELDON_TPU_TP", "") or "").strip()
         tp = int(raw) if raw else 1
         if tp == 0:
             tp = 1

@@ -23,7 +23,9 @@ _POOL: ThreadPoolExecutor | None = None
 def dispatch_pool() -> ThreadPoolExecutor:
     global _POOL
     if _POOL is None:
-        workers = int(os.environ.get("SELDON_TPU_DISPATCH_THREADS", "128"))
+        from seldon_core_tpu.runtime import knobs
+
+        workers = int(knobs.raw("SELDON_TPU_DISPATCH_THREADS", "128"))
         _POOL = ThreadPoolExecutor(max_workers=workers, thread_name_prefix="seldon-dispatch")
     return _POOL
 

@@ -56,7 +56,7 @@ def _grpc_deadline_ms(context):
         remaining = context.time_remaining()
         if remaining is not None:
             native_ms = max(0.0, float(remaining) * 1000.0)
-    except Exception:  # noqa: BLE001
+    except Exception:  # noqa: BLE001 — bad metadata must not fail the call
         native_ms = None
     if md_ms is None:
         return native_ms

@@ -29,6 +29,8 @@ import signal
 import sys
 from typing import Any, Dict, List, Optional
 
+from seldon_core_tpu.runtime import knobs
+
 from seldon_core_tpu.runtime.params import (
     PARAMETERS_ENV_NAME,
     SERVICE_PORT_ENV_NAME,
@@ -94,7 +96,7 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
     parser.add_argument("--tracing", action="store_true", default=bool(int(os.environ.get("TRACING", "0"))))
     parser.add_argument("--log-level", default=os.environ.get("SELDON_LOG_LEVEL", "INFO"))
     parser.add_argument(
-        "--platform", default=os.environ.get("SELDON_TPU_PLATFORM", ""),
+        "--platform", default=knobs.raw("SELDON_TPU_PLATFORM", ""),
         help="force the jax platform (cpu|tpu|...). Needed because some "
         "environments pre-import jax before env vars like JAX_PLATFORMS "
         "can take effect; applied through jax.config before backend init",
@@ -194,7 +196,7 @@ def main(argv: Optional[List[str]] = None) -> None:
         # standard OTEL_EXPORTER_OTLP_ENDPOINT env either way)
         setup_tracing(
             service_name=args.unit_id or args.component,
-            export_path=os.environ.get("SELDON_TPU_TRACE_EXPORT") or None,
+            export_path=knobs.raw("SELDON_TPU_TRACE_EXPORT") or None,
         )
 
     persistence_thread = None

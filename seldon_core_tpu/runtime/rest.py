@@ -135,13 +135,21 @@ def _custom_endpoint(user_handler: Callable) -> Callable:
     process), and must not freeze the event loop."""
 
     async def handler(request: web.Request) -> web.Response:
+        from seldon_core_tpu.utils import deadlines as _deadlines
+        from seldon_core_tpu.utils.tracing import activate_context
+
         try:
-            if asyncio.iscoroutinefunction(user_handler):
-                result = await user_handler(request)
-            else:
-                result = await run_dispatch(user_handler, request)
-                if asyncio.iscoroutine(result):  # sync fn returned a coroutine
-                    result = await result
+            # custom routes are ingress too (graftlint: propagation):
+            # the user handler inherits the caller's trace + budget
+            with activate_context(_remote_ctx(request)), \
+                    _deadlines.activate_ms(_remote_deadline_ms(request)):
+                _deadlines.check(f"microservice ingress {request.path}")
+                if asyncio.iscoroutinefunction(user_handler):
+                    result = await user_handler(request)
+                else:
+                    result = await run_dispatch(user_handler, request)
+                    if asyncio.iscoroutine(result):  # sync fn returned a coroutine
+                        result = await result
             if isinstance(result, web.Response):
                 return result
             return web.json_response(result)
@@ -238,9 +246,18 @@ def build_app(
     async def ping(_request: web.Request) -> web.Response:
         return web.Response(text="pong")
 
-    async def status(_request: web.Request) -> web.Response:
+    async def status(request: web.Request) -> web.Response:
+        from seldon_core_tpu.utils import deadlines as _deadlines
+        from seldon_core_tpu.utils.tracing import activate_context
+
         try:
-            out = await run_dispatch(dispatch.health_check, user_model)
+            # health dispatch honours the same ingress contract: a
+            # probe with a budget fast-fails instead of piling onto a
+            # saturated dispatch pool
+            with activate_context(_remote_ctx(request)), \
+                    _deadlines.activate_ms(_remote_deadline_ms(request)):
+                _deadlines.check("microservice ingress /health/status")
+                out = await run_dispatch(dispatch.health_check, user_model)
             return web.json_response(out.to_json())
         except Exception as e:  # noqa: BLE001
             return _error_response(e)

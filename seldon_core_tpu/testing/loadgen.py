@@ -71,7 +71,8 @@ def run_load(
             t0 = time.perf_counter()
             try:
                 ok = request_fn()
-            except Exception:  # noqa: BLE001
+            except Exception:  # noqa: BLE001 — load generator counts any
+                # request failure as an error sample
                 ok = False
             if ok:
                 mine.append((time.perf_counter() - t0) * 1000.0)

@@ -117,5 +117,5 @@ def parse_basic_auth(header: Optional[str]) -> Optional[tuple]:
         decoded = base64.b64decode(header[6:].strip()).decode()
         key, _, secret = decoded.partition(":")
         return key, secret
-    except Exception:  # noqa: BLE001
+    except Exception:  # noqa: BLE001 — any malformed header is not-authenticated
         return None

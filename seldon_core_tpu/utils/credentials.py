@@ -31,7 +31,7 @@ def _decode(v: Any) -> str:
         # round-trips cleanly AND decodes to printable text -> was base64
         if decoded.isprintable() and base64.b64encode(decoded.encode()).decode() == v:
             return decoded
-    except Exception:  # noqa: BLE001
+    except Exception:  # noqa: BLE001 — not base64: use the raw value
         pass
     return str(v)
 

@@ -21,7 +21,7 @@ for delay-style points).
 
 Registered injection points:
 
-* ``paged.alloc`` — ``PagedEngine._alloc`` returns None (allocator
+* ``paged.alloc`` — ``PagedEngine._alloc_locked`` returns None (allocator
   exhaustion): exercises the stall/evict/rollback machinery.
 * ``paged.chunk`` — the decode/verify chunk raises *before* the device
   call is issued (buffers stay valid): exercises the engine's
@@ -104,6 +104,11 @@ _fired_total: Dict[str, int] = {}
 
 
 def _parse(spec: str) -> Dict[str, _Fault]:
+    """Strict spec-grammar parse: every malformation raises ValueError
+    naming the offending fragment.  A chaos harness that silently
+    no-ops on a typo'd spec certifies resilience it never exercised —
+    loud failure IS the feature (the negative-grammar tests pin each
+    case)."""
     out: Dict[str, _Fault] = {}
     for part in spec.split(";"):
         part = part.strip()
@@ -116,24 +121,50 @@ def _parse(spec: str) -> Dict[str, _Fault]:
                 f"unknown fault point {point!r}: known points are "
                 f"{', '.join(KNOWN_POINTS)}"
             )
+        if point in out:
+            raise ValueError(
+                f"duplicate fault point {point!r} in spec {spec!r}: each "
+                "point carries ONE times/prob/ms budget"
+            )
         kwargs: Dict[str, float] = {}
         for kv in params.split(","):
             kv = kv.strip()
             if not kv:
                 continue
-            k, _, v = kv.partition("=")
-            k = k.strip()
-            if k == "times":
-                kwargs["times"] = float("inf") if v.strip() == "inf" else int(v)
-            elif k == "prob":
-                kwargs["prob"] = float(v)
-            elif k == "ms":
-                kwargs["delay_ms"] = float(v)
-            else:
+            k, sep, v = kv.partition("=")
+            k, v = k.strip(), v.strip()
+            if not sep or not v:
                 raise ValueError(
-                    f"unknown fault parameter {k!r} for point {point!r} "
-                    "(supported: times, prob, ms)"
+                    f"malformed fault parameter {kv!r} for point "
+                    f"{point!r}: expected k=v (supported: times, prob, ms)"
                 )
+            try:
+                if k == "times":
+                    kwargs["times"] = (
+                        float("inf") if v == "inf" else int(v)
+                    )
+                elif k == "prob":
+                    kwargs["prob"] = float(v)
+                elif k == "ms":
+                    kwargs["delay_ms"] = float(v)
+                else:
+                    raise ValueError(
+                        f"unknown fault parameter {k!r} for point {point!r} "
+                        "(supported: times, prob, ms)"
+                    )
+            except ValueError as e:
+                if "fault parameter" in str(e):
+                    raise
+                raise ValueError(
+                    f"bad value in fault parameter {kv!r} for point "
+                    f"{point!r}: {e}"
+                ) from e
+        if kwargs.get("times", 1) < 0:
+            raise ValueError(f"fault point {point!r}: times must be >= 0")
+        if not 0.0 <= kwargs.get("prob", 1.0) <= 1.0:
+            raise ValueError(f"fault point {point!r}: prob must be in [0, 1]")
+        if kwargs.get("delay_ms", 0.0) < 0:
+            raise ValueError(f"fault point {point!r}: ms must be >= 0")
         out[point] = _Fault(point, **kwargs)
     return out
 
@@ -143,8 +174,13 @@ def configure(spec: Optional[str] = None) -> None:
     An empty/absent spec clears everything."""
     global _enabled
     if spec is None:
-        spec = os.environ.get(ENV_VAR, "")
-    faults = _parse(spec) if spec else {}
+        from seldon_core_tpu.runtime import knobs
+
+        spec = knobs.raw(ENV_VAR, "") or ""
+    # "=0 spells OFF" contract (runtime/knobs.py): SELDON_TPU_FAULT=0
+    # disarms, matching every other zero-off knob, instead of parsing
+    # "0" as a (nonexistent) point name
+    faults = _parse(spec) if spec and spec.strip() != "0" else {}
     with _lock:
         _faults.clear()
         _faults.update(faults)
@@ -228,8 +264,14 @@ def stats() -> Dict[str, int]:
 
 
 # arm from the environment at import so worker processes spawned with
-# SELDON_TPU_FAULT set participate without extra wiring
-if os.environ.get(ENV_VAR):
+# SELDON_TPU_FAULT set participate without extra wiring.  A malformed
+# spec is logged LOUDLY but does not kill the process at import: the
+# chaos tests assert firing stats, so an unarmed harness cannot pass
+# silently, while a serving process never dies to a chaos-spec typo.
+if os.environ.get(ENV_VAR):  # graftlint: allow[knob-registry] — configure()
+    # re-reads through the registry; this is only the cheap "is it set
+    # at all" probe, and importing runtime.knobs lazily here keeps the
+    # no-fault import path free of the runtime package
     try:
         configure()
     except ValueError:

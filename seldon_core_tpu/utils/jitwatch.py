@@ -30,7 +30,9 @@ JIT_COMPILES_METRIC = "seldon_tpu_jit_compiles_total"
 
 
 def sentinel_enabled() -> bool:
-    return os.environ.get("SELDON_TPU_JIT_SENTINEL", "1") != "0"
+    from seldon_core_tpu.runtime import knobs
+
+    return knobs.flag("SELDON_TPU_JIT_SENTINEL")
 
 
 def _leaf_sig(x: Any) -> Any:
@@ -102,7 +104,7 @@ class JitSentinel:
                         self._seen.add(sig)
                 if new:
                     _count_compile(self.program, sig[1:], static)
-            except Exception:  # noqa: BLE001
+            except Exception:  # noqa: BLE001 — the sentinel never breaks serving
                 logger.exception("jit sentinel failed for %s", self.program)
             return fn(*args, **kwargs)
 

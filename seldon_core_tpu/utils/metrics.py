@@ -84,7 +84,7 @@ def increment_counter(name: str, documentation: str = "", registry=None) -> None
     failures are logged so a broken counter is visible, not silent."""
     try:
         _cache_for(registry).get("counter", name, (), documentation).inc()
-    except Exception:  # noqa: BLE001
+    except Exception:  # noqa: BLE001 — a broken counter is logged, never fatal
         logger.exception("failed to increment counter %s", name)
 
 
@@ -379,7 +379,7 @@ class GenerationPrometheusBridge:
         """Never raises — the bridge must not take the decode loop down."""
         try:
             self._collect()
-        except Exception:  # noqa: BLE001
+        except Exception:  # noqa: BLE001 — the bridge never takes the decode loop down
             logger.exception("generation prometheus bridge collect failed")
 
     def _collect(self) -> None:
@@ -453,9 +453,9 @@ TRANSPORT_INFLIGHT_METRIC = "seldon_tpu_transport_inflight"
 def transport_telemetry_enabled() -> bool:
     """SELDON_TPU_TRANSPORT_TELEMETRY=0 turns the per-hop metrics off
     (the bench's trace_prop on/off contrast flips this)."""
-    import os
+    from seldon_core_tpu.runtime import knobs
 
-    return os.environ.get("SELDON_TPU_TRANSPORT_TELEMETRY", "1") != "0"
+    return knobs.flag("SELDON_TPU_TRANSPORT_TELEMETRY")
 
 
 class _BoundHop:
@@ -530,7 +530,7 @@ def record_transport_hop(
             # would poison the histograms' lower buckets
             hop.serialize_seconds.observe(max(0.0, serialize_seconds))
             hop.network_seconds.observe(max(0.0, network_seconds))
-    except Exception:  # noqa: BLE001
+    except Exception:  # noqa: BLE001 — telemetry never fails the hop
         logger.exception("transport telemetry failed for %s/%s", unit, method)
 
 
@@ -546,7 +546,7 @@ def record_transport_failover(
         _cache_for(registry).get(kind, name, TRANSPORT_LABELS, doc).labels(
             unit=unit, method=method, transport=transport
         ).inc()
-    except Exception:  # noqa: BLE001
+    except Exception:  # noqa: BLE001 — telemetry never fails the failover
         logger.exception("transport failover counter failed for %s/%s", unit, method)
 
 
@@ -558,7 +558,7 @@ def transport_inflight(unit: str, method: str, transport: str, registry=None):
         return None
     try:
         return _bound_hop(unit, method, transport, registry).inflight
-    except Exception:  # noqa: BLE001
+    except Exception:  # noqa: BLE001 — telemetry never fails the hop
         logger.exception("transport inflight gauge failed for %s/%s", unit, method)
         return None
 
@@ -594,7 +594,7 @@ def record_breaker_state(endpoint: str, state: str, registry=None) -> None:
             "counter", BREAKER_TRANSITIONS_METRIC, ("endpoint", "to"),
             "circuit-breaker state transitions",
         ).labels(endpoint=endpoint, to=state).inc()
-    except Exception:  # noqa: BLE001
+    except Exception:  # noqa: BLE001 — telemetry never fails the breaker
         logger.exception("breaker state metric failed for %s", endpoint)
 
 
@@ -610,7 +610,7 @@ def record_breaker_fastfail(
             "counter", BREAKER_FASTFAIL_METRIC, TRANSPORT_LABELS,
             "calls fast-failed by an open circuit breaker before dispatch",
         ).labels(unit=unit, method=method, transport=transport).inc()
-    except Exception:  # noqa: BLE001
+    except Exception:  # noqa: BLE001 — telemetry never fails the fast-fail
         logger.exception("breaker fastfail counter failed for %s/%s", unit, method)
 
 
@@ -633,7 +633,7 @@ def record_transport_hedge(
         cache.get("counter", name, TRANSPORT_LABELS, doc).labels(
             unit=unit, method=method, transport=transport
         ).inc()
-    except Exception:  # noqa: BLE001
+    except Exception:  # noqa: BLE001 — telemetry never fails the hedge
         logger.exception("hedge counter failed for %s/%s", unit, method)
 
 
@@ -654,7 +654,7 @@ def record_worker_health(
             "1 when the worker exceeded its restart budget and the "
             "supervisor gave up (the worker is dead until redeployed)",
         ).labels(worker=worker).set(1.0 if exhausted else 0.0)
-    except Exception:  # noqa: BLE001
+    except Exception:  # noqa: BLE001 — metrics never break supervision
         logger.exception("worker health metric failed for %s", worker)
 
 

@@ -63,14 +63,14 @@ class _PersistenceThread(threading.Thread):
         while not self._stop.wait(self.period_s):
             try:
                 self.manager.save(self.component)
-            except Exception:
+            except Exception:  # checkpointing must never kill serving
                 logger.exception("periodic state checkpoint failed")
 
     def stop(self) -> None:
         self._stop.set()
         try:
             self.manager.save(self.component)  # final snapshot on shutdown
-        except Exception:
+        except Exception:  # shutdown snapshot is best-effort
             logger.exception("final state checkpoint failed")
 
 
@@ -111,7 +111,7 @@ class PersistenceManager:
             fn(_from_jsonable(payload["state"]))
             logger.info("restored component state from %s", self.path)
             return True
-        except Exception:
+        except Exception:  # corrupt snapshot: fresh start beats a dead start
             logger.exception("state restore failed; starting fresh")
             return False
 

@@ -174,7 +174,7 @@ def build_consumer_app(index: PairIndex):
     async def ingest(request: web.Request) -> web.Response:
         try:
             pair = await request.json()
-        except Exception:  # noqa: BLE001
+        except Exception:  # noqa: BLE001 — malformed body maps to 400
             return web.json_response({"error": "body is not JSON"}, status=400)
         ce_type = request.headers.get("CE-Type", "")
         if ce_type and ce_type != "seldon.message.pair":

@@ -85,7 +85,8 @@ class HttpPairLogger:
                 headers = dict(CE_HEADERS)
                 headers["CE-Time"] = str(pair["time"])
                 requests.post(self.url, json=pair, headers=headers, timeout=self.timeout_s)
-            except Exception as e:  # noqa: BLE001
+            except Exception as e:  # noqa: BLE001 — logging loses a pair,
+                # never a request
                 logger.warning("request logger POST failed: %s", e)
 
     def close(self) -> None:
@@ -163,7 +164,7 @@ class KafkaPairLogger:
                     self.topic, json.dumps(pair).encode("utf-8"), key=key
                 )
                 self.sent += 1
-            except Exception as e:  # noqa: BLE001
+            except Exception as e:  # noqa: BLE001 — counted data loss
                 # counted: a broker outage's data loss must be visible
                 # in the counters, not only in a log line
                 self.failed += 1

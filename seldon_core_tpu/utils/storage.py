@@ -30,7 +30,9 @@ _CACHE_ENV = "SELDON_TPU_MODEL_CACHE"
 
 
 def _cache_dir() -> str:
-    d = os.environ.get(_CACHE_ENV) or os.path.join(tempfile.gettempdir(), "seldon-tpu-models")
+    from seldon_core_tpu.runtime import knobs
+
+    d = knobs.raw(_CACHE_ENV) or os.path.join(tempfile.gettempdir(), "seldon-tpu-models")
     os.makedirs(d, exist_ok=True)
     return d
 

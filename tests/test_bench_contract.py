@@ -176,6 +176,13 @@ def _r3_like_full_result():
                 "mix": "48 unary requests round-robined over 2 remote "
                        "StreamingLM workers; worker 0 SIGKILLed at request 16",
             },
+            "lint": {
+                "violations": 0,
+                "counts": {},
+                "allowlisted": 7,
+                "files_scanned": 92,
+                "checkers": 6,
+            },
             "mean_batch_rows": 26.69,
             "device_batches": 1106,
             "latency_phase": {
@@ -334,6 +341,30 @@ def test_compact_line_carries_chaos_story(bench):
     assert "hedges_fired" not in e
     assert "dead_endpoint_breaker" not in e
     assert "mix" not in e
+
+
+def test_compact_line_carries_lint_violations(bench):
+    """r13 certification key: unsuppressed graftlint violations at
+    bench time — an int that MUST be 0 (per-checker counts, allowlist
+    burn-down size and files_scanned stay in bench_full.json lint)."""
+    compact = bench._compact_result(_r3_like_full_result())
+    e = compact["extra"]
+    assert e["lint_violations"] == 0
+    assert isinstance(e["lint_violations"], int)
+    # the breakdown is full-blob-only
+    assert "allowlisted" not in e
+    assert "files_scanned" not in e
+
+
+def test_lint_phase_runs_suite_clean(bench):
+    """The real lint phase against the real tree: 0 violations with
+    the committed allowlist, >=6 checkers, schema the compact pick
+    reads."""
+    res = bench.lint_phase()
+    assert res["violations"] == 0
+    assert res["checkers"] >= 6
+    assert res["files_scanned"] > 50
+    assert isinstance(res["counts"], dict)
 
 
 def test_compact_line_carries_tp_story(bench):

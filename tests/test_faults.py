@@ -72,6 +72,67 @@ class TestSpec:
         with pytest.raises(ValueError):
             faults.configure("paged.alloc:bogus=1")
 
+    # ---- negative grammar (the tests PR 6 deferred): every
+    # malformation must error LOUDLY naming the fragment — a chaos
+    # harness that silently no-ops on a typo certifies resilience it
+    # never exercised ------------------------------------------------------
+
+    def test_malformed_kv_pair_rejected_loudly(self):
+        # bare key, no '='
+        with pytest.raises(ValueError, match=r"malformed fault parameter 'times'"):
+            faults.configure("paged.alloc:times")
+        # '=' with empty value
+        with pytest.raises(ValueError, match=r"malformed fault parameter 'ms='"):
+            faults.configure("transport.delay:ms=")
+        assert not faults.enabled()  # nothing half-armed
+
+    def test_bad_numeric_values_rejected_with_context(self):
+        with pytest.raises(ValueError, match=r"bad value.*'times=lots'.*paged\.alloc"):
+            faults.configure("paged.alloc:times=lots")
+        with pytest.raises(ValueError, match=r"bad value.*'prob=maybe'"):
+            faults.configure("paged.chunk:prob=maybe")
+        with pytest.raises(ValueError, match=r"bad value.*'ms=fast'"):
+            faults.configure("transport.delay:ms=fast")
+
+    def test_out_of_range_values_rejected(self):
+        with pytest.raises(ValueError, match="prob must be in"):
+            faults.configure("paged.alloc:prob=1.5")
+        with pytest.raises(ValueError, match="prob must be in"):
+            faults.configure("paged.alloc:prob=-0.1")
+        with pytest.raises(ValueError, match="times must be >= 0"):
+            faults.configure("paged.alloc:times=-2")
+        with pytest.raises(ValueError, match="ms must be >= 0"):
+            faults.configure("transport.delay:ms=-50")
+
+    def test_duplicate_point_rejected(self):
+        with pytest.raises(ValueError, match="duplicate fault point"):
+            faults.configure("paged.alloc:times=1;paged.alloc:times=2")
+
+    def test_unknown_point_names_known_points(self):
+        with pytest.raises(ValueError, match="transport.slow"):
+            faults.configure("paged.everything")
+
+    def test_inject_rejects_unknown_point(self):
+        with pytest.raises(ValueError, match="unknown fault point"):
+            faults.inject("paged.everything")
+
+    def test_times_inf_still_parses(self):
+        faults.configure("paged.alloc:times=inf,prob=1.0")
+        for _ in range(5):
+            assert faults.fire("paged.alloc")
+        faults.clear()
+
+    def test_failed_configure_leaves_registry_disarmed(self):
+        faults.configure("paged.alloc:times=3")
+        assert faults.enabled()
+        with pytest.raises(ValueError):
+            faults.configure("paged.alloc:times=3;bogus.point")
+        # the bad spec cleared nothing mid-way: configure is atomic
+        # (parse first, swap under the lock after)
+        assert faults.enabled()
+        assert faults.fire("paged.alloc")
+        faults.clear()
+
     def test_env_configure_and_clear(self, monkeypatch):
         monkeypatch.setenv(faults.ENV_VAR, "paged.chunk:times=1")
         faults.configure()

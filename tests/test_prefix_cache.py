@@ -143,11 +143,11 @@ class TestAllocator:
         eng = _engine(params)
         with eng._lock:
             total = eng.num_pages - 1
-            got = eng._alloc(3)
+            got = eng._alloc_locked(3)
             assert len(got) == 3 and len(eng._free_pages) == total - 3
             assert all(int(eng._page_ref[p]) == 1 for p in got)
-            assert eng._alloc(total) is None  # over capacity: refused
-            eng._free(got)
+            assert eng._alloc_locked(total) is None  # over capacity: refused
+            eng._free_locked(got)
             assert len(eng._free_pages) == total
             assert all(int(eng._page_ref[p]) == 0 for p in got)
 
@@ -158,7 +158,7 @@ class TestAllocator:
         assert s["prefix_pages_cached"] > 0
         with eng._lock:
             total = eng.num_pages - 1
-            got = eng._alloc(total)  # must evict every cached page
+            got = eng._alloc_locked(total)  # must evict every cached page
             assert got is not None and len(got) == total
         s = eng.engine_stats()
         assert s["prefix_pages_cached"] == 0

@@ -1,0 +1,2 @@
+"""Repo tooling: profiling harnesses (``profile_*.py``) and the
+``tools.graftlint`` static-analysis suite (``python -m tools.graftlint``)."""

@@ -1,0 +1,23 @@
+"""graftlint — AST-based invariant checkers for seldon-core-tpu.
+
+The codebase's load-bearing invariants (PRs 1-8) exist as conventions:
+jitted programs stay host-pure, every knob is declared and documented,
+``_*_locked`` helpers only run under the engine lock, engine_stats
+counters thread complete-by-contract into the Prometheus bridge, every
+ingress mints a deadline and adopts the caller's trace, and hot-path
+``except Exception`` blocks justify themselves.  graftlint turns each
+convention into a checker over the package's ASTs (stdlib ``ast``, no
+dependencies), so regressions fail the tier-1 suite instead of waiting
+for a reviewer — run ``python -m tools.graftlint`` or ``make lint``.
+
+See docs/architecture.md "Invariants & linting" for the checker
+catalogue and the allowlist/pragma burn-down workflow.
+"""
+
+from tools.graftlint.core import (  # noqa: F401
+    LintContext,
+    Source,
+    Violation,
+    load_allowlist,
+    run_suite,
+)

@@ -1,0 +1,76 @@
+"""CLI: ``python -m tools.graftlint [--json] [--checker NAME ...]``.
+
+Exit code 0 when the tree is clean (inline pragmas and the allowlist
+burn-down file are the only sanctioned suppressions), 1 when any
+violation survives, 2 on usage errors.  ``--json`` emits the full
+machine-readable result (the same dict the tier-1 test and the bench's
+``lint_violations`` key consume).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+# same sys.path bootstrap as every tools/ script: runnable from any cwd
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+if REPO_ROOT not in sys.path:
+    sys.path.insert(0, REPO_ROOT)
+
+
+def main(argv=None) -> int:
+    from tools.graftlint.checkers import ALL_CHECKERS, BY_NAME
+    from tools.graftlint.core import run_suite
+
+    parser = argparse.ArgumentParser(
+        prog="python -m tools.graftlint",
+        description="AST-based invariant checkers for seldon-core-tpu",
+    )
+    parser.add_argument("--json", action="store_true",
+                        help="machine-readable output")
+    parser.add_argument("--root", default=REPO_ROOT,
+                        help="repo root (default: this checkout)")
+    parser.add_argument("--checker", action="append", default=[],
+                        metavar="NAME",
+                        help="run only the named checker(s); repeatable")
+    parser.add_argument("--list", action="store_true",
+                        help="list registered checkers and exit")
+    args = parser.parse_args(argv)
+
+    if args.list:
+        for c in ALL_CHECKERS:
+            first = (c.doc or "").strip().splitlines()[0]
+            print(f"{c.name:18s} {','.join(c.codes):30s} {first}")
+        return 0
+
+    checkers = None
+    if args.checker:
+        unknown = [n for n in args.checker if n not in BY_NAME]
+        if unknown:
+            print(f"unknown checker(s): {', '.join(unknown)} "
+                  f"(known: {', '.join(sorted(BY_NAME))})", file=sys.stderr)
+            return 2
+        checkers = [BY_NAME[n] for n in args.checker]
+
+    result = run_suite(args.root, checkers=checkers)
+    if args.json:
+        print(json.dumps(result, indent=2, sort_keys=True))
+    else:
+        for v in result["violations"]:
+            print(f"{v['path']}:{v['line']}: {v['code']} ({v['checker']})"
+                  f"{' [' + v['symbol'] + ']' if v['symbol'] else ''} "
+                  f"{v['message']}")
+        n = len(result["violations"])
+        s = len(result["suppressed"])
+        print(
+            f"graftlint: {n} violation(s), {s} allowlisted, "
+            f"{result['files_scanned']} files, "
+            f"{len(result['checkers'])} checkers"
+        )
+    return 0 if result["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,22 @@
+"""Checker registry.  Every checker module under this package exports a
+``CHECKER`` singleton; the suite entrypoint runs exactly this list (a
+meta-test asserts the directory and the registry agree, so a new
+checker cannot be written and silently never run)."""
+
+from tools.graftlint.checkers.except_hygiene import CHECKER as EXCEPT_HYGIENE
+from tools.graftlint.checkers.jit_purity import CHECKER as JIT_PURITY
+from tools.graftlint.checkers.knob_registry import CHECKER as KNOB_REGISTRY
+from tools.graftlint.checkers.lock_discipline import CHECKER as LOCK_DISCIPLINE
+from tools.graftlint.checkers.metrics_contract import CHECKER as METRICS_CONTRACT
+from tools.graftlint.checkers.propagation import CHECKER as PROPAGATION
+
+ALL_CHECKERS = (
+    JIT_PURITY,
+    KNOB_REGISTRY,
+    LOCK_DISCIPLINE,
+    METRICS_CONTRACT,
+    PROPAGATION,
+    EXCEPT_HYGIENE,
+)
+
+BY_NAME = {c.name: c for c in ALL_CHECKERS}

@@ -1,0 +1,145 @@
+"""Checker: exception hygiene on serving paths (GL6xx).
+
+Invariant (PRs 3-8 convention): a broad ``except Exception`` in the
+engine/transport/models hot paths either **re-raises**, **converts**
+the exception into a reply (MicroserviceError / a status payload that
+uses the caught value), or **justifies itself** with a comment on the
+``except`` line — a silent ``pass``/log-only swallow is how contained
+faults become invisible corruption.  Bare ``except:`` additionally
+swallows KeyboardInterrupt/SystemExit and always needs a pragma.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterable, List
+
+from tools.graftlint.core import LintContext, Source, Violation, attr_root
+
+NAME = "except-hygiene"
+
+# pure-logging callees: using the caught exception here is reporting,
+# not conversion
+_LOG_ROOTS = {"logger", "logging", "log", "print", "warnings"}
+
+_NOQA_RE = re.compile(r"#\s*noqa[:,]?\s*[A-Z0-9, ]*")
+
+
+class _Checker:
+    name = NAME
+    codes = ("GL601", "GL602", "GL603")
+    doc = __doc__
+
+    def run(self, ctx: LintContext) -> Iterable[Violation]:
+        out: List[Violation] = []
+        for src in ctx.sources:
+            out.extend(self.check_source(src))
+        return out
+
+    # separated so fixture tests can run one file
+    def check_source(self, src: Source) -> List[Violation]:
+        out: List[Violation] = []
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            broad = node.type is None or (
+                isinstance(node.type, ast.Name)
+                and node.type.id in ("Exception", "BaseException")
+            )
+            if not broad:
+                continue
+            base_exc = (
+                isinstance(node.type, ast.Name)
+                and node.type.id == "BaseException"
+            )
+            if self._reraises(node):
+                continue
+            if not base_exc and node.type is not None:
+                if self._converts(node) or self._justified(src, node.lineno):
+                    continue
+                out.append(Violation(
+                    checker=self.name, code="GL601", path=src.path,
+                    line=node.lineno, symbol=f"except@{node.lineno}",
+                    message=(
+                        "broad `except Exception` neither re-raises, converts "
+                        "the exception into a reply, nor carries a "
+                        "justification comment on the except line"
+                    ),
+                ))
+            elif node.type is None:
+                out.append(Violation(
+                    checker=self.name, code="GL602", path=src.path,
+                    line=node.lineno, symbol=f"except@{node.lineno}",
+                    message=(
+                        "bare `except:` swallows KeyboardInterrupt/SystemExit; "
+                        "catch Exception (with justification) or re-raise"
+                    ),
+                ))
+            else:
+                out.append(Violation(
+                    checker=self.name, code="GL603", path=src.path,
+                    line=node.lineno, symbol=f"except@{node.lineno}",
+                    message=(
+                        "`except BaseException` without re-raise traps "
+                        "interpreter shutdown signals"
+                    ),
+                ))
+        return out
+
+    @staticmethod
+    def _reraises(handler: ast.ExceptHandler) -> bool:
+        return any(isinstance(n, ast.Raise) for n in ast.walk(handler))
+
+    @staticmethod
+    def _converts(handler: ast.ExceptHandler) -> bool:
+        """The caught name is USED somewhere that is not pure logging —
+        built into a status reply, returned, attached to a record."""
+        if handler.name is None:
+            return False
+        caught = handler.name
+
+        class V(ast.NodeVisitor):
+            def __init__(self):
+                self.converts = False
+
+            def visit_Call(self, call: ast.Call):
+                root = attr_root(call.func)
+                uses = any(
+                    isinstance(n, ast.Name) and n.id == caught
+                    for a in list(call.args) + [k.value for k in call.keywords]
+                    for n in ast.walk(a)
+                )
+                if uses and root not in _LOG_ROOTS:
+                    self.converts = True
+                self.generic_visit(call)
+
+            def visit_Return(self, ret: ast.Return):
+                if ret.value is not None and any(
+                    isinstance(n, ast.Name) and n.id == caught
+                    for n in ast.walk(ret.value)
+                ):
+                    self.converts = True
+                self.generic_visit(ret)
+
+        v = V()
+        for stmt in handler.body:
+            v.visit(stmt)
+        return v.converts
+
+    @staticmethod
+    def _justified(src: Source, lineno: int) -> bool:
+        """A comment on the except line with real words beyond a bare
+        ``noqa`` code counts as the explicit allow pragma."""
+        if not 1 <= lineno <= len(src.lines):
+            return False
+        line = src.lines[lineno - 1]
+        if "#" not in line:
+            return False
+        comment = line.split("#", 1)[1]
+        comment = _NOQA_RE.sub("", "#" + comment)
+        comment = comment.strip("#").strip(" -—:;")
+        return len(re.sub(r"\W", "", comment)) >= 3
+
+
+CHECKER = _Checker()

@@ -1,0 +1,422 @@
+"""Checker: host purity of jitted programs (GL1xx).
+
+Invariant (PR 4's jitwatch sentinel, made static): a function handed to
+``jax.jit`` / ``PagedEngine._tp_jit`` runs ONCE per shape signature at
+trace time — host-side branching on traced values raises
+``TracerBoolConversionError`` in the best case and silently bakes one
+branch into the compiled program in the worst; ``float()/int()/.item()``
+on a tracer forces a device sync or crashes; mutating captured Python
+state from inside the traced body executes once per COMPILE, not once
+per call (the classic "my counter only moved on the first request"
+bug); and an unhashable static arg fails at call time.  The runtime
+sentinel catches the recompile storm after deploy — this checker
+catches the cause in review.
+
+Rules (within resolved jit targets):
+
+* GL101 — ``float()/int()/bool()/complex()`` on a traced value.
+* GL102 — ``.item()/.tolist()``, ``np.asarray/np.array``,
+  ``jax.device_get``, or ``print`` applied to a traced value.
+* GL103 — ``if``/``while``/``assert``/ternary condition on a traced
+  value (host control flow on a tracer; use ``jnp.where``/``lax.cond``).
+* GL104 — mutation of captured state: ``global``/``nonlocal``
+  declarations, or writes to free variables / ``self`` attributes from
+  inside the traced body.
+* GL105 — ``static_argnums``/``static_argnames`` naming a parameter
+  whose default is an unhashable literal (list/dict/set).
+
+Tracked-value analysis is deliberately conservative: parameters are
+traced; names assigned from expressions using traced names become
+traced; expressions rooted in ``.shape``/``.ndim``/``.dtype``/
+``len()``/``isinstance()`` are STATIC (shape math is host-legal), as
+are subscripts of ``.shape``.  Anything the analysis cannot prove
+traced is left alone — precision over recall, with the allowlist as
+the escape hatch.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from tools.graftlint.core import LintContext, Source, Violation, call_name, str_const
+
+NAME = "jit-purity"
+
+JIT_NAMES = {"jit", "_tp_jit"}
+WRAPPER_NAMES = {"vmap", "pmap", "partial", "wraps", "checkpoint", "remat",
+                 "named_call", "wrap"}
+STATIC_ATTRS = {"shape", "ndim", "dtype", "size", "sharding", "aval",
+                "weak_type"}
+STATIC_CALLS = {"len", "isinstance", "getattr", "hasattr", "type", "range",
+                "enumerate", "zip", "min", "max"}
+CAST_CALLS = {"float", "int", "bool", "complex"}
+HOST_PULL_ATTRS = {"item", "tolist", "to_py"}
+HOST_PULL_CALLS = {"asarray", "array", "device_get"}
+
+
+def _jit_target(call: ast.Call) -> Optional[ast.AST]:
+    """The function expression handed to a jit call, unwrapping
+    vmap/partial-style wrappers."""
+    if not call.args:
+        for kw in call.keywords:
+            if kw.arg in ("fun", "f"):
+                return kw.value
+        return None
+    target = call.args[0]
+    while isinstance(target, ast.Call) and call_name(target) in WRAPPER_NAMES:
+        if not target.args:
+            return None
+        target = target.args[0]
+    return target
+
+
+def _static_params(call: ast.Call, fn) -> Set[str]:
+    """Parameter names made static by static_argnums/static_argnames."""
+    params = [p.arg for p in fn.args.posonlyargs + fn.args.args]
+    out: Set[str] = set()
+    for kw in call.keywords:
+        if kw.arg == "static_argnames":
+            vals = kw.value.elts if isinstance(kw.value, (ast.Tuple, ast.List)) \
+                else [kw.value]
+            for v in vals:
+                s = str_const(v)
+                if s:
+                    out.add(s)
+        elif kw.arg == "static_argnums":
+            vals = kw.value.elts if isinstance(kw.value, (ast.Tuple, ast.List)) \
+                else [kw.value]
+            for v in vals:
+                if isinstance(v, ast.Constant) and isinstance(v.value, int) \
+                        and 0 <= v.value < len(params):
+                    out.add(params[v.value])
+    return out
+
+
+class _PurityVisitor(ast.NodeVisitor):
+    """Walks one jitted function body with a traced-name set."""
+
+    def __init__(self, checker, src: Source, qual: str, fn, traced: Set[str],
+                 local: Set[str], out: List[Violation]):
+        self.checker = checker
+        self.src = src
+        self.qual = qual
+        self.fn = fn
+        self.traced = set(traced)
+        self.local = set(local)
+        self.out = out
+
+    # -- traced-ness of an expression -----------------------------------
+
+    def _is_traced(self, node: ast.AST) -> bool:
+        """Does evaluating ``node`` touch a traced value dynamically
+        (i.e. not through a shape/dtype/len escape)?"""
+        if isinstance(node, ast.Name):
+            return node.id in self.traced
+        if isinstance(node, ast.Attribute):
+            if node.attr in STATIC_ATTRS:
+                return False
+            return self._is_traced(node.value)
+        if isinstance(node, ast.Subscript):
+            # x.shape[0] is static; traced[i] is traced
+            return self._is_traced(node.value)
+        if isinstance(node, ast.Call):
+            if call_name(node) in STATIC_CALLS:
+                return False
+            args = list(node.args) + [k.value for k in node.keywords]
+            return any(self._is_traced(a) for a in args) or (
+                isinstance(node.func, ast.Attribute)
+                and self._is_traced(node.func.value)
+            )
+        if isinstance(node, (ast.BinOp,)):
+            return self._is_traced(node.left) or self._is_traced(node.right)
+        if isinstance(node, ast.UnaryOp):
+            return self._is_traced(node.operand)
+        if isinstance(node, ast.BoolOp):
+            return any(self._is_traced(v) for v in node.values)
+        if isinstance(node, ast.Compare):
+            return self._is_traced(node.left) or any(
+                self._is_traced(c) for c in node.comparators
+            )
+        if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+            return any(self._is_traced(e) for e in node.elts)
+        if isinstance(node, ast.IfExp):
+            return any(self._is_traced(n)
+                       for n in (node.test, node.body, node.orelse))
+        if isinstance(node, ast.Starred):
+            return self._is_traced(node.value)
+        return False
+
+    def _emit(self, code: str, node: ast.AST, msg: str) -> None:
+        self.out.append(Violation(
+            checker=self.checker.name, code=code, path=self.src.path,
+            line=getattr(node, "lineno", self.fn.lineno),
+            symbol=self.qual, message=f"in jitted {self.qual!r}: {msg}",
+        ))
+
+    # -- assignments propagate traced-ness ------------------------------
+
+    def visit_Assign(self, node: ast.Assign):
+        traced_rhs = self._is_traced(node.value)
+        for t in node.targets:
+            for n in ast.walk(t):
+                if isinstance(n, ast.Name):
+                    self.local.add(n.id)
+                    if traced_rhs:
+                        self.traced.add(n.id)
+        self._check_capture_write(node.targets, node)
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node: ast.AugAssign):
+        if isinstance(node.target, ast.Name):
+            self.local.add(node.target.id)
+            if self._is_traced(node.value):
+                self.traced.add(node.target.id)
+        self._check_capture_write([node.target], node)
+        self.generic_visit(node)
+
+    def _check_capture_write(self, targets: Sequence[ast.AST], node) -> None:
+        for t in targets:
+            base = t
+            is_container_write = False
+            while isinstance(base, (ast.Subscript, ast.Attribute)):
+                is_container_write = True
+                base = base.value
+            if not is_container_write:
+                continue
+            if isinstance(base, ast.Name):
+                if base.id == "self":
+                    self._emit("GL104", node,
+                               "writes self state from inside the traced "
+                               "body (runs once per COMPILE, not per call)")
+                elif base.id not in self.local and base.id not in self.traced:
+                    self._emit("GL104", node,
+                               f"writes captured variable {base.id!r} from "
+                               "inside the traced body (runs once per "
+                               "COMPILE, not per call)")
+
+    def visit_Global(self, node: ast.Global):
+        self._emit("GL104", node,
+                   "`global` inside a jitted function — captured-state "
+                   "mutation executes at trace time only")
+
+    def visit_Nonlocal(self, node: ast.Nonlocal):
+        self._emit("GL104", node,
+                   "`nonlocal` inside a jitted function — captured-state "
+                   "mutation executes at trace time only")
+
+    # -- host pulls / casts ---------------------------------------------
+
+    def visit_Call(self, node: ast.Call):
+        name = call_name(node)
+        if name in CAST_CALLS and node.args \
+                and self._is_traced(node.args[0]):
+            self._emit("GL101", node,
+                       f"{name}() on a traced value forces a host sync / "
+                       "TracerConversionError — keep it on-device "
+                       "(jnp.asarray / astype)")
+        elif name in HOST_PULL_ATTRS and isinstance(node.func, ast.Attribute) \
+                and self._is_traced(node.func.value):
+            self._emit("GL102", node,
+                       f".{name}() pulls a traced value to host at trace "
+                       "time")
+        elif name in HOST_PULL_CALLS and isinstance(node.func, ast.Attribute):
+            root = node.func.value
+            rootname = root.id if isinstance(root, ast.Name) else ""
+            if rootname in ("np", "numpy", "jax") and node.args \
+                    and self._is_traced(node.args[0]):
+                self._emit("GL102", node,
+                           f"{rootname}.{name}() materializes a traced value "
+                           "on host (use jnp inside the program)")
+        elif name == "print" and any(
+            self._is_traced(a) for a in node.args
+        ):
+            self._emit("GL102", node,
+                       "print(traced) runs at trace time only (use "
+                       "jax.debug.print)")
+        self.generic_visit(node)
+
+    # -- host control flow on tracers -----------------------------------
+
+    def visit_If(self, node: ast.If):
+        if self._is_traced(node.test):
+            self._emit("GL103", node,
+                       "`if` on a traced value — host control flow cannot "
+                       "branch on tracers (use jnp.where / lax.cond)")
+        self.generic_visit(node)
+
+    def visit_While(self, node: ast.While):
+        if self._is_traced(node.test):
+            self._emit("GL103", node,
+                       "`while` on a traced value (use lax.while_loop)")
+        self.generic_visit(node)
+
+    def visit_Assert(self, node: ast.Assert):
+        if self._is_traced(node.test):
+            self._emit("GL103", node,
+                       "`assert` on a traced value (use checkify or move "
+                       "the check outside the program)")
+        self.generic_visit(node)
+
+    def visit_IfExp(self, node: ast.IfExp):
+        if self._is_traced(node.test):
+            self._emit("GL103", node,
+                       "ternary condition on a traced value (use jnp.where)")
+        self.generic_visit(node)
+
+    # nested defs/lambdas get their params as local, not traced
+    def visit_FunctionDef(self, node):
+        self.local.add(node.name)
+        inner_locals = {a.arg for a in node.args.args + node.args.posonlyargs
+                        + node.args.kwonlyargs}
+        self.local |= inner_locals
+        self.generic_visit(node)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Lambda(self, node: ast.Lambda):
+        self.local |= {a.arg for a in node.args.args + node.args.posonlyargs
+                       + node.args.kwonlyargs}
+        self.generic_visit(node)
+
+
+class _Checker:
+    name = NAME
+    codes = ("GL101", "GL102", "GL103", "GL104", "GL105")
+    doc = __doc__
+
+    def run(self, ctx: LintContext) -> Iterable[Violation]:
+        out: List[Violation] = []
+        for src in ctx.sources:
+            out.extend(self.check_source(src))
+        return out
+
+    def check_source(self, src: Source) -> List[Violation]:
+        out: List[Violation] = []
+        index = _FunctionIndex(src.tree)
+        seen: Set[Tuple[int, int]] = set()
+        for scope_stack, call in _jit_calls(src.tree):
+            target = _jit_target(call)
+            if target is None:
+                continue
+            fn, qual = index.resolve(target, scope_stack)
+            if fn is None:
+                continue
+            key = (fn.lineno, getattr(fn, "col_offset", 0))
+            if key in seen:
+                continue  # one function jitted from several sites
+            seen.add(key)
+            statics = _static_params(call, fn) if isinstance(fn, (
+                ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)) else set()
+            out.extend(self._check_target(src, call, fn, qual, statics))
+        # decorator spellings: @jax.jit / @partial(jax.jit, ...)
+        for qual, fn in index.decorated_jits():
+            key = (fn.lineno, getattr(fn, "col_offset", 0))
+            if key in seen:
+                continue
+            seen.add(key)
+            statics: Set[str] = set()
+            for dec in fn.decorator_list:
+                if isinstance(dec, ast.Call):
+                    statics |= _static_params(dec, fn)
+            out.extend(self._check_target(src, fn, fn, qual, statics))
+        return out
+
+    def _check_target(self, src: Source, call, fn, qual: str,
+                      statics: Set[str]) -> List[Violation]:
+        out: List[Violation] = []
+        if isinstance(fn, ast.Lambda):
+            params = {a.arg for a in fn.args.args + fn.args.posonlyargs}
+            v = _PurityVisitor(self, src, qual, fn,
+                               traced=params - statics, local=set(params), out=out)
+            v.visit(fn.body)
+            return out
+        params = [a.arg for a in fn.args.posonlyargs + fn.args.args
+                  + fn.args.kwonlyargs]
+        traced = {p for p in params if p not in statics and p != "self"}
+        # GL105: unhashable static-arg defaults
+        defaults = dict(zip(reversed([a.arg for a in fn.args.args]),
+                            reversed(fn.args.defaults)))
+        for p in sorted(statics):
+            d = defaults.get(p)
+            if isinstance(d, (ast.List, ast.Dict, ast.Set)):
+                out.append(Violation(
+                    checker=self.name, code="GL105", path=src.path,
+                    line=fn.lineno, symbol=qual,
+                    message=(
+                        f"in jitted {qual!r}: static arg {p!r} defaults to "
+                        "an unhashable literal — static args must be "
+                        "hashable (use a tuple / frozen mapping)"
+                    ),
+                ))
+        v = _PurityVisitor(self, src, qual, fn, traced=traced,
+                           local=set(params), out=out)
+        for stmt in fn.body:
+            v.visit(stmt)
+        return out
+
+
+class _FunctionIndex:
+    """Resolve a jit call's target expression to a FunctionDef in the
+    same module: bare names to the enclosing lexical scope, ``self.X``
+    to a method of the enclosing class."""
+
+    def __init__(self, tree: ast.Module):
+        self.tree = tree
+
+    def resolve(self, target: ast.AST, scope_stack) -> Tuple[Optional[ast.AST], str]:
+        if isinstance(target, ast.Lambda):
+            return target, "<lambda>"
+        name = None
+        method_of_self = False
+        if isinstance(target, ast.Name):
+            name = target.id
+        elif isinstance(target, ast.Attribute) \
+                and isinstance(target.value, ast.Name) \
+                and target.value.id == "self":
+            name = target.attr
+            method_of_self = True
+        if name is None:
+            return None, ""
+        # innermost scope first
+        for scope in reversed(scope_stack):
+            if method_of_self and not isinstance(scope, ast.ClassDef):
+                continue
+            for child in ast.iter_child_nodes(scope):
+                if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                        and child.name == name:
+                    return child, name
+        return None, ""
+
+    def decorated_jits(self):
+        for node in ast.walk(self.tree):
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            for dec in node.decorator_list:
+                base = dec.func if isinstance(dec, ast.Call) else dec
+                if call_name(base) == "jit" or (
+                    isinstance(dec, ast.Call)
+                    and call_name(dec) == "partial"
+                    and dec.args
+                    and call_name(dec.args[0]) == "jit"
+                ):
+                    yield node.name, node
+                    break
+
+
+def _jit_calls(tree: ast.Module):
+    """Yield (enclosing-scope-stack, jit Call) pairs."""
+    def walk(node, stack):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.Call) and call_name(child) in JIT_NAMES:
+                yield stack, child
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.ClassDef, ast.Module)):
+                yield from walk(child, stack + [child])
+            else:
+                yield from walk(child, stack)
+
+    yield from walk(tree, [tree])
+
+
+CHECKER = _Checker()

@@ -1,0 +1,214 @@
+"""Checker: central knob registry (GL2xx).
+
+Invariant (PR 7 review catch, generalized): every ``SELDON_TPU_*`` env
+var, ``seldon.io/*`` annotation and ``X-Seldon-*`` header the package
+touches is DECLARED in ``runtime/knobs.py`` — with type, default,
+``=0``-means-OFF semantics and a docs anchor — and every env read goes
+through the registry (``knobs.raw``/``knobs.flag``), never through
+``os.environ`` directly.  Docs drift fails too: a registered knob
+missing from ``docs/`` or a ``SELDON_TPU_*`` token in the docs that no
+longer exists in the registry.
+
+Rules:
+
+* GL201 — direct ``os.environ.get/[]`` / ``os.getenv`` read of a
+  ``SELDON_TPU_*`` name anywhere outside ``runtime/knobs.py``.
+* GL202 — a full-string ``SELDON_TPU_*`` / ``seldon.io/*`` /
+  ``X-Seldon-*`` literal that is not declared in the registry.
+* GL203 — docs drift (registry -> docs and docs -> registry).
+* GL204 — ``knobs.raw``/``knobs.flag`` called with an undeclared
+  literal (the static twin of the runtime UndeclaredKnobError).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterable, List, Optional
+
+from tools.graftlint.core import (
+    LintContext,
+    Source,
+    Violation,
+    attr_root,
+    call_name,
+    module_constants,
+    str_const,
+)
+
+NAME = "knob-registry"
+
+KNOBS_MODULE = "seldon_core_tpu/runtime/knobs.py"
+
+ENV_RE = re.compile(r"^SELDON_TPU_[A-Z0-9_]+$")
+ANN_RE = re.compile(r"^seldon\.io/[a-z0-9.\-]+$")
+HDR_RE = re.compile(r"^[Xx]-[Ss]eldon-[A-Za-z\-]+$")
+DOCS_TOKEN_RE = re.compile(r"\bSELDON_TPU_[A-Z0-9_]+\b")
+
+
+def _registry():
+    from seldon_core_tpu.runtime import knobs
+
+    return knobs
+
+
+class _Checker:
+    name = NAME
+    codes = ("GL201", "GL202", "GL203", "GL204")
+    doc = __doc__
+
+    def run(self, ctx: LintContext) -> Iterable[Violation]:
+        knobs = _registry()
+        out: List[Violation] = []
+        for src in ctx.sources:
+            out.extend(self.check_source(src, knobs))
+        out.extend(self._docs_drift(ctx, knobs))
+        return out
+
+    def check_source(self, src: Source, knobs=None) -> List[Violation]:
+        if knobs is None:
+            knobs = _registry()
+        out: List[Violation] = []
+        consts = module_constants(src.tree)
+        in_registry_module = src.path == KNOBS_MODULE
+
+        def env_name(node: ast.AST) -> Optional[str]:
+            """The SELDON_TPU_* name an expression denotes (literal or
+            module-level constant), else None."""
+            s = str_const(node)
+            if s is None and isinstance(node, ast.Name):
+                s = consts.get(node.id)
+            if s is not None and ENV_RE.match(s):
+                return s
+            return None
+
+        for node in ast.walk(src.tree):
+            # -- GL201: direct environ reads ------------------------------
+            if isinstance(node, ast.Call):
+                fname = call_name(node)
+                root = attr_root(node.func)
+                is_env_get = (
+                    fname == "getenv"
+                    or (
+                        fname == "get"
+                        and isinstance(node.func, ast.Attribute)
+                        and isinstance(node.func.value, ast.Attribute)
+                        and node.func.value.attr == "environ"
+                    )
+                    or (
+                        fname == "get"
+                        and isinstance(node.func, ast.Attribute)
+                        and isinstance(node.func.value, ast.Name)
+                        and node.func.value.id == "environ"
+                    )
+                )
+                if is_env_get and node.args and not in_registry_module:
+                    name = env_name(node.args[0])
+                    if name is not None:
+                        out.append(Violation(
+                            checker=self.name, code="GL201", path=src.path,
+                            line=node.lineno, symbol=name,
+                            message=(
+                                f"direct environ read of {name!r}: go through "
+                                "runtime/knobs.py (knobs.raw / knobs.flag)"
+                            ),
+                        ))
+                # -- GL204: registry read of an undeclared name ----------
+                if fname in ("raw", "flag") and root in ("knobs", "_knobs"):
+                    if node.args:
+                        s = str_const(node.args[0])
+                        if s is None and isinstance(node.args[0], ast.Name):
+                            s = consts.get(node.args[0].id)
+                        if s is not None and ENV_RE.match(s) \
+                                and s not in knobs.ENV_KNOBS:
+                            out.append(Violation(
+                                checker=self.name, code="GL204",
+                                path=src.path, line=node.lineno, symbol=s,
+                                message=(
+                                    f"knobs.{fname}({s!r}) reads a knob that "
+                                    "is not declared in runtime/knobs.py"
+                                ),
+                            ))
+            elif isinstance(node, ast.Subscript) and isinstance(node.ctx, ast.Load):
+                v = node.value
+                is_environ = (
+                    isinstance(v, ast.Attribute) and v.attr == "environ"
+                ) or (isinstance(v, ast.Name) and v.id == "environ")
+                if is_environ and not in_registry_module:
+                    name = env_name(node.slice)
+                    if name is not None:
+                        out.append(Violation(
+                            checker=self.name, code="GL201", path=src.path,
+                            line=node.lineno, symbol=name,
+                            message=(
+                                f"direct environ[{name!r}] read: go through "
+                                "runtime/knobs.py"
+                            ),
+                        ))
+
+            # -- GL202: undeclared full-string literals -------------------
+            s = str_const(node)
+            if s is None or in_registry_module:
+                continue
+            if ENV_RE.match(s) and s not in knobs.ENV_KNOBS:
+                out.append(Violation(
+                    checker=self.name, code="GL202", path=src.path,
+                    line=node.lineno, symbol=s,
+                    message=(
+                        f"env knob {s!r} is not declared in runtime/knobs.py "
+                        "(name, kind, default, zero-off semantics, docs anchor)"
+                    ),
+                ))
+            elif ANN_RE.match(s) and s not in knobs.ANNOTATIONS:
+                out.append(Violation(
+                    checker=self.name, code="GL202", path=src.path,
+                    line=node.lineno, symbol=s,
+                    message=(
+                        f"annotation {s!r} is not declared in "
+                        "runtime/knobs.py ANNOTATIONS"
+                    ),
+                ))
+            elif HDR_RE.match(s) and not knobs.declared(s):
+                out.append(Violation(
+                    checker=self.name, code="GL202", path=src.path,
+                    line=node.lineno, symbol=s,
+                    message=(
+                        f"header {s!r} is not declared in "
+                        "runtime/knobs.py HEADERS"
+                    ),
+                ))
+        return out
+
+    def _docs_drift(self, ctx: LintContext, knobs) -> List[Violation]:
+        out: List[Violation] = []
+        docs = ctx.docs_text
+        for name, knob in sorted(knobs.ENV_KNOBS.items()):
+            if name not in docs:
+                out.append(Violation(
+                    checker=self.name, code="GL203", path=KNOBS_MODULE,
+                    line=1, symbol=name,
+                    message=(
+                        f"registered knob {name!r} (anchor {knob.anchor!r}) "
+                        "is not documented anywhere under docs/"
+                    ),
+                ))
+            if not knob.anchor:
+                out.append(Violation(
+                    checker=self.name, code="GL203", path=KNOBS_MODULE,
+                    line=1, symbol=name,
+                    message=f"registered knob {name!r} has an empty docs anchor",
+                ))
+        for token in sorted(set(DOCS_TOKEN_RE.findall(docs))):
+            if token not in knobs.ENV_KNOBS:
+                out.append(Violation(
+                    checker=self.name, code="GL203", path="docs/",
+                    line=1, symbol=token,
+                    message=(
+                        f"docs mention {token!r} but the registry does not "
+                        "declare it — ghost knob or docs drift"
+                    ),
+                ))
+        return out
+
+
+CHECKER = _Checker()

@@ -1,0 +1,335 @@
+"""Checker: deadline + trace propagation (GL5xx).
+
+Invariant (PR 4 + PR 6): **every ingress mints a Deadline and adopts
+the caller's trace context; every NodeClient dispatch injects both
+downstream and meters a ``_Hop``.**  A handler that dispatches without
+activating the budget silently refunds queue time to abandoned
+callers; a client method that skips injection orphans the downstream
+spans and unbounds the hop.
+
+Ingress rules (over the ingress modules listed below):
+
+* handlers are module-level/nested functions with a ``request``-shaped
+  parameter (aiohttp), a gRPC ``(request, context)`` pair, or
+  ``__call__`` methods (native-lane bridge objects);
+* a handler that DISPATCHES (calls ``run_dispatch``/``predict_async``,
+  a gateway/predictor ``predict``/``send_feedback``/``aggregate``/
+  ``explain``, or a ``predict_stream`` generator obtained via
+  ``getattr``) must handle the deadline (``activate_ms``/``extract_ms``
+  — the latter is the meta-tags absolute-expiry carrier stream lanes
+  use) -> GL501, and the trace (``activate_context`` or an
+  ``extract``/``_remote_ctx``/``_grpc_remote_ctx`` helper) -> GL502.
+
+Transport rules (engine/transport.py):
+
+* every NodeClient subclass's dispatch method (transform_input/
+  transform_output/route/aggregate/send_feedback) must — transitively
+  through same-class helpers and module functions — construct a
+  ``_Hop`` (GL503), inject trace context (GL504) and handle the
+  deadline (GL505), unless it merely delegates to another client's
+  same-named method (BalancedClient's failover pattern).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set
+
+from tools.graftlint.core import (
+    LintContext,
+    Source,
+    Violation,
+    attr_root,
+    call_name,
+    str_const,
+)
+
+NAME = "propagation"
+
+INGRESS_MODULES = (
+    "seldon_core_tpu/runtime/rest.py",
+    "seldon_core_tpu/runtime/grpc_server.py",
+    "seldon_core_tpu/engine/server.py",
+    "seldon_core_tpu/engine/sync_server.py",
+    "seldon_core_tpu/engine/native_ingress.py",
+    "seldon_core_tpu/native/frontserver.py",
+)
+TRANSPORT_MODULE = "seldon_core_tpu/engine/transport.py"
+
+DISPATCH_CALLS = {"run_dispatch", "predict_async"}
+DISPATCH_ATTRS = {"predict", "send_feedback", "aggregate", "explain",
+                  "predict_stream"}
+DEADLINE_MARKS = {"activate_ms", "extract_ms", "activate",
+                  "_remote_deadline_ms", "_grpc_deadline_ms"}
+TRACE_MARKS = {"activate_context", "extract", "_remote_ctx",
+               "_grpc_remote_ctx"}
+
+CLIENT_METHODS = ("transform_input", "transform_output", "route",
+                  "aggregate", "send_feedback")
+
+REQUEST_PARAMS = {"request", "_request", "_r", "req"}
+
+
+def _params(fn) -> List[str]:
+    a = fn.args
+    return [p.arg for p in a.posonlyargs + a.args + a.kwonlyargs]
+
+
+def _is_handler(fn, cls_name: Optional[str]) -> bool:
+    params = _params(fn)
+    if cls_name is not None:
+        # native-lane bridge objects (__call__) and sync-server servicer
+        # methods taking (self, request, context)
+        return fn.name == "__call__" or (
+            "context" in params and not fn.name.startswith("_")
+        )
+    if any(p in REQUEST_PARAMS for p in params):
+        return True
+    return "context" in params and len(params) >= 2  # grpc (request, context)
+
+
+def _fn_calls(fn) -> Iterable[ast.Call]:
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call):
+            yield node
+
+
+def _getattr_marker_aliases(fn) -> Set[str]:
+    """Names bound as ``x = getattr(obj, "<dispatch-attr>", ...)`` —
+    the stream lanes call the generator through such an alias."""
+    out: Set[str] = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name) \
+                and isinstance(node.value, ast.Call) \
+                and call_name(node.value) == "getattr" \
+                and len(node.value.args) >= 2:
+            attr = str_const(node.value.args[1])
+            if attr in DISPATCH_ATTRS:
+                out.add(node.targets[0].id)
+    return out
+
+
+def _dispatches(fn) -> bool:
+    aliases = _getattr_marker_aliases(fn)
+    for call in _fn_calls(fn):
+        name = call_name(call)
+        if name in DISPATCH_CALLS:
+            return True
+        if isinstance(call.func, ast.Attribute) and name in DISPATCH_ATTRS:
+            # self.predict(...) delegates to a SIBLING handler (which is
+            # checked itself); self.gateway.predict(...) is the real
+            # dispatch
+            if isinstance(call.func.value, ast.Name) \
+                    and call.func.value.id == "self":
+                continue
+            return True
+        if isinstance(call.func, ast.Name) and call.func.id in aliases:
+            return True
+    return False
+
+
+def _marks_used(fn, marks: Set[str]) -> bool:
+    return any(call_name(c) in marks for c in _fn_calls(fn))
+
+
+class _Checker:
+    name = NAME
+    codes = ("GL501", "GL502", "GL503", "GL504", "GL505")
+    doc = __doc__
+
+    def run(self, ctx: LintContext) -> Iterable[Violation]:
+        out: List[Violation] = []
+        for path in INGRESS_MODULES:
+            src = ctx.source(path)
+            if src is not None:
+                out.extend(self.check_ingress(src))
+        transport = ctx.source(TRANSPORT_MODULE)
+        if transport is not None:
+            out.extend(self.check_transport(transport))
+        return out
+
+    # ---- ingress ---------------------------------------------------------
+
+    def check_ingress(self, src: Source) -> List[Violation]:
+        out: List[Violation] = []
+        for qual, fn, cls in _walk_funcs(src.tree):
+            cls_name = cls.name if cls is not None else None
+            if not _is_handler(fn, cls_name):
+                continue
+            if not _dispatches(fn):
+                continue  # health/debug/metrics handlers are exempt
+            if not _marks_used(fn, DEADLINE_MARKS):
+                out.append(Violation(
+                    checker=self.name, code="GL501", path=src.path,
+                    line=fn.lineno, symbol=qual,
+                    message=(
+                        f"ingress handler {qual!r} dispatches without "
+                        "minting the deadline (deadlines.activate_ms / the "
+                        "extract_ms meta-tags carrier)"
+                    ),
+                ))
+            if not _marks_used(fn, TRACE_MARKS):
+                out.append(Violation(
+                    checker=self.name, code="GL502", path=src.path,
+                    line=fn.lineno, symbol=qual,
+                    message=(
+                        f"ingress handler {qual!r} dispatches without "
+                        "adopting the caller's trace context "
+                        "(tracing.activate_context / extract)"
+                    ),
+                ))
+        return out
+
+    # ---- transport -------------------------------------------------------
+
+    def check_transport(self, src: Source) -> List[Violation]:
+        out: List[Violation] = []
+        module_funcs: Dict[str, ast.AST] = {
+            n.name: n for n in src.tree.body
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+        }
+        for node in src.tree.body:
+            if not isinstance(node, ast.ClassDef):
+                continue
+            if not node.name.endswith("Client"):
+                continue
+            methods = {
+                m.name: m for m in node.body
+                if isinstance(m, (ast.FunctionDef, ast.AsyncFunctionDef))
+            }
+            for mname in CLIENT_METHODS:
+                m = methods.get(mname)
+                if m is None:
+                    continue
+                if _body_only_raises(m):
+                    continue  # the NodeClient abstract surface
+                closure = self._closure(m, methods, module_funcs)
+                if self._delegates(closure, mname):
+                    continue  # failover wrappers delegate to real clients
+                has_hop = any(
+                    call_name(c) == "_Hop"
+                    for f in closure for c in _fn_calls(f)
+                )
+                has_trace = any(
+                    self._is_trace_inject(c, methods)
+                    for f in closure for c in _fn_calls(f)
+                )
+                has_deadline = any(
+                    self._is_deadline_use(c)
+                    for f in closure for c in _fn_calls(f)
+                )
+                qual = f"{node.name}.{mname}"
+                if not has_hop:
+                    out.append(Violation(
+                        checker=self.name, code="GL503", path=src.path,
+                        line=m.lineno, symbol=qual,
+                        message=f"{qual} dispatches without metering a _Hop "
+                                "(per-hop transport telemetry contract)",
+                    ))
+                if not has_trace:
+                    out.append(Violation(
+                        checker=self.name, code="GL504", path=src.path,
+                        line=m.lineno, symbol=qual,
+                        message=f"{qual} dispatches without injecting trace "
+                                "context (tracing.inject/inject_metadata/"
+                                "_inject_meta)",
+                    ))
+                if not has_deadline:
+                    out.append(Violation(
+                        checker=self.name, code="GL505", path=src.path,
+                        line=m.lineno, symbol=qual,
+                        message=f"{qual} dispatches without checking/"
+                                "injecting the deadline budget "
+                                "(deadlines.check/inject/inject_metadata)",
+                    ))
+        return out
+
+    @staticmethod
+    def _delegates(closure, mname: str) -> bool:
+        """The method (or a helper it calls) invokes
+        ``<expr>.<same-method>(...)`` on something that is not ``self``,
+        or dispatches dynamically via ``getattr(client, method)(...)`` —
+        the failover/balancer delegation patterns.  The wrapped clients
+        carry the injection obligations."""
+        for fn in closure:
+            for call in _fn_calls(fn):
+                if isinstance(call.func, ast.Attribute) \
+                        and call.func.attr == mname \
+                        and attr_root(call.func.value) != "self":
+                    return True
+                if isinstance(call.func, ast.Call) \
+                        and call_name(call.func) == "getattr":
+                    return True
+        return False
+
+    @staticmethod
+    def _closure(m, methods: Dict[str, ast.AST],
+                 module_funcs: Dict[str, ast.AST]) -> List[ast.AST]:
+        """m plus every same-class method / module-level function it
+        transitively calls."""
+        seen: Set[str] = set()
+        order: List[ast.AST] = []
+        stack = [m]
+        while stack:
+            fn = stack.pop()
+            order.append(fn)
+            for call in _fn_calls(fn):
+                target = None
+                key = None
+                if isinstance(call.func, ast.Attribute) \
+                        and isinstance(call.func.value, ast.Name) \
+                        and call.func.value.id == "self":
+                    key = f"self.{call.func.attr}"
+                    target = methods.get(call.func.attr)
+                elif isinstance(call.func, ast.Name):
+                    key = call.func.id
+                    target = module_funcs.get(call.func.id)
+                if target is not None and key not in seen:
+                    seen.add(key)
+                    stack.append(target)
+        return order
+
+    @staticmethod
+    def _is_trace_inject(call: ast.Call, methods) -> bool:
+        name = call_name(call)
+        root = attr_root(call.func)
+        if root in ("_tracing", "tracing") and name.startswith("inject"):
+            return True
+        return name == "_inject_meta" and "_inject_meta" in methods
+
+    @staticmethod
+    def _is_deadline_use(call: ast.Call) -> bool:
+        name = call_name(call)
+        root = attr_root(call.func)
+        return root in ("_deadlines", "deadlines") and name in (
+            "check", "inject", "inject_metadata", "current_deadline",
+        )
+
+
+def _body_only_raises(fn) -> bool:
+    """True for abstract-surface methods whose body is just
+    ``raise NotImplementedError`` (plus an optional docstring)."""
+    body = [
+        n for n in fn.body
+        if not (isinstance(n, ast.Expr) and str_const(n.value) is not None)
+    ]
+    return all(isinstance(n, (ast.Raise, ast.Pass)) for n in body)
+
+
+def _walk_funcs(tree: ast.Module):
+    """(qualname, fn, class-or-None) including nested functions."""
+    def walk(node, prefix, cls):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                yield f"{prefix}{child.name}", child, cls
+                yield from walk(child, f"{prefix}{child.name}.", cls)
+            elif isinstance(child, ast.ClassDef):
+                yield from walk(child, prefix + child.name + ".", child)
+            else:
+                yield from walk(child, prefix, cls)
+
+    yield from walk(tree, "", None)
+
+
+CHECKER = _Checker()

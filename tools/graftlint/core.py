@@ -1,0 +1,340 @@
+"""graftlint core: source loading, pragmas, the allowlist, the suite.
+
+Design rules every checker follows:
+
+* **One violation = one (checker, path, symbol) identity.**  Line
+  numbers churn; the allowlist matches on the stable triple so a
+  justified entry survives refactors and a STALE entry (matching
+  nothing) is itself reported — burn-down files cannot rot silently.
+* **Inline pragmas are for single sites**: ``# graftlint:
+  allow[<checker>] — reason`` on the flagged line (or the line above)
+  suppresses that site; a pragma with no reason text does not count.
+* **Checkers never import the scanned code.**  Everything is stdlib
+  ``ast`` over the files; the only runtime import is the knob registry
+  (``seldon_core_tpu.runtime.knobs``), which is itself stdlib-only.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Any, Dict, Iterable, List, Optional, Sequence
+
+PRAGMA_RE = re.compile(
+    r"#\s*graftlint:\s*allow\[(?P<names>[a-z0-9_,\- ]+)\]\s*(?P<reason>.*)"
+)
+
+# generated protobuf modules: machine-written, exempt wholesale
+GENERATED_SUFFIXES = ("_pb2.py",)
+
+DEFAULT_PACKAGE = "seldon_core_tpu"
+
+
+@dataclass
+class Violation:
+    checker: str
+    code: str  # e.g. "GL201"
+    path: str  # repo-relative, forward slashes
+    line: int
+    message: str
+    symbol: str = ""  # stable identity for allowlisting (qualname, knob, ...)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "checker": self.checker,
+            "code": self.code,
+            "path": self.path,
+            "line": self.line,
+            "symbol": self.symbol,
+            "message": self.message,
+        }
+
+    def render(self) -> str:
+        sym = f" [{self.symbol}]" if self.symbol else ""
+        return f"{self.path}:{self.line}: {self.code} ({self.checker}){sym} {self.message}"
+
+
+@dataclass
+class Source:
+    """One parsed file plus the line-level pragma index."""
+
+    path: str  # repo-relative
+    abspath: str
+    text: str
+    lines: List[str]
+    tree: ast.Module
+
+    def pragma_allows(self, line: int, checker: str) -> bool:
+        """True when ``line`` (1-based) or the line above carries a
+        justified ``graftlint: allow[...]`` pragma naming ``checker``."""
+        for ln in (line, line - 1):
+            if 1 <= ln <= len(self.lines):
+                m = PRAGMA_RE.search(self.lines[ln - 1])
+                if m is None:
+                    continue
+                names = {n.strip() for n in m.group("names").split(",")}
+                reason = m.group("reason").strip(" -—:#")
+                if checker in names and len(re.sub(r"\W", "", reason)) >= 3:
+                    return True
+        return False
+
+
+@dataclass
+class LintContext:
+    root: str  # repo root (abs)
+    sources: List[Source]
+    docs_text: str  # concatenated docs/*.md
+    extra: Dict[str, Any] = field(default_factory=dict)
+
+    def source(self, rel_path: str) -> Optional[Source]:
+        for s in self.sources:
+            if s.path == rel_path:
+                return s
+        return None
+
+
+def _load_source(root: str, abspath: str) -> Optional[Source]:
+    rel = os.path.relpath(abspath, root).replace(os.sep, "/")
+    try:
+        with open(abspath, encoding="utf-8") as f:
+            text = f.read()
+        tree = ast.parse(text, filename=rel)
+    except (OSError, SyntaxError, ValueError):
+        return None
+    return Source(path=rel, abspath=abspath, text=text,
+                  lines=text.splitlines(), tree=tree)
+
+
+def collect_sources(root: str, package: str = DEFAULT_PACKAGE) -> List[Source]:
+    out: List[Source] = []
+    pkg_dir = os.path.join(root, package)
+    for dirpath, dirnames, filenames in os.walk(pkg_dir):
+        dirnames[:] = sorted(d for d in dirnames if d != "__pycache__")
+        for name in sorted(filenames):
+            if not name.endswith(".py"):
+                continue
+            if any(name.endswith(sfx) for sfx in GENERATED_SUFFIXES):
+                continue
+            src = _load_source(root, os.path.join(dirpath, name))
+            if src is not None:
+                out.append(src)
+    return out
+
+
+def load_docs_text(root: str) -> str:
+    parts = []
+    docs_dir = os.path.join(root, "docs")
+    if os.path.isdir(docs_dir):
+        for name in sorted(os.listdir(docs_dir)):
+            if name.endswith(".md"):
+                try:
+                    with open(os.path.join(docs_dir, name), encoding="utf-8") as f:
+                        parts.append(f.read())
+                except OSError:
+                    pass
+    return "\n".join(parts)
+
+
+# ---------------------------------------------------------------------------
+# allowlist (TOML subset: [[allow]] tables of `key = "basic string"`)
+# ---------------------------------------------------------------------------
+
+@dataclass
+class AllowEntry:
+    checker: str
+    path: str
+    symbol: str
+    reason: str
+    line: int  # line in allowlist.toml (for stale-entry reporting)
+    used: bool = False
+
+    def matches(self, v: Violation) -> bool:
+        if self.checker != v.checker or self.path != v.path:
+            return False
+        return self.symbol in ("", "*") or self.symbol == v.symbol
+
+
+_TOML_KV = re.compile(r'^\s*([A-Za-z_][A-Za-z0-9_-]*)\s*=\s*"((?:[^"\\]|\\.)*)"\s*(?:#.*)?$')
+
+
+def load_allowlist(path: str) -> List[AllowEntry]:
+    """Parse the graftlint allowlist.
+
+    A deliberately tiny TOML subset (python 3.10 has no tomllib):
+    ``[[allow]]`` array-of-tables whose values are basic one-line
+    strings.  Anything else in the file is a hard error — a burn-down
+    file that half-parses would silently widen the allowlist."""
+    entries: List[AllowEntry] = []
+    if not os.path.exists(path):
+        return entries
+    current: Optional[Dict[str, Any]] = None
+    with open(path, encoding="utf-8") as f:
+        for lineno, raw in enumerate(f, 1):
+            line = raw.strip()
+            if not line or line.startswith("#"):
+                continue
+            if line == "[[allow]]":
+                current = {"line": lineno}
+                entries.append(current)  # type: ignore[arg-type]
+                continue
+            m = _TOML_KV.match(raw)
+            if m and current is not None:
+                current[m.group(1)] = (
+                    m.group(2).encode().decode("unicode_escape")
+                )
+                continue
+            raise ValueError(
+                f"{path}:{lineno}: unparseable allowlist line {line!r} "
+                "(supported: [[allow]] tables with key = \"value\")"
+            )
+    out: List[AllowEntry] = []
+    for e in entries:
+        if not isinstance(e, dict):
+            continue
+        reason = str(e.get("reason", "")).strip()
+        if not reason:
+            raise ValueError(
+                f"{path}:{e['line']}: allowlist entry without a reason — "
+                "every kept violation carries a one-line justification"
+            )
+        out.append(AllowEntry(
+            checker=str(e.get("checker", "")),
+            path=str(e.get("path", "")),
+            symbol=str(e.get("symbol", "")),
+            reason=reason,
+            line=int(e["line"]),
+        ))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# suite
+# ---------------------------------------------------------------------------
+
+def run_suite(
+    root: str,
+    checkers: Optional[Sequence[Any]] = None,
+    allowlist_path: Optional[str] = None,
+    package: str = DEFAULT_PACKAGE,
+) -> Dict[str, Any]:
+    """Run ``checkers`` (default: the full registry) over ``package``
+    under ``root``; returns the machine-readable result dict."""
+    from tools.graftlint.checkers import ALL_CHECKERS
+
+    active = list(checkers) if checkers is not None else list(ALL_CHECKERS)
+    sources = collect_sources(root, package=package)
+    ctx = LintContext(root=root, sources=sources,
+                      docs_text=load_docs_text(root))
+    raw: List[Violation] = []
+    for checker in active:
+        found = list(checker.run(ctx))
+        for v in found:
+            src = ctx.source(v.path)
+            if src is not None and src.pragma_allows(v.line, v.checker):
+                continue
+            raw.append(v)
+
+    if allowlist_path is None:
+        allowlist_path = os.path.join(
+            root, "tools", "graftlint", "allowlist.toml"
+        )
+    allow = load_allowlist(allowlist_path)
+    kept: List[Violation] = []
+    suppressed: List[Dict[str, Any]] = []
+    for v in raw:
+        hit = next((a for a in allow if a.matches(v)), None)
+        if hit is not None:
+            hit.used = True
+            suppressed.append({**v.to_dict(), "reason": hit.reason})
+        else:
+            kept.append(v)
+    active_names = {c.name for c in active}
+    for a in allow:
+        # staleness is only judged for checkers that actually ran: a
+        # --checker subset run must not flag other checkers' entries
+        if a.checker not in active_names:
+            continue
+        if not a.used:
+            kept.append(Violation(
+                checker="allowlist", code="GL001",
+                path=os.path.relpath(allowlist_path, root).replace(os.sep, "/"),
+                line=a.line,
+                symbol=f"{a.checker}:{a.path}:{a.symbol}",
+                message=(
+                    "stale allowlist entry matches no current violation — "
+                    "delete it (the burn-down shrank, keep the file honest)"
+                ),
+            ))
+
+    kept.sort(key=lambda v: (v.path, v.line, v.code))
+    counts: Dict[str, int] = {}
+    for v in kept:
+        counts[v.checker] = counts.get(v.checker, 0) + 1
+    return {
+        "ok": not kept,
+        "violations": [v.to_dict() for v in kept],
+        "suppressed": suppressed,
+        "counts": counts,
+        "files_scanned": len(sources),
+        "checkers": [c.name for c in active],
+    }
+
+
+# ---------------------------------------------------------------------------
+# shared AST helpers used by several checkers
+# ---------------------------------------------------------------------------
+
+def call_name(node: ast.AST) -> str:
+    """Last dotted component of a call target: ``a.b.c(...)`` -> 'c',
+    ``f(...)`` -> 'f', anything else -> ''."""
+    if isinstance(node, ast.Call):
+        node = node.func
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return ""
+
+
+def attr_root(node: ast.AST) -> str:
+    """Leftmost name of an attribute chain: ``a.b.c`` -> 'a'."""
+    while isinstance(node, ast.Attribute):
+        node = node.value
+    return node.id if isinstance(node, ast.Name) else ""
+
+
+def str_const(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def iter_funcs(tree: ast.Module) -> Iterable[tuple]:
+    """Yield (qualname, func_node, class_node_or_None) for every
+    function/method in the module, including nested ones."""
+    def walk(node: ast.AST, prefix: str, cls: Optional[ast.ClassDef]):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                q = f"{prefix}{child.name}"
+                yield q, child, cls
+                yield from walk(child, q + ".", cls)
+            elif isinstance(child, ast.ClassDef):
+                yield from walk(child, prefix + child.name + ".", child)
+            else:
+                yield from walk(child, prefix, cls)
+
+    yield from walk(tree, "", None)
+
+
+def module_constants(tree: ast.Module) -> Dict[str, str]:
+    """Module-level ``NAME = "literal"`` string constants."""
+    out: Dict[str, str] = {}
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1:
+            t = node.targets[0]
+            v = str_const(node.value)
+            if isinstance(t, ast.Name) and v is not None:
+                out[t.id] = v
+    return out

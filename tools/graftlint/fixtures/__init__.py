@@ -1,0 +1,9 @@
+"""Seeded TRUE-POSITIVE fixtures for the graftlint checker tests.
+
+Each ``bad_*.py`` file contains known violations of exactly one
+checker's invariant; tests/test_graftlint.py runs the checker over the
+fixture and asserts every seeded violation is caught (a checker that
+goes vacuous fails its fixture test, not just silently passes the
+tree).  These files are NEVER imported — syntax-valid but semantically
+nonsense on purpose.
+"""

@@ -1,0 +1,56 @@
+"""Seeded exception-hygiene violations (GL601-603).  Never imported."""
+import logging
+
+logger = logging.getLogger(__name__)
+
+
+def swallow_silently(fn):
+    # seeded GL601: no raise, no conversion, no justification comment
+    # (the comment must sit OFF the except line or it would count)
+    try:
+        return fn()
+    except Exception:
+        return None
+
+
+def log_only(fn):
+    try:
+        return fn()
+    # seeded GL601: a bare noqa code is not a justification
+    except Exception:  # noqa: BLE001
+        logger.exception("it broke")
+
+
+def bare(fn):
+    try:
+        return fn()
+    except:  # GL602: bare except
+        return None
+
+
+def base_exc(fn):
+    try:
+        return fn()
+    except BaseException:  # GL603: traps interpreter shutdown
+        return None
+
+
+def reraises_fine(fn):
+    try:
+        return fn()
+    except Exception:
+        raise
+
+
+def converts_fine(fn):
+    try:
+        return fn()
+    except Exception as e:
+        return {"status": {"info": str(e), "code": 500}}
+
+
+def justified_fine(fn):
+    try:
+        return fn()
+    except Exception:  # metrics must never break the data plane
+        return None

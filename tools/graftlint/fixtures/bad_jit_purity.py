@@ -1,0 +1,46 @@
+"""Seeded jit-purity violations (GL101-105).  Never imported."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+COUNTER = {"calls": 0}
+
+
+def impure(x, y, opts=[1, 2]):  # noqa: B006 — part of the GL105 seed
+    if x > 0:  # GL103: host branch on a tracer
+        y = y + 1
+    z = x * 2
+    f = float(z)  # GL101: host cast of a traced value
+    v = x.item()  # GL102: host pull
+    arr = np.asarray(y)  # GL102: host materialization
+    COUNTER["calls"] += 1  # GL104: captured-state mutation
+    while y < 0:  # GL103: host loop on a tracer
+        y = y + 1
+    return jnp.sum(z) + f + v + arr.sum()
+
+
+jitted = jax.jit(impure, static_argnums=(2,))  # GL105: unhashable static default
+
+
+class Engine:
+    def __init__(self):
+        self._hits = 0
+        self._fn = jax.jit(self._method)
+
+    def _method(self, x):
+        self._hits += 1  # GL104: self-state mutation inside the traced body
+        ok = bool(x)  # GL101
+        return x * 2, ok
+
+
+def pure_ok(x, n_steps):
+    # all host work here is shape/static math: must NOT be flagged
+    b = x.shape[0]
+    if b > 4:
+        x = x[:4]
+    for _ in range(int(n_steps) if isinstance(n_steps, int) else 1):
+        x = x * 2
+    return jnp.sum(x)
+
+
+jitted_ok = jax.jit(pure_ok, static_argnames=("n_steps",))

@@ -1,0 +1,24 @@
+"""Seeded knob-registry violations (GL201/202/204).  Never imported."""
+import os
+
+from seldon_core_tpu.runtime import knobs
+
+# GL201 + (registered name, so not GL202): direct environ read of a knob
+TP = os.environ.get("SELDON_TPU_TP", "")
+# GL201 via os.getenv
+DBG = os.getenv("SELDON_TPU_PAGED_DEBUG")
+# GL201 via subscript
+QUEUE = os.environ["SELDON_TPU_MAX_QUEUE"]
+# GL201 via a module-level constant name
+_MY_KNOB = "SELDON_TPU_PREFIX_CACHE"
+PC = os.environ.get(_MY_KNOB)
+
+# GL202: undeclared knob literal (never registered)
+MYSTERY = os.environ.get("SELDON_TPU_TOTALLY_UNDECLARED", "1")
+
+# GL202: undeclared annotation / header literals
+ANN = "seldon.io/not-a-real-annotation"
+HDR = "X-Seldon-Mystery-Header"
+
+# GL204: registry read of an undeclared name
+GHOST = knobs.raw("SELDON_TPU_GHOST_KNOB")

@@ -1,0 +1,18 @@
+"""Seeded metrics-contract fixture: the BRIDGE side.  Never imported."""
+
+ENGINE_STATS_METRICS = {
+    "chunks": ("counter", "seldon_tpu_engine_chunks_total", "chunks"),
+    "shed": ("counter", "seldon_tpu_engine_shed_total", "shed"),
+    "active_slots": ("gauge", "seldon_tpu_engine_slot_occupancy", "slots"),
+    # GL402: mapped but the engine never emits it
+    "never_emitted": ("counter", "seldon_tpu_engine_never_total", "ghost"),
+    # GL403: counter without _total suffix
+    "bad_name": ("counter", "seldon_tpu_engine_bad_name", "bad"),
+}
+
+ENGINE_STATS_EXCLUDED = {"chunk_wall_s", "bad_name"}
+
+TRANSPORT_METRICS = {
+    # GL403: missing the seldon_tpu_ prefix
+    "requests": ("counter", "transport_requests_total", "reqs"),
+}

@@ -1,0 +1,20 @@
+"""Seeded metrics-contract fixture: the ENGINE side.  Paired with
+bad_metrics_metrics.py by tests/test_graftlint.py.  Never imported."""
+
+_SLO_COUNTER_KEYS = ("shed", "ghost_slo_key")  # ghost_slo_key -> GL404
+
+
+class FakeEngine:
+    def __init__(self):
+        self._counters = {
+            "chunks": 0,
+            "shed": 0,
+            "unmapped_counter": 0,  # not mapped, not excluded -> GL401
+            "chunk_wall_s": 0.0,  # excluded: fine
+        }
+
+    def engine_stats(self, detail=False):
+        return {
+            **self._counters,
+            "active_slots": 0,
+        }

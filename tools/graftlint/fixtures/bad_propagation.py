@@ -1,0 +1,48 @@
+"""Seeded propagation violations (GL501-505).  Never imported."""
+from seldon_core_tpu.runtime.executor_pool import run_dispatch
+from seldon_core_tpu.utils import deadlines as _deadlines
+from seldon_core_tpu.utils import tracing as _tracing
+from seldon_core_tpu.utils.tracing import activate_context
+
+
+class _Hop:  # stand-in so the fixture parses standalone
+    def __init__(self, *a): ...
+
+    def finish(self, error=False): ...
+
+
+async def bad_handler(request):
+    # GL501 + GL502: dispatches with neither deadline nor trace handling
+    body = await request.json()
+    return await run_dispatch(lambda: body)
+
+
+async def good_handler(request):
+    with activate_context(None), _deadlines.activate_ms(None):
+        _deadlines.check("fixture ingress")
+        return await run_dispatch(lambda: None)
+
+
+class BadClient:
+    """GL503/504/505: dispatch method with no hop, no injection, no
+    deadline handling."""
+
+    async def transform_input(self, msg):
+        return await self._post(msg)
+
+    async def _post(self, msg):
+        return msg
+
+
+class GoodClient:
+    async def transform_input(self, msg):
+        return await self._call("transform_input", msg)
+
+    async def _call(self, method, msg):
+        _deadlines.check("fixture hop")
+        headers = _tracing.inject({})
+        hop = _Hop("unit", method, "rest")
+        try:
+            return (msg, headers)
+        finally:
+            hop.finish()
