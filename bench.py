@@ -256,6 +256,15 @@ COMPACT_PICKS = [
     # grpc-python stub, SAME C++ client (reference methodology)
     ("native_vs_py_stub", ("native_vs_py_stub",)),
     ("py_stub_qps", ("python_grpc_stub_qps",)),
+    # r14 zero-copy certification (§9a / ROADMAP 4): 1x16 int8 (an
+    # extension wire dtype the C++ fast lane can't batch — the PYTHON
+    # model path is measured) through the buffer-view lane's
+    # predict_sync path on a single-MODEL mlp, C++ load client; gated
+    # >= 0.5 x stub_qps.  zero_copy_x = lane-on / lane-off (JSON
+    # rawTensor b64 + async gateway, SELDON_TPU_ZERO_COPY=0) model
+    # qps, gated >= 2.0 with served outputs bit-exact both lanes
+    ("native_model_qps", ("zero_copy", "native_model_qps")),
+    ("zero_copy_x", ("zero_copy", "zero_copy_x")),
     ("stub_qps", ("stub_engine_qps",)),
     ("native_front_qps", ("native_front_qps",)),
     ("server_p99_ms", ("server_latency", "p99_ms")),
@@ -891,6 +900,140 @@ async def native_model_phase(handle, shape, seconds: float = 6.0) -> dict:
     }
 
 
+async def zero_copy_phase(seconds: float = 4.0) -> dict:
+    """Small-tensor native→model qps, buffer-view lane on vs off.
+
+    The ROADMAP-4 gap: BENCH_r05's python model path pays
+    proto→dict→numpy per request while the C++ front does 105k qps.
+    This phase serves a small MLP as a single-MODEL deployment through
+    the native ingress and sends **int8** tensors — an SRT1 EXTENSION
+    dtype (code 4), which the in-C++ fast lane deliberately does not
+    batch, so both arms measure the PYTHON model path the lane exists
+    to fix:
+
+    * **lane on** — SRT1 frames (`application/x-seldon-raw`): C++
+      forwards the body whole, `GatewayRawHandler` decodes a zero-copy
+      BufferView and runs the single-local-model graph ON the C++
+      raw-worker thread (`predict_sync` — no event-loop crossing, no
+      JSON/proto parse; §9a), coalescing in the model's batcher.
+    * **lane off** — `SELDON_TPU_ZERO_COPY=0` + the JSON rawTensor
+      (b64) encoding of the SAME tensor: today's path (json parse →
+      b64 copy → async gateway over the event loop), same client,
+      same graph, same device work.
+
+    Served outputs are asserted bit-exact lane-on vs lane-off BEFORE
+    any timing (gate: exactness is a precondition, not a metric).
+    Emits `native_model_qps` (lane-on requests/s; gate >= 0.5 x
+    stub_qps) and `zero_copy_x` (on/off ratio; gate >= 2.0).
+    """
+    import asyncio
+    import base64
+
+    import numpy as np
+
+    from seldon_core_tpu.codec import bufview
+    from seldon_core_tpu.engine import PredictorService, UnitSpec
+    from seldon_core_tpu.engine.native_ingress import serve_native_ingress
+    from seldon_core_tpu.engine.server import Gateway
+    from seldon_core_tpu.models.jaxserver import JaxServer
+    from seldon_core_tpu.native.frontserver import native_load, read_http_response
+    from seldon_core_tpu.testing.loadgen import build_http_blob
+
+    feat = 16
+    server = JaxServer(
+        model="mlp", num_classes=8, input_shape=(feat,), dtype="float32",
+        warmup_dtypes=("float32",), max_batch_size=64, max_wait_ms=0.5,
+        warmup=True,
+    )
+    root = UnitSpec(name="zc-model", type="MODEL", component=server)
+    gateway = Gateway([(PredictorService(root, name="zero-copy"), 1.0)])
+    handle = await serve_native_ingress(
+        gateway, host="127.0.0.1", http_port=0, max_wait_ms=0.5,
+    )
+    prior_env = os.environ.get("SELDON_TPU_ZERO_COPY")
+
+    def _restore_env():
+        if prior_env is None:
+            os.environ.pop("SELDON_TPU_ZERO_COPY", None)
+        else:
+            os.environ["SELDON_TPU_ZERO_COPY"] = prior_env
+
+    try:
+        # constant content, like every serving phase (relay note in
+        # native_model_phase); 1 row per request = the small-tensor
+        # shape; int8 = an extension wire dtype the C++ fast lane does
+        # not batch, so the frame reaches the python lane under test
+        x = np.zeros((1, feat), np.int8)
+        frame = bufview.pack_frame(x)
+        frame_blob = build_http_blob(
+            "/api/v0.1/predictions", frame,
+            content_type="application/x-seldon-raw",
+        )
+        jreq = json.dumps({"data": {"rawTensor": {
+            "shape": [1, feat], "dtype": "int8",
+            "data": base64.b64encode(x.tobytes()).decode(),
+        }}}).encode()
+        json_blob = build_http_blob(
+            "/api/v0.1/predictions", jreq, content_type="application/json",
+        )
+
+        def one_request(blob) -> tuple:
+            import socket
+
+            s = socket.create_connection(("127.0.0.1", handle.port), timeout=20)
+            try:
+                s.sendall(blob)
+                status, body, _ = read_http_response(s, b"", timeout_s=30)
+            finally:
+                s.close()
+            return status, body
+
+        # bit-exactness gate BEFORE timing: the lanes must serve the
+        # same bytes or the ratio measures a wrong answer's speed
+        os.environ["SELDON_TPU_ZERO_COPY"] = "1"
+        st_on, body_on = await asyncio.to_thread(one_request, frame_blob)
+        out_on = bufview.unpack_frame(body_on).array()
+        os.environ["SELDON_TPU_ZERO_COPY"] = "0"
+        st_off, body_off = await asyncio.to_thread(one_request, json_blob)
+        rt = json.loads(body_off)["data"]["rawTensor"]
+        out_off = np.frombuffer(
+            base64.b64decode(rt["data"]), dtype=rt["dtype"]
+        ).reshape(out_on.shape)
+        if st_on != 200 or st_off != 200 or not np.array_equal(out_on, out_off):
+            raise RuntimeError(
+                f"zero-copy lanes disagree: on={st_on} off={st_off} "
+                f"bit_exact={np.array_equal(out_on, out_off)}"
+            )
+
+        async def best_of(blob, n: int = 3) -> float:
+            best = 0.0
+            for _ in range(n):
+                out = await asyncio.to_thread(
+                    native_load, handle.port, blob, seconds / n, 8, 4
+                )
+                if out and out.get("errors", 0) == 0 and out["qps"] > best:
+                    best = out["qps"]
+            return best
+
+        os.environ["SELDON_TPU_ZERO_COPY"] = "1"
+        on_qps = await best_of(frame_blob)
+        os.environ["SELDON_TPU_ZERO_COPY"] = "0"
+        off_qps = await best_of(json_blob)
+        return {
+            "native_model_qps": round(on_qps, 1),
+            "zero_copy_off_qps": round(off_qps, 1),
+            "zero_copy_x": round(on_qps / off_qps, 2) if off_qps else None,
+            "bit_exact": True,
+            "mix": f"1x{feat} int8 (extension wire dtype -> python lane), "
+                   "single-MODEL mlp, 8 conns x depth 4, C++ load client, "
+                   "best-of-3 windows/side",
+        }
+    finally:
+        _restore_env()
+        await handle.stop()
+        server.unload()
+
+
 def host_costs_phase(shape, out_dim: int = 1000, iters: int = 300) -> dict:
     """Measured host-side per-request costs an attached host still pays
     (all relay-independent, so measurable here): request proto parse,
@@ -1266,8 +1409,9 @@ async def child_main() -> None:
                 native_handle, shape, seconds=min(SECONDS, 6.0)
             )
             nm = status["extra"]["native_model"]
-            if nm.get("images_per_s"):
-                status["extra"]["native_model_qps"] = nm["requests_per_s"]
+            # (native_model_qps moved to the zero_copy phase in r14 —
+            # the compact key now means the small-tensor python-lane
+            # rate; this phase's requests_per_s stays in native_model)
             # context row, NOT the native-vs-python verdict: C++-client
             # HTTP lane vs python-client gRPC lane mixes client stacks
             # (the r4 vs_python_lane read backwards because of exactly
@@ -1317,6 +1461,15 @@ async def child_main() -> None:
     except Exception as e:  # noqa: BLE001
         status["extra"]["native_grpc_error"] = str(e)[:200]
     _checkpoint(status)
+
+    if os.environ.get("BENCH_ZERO_COPY", "1") == "1":
+        try:
+            status["extra"]["zero_copy"] = await zero_copy_phase(
+                seconds=min(SECONDS, 4.0)
+            )
+        except Exception as e:  # noqa: BLE001
+            status["extra"]["zero_copy_error"] = str(e)[:200]
+        _checkpoint(status)
 
     try:
         pg = await python_grpc_stub_qps()

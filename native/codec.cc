@@ -175,8 +175,71 @@ void u8_to_f32_normalize(const uint8_t* src, int64_t n_pixels, int64_t channels,
   }
 }
 
+// ---------------------------------------------------------------------------
+// SRT1 buffer-view framing — THE wire agreement
+// ---------------------------------------------------------------------------
+//
+// frame := magic u32 'S''R''T''1' | dtype u8 | ndim u8 | flags u16 |
+//          shape i64[ndim] | payload bytes (little-endian, C order)
+//
+// The header is 8 + 8*ndim bytes — a multiple of 8, so a frame placed
+// at an aligned offset keeps its payload aligned for every dtype in
+// the table (device_put/dlpack alignment).  Three implementations
+// share this table and must not drift: this file (the C ABI source of
+// truth tests assert against), frontserver.cc parse_raw_frame (fast
+// lane: codes 0/1 only), and codec/bufview.py SRT1_DTYPES.
+//
+// code: 0=f32 1=u8 2=i32 3=f64 | 4=i8 5=bf16 6=f16 7=i64 8=u16 9=i16
+//       10=u32 11=u64   (codes 4+ ride the Python buffer-view lane;
+//       the in-C++ fast lane batches 0/1)
+
+const int32_t kSrt1DtypeCount = 12;
+static const int64_t kSrt1ItemSize[kSrt1DtypeCount] = {
+    4, 1, 4, 8, 1, 2, 2, 8, 2, 2, 4, 8};
+
+uint32_t srt1_magic() { return 0x31545253u; }
+
+// bytes per element for a dtype code, or -1 for an unknown code
+int64_t srt1_item_size(int32_t dtype_code) {
+  if (dtype_code < 0 || dtype_code >= kSrt1DtypeCount) return -1;
+  return kSrt1ItemSize[dtype_code];
+}
+
+// header length for an ndim-dimensional frame (payload offset), or -1
+// when ndim is outside the framing's 0..8 range
+int64_t srt1_header_bytes(int32_t ndim) {
+  if (ndim < 0 || ndim > 8) return -1;
+  return 8 + 8 * (int64_t)ndim;
+}
+
+// Validate a frame header and return the payload byte count it
+// promises, or -1 when malformed (bad magic/code/ndim, negative or
+// overflowing dims, truncated shape block).  Shared validation core so
+// a C++ consumer of extension-code frames agrees byte-for-byte with
+// codec/bufview.py's unpack_frame.
+int64_t srt1_payload_bytes(const uint8_t* frame, int64_t len) {
+  if (len < 8) return -1;
+  uint32_t magic;
+  memcpy(&magic, frame, 4);
+  if (magic != srt1_magic()) return -1;
+  int64_t item = srt1_item_size(frame[4]);
+  int64_t head = srt1_header_bytes(frame[5]);
+  if (item < 0 || head < 0 || len < head) return -1;
+  constexpr uint64_t kMaxElems = 1ull << 31;
+  uint64_t n = 1;
+  for (int d = 0; d < frame[5]; d++) {
+    int64_t dim;
+    memcpy(&dim, frame + 8 + 8 * d, 8);
+    if (dim < 0 || (uint64_t)dim > kMaxElems) return -1;
+    n *= (uint64_t)dim;
+    if (n > kMaxElems) return -1;
+  }
+  return (int64_t)(n * (uint64_t)item);
+}
+
 // v2: FsConfig gained bind_host (frontserver.cc); a stale .so built
 // before that field would silently ignore the requested bind address.
-int32_t native_abi_version() { return 2; }
+// v3: srt1_* framing-agreement surface (zero-copy buffer-view lane).
+int32_t native_abi_version() { return 3; }
 
 }  // extern "C"

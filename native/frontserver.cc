@@ -291,6 +291,12 @@ std::string find_string_value(const std::string& s, const char* key) {
 // frame := magic u32 'S''R''T''1' | dtype u8 | ndim u8 | flags u16 |
 //          shape i64[ndim] | payload bytes (little-endian, C order)
 // dtype:  0=float32 1=uint8 2=int32 3=float64
+//
+// The framing agreement (full 12-code dtype table, alignment rules)
+// lives in codec.cc (srt1_*) and codec/bufview.py; THIS parser is the
+// in-C++ fast lane and deliberately batches codes 0/1 only — frames
+// carrying extension codes fall through to the Python buffer-view
+// lane, which decodes them zero-copy.
 
 constexpr uint32_t kRawMagic = 0x31545253;  // "SRT1" little-endian
 
@@ -1019,7 +1025,11 @@ class FrontServer {
       p.keep_alive = req.keep_alive;
       if (req.is_raw_tensor) {
         RawFrame f;
-        if (parse_raw_frame((const uint8_t*)body.data(), (int64_t)body.size(), &f) &&
+        // no in-C++ model (fallback-only deployment): the frame must
+        // reach the Python buffer-view lane whole, not 500 out of an
+        // armless fast lane
+        if ((batch_cb_ != nullptr || cfg_.stub_mode) &&
+            parse_raw_frame((const uint8_t*)body.data(), (int64_t)body.size(), &f) &&
             (f.dtype == 0 || f.dtype == 1) && f.shape.size() == 2 &&
             f.shape[0] >= 1 && f.shape[1] >= 1 &&  // mirror the JSON lane: no empty batches
             (cfg_.feature_dim <= 0 || f.shape[1] == cfg_.feature_dim)) {

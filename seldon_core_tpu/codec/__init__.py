@@ -22,4 +22,20 @@ from seldon_core_tpu.codec.jsonpath import (  # noqa: F401
     json_to_proto,
     proto_to_json,
 )
-from seldon_core_tpu.codec.device import from_device, is_device_array, to_device  # noqa: F401
+from seldon_core_tpu.codec.device import (  # noqa: F401
+    from_device,
+    from_device_many,
+    is_device_array,
+    to_device,
+)
+from seldon_core_tpu.codec.bufview import (  # noqa: F401
+    BufferView,
+    is_frame,
+    pack_frame,
+    pack_frames,
+    stack_views,
+    unpack_frame,
+    unpack_frames,
+    zero_copy_enabled,
+)
+

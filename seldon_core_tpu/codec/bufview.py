@@ -1,0 +1,432 @@
+"""Buffer-view payloads: the zero-copy SeldonMessage lane.
+
+The proto path materialises every tensor payload at least twice between
+the socket and the device (proto parse -> python ``bytes`` -> numpy ->
+``device_put``), and the JSON path adds a float64 ``tolist`` round-trip
+on top.  :class:`BufferView` replaces all of that with one immutable
+triple ``(dtype, shape, buffer)`` over the ingress byte buffer: decode
+is an ``np.frombuffer`` *view* (no copy, no dtype widening), co-located
+graph hops pass the view by reference, and the engines stack views into
+a device batch with a single copy per micro-batch (the ``device_put``
+staging buffer — the one copy the hardware requires).
+
+Wire format — **the SRT1 framing agreement** (one definition, three
+implementations that must not drift: this module, the C ABI table in
+``native/codec.cc`` (``srt1_item_size``), and the fast-lane parser in
+``native/frontserver.cc``):
+
+    frame := magic u32 'S''R''T''1' | dtype u8 | ndim u8 | flags u16
+           | shape i64[ndim] | payload bytes
+
+* everything little-endian, payload C-order;
+* the header is ``8 + 8*ndim`` bytes — always a multiple of 8, so a
+  frame placed at an aligned offset keeps its payload aligned for every
+  supported dtype (``device_put`` and dlpack both want this);
+* dtype codes 0-3 are the legacy table the C++ fast lane batches
+  in-process; codes 4+ extend the lane to the full serving vocabulary
+  (int8/bf16/f16/...) and flow through the Python buffer-view lane
+  (the C++ ingress forwards the body whole — no per-request parse).
+
+``SELDON_TPU_ZERO_COPY=0`` disables every buffer-view lane; the proto /
+JSON paths are then byte-identical to the pre-lane engine.
+"""
+
+from __future__ import annotations
+
+import math
+import struct
+from typing import Any, Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+from seldon_core_tpu.codec.tensor import PayloadError, ensure_little_endian, np_dtype
+
+__all__ = [
+    "SRT1_MAGIC",
+    "SRT1_DTYPES",
+    "BufferView",
+    "zero_copy_enabled",
+    "pack_frame",
+    "unpack_frame",
+    "pack_frames",
+    "unpack_frames",
+    "frame_header",
+    "is_frame",
+]
+
+SRT1_MAGIC = 0x31545253  # "SRT1" little-endian
+_MAGIC_BYTES = b"SRT1"
+
+# dtype code -> canonical dtype name.  Codes 0-3 are the legacy table
+# native/frontserver.cc parse_raw_frame understands (its fast lane
+# accepts 0/1 only); the extension codes ride the Python lane.  The
+# C ABI mirror is srt1_item_size() in native/codec.cc — extend BOTH or
+# tests/test_zero_copy.py's agreement check fails.
+SRT1_DTYPES = (
+    "float32",   # 0 — legacy (C++ fast lane)
+    "uint8",     # 1 — legacy (C++ fast lane)
+    "int32",     # 2 — legacy
+    "float64",   # 3 — legacy
+    "int8",      # 4
+    "bfloat16",  # 5 (ml_dtypes)
+    "float16",   # 6
+    "int64",     # 7
+    "uint16",    # 8
+    "int16",     # 9
+    "uint32",    # 10
+    "uint64",    # 11
+)
+
+_CODE_BY_NAME = {name: code for code, name in enumerate(SRT1_DTYPES)}
+MAX_NDIM = 8
+# element-count ceiling shared with native/codec.cc (kMaxElems): a
+# crafted shape whose product wraps int64 must fail VALIDATION, not
+# surface later as a bare numpy reshape error
+MAX_ELEMS = 1 << 31
+
+
+def zero_copy_enabled() -> bool:
+    """SELDON_TPU_ZERO_COPY=0 turns every buffer-view lane off (the
+    parity lane: lane-off is behaviour-identical to the proto path)."""
+    from seldon_core_tpu.runtime import knobs
+
+    return knobs.flag("SELDON_TPU_ZERO_COPY")
+
+
+def _byte_view(buffer: Union[bytes, bytearray, memoryview, np.ndarray]) -> memoryview:
+    """A flat uint8 memoryview over ``buffer`` without copying.  The
+    one edge ``cast("B")`` refuses — zero-size buffers — degrades to an
+    empty view (there are no bytes to alias)."""
+    mv = buffer if isinstance(buffer, memoryview) else memoryview(buffer)
+    if mv.ndim == 1 and mv.format in ("B", "b", "c"):
+        return mv.cast("B") if mv.format != "B" else mv
+    if mv.nbytes == 0:
+        return memoryview(b"")
+    return mv.cast("B")
+
+
+def dtype_code(dtype: np.dtype) -> int:
+    """The SRT1 wire code for ``dtype`` (PayloadError when the dtype has
+    no code — strings/objects must travel the ndarray/JSON path)."""
+    code = _CODE_BY_NAME.get(np.dtype(dtype).name)
+    if code is None:
+        raise PayloadError(
+            f"dtype {np.dtype(dtype).name!r} has no SRT1 wire code "
+            f"(supported: {', '.join(SRT1_DTYPES)})"
+        )
+    return code
+
+
+class BufferView:
+    """One tensor payload as ``(dtype, shape, buffer)`` — no python
+    lists, no copy.  ``array()`` is an ``np.frombuffer`` view over the
+    underlying buffer (read-only when the buffer is); ``np.asarray`` on
+    a view resolves through ``__array__`` so every existing component
+    consumes views unchanged.
+
+    ``copied`` records whether constructing the view had to copy
+    (non-contiguous source arrays) — the transport telemetry's
+    zero-copy-vs-copied split reads it.
+    """
+
+    __slots__ = ("dtype", "shape", "_mv", "copied", "_arr")
+
+    def __init__(
+        self,
+        dtype: Any,
+        shape: Sequence[int],
+        buffer: Union[bytes, bytearray, memoryview, np.ndarray],
+        offset: int = 0,
+        copied: bool = False,
+    ):
+        self.dtype = np.dtype(dtype) if not isinstance(dtype, np.dtype) else dtype
+        self.shape = tuple(int(d) for d in shape)
+        if any(d < 0 for d in self.shape):
+            raise PayloadError(f"negative dimension in shape {self.shape}")
+        mv = _byte_view(buffer)
+        # math.prod: exact python-int arithmetic — an attacker-sized
+        # shape cannot wrap an int64 product into a small "valid" need
+        elems = math.prod(self.shape) if self.shape else 1
+        if elems > MAX_ELEMS:
+            raise PayloadError(
+                f"shape {self.shape} holds {elems} elements, over the "
+                f"{MAX_ELEMS} framing ceiling"
+            )
+        need = elems * self.dtype.itemsize
+        if offset < 0 or offset + need > len(mv):
+            raise PayloadError(
+                f"buffer of {len(mv)} bytes cannot hold {self.shape} "
+                f"{self.dtype.name} at offset {offset} (needs {need} bytes)"
+            )
+        self._mv = mv[offset:offset + need]
+        self.copied = bool(copied)
+        self._arr: Optional[np.ndarray] = None
+
+    # ---- constructors -----------------------------------------------------
+
+    @classmethod
+    def from_array(cls, arr: np.ndarray) -> "BufferView":
+        """Wrap an ndarray.  C-contiguous arrays are wrapped in place
+        (zero copy); strided/non-contiguous inputs are compacted once
+        and flagged ``copied`` so telemetry stays honest."""
+        arr = np.asarray(arr)
+        copied = not arr.flags["C_CONTIGUOUS"]
+        if copied:
+            arr = np.ascontiguousarray(arr)
+        view = cls(arr.dtype, arr.shape, _byte_view(arr), copied=copied)
+        view._arr = arr  # keep the exact array (and its writability)
+        return view
+
+    @classmethod
+    def from_bytes(
+        cls, data: Union[bytes, memoryview], dtype: Any,
+        shape: Sequence[int], offset: int = 0,
+    ) -> "BufferView":
+        """View over raw little-endian payload bytes.  A byte count that
+        does not divide into whole elements raises a precise
+        :class:`PayloadError` naming the offset (the numpy ValueError it
+        replaces named neither)."""
+        dt = np_dtype(dtype) if isinstance(dtype, str) else np.dtype(dtype)
+        mv = _byte_view(data)
+        avail = len(mv) - offset
+        if offset < 0 or avail < 0:
+            raise PayloadError(
+                f"offset {offset} is outside the {len(mv)}-byte buffer"
+            )
+        if shape is None or len(tuple(shape)) == 0:
+            # 0-d scalar: exactly one element
+            if avail != dt.itemsize:
+                raise PayloadError(
+                    f"scalar {dt.name} payload at offset {offset} must be "
+                    f"{dt.itemsize} bytes, got {avail}"
+                )
+            return cls(dt, (), mv, offset=offset)
+        if avail % dt.itemsize:
+            raise PayloadError(
+                f"misaligned rawTensor payload: {avail} bytes at offset "
+                f"{offset} is not a multiple of {dt.name} itemsize "
+                f"{dt.itemsize}"
+            )
+        return cls(dt, shape, mv, offset=offset)
+
+    # ---- accessors --------------------------------------------------------
+
+    @property
+    def nbytes(self) -> int:
+        return len(self._mv)
+
+    @property
+    def ndim(self) -> int:
+        return len(self.shape)
+
+    def array(self) -> np.ndarray:
+        """The payload as an ndarray VIEW over the buffer (cached; no
+        copy, read-only when the buffer is immutable)."""
+        if self._arr is None:
+            arr = np.frombuffer(self._mv, dtype=self.dtype)
+            self._arr = arr.reshape(self.shape)
+        return self._arr
+
+    def __array__(self, dtype=None, copy=None):
+        arr = self.array()
+        if dtype is not None and np.dtype(dtype) != arr.dtype:
+            return arr.astype(dtype)
+        if copy:
+            return arr.copy()
+        return arr
+
+    def tobytes(self) -> bytes:
+        return self._mv.tobytes()
+
+    def to_device(self, sharding=None, dtype=None):
+        """One ``device_put`` straight off the buffer (the single copy
+        the hardware requires), skipping the device-side cast when the
+        view already carries the target dtype."""
+        from seldon_core_tpu.codec.device import to_device
+
+        return to_device(self.array(), sharding=sharding, dtype=dtype)
+
+    def __len__(self) -> int:
+        if not self.shape:
+            raise TypeError("len() of a 0-d BufferView")
+        return self.shape[0]
+
+    def __repr__(self) -> str:
+        tag = "copied" if self.copied else "zero-copy"
+        return f"BufferView({self.dtype.name}, shape={self.shape}, {tag}, {self.nbytes}B)"
+
+
+# ---------------------------------------------------------------------------
+# SRT1 frame codec
+# ---------------------------------------------------------------------------
+
+
+def is_frame(data: Union[bytes, memoryview]) -> bool:
+    """Cheap sniff: does ``data`` start with the SRT1 magic?  (A JSON or
+    proto body cannot — 'S' would need to open a JSON document.)"""
+    return len(data) >= 8 and bytes(memoryview(data)[:4]) == _MAGIC_BYTES
+
+
+def frame_header(dtype: np.dtype, shape: Sequence[int]) -> bytes:
+    """The ``8 + 8*ndim``-byte SRT1 header for one payload."""
+    shape = tuple(int(d) for d in shape)
+    if len(shape) > MAX_NDIM:
+        raise PayloadError(f"SRT1 frames carry at most {MAX_NDIM} dims, got {len(shape)}")
+    head = struct.pack("<IBBH", SRT1_MAGIC, dtype_code(dtype), len(shape), 0)
+    return head + struct.pack(f"<{len(shape)}q", *shape)
+
+
+def pack_frame(payload: Union[np.ndarray, BufferView]) -> bytes:
+    """Encode one array / view as an SRT1 frame (header + payload).
+    Big-endian sources are byteswapped — the wire is little-endian by
+    contract, whatever the producer's byte order."""
+    arr = payload.array() if isinstance(payload, BufferView) else np.asarray(payload)
+    arr = ensure_little_endian(arr)
+    if not arr.flags["C_CONTIGUOUS"]:
+        arr = np.ascontiguousarray(arr)
+    return frame_header(arr.dtype, arr.shape) + arr.tobytes()
+
+
+def _parse_header(mv: memoryview, offset: int) -> Tuple[np.dtype, Tuple[int, ...], int, int]:
+    """Validate one frame header at ``offset``: returns
+    ``(dtype, shape, payload_offset, payload_bytes)``.  Malformed
+    headers raise :class:`PayloadError` naming the defect and its byte
+    offset — never a bare struct/numpy error."""
+    if len(mv) - offset < 8:
+        raise PayloadError(
+            f"truncated SRT1 frame: {len(mv) - offset} bytes at offset "
+            f"{offset} (header needs 8)"
+        )
+    magic, code, ndim, _flags = struct.unpack_from("<IBBH", mv, offset)
+    if magic != SRT1_MAGIC:
+        raise PayloadError(f"bad SRT1 magic 0x{magic:08x} at offset {offset}")
+    if code >= len(SRT1_DTYPES):
+        raise PayloadError(f"unknown SRT1 dtype code {code} at offset {offset + 4}")
+    if ndim > MAX_NDIM:
+        raise PayloadError(f"SRT1 ndim {ndim} exceeds {MAX_NDIM} at offset {offset + 5}")
+    shape_off = offset + 8
+    if len(mv) < shape_off + 8 * ndim:
+        raise PayloadError(
+            f"truncated SRT1 shape: frame ends inside the {ndim}-dim "
+            f"shape block at offset {shape_off}"
+        )
+    shape = struct.unpack_from(f"<{ndim}q", mv, shape_off)
+    if any(d < 0 for d in shape):
+        raise PayloadError(f"negative SRT1 dimension in {shape} at offset {shape_off}")
+    # exact python-int product + the same ceiling native/codec.cc
+    # enforces (kMaxElems): overflow-crafted shapes fail HERE as a
+    # named validation error, byte-for-byte with srt1_payload_bytes
+    # (per-dim cap included, so a [huge, 0] shape rejects identically)
+    if any(d > MAX_ELEMS for d in shape):
+        raise PayloadError(
+            f"SRT1 dimension over the {MAX_ELEMS} framing ceiling in "
+            f"{tuple(shape)} at offset {shape_off}"
+        )
+    elems = math.prod(shape) if ndim else 1
+    if elems > MAX_ELEMS:
+        raise PayloadError(
+            f"SRT1 shape {tuple(shape)} at offset {shape_off} holds "
+            f"{elems} elements, over the {MAX_ELEMS} framing ceiling"
+        )
+    payload_off = shape_off + 8 * ndim
+    dt = np_dtype(SRT1_DTYPES[code])
+    return dt, tuple(shape), payload_off, elems * dt.itemsize
+
+
+def unpack_frame(data: Union[bytes, memoryview], offset: int = 0) -> BufferView:
+    """Decode one SRT1 frame into a :class:`BufferView` over ``data``
+    (zero copy — the view's buffer IS the frame's payload region).
+    The frame must consume the whole buffer; multi-tensor bodies use
+    :func:`unpack_frames`."""
+    mv = _byte_view(data)
+    dt, shape, payload_off, need = _parse_header(mv, offset)
+    avail = len(mv) - payload_off
+    if avail != need:
+        raise PayloadError(
+            f"SRT1 payload at offset {payload_off} carries {avail} bytes "
+            f"but shape {shape} {dt.name} needs {need}"
+        )
+    return BufferView(dt, shape, mv, offset=payload_off)
+
+
+def pack_frames(payloads: Sequence[Union[np.ndarray, BufferView]]) -> bytes:
+    """The multi-tensor container: N frames back to back, each padded
+    to an 8-byte boundary so every payload stays aligned whatever the
+    preceding frame's byte length (int8/bf16 tails are not multiples
+    of 8).  One frame is byte-identical to :func:`pack_frame`."""
+    if not payloads:
+        raise PayloadError("pack_frames needs at least one payload")
+    frames = [pack_frame(p) for p in payloads]
+    parts = []
+    for i, frame in enumerate(frames):
+        parts.append(frame)
+        # pad BETWEEN frames only: each (frame + pad) block is a
+        # multiple of 8, so every subsequent frame starts aligned
+        pad = -len(frame) % 8
+        if pad and i < len(frames) - 1:
+            parts.append(b"\x00" * pad)
+    return b"".join(parts)
+
+
+def unpack_frames(data: Union[bytes, memoryview]) -> list:
+    """Decode a multi-frame container into zero-copy views (8-byte
+    alignment padding between frames per :func:`pack_frames`; trailing
+    padding after the last frame is tolerated)."""
+    mv = _byte_view(data)
+    views = []
+    offset = 0
+    while offset < len(mv):
+        dt, shape, payload_off, need = _parse_header(mv, offset)
+        if payload_off + need > len(mv):
+            raise PayloadError(
+                f"SRT1 payload at offset {payload_off} needs {need} bytes "
+                f"but the container ends at {len(mv)}"
+            )
+        views.append(BufferView(dt, shape, mv, offset=payload_off))
+        offset = payload_off + need
+        pad = -offset % 8
+        tail = bytes(mv[offset:offset + pad])
+        if tail.strip(b"\x00"):
+            raise PayloadError(
+                f"non-zero inter-frame padding at offset {offset} "
+                "(frames must be 8-byte aligned; see pack_frames)"
+            )
+        if len(tail) < pad:
+            break  # final frame: trailing pad absent at container end
+        offset += pad
+    if not views:
+        raise PayloadError("empty SRT1 container")
+    return views
+
+
+def stack_views(
+    views: Sequence[Union[BufferView, np.ndarray]],
+    dtype: Optional[np.dtype] = None,
+) -> Tuple[np.ndarray, list]:
+    """Stack N row-batched views ``[rows_i, *tail]`` into ONE contiguous
+    micro-batch + the per-view row offsets (for splitting outputs).
+
+    One allocation, one copy pass (the ``device_put`` staging buffer);
+    a single view that already forms the whole batch passes through
+    with NO copy at all.  Views must agree on dtype and trailing shape.
+    """
+    if not views:
+        raise PayloadError("stack_views needs at least one view")
+    arrs = [v.array() if isinstance(v, BufferView) else np.asarray(v) for v in views]
+    tail = arrs[0].shape[1:]
+    dt = dtype or arrs[0].dtype
+    for i, a in enumerate(arrs):
+        if a.ndim < 1 or a.shape[1:] != tail or a.dtype != dt:
+            raise PayloadError(
+                f"view {i} ({a.dtype.name}{a.shape}) does not stack with "
+                f"view 0 ({dt.name}[rows, {', '.join(map(str, tail))}])"
+            )
+    offsets = [0]
+    for a in arrs:
+        offsets.append(offsets[-1] + a.shape[0])
+    if len(arrs) == 1:
+        return arrs[0], offsets
+    batch = np.empty((offsets[-1], *tail), dtype=dt)
+    for a, start in zip(arrs, offsets):
+        batch[start:start + a.shape[0]] = a
+    return batch, offsets

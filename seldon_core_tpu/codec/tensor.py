@@ -65,11 +65,30 @@ def tensor_to_array(tensor: pb.Tensor) -> np.ndarray:
 
 
 def raw_tensor_to_array(raw: pb.RawTensor) -> np.ndarray:
-    """Zero-copy decode of the RawTensor fast path."""
+    """Zero-copy decode of the RawTensor fast path.
+
+    Malformed payloads raise :class:`PayloadError` naming the byte
+    counts precisely — a byte blob that does not divide into whole
+    elements, or a shape the element count cannot fill, must surface as
+    a 400-shaped codec error, never a bare numpy ValueError."""
     dtype = np_dtype(raw.dtype or "float32")
+    nbytes = len(raw.data)
+    if nbytes % dtype.itemsize:
+        raise PayloadError(
+            f"misaligned rawTensor payload: {nbytes} bytes is not a "
+            f"multiple of {dtype.name} itemsize {dtype.itemsize} "
+            f"(offset {nbytes - nbytes % dtype.itemsize} starts a "
+            "partial element)"
+        )
     arr = np.frombuffer(raw.data, dtype=dtype)
     shape = tuple(raw.shape)
     if shape:
+        expect = int(np.prod(shape, dtype=np.int64))
+        if expect != arr.size:
+            raise PayloadError(
+                f"rawTensor shape {shape} needs {expect} {dtype.name} "
+                f"elements but the payload carries {arr.size}"
+            )
         arr = arr.reshape(shape)
     return arr
 
@@ -117,8 +136,26 @@ def array_to_tensor(arr: np.ndarray) -> pb.Tensor:
     return pb.Tensor(shape=list(arr.shape), values=arr.ravel().tolist())
 
 
+def ensure_little_endian(arr: np.ndarray) -> np.ndarray:
+    """The wire contract is little-endian regardless of the producing
+    array's byte order: a big-endian source is byteswapped here (its
+    ``dtype.name`` drops the byte order, so emitting native bytes under
+    the LE label would decode as garbage, not as an error)."""
+    import sys
+
+    if arr.dtype.byteorder == ">" or (
+        arr.dtype.byteorder == "=" and sys.byteorder == "big"
+    ):
+        return arr.astype(arr.dtype.newbyteorder("<"))
+    return arr
+
+
 def array_to_raw_tensor(arr: np.ndarray) -> pb.RawTensor:
-    arr = np.ascontiguousarray(arr)
+    arr = ensure_little_endian(np.asarray(arr))
+    if not arr.flags["C_CONTIGUOUS"]:
+        # only strided/transposed inputs pay the compaction copy;
+        # tobytes() on a contiguous array is the single wire copy
+        arr = np.ascontiguousarray(arr)
     return pb.RawTensor(
         shape=list(arr.shape), dtype=arr.dtype.name, data=arr.tobytes()
     )

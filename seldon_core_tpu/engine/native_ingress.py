@@ -176,6 +176,57 @@ class _DeploymentGrpcHandler:
         from seldon_core_tpu.runtime.message import InternalFeedback, InternalMessage
 
         try:
+            if path == "/seldon.protos.Seldon/PredictRaw":
+                # zero-copy h2c lane: the gRPC message IS one SRT1 frame
+                # (gRPC's own length-prefixed framing delimits it) — no
+                # proto parse anywhere on the request path; the reply is
+                # the response frame.  Gated like the HTTP frame lane.
+                from seldon_core_tpu import codec
+
+                if not codec.zero_copy_enabled():
+                    return 12, ("PredictRaw needs SELDON_TPU_ZERO_COPY=1; "
+                                "use Seldon/Predict"), b""
+                import numpy as np
+
+                try:
+                    views = codec.unpack_frames(body)
+                except codec.PayloadError as e:
+                    return 3, str(e)[:200], b""
+                if len(views) > 1:
+                    # multi-frame container = the batched-submission
+                    # surface (same eligibility rule as the HTTP lane:
+                    # single-local-MODEL, no shadows/splits)
+                    fast = None
+                    if len(self.gateway.entries) == 1 and not self.gateway.shadows:
+                        fast = self.gateway.entries[0][0].single_local_model()
+                    raw_views = getattr(fast[1], "raw_batch_views", None) if fast else None
+                    if raw_views is None:
+                        return 3, ("multi-frame containers need a "
+                                   "single-local-MODEL predictor with "
+                                   "raw_batch_views"), b""
+                    try:
+                        return 0, "", codec.pack_frames(raw_views(views))
+                    except codec.PayloadError as e:
+                        # container shape/dtype mismatch is the CLIENT's
+                        # fault — INVALID_ARGUMENT, matching the HTTP
+                        # twin's 400 for the identical body
+                        return 3, str(e)[:200], b""
+                msg = InternalMessage(payload=views[0], kind="rawTensor")
+                out = asyncio.run_coroutine_threadsafe(
+                    self.gateway.predict(msg), self.loop
+                ).result(timeout=120.0)
+                if out.status and out.status.get("status") == "FAILURE":
+                    code = int(out.status.get("code", 500) or 500)
+                    return (3 if 400 <= code < 500 else 13), str(
+                        out.status.get("info", "engine failure")
+                    ), b""
+                try:
+                    return 0, "", codec.pack_frame(np.asarray(out.host_payload()))
+                except codec.PayloadError as e:
+                    # healthy answer, un-frameable dtype (strings): the
+                    # frame-only lane cannot express it — point the
+                    # client at the full-contract method
+                    return 3, f"response not frameable ({e}); use Seldon/Predict", b""
             if path == "/seldon.protos.Seldon/Predict":
                 msg = InternalMessage.from_proto(pb.SeldonMessage.FromString(body))
                 fut = asyncio.run_coroutine_threadsafe(

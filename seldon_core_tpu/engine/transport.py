@@ -98,8 +98,9 @@ class _Hop:
     decomposition.  Constructing one is cheap when telemetry is off."""
 
     __slots__ = (
-        "unit", "method", "transport", "t0",
-        "serialize_s", "request_bytes", "response_bytes", "retries", "_gauge",
+        "unit", "method", "transport", "t0", "serialize_s",
+        "request_bytes", "response_bytes", "zero_copy_bytes", "retries",
+        "_gauge",
     )
 
     def __init__(self, unit: str, method: str, transport: str):
@@ -107,6 +108,10 @@ class _Hop:
         self.serialize_s = 0.0
         self.request_bytes = 0
         self.response_bytes = 0
+        # bytes passed BY REFERENCE (buffer views / device handles on
+        # the local lane) vs request/response_bytes, which are COPIED
+        # through a wire codec — the zero-copy-vs-copied split
+        self.zero_copy_bytes = 0
         self.retries = 0
         self._gauge = _metrics.transport_inflight(unit, method, transport)
         if self._gauge is not None:
@@ -131,6 +136,7 @@ class _Hop:
             self.unit, self.method, self.transport,
             request_bytes=self.request_bytes,
             response_bytes=self.response_bytes,
+            zero_copy_bytes=self.zero_copy_bytes,
             serialize_seconds=self.serialize_s,
             network_seconds=network_s,
             retries=self.retries,
@@ -144,6 +150,8 @@ class _Hop:
                 span.tags["response_bytes"] = self.response_bytes
                 span.tags["serialize_ms"] = round(self.serialize_s * 1000.0, 3)
                 span.tags["network_ms"] = round(network_s * 1000.0, 3)
+            if self.zero_copy_bytes:
+                span.tags["zero_copy_bytes"] = self.zero_copy_bytes
             if self.retries:
                 span.tags["retries"] = self.retries
             if error:
@@ -459,7 +467,23 @@ class LocalClient(NodeClient):
         if meta is not None:
             _tracing.inject(meta.trace_context)
 
-    async def _invoke(self, method: str, factory: Callable[[], Any]):
+    @staticmethod
+    def _ref_bytes(msg: Any) -> int:
+        """Payload bytes this hop passes BY REFERENCE: buffer views and
+        device-resident arrays cross the local lane as handles, never
+        through a codec — the `zero_copy_bytes` share of `_Hop` (the
+        remote lanes' request/response_bytes are the COPIED share)."""
+        from seldon_core_tpu.codec import BufferView, is_device_array
+
+        total = 0
+        for m in (msg if isinstance(msg, list) else [msg]):
+            payload = getattr(m, "payload", None)
+            if isinstance(payload, BufferView) or is_device_array(payload):
+                total += int(getattr(payload, "nbytes", 0) or 0)
+        return total
+
+    async def _invoke(self, method: str, factory: Callable[[], Any],
+                      msg: Any = None):
         # spent budget: fail before dispatch — the model must never see
         # a request its caller has already abandoned
         _deadlines.check(f"node {self.unit.name!r} {method} (local)")
@@ -467,6 +491,11 @@ class LocalClient(NodeClient):
         # acquire raises the 503 CIRCUIT_OPEN fast-fail itself)
         call = _BreakerCall(self.breaker, self.unit.name, method, "local")
         hop = _Hop(self.unit.name, method, "local")
+        if hop._gauge is not None and msg is not None:
+            # lazy: the isinstance/nbytes walk only runs when telemetry
+            # is ON (the gauge child existing is exactly that signal) —
+            # off-path local hops stay as cheap as before the lane
+            hop.zero_copy_bytes = self._ref_bytes(msg)
         ok = False
         healthy: Optional[bool] = False
         try:
@@ -493,11 +522,13 @@ class LocalClient(NodeClient):
         # (reference: InternalPredictionService.java transformInput routing).
         if self.unit.type == MODEL:
             return await self._invoke(
-                "predict", lambda: dispatch.predict_async(self.component, msg)
+                "predict", lambda: dispatch.predict_async(self.component, msg),
+                msg=msg,
             )
         return await self._invoke(
             "transform_input",
             lambda: self._run(dispatch.transform_input, self.component, msg),
+            msg=msg,
         )
 
     async def transform_output(self, msg: InternalMessage) -> InternalMessage:
@@ -505,18 +536,21 @@ class LocalClient(NodeClient):
         return await self._invoke(
             "transform_output",
             lambda: self._run(dispatch.transform_output, self.component, msg),
+            msg=msg,
         )
 
     async def route(self, msg: InternalMessage) -> InternalMessage:
         self._inject_meta(msg)
         return await self._invoke(
-            "route", lambda: self._run(dispatch.route, self.component, msg)
+            "route", lambda: self._run(dispatch.route, self.component, msg),
+            msg=msg,
         )
 
     async def aggregate(self, msgs: List[InternalMessage]) -> InternalMessage:
         self._inject_meta(msgs)
         return await self._invoke(
-            "aggregate", lambda: self._run(dispatch.aggregate, self.component, msgs)
+            "aggregate", lambda: self._run(dispatch.aggregate, self.component, msgs),
+            msg=msgs,
         )
 
     async def send_feedback(self, feedback: InternalFeedback) -> InternalMessage:

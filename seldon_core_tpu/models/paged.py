@@ -2138,6 +2138,45 @@ class PagedEngine:
             self._queued.add(stream)
         return stream
 
+    def submit_views(self, views, **kwargs) -> List["_Stream"]:
+        """Batched submission front for the zero-copy lane: N token
+        buffer views (1-D int32 — ``np.frombuffer`` windows over the
+        ingress byte buffers, no python-list or proto round-trip) are
+        decoded zero-copy and admitted in one pass.  Each stream keeps
+        EXACTLY :meth:`submit`'s semantics — validation, queue-bound
+        shedding, priority admission, deadline fast-fail — so the SLO
+        path (r10) sees no behaviour change; the batching only amortises
+        the per-request python marshalling.
+
+        ``kwargs`` apply to every view (per-request settings: call
+        :meth:`submit` directly).  Admission is all-or-nothing: when a
+        later view's admission raises (SEQUENCE_TOO_LONG, deadline
+        fast-fail, SHED), every stream already admitted by this call is
+        cancelled before the error surfaces — otherwise they would
+        decode tokens nobody holds a handle to.
+        """
+        from seldon_core_tpu.codec.bufview import BufferView
+
+        prompts = []
+        for v in views:
+            arr = v.array() if isinstance(v, BufferView) else np.asarray(v)
+            if arr.dtype != np.int32:
+                arr = arr.astype(np.int32, copy=False)
+            prompts.append(arr.reshape(-1))
+        admitted: List[_Stream] = []
+        try:
+            for p in prompts:
+                admitted.append(self.submit(p, **kwargs))
+        except BaseException:
+            for s in admitted:
+                try:
+                    self.cancel(s)
+                except Exception:  # noqa: BLE001 — rollback is best-effort;
+                    # the admission error below is the one the caller acts on
+                    logger.exception("submit_views rollback cancel failed")
+            raise
+        return admitted
+
     # ---- refcounted page allocator + prefix cache (r9) --------------------
 
     def _allocatable_locked(self) -> int:

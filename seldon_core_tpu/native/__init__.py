@@ -137,10 +137,20 @@ def _load(so: str) -> Optional[ctypes.CDLL]:
             ctypes.POINTER(ctypes.c_char_p), ctypes.POINTER(ctypes.c_int64), ctypes.c_int64,
             ctypes.c_int64, ctypes.c_int64, ctypes.c_char_p,
         ]
-        if lib.native_abi_version() != 2:  # not assert: must survive python -O
+        # v3 added the srt1_* framing-agreement surface (zero-copy lane)
+        if lib.native_abi_version() != 3:  # not assert: must survive python -O
             raise RuntimeError(
                 "stale libseldon_tpu_native.so (ABI mismatch): rebuild with `make -C native`"
             )
+        lib.srt1_item_size.restype = ctypes.c_int64
+        lib.srt1_item_size.argtypes = [ctypes.c_int32]
+        lib.srt1_header_bytes.restype = ctypes.c_int64
+        lib.srt1_header_bytes.argtypes = [ctypes.c_int32]
+        lib.srt1_magic.restype = ctypes.c_uint32
+        lib.srt1_payload_bytes.restype = ctypes.c_int64
+        lib.srt1_payload_bytes.argtypes = [
+            ctypes.POINTER(ctypes.c_uint8), ctypes.c_int64,
+        ]
         logger.info("native data-plane core loaded from %s", so)
         return lib
     except Exception as e:  # noqa: BLE001 — missing native core degrades

@@ -405,6 +405,84 @@ class GatewayRawHandler:
             return json.loads(query["json"][0])
         raise ValueError("empty request body")
 
+    # request targets eligible for the buffer-view (SRT1) lane
+    _PREDICT_PATHS = ("/api/v0.1/predictions", "/api/v1.0/predictions", "/predict")
+
+    def _frame_lane_service(self, predictor):
+        """The predictor eligible for the loop-free frame paths, or
+        None (shadows / traffic splits / named-predictor routing keep
+        full gateway semantics)."""
+        if predictor is None and len(self.gateway.entries) == 1 \
+                and not self.gateway.shadows:
+            return self.gateway.entries[0][0]
+        return None
+
+    def _predict_raw_frame(self, body: bytes, predictor) -> Tuple[int, str, bytes]:
+        """The zero-copy lane: an SRT1 frame body decodes to a
+        :class:`~seldon_core_tpu.codec.BufferView` over the ingress
+        bytes (no JSON/proto parse, no python lists, no float64
+        widening), rides the engine as a by-reference payload, and the
+        response array leaves as an SRT1 frame.  Full engine semantics
+        — deadlines, breakers, tracing, shedding — are untouched: only
+        the payload codec changed.  Errors keep the JSON status shape
+        (clients tell the lanes apart by Content-Type, exactly like the
+        C++ fast lane).
+
+        A MULTI-frame container (``pack_frames``: N tensors, 8-byte
+        aligned) is the batched-submission surface: the whole container
+        goes through ``raw_batch_views`` as ONE stacked micro-batch —
+        per-request engine bookkeeping is bypassed exactly like the
+        in-C++ fast lane, so it is only served for single-local-MODEL
+        deployments — and the reply is the response container."""
+        import asyncio
+
+        from seldon_core_tpu import codec
+        from seldon_core_tpu.engine.server import _http_status
+        from seldon_core_tpu.runtime.message import InternalMessage
+
+        views = codec.unpack_frames(body)
+        svc = self._frame_lane_service(predictor)
+        fast = svc.single_local_model() if svc is not None else None
+        if len(views) > 1:
+            raw_views = getattr(fast[1], "raw_batch_views", None) if fast else None
+            if raw_views is None:
+                return 400, "application/json", json.dumps(
+                    {"status": {"status": "FAILURE", "code": 400,
+                                "info": "multi-frame containers need a "
+                                        "single-local-MODEL predictor with "
+                                        "raw_batch_views; send one frame "
+                                        "per request",
+                                "reason": "BAD_REQUEST"}}
+                ).encode()
+            outs = raw_views(views)
+            return 200, "application/x-seldon-raw", codec.pack_frames(outs)
+        msg = InternalMessage(payload=views[0], kind="rawTensor")
+        if fast is not None:
+            # single-local-MODEL deployment: run the graph ON this C++
+            # raw-worker thread (predict_sync — the sync gRPC server's
+            # fast path), so the frame lane never crosses the event
+            # loop.  Shadows / traffic splits / multi-node graphs take
+            # the full async gateway below.
+            out = svc.predict_sync(msg)
+        else:
+            out = asyncio.run_coroutine_threadsafe(
+                self.gateway.predict(msg, predictor=predictor), self.loop
+            ).result(timeout=60)
+        status = _http_status(out)
+        payload = out.host_payload()
+        if status < 400 and payload is not None and not isinstance(
+            payload, (bytes, str, dict)
+        ):
+            try:
+                return status, "application/x-seldon-raw", codec.pack_frame(
+                    np.asarray(payload)
+                )
+            except codec.PayloadError:
+                # a healthy answer whose dtype has no SRT1 code
+                # (strings/objects): degrade to the JSON reply below
+                pass
+        return status, "application/json", json.dumps(out.to_json()).encode()
+
     def __call__(self, method: str, path: str, body: bytes) -> Tuple[int, str, bytes]:
         import asyncio
         from urllib.parse import parse_qs, urlsplit
@@ -418,6 +496,24 @@ class GatewayRawHandler:
             path = split.path
             query = parse_qs(split.query)
             predictor = (query.get("predictor") or [None])[0]
+            if (
+                method == "POST"
+                and path in self._PREDICT_PATHS
+                and body[:4] == b"SRT1"
+            ):
+                from seldon_core_tpu.codec import bufview
+
+                if bufview.zero_copy_enabled():
+                    return self._predict_raw_frame(body, predictor)
+                # lane off: the frame is not a JSON body — reject it the
+                # way the JSON parser would, naming the remedy
+                return 400, "application/json", json.dumps(
+                    {"status": {"status": "FAILURE", "code": 400,
+                                "info": "SRT1 frame received but "
+                                        "SELDON_TPU_ZERO_COPY=0 — send a "
+                                        "JSON SeldonMessage",
+                                "reason": "BAD_REQUEST"}}
+                ).encode()
             if path in ("/pause", "/unpause") and method in ("POST", "PUT"):
                 # synchronous flag flips; we are already off the loop on a
                 # C++ raw-worker thread, so call directly
@@ -462,18 +558,14 @@ class GatewayRawHandler:
 
 
 def pack_raw_frame(arr: np.ndarray) -> bytes:
-    """Encode an array as the binary raw-tensor frame (SRT1)."""
-    dtype_codes = {np.dtype(np.float32): 0, np.dtype(np.uint8): 1,
-                   np.dtype(np.int32): 2, np.dtype(np.float64): 3}
-    arr = np.ascontiguousarray(arr)
-    code = dtype_codes.get(arr.dtype)
-    if code is None:
-        raise ValueError(f"raw frame does not support dtype {arr.dtype}")
-    import struct
+    """Encode an array as the binary raw-tensor frame (SRT1).
 
-    head = struct.pack("<IBBH", 0x31545253, code, arr.ndim, 0)
-    shape = struct.pack(f"<{arr.ndim}q", *arr.shape)
-    return head + shape + arr.tobytes()
+    Delegates to the buffer-view codec — ONE framing implementation
+    (codec/bufview.py) shared with the zero-copy lane, so the C++
+    parser, the load clients and the Python lane cannot drift."""
+    from seldon_core_tpu.codec import bufview
+
+    return bufview.pack_frame(np.asarray(arr))
 
 
 def native_load(
@@ -649,13 +741,8 @@ def read_http_response(sock, buf: bytes, timeout_s: Optional[float] = None):
 
 
 def unpack_raw_frame(data: bytes) -> np.ndarray:
-    """Decode a binary raw-tensor frame (SRT1) into an array."""
-    import struct
+    """Decode a binary raw-tensor frame (SRT1) into an array (a
+    zero-copy view over ``data`` — see codec/bufview.py)."""
+    from seldon_core_tpu.codec import bufview
 
-    magic, code, ndim, _ = struct.unpack_from("<IBBH", data, 0)
-    if magic != 0x31545253:
-        raise ValueError("bad raw frame magic")
-    dtypes = [np.float32, np.uint8, np.int32, np.float64]
-    shape = struct.unpack_from(f"<{ndim}q", data, 8)
-    off = 8 + 8 * ndim
-    return np.frombuffer(data, dtype=dtypes[code], offset=off).reshape(shape)
+    return bufview.unpack_frame(data).array()

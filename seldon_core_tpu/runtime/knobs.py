@@ -132,6 +132,11 @@ ENV_KNOBS: Dict[str, Knob] = _knobs(
          "native ingress raw/gRPC fallback worker count",
          "architecture.md §9"),
     # ---- transport / telemetry -------------------------------------------
+    Knob("SELDON_TPU_ZERO_COPY", "flag", "1", True,
+         "buffer-view SeldonMessage lane: SRT1 frames decode to zero-copy "
+         "views from native ingress to device buffers (0 = proto/JSON "
+         "path only, behaviour-identical to the pre-lane engine)",
+         "architecture.md §9a"),
     Knob("SELDON_TPU_BREAKER", "flag", "1", True,
          "per-endpoint circuit breakers (0 = off; breaker-off is "
          "byte-identical to the pre-breaker transport)",

@@ -172,9 +172,14 @@ class InternalMessage:
     # ---- exporters --------------------------------------------------------
 
     def host_payload(self) -> Any:
-        """Payload with any device array fetched back to host."""
+        """Payload with any device array fetched back to host.  A
+        buffer-view payload materialises as its ndarray VIEW (no copy)
+        — so the proto/JSON exporters degrade a zero-copy message to
+        the ordinary wire encodings without special-casing."""
         if codec.is_device_array(self.payload):
             return codec.from_device(self.payload)
+        if isinstance(self.payload, codec.BufferView):
+            return self.payload.array()
         return self.payload
 
     def array(self) -> np.ndarray:

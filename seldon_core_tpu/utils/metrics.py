@@ -438,6 +438,10 @@ TRANSPORT_METRICS: Dict[str, Tuple[str, str, str]] = {
                       "serialized request payload bytes put on the wire"),
     "response_bytes": ("counter", "seldon_tpu_transport_response_bytes_total",
                        "serialized response payload bytes read off the wire"),
+    "zero_copy_bytes": ("counter", "seldon_tpu_transport_zero_copy_bytes_total",
+                        "payload bytes passed BY REFERENCE on co-located "
+                        "hops (buffer views / device handles) — the bytes "
+                        "the zero-copy lane did NOT re-encode"),
     "serialize_seconds": ("histogram", "seldon_tpu_transport_serialize_seconds",
                           "encode+decode (codec) share of one hop"),
     "network_seconds": ("histogram", "seldon_tpu_transport_network_seconds",
@@ -503,6 +507,7 @@ def record_transport_hop(
     *,
     request_bytes: int = 0,
     response_bytes: int = 0,
+    zero_copy_bytes: int = 0,
     serialize_seconds: float = 0.0,
     network_seconds: float = 0.0,
     retries: int = 0,
@@ -524,6 +529,8 @@ def record_transport_hop(
             hop.request_bytes.inc(request_bytes)
         if response_bytes > 0:
             hop.response_bytes.inc(response_bytes)
+        if zero_copy_bytes > 0:
+            hop.zero_copy_bytes.inc(zero_copy_bytes)
         if transport != "local":
             # the local transport has no codec or wire share by design
             # (device payloads pass by handle); observing constant 0.0

@@ -79,7 +79,15 @@ def _r3_like_full_result():
                 "dropped_orphans": 1,
                 "vs_python_lane": 1.2,
             },
-            "native_model_qps": 12.0,
+            "zero_copy": {
+                "native_model_qps": 9500.0,
+                "zero_copy_off_qps": 3100.0,
+                "zero_copy_x": 3.06,
+                "bit_exact": True,
+                "mix": "1x16 int8 (extension wire dtype -> python lane), "
+                       "single-MODEL mlp, 8 conns x depth 4, C++ load "
+                       "client, best-of-3 windows/side",
+            },
             "stub_engine_qps": 18687.0,
             "stub_vs_reference_grpc": 0.661,
             "native_front_qps": 112147.8,
@@ -340,6 +348,24 @@ def test_compact_line_carries_chaos_story(bench):
     # raw counters + breaker dump + mix are full-blob-only
     assert "hedges_fired" not in e
     assert "dead_endpoint_breaker" not in e
+    assert "mix" not in e
+
+
+def test_compact_line_carries_zero_copy_story(bench):
+    """r14 certification keys (ROADMAP 4): the small-tensor
+    native→model qps through the python buffer-view lane (gate >= 0.5 x
+    stub_qps) and the lane-on/lane-off ratio (gate >= 2.0, outputs
+    bit-exact both lanes); the off-arm rate and the mix string stay in
+    bench_full.json."""
+    compact = bench._compact_result(_r3_like_full_result())
+    e = compact["extra"]
+    assert isinstance(e["native_model_qps"], float)
+    assert e["native_model_qps"] == 9500.0
+    assert isinstance(e["zero_copy_x"], float)
+    assert e["zero_copy_x"] == 3.06
+    # raw contrast arm + provenance are full-blob-only
+    assert "zero_copy_off_qps" not in e
+    assert "bit_exact" not in e
     assert "mix" not in e
 
 

@@ -59,6 +59,94 @@ class TestRawTensor:
         msg64 = codec.build_message(np.ones((2, 2), dtype=np.float64))
         assert codec.message_data_kind(msg64) == "tensor"
 
+    # ---- r14 property-style matrix: dtype x shape round-trips -------------
+
+    DTYPES = ["float32", "int8", "bfloat16", "float16", "int64", "uint16"]
+    SHAPES = [
+        (),            # 0-d scalar
+        (0,),          # empty
+        (1,),
+        (3, 5),
+        (2, 3, 4, 5),
+        (1, 65536),    # large-ish flat row
+    ]
+
+    @pytest.mark.parametrize("dtype", DTYPES)
+    @pytest.mark.parametrize("shape", SHAPES)
+    def test_matrix_roundtrip_bit_exact(self, dtype, shape):
+        np_dt = codec.np_dtype(dtype)
+        n = int(np.prod(shape)) if shape else 1
+        src = (np.arange(n) % 120 + 1).astype(np_dt).reshape(shape)
+        msg = codec.build_message(src, data_type="rawTensor")
+        wire = msg.SerializeToString()
+        out = codec.get_data_from_proto(pb.SeldonMessage.FromString(wire))
+        assert out.dtype == np_dt
+        # the proto rawTensor's repeated shape cannot express 0-d (an
+        # empty shape list means "flat"), so scalars degrade to (1,) on
+        # THIS wire; the SRT1 frame lane round-trips 0-d exactly
+        # (tests/test_zero_copy.py)
+        assert out.shape == (tuple(shape) if shape else (1,))
+        # bit-exact: compare the raw little-endian bytes, not values
+        # (NaN-safe, bf16-safe)
+        assert out.tobytes() == src.tobytes()
+
+    def test_wire_bytes_are_little_endian(self):
+        # the framing agreement promises little-endian on the wire
+        # regardless of the producing array's byte order: a big-endian
+        # SOURCE array must be byteswapped at encode, not emitted raw
+        # under the LE dtype label
+        be = np.arange(4, dtype=">i4")
+        msg = codec.build_message(be, data_type="rawTensor")
+        assert msg.data.rawTensor.data == np.arange(4, dtype="<i4").tobytes()
+        out = codec.get_data_from_proto(msg)
+        np.testing.assert_array_equal(out, [0, 1, 2, 3])
+
+    def test_big_endian_floats_roundtrip_values(self):
+        be = np.array([1.5, -2.25], dtype=">f8")
+        out = codec.raw_tensor_to_array(codec.array_to_raw_tensor(be))
+        np.testing.assert_array_equal(out, [1.5, -2.25])
+
+    def test_decode_over_wire_is_view_not_copy(self):
+        # the zero-copy invariant: the decoded array is a frombuffer
+        # VIEW over a payload buffer (read-only, base chain rooted in
+        # the bytes object), never a materialised copy
+        arr = np.arange(32, dtype=np.float32).reshape(4, 8)
+        msg2 = pb.SeldonMessage.FromString(
+            codec.build_message(arr, data_type="rawTensor").SerializeToString()
+        )
+        out = codec.get_data_from_proto(msg2)
+        assert not out.flags.writeable  # frombuffer over immutable bytes
+        root = out
+        while getattr(root, "base", None) is not None:
+            root = root.base
+        assert isinstance(root, (bytes, memoryview, np.ndarray))
+        np.testing.assert_array_equal(out, arr)
+
+    def test_non_contiguous_encode_only_copies_when_needed(self):
+        base = np.arange(24, dtype=np.float32).reshape(4, 6)
+        strided = base[:, ::2]
+        assert not strided.flags["C_CONTIGUOUS"]
+        rt = codec.array_to_raw_tensor(strided)
+        np.testing.assert_array_equal(
+            np.frombuffer(rt.data, np.float32).reshape(4, 3), strided
+        )
+        # a contiguous array round-trips its exact bytes
+        rt2 = codec.array_to_raw_tensor(base)
+        assert rt2.data == base.tobytes()
+
+    def test_misaligned_payload_raises_precise_payload_error(self):
+        rt = pb.RawTensor(shape=[2], dtype="float32", data=b"\x00" * 7)
+        with pytest.raises(codec.PayloadError) as e:
+            codec.raw_tensor_to_array(rt)
+        # names the byte count, the dtype and the offending offset
+        assert "7 bytes" in str(e.value) and "float32" in str(e.value)
+
+    def test_shape_element_mismatch_raises_payload_error(self):
+        rt = pb.RawTensor(shape=[3, 3], dtype="float32", data=b"\x00" * 16)
+        with pytest.raises(codec.PayloadError) as e:
+            codec.raw_tensor_to_array(rt)
+        assert "(3, 3)" in str(e.value) and "9" in str(e.value)
+
 
 class TestNdarray:
     def test_numeric(self):

@@ -124,9 +124,14 @@ class TestSeededFixtures:
         assert ("GL403", "seldon_tpu_engine_bad_name") in pairs
         assert ("GL403", "transport_requests_total") in pairs
         assert ("GL404", "ghost_slo_key") in pairs
+        # a record_transport_hop measurement kwarg with no metric mapping
+        assert ("GL405", "ghost_measurement") in pairs
         # mapped-and-emitted keys are clean
         assert not [v for v in vs if v.symbol in ("chunks", "shed",
                                                   "active_slots")]
+        # mapped/excluded/plumbing recorder kwargs are clean
+        assert not [v for v in vs if v.code == "GL405" and v.symbol in (
+            "requests", "zero_copy_bytes", "error", "registry")]
 
     def test_propagation_catches_all_seeds(self):
         src = _fixture("bad_propagation.py")

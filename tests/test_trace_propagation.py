@@ -352,8 +352,8 @@ class TestTransportTelemetry:
 
         hop_fields = {
             "unit", "method", "transport", "request_bytes",
-            "response_bytes", "serialize_seconds", "network_seconds",
-            "retries", "error", "requests", "failovers",
+            "response_bytes", "zero_copy_bytes", "serialize_seconds",
+            "network_seconds", "retries", "error", "requests", "failovers",
         }
         mapped = set(m.TRANSPORT_METRICS) | m.TRANSPORT_RECORD_EXCLUDED
         unmapped = hop_fields - mapped - {

@@ -19,6 +19,10 @@ Rules:
   ``ENGINE_STATS_METRICS`` and ``TRANSPORT_METRICS``.
 * GL404 — ``_SLO_COUNTER_KEYS`` entry that is not a mapped
   engine-stats counter (the flight-recorder threading contract).
+* GL405 — ``record_transport_hop`` keyword parameter with no
+  ``TRANSPORT_METRICS`` mapping and no ``TRANSPORT_RECORD_EXCLUDED``
+  entry: a per-hop measurement (e.g. the r14 ``zero_copy_bytes``
+  split) that would silently skip Prometheus export.
 """
 
 from __future__ import annotations
@@ -118,9 +122,21 @@ def _engine_stats_keys(paged: Source) -> Set[str]:
     return keys
 
 
+def _hop_record_params(tree: ast.AST) -> List[Tuple[str, int]]:
+    """The keyword parameters of ``record_transport_hop`` (the per-hop
+    recording surface) with their line — every quantitative one must be
+    bridge-mapped or excluded."""
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and node.name == "record_transport_hop":
+            a = node.args
+            return [(p.arg, node.lineno) for p in a.kwonlyargs]
+    return []
+
+
 class _Checker:
     name = NAME
-    codes = ("GL401", "GL402", "GL403", "GL404")
+    codes = ("GL401", "GL402", "GL403", "GL404", "GL405")
     doc = __doc__
 
     def run(self, ctx: LintContext) -> Iterable[Violation]:
@@ -193,6 +209,27 @@ class _Checker:
                     symbol=metric,
                     message=f"gauge {metric!r} (key {key!r}) must not end in "
                             "_total",
+                ))
+
+        excluded_record = _set_literal(metrics.tree, "TRANSPORT_RECORD_EXCLUDED") or set()
+        # internal plumbing kwargs of the recording call, not measurements
+        record_plumbing = {"registry", "error"}
+        # fields the recorder derives rather than receives (the seconds
+        # pair maps the *_s internals) are already TRANSPORT_METRICS keys
+        for param, line in _hop_record_params(metrics.tree):
+            if param in record_plumbing or param in excluded_record:
+                continue
+            if param not in transport_specs:
+                out.append(Violation(
+                    checker=self.name, code="GL405", path=METRICS, line=line,
+                    symbol=param,
+                    message=(
+                        f"record_transport_hop takes {param!r} but "
+                        "TRANSPORT_METRICS neither maps it nor "
+                        "TRANSPORT_RECORD_EXCLUDED excludes it — the "
+                        "per-hop measurement would silently skip "
+                        "Prometheus export"
+                    ),
                 ))
 
         for key in sorted(slo_keys):

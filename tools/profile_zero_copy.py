@@ -1,0 +1,158 @@
+"""Per-stage cost of the three payload lanes: proto vs JSON vs buffer-view.
+
+For one request tensor, times each stage a payload passes between the
+ingress bytes and the model call — parse (wire container), decode
+(payload -> ndarray), device_put (host -> HBM staging), dispatch (the
+jitted model call) — and counts the bytes COPIED inside Python at each
+stage.  The buffer-view (SRT1) lane's parse/decode stages are
+header-only + `np.frombuffer` views, so its copied-bytes column is the
+lane's whole argument (docs/architecture.md §9a):
+
+    python tools/profile_zero_copy.py --rows 32 --feat 1024 --iters 300
+
+Prints one table; run on CPU (`JAX_PLATFORMS=cpu`) for the host-side
+story or on the TPU host for true device_put numbers.
+"""
+
+from __future__ import annotations
+
+import argparse
+import base64
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _pct(vals, q=0.5):
+    vals = sorted(vals)
+    return vals[max(0, int(q * len(vals)) - 1)] * 1e6  # us
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--rows", type=int, default=32)
+    ap.add_argument("--feat", type=int, default=1024)
+    ap.add_argument("--dtype", default="float32")
+    ap.add_argument("--iters", type=int, default=300)
+    ap.add_argument("--model", default="mlp",
+                    help="jaxserver model for the dispatch stage")
+    args = ap.parse_args()
+
+    import numpy as np
+
+    from seldon_core_tpu import codec
+    from seldon_core_tpu.codec import bufview
+    from seldon_core_tpu.models.jaxserver import JaxServer
+    from seldon_core_tpu.proto import pb
+
+    rng = np.random.default_rng(7)
+    x = rng.normal(size=(args.rows, args.feat)).astype(codec.np_dtype(args.dtype))
+    nbytes = x.nbytes
+
+    # ---- wire bodies ------------------------------------------------------
+    req = pb.SeldonMessage()
+    req.data.rawTensor.dtype = x.dtype.name
+    req.data.rawTensor.shape.extend(x.shape)
+    req.data.rawTensor.data = x.tobytes()
+    proto_bytes = req.SerializeToString()
+    json_bytes = json.dumps({"data": {"rawTensor": {
+        "shape": list(x.shape), "dtype": x.dtype.name,
+        "data": base64.b64encode(x.tobytes()).decode(),
+    }}}).encode()
+    frame = bufview.pack_frame(x)
+
+    server = JaxServer(
+        model=args.model, num_classes=8, input_shape=(args.feat,),
+        dtype="float32", warmup_dtypes=(x.dtype.name,),
+        max_batch_size=max(args.rows, 1), warmup=True,
+    )
+    server.load()
+
+    lanes = {}
+
+    # proto lane: FromString copies the payload into the message; the
+    # frombuffer decode is a view over those message bytes
+    def proto_stages():
+        t0 = time.perf_counter()
+        m = pb.SeldonMessage.FromString(proto_bytes)
+        t1 = time.perf_counter()
+        arr = codec.raw_tensor_to_array(m.data.rawTensor)
+        t2 = time.perf_counter()
+        return (t1 - t0, t2 - t1), arr
+
+    # JSON lane: json parse + base64 decode (one full copy) + frombuffer
+    def json_stages():
+        t0 = time.perf_counter()
+        body = json.loads(json_bytes)
+        t1 = time.perf_counter()
+        rt = body["data"]["rawTensor"]
+        arr = np.frombuffer(
+            base64.b64decode(rt["data"]), dtype=rt["dtype"]
+        ).reshape(rt["shape"])
+        t2 = time.perf_counter()
+        return (t1 - t0, t2 - t1), arr
+
+    # buffer-view lane: header-only parse, view decode — zero copies
+    def view_stages():
+        t0 = time.perf_counter()
+        view = bufview.unpack_frame(frame)
+        t1 = time.perf_counter()
+        arr = view.array()
+        t2 = time.perf_counter()
+        return (t1 - t0, t2 - t1), arr
+
+    copied = {
+        "proto": {"parse": nbytes, "decode": 0},
+        "json": {"parse": len(json_bytes), "decode": nbytes},
+        "bufview": {"parse": 0, "decode": 0},
+    }
+
+    for name, fn in (("proto", proto_stages), ("json", json_stages),
+                     ("bufview", view_stages)):
+        parse_t, decode_t, put_t, disp_t = [], [], [], []
+        for _ in range(args.iters):
+            (tp, td), arr = fn()
+            t0 = time.perf_counter()
+            dev = codec.to_device(arr)
+            dev.block_until_ready()
+            t1 = time.perf_counter()
+            out = server.raw_batch_call(arr)
+            t2 = time.perf_counter()
+            parse_t.append(tp)
+            decode_t.append(td)
+            put_t.append(t1 - t0)
+            disp_t.append(t2 - t1)
+            del out, dev
+        lanes[name] = {
+            "parse": parse_t, "decode": decode_t,
+            "device_put": put_t, "dispatch": disp_t,
+        }
+
+    hdr = (f"{'lane':9s} {'stage':11s} {'p50 us':>10s} {'p99 us':>10s} "
+           f"{'copied B/req':>13s}")
+    print(f"\npayload: {x.shape} {x.dtype.name} = {nbytes} bytes "
+          f"(proto body {len(proto_bytes)}B, json body {len(json_bytes)}B, "
+          f"frame {len(frame)}B)\n")
+    print(hdr)
+    print("-" * len(hdr))
+    for name, stages in lanes.items():
+        for stage, vals in stages.items():
+            cp = copied[name].get(stage, nbytes if stage == "device_put" else 0)
+            print(f"{name:9s} {stage:11s} {_pct(vals, 0.5):10.1f} "
+                  f"{_pct(vals, 0.99):10.1f} {cp:13d}")
+        total50 = sum(_pct(v, 0.5) for v in stages.values())
+        print(f"{name:9s} {'TOTAL':11s} {total50:10.1f}")
+        print("-" * len(hdr))
+    v50 = sum(_pct(v, 0.5) for v in lanes["bufview"].values())
+    p50 = sum(_pct(v, 0.5) for v in lanes["proto"].values())
+    j50 = sum(_pct(v, 0.5) for v in lanes["json"].values())
+    print(f"\nbufview vs proto: {p50 / v50:.2f}x   bufview vs json: {j50 / v50:.2f}x")
+    server.unload()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
