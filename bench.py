@@ -203,6 +203,15 @@ COMPACT_PICKS = [
     ("goodput_pct", ("generation", "goodput_pct")),
     ("shed_pct", ("generation", "shed_pct")),
     ("interactive_p99_ms", ("generation", "interactive_p99_ms")),
+    # r15 chunked-prefill certification: interactive TTFT p99 under
+    # bimodal load with the token-budget chunk scheduler ON, gated
+    # against the unchunked baseline (ttft_x / ttft_unchunked_p99_ms
+    # in bench_full.json), plus the dominant term of the per-request
+    # p99 decomposition (gen_p99_terms_ms: queue_wait/prefill/decode)
+    # — the ROADMAP-2 gate is queue_wait no longer dominant once
+    # prefill interleaves into budgeted waves
+    ("ttft_p99_ms", ("generation", "ttft_p99_ms")),
+    ("gen_p99_dominant", ("generation", "gen_p99_dominant")),
     # r12 self-healing certification: 2 remote workers, one SIGKILLed
     # mid-load (no respawn) under transport.slow stragglers.
     # chaos_goodput_pct = served/offered (gate >= 80 with half the
@@ -2729,6 +2738,139 @@ def generation_phase() -> dict:
     except Exception as e:  # noqa: BLE001
         result["overload_error"] = str(e)[:200]
 
+    # ---- chunked-prefill TTFT phase (r15, ROADMAP 2): bimodal load —
+    # long batch prompts decoding while interactive prompts arrive
+    # mid-decode — measured twice with ONE protocol: monolithic prefill
+    # (the historical scheduler) vs the token-budget chunk scheduler.
+    # Gates: interactive ttft_p99_ms under chunking vs the unchunked
+    # baseline (ttft_x in bench_full.json), and the per-request p99
+    # decomposition (queue_wait / prefill / decode from the engine's
+    # own lifecycle stamps — no tracer) with queue_wait no longer the
+    # dominant term once waves stop carrying whole prompts.
+    try:
+        import threading as _threading
+
+        from seldon_core_tpu.models.paged import PagedEngine as _CpEngine
+
+        cp_slots = 4 if quick else 8
+        cp_new = 8 if quick else 16
+        cp_batch_new = 32 if quick else 96
+        cp_long = min(192 if quick else 448,
+                      serve_cfg["max_len"] - cp_batch_new)
+        cp_budget = 96 if quick else 256
+        rng5 = np.random.default_rng(17)
+
+        def cp_chat(i):
+            return rng5.integers(
+                0, cfg["vocab_size"], size=(24 + (i % 3) * 8,)
+            ).astype(np.int32)
+
+        def cp_batch(_i):
+            return rng5.integers(
+                0, cfg["vocab_size"], size=(cp_long,)
+            ).astype(np.int32)
+
+        def ttft_round(budget):
+            """One arm: 2x-slots batch prompts decode while a full
+            slot-count of priority-2 interactive prompts arrives
+            mid-decode (the preemption shape).  The first (untimed)
+            round pays the slice/chunk compiles; the timed round's
+            interactive streams carry the engine's own lifecycle
+            stamps, so TTFT and its terms need no tracer."""
+            eng = _CpEngine(
+                params, dtype=jnp.bfloat16, page_size=64,
+                max_slots=cp_slots, steps_per_call=8,
+                chunk_token_budget=budget, tp=1, **serve_cfg,
+            )
+            try:
+                def one_round():
+                    batch = [
+                        eng.submit(cp_batch(i), max_new_tokens=cp_batch_new,
+                                   priority=0)
+                        for i in range(2 * cp_slots)
+                    ]
+                    stepper = _threading.Thread(target=eng.run)
+                    stepper.start()
+                    _time.sleep(0.05)
+                    chats = [
+                        eng.submit(cp_chat(i), max_new_tokens=cp_new,
+                                   priority=2)
+                        for i in range(cp_slots)
+                    ]
+                    for s in chats + batch:
+                        s.event.wait(timeout=600)
+                    stepper.join(timeout=600)
+                    while not stepper.is_alive() and eng.has_work():
+                        eng.step()
+                    return chats
+
+                one_round()  # warm: pays every slice/chunk compile
+                chats = one_round()
+                ttfts = []
+                terms = {"queue_wait": [], "prefill": [], "decode": []}
+                for s in chats:
+                    if s.error is not None or not s.t_first_token:
+                        continue
+                    ttfts.append((s.t_first_token - s.t_submit) * 1000.0)
+                    terms["queue_wait"].append(
+                        (s.t_prefill_start - s.t_submit) * 1000.0
+                    )
+                    terms["prefill"].append(
+                        (s.t_decode_start - s.t_prefill_start) * 1000.0
+                    )
+                    terms["decode"].append(
+                        (s.t_finish - s.t_decode_start) * 1000.0
+                    )
+
+                def p99(xs):
+                    xs = sorted(xs)
+                    if not xs:
+                        return 0.0
+                    return xs[min(len(xs) - 1,
+                                  int(0.99 * (len(xs) - 1) + 0.5))]
+
+                rs = eng.engine_stats(detail=True).get("recorder_stats", {})
+                return {
+                    "ttft_p99_ms": round(p99(ttfts), 1),
+                    "terms_p99_ms": {
+                        k: round(p99(v), 1) for k, v in terms.items()
+                    },
+                    "served": len(ttfts),
+                    "window_prefill_tokens": rs.get(
+                        "window_prefill_tokens", 0),
+                    "window_decode_tokens": rs.get(
+                        "window_decode_tokens", 0),
+                }
+            finally:
+                eng.close()
+
+        cp_base = ttft_round(0)
+        cp_on = ttft_round(cp_budget)
+        result["ttft_p99_ms"] = cp_on["ttft_p99_ms"]
+        result["ttft_unchunked_p99_ms"] = cp_base["ttft_p99_ms"]
+        result["ttft_x"] = round(
+            cp_base["ttft_p99_ms"] / max(cp_on["ttft_p99_ms"], 1e-9), 2
+        )
+        result["gen_p99_terms_ms"] = cp_on["terms_p99_ms"]
+        result["gen_p99_terms_unchunked_ms"] = cp_base["terms_p99_ms"]
+        result["gen_p99_dominant"] = max(
+            cp_on["terms_p99_ms"], key=cp_on["terms_p99_ms"].get
+        )
+        result["chunk_mix"] = {
+            "budget": cp_budget,
+            "window_prefill_tokens": cp_on["window_prefill_tokens"],
+            "window_decode_tokens": cp_on["window_decode_tokens"],
+            "interactive_served": cp_on["served"],
+        }
+        result["chunked_prefill_protocol"] = (
+            f"{2 * cp_slots} batch ({cp_long}-token prompts, "
+            f"{cp_batch_new} new, prio 0) + {cp_slots} interactive "
+            f"(24-40 tokens, {cp_new} new, prio 2, mid-decode) into "
+            f"{cp_slots} slots; budget {cp_budget} vs monolithic"
+        )
+    except Exception as e:  # noqa: BLE001
+        result["chunked_prefill_error"] = str(e)[:200]
+
     # ---- serving capacity (r6, VERDICT r5 #5): max concurrent
     # 512-token streams inside a stated pool-HBM budget, priced by the
     # donation-aware accounting (paged_hbm_accounting) — host
@@ -2756,12 +2898,21 @@ def generation_phase() -> dict:
         copied = paged_capacity_streams(
             budget, cap_ctx, donated=False, **cap_model
         )
+        # r15 bugfix contrast: a prompt mid-chunking holds its WHOLE
+        # block table mapped while contributing no decode — the
+        # accounting reserves those pages off the top so chunked
+        # prefill cannot over-admit during the chunking window
+        chunking = paged_capacity_streams(
+            budget, cap_ctx, donated=True,
+            inflight_prefill_tokens=cap_ctx, **cap_model
+        )
         result["paged_capacity"] = {
             "streams": donated,
             "ctx_len": cap_ctx,
             "budget_gib": cap_gib,
             "accounting": "donated",
             "streams_if_copied": copied,
+            "streams_with_inflight_prefill": chunking,
             "per_stream_accounting": paged_hbm_accounting(
                 streams=1, ctx_len=cap_ctx, donated=True, **cap_model
             ),

@@ -52,6 +52,8 @@ __all__ = [
     "unpack_frames",
     "frame_header",
     "is_frame",
+    "pack_kv_handoff",
+    "unpack_kv_handoff",
 ]
 
 SRT1_MAGIC = 0x31545253  # "SRT1" little-endian
@@ -397,6 +399,97 @@ def unpack_frames(data: Union[bytes, memoryview]) -> list:
     if not views:
         raise PayloadError("empty SRT1 container")
     return views
+
+
+# ---------------------------------------------------------------------------
+# KV-page handoff container (disaggregated prefill/decode, r15)
+# ---------------------------------------------------------------------------
+
+# Fixed frame order of one handoff container.  Everything else a decode
+# engine needs is derivable: the pool layout from k's rank (4 = flat
+# ``(L, P, ps, d_model)``, 5 = split ``(L, P, ps, h, hd)``), page_size
+# from ``k.shape[2]``, vocab from last_logits, prompt length from the
+# prompt frame — no side-channel metadata to drift from the tensors.
+_KV_HANDOFF_FRAMES = ("prompt", "last_logits", "k", "v")
+
+
+def pack_kv_handoff(payload: dict) -> bytes:
+    """Encode a ``PagedEngine.prefill_export`` payload as one SRT1
+    multi-frame container — the wire form of the disaggregated KV-page
+    handoff.  Locally the container is handed over as one buffer and
+    :func:`unpack_kv_handoff` reopens it as zero-copy views; across
+    hosts the same bytes ride a rawTensor proto (uint8) over DCN."""
+    try:
+        frames = [np.asarray(payload[name]) for name in _KV_HANDOFF_FRAMES]
+    except KeyError as exc:
+        raise PayloadError(
+            f"KV handoff payload is missing the {exc.args[0]!r} entry "
+            f"(needs {', '.join(_KV_HANDOFF_FRAMES)})"
+        ) from None
+    prompt, last, k, v = frames
+    if prompt.ndim != 1 or prompt.size < 1:
+        raise PayloadError(
+            f"KV handoff prompt must be a non-empty 1-D token array, got "
+            f"shape {tuple(prompt.shape)}"
+        )
+    if k.ndim not in (4, 5) or k.shape != v.shape or k.dtype != v.dtype:
+        raise PayloadError(
+            f"KV handoff k/v must be matching rank-4 (flat) or rank-5 "
+            f"(split) page stacks, got {k.dtype}{tuple(k.shape)} vs "
+            f"{v.dtype}{tuple(v.shape)}"
+        )
+    return pack_frames([
+        prompt.astype(np.int32, copy=False),
+        np.asarray(last, np.float32).reshape(-1),
+        k, v,
+    ])
+
+
+def unpack_kv_handoff(data) -> dict:
+    """Decode one KV-handoff container into zero-copy views, shaped for
+    ``PagedEngine.submit_prefilled``: the returned ``k``/``v`` views
+    alias ``data``'s payload regions (the decode engine's scatter is
+    the single copy the hardware requires).  Malformed containers raise
+    :class:`PayloadError` naming the defect — a handoff must never
+    scatter garbage silently."""
+    views = unpack_frames(data)
+    if len(views) != len(_KV_HANDOFF_FRAMES):
+        raise PayloadError(
+            f"KV handoff container carries {len(views)} frames, expected "
+            f"{len(_KV_HANDOFF_FRAMES)} ({', '.join(_KV_HANDOFF_FRAMES)})"
+        )
+    prompt, last, k, v = views
+    if prompt.dtype != np.int32 or prompt.ndim != 1 or len(prompt) < 1:
+        raise PayloadError(
+            f"KV handoff prompt frame must be 1-D int32, got "
+            f"{prompt.dtype.name}{prompt.shape}"
+        )
+    if last.dtype != np.float32 or last.ndim != 1:
+        raise PayloadError(
+            f"KV handoff last_logits frame must be 1-D float32, got "
+            f"{last.dtype.name}{last.shape}"
+        )
+    if k.ndim not in (4, 5) or k.shape != v.shape or k.dtype != v.dtype:
+        raise PayloadError(
+            f"KV handoff k/v frames must be matching rank-4/5 page "
+            f"stacks, got {k.dtype.name}{k.shape} vs {v.dtype.name}{v.shape}"
+        )
+    page_size = int(k.shape[2])
+    pages = int(k.shape[1])
+    if page_size < 1 or pages != -(-len(prompt) // page_size):
+        raise PayloadError(
+            f"KV handoff geometry mismatch: {len(prompt)} prompt tokens "
+            f"need {-(-len(prompt) // max(1, page_size))} pages of "
+            f"{page_size}, container holds {pages}"
+        )
+    return {
+        "prompt": prompt.array(),
+        "last_logits": last.array(),
+        "k": k.array(),
+        "v": v.array(),
+        "page_size": page_size,
+        "layout": "flat" if k.ndim == 4 else "split",
+    }
 
 
 def stack_views(

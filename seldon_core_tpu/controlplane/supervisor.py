@@ -195,11 +195,85 @@ class SupervisedProcess:
                 pass  # never written / already consumed
 
 
+def disagg_worker_specs(
+    name: str,
+    *,
+    prefill_workers: int = 1,
+    base_http: int = 9500,
+    base_grpc: int = 9600,
+    decode_component: str = "seldon_core_tpu.models.disagg.DisaggregatedLM",
+    prefill_component: str = "seldon_core_tpu.models.disagg.PrefillLM",
+    parameters_json: str = "[]",
+    env: Optional[Dict[str, str]] = None,
+) -> List[ProcessSpec]:
+    """Worker-set specs for a DistServe-style disaggregated deployment
+    (r15): N dedicated prefill workers plus ONE decode worker whose
+    ``prefill_endpoints`` parameter points at them, every role pinned
+    via ``SELDON_TPU_DISAGG_ROLE`` so operators (and ``/debug``
+    surfaces) can tell the roles apart.  The decode worker keeps the
+    drain/handoff journal default (it owns the live decode streams);
+    prefill workers are stateless between requests — a crashed prefill
+    worker loses only in-flight exports, which the coordinator's
+    waiters see as ordinary transport errors and retry.
+
+    Spawn order matters: put the PREFILL specs up first (the returned
+    list is ordered that way) so the decode worker's first dial finds
+    live endpoints instead of paying a retry ladder."""
+    import json
+
+    specs: List[ProcessSpec] = []
+    endpoints: List[str] = []
+    for i in range(max(1, int(prefill_workers))):
+        http, grpc = base_http + 1 + i, base_grpc + 1 + i
+        endpoints.append(f"grpc://127.0.0.1:{grpc}")
+        specs.append(ProcessSpec(
+            name=f"{name}-prefill-{i}",
+            component=prefill_component,
+            http_port=http,
+            grpc_port=grpc,
+            parameters_json=parameters_json,
+            env={**(env or {}), "SELDON_TPU_DISAGG_ROLE": "prefill"},
+        ))
+    params = json.loads(parameters_json or "[]")
+    params.append({
+        "name": "prefill_endpoints",
+        "value": json.dumps(endpoints),
+        "type": "STRING",
+    })
+    specs.append(ProcessSpec(
+        name=f"{name}-decode",
+        component=decode_component,
+        http_port=base_http,
+        grpc_port=base_grpc,
+        parameters_json=json.dumps(params),
+        env={**(env or {}), "SELDON_TPU_DISAGG_ROLE": "decode"},
+    ))
+    return specs
+
+
 class Supervisor:
     """Manages the full set of out-of-process nodes on this host."""
 
     def __init__(self) -> None:
         self.processes: Dict[str, SupervisedProcess] = {}
+
+    def add_group(
+        self, specs: List[ProcessSpec], wait_ready_s: float = 30.0
+    ) -> List[SupervisedProcess]:
+        """Spawn a worker SET in list order (e.g. ``disagg_worker_specs``:
+        prefill workers first, then the decode worker that dials them),
+        tearing the whole group down if any member never comes ready —
+        a half-spawned disaggregated deployment serves nothing."""
+        started: List[SupervisedProcess] = []
+        try:
+            for spec in specs:
+                started.append(self.add(spec, wait_ready_s=wait_ready_s))
+        except Exception:
+            for sp in started:
+                sp.stop()
+                self.processes.pop(sp.spec.name, None)
+            raise
+        return started
 
     def add(self, spec: ProcessSpec, wait_ready_s: float = 30.0) -> SupervisedProcess:
         sp = SupervisedProcess(spec)

@@ -158,6 +158,29 @@ class _Hop:
                 span.tags["error"] = True
 
 
+@contextmanager
+def kv_handoff_hop(unit: str, transport: str = "local"):
+    """Meter one disaggregated KV-page handoff (prefill worker ->
+    decode pool) through the SAME ``seldon_tpu_transport_*`` surface
+    NodeClient hops use, under ``method="kv_handoff"`` — so the
+    dashboards price the handoff lane next to the request lanes it
+    displaces.  The caller sets byte counts on the yielded hop:
+    ``zero_copy_bytes`` for the local buffer-view lane (the container
+    is passed by reference and reopened as views), ``request_bytes``
+    for a DCN transfer that re-encoded it.  Yields None when telemetry
+    is off — metering must cost nothing then."""
+    if not _metrics.transport_telemetry_enabled():
+        yield None
+        return
+    hop = _Hop(unit, "kv_handoff", transport)
+    try:
+        yield hop
+    except BaseException:
+        hop.finish(error=True)
+        raise
+    hop.finish()
+
+
 def backoff_s(attempt: int, base_s: float = 0.05, cap_s: float = 2.0) -> float:
     """Full-jitter exponential backoff for attempt ``attempt`` (0-based
     retry index): uniform over [0, min(cap, base * 2^attempt)].

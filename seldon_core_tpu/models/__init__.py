@@ -54,6 +54,11 @@ def _register_optional() -> None:
     from seldon_core_tpu.models.speculative import SpeculativeLM
 
     register_implementation("SPECULATIVE_LM", SpeculativeLM)
+    # disaggregated prefill/decode roles (r15, §5b-quater)
+    from seldon_core_tpu.models.disagg import DisaggregatedLM, PrefillLM
+
+    register_implementation("DISAGGREGATED_LM", DisaggregatedLM)
+    register_implementation("PREFILL_LM", PrefillLM)
     # Reference's TENSORFLOW_SERVER prepackaged proxy
     # (operator/controllers/seldondeployment_prepackaged_servers.go:109)
     register_implementation("TENSORFLOW_SERVER", TFServingGrpcProxy)

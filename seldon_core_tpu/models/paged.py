@@ -603,6 +603,7 @@ def paged_hbm_accounting(
     cached_prefix_pages: int = 0,
     tp_degree: int = 1,
     num_heads: Optional[int] = None,
+    inflight_prefill_tokens: int = 0,
 ) -> Dict[str, int]:
     """Pool-HBM bytes for ``streams`` concurrent streams at ``ctx_len``
     tokens — the capacity model the bench certifies (VERDICT r5 #3/#5).
@@ -645,6 +646,16 @@ def paged_hbm_accounting(
       prices FULL bytes rather than certifying capacity the fallback
       cannot deliver.
 
+    * **in-flight prefill scratch (r15)** — under chunked prefill a
+      stream admitted but still chunking holds ALL its prompt pages
+      mapped (admission allocates the whole prompt's block table up
+      front; slices fill it over several waves) while contributing no
+      decode.  ``inflight_prefill_tokens`` prices those mapped pages
+      (``inflight_prefill_bytes``, included in ``peak_bytes``) so
+      :func:`paged_capacity_streams` cannot over-admit during the
+      chunking window — the over-admission bug the r15 satellite
+      fixed.
+
     Weights, activations, and the host runtime are out of scope: this
     prices the KV side, which is what scales with streams.
     """
@@ -664,20 +675,26 @@ def paged_hbm_accounting(
             * tok_bytes * split_tile_pad
         ) // shard
     at_rest = pool if donated else 2 * pool
+    inflight_pages = -(-int(inflight_prefill_tokens) // page_size)
+    inflight = int(
+        inflight_pages * page_size * tok_bytes * pool_pad
+    ) // shard
     return {
         "pool_bytes": pool,
         "working_set_bytes": ws,
-        "peak_bytes": at_rest + ws,
+        "peak_bytes": at_rest + ws + inflight,
         "per_stream_bytes": (at_rest + ws) // max(1, streams),
         "reclaimable_bytes": int(
             cached_prefix_pages * page_size * tok_bytes * pool_pad
         ) // shard,
+        "inflight_prefill_bytes": inflight,
         "tp_degree": shard,
     }
 
 
 def paged_capacity_streams(
-    budget_bytes: int, ctx_len: int, *, donated: bool = True, **model_kw
+    budget_bytes: int, ctx_len: int, *, donated: bool = True,
+    inflight_prefill_tokens: int = 0, **model_kw
 ) -> int:
     """Max concurrent streams whose paged KV peak fits ``budget_bytes``
     at ``ctx_len`` tokens each (per-stream cost is linear in streams,
@@ -686,11 +703,22 @@ def paged_capacity_streams(
     Prefix-cache residue never prices into this: LRU-cached pages are
     reclaimable on demand (``cached_prefix_pages`` above contributes
     ``reclaimable_bytes``, not ``peak_bytes``), so a warm cache holds
-    the same number of admissible streams as a cold pool."""
+    the same number of admissible streams as a cold pool.
+
+    In-flight prefill scratch DOES price into this (r15 bugfix):
+    ``inflight_prefill_tokens`` — prompt tokens of streams admitted
+    but still chunking their prefill — reserves its mapped pages off
+    the top of the budget BEFORE the per-stream division, because
+    those pages are neither free nor reclaimable while the slices run.
+    Without the term, chunked prefill let the planner admit streams
+    whose pages the chunking prompts already held."""
     one = paged_hbm_accounting(
-        streams=1, ctx_len=ctx_len, donated=donated, **model_kw
+        streams=1, ctx_len=ctx_len, donated=donated,
+        inflight_prefill_tokens=inflight_prefill_tokens, **model_kw
     )
-    return int(budget_bytes // max(1, one["peak_bytes"]))
+    per_stream = max(1, one["peak_bytes"] - one["inflight_prefill_bytes"])
+    usable = max(0, int(budget_bytes) - one["inflight_prefill_bytes"])
+    return int(usable // per_stream)
 
 
 # ---------------------------------------------------------------------------
@@ -747,9 +775,10 @@ class _Stream:
         "req_id", "prompt", "max_new", "temperature", "top_k", "eos_id",
         "seed", "tokens", "event", "result", "error", "slot", "pages",
         "pending", "draft_hint", "token_queue", "streamed", "cancelled",
-        "trace_id", "parent_span_id", "t_submit", "t_decode_start",
-        "queue_depth_at_submit", "cached_len", "priority", "deadline",
-        "preempted",
+        "trace_id", "parent_span_id", "t_submit", "t_prefill_start",
+        "t_decode_start", "t_first_token", "t_finish",
+        "queue_depth_at_submit", "cached_len", "prefilled", "priority",
+        "deadline", "preempted", "kv_export", "kv_import", "kv_payload",
     )
 
     def __init__(self, req_id, prompt, max_new, temperature, top_k, eos_id, seed):
@@ -769,6 +798,18 @@ class _Stream:
         # tokens already resident in shared prefix-cache pages at
         # admission (page-aligned); prefill runs only past this point
         self.cached_len = 0
+        # prompt tokens whose KV is ACTUALLY in the pool: cached_len at
+        # admission, advanced by every prefill slice (monolithic
+        # prefill jumps straight to len(prompt)); a stream decodes only
+        # once prefilled == len(prompt) — the chunked-prefill state
+        self.prefilled = 0
+        # disaggregation (r15): kv_export streams finish at the end of
+        # prefill with their pages read back into kv_payload instead of
+        # decoding; kv_import carries a prefill worker's payload whose
+        # pages are scatter-written at admission (no prefill FLOPs)
+        self.kv_export = False
+        self.kv_import: Optional[Dict[str, Any]] = None
+        self.kv_payload: Optional[Dict[str, Any]] = None
         # speculative mode: the next greedy token (argmax of the last
         # verified logits), decided on host between verify rounds
         self.pending: Optional[int] = None
@@ -789,7 +830,20 @@ class _Stream:
         self.trace_id = ""
         self.parent_span_id: Optional[str] = None
         self.t_submit = 0.0
+        # wall time the stream's FIRST prefill slice started: with
+        # t_submit/t_decode_start/t_first_token this decomposes a
+        # request's latency into queue-wait / prefill / decode without
+        # a tracer (the bench's p99-terms source)
+        self.t_prefill_start = 0.0
         self.t_decode_start = 0.0
+        # wall time the stream's FIRST decode token landed (the TTFT
+        # numerator: t_first_token - t_submit); always stamped — the
+        # bench's interactive-TTFT gate and the profile tool's TTFT
+        # column must not require a tracer
+        self.t_first_token = 0.0
+        # wall time the result was delivered (_finish_locked): closes
+        # the queue_wait / prefill / decode request decomposition
+        self.t_finish = 0.0
         self.queue_depth_at_submit = 0
         # SLO lifecycle (r10): admission/shedding order (higher wins),
         # absolute time.monotonic() expiry (None = no deadline), and
@@ -838,6 +892,7 @@ class PagedEngine:
         speculative: Optional[Dict[str, Any]] = None,
         prefix_cache: Optional[bool] = None,
         max_queue: int = 0,
+        chunk_token_budget: int = 0,
     ):
         import jax
         import jax.numpy as jnp
@@ -1083,6 +1138,30 @@ class PagedEngine:
         if not max_queue:
             max_queue = int(_knobs.raw("SELDON_TPU_MAX_QUEUE", "0") or 0)
         self.max_queue = max(0, int(max_queue))
+        # chunked-prefill co-scheduling (r15, Sarathi-style): each
+        # engine wave carries at most this many tokens, filled
+        # decode-first then with page-aligned slices of pending
+        # prefills — a long prompt stops monopolising waves, so
+        # decoding streams keep their cadence and interactive TTFT
+        # stops queueing behind batch prefills.  0 (the default) keeps
+        # the historical monolithic prefill byte-for-byte.  Ctor arg
+        # wins over SELDON_TPU_CHUNK_TOKEN_BUDGET; a budget below one
+        # page + one decode step can't make page-aligned progress, so
+        # it clamps up with a WARN rather than livelocking.
+        if not chunk_token_budget:
+            chunk_token_budget = int(
+                _knobs.raw("SELDON_TPU_CHUNK_TOKEN_BUDGET", "0") or 0
+            )
+        self.chunk_token_budget = max(0, int(chunk_token_budget))
+        if self.chunk_token_budget:
+            floor = self.page_size + self.steps_per_call
+            if self.chunk_token_budget < floor:
+                logger.warning(
+                    "SELDON_TPU_CHUNK_TOKEN_BUDGET=%d cannot cover one "
+                    "prefill page plus one decode chunk; clamping to %d",
+                    self.chunk_token_budget, floor,
+                )
+                self.chunk_token_budget = floor
         self._queue: Deque[_Stream] = deque()
         self._queued: set = set()  # identity membership (streams are unhashable-by-value)
         self._slots: List[Optional[_Stream]] = [None] * self.max_slots
@@ -1120,6 +1199,18 @@ class PagedEngine:
                           # by drain() for a respawned engine, and
                           # journal entries replay() re-submitted here
                           "drained": 0, "replayed": 0,
+                          # chunked prefill (r15): prompt tokens whose
+                          # KV was COMPUTED by prefill programs (cache
+                          # hits and KV imports excluded) and the
+                          # number of prefill device calls — with
+                          # "tokens" (decode) this is the
+                          # prefill/decode split the flight-recorder
+                          # chunk records carry per wave
+                          "prefill_tokens": 0, "prefill_chunks": 0,
+                          # disaggregation (r15): prefills exported as
+                          # KV-page handoff payloads, and imported
+                          # payloads scatter-written into this pool
+                          "kv_exports": 0, "kv_imports": 0,
                           # wall seconds inside device calls + readback,
                           # split by phase: decode-rate observability
                           # (tokens / chunk_wall_s) independent of
@@ -1233,6 +1324,8 @@ class PagedEngine:
         self._prefill_jit: Dict[Tuple[int, int], Any] = {}  # (bucket, k)
         # cached-prefix suffix prefill: (suffix bucket, k, read pages)
         self._prefill_cached_jit: Dict[Tuple[int, int, int], Any] = {}
+        # disaggregated KV import: pages-per-payload -> donated scatter
+        self._import_kv_jit: Dict[int, Any] = {}
         # (steps, bucket spec) -> compiled chunk program, where the
         # bucket spec is a static tuple of (lane_count, ctx_pages)
         # pairs (one entry = uniform, two = the length-bucketed gather)
@@ -2036,6 +2129,8 @@ class PagedEngine:
         parent_span_id: Optional[str] = None,
         priority: int = 0,
         deadline: Optional[float] = None,
+        kv_export: bool = False,
+        kv_import: Optional[Dict[str, Any]] = None,
     ) -> _Stream:
         """Queue one prompt (1-D int array). Returns a stream handle whose
         ``event`` fires when ``result`` (``(max_new,)`` ids) is ready.
@@ -2057,7 +2152,16 @@ class PagedEngine:
         device, and mid-decode expiry cancels the stream at the next
         chunk boundary.  Both default to the pre-SLO behaviour (every
         stream equal, no expiry), which keeps greedy decode bit-exact
-        with the historical engine."""
+        with the historical engine.
+
+        ``kv_export`` (disaggregation, r15): the stream finishes at the
+        END of prefill — its KV pages are read back into
+        ``stream.kv_payload`` instead of decoding (``max_new_tokens``
+        still sizes the request for admission but no decode runs).
+        ``kv_import`` admits a prefill worker's payload: the pages are
+        scatter-written (no prefill FLOPs) and decode starts from the
+        imported last-token logits.  Prefer the :meth:`prefill_export`
+        / :meth:`submit_prefilled` fronts, which validate payloads."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         plen = len(prompt)
         if plen < 1:
@@ -2114,16 +2218,21 @@ class PagedEngine:
             )
             stream.priority = int(priority)
             stream.deadline = float(deadline) if deadline is not None else None
+            stream.kv_export = bool(kv_export)
+            stream.kv_import = kv_import
             if draft_hint is not None:
                 stream.draft_hint = np.asarray(draft_hint, np.int32).reshape(-1)
             if stream_tokens:
                 stream.token_queue = _queue.Queue()
             self._next_id += 1
+            # always stamped (one time() call): TTFT is measured as
+            # t_first_token - t_submit by the bench gate and the
+            # profile tool, tracer installed or not
+            stream.t_submit = _time.time()
+            stream.queue_depth_at_submit = len(self._queue)
             from seldon_core_tpu.utils import tracing as _tracing
 
             if _tracing.get_tracer() is not None:  # one global read when off
-                import time as _time
-
                 enclosing = _tracing.current_span()
                 stream.trace_id = trace_id or (
                     enclosing.trace_id if enclosing is not None
@@ -2132,8 +2241,6 @@ class PagedEngine:
                 stream.parent_span_id = parent_span_id or (
                     enclosing.span_id if enclosing is not None else None
                 )
-                stream.t_submit = _time.time()
-                stream.queue_depth_at_submit = len(self._queue)
             self._queue.append(stream)
             self._queued.add(stream)
         return stream
@@ -2457,7 +2564,14 @@ class PagedEngine:
         back (deepest page re-parked first, preserving the leaves-
         evict-first LRU discipline)."""
         plen = len(stream.prompt)
-        matched = self._match_prefix_locked(stream.prompt)
+        # KV imports never map shared prefix pages: the payload's
+        # scatter would write INTO pages other streams read (same
+        # values, but shared pages are read-only by contract) — they
+        # allocate fresh pages and re-register afterwards instead
+        matched = (
+            [] if stream.kv_import is not None
+            else self._match_prefix_locked(stream.prompt)
+        )
         for e in matched:
             if int(self._page_ref[e.page]) == 0:
                 self._lru.pop(e.page, None)
@@ -2473,6 +2587,10 @@ class PagedEngine:
         stream.slot = slot
         stream.pages = [e.page for e in matched] + fresh
         stream.cached_len = len(matched) * self.page_size
+        # chunked-prefill cursor: prefill resumes past the cached
+        # prefix; slices advance it to plen (monolithic prefill jumps
+        # there in one wave)
+        stream.prefilled = stream.cached_len
         if self._prefix_cache_enabled:
             if matched:
                 self._counters["prefix_hits"] += 1
@@ -2557,18 +2675,74 @@ class PagedEngine:
             free_slots.append(slot)
         return admitted
 
-    def _prefill_streams(self, streams: List[_Stream]) -> None:
-        """Prefill admitted streams, batching same-bucket prompts into
-        one device call each (k padded to the next power of two so the
-        compile count stays logarithmic)."""
+    def _prefill_streams(
+        self, streams: List[_Stream]
+    ) -> Tuple[List[_Stream], int, float]:
+        """Monolithic prefill wave (chunk budget OFF — the historical
+        path): every admitted stream's full uncached suffix runs in
+        this one wave.  Returns ``(completed streams, prompt tokens
+        computed, wall seconds)`` — the same contract as the chunked
+        slice runner, so both step paths share one completion tail."""
+        return self._run_prefill_slices([
+            (s, s.prefilled, len(s.prompt) - s.prefilled) for s in streams
+        ])
+
+    def _plan_prefill_slices_locked(
+        self, prefilling: List[_Stream], budget: int
+    ) -> List[Tuple[_Stream, int, int]]:
+        """Token-budget slice plan for this wave (the Sarathi-Serve
+        rule): pending prefills ordered priority-first then FIFO, each
+        taking up to the remaining budget, floored to a page boundary
+        unless the slice finishes the prompt — the next slice's
+        "cached" length must stay page-aligned for the suffix program's
+        shifted write table.  KV imports cost no budget: their pages
+        arrive computed, the wave only places them.  Caller holds
+        ``_lock``; execution happens later, outside it."""
+        slices: List[Tuple[_Stream, int, int]] = []
+        left = int(budget)
+        ps = self.page_size
+        for s in sorted(prefilling, key=lambda s: (-s.priority, s.req_id)):
+            need = len(s.prompt) - s.prefilled
+            if s.kv_import is not None:
+                slices.append((s, s.prefilled, need))
+                continue
+            if left < ps:
+                continue  # cannot make page-aligned progress this wave
+            n = min(left, need)
+            if n < need:
+                n = (n // ps) * ps
+            if n <= 0:
+                continue
+            slices.append((s, s.prefilled, n))
+            left -= n
+        return slices
+
+    def _run_prefill_slices(
+        self, slices: List[Tuple[_Stream, int, int]]
+    ) -> Tuple[List[_Stream], int, float]:
+        """Execute one wave's prefill work: ``(stream, start, n)``
+        slices, ``start`` page-aligned (it is the stream's ``prefilled``
+        cursor).  KV imports scatter first (no FLOPs), then per-bucket
+        grouped device calls — the classic from-zero program for whole
+        prompts (byte-identical to the pre-chunking engine, so the
+        budget-off lane keeps its compiled shapes) and the r9
+        cached-suffix program for everything mid-prompt: a chunk slice
+        IS a suffix prefill whose "cached" prefix is the pages earlier
+        slices already wrote.  Returns ``(completed streams, prompt
+        tokens computed, wall seconds)``; kv_export streams resolve
+        with their handoff payload instead of entering decode."""
+        if not slices:
+            return [], 0, 0.0
         import time as _time
 
         t_start = _time.perf_counter()
         t_admit = _time.time()
-        for stream in streams:
-            # queue-wait is the irreducible tail term (§10a): give it a
-            # span of its own so one trace decomposes it per request
-            if stream.trace_id:
+        for stream, start, _n in slices:
+            if not stream.t_prefill_start:
+                stream.t_prefill_start = t_admit  # queue-wait term ends
+            # queue-wait is the irreducible tail term (§10a): one span
+            # per stream, emitted on its FIRST slice
+            if stream.trace_id and start == stream.cached_len:
                 self._gen_span(
                     stream, "gen.queued", stream.t_submit or t_admit,
                     max(0.0, t_admit - stream.t_submit)
@@ -2576,41 +2750,69 @@ class PagedEngine:
                     slot=stream.slot,
                     queue_depth=stream.queue_depth_at_submit,
                 )
-        # group by the bucket covering what actually prefills: the full
-        # prompt for cache misses, only the uncached SUFFIX for streams
-        # whose leading pages matched the prefix cache — the cached-
-        # prefill skip, where a shared 256-token system prompt costs
-        # each follower a suffix-sized program instead of a full one
-        plain: Dict[int, List[_Stream]] = {}
-        cached: Dict[int, List[_Stream]] = {}
-        for stream in streams:
-            slen = len(stream.prompt) - stream.cached_len
-            bucket = next(b for b in self.prompt_buckets if b >= slen)
-            target = cached if stream.cached_len else plain
-            target.setdefault(bucket, []).append(stream)
+        completed: List[_Stream] = []
+        tokens = 0
+        calls = 0
+        # group by the bucket covering what actually prefills THIS
+        # wave: the full prompt only for an uncached whole-prompt
+        # slice; cache hits and mid-prompt chunk slices pay a
+        # suffix-sized program
+        plain: Dict[int, List[Tuple[_Stream, int, int]]] = {}
+        cached: Dict[int, List[Tuple[_Stream, int, int]]] = {}
+        for stream, start, n in slices:
+            if stream.kv_import is not None:
+                self._import_kv_stream(stream)
+                completed.append(stream)
+                continue
+            bucket = next(b for b in self.prompt_buckets if b >= n)
+            target = (
+                plain if start == 0 and n == len(stream.prompt) else cached
+            )
+            target.setdefault(bucket, []).append((stream, start, n))
+            tokens += n
         for bucket, group in plain.items():
-            self._prefill_group(bucket, group, use_cache=False)
+            completed.extend(
+                self._prefill_group(bucket, group, use_cache=False)
+            )
+            calls += 1
         for bucket, group in cached.items():
-            self._prefill_group(bucket, group, use_cache=True)
-        if self._prefix_cache_enabled and streams:
-            # publish the full prompt pages for reuse: the device calls
-            # that write their KV have been issued, and any later shared
-            # read is ordered after them by the threaded pool arrays
-            with self._lock:
-                for stream in streams:
+            completed.extend(
+                self._prefill_group(bucket, group, use_cache=True)
+            )
+            calls += 1
+        wall = _time.perf_counter() - t_start
+        with self._lock:
+            if calls:
+                self._counters["prefill_wall_s"] += wall
+                self._counters["prefill_tokens"] += tokens
+                self._counters["prefill_chunks"] += calls
+            if self._prefix_cache_enabled:
+                # publish full prompt pages only once the WHOLE
+                # prompt's KV is resident (the chain registration walks
+                # every page); the device calls that wrote them have
+                # been issued, and any later shared read is ordered
+                # after them by the threaded pool arrays
+                for stream in completed:
                     self._register_prefix_locked(stream)
-        if streams:
-            with self._lock:
-                self._counters["prefill_wall_s"] += _time.perf_counter() - t_start
+        exports = [s for s in completed if s.kv_export]
+        if exports:
+            self._export_streams(exports)
+            completed = [s for s in completed if not s.kv_export]
+        return completed, tokens, wall
 
     def _prefill_group(
-        self, bucket: int, group: List[_Stream], use_cache: bool
-    ) -> None:
-        """One batched prefill device call for ``group`` (all same
-        bucket; ``use_cache`` selects the suffix program attending over
-        shared prefix pages vs the classic from-zero program, which
-        stays byte-identical to the pre-cache engine so the cache-off
-        lane keeps its compiled shapes)."""
+        self, bucket: int, group: List[Tuple[_Stream, int, int]],
+        use_cache: bool,
+    ) -> List[_Stream]:
+        """One batched prefill device call for ``group`` slices (all
+        same bucket; ``use_cache`` selects the suffix program attending
+        over already-resident pages — shared prefix pages and pages
+        earlier chunk slices wrote — vs the classic from-zero program,
+        which stays byte-identical to the pre-cache engine so the
+        cache-off lane keeps its compiled shapes).  Returns the streams
+        whose prompt is now FULLY prefilled: their decode state
+        (logits, rng keys, speculative pending) installs here;
+        mid-prompt slices only advance the ``prefilled`` cursor."""
         import time as _time
 
         jnp = self._jnp
@@ -2618,9 +2820,11 @@ class PagedEngine:
         k = 1
         while k < len(group):
             k *= 2
+        ps = self.page_size
         if use_cache:
-            ps = self.page_size
-            rp = self._pages_pow2(max(s.cached_len // ps for s in group))
+            rp = self._pages_pow2(
+                max(1, max(start // ps for _s, start, _n in group))
+            )
             wp = -(-bucket // ps)
             key3 = (bucket, k, rp)
             if key3 not in self._prefill_cached_jit:
@@ -2632,17 +2836,16 @@ class PagedEngine:
             cached_lens = np.zeros((k,), np.int32)
             read_rows = np.zeros((k, rp), np.int32)
             write_rows = np.zeros((k, wp), np.int32)
-            for i, stream in enumerate(group):
-                cl = stream.cached_len
-                suffix = stream.prompt[cl:]
-                padded[i, : len(suffix)] = suffix
-                true_lens[i] = len(suffix)
-                cached_lens[i] = cl
+            for i, (stream, start, n) in enumerate(group):
+                padded[i, :n] = stream.prompt[start : start + n]
+                true_lens[i] = n
+                cached_lens[i] = start
                 read_rows[i] = self._block_tables[stream.slot, :rp]
-                # shifted write table: suffix block j lands in the page
-                # AFTER the cached prefix (cl is page-aligned, so every
-                # write starts at offset 0 — the from_zero fast path)
-                cp = cl // ps
+                # shifted write table: slice block j lands in the page
+                # AFTER the resident prefix (start is page-aligned, so
+                # every write starts at offset 0 — the from_zero fast
+                # path)
+                cp = start // ps
                 row = self._block_tables[stream.slot, cp : cp + wp]
                 write_rows[i, : len(row)] = row
             last, self.pages_k, self.pages_v = self._prefill_cached_jit[key3](
@@ -2663,42 +2866,64 @@ class PagedEngine:
             padded = np.zeros((k, bucket), np.int32)
             true_lens = np.ones((k,), np.int32)  # pad rows: 1 token -> trash
             block_rows = np.zeros((k, pages_h), np.int32)
-            for i, stream in enumerate(group):
-                plen = len(stream.prompt)
-                padded[i, :plen] = stream.prompt
-                true_lens[i] = plen
+            for i, (stream, _start, n) in enumerate(group):
+                padded[i, :n] = stream.prompt
+                true_lens[i] = n
                 block_rows[i] = self._block_tables[stream.slot, :pages_h]
             last, self.pages_k, self.pages_v = self._prefill_jit[key2](
                 self.params, self.pages_k, self.pages_v,
                 jnp.asarray(padded), jnp.asarray(true_lens),
                 jnp.asarray(block_rows),
             )
-        g = len(group)
+        finals: List[Tuple[int, _Stream]] = []
+        for i, (stream, start, n) in enumerate(group):
+            stream.prefilled = start + n
+            if stream.prefilled >= len(stream.prompt):
+                finals.append((i, stream))
+        if not finals:
+            return []
+        g = len(finals)
         # batched tail: per-stream .at[].set / key() calls are tiny
         # device dispatches, and ~3 per stream serialised through a
         # relayed dispatch stream measured as a large share of
         # admission wall time at 16 joiners.  Three dispatches total
         # instead: one fixed-shape key derivation, two scatters.
-        slots = jnp.asarray(np.array([s.slot for s in group], np.int32))
+        slots = jnp.asarray(
+            np.array([s.slot for _i, s in finals], np.int32)
+        )
         # deterministic per submit(seed=...): same seed -> same
         # sample path (per-request variation is the component
         # layer's job, as in GenerativeLM's puid/counter folding).
         # Seeds fold into [0, 2^63) — same key for any practical
         # seed (component layers derive seeds well below 2^63)
         seeds = np.zeros((self.max_slots,), np.uint64)
-        for i, stream in enumerate(group):
-            seeds[i] = stream.seed % (1 << 63)
+        for j, (_i, stream) in enumerate(finals):
+            seeds[j] = stream.seed % (1 << 63)
         all_keys = self._derive_keys(jnp.asarray(seeds))
         self._keys = self._keys.at[slots].set(all_keys[:g])
-        self._logits = self._logits.at[slots].set(last[:g])
+        last_f = last[jnp.asarray(np.array([i for i, _s in finals], np.int32))]
+        self._logits = self._logits.at[slots].set(last_f)
         if self.speculative is not None:
             # host decides the next greedy token between verify
             # rounds — ONE blocking readback for the whole group
-            pending = np.asarray(jnp.argmax(last[:g], axis=-1))
-            for i, stream in enumerate(group):
-                stream.pending = int(pending[i])
+            pending = np.asarray(jnp.argmax(last_f, axis=-1))
+            for j, (_i, stream) in enumerate(finals):
+                stream.pending = int(pending[j])
+        exports = [
+            (j, stream) for j, (_i, stream) in enumerate(finals)
+            if stream.kv_export
+        ]
+        if exports:
+            # the handoff payload carries the last-token logits so the
+            # decode worker starts sampling without a forward of its own
+            last_np = np.asarray(last_f)
+            for j, stream in exports:
+                stream.kv_payload = {
+                    "last_logits": last_np[j].astype(np.float32, copy=False)
+                }
         t_done = _time.time()
-        for stream in group:
+        out: List[_Stream] = []
+        for _i, stream in finals:
             stream.t_decode_start = t_done
             if stream.trace_id:
                 # the group prefills in ONE device call, so every
@@ -2712,6 +2937,210 @@ class PagedEngine:
                     pages_held=len(stream.pages),
                     group_size=len(group),
                 )
+            out.append(stream)
+        return out
+
+    # ---- disaggregated prefill/decode: KV-page handoff (r15) --------------
+
+    def _build_import_kv(self, P: int):
+        """Donated KV-page scatter for one imported payload: the pages
+        arrive computed (the prefill worker ran the FLOPs), this
+        program only places them — in AND out pool shardings pinned by
+        ``_tp_jit`` so a TP-sharded pool round-trips without a
+        resharding copy."""
+
+        def imp(params, pk, pv, k, v, pages):
+            del params  # present only for _tp_jit's argument convention
+            return pk.at[:, pages].set(k), pv.at[:, pages].set(v)
+
+        return self._tp_jit(imp, n_rep_in=3, out_spec=("pool", "pool"))
+
+    def _import_kv_stream(self, stream: _Stream) -> None:
+        """Scatter an imported prefill's pages into this pool and
+        install the stream's decode state — the decode half of the
+        disaggregated handoff.  Afterwards the stream is
+        indistinguishable from one that prefilled locally (same rng
+        keys, same logits, same page discipline), which is what makes
+        disaggregated decode bit-exact with unified serving."""
+        import time as _time
+
+        jnp = self._jnp
+        payload = stream.kv_import
+        t0 = _time.time()
+        plen = len(stream.prompt)
+        P = -(-plen // self.page_size)
+        pages = np.asarray(stream.pages[:P], np.int32)
+        fn = self._import_kv_jit.get(P)
+        if fn is None:
+            fn = self._import_kv_jit[P] = self._build_import_kv(P)
+        k = jnp.asarray(np.asarray(payload["k"]), self._dtype)
+        v = jnp.asarray(np.asarray(payload["v"]), self._dtype)
+        self.pages_k, self.pages_v = fn(
+            self.params, self.pages_k, self.pages_v, k, v,
+            jnp.asarray(pages),
+        )
+        last = np.asarray(
+            payload["last_logits"], np.float32
+        ).reshape(-1)
+        slot = stream.slot
+        self._logits = self._logits.at[slot].set(jnp.asarray(last))
+        seeds = np.zeros((self.max_slots,), np.uint64)
+        seeds[0] = stream.seed % (1 << 63)
+        self._keys = self._keys.at[slot].set(
+            self._derive_keys(jnp.asarray(seeds))[0]
+        )
+        if self.speculative is not None:
+            stream.pending = int(np.argmax(last))
+        stream.prefilled = plen
+        stream.t_decode_start = _time.time()
+        with self._lock:
+            self._counters["kv_imports"] += 1
+        if stream.trace_id:
+            self._gen_span(
+                stream, "gen.prefill", t0, stream.t_decode_start - t0,
+                slot=slot, bucket=0, prompt_len=plen,
+                cached_tokens=0, pages_held=len(stream.pages),
+                group_size=1, imported=True,
+            )
+
+    def _export_streams(self, streams: List[_Stream]) -> None:
+        """Resolve kv_export streams with their KV-page handoff payload
+        (prompt, per-page K/V, last-token logits): one device gather +
+        readback per stream, then the pages release through the normal
+        free path — the full prompt pages were registered in the prefix
+        index just before, so a prefill worker keeps a warm prefix
+        cache across exports."""
+        import time as _time
+
+        jnp = self._jnp
+        for stream in streams:
+            P = -(-len(stream.prompt) // self.page_size)
+            idx = jnp.asarray(np.asarray(stream.pages[:P], np.int32))
+            k = np.asarray(self.pages_k[:, idx])
+            v = np.asarray(self.pages_v[:, idx])
+            payload = {
+                "prompt": np.asarray(stream.prompt, np.int32),
+                "k": k,
+                "v": v,
+                "last_logits": np.asarray(
+                    (stream.kv_payload or {}).get("last_logits"), np.float32
+                ).reshape(-1),
+                "page_size": self.page_size,
+                "layout": "flat" if self._pool_flat else "split",
+            }
+            with self._lock:
+                stream.kv_payload = payload
+                slot = stream.slot
+                if slot is not None and self._slots[slot] is stream:
+                    self._slots[slot] = None
+                    self._lengths[slot] = 0
+                if stream.pages:
+                    self._free_locked(stream.pages)
+                    stream.pages = []
+                stream.slot = None
+                self._counters["kv_exports"] += 1
+                self._counters["completed"] += 1
+                if stream.trace_id:
+                    self._gen_span_deferred(
+                        stream, "gen.finish", _time.time(), 0.0,
+                        slot=slot, tokens=0, kv_export=True,
+                    )
+                stream.event.set()
+
+    def prefill_export(
+        self,
+        prompt: np.ndarray,
+        *,
+        seed: int = 0,
+        priority: int = 0,
+        deadline: Optional[float] = None,
+        drive: bool = True,
+    ) -> Dict[str, Any]:
+        """Synchronous prefill-only front — the prefill WORKER's one
+        call in disaggregated serving: admit ``prompt``, run its
+        (possibly chunked) prefill, and return the KV-page handoff
+        payload for :meth:`submit_prefilled` on a decode engine.
+        ``drive=False`` when another thread owns the step loop (the
+        single-stepper invariant); the default drives inline."""
+        stream = self.submit(
+            np.asarray(prompt), max_new_tokens=1, seed=seed,
+            priority=priority, deadline=deadline, kv_export=True,
+        )
+        if drive:
+            while not stream.event.is_set() and self.has_work():
+                self.step()
+        stream.event.wait()
+        if stream.error is not None:
+            raise stream.error
+        return stream.kv_payload
+
+    def submit_prefilled(self, payload: Dict[str, Any], **kw) -> _Stream:
+        """Admit a prefill worker's KV-page payload for decode (the
+        receiving half of disaggregation); ``kw`` forwards to
+        :meth:`submit` (priority/deadline/streaming — the r10 SLO
+        machinery applies unchanged).  The payload is validated against
+        this engine's pool geometry first, because a scatter of
+        mismatched bytes would serve garbage rather than raise."""
+        prompt = np.asarray(payload["prompt"], np.int32).reshape(-1)
+        k = np.asarray(payload["k"])
+        v = np.asarray(payload["v"])
+        last = np.asarray(payload["last_logits"], np.float32).reshape(-1)
+        ps = int(payload.get("page_size", self.page_size))
+        if ps != self.page_size:
+            raise MicroserviceError(
+                f"KV payload page_size {ps} != engine page_size "
+                f"{self.page_size}: prefill and decode workers must share "
+                "one pool configuration",
+                status_code=400, reason="KV_LAYOUT_MISMATCH",
+            )
+        P = -(-len(prompt) // self.page_size)
+        want = (self.module.num_layers, P) + tuple(self.pages_k.shape[2:])
+        for name, arr in (("k", k), ("v", v)):
+            if tuple(arr.shape) != want:
+                raise MicroserviceError(
+                    f"KV payload {name} shape {tuple(arr.shape)} does not "
+                    f"fit this engine's pool geometry {want} (layers, "
+                    "prompt pages, page tail)",
+                    status_code=400, reason="KV_LAYOUT_MISMATCH",
+                )
+            if arr.dtype != np.dtype(self._dtype):
+                raise MicroserviceError(
+                    f"KV payload {name} dtype {arr.dtype} != pool dtype "
+                    f"{np.dtype(self._dtype)}",
+                    status_code=400, reason="KV_LAYOUT_MISMATCH",
+                )
+        if last.shape[0] != self.vocab_size:
+            raise MicroserviceError(
+                f"KV payload last_logits carries {last.shape[0]} entries, "
+                f"engine vocab is {self.vocab_size}",
+                status_code=400, reason="KV_LAYOUT_MISMATCH",
+            )
+        return self.submit(
+            prompt,
+            kv_import={"k": k, "v": v, "last_logits": last},
+            **kw,
+        )
+
+    def predict_cost_s(
+        self, prompt_len: int, max_new: int
+    ) -> Optional[float]:
+        """Predicted service seconds for one request from this engine's
+        own measured rates (cumulative wall / cumulative tokens —
+        stable after warmup, no tuning): the admission-pricing input
+        disaggregated serving uses to fast-fail deadlines a request
+        cannot meet BEFORE burning prefill on it.  ``None`` while the
+        engine is cold (nothing measured yet — admit unpriced)."""
+        with self._lock:
+            ptok = self._counters["prefill_tokens"]
+            pwall = self._counters["prefill_wall_s"]
+            dtok = self._counters["tokens"]
+            dwall = self._counters["chunk_wall_s"]
+        if ptok <= 0 or pwall <= 0 or dtok <= 0 or dwall <= 0:
+            return None
+        return (
+            float(prompt_len) * (pwall / ptok)
+            + float(max_new) * (dwall / dtok)
+        )
 
     def _ensure_pages_locked(self, stream: _Stream, per_chunk: Optional[int] = None) -> bool:
         """Grow the stream's block table to cover the next chunk."""
@@ -2753,7 +3182,10 @@ class PagedEngine:
             q.put([int(t) for t in new])
 
     def _finish_locked(self, stream: _Stream) -> None:
+        import time as _time
+
         slot = stream.slot
+        stream.t_finish = _time.time()
         toks = stream.tokens[: stream.max_new]
         emitted_n = len(toks)
         eos = stream.eos_id
@@ -2797,29 +3229,36 @@ class PagedEngine:
     def _evict_locked(self, stream: _Stream) -> None:
         """Kick a stream out of its slot back to the queue head; it will
         re-prefill from scratch on re-admission."""
-        slot = stream.slot
-        if stream.trace_id:
-            import time as _time
+        import time as _time
 
-            now = _time.time()
+        slot = stream.slot
+        now = _time.time()
+        if stream.trace_id:
             self._gen_span_deferred(
                 stream, "gen.evict", now, 0.0,
                 slot=slot, tokens_discarded=len(stream.tokens),
                 pages_freed=len(stream.pages),
             )
-            # restart the lifecycle clock: the re-admitted run's
-            # gen.queued must measure the RE-queue wait, not the first
-            # service attempt — otherwise the decomposition blames
-            # served time on the queue-wait term it exists to isolate
-            stream.t_submit = now
-            stream.t_decode_start = 0.0
-            stream.queue_depth_at_submit = len(self._queue)
+        # restart the lifecycle clock (tracer or not — the bench reads
+        # the raw stamps): the re-admitted run's gen.queued must measure
+        # the RE-queue wait, not the first service attempt — otherwise
+        # the decomposition blames served time on the queue-wait term
+        # it exists to isolate
+        stream.t_submit = now
+        stream.t_prefill_start = 0.0
+        stream.t_decode_start = 0.0
+        # the re-derived run re-emits its first token: a stale stamp
+        # would make TTFT (t_first_token - t_submit) go NEGATIVE after
+        # the submit reset above
+        stream.t_first_token = 0.0
+        stream.queue_depth_at_submit = len(self._queue)
         self._slots[slot] = None
         self._free_locked(stream.pages)
         stream.pages = []
         stream.tokens = []
         stream.slot = None
         stream.cached_len = 0  # re-admission re-matches the prefix index
+        stream.prefilled = 0  # chunked prefill restarts (or re-imports)
         self._lengths[slot] = 0
         self._counters["evictions"] += 1
         self._queue.appendleft(stream)
@@ -2928,6 +3367,9 @@ class PagedEngine:
                 # tp_degree term)
                 "tp_degree": self.tp_degree,
                 "pool_shard_bytes": self._pool_shard_bytes,
+                # chunked-prefill co-scheduling (r15): the wave token
+                # budget this engine runs under (0 = monolithic prefill)
+                "chunk_token_budget": self.chunk_token_budget,
                 # distinct compiled signatures seen by the jit sentinels
                 # (prometheus gets the per-program split directly from
                 # jitwatch — bridge-excluded to avoid double export)
@@ -2969,6 +3411,13 @@ class PagedEngine:
             now = _time.monotonic()
             entries: List[Dict[str, Any]] = []
             for s in victims:
+                if s.kv_export or s.kv_import is not None:
+                    # disaggregated handoff streams are not journaled:
+                    # the coordinating component retries the whole
+                    # prefill-export / import round trip itself (a
+                    # replayed import would need the payload persisted,
+                    # and an export's waiter died with this process)
+                    continue
                 entries.append({
                     "req_id": s.req_id,
                     "prompt": [int(t) for t in s.prompt],
@@ -3094,6 +3543,54 @@ class PagedEngine:
                     stream.token_queue.put(None)  # unblock the consumer
                 stream.event.set()
 
+    def _record_prefill_wave(
+        self, *, wall_s: float, tokens: int, occupancy: int,
+        admissions: int, stalls: int, pre_hits: int, pre_saved: int,
+        pre_slo: Dict[str, int],
+    ) -> bool:
+        """Record a wave that carried ONLY prefill work — budgeted
+        prefill-only waves AND waves whose streams all finished at
+        prefill (kv_export workers, spec max_new=1).  Without this the
+        recorder's window mix undercounts against the prefill_tokens
+        counter exactly on pure prefill workers.  Returns step()'s
+        has-more-work value."""
+        with self._lock:
+            if self._debug_invariants:
+                self._check_invariants_locked()
+            more = bool(self._queue) or any(
+                s is not None for s in self._slots
+            )
+            queue_depth = len(self._queue)
+            prefix_hits_d = self._counters["prefix_hits"] - pre_hits
+            prefix_saved_d = (
+                self._counters["prefix_tokens_saved"] - pre_saved
+            )
+            slo_d = {
+                k: self._counters[k] - pre_slo[k]
+                for k in _SLO_COUNTER_KEYS
+            }
+            pages_cached = len(self._lru)
+        self._record_chunk({
+            "phase": "prefill",
+            "wall_ms": round(wall_s * 1000.0, 3),
+            "prefill_wall_ms": round(wall_s * 1000.0, 3),
+            "tp_degree": self.tp_degree,
+            "steps": 0,
+            "buckets": [],
+            "occupancy": occupancy,
+            "admissions": admissions,
+            "stalls": stalls,
+            "queue_depth": queue_depth,
+            "tokens": tokens,
+            "prefill_tokens": tokens,
+            "decode_tokens": 0,
+            "prefix_hits": prefix_hits_d,
+            "prefix_tokens_saved": prefix_saved_d,
+            "prefix_pages_cached": pages_cached,
+            **slo_d,
+        })
+        return more
+
     def step(self) -> bool:
         """Admit + prefill joiners, run one decode chunk, retire finished.
 
@@ -3118,31 +3615,67 @@ class PagedEngine:
             pre_saved = self._counters["prefix_tokens_saved"]
             pre_slo = {k: self._counters[k] for k in _SLO_COUNTER_KEYS}
             admitted = self._admit_locked()
-        self._prefill_streams([s for s, _ in admitted])
+        budget = self.chunk_token_budget
+        wave_prefill_tokens = 0
+        wave_prefill_wall = 0.0
+        if not budget:
+            # monolithic prefill (the historical wave shape): admitted
+            # prompts prefill whole, then decode in this same wave
+            _done, wave_prefill_tokens, wave_prefill_wall = (
+                self._prefill_streams([s for s, _ in admitted])
+            )
 
         with self._lock:
             self._counters["prefills"] += len(admitted)
             active = self._retire_cancelled_locked(
                 [s for s in self._slots if s is not None]
             )
-            if not active:
+        if not active:
+            # every admitted stream finished AT prefill (kv_export
+            # workers, cancellations): the wave still carried prefill
+            # work and must be recorded, or a pure prefill worker's
+            # window mix reads zero
+            if wave_prefill_tokens:
+                return self._record_prefill_wave(
+                    wall_s=wave_prefill_wall, tokens=wave_prefill_tokens,
+                    occupancy=0, admissions=len(admitted), stalls=0,
+                    pre_hits=pre_hits, pre_saved=pre_saved,
+                    pre_slo=pre_slo,
+                )
+            with self._lock:
                 return bool(self._queue)
+        with self._lock:
+            if budget:
+                # chunked co-scheduling (r15): only fully-prefilled
+                # streams decode THIS wave — a stream whose final slice
+                # runs below starts decoding next wave, which is what
+                # bounds the wave at the token budget (its lane stays
+                # masked in done_in)
+                decoding = [
+                    s for s in active if s.prefilled >= len(s.prompt)
+                ]
+                prefilling = [
+                    s for s in active if s.prefilled < len(s.prompt)
+                ]
+            else:
+                decoding, prefilling = list(active), []
             # saturated-decode ladder: with nothing waiting for a slot,
             # bigger chunks amortise the per-call round-trip; a waiting
-            # queue pins the short chunk so admission cadence (not the
-            # chunk length) stays the latency bound.  Each doubling is
-            # taken only if the POOL can back it for every active
+            # queue (or a chunked-prefill backlog, which needs wave
+            # cadence for its slices) pins the short chunk so admission
+            # latency stays bounded by the chunk length.  Each doubling
+            # is taken only if the POOL can back it for every decoding
             # stream — otherwise a shrunk pool would mass-stall and the
             # evict/re-admit cycle would discard decoded progress that
             # base-size chunks were making steadily.
             steps = self.steps_per_call
-            if not self._queue:
-                most = max(s.max_new - len(s.tokens) for s in active)
+            if decoding and not self._queue and not prefilling:
+                most = max(s.max_new - len(s.tokens) for s in decoding)
                 free = self._allocatable_locked()  # LRU-cached pages reclaim on demand
                 while steps * 2 <= self.max_steps and steps < most:
                     nxt = steps * 2
                     need = 0
-                    for s in active:
+                    for s in decoding:
                         horizon = min(
                             int(self._lengths[s.slot]) + nxt,
                             len(s.prompt) + s.max_new,
@@ -3155,40 +3688,56 @@ class PagedEngine:
                         break
                     steps = nxt
             stalled = np.zeros((self.max_slots,), bool)
-            for stream in active:
+            for stream in decoding:
                 if not self._ensure_pages_locked(stream, per_chunk=steps):
                     stalled[stream.slot] = True
             self._counters["stalls"] += int(stalled.sum())
-            # every active stream stalled on pool pressure: evict victims
-            # (least progress lost, ties to the youngest) back to the head
-            # of the queue until someone can run.  Seeds are deterministic
-            # per stream, so a re-run reproduces the same tokens — callers
-            # see latency, never corruption.  Terminates because a lone
-            # stream always fits (submit() rejects need > num_pages-1).
-            while active and all(stalled[s.slot] for s in active):
-                victim = min(active, key=lambda s: (len(s.tokens), -s.req_id))
-                active.remove(victim)
+            # every decoding stream stalled on pool pressure: evict
+            # victims (least progress lost, ties to the youngest) back to
+            # the head of the queue until someone can run.  Seeds are
+            # deterministic per stream, so a re-run reproduces the same
+            # tokens — callers see latency, never corruption.  Terminates
+            # because a lone stream always fits (submit() rejects need >
+            # num_pages-1).  With a chunked-prefill backlog the eviction
+            # loop stands down: prefill slices ARE progress this wave,
+            # and their completions turn into decoders next wave.
+            while (
+                decoding and not prefilling
+                and all(stalled[s.slot] for s in decoding)
+            ):
+                victim = min(decoding, key=lambda s: (len(s.tokens), -s.req_id))
+                decoding.remove(victim)
                 self._evict_locked(victim)
-                for stream in active:
+                for stream in decoding:
                     if stalled[stream.slot] and self._ensure_pages_locked(
                         stream, per_chunk=steps
                     ):
                         stalled[stream.slot] = False
-            if not active:
+            if not decoding and not prefilling:
                 return bool(self._queue)
+            runnable_now = [s for s in decoding if not stalled[s.slot]]
+            if budget and runnable_now:
+                # decode admitted FIRST: never squeezed below one step,
+                # but capped so decode + prefill stay inside the budget
+                steps = min(steps, max(1, budget // len(runnable_now)))
+            slices = (
+                self._plan_prefill_slices_locked(
+                    prefilling, budget - steps * len(runnable_now)
+                )
+                if budget else []
+            )
             done_in = np.ones((self.max_slots,), bool)
             max_new = np.zeros((self.max_slots,), np.int32)
             temps = np.zeros((self.max_slots,), np.float32)
             top_ks = np.zeros((self.max_slots,), np.int32)
             eos_ids = np.full((self.max_slots,), -1, np.int32)
-            for stream in active:
+            for stream in decoding:
                 s = stream.slot
                 done_in[s] = stalled[s]
                 max_new[s] = stream.max_new - len(stream.tokens)
                 temps[s] = stream.temperature
                 top_ks[s] = stream.top_k
                 eos_ids[s] = stream.eos_id
-            runnable_now = [s for s in active if not stalled[s.slot]]
             pages_h = self._pages_horizon(runnable_now, steps)
             # ctx horizons for the chunk: per length bucket (the ring
             # impl gathers only pages holding tokens that EXIST at
@@ -3200,6 +3749,32 @@ class PagedEngine:
             emitted0 = jnp.zeros((self.max_slots,), jnp.int32)
 
         import time as _time
+
+        # chunked-prefill slices run BEFORE the decode chunk: the wave's
+        # budget covers both, and streams completing here decode next
+        # wave (their lanes stay masked in this chunk's done_in)
+        if slices:
+            _done, ptok, pwall = self._run_prefill_slices(slices)
+            wave_prefill_tokens += ptok
+            wave_prefill_wall += pwall
+        if not runnable_now:
+            # prefill-only wave: no decode lane could run, but slices
+            # made progress (or every decoder awaits pages a chunking
+            # prompt still holds) — record the wave so the scheduler's
+            # chunk mix stays observable
+            if wave_prefill_tokens:
+                return self._record_prefill_wave(
+                    wall_s=wave_prefill_wall, tokens=wave_prefill_tokens,
+                    occupancy=len(active), admissions=len(admitted),
+                    stalls=int(stalled.sum()), pre_hits=pre_hits,
+                    pre_saved=pre_saved, pre_slo=pre_slo,
+                )
+            with self._lock:
+                if self._debug_invariants:
+                    self._check_invariants_locked()
+                return bool(self._queue) or any(
+                    s is not None for s in self._slots
+                )
 
         try:
             # fault point paged.chunk fires BEFORE the device call is
@@ -3235,7 +3810,8 @@ class PagedEngine:
             self._counters["bucketed_chunks"] += int(len(buckets) > 1)
             self._counters["chunk_wall_s"] += chunk_wall
             chunk_tokens = 0
-            for stream in active:
+            t_now = _time.time()
+            for stream in decoding:
                 s = stream.slot
                 if stalled[s]:
                     continue
@@ -3243,6 +3819,11 @@ class PagedEngine:
                 self._counters["tokens"] += n
                 chunk_tokens += n
                 got = toks_np[s, :n].tolist()
+                if got and not stream.tokens and not stream.t_first_token:
+                    # TTFT numerator: the stream's first decode token
+                    # landed in this chunk (chunk-boundary resolution —
+                    # the finest the host observes)
+                    stream.t_first_token = t_now
                 stream.tokens.extend(got)
                 hit_eos = stream.eos_id in got
                 if hit_eos or len(stream.tokens) >= stream.max_new:
@@ -3260,6 +3841,7 @@ class PagedEngine:
         self._record_chunk({
             "phase": "decode",
             "wall_ms": round(chunk_wall * 1000.0, 3),
+            "prefill_wall_ms": round(wave_prefill_wall * 1000.0, 3),
             "tp_degree": self.tp_degree,
             "steps": steps,
             "buckets": [list(b) for b in buckets],
@@ -3267,7 +3849,13 @@ class PagedEngine:
             "admissions": len(admitted),
             "stalls": int(stalled.sum()),
             "queue_depth": queue_depth,
-            "tokens": chunk_tokens,
+            # the wave's token mix: "tokens" is the TOTAL work the wave
+            # carried (the budgeted quantity); the split is what the
+            # chunk-mix observability reads (r15 — "tokens" used to
+            # conflate the two on admission waves)
+            "tokens": chunk_tokens + wave_prefill_tokens,
+            "prefill_tokens": wave_prefill_tokens,
+            "decode_tokens": chunk_tokens,
             "prefix_hits": prefix_hits_d,
             "prefix_tokens_saved": prefix_saved_d,
             "prefix_pages_cached": pages_cached,
@@ -3283,6 +3871,8 @@ class PagedEngine:
         batched forward — speculative decode and continuous batching
         compose instead of being separate lanes.
         """
+        import time as _time
+
         from seldon_core_tpu.models.speculative import ngram_draft
 
         jnp = self._jnp
@@ -3291,14 +3881,45 @@ class PagedEngine:
             pre_saved = self._counters["prefix_tokens_saved"]
             pre_slo = {k: self._counters[k] for k in _SLO_COUNTER_KEYS}
             admitted = self._admit_locked()
-        self._prefill_streams([s for s, _ in admitted])
+        budget = self.chunk_token_budget
+        wave_prefill_tokens = 0
+        wave_prefill_wall = 0.0
+        fresh: List[_Stream] = []
+        slices: List[Tuple[_Stream, int, int]] = []
+        if not budget:
+            fresh, wave_prefill_tokens, wave_prefill_wall = (
+                self._prefill_streams([s for s, _ in admitted])
+            )
+        else:
+            # chunked co-scheduling, verify-first: every fully-prefilled
+            # stream's verify forward is priced at its fixed width
+            # (draft_k+1 — verification cannot shrink), the rest of the
+            # budget goes to prompt slices
+            with self._lock:
+                live = [s for s in self._slots if s is not None]
+                verify_lanes = sum(
+                    1 for s in live if s.prefilled >= len(s.prompt)
+                )
+                slices = self._plan_prefill_slices_locked(
+                    [s for s in live if s.prefilled < len(s.prompt)],
+                    budget - verify_lanes * (self.draft_k + 1),
+                )
+            if slices:
+                fresh, wave_prefill_tokens, wave_prefill_wall = (
+                    self._run_prefill_slices(slices)
+                )
 
         with self._lock:
             self._counters["prefills"] += len(admitted)
-            for stream, _ in admitted:
+            t_now = _time.time()
+            for stream in fresh:
                 # the prefill's argmax IS the first generated token:
                 # emit it now so round 1 verifies continuations of it
                 # (pending == tokens[-1] is the loop invariant)
+                if stream.result is not None or stream.error is not None:
+                    continue
+                if not stream.t_first_token:
+                    stream.t_first_token = t_now
                 stream.tokens.append(int(stream.pending))
                 self._counters["tokens"] += 1
                 if stream.pending == stream.eos_id or len(stream.tokens) >= stream.max_new:
@@ -3309,17 +3930,53 @@ class PagedEngine:
                 [s for s in self._slots if s is not None]
             )
             if not active:
+                wave_done_early = True
+            else:
+                wave_done_early = False
+        if wave_done_early:
+            # every stream finished at/with prefill (kv_export, or the
+            # pending-append completed max_new==1 streams): still a
+            # prefill wave the recorder must see
+            if wave_prefill_tokens:
+                return self._record_prefill_wave(
+                    wall_s=wave_prefill_wall, tokens=wave_prefill_tokens,
+                    occupancy=0, admissions=len(admitted), stalls=0,
+                    pre_hits=pre_hits, pre_saved=pre_saved,
+                    pre_slo=pre_slo,
+                )
+            with self._lock:
                 return bool(self._queue)
+        with self._lock:
+            # chunked: streams mid-prefill never verify, and streams
+            # whose final slice ran THIS wave verify next wave (that is
+            # what keeps the wave inside its planned token count)
+            fresh_ids = {id(s) for s in fresh} if budget else set()
+            verify_set = [
+                s for s in active
+                if s.prefilled >= len(s.prompt) and id(s) not in fresh_ids
+            ]
             stalled = np.zeros((self.max_slots,), bool)
-            for stream in active:
+            for stream in verify_set:
                 if not self._ensure_pages_locked(stream):
                     stalled[stream.slot] = True
             self._counters["stalls"] += int(stalled.sum())
-            while active and all(stalled[s.slot] for s in active):
-                victim = min(active, key=lambda s: (len(s.tokens), -s.req_id))
+            # eviction stands down ONLY when this wave's prefill slices
+            # actually progressed — gating on a mere backlog would
+            # livelock when every verify lane is page-starved AND the
+            # verify-first pricing left the planner under one page
+            # (stalled lanes were priced in): no slice, no verify, and
+            # no eviction would ever run
+            while (
+                verify_set and not slices
+                and all(stalled[s.slot] for s in verify_set)
+            ):
+                victim = min(
+                    verify_set, key=lambda s: (len(s.tokens), -s.req_id)
+                )
+                verify_set.remove(victim)
                 active.remove(victim)
                 self._evict_locked(victim)
-                for stream in active:
+                for stream in verify_set:
                     if stalled[stream.slot] and self._ensure_pages_locked(stream):
                         stalled[stream.slot] = False
             if not active:
@@ -3328,7 +3985,7 @@ class PagedEngine:
             segs = np.zeros((self.max_slots, L), np.int32)
             n_drafts = np.zeros((self.max_slots,), np.int32)
             active_mask = np.zeros((self.max_slots,), bool)
-            runnable = [s for s in active if not stalled[s.slot]]
+            runnable = [s for s in verify_set if not stalled[s.slot]]
             mode = self.speculative["draft"]
             model_drafts = None
             if mode == "model" and runnable:
@@ -3386,8 +4043,10 @@ class PagedEngine:
             lengths = jnp.asarray(self._lengths)
 
         if not runnable:
+            # nothing to verify this wave; prefill slices (or the
+            # freshly-completed streams now waiting a wave) are the
+            # progress — there is more work by construction
             return True
-        import time as _time
 
         try:  # same pre-device-call containment as the decode path
             _faults.raise_if("paged.chunk")
@@ -3437,6 +4096,7 @@ class PagedEngine:
         self._record_chunk({
             "phase": "spec_verify",
             "wall_ms": round(chunk_wall * 1000.0, 3),
+            "prefill_wall_ms": round(wave_prefill_wall * 1000.0, 3),
             "tp_degree": self.tp_degree,
             "steps": self.draft_k + 1,
             "buckets": [],
@@ -3444,7 +4104,9 @@ class PagedEngine:
             "admissions": len(admitted),
             "stalls": int(stalled.sum()),
             "queue_depth": queue_depth,
-            "tokens": chunk_tokens,
+            "tokens": chunk_tokens + wave_prefill_tokens,
+            "prefill_tokens": wave_prefill_tokens,
+            "decode_tokens": chunk_tokens,
             "prefix_hits": prefix_hits_d,
             "prefix_tokens_saved": prefix_saved_d,
             "prefix_pages_cached": pages_cached,
@@ -3512,6 +4174,7 @@ class StreamingLM(TPUComponent):
         speculative: Optional[Dict[str, Any]] = None,
         prefix_cache: Optional[bool] = None,
         max_queue: int = 0,
+        chunk_token_budget: int = 0,
         **kwargs: Any,
     ):
         super().__init__(**kwargs)
@@ -3541,6 +4204,9 @@ class StreamingLM(TPUComponent):
             # bounded run queue with priority shedding (0 defers to
             # SELDON_TPU_MAX_QUEUE; 0 = unbounded)
             max_queue=int(max_queue),
+            # chunked-prefill co-scheduling (0 defers to
+            # SELDON_TPU_CHUNK_TOKEN_BUDGET; 0 = monolithic prefill)
+            chunk_token_budget=int(chunk_token_budget),
         )
         self.mesh_axes = dict(mesh_axes) if mesh_axes else None
         # tensor-parallel serving degree (r11): `tp=N` (or SELDON_TPU_TP
@@ -3748,6 +4414,23 @@ class StreamingLM(TPUComponent):
                 logger.exception("drain journal write failed (%s)", path)
         return entries
 
+    def _request_seed(self, tags, meta) -> int:
+        """The per-request sampling seed rule shared by every serving
+        front (unary, streaming, disaggregated): explicit ``seed`` tag
+        wins, else the request puid hashes deterministically (a retried
+        request reproduces its continuation), else a per-process
+        counter keeps distinct requests actually sampling."""
+        if "seed" in tags:
+            return int(tags["seed"])
+        puid = meta.get("puid", "")
+        if puid:
+            import zlib
+
+            return zlib.crc32(puid.encode())
+        with self._counter_lock:
+            self._counter += 1
+            return self._counter
+
     @staticmethod
     def _slo_terms(tags) -> Tuple[int, Optional[float]]:
         """Per-request SLO terms: the ``priority`` tag (higher wins,
@@ -3801,18 +4484,7 @@ class StreamingLM(TPUComponent):
         top_k = int(tags.get("top_k", self.top_k))
         # sampling must actually sample across requests unless pinned:
         # tag override > puid > per-process counter (GenerativeLM's rule)
-        if "seed" in tags:
-            request_seed = int(tags["seed"])
-        else:
-            puid = meta.get("puid", "")
-            if puid:
-                import zlib
-
-                request_seed = zlib.crc32(puid.encode())
-            else:
-                with self._counter_lock:
-                    self._counter += 1
-                    request_seed = self._counter
+        request_seed = self._request_seed(tags, meta)
         priority, deadline = self._slo_terms(tags)
         X = np.atleast_2d(np.asarray(X, np.int32))
         streams = []
@@ -3860,18 +4532,7 @@ class StreamingLM(TPUComponent):
         # streamed request samples identically to the unary predict of
         # the same request (and a retried stream with the same puid
         # reproduces its continuation)
-        if "seed" in tags:
-            request_seed = int(tags["seed"])
-        else:
-            puid = meta.get("puid", "")
-            if puid:
-                import zlib
-
-                request_seed = zlib.crc32(puid.encode())
-            else:
-                with self._counter_lock:
-                    self._counter += 1
-                    request_seed = self._counter
+        request_seed = self._request_seed(tags, meta)
         X = np.atleast_2d(np.asarray(X, np.int32))
         if X.shape[0] != 1:
             raise MicroserviceError(

@@ -31,13 +31,17 @@ program (fixed k+1 segment) — rounds never re-trace.
 
 from __future__ import annotations
 
+import logging
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
 from seldon_core_tpu.models.generate import _buckets_for
 from seldon_core_tpu.models.paged import get_paged_lm_class, write_kv
+from seldon_core_tpu.runtime import knobs as _knobs
 from seldon_core_tpu.runtime.component import MicroserviceError, TPUComponent
+
+logger = logging.getLogger(__name__)
 
 
 def ngram_draft(context: np.ndarray, k: int, ngram: int = 2) -> np.ndarray:
@@ -134,6 +138,7 @@ class SpeculativeGenerator:
         model_axis: str = "model",
         shard_min_weight_size: int = 16_384,
         quantize: str = "",
+        chunk_token_budget: int = 0,
     ):
         import jax
         import jax.numpy as jnp
@@ -161,6 +166,23 @@ class SpeculativeGenerator:
         self.draft_k = int(draft_k)
         self.ngram = int(ngram)
         self.prompt_buckets = sorted(set(prompt_buckets or _buckets_for(max_len)))
+        # chunked prompt prefill (r15, same knob as the paged engine):
+        # the prompt forwards in page-aligned chunks of ONE static
+        # width instead of one bucket-sized program — bounds the
+        # longest device call AND caps prompt-prefill compile diversity
+        # at one program per width.  0 = off (the historical
+        # bucket-padded prefill, byte-identical programs).
+        if not chunk_token_budget:
+            chunk_token_budget = int(
+                _knobs.raw("SELDON_TPU_CHUNK_TOKEN_BUDGET", "0") or 0
+            )
+        self.chunk_token_budget = max(0, int(chunk_token_budget))
+        if self.chunk_token_budget and self.chunk_token_budget < page_size:
+            logger.warning(
+                "chunk_token_budget %d is under one page (%d); clamping",
+                self.chunk_token_budget, page_size,
+            )
+            self.chunk_token_budget = page_size
         self.stats = {"rounds": 0, "drafted": 0, "accepted": 0, "tokens": 0}
 
         cls = get_paged_lm_class()
@@ -228,6 +250,79 @@ class SpeculativeGenerator:
         )
         return np.asarray(greedy)
 
+    def _forward_chunk(self, state: _PagedState, tokens: np.ndarray,
+                       start: int):
+        """One page-aligned prompt chunk at absolute offset ``start``:
+        reads the pool through the full table masked at
+        ``lengths=start`` (earlier chunks' KV), writes whole page
+        blocks through the table WINDOW at ``start``'s page (page 0 —
+        the trash page — pads a window that runs past the table, the
+        same redirection the engine's prefill uses).  One compiled
+        program per chunk WIDTH, shared by every offset: ``start`` and
+        the window are traced."""
+        jax, jnp = self._jax, self._jnp
+        W = tokens.shape[1]
+        wpages = -(-W // self.page_size)
+        key = (id(state.module), W, "chunk")
+        if key not in self._forward_jit:
+
+            def run(params, pk, pv, toks, start, table, wtable):
+                from seldon_core_tpu.ops.surgery import materialize
+
+                params = materialize(params, state.quantize, state.dtype)
+                positions = start + jnp.arange(toks.shape[1])[None, :]
+                positions = jnp.minimum(positions, state.max_len - 1)
+                logits, nk, nv = state.module.apply(
+                    {"params": params}, toks, positions, pk, pv,
+                    table, jnp.full((1,), start, jnp.int32),
+                )
+                pk, pv = write_kv(
+                    pk, pv, nk, nv, wtable, jnp.zeros((1,), jnp.int32),
+                    jnp.ones_like(toks, bool),
+                    page_size=state.page_size, max_len=state.max_len,
+                    from_zero=True,
+                )
+                return jnp.argmax(logits[0], axis=-1), pk, pv
+
+            self._forward_jit[key] = jax.jit(run, donate_argnums=(1, 2))
+        shift = int(start) // self.page_size
+        window = np.asarray(state.table[0, shift : shift + wpages])
+        wt = np.zeros((1, wpages), np.int32)
+        wt[0, : len(window)] = window
+        greedy, state.pk, state.pv = self._forward_jit[key](
+            state.params, state.pk, state.pv, jnp.asarray(tokens),
+            jnp.asarray(start, jnp.int32), state.table, jnp.asarray(wt),
+        )
+        return np.asarray(greedy)
+
+    def _prefill_prompt(self, state: _PagedState, prompt: np.ndarray) -> int:
+        """Prompt prefill for one state; returns the next greedy token.
+        Monolithic bucket-padded forward by default; with
+        ``chunk_token_budget`` set, page-aligned chunks of one static
+        width (the r15 slice shape) — same KV, same argmax, bounded
+        device calls."""
+        plen = len(prompt)
+        budget = self.chunk_token_budget
+        if not budget or plen <= budget:
+            bucket = next(b for b in self.prompt_buckets if b >= plen)
+            padded = np.zeros((1, bucket), np.int32)
+            padded[0, :plen] = prompt
+            greedy = self._forward(state, padded, 0)
+            state.length = plen
+            return int(greedy[plen - 1])
+        W = (budget // self.page_size) * self.page_size
+        start = 0
+        greedy = None
+        n = 0
+        while start < plen:
+            n = min(W, plen - start)
+            seg = np.zeros((1, W), np.int32)
+            seg[0, :n] = prompt[start : start + n]
+            greedy = self._forward_chunk(state, seg, start)
+            start += n
+            state.length = start
+        return int(greedy[n - 1])
+
     # ---- drafting ---------------------------------------------------------
 
     def _draft(self, context: np.ndarray, k: int) -> np.ndarray:
@@ -279,16 +374,10 @@ class SpeculativeGenerator:
         if self.draft_state is not None:
             self.draft_state.length = 0
 
-        bucket = next(b for b in self.prompt_buckets if b >= plen)
-        padded = np.zeros((1, bucket), np.int32)
-        padded[0, :plen] = prompt
-        greedy = self._forward(self.target, padded, 0)
-        self.target.length = plen
+        next_token = self._prefill_prompt(self.target, prompt)
         if self.draft_state is not None:
             # prime the draft cache on the same prompt
-            self._forward(self.draft_state, padded, 0)
-            self.draft_state.length = plen
-        next_token = int(greedy[plen - 1])
+            self._prefill_prompt(self.draft_state, prompt)
 
         out: List[int] = [next_token]
         while len(out) < max_new_tokens and next_token != eos_id:
@@ -361,6 +450,7 @@ class SpeculativeLM(TPUComponent):
         mesh_axes: Optional[Dict[str, int]] = None,
         tp: int = 0,
         quantize: str = "",
+        chunk_token_budget: int = 0,
         **kwargs: Any,
     ):
         super().__init__(**kwargs)
@@ -387,6 +477,9 @@ class SpeculativeLM(TPUComponent):
         from seldon_core_tpu.ops.surgery import validate_quantize_mode
 
         self.quantize = validate_quantize_mode(quantize)  # fail at construction
+        # chunked prompt prefill (r15): 0 defers to the
+        # SELDON_TPU_CHUNK_TOKEN_BUDGET knob inside the generator
+        self.chunk_token_budget = int(chunk_token_budget)
         self.generator: Optional[SpeculativeGenerator] = None
         import threading
 
@@ -429,6 +522,7 @@ class SpeculativeLM(TPUComponent):
             draft=self.draft, draft_k=self.draft_k, ngram=self.ngram,
             draft_params=draft_params, draft_config=self.draft_config,
             mesh=mesh, tp=self.tp or None, quantize=self.quantize,
+            chunk_token_budget=self.chunk_token_budget,
             **self.config,
         )
 

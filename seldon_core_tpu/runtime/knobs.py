@@ -174,6 +174,25 @@ ENV_KNOBS: Dict[str, Knob] = _knobs(
     Knob("SELDON_TPU_MAX_QUEUE", "int", "0", True,
          "bounded run-queue depth for priority shedding (0 = unbounded)",
          "operations.md overload-runbook"),
+    Knob("SELDON_TPU_CHUNK_TOKEN_BUDGET", "int", "0", True,
+         "chunked-prefill co-scheduling: max tokens one engine wave may "
+         "carry, filled decode-first then with page-aligned prompt "
+         "slices (0 = off: monolithic prefill, the historical engine)",
+         "architecture.md §5b-quater"),
+    Knob("SELDON_TPU_PREFILL_WORKERS", "int", "0", True,
+         "disaggregated serving: dedicated prefill workers streaming "
+         "finished KV pages into the decode engine's pool (0 = off: "
+         "unified prefill+decode engine)",
+         "architecture.md §5b-quater"),
+    Knob("SELDON_TPU_DISAGG_ROLE", "str", "", False,
+         "role pin for supervisor-spawned disaggregated workers "
+         "('prefill' | 'decode'; empty = unified engine)",
+         "architecture.md §5b-quater"),
+    Knob("SELDON_TPU_ADMISSION_PRICING", "flag", "1", True,
+         "disaggregated admission prices a request by predicted "
+         "prefill+decode cost and fast-fails deadlines it cannot meet "
+         "(0 = admit everything, price nothing)",
+         "architecture.md §5b-quater"),
     Knob("SELDON_TPU_JIT_SENTINEL", "flag", "1", True,
          "XLA recompile sentinel on engine jit entry points (0 = off)",
          "architecture.md §5c"),

@@ -121,11 +121,22 @@ class FlightRecorder:
             n = len(self._ring)
             last = self._ring[-1] if n else {}
             p99 = self._p99_ms_locked()
+            # the window's prefill/decode token mix (r15): what the
+            # chunked-prefill scheduler actually interleaved — the
+            # /debug/engine and profile-tool chunk-mix summary
+            prefill_toks = sum(
+                int(r.get("prefill_tokens", 0)) for r in self._ring
+            )
+            decode_toks = sum(
+                int(r.get("decode_tokens", 0)) for r in self._ring
+            )
         return {
             "records": n,
             "seq": self._seq,
             "chunk_p99_ms": round(p99, 3),
             "last_queue_depth": int(last.get("queue_depth", 0)),
+            "window_prefill_tokens": prefill_toks,
+            "window_decode_tokens": decode_toks,
             "dumps": self.dumps,
         }
 

@@ -278,6 +278,23 @@ ENGINE_STATS_METRICS: Dict[str, Tuple[str, str, str]] = {
                             "seldon_tpu_engine_prefix_cache_tokens_saved_total",
                             "prompt tokens whose prefill was skipped via "
                             "cached prefix pages"),
+    # chunked-prefill co-scheduling (r15): the prefill/decode token
+    # split — "tokens" counts decode, these count the prompt side and
+    # the prefill device calls that carried it, so the chunk-mix
+    # dashboards can decompose a wave's work
+    "prefill_tokens": ("counter", "seldon_tpu_engine_prefill_tokens_total",
+                       "prompt tokens whose KV was computed by prefill "
+                       "programs (cache hits and KV imports excluded)"),
+    "prefill_chunks": ("counter", "seldon_tpu_engine_prefill_chunks_total",
+                       "prefill device calls (whole prompts and "
+                       "token-budget chunk slices alike)"),
+    # disaggregated prefill/decode (r15): the KV-page handoff lane
+    "kv_exports": ("counter", "seldon_tpu_engine_kv_exports_total",
+                   "prefills exported as KV-page handoff payloads "
+                   "(prefill-worker role)"),
+    "kv_imports": ("counter", "seldon_tpu_engine_kv_imports_total",
+                   "KV-page payloads scatter-written into this pool "
+                   "(decode-worker role)"),
     # self-healing lifecycle (r12): drain/handoff observability — a
     # drained engine journals its live streams for a respawned engine
     # to replay through the ordinary submit path
@@ -324,6 +341,9 @@ ENGINE_STATS_METRICS: Dict[str, Tuple[str, str, str]] = {
     "pool_shard_bytes": ("gauge", "seldon_tpu_engine_pool_shard_bytes",
                          "K+V pool bytes ONE device holds (per-shard "
                          "under tensor parallelism, full pool at tp=1)"),
+    "chunk_token_budget": ("gauge", "seldon_tpu_engine_chunk_token_budget",
+                           "token budget one engine wave may carry "
+                           "(0 = monolithic prefill)"),
 }
 
 # keys intentionally NOT exported as their own series: the wall-clock

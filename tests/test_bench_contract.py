@@ -160,6 +160,21 @@ def _r3_like_full_result():
                 "interactive_p99_x": 1.34,
                 "overload_expired_streams": 0,
                 "overload_mix": "24 batch (prio 0, 128 new) + 8 interactive (prio 2, 16 new, 60s deadline) into 8 slots, queue bound 16",
+                "ttft_p99_ms": 310.2,
+                "ttft_unchunked_p99_ms": 905.7,
+                "ttft_x": 2.92,
+                "gen_p99_terms_ms": {
+                    "queue_wait": 45.0, "prefill": 60.1, "decode": 210.4,
+                },
+                "gen_p99_terms_unchunked_ms": {
+                    "queue_wait": 620.3, "prefill": 160.9, "decode": 300.2,
+                },
+                "gen_p99_dominant": "decode",
+                "chunk_mix": {
+                    "budget": 256, "window_prefill_tokens": 8400,
+                    "window_decode_tokens": 3800, "interactive_served": 8,
+                },
+                "chunked_prefill_protocol": "16 batch (448-token prompts, 96 new, prio 0) + 8 interactive (24-40 tokens, 16 new, prio 2, mid-decode) into 8 slots; budget 256 vs monolithic",
             },
             "trace_prop": {
                 "trace_on_tok_s": 4360.0,
@@ -328,6 +343,67 @@ def test_compact_line_carries_overload_story(bench):
     assert "interactive_p99_x" not in e
     assert "interactive_unloaded_p99_ms" not in e
     assert "overload_mix" not in e
+
+
+def test_compact_line_carries_chunked_prefill_story(bench):
+    """r15 certification keys: interactive TTFT p99 under bimodal load
+    with the token-budget chunk scheduler on, and the dominant term of
+    the per-request p99 decomposition (the ROADMAP-2 gate: queue_wait
+    no longer dominant).  The unchunked contrast arm, the full terms
+    breakdown, and the chunk mix stay in bench_full.json."""
+    compact = bench._compact_result(_r3_like_full_result())
+    e = compact["extra"]
+    assert isinstance(e["ttft_p99_ms"], float)
+    assert e["ttft_p99_ms"] == 310.2
+    assert e["gen_p99_dominant"] == "decode"
+    assert "ttft_unchunked_p99_ms" not in e
+    assert "ttft_x" not in e
+    assert "gen_p99_terms_ms" not in e
+    assert "gen_p99_terms_unchunked_ms" not in e
+    assert "chunk_mix" not in e
+    assert "chunked_prefill_protocol" not in e
+
+
+def test_capacity_accounting_prices_inflight_prefill():
+    """r15 bugfix: a prompt admitted but still chunking holds its whole
+    block table mapped while contributing no decode — the accounting
+    must reserve those pages off the top, or chunked prefill
+    over-admits during the chunking window."""
+    from seldon_core_tpu.models.paged import (
+        paged_capacity_streams,
+        paged_hbm_accounting,
+    )
+
+    kw = dict(
+        d_model=512, num_layers=8, page_size=64, steps_per_call=8,
+        dtype_bytes=2, flat_pool=True, chunk_impl="ring",
+    )
+    zero = paged_hbm_accounting(streams=1, ctx_len=512, **kw)
+    one = paged_hbm_accounting(
+        streams=1, ctx_len=512, inflight_prefill_tokens=512, **kw
+    )
+    assert zero["inflight_prefill_bytes"] == 0
+    assert one["inflight_prefill_bytes"] > 0
+    # the reservation lands in peak_bytes, nothing else moves
+    assert one["peak_bytes"] == (
+        zero["peak_bytes"] + one["inflight_prefill_bytes"]
+    )
+    assert one["pool_bytes"] == zero["pool_bytes"]
+    # capacity: 8 streams' worth of in-flight prefill displaces at
+    # most 8 admissions (pool bytes only — no working-set term), and
+    # at least one
+    base = paged_capacity_streams(8 << 30, 512, **kw)
+    chunking = paged_capacity_streams(
+        8 << 30, 512, inflight_prefill_tokens=8 * 512, **kw
+    )
+    assert base - 8 <= chunking < base
+    # partial pages round UP to whole mapped pages
+    part = paged_hbm_accounting(
+        streams=1, ctx_len=512, inflight_prefill_tokens=65, **kw
+    )
+    assert part["inflight_prefill_bytes"] == paged_hbm_accounting(
+        streams=1, ctx_len=512, inflight_prefill_tokens=128, **kw
+    )["inflight_prefill_bytes"]
 
 
 def test_compact_line_carries_chaos_story(bench):

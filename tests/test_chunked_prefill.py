@@ -1,0 +1,431 @@
+"""Chunked-prefill co-scheduling (r15): the token-budget wave planner.
+
+Correctness bar: greedy decode is bit-exact chunked-on vs chunked-off —
+a slice computes exactly the attention the monolithic prefill computes
+— across chunk impls (ring | pool) × w8a8 × prefix-cache, in the f32
+exactness regime (the same single-numeric-regime discipline every
+cross-program parity suite here uses).
+
+Fast tier: budget accounting (a wave never exceeds the token budget,
+decode admitted first, page-aligned slices, priority ordering), knob
+resolution, recorder/stats surfaces.  The full parity matrix is @slow.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from seldon_core_tpu.models.paged import PagedEngine, StreamingLM, _Stream
+from seldon_core_tpu.models.transformer import TransformerLM
+
+CFG = dict(vocab_size=64, d_model=32, num_layers=1, num_heads=2, max_len=256)
+
+
+@pytest.fixture(scope="module")
+def params():
+    lm = TransformerLM(dtype=jnp.float32, **CFG)
+    return lm.init(jax.random.key(0), jnp.zeros((1, 8), jnp.int32))["params"]
+
+
+def _engine(params, **kw):
+    base = dict(dtype=jnp.float32, page_size=8, max_slots=3, steps_per_call=4)
+    base.update(kw)
+    return PagedEngine(params, **CFG, **base)
+
+
+def _prompts(sizes, seed=3):
+    rng = np.random.default_rng(seed)
+    return [
+        rng.integers(0, CFG["vocab_size"], size=(n,)).astype(np.int32)
+        for n in sizes
+    ]
+
+
+class TestKnobResolution:
+    def test_ctor_wins_over_env(self, params, monkeypatch):
+        monkeypatch.setenv("SELDON_TPU_CHUNK_TOKEN_BUDGET", "64")
+        eng = _engine(params, chunk_token_budget=24)
+        assert eng.chunk_token_budget == 24
+        eng.close()
+
+    def test_env_applies_when_ctor_unset(self, params, monkeypatch):
+        monkeypatch.setenv("SELDON_TPU_CHUNK_TOKEN_BUDGET", "64")
+        eng = _engine(params)
+        assert eng.chunk_token_budget == 64
+        eng.close()
+
+    def test_zero_spells_off(self, params, monkeypatch):
+        monkeypatch.setenv("SELDON_TPU_CHUNK_TOKEN_BUDGET", "0")
+        eng = _engine(params)
+        assert eng.chunk_token_budget == 0
+        eng.close()
+
+    def test_tiny_budget_clamps_up(self, params):
+        # a budget under one page + one decode step could never make
+        # page-aligned progress: it clamps instead of livelocking
+        eng = _engine(params, chunk_token_budget=3)
+        assert eng.chunk_token_budget == eng.page_size + eng.steps_per_call
+        eng.close()
+
+    def test_streaminglm_passes_budget_through(self):
+        lm = StreamingLM(
+            chunk_token_budget=48, page_size=8, max_slots=2,
+            steps_per_call=4, max_new_tokens=4, **CFG,
+        )
+        lm.load()
+        try:
+            assert lm.engine.chunk_token_budget == 48
+            assert lm.engine.engine_stats()["chunk_token_budget"] == 48
+        finally:
+            lm.shutdown()
+
+
+class TestSlicePlanner:
+    """Host-side planner invariants — no device work."""
+
+    def _stream(self, eng, plen, *, prefilled=0, priority=0, req_id=0):
+        s = _Stream(req_id, np.zeros((plen,), np.int32), 4, 0.0, 0, -1, 0)
+        s.prefilled = prefilled
+        s.priority = priority
+        return s
+
+    def test_slices_page_aligned_unless_final(self, params):
+        eng = _engine(params, chunk_token_budget=24)
+        try:
+            a = self._stream(eng, 50, req_id=1)
+            plan = eng._plan_prefill_slices_locked([a], 20)
+            # 20 tokens floor to 2 pages of 8
+            assert plan == [(a, 0, 16)]
+            a.prefilled = 48
+            plan = eng._plan_prefill_slices_locked([a], 20)
+            # final slice may end unaligned: it finishes the prompt
+            assert plan == [(a, 48, 2)]
+        finally:
+            eng.close()
+
+    def test_budget_is_a_hard_cap_and_fifo_within_class(self, params):
+        eng = _engine(params, chunk_token_budget=24)
+        try:
+            a = self._stream(eng, 64, req_id=1)
+            b = self._stream(eng, 64, req_id=2)
+            plan = eng._plan_prefill_slices_locked([a, b], 20)
+            # a (older) takes the floored 16; the 4 left cannot make a
+            # page of progress for b
+            assert plan == [(a, 0, 16)]
+            plan = eng._plan_prefill_slices_locked([a, b], 32)
+            assert plan == [(a, 0, 32)]
+            assert sum(n for _s, _st, n in plan) <= 32
+        finally:
+            eng.close()
+
+    def test_priority_first(self, params):
+        eng = _engine(params, chunk_token_budget=24)
+        try:
+            lo = self._stream(eng, 64, priority=0, req_id=1)
+            hi = self._stream(eng, 64, priority=2, req_id=2)
+            plan = eng._plan_prefill_slices_locked([lo, hi], 16)
+            assert plan == [(hi, 0, 16)]
+        finally:
+            eng.close()
+
+    def test_kv_import_costs_no_budget(self, params):
+        eng = _engine(params, chunk_token_budget=24)
+        try:
+            imp = self._stream(eng, 64, req_id=1)
+            imp.kv_import = {"k": None}
+            comp = self._stream(eng, 64, req_id=2)
+            plan = eng._plan_prefill_slices_locked([imp, comp], 16)
+            # the import places computed pages (no FLOPs) and the full
+            # compute budget still goes to the computing stream
+            assert plan == [(imp, 0, 64), (comp, 0, 16)]
+        finally:
+            eng.close()
+
+
+class TestBudgetAccounting:
+    def test_wave_never_exceeds_budget(self, params, monkeypatch):
+        """The Sarathi invariant, observed end-to-end via the flight
+        recorder: every wave's prefill+decode token total stays inside
+        the budget, and the workload actually exercises mixed waves."""
+        monkeypatch.setenv("SELDON_TPU_FLIGHT_RECORDER", "256")
+        budget = 24
+        eng = _engine(params, chunk_token_budget=budget, max_slots=3)
+        try:
+            streams = [
+                eng.submit(p, max_new_tokens=12)
+                for p in _prompts((5, 70, 120, 33, 64))
+            ]
+            eng.run()
+            assert all(s.result is not None for s in streams)
+            recs = eng.engine_stats(detail=True)["recorder"]
+            assert recs
+            for r in recs:
+                assert r["prefill_tokens"] + r["decode_tokens"] <= budget, r
+                assert r["tokens"] == r["prefill_tokens"] + r["decode_tokens"]
+            assert any(r["prefill_tokens"] for r in recs)
+            assert any(r["decode_tokens"] for r in recs)
+        finally:
+            eng.close()
+
+    def test_decode_admitted_first(self, params, monkeypatch):
+        """A wave with running decodes AND a pending prefill spends its
+        budget on decode first; prefill gets only the remainder."""
+        monkeypatch.setenv("SELDON_TPU_FLIGHT_RECORDER", "256")
+        budget = 16  # 3 decode lanes x 4 steps = 12, leaves 4 < 1 page
+        eng = _engine(params, chunk_token_budget=budget, max_slots=3)
+        try:
+            short = [
+                eng.submit(p, max_new_tokens=16) for p in _prompts((5, 6, 7))
+            ]
+            # get all three decoding (prefill waves first)
+            while any(s.prefilled < len(s.prompt) for s in short):
+                eng.step()
+            long = eng.submit(_prompts((120,), seed=9)[0], max_new_tokens=4)
+            eng.step()  # 3 decode lanes admitted first: no prefill fits
+            recs = eng.engine_stats(detail=True)["recorder"]
+            last = recs[-1]
+            assert last["decode_tokens"] > 0
+            assert last["prefill_tokens"] == 0
+            assert long.prefilled == 0
+            eng.run()
+            assert long.result is not None
+        finally:
+            eng.close()
+
+    def test_completion_decodes_next_wave(self, params, monkeypatch):
+        """A stream whose final slice ran this wave starts decoding the
+        NEXT wave — the hard per-wave bound's enabling rule."""
+        monkeypatch.setenv("SELDON_TPU_FLIGHT_RECORDER", "256")
+        eng = _engine(params, chunk_token_budget=24, max_slots=1)
+        try:
+            s = eng.submit(_prompts((20,))[0], max_new_tokens=4)
+            eng.step()
+            recs = eng.engine_stats(detail=True)["recorder"]
+            assert recs[-1]["phase"] == "prefill"
+            assert recs[-1]["decode_tokens"] == 0
+            assert s.prefilled == 20 and not s.tokens
+            eng.step()
+            assert len(s.tokens) > 0
+        finally:
+            eng.close()
+
+    def test_long_prompt_spreads_over_waves(self, params, monkeypatch):
+        monkeypatch.setenv("SELDON_TPU_FLIGHT_RECORDER", "256")
+        eng = _engine(params, chunk_token_budget=16, max_slots=1)
+        try:
+            out = eng.generate(_prompts((100,))[0], max_new_tokens=4)
+            assert out.shape == (4,)
+            s = eng.engine_stats()
+            # ceil(100 / 16-token slices) -> at least 7 prefill calls
+            assert s["prefill_chunks"] >= 7
+            assert s["prefill_tokens"] == 100
+        finally:
+            eng.close()
+
+    def test_prefill_token_counters_match_monolithic(self, params):
+        """Chunking changes the schedule, not the work: the same prompt
+        set computes the same prefill tokens either way."""
+        outs = {}
+        for budget in (0, 24):
+            eng = _engine(params, chunk_token_budget=budget)
+            try:
+                for p in _prompts((30, 70)):
+                    eng.generate(p, max_new_tokens=4)
+                outs[budget] = eng.engine_stats()
+            finally:
+                eng.close()
+        assert outs[0]["prefill_tokens"] == outs[24]["prefill_tokens"] == 100
+        assert outs[24]["prefill_chunks"] > outs[0]["prefill_chunks"]
+
+
+class TestLifecycleStamps:
+    def test_ttft_decomposition_stamps(self, params):
+        """t_submit <= t_prefill_start <= t_decode_start <=
+        t_first_token <= t_finish — the tracer-free terms the bench and
+        the profile tool read."""
+        eng = _engine(params, chunk_token_budget=24)
+        try:
+            s = eng.submit(_prompts((40,))[0], max_new_tokens=6)
+            eng.run()
+            assert s.result is not None
+            assert 0 < s.t_submit <= s.t_prefill_start <= s.t_decode_start
+            assert s.t_decode_start <= s.t_first_token <= s.t_finish
+        finally:
+            eng.close()
+
+    def test_recorder_stats_window_mix(self, params, monkeypatch):
+        monkeypatch.setenv("SELDON_TPU_FLIGHT_RECORDER", "256")
+        eng = _engine(params, chunk_token_budget=24)
+        try:
+            eng.generate(_prompts((40,))[0], max_new_tokens=6)
+            rs = eng.recorder.stats()
+            assert rs["window_prefill_tokens"] == 40
+            assert rs["window_decode_tokens"] == 6
+        finally:
+            eng.close()
+
+
+class TestSpeculativeGeneratorChunkedPrefill:
+    def test_chunked_prompt_prefill_exact(self, params):
+        """The single-stream speculative lane under the same knob: the
+        prompt forwards in page-aligned chunks of one static width —
+        emitted tokens identical to the bucket-padded prefill."""
+        from seldon_core_tpu.models.speculative import SpeculativeGenerator
+
+        def run(budget):
+            gen = SpeculativeGenerator(
+                params, dtype=jnp.float32, page_size=8, draft="ngram",
+                draft_k=3, chunk_token_budget=budget, **CFG,
+            )
+            return gen.generate(_prompts((70,))[0], max_new_tokens=10)
+
+        np.testing.assert_array_equal(run(0), run(16))
+        # widths stay static across offsets: one chunk program total
+        gen = SpeculativeGenerator(
+            params, dtype=jnp.float32, page_size=8, draft="ngram",
+            draft_k=3, chunk_token_budget=16, **CFG,
+        )
+        gen.generate(_prompts((70,))[0], max_new_tokens=4)
+        gen.generate(_prompts((100,), seed=8)[0], max_new_tokens=4)
+        chunk_keys = [
+            k for k in gen._forward_jit if k[-1] == "chunk"
+        ]
+        assert len(chunk_keys) == 1  # ONE width serves every prompt
+
+
+class TestChunkedParityFast:
+    def test_bit_exact_with_prefix_cache_and_streaming(self, params):
+        """Default impl: chunked-on vs off bit-exact, prefix-cache hits
+        engaged, streamed tokens equal the unary result."""
+        rng = np.random.default_rng(4)
+        shared = rng.integers(0, 64, size=(16,)).astype(np.int32)
+        prompts = [
+            np.concatenate(
+                [shared, rng.integers(0, 64, size=(3 + i,)).astype(np.int32)]
+            )
+            for i in range(3)
+        ]
+        on = _engine(params, chunk_token_budget=16, max_slots=2)
+        off = _engine(params, max_slots=2)
+        try:
+            for p in prompts:
+                a = on.generate(p, max_new_tokens=8)
+                b = off.generate(p, max_new_tokens=8)
+                np.testing.assert_array_equal(a, b)
+            s = on.engine_stats()
+            assert s["prefix_hits"] == 2  # chunking composes with r9
+            stream = on.submit(prompts[0], max_new_tokens=8,
+                               stream_tokens=True)
+            got = []
+            while True:
+                on.step()
+                while not stream.token_queue.empty():
+                    item = stream.token_queue.get()
+                    if item is None:
+                        break
+                    got.extend(item)
+                if stream.event.is_set():
+                    break
+            np.testing.assert_array_equal(
+                np.asarray(got[:8], np.int32), stream.result[:8]
+            )
+        finally:
+            on.close()
+            off.close()
+
+
+@pytest.mark.slow
+class TestChunkedParityMatrix:
+    """The tentpole correctness bar: greedy bit-exactness chunked-on vs
+    chunked-off across ring|pool × w8a8 × prefix-cache, in the f32
+    exactness regime (same discipline as the r9/r11 matrices)."""
+
+    MCFG = dict(vocab_size=64, d_model=32, num_layers=2, num_heads=4,
+                max_len=128)
+
+    @pytest.fixture(scope="class")
+    def mparams(self):
+        lm = TransformerLM(dtype=jnp.float32, **self.MCFG)
+        return lm.init(jax.random.key(1), jnp.zeros((1, 8), jnp.int32))["params"]
+
+    def _prompts(self):
+        rng = np.random.default_rng(7)
+        shared = rng.integers(0, 64, size=(17,)).astype(np.int32)
+        out = [
+            np.concatenate(
+                [shared, rng.integers(0, 64, size=(2 + i,)).astype(np.int32)]
+            )
+            for i in range(2)
+        ]
+        out.append(rng.integers(0, 64, size=(61,)).astype(np.int32))
+        return out
+
+    def _run(self, params, monkeypatch, *, impl, precision, prefix_cache,
+             budget):
+        monkeypatch.setenv("SELDON_TPU_CHUNK_IMPL", impl)
+        eng = PagedEngine(
+            params, dtype=jnp.float32, page_size=8, max_slots=2,
+            steps_per_call=4, precision=precision,
+            prefix_cache=prefix_cache, chunk_token_budget=budget,
+            **self.MCFG,
+        )
+        try:
+            outs = []
+            # concurrent submission: chunked prefill must interleave
+            # with live decodes, not just run solo
+            streams = [
+                eng.submit(p, max_new_tokens=8) for p in self._prompts()
+            ]
+            eng.run()
+            outs = [s.result for s in streams]
+            return outs, eng.engine_stats()
+        finally:
+            eng.close()
+
+    @pytest.mark.parametrize("impl", ["ring", "pool"])
+    @pytest.mark.parametrize("precision", ["", "w8a8"])
+    @pytest.mark.parametrize("prefix_cache", [True, False])
+    def test_chunked_parity(self, mparams, monkeypatch, impl, precision,
+                            prefix_cache):
+        on, s_on = self._run(mparams, monkeypatch, impl=impl,
+                             precision=precision,
+                             prefix_cache=prefix_cache, budget=16)
+        off, s_off = self._run(mparams, monkeypatch, impl=impl,
+                               precision=precision,
+                               prefix_cache=prefix_cache, budget=0)
+        for a, b in zip(on, off):
+            np.testing.assert_array_equal(a, b)
+        # same computed prefill work, more (budgeted) device calls
+        assert s_on["prefill_tokens"] == s_off["prefill_tokens"]
+        assert s_on["prefill_chunks"] >= s_off["prefill_chunks"]
+
+    def test_chunked_speculative_parity(self, mparams, monkeypatch):
+        """Spec engine under the budget: verify-first pricing, prompt
+        slices in the remainder — outputs equal the plain engine's."""
+        monkeypatch.setenv("SELDON_TPU_CHUNK_IMPL", "ring")
+        plain, _ = self._run(mparams, monkeypatch, impl="ring",
+                             precision="", prefix_cache=False, budget=0)
+
+        def spec_run(budget):
+            eng = PagedEngine(
+                mparams, dtype=jnp.float32, page_size=8, max_slots=2,
+                steps_per_call=4, speculative={"draft": "ngram",
+                                               "draft_k": 3},
+                prefix_cache=False, chunk_token_budget=budget, **self.MCFG,
+            )
+            try:
+                streams = [
+                    eng.submit(p, max_new_tokens=8) for p in self._prompts()
+                ]
+                eng.run()
+                return [s.result for s in streams]
+            finally:
+                eng.close()
+
+        on = spec_run(16)
+        off = spec_run(0)
+        for a, b, c in zip(on, off, plain):
+            np.testing.assert_array_equal(a, b)
+            np.testing.assert_array_equal(a, c)

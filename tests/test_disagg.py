@@ -1,0 +1,474 @@
+"""Disaggregated prefill/decode (r15): KV-page export/import, the SRT1
+handoff container, DisaggregatedLM/PrefillLM roles, priced admission,
+and the supervisor worker-set specs.
+
+Correctness bar: disaggregated decode is bit-exact with unified serving
+(the imported pages are the same deterministic prefill KV; rng keys
+derive from the same seed rule), in the f32 exactness regime.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from seldon_core_tpu.codec.bufview import (
+    pack_kv_handoff,
+    unpack_kv_handoff,
+)
+from seldon_core_tpu.codec.tensor import PayloadError
+from seldon_core_tpu.models.disagg import DisaggregatedLM, PrefillLM
+from seldon_core_tpu.models.paged import PagedEngine, StreamingLM
+from seldon_core_tpu.models.transformer import TransformerLM
+from seldon_core_tpu.runtime.component import MicroserviceError
+
+CFG = dict(vocab_size=64, d_model=32, num_layers=1, num_heads=2, max_len=256)
+LM_CFG = dict(page_size=8, max_slots=2, steps_per_call=4, max_new_tokens=8,
+              **CFG)
+
+
+@pytest.fixture(scope="module")
+def params():
+    lm = TransformerLM(dtype=jnp.float32, **CFG)
+    return lm.init(jax.random.key(0), jnp.zeros((1, 8), jnp.int32))["params"]
+
+
+def _engine(params, **kw):
+    base = dict(dtype=jnp.float32, page_size=8, max_slots=2, steps_per_call=4)
+    base.update(kw)
+    return PagedEngine(params, **CFG, **base)
+
+
+def _prompt(n=40, seed=5):
+    return np.random.default_rng(seed).integers(
+        0, CFG["vocab_size"], size=(n,)
+    ).astype(np.int32)
+
+
+class TestEngineHandoff:
+    def test_export_import_bit_exact(self, params):
+        a = _engine(params)
+        b = _engine(params)
+        ref = _engine(params)
+        try:
+            payload = a.prefill_export(_prompt(), seed=7)
+            for key in ("prompt", "k", "v", "last_logits", "page_size",
+                        "layout"):
+                assert key in payload
+            s = b.submit_prefilled(payload, max_new_tokens=12, seed=7)
+            b.run()
+            np.testing.assert_array_equal(
+                s.result, ref.generate(_prompt(), max_new_tokens=12, seed=7)
+            )
+            assert a.engine_stats()["kv_exports"] == 1
+            assert b.engine_stats()["kv_imports"] == 1
+            # the decode engine computed ZERO prefill tokens
+            assert b.engine_stats()["prefill_tokens"] == 0
+        finally:
+            a.close()
+            b.close()
+            ref.close()
+
+    def test_export_under_chunk_budget(self, params):
+        """A prefill worker with the budget on slices its exports too.
+        Chunked and monolithic exports drive the SAME greedy decode
+        (the parity bar; raw logits carry the documented one-ulp
+        cross-program caveat of the suffix-vs-whole einsum shapes, so
+        they compare allclose, not bitwise)."""
+        a = _engine(params, chunk_token_budget=16)
+        b = _engine(params)
+        try:
+            pa = a.prefill_export(_prompt(100), seed=1)
+            pb = b.prefill_export(_prompt(100), seed=1)
+            np.testing.assert_allclose(pa["k"], pb["k"], rtol=1e-4,
+                                       atol=1e-5)
+            np.testing.assert_allclose(pa["last_logits"],
+                                       pb["last_logits"], rtol=1e-4,
+                                       atol=1e-5)
+            assert a.engine_stats()["prefill_chunks"] > 1
+            outs = []
+            for payload in (pa, pb):
+                dec = _engine(params)
+                try:
+                    s = dec.submit_prefilled(
+                        payload, max_new_tokens=12, seed=1
+                    )
+                    dec.run()
+                    outs.append(s.result)
+                finally:
+                    dec.close()
+            np.testing.assert_array_equal(outs[0], outs[1])
+        finally:
+            a.close()
+            b.close()
+
+    def test_export_releases_pages_and_warms_prefix_cache(self, params):
+        eng = _engine(params)
+        try:
+            eng.prefill_export(_prompt(), seed=0)
+            s = eng.engine_stats()
+            assert s["pool_pages_used"] == 0  # everything released
+            assert s["prefix_pages_cached"] > 0  # ... into the LRU
+            # a second export of the same prompt hits the warm cache
+            eng.prefill_export(_prompt(), seed=0)
+            assert eng.engine_stats()["prefix_hits"] == 1
+        finally:
+            eng.close()
+
+    def test_import_registers_prefix_for_followers(self, params):
+        a = _engine(params)
+        b = _engine(params)
+        try:
+            payload = a.prefill_export(_prompt(), seed=0)
+            s = b.submit_prefilled(payload, max_new_tokens=4)
+            b.run()
+            assert s.result is not None
+            # a local follower with the same prompt prefix now hits
+            b.generate(_prompt(), max_new_tokens=4)
+            assert b.engine_stats()["prefix_hits"] == 1
+        finally:
+            a.close()
+            b.close()
+
+    def test_geometry_validation_rejects_mismatches(self, params):
+        a = _engine(params)
+        b = _engine(params, page_size=16)
+        try:
+            payload = a.prefill_export(_prompt(), seed=0)
+            with pytest.raises(MicroserviceError) as exc:
+                b.submit_prefilled(payload)
+            assert exc.value.reason == "KV_LAYOUT_MISMATCH"
+            bad = dict(payload)
+            bad["k"] = payload["k"][:, :1]
+            with pytest.raises(MicroserviceError) as exc:
+                a.submit_prefilled(bad)
+            assert exc.value.reason == "KV_LAYOUT_MISMATCH"
+            bad = dict(payload)
+            bad["last_logits"] = payload["last_logits"][:10]
+            with pytest.raises(MicroserviceError) as exc:
+                a.submit_prefilled(bad)
+            assert exc.value.reason == "KV_LAYOUT_MISMATCH"
+        finally:
+            a.close()
+            b.close()
+
+    def test_pure_prefill_worker_waves_are_recorded(self, params,
+                                                    monkeypatch):
+        """A wave whose streams all finish AT prefill (the kv_export
+        worker shape) still lands in the flight recorder — the window
+        mix must match the prefill_tokens counter on a pure prefill
+        worker."""
+        monkeypatch.setenv("SELDON_TPU_FLIGHT_RECORDER", "64")
+        eng = _engine(params)
+        try:
+            eng.prefill_export(_prompt(), seed=0)
+            rs = eng.recorder.stats()
+            assert rs["window_prefill_tokens"] == 40
+            recs = eng.recorder.snapshot()
+            assert recs and recs[-1]["phase"] == "prefill"
+            assert (
+                sum(r["prefill_tokens"] for r in recs)
+                == eng.engine_stats()["prefill_tokens"]
+            )
+        finally:
+            eng.close()
+
+    def test_predict_cost_model(self, params):
+        eng = _engine(params)
+        try:
+            assert eng.predict_cost_s(40, 8) is None  # cold: unpriced
+            eng.generate(_prompt(), max_new_tokens=8)
+            cost = eng.predict_cost_s(40, 8)
+            assert cost is not None and cost > 0
+            # monotone in both terms
+            assert eng.predict_cost_s(400, 8) > cost
+            assert eng.predict_cost_s(40, 80) > cost
+        finally:
+            eng.close()
+
+
+class TestHandoffContainer:
+    def _payload(self, params):
+        eng = _engine(params)
+        try:
+            return eng.prefill_export(_prompt(), seed=0)
+        finally:
+            eng.close()
+
+    def test_round_trip_zero_copy(self, params):
+        payload = self._payload(params)
+        buf = pack_kv_handoff(payload)
+        out = unpack_kv_handoff(buf)
+        np.testing.assert_array_equal(out["prompt"], payload["prompt"])
+        np.testing.assert_array_equal(out["k"], payload["k"])
+        np.testing.assert_array_equal(out["v"], payload["v"])
+        np.testing.assert_array_equal(out["last_logits"],
+                                      payload["last_logits"])
+        assert out["page_size"] == payload["page_size"]
+        assert out["layout"] == payload["layout"]
+        # zero copy: the views alias the container's payload regions
+        mv = memoryview(buf)
+        for key in ("prompt", "k", "v", "last_logits"):
+            assert np.shares_memory(
+                out[key], np.frombuffer(mv, np.uint8)
+            ) or out[key].base is not None
+
+    def test_malformed_containers_raise_named_errors(self, params):
+        payload = self._payload(params)
+        buf = pack_kv_handoff(payload)
+        with pytest.raises(PayloadError):
+            unpack_kv_handoff(buf[: len(buf) // 2])  # truncated
+        with pytest.raises(PayloadError):
+            unpack_kv_handoff(b"SRT1" + b"\x00" * 16)  # not a handoff
+        # wrong frame count
+        from seldon_core_tpu.codec.bufview import pack_frames
+
+        with pytest.raises(PayloadError) as exc:
+            unpack_kv_handoff(pack_frames([payload["prompt"]]))
+        assert "frames" in str(exc.value)
+        # geometry mismatch: prompt length vs page count
+        bad = dict(payload)
+        bad["prompt"] = payload["prompt"][:3]
+        with pytest.raises(PayloadError) as exc:
+            unpack_kv_handoff(pack_kv_handoff(bad))
+        assert "geometry" in str(exc.value)
+
+    def test_missing_entry_named(self):
+        with pytest.raises(PayloadError) as exc:
+            pack_kv_handoff({"prompt": np.zeros(4, np.int32)})
+        assert "last_logits" in str(exc.value)
+
+
+class TestDisaggregatedLM:
+    def test_parity_with_unified_serving(self):
+        uni = StreamingLM(**LM_CFG)
+        dis = DisaggregatedLM(prefill_workers=2, **LM_CFG)
+        try:
+            uni.load()
+            dis.load()
+            X = np.random.default_rng(5).integers(
+                0, CFG["vocab_size"], size=(3, 40)
+            ).astype(np.int32)
+            meta = {"tags": {"seed": 11}}
+            a = uni.predict(X, [], dict(meta))
+            b = dis.predict(X, [], dict(meta))
+            np.testing.assert_array_equal(a, b)
+            assert dis.engine.engine_stats()["kv_imports"] == 3
+            assert dis.engine.engine_stats()["prefill_tokens"] == 0
+            exports = sum(
+                e.engine_stats()["kv_exports"] for e in dis._prefill_engines
+            )
+            assert exports == 3
+        finally:
+            uni.shutdown()
+            dis.shutdown()
+
+    def test_predict_stream_routes_through_prefill(self):
+        dis = DisaggregatedLM(prefill_workers=1, **LM_CFG)
+        uni = StreamingLM(**LM_CFG)
+        try:
+            uni.load()
+            dis.load()
+            X = _prompt()[None, :]
+            meta = {"tags": {"seed": 3}}
+            want = uni.predict(X, [], dict(meta))[0]
+            got = np.concatenate(
+                list(dis.predict_stream(X, [], dict(meta)))
+            )
+            np.testing.assert_array_equal(got, want[: len(got)])
+            assert dis.engine.engine_stats()["kv_imports"] == 1
+        finally:
+            uni.shutdown()
+            dis.shutdown()
+
+    def test_degrades_to_streaminglm_when_unconfigured(self):
+        dis = DisaggregatedLM(**LM_CFG)
+        try:
+            dis.load()
+            X = _prompt()[None, :]
+            out = dis.predict(X, [], {"tags": {"seed": 1}})
+            assert out.shape == (1, LM_CFG["max_new_tokens"])
+            assert dis.engine.engine_stats()["kv_imports"] == 0
+        finally:
+            dis.shutdown()
+
+    def test_priced_admission_rejects_unreachable_deadline(self):
+        dis = DisaggregatedLM(prefill_workers=1, **LM_CFG)
+        try:
+            dis.load()
+            X = _prompt()[None, :]
+            # warm: the cost model needs measured rates
+            dis.predict(X, [], {"tags": {"seed": 1}})
+            with pytest.raises(MicroserviceError) as exc:
+                dis.predict(
+                    X, [],
+                    {"tags": {"seed": 1, "deadline_ms": 0.001,
+                              "max_new_tokens": 8}},
+                )
+            assert exc.value.reason in (
+                "DEADLINE_UNREACHABLE", "DEADLINE_EXCEEDED",
+            )
+        finally:
+            dis.shutdown()
+
+    def test_cancelled_queued_jobs_never_prefill(self):
+        """The error-cleanup flag: a job still queued when a sibling
+        fails is skipped by the workers — no prefill FLOPs, no decode
+        stream nobody reads."""
+        dis = DisaggregatedLM(prefill_workers=1, **LM_CFG)
+        try:
+            job = dis._enqueue_prefill(
+                _prompt(), 0,
+                dict(max_new_tokens=4, eos_id=-1, seed=0, priority=0,
+                     deadline=None, temperature=0.0, top_k=0),
+            )
+            job.cancelled = True
+            dis.load()  # worker starts, pops the flagged job, skips it
+            assert job.event.wait(timeout=30)
+            assert job.stream is None and job.error is None
+            assert all(
+                e.engine_stats()["kv_exports"] == 0
+                for e in dis._prefill_engines
+            )
+        finally:
+            dis.shutdown()
+
+    def test_admission_pricing_knob_off_admits(self, monkeypatch):
+        monkeypatch.setenv("SELDON_TPU_ADMISSION_PRICING", "0")
+        dis = DisaggregatedLM(prefill_workers=1, **LM_CFG)
+        try:
+            assert dis.admission_pricing is False
+        finally:
+            dis.shutdown()
+
+    def test_env_worker_count(self, monkeypatch):
+        monkeypatch.setenv("SELDON_TPU_PREFILL_WORKERS", "3")
+        dis = DisaggregatedLM(**LM_CFG)
+        try:
+            assert dis.prefill_workers == 3
+        finally:
+            dis.shutdown()
+
+    def test_metrics_carry_disagg_gauges(self):
+        dis = DisaggregatedLM(prefill_workers=1, **LM_CFG)
+        try:
+            dis.load()
+            dis.predict(_prompt()[None, :], [], {"tags": {"seed": 1}})
+            keys = {m["key"]: m["value"] for m in dis.metrics()}
+            assert keys["paged_kv_imports"] == 1
+            assert keys["paged_kv_exports"] == 1
+            assert keys["paged_prefill_workers"] == 1
+        finally:
+            dis.shutdown()
+
+
+class TestPrefillLM:
+    def test_returns_container_as_uint8_row(self, monkeypatch):
+        pre = PrefillLM(**LM_CFG)
+        uni = StreamingLM(**LM_CFG)
+        try:
+            pre.load()
+            uni.load()
+            X = _prompt()[None, :]
+            row = pre.predict(X, [], {})
+            assert row.dtype == np.uint8 and row.ndim == 2
+            payload = unpack_kv_handoff(np.ascontiguousarray(row[0]).tobytes())
+            s = uni.engine.submit_prefilled(
+                payload, max_new_tokens=8, seed=0
+            )
+            uni._wake.set()
+            assert s.event.wait(timeout=30)
+            want = uni.predict(X, [], {"tags": {"seed": 0}})
+            # NOTE seed rules differ (predict folds the request seed);
+            # compare via a pinned-seed reference instead
+            ref = _engine_reference(X[0])
+            np.testing.assert_array_equal(s.result, ref)
+            assert want.shape == (1, 8)
+        finally:
+            pre.shutdown()
+            uni.shutdown()
+
+    def test_rejects_multi_row(self):
+        pre = PrefillLM(**LM_CFG)
+        try:
+            pre.load()
+            with pytest.raises(MicroserviceError):
+                pre.predict(np.zeros((2, 8), np.int32), [], {})
+        finally:
+            pre.shutdown()
+
+
+def _engine_reference(prompt):
+    """Greedy reference through a fresh StreamingLM-config engine with
+    seed 0 — what submit_prefilled(seed=0) must reproduce."""
+    import jax.numpy as jnp  # noqa: F811 — local to mirror load()
+
+    from seldon_core_tpu.models.generate import load_lm_params
+
+    params = load_lm_params("", CFG, 0)
+    eng = PagedEngine(params, dtype=jnp.bfloat16, page_size=8, max_slots=2,
+                      steps_per_call=4, **CFG)
+    try:
+        return eng.generate(np.asarray(prompt), max_new_tokens=8, seed=0)
+    finally:
+        eng.close()
+
+
+class TestSupervisorSpecs:
+    def test_disagg_worker_specs_shape(self):
+        from seldon_core_tpu.controlplane.supervisor import (
+            disagg_worker_specs,
+        )
+
+        specs = disagg_worker_specs(
+            "gen", prefill_workers=2, base_http=9700, base_grpc=9800,
+        )
+        assert [s.name for s in specs] == [
+            "gen-prefill-0", "gen-prefill-1", "gen-decode",
+        ]
+        for s in specs[:-1]:
+            assert s.env["SELDON_TPU_DISAGG_ROLE"] == "prefill"
+            assert s.component.endswith("PrefillLM")
+        decode = specs[-1]
+        assert decode.env["SELDON_TPU_DISAGG_ROLE"] == "decode"
+        assert decode.component.endswith("DisaggregatedLM")
+        import json
+
+        params = json.loads(decode.parameters_json)
+        eps = json.loads(
+            next(p["value"] for p in params
+                 if p["name"] == "prefill_endpoints")
+        )
+        assert eps == ["grpc://127.0.0.1:9801", "grpc://127.0.0.1:9802"]
+        # ports are disjoint across the set
+        ports = [s.http_port for s in specs] + [s.grpc_port for s in specs]
+        assert len(set(ports)) == len(ports)
+
+    def test_add_group_rolls_back_on_failure(self, monkeypatch):
+        from seldon_core_tpu.controlplane import supervisor as sup_mod
+
+        sup = sup_mod.Supervisor()
+        calls = []
+
+        class _FakeSP:
+            def __init__(self, spec):
+                self.spec = spec
+
+            def stop(self):
+                calls.append(("stop", self.spec.name))
+
+        def fake_add(spec, wait_ready_s=30.0):
+            if spec.name.endswith("decode"):
+                raise TimeoutError("never ready")
+            sp = _FakeSP(spec)
+            sup.processes[spec.name] = sp
+            calls.append(("add", spec.name))
+            return sp
+
+        monkeypatch.setattr(sup, "add", fake_add)
+        specs = sup_mod.disagg_worker_specs("gen", prefill_workers=1)
+        with pytest.raises(TimeoutError):
+            sup.add_group(specs)
+        assert ("stop", "gen-prefill-0") in calls
+        assert not sup.processes

@@ -165,9 +165,19 @@ class TestEngineRecorder:
                 assert rec["steps"] == 4
                 assert rec["occupancy"] >= 1
                 assert isinstance(rec["buckets"], list)
-                for key in ("admissions", "stalls", "queue_depth", "tokens"):
+                for key in ("admissions", "stalls", "queue_depth", "tokens",
+                            "prefill_tokens", "decode_tokens"):
                     assert key in rec
-            assert sum(r["tokens"] for r in recs) == base["tokens"]
+                # r15 contract: "tokens" is the wave's TOTAL work and
+                # the prefill/decode split decomposes it exactly
+                assert rec["tokens"] == (
+                    rec["prefill_tokens"] + rec["decode_tokens"]
+                )
+            assert sum(r["decode_tokens"] for r in recs) == base["tokens"]
+            assert (
+                sum(r["prefill_tokens"] for r in recs)
+                == base["prefill_tokens"]
+            )
         finally:
             eng.close()
 

@@ -46,6 +46,11 @@ def main():
     ap.add_argument("--vocab", type=int, default=2048)
     ap.add_argument("--page-size", type=int, default=64)
     ap.add_argument("--max-len", type=int, default=512)
+    ap.add_argument(
+        "--chunk-budget", type=int, default=0,
+        help="SELDON_TPU_CHUNK_TOKEN_BUDGET for the engine (0 = "
+             "monolithic prefill, the historical scheduler)",
+    )
     ap.add_argument("--out", default="/tmp/engine-trace")
     args = ap.parse_args()
 
@@ -71,6 +76,7 @@ def main():
         num_layers=args.layers, num_heads=args.heads,
         max_len=args.max_len, page_size=args.page_size,
         max_slots=args.slots, steps_per_call=8,
+        chunk_token_budget=args.chunk_budget,
         dtype=jnp.bfloat16,
     )
 
@@ -113,8 +119,10 @@ def main():
     for s in spans:
         if s.name.startswith("gen."):
             by_req[s.trace_id][s.name] = s
+    by_rid_stream = {f"req-{i:03d}": s for i, s in enumerate(streams)}
     print(f"{'request':<10} {'queue ms':>9} {'prefill ms':>11} "
-          f"{'decode ms':>10} {'tokens':>7} {'slot':>5} {'evicted':>8}")
+          f"{'decode ms':>10} {'ttft ms':>8} {'tokens':>7} {'slot':>5} "
+          f"{'evicted':>8}")
     agg = defaultdict(float)
     for rid in sorted(by_req):
         phases = by_req[rid]
@@ -122,6 +130,14 @@ def main():
         p = phases.get("gen.prefill")
         d = phases.get("gen.decode")
         fin = phases.get("gen.finish")
+        # TTFT: first decode token minus submit — the interactive
+        # latency the chunked-prefill scheduler exists to protect
+        # (queue + prefill + first decode chunk, in one number)
+        st = by_rid_stream.get(rid)
+        ttft = (
+            (st.t_first_token - st.t_submit) * 1000.0
+            if st is not None and st.t_first_token and st.t_submit else 0.0
+        )
         row = [
             q.duration_s * 1000 if q else 0.0,
             p.duration_s * 1000 if p else 0.0,
@@ -130,14 +146,17 @@ def main():
         agg["queue"] += row[0]
         agg["prefill"] += row[1]
         agg["decode"] += row[2]
+        agg["ttft"] += ttft
         print(f"{rid:<10} {row[0]:>9.1f} {row[1]:>11.1f} {row[2]:>10.1f} "
+              f"{ttft:>8.1f} "
               f"{(fin.tags.get('tokens') if fin else 0):>7} "
               f"{(fin.tags.get('slot') if fin else '-'):>5} "
               f"{'yes' if 'gen.evict' in phases else 'no':>8}")
     n = max(1, len(by_req))
     print(f"\nmeans: queue {agg['queue'] / n:.1f} ms, "
           f"prefill {agg['prefill'] / n:.1f} ms, "
-          f"decode {agg['decode'] / n:.1f} ms over {len(by_req)} requests")
+          f"decode {agg['decode'] / n:.1f} ms, "
+          f"ttft {agg['ttft'] / n:.1f} ms over {len(by_req)} requests")
 
     if eng.recorder is not None:
         rs = eng.recorder.stats()
@@ -146,6 +165,21 @@ def main():
         print(f"chunks recorded {rs['records']}, chunk p99 "
               f"{rs['chunk_p99_ms']:.1f} ms, stalls {stalls}, "
               f"last queue depth {rs['last_queue_depth']}")
+        # the scheduler's chosen chunk mix (r15): what each wave
+        # actually carried under the token budget
+        total = max(
+            1, rs["window_prefill_tokens"] + rs["window_decode_tokens"]
+        )
+        mixed = sum(
+            1 for r in recs
+            if r.get("prefill_tokens", 0) and r.get("decode_tokens", 0)
+        )
+        print(f"chunk mix (budget={eng.chunk_token_budget or 'off'}): "
+              f"{rs['window_prefill_tokens']} prefill + "
+              f"{rs['window_decode_tokens']} decode tokens "
+              f"({100.0 * rs['window_prefill_tokens'] / total:.0f}% "
+              f"prefill), {mixed}/{rs['records']} waves mixed "
+              "prefill+decode")
     eng.close()
     tracing._tracer = None
 
