@@ -300,6 +300,11 @@ def build_gateway_app(gateway: Gateway, auth=None) -> web.Application:
             prio = _deadlines.extract_priority(request.headers)
             if prio is not None and "priority" not in msg.meta.tags:
                 msg.meta.tags["priority"] = prio
+            # X-Seldon-Adapter selects the LoRA weight set (r16); an
+            # explicit tag in the body wins, same precedence as priority
+            adapter = _deadlines.extract_adapter(request.headers)
+            if adapter and "adapter" not in msg.meta.tags:
+                msg.meta.tags["adapter"] = adapter
             # an external caller's traceparent makes the gateway's
             # predictor.predict span a child of ITS trace — the whole
             # graph then stitches under the caller's root
@@ -380,6 +385,9 @@ def build_gateway_app(gateway: Gateway, auth=None) -> web.Application:
         sse_prio = _deadlines.extract_priority(request.headers)
         if sse_prio is not None:
             meta["tags"].setdefault("priority", sse_prio)
+        sse_adapter = _deadlines.extract_adapter(request.headers)
+        if sse_adapter:
+            meta["tags"].setdefault("adapter", sse_adapter)
         loop = _asyncio.get_running_loop()
         sentinel = object()
         # pull the FIRST chunk before sending headers: bad prompts /
@@ -537,6 +545,31 @@ def build_gateway_app(gateway: Gateway, auth=None) -> web.Application:
             {"enabled": True, "spans": [s.to_dict() for s in spans[-limit:]]}
         )
 
+    async def debug_weights(_r: web.Request) -> web.Response:
+        """The weight-multiplexing surface (r16): the process weight
+        registry's residency/budget state (null when this process never
+        touched it) plus every local paged engine's adapter-pool
+        stats, keyed predictor -> node — "which weight sets is this
+        gateway actually serving" as one curl."""
+        from seldon_core_tpu.models.registry import registry_snapshot
+
+        engines: Dict[str, Dict[str, object]] = {}
+        for svc in gateway.predictors:
+            nodes = {}
+            for unit in svc.graph.walk():
+                component = svc.executor.component(unit.name)
+                engine = getattr(component, "engine", None)
+                stats_fn = getattr(engine, "adapter_stats", None)
+                if stats_fn is None:
+                    continue
+                nodes[unit.name] = stats_fn()
+            if nodes:
+                engines[svc.name] = nodes
+        return web.json_response({
+            "registry": registry_snapshot(),
+            "engines": engines,
+        })
+
     async def debug_knobs(_r: web.Request) -> web.Response:
         """The central knob registry (runtime/knobs.py) with this
         process's effective values: "what is this gateway actually
@@ -572,6 +605,7 @@ def build_gateway_app(gateway: Gateway, auth=None) -> web.Application:
     app.router.add_get("/debug/workers", debug_workers)
     app.router.add_get("/debug/traces", debug_traces)
     app.router.add_get("/debug/knobs", debug_knobs)
+    app.router.add_get("/debug/weights", debug_weights)
     return app
 
 
@@ -672,6 +706,11 @@ def add_seldon_service(server: grpc.aio.Server, gateway: Gateway, auth=None) -> 
         md_prio = _deadlines.extract_priority(context.invocation_metadata() or ())
         if md_prio is not None:
             meta["tags"].setdefault("priority", md_prio)
+        md_adapter = _deadlines.extract_adapter(
+            context.invocation_metadata() or ()
+        )
+        if md_adapter:
+            meta["tags"].setdefault("adapter", md_adapter)
         loop = asyncio.get_running_loop()
         it = gen_fn(msg.array(), [], meta=meta)
         sentinel = object()

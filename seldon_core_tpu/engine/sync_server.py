@@ -167,6 +167,9 @@ class SyncSeldonService:
         stream_prio = _deadlines.extract_priority(md)
         if stream_prio is not None:
             meta["tags"].setdefault("priority", stream_prio)
+        stream_adapter = _deadlines.extract_adapter(md)
+        if stream_adapter:
+            meta["tags"].setdefault("adapter", stream_adapter)
         it = gen_fn(msg.array(), [], meta=meta)
         try:
             for chunk in it:

@@ -77,7 +77,7 @@ class PrefillLM(StreamingLM):
         priority, deadline = self._slo_terms(tags)
         stream = self.engine.submit(
             X[0], max_new_tokens=1, priority=priority, deadline=deadline,
-            kv_export=True,
+            kv_export=True, adapter=self._request_adapter(tags),
         )
         self._wake.set()
         stream.event.wait()
@@ -222,9 +222,15 @@ class DisaggregatedLM(StreamingLM):
                 # and queue bounds belong to the decode worker
                 speculative=None, max_queue=0,
             )
+            # adapter-carrying prompts prefill WITH their adapter (the
+            # exported KV must match the decode worker's weight set) —
+            # prefill engines resolve the same registry names
+            registry = self._register_adapters()
             for i in range(self.prefill_workers):
                 eng = PagedEngine(
                     params, dtype=jnp.bfloat16, tp=self.tp or None,
+                    max_adapters=self.max_adapters,
+                    lora_rank=self.lora_rank, weight_registry=registry,
                     **self.config, **eng_cfg,
                 )
                 self._prefill_engines.append(eng)
@@ -337,6 +343,7 @@ class DisaggregatedLM(StreamingLM):
                     job.prompt,
                     priority=job.priority,
                     deadline=job.submit_kw.get("deadline"),
+                    adapter=job.submit_kw.get("adapter"),
                 )
                 if job.cancelled:  # cancelled mid-export: don't admit
                     continue
@@ -390,6 +397,10 @@ class DisaggregatedLM(StreamingLM):
                 try:
                     msg = InternalMessage(payload=np.atleast_2d(job.prompt))
                     msg.meta.tags["priority"] = job.priority
+                    # the remote PrefillLM must prefill with the SAME
+                    # weight set the decode engine will decode with
+                    if job.submit_kw.get("adapter"):
+                        msg.meta.tags["adapter"] = job.submit_kw["adapter"]
                     # the deadline must CROSS the DCN hop: the remote
                     # PrefillLM mints its own expiry from the remaining
                     # budget (its _slo_terms reads deadline_ms), and a
@@ -438,6 +449,7 @@ class DisaggregatedLM(StreamingLM):
         top_k = int(tags.get("top_k", self.top_k))
         request_seed = self._request_seed(tags, meta)
         priority, deadline = self._slo_terms(tags)
+        adapter = self._request_adapter(tags)
         X = np.atleast_2d(np.asarray(X, np.int32))
         jobs: List[_PrefillJob] = []
         try:
@@ -450,6 +462,7 @@ class DisaggregatedLM(StreamingLM):
                         top_k=top_k, eos_id=self.eos_id,
                         seed=self.seed ^ (request_seed * 1000003 + i),
                         priority=priority, deadline=deadline,
+                        adapter=adapter,
                     ),
                 ))
             out = []
@@ -504,6 +517,7 @@ class DisaggregatedLM(StreamingLM):
                 seed=self.seed ^ (request_seed * 1000003),
                 priority=priority, deadline=deadline,
                 stream_tokens=True,
+                adapter=self._request_adapter(tags),
             ),
         )
         job.event.wait()

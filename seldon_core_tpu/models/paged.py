@@ -128,7 +128,8 @@ def _build_modules():
         decode_kernel: bool = True
 
         @nn.compact
-        def __call__(self, x, pk, pv, block_tables, lengths):
+        def __call__(self, x, pk, pv, block_tables, lengths,
+                     lora=None, adapter_idx=None):
             # x: (B, L, d)  pk/pv: (num_pages, ps, h, hd) split, or the
             # r5-default flat (num_pages, ps, d) — the gather below
             # reshapes either to (B, cache_len, h, hd), and the kernel
@@ -139,6 +140,11 @@ def _build_modules():
             # each bucket gathers/attends at its own static page
             # horizon (dense projections stay full-batch)
             # lengths: (B,) tokens in cache
+            # lora/adapter_idx (r16): slot-granular low-rank factor
+            # pools + a TRACED per-lane slot id — every projection adds
+            # the gathered grouped-matmul delta (ops/lora.py), so a
+            # wave mixing K adapters is ONE program; lora=None is the
+            # byte-identical adapter-off path (no new ops traced)
             tables = (
                 tuple(block_tables)
                 if isinstance(block_tables, (tuple, list))
@@ -148,8 +154,20 @@ def _build_modules():
             heads = self.num_heads
             head_dim = d_model // heads
             batch, seg_len = x.shape[:2]
+
+            def _proj(name, features, inp):
+                out = _dense(self.precision, features, self.dtype, name)(inp)
+                if lora is not None and name in lora:
+                    from seldon_core_tpu.ops.lora import lora_delta
+
+                    a_f, b_f = lora[name]
+                    out = out + lora_delta(inp, a_f, b_f, adapter_idx).astype(
+                        out.dtype
+                    )
+                return out
+
             y = nn.LayerNorm(dtype=jnp.float32)(x)
-            qkv = _dense(self.precision, 3 * d_model, self.dtype, "qkv")(y)
+            qkv = _proj("qkv", 3 * d_model, y)
             q, k, v = jnp.split(qkv, 3, axis=-1)
             shape = (batch, seg_len, heads, head_dim)
             q, k, v = q.reshape(shape), k.reshape(shape), v.reshape(shape)
@@ -270,11 +288,11 @@ def _build_modules():
                 )
                 attn = attn.reshape(batch, seg_len, d_model)
 
-            x = x + _dense(self.precision, d_model, self.dtype, "attn_proj")(attn)
+            x = x + _proj("attn_proj", d_model, attn)
             y = nn.LayerNorm(dtype=jnp.float32)(x)
-            y = _dense(self.precision, self.mlp_ratio * d_model, self.dtype, "mlp_in")(y)
+            y = _proj("mlp_in", self.mlp_ratio * d_model, y)
             y = nn.gelu(y)
-            x = x + _dense(self.precision, d_model, self.dtype, "mlp_out")(y)
+            x = x + _proj("mlp_out", d_model, y)
             return x, k, v
 
     class ChunkTransformerBlock(nn.Module):
@@ -301,7 +319,8 @@ def _build_modules():
         precision: str = "bf16"  # "w8a8": int8×int8 projections
 
         @nn.compact
-        def __call__(self, x, ctx_k, ctx_v, ring_k, ring_v, step, len0):
+        def __call__(self, x, ctx_k, ctx_v, ring_k, ring_v, step, len0,
+                     lora=None, adapter_idx=None):
             # x: (B, 1, d)   ring_k/v: (B, S, h, hd)
             # ctx_k/v: (B, C, h, hd), or a TUPLE of per-bucket buffers
             # ((B0, C0, h, hd), (B1, C1, h, hd), ...) with sum(Bb) == B —
@@ -323,8 +342,23 @@ def _build_modules():
             heads = self.num_heads
             head_dim = d_model // heads
             batch, seg_len = x.shape[:2]
+
+            # same grouped multi-LoRA hook as PagedTransformerBlock —
+            # dense work (and therefore the delta) stays full-batch,
+            # only the context attention splits by bucket
+            def _proj(name, features, inp):
+                out = _dense(self.precision, features, self.dtype, name)(inp)
+                if lora is not None and name in lora:
+                    from seldon_core_tpu.ops.lora import lora_delta
+
+                    a_f, b_f = lora[name]
+                    out = out + lora_delta(inp, a_f, b_f, adapter_idx).astype(
+                        out.dtype
+                    )
+                return out
+
             y = nn.LayerNorm(dtype=jnp.float32)(x)
-            qkv = _dense(self.precision, 3 * d_model, self.dtype, "qkv")(y)
+            qkv = _proj("qkv", 3 * d_model, y)
             q, k, v = jnp.split(qkv, 3, axis=-1)
             shape = (batch, seg_len, heads, head_dim)
             q, k, v = q.reshape(shape), k.reshape(shape), v.reshape(shape)
@@ -360,11 +394,11 @@ def _build_modules():
                 off += nb
             attn = outs[0] if len(outs) == 1 else jnp.concatenate(outs, axis=0)
             attn = attn.reshape(batch, seg_len, d_model)
-            x = x + _dense(self.precision, d_model, self.dtype, "attn_proj")(attn)
+            x = x + _proj("attn_proj", d_model, attn)
             y = nn.LayerNorm(dtype=jnp.float32)(x)
-            y = _dense(self.precision, self.mlp_ratio * d_model, self.dtype, "mlp_in")(y)
+            y = _proj("mlp_in", self.mlp_ratio * d_model, y)
             y = nn.gelu(y)
-            x = x + _dense(self.precision, d_model, self.dtype, "mlp_out")(y)
+            x = x + _proj("mlp_out", d_model, y)
             return x, k, v
 
     class ChunkTransformerLM(nn.Module):
@@ -388,7 +422,7 @@ def _build_modules():
 
         @nn.compact
         def __call__(self, tokens, positions, ctx_k, ctx_v, ring_k, ring_v,
-                     step, len0):
+                     step, len0, lora=None, adapter_idx=None):
             tokens = tokens.astype(jnp.int32)
             x = nn.Embed(
                 self.vocab_size, self.d_model, dtype=self.dtype, name="tok_embed"
@@ -406,10 +440,15 @@ def _build_modules():
                 layer_cv = (
                     tuple(c[i] for c in ctx_v) if bucketed else ctx_v[i]
                 )
+                lora_i = (
+                    {t: (ab[0][i], ab[1][i]) for t, ab in lora.items()}
+                    if lora is not None else None
+                )
                 x, k, v = ChunkTransformerBlock(
                     num_heads=self.num_heads, dtype=self.dtype,
                     precision=self.precision, name=f"block_{i}"
-                )(x, layer_ck, layer_cv, ring_k[i], ring_v[i], step, len0)
+                )(x, layer_ck, layer_cv, ring_k[i], ring_v[i], step, len0,
+                  lora=lora_i, adapter_idx=adapter_idx)
                 new_k.append(k)
                 new_v.append(v)
             x = nn.LayerNorm(dtype=jnp.float32)(x)
@@ -434,7 +473,8 @@ def _build_modules():
         decode_kernel: bool = True
 
         @nn.compact
-        def __call__(self, tokens, positions, pages_k, pages_v, block_tables, lengths):
+        def __call__(self, tokens, positions, pages_k, pages_v, block_tables,
+                     lengths, lora=None, adapter_idx=None):
             tokens = tokens.astype(jnp.int32)
             x = nn.Embed(
                 self.vocab_size, self.d_model, dtype=self.dtype, name="tok_embed"
@@ -445,11 +485,16 @@ def _build_modules():
             x = x + pos
             new_k, new_v = [], []
             for i in range(self.num_layers):
+                lora_i = (
+                    {t: (ab[0][i], ab[1][i]) for t, ab in lora.items()}
+                    if lora is not None else None
+                )
                 x, k, v = PagedTransformerBlock(
                     num_heads=self.num_heads, dtype=self.dtype,
                     precision=self.precision,
                     decode_kernel=self.decode_kernel, name=f"block_{i}"
-                )(x, pages_k[i], pages_v[i], block_tables, lengths)
+                )(x, pages_k[i], pages_v[i], block_tables, lengths,
+                  lora=lora_i, adapter_idx=adapter_idx)
                 new_k.append(k)
                 new_v.append(v)
             x = nn.LayerNorm(dtype=jnp.float32)(x)
@@ -604,6 +649,8 @@ def paged_hbm_accounting(
     tp_degree: int = 1,
     num_heads: Optional[int] = None,
     inflight_prefill_tokens: int = 0,
+    adapter_bytes: int = 0,
+    reclaimable_weight_bytes: int = 0,
 ) -> Dict[str, int]:
     """Pool-HBM bytes for ``streams`` concurrent streams at ``ctx_len``
     tokens — the capacity model the bench certifies (VERDICT r5 #3/#5).
@@ -656,8 +703,19 @@ def paged_hbm_accounting(
       chunking window — the over-admission bug the r15 satellite
       fixed.
 
-    Weights, activations, and the host runtime are out of scope: this
-    prices the KV side, which is what scales with streams.
+    * **adapter pool (r16)** — multi-LoRA serving preallocates a
+      slot-granular factor pool next to the KV pool
+      (``LoraPool.hbm_bytes`` — already per-shard under TP, since each
+      target's sharded factor follows its base layer's megatron
+      sharding).  ``adapter_bytes`` prices it into ``peak_bytes``: the
+      pool is resident whether or not slots are full, so capacity
+      planning must reserve it off the top like in-flight prefill.
+      ``reclaimable_weight_bytes`` prices the weight registry's CACHED
+      (refcount-0) sets next to the prefix cache's reclaimable pages —
+      capacity, never cost.
+
+    BASE weights, activations, and the host runtime stay out of scope:
+    this prices what scales with streams and adapter multiplexing.
     """
     shard = max(1, int(tp_degree))
     if num_heads is not None and num_heads % shard:
@@ -682,19 +740,21 @@ def paged_hbm_accounting(
     return {
         "pool_bytes": pool,
         "working_set_bytes": ws,
-        "peak_bytes": at_rest + ws + inflight,
+        "peak_bytes": at_rest + ws + inflight + int(adapter_bytes),
         "per_stream_bytes": (at_rest + ws) // max(1, streams),
         "reclaimable_bytes": int(
             cached_prefix_pages * page_size * tok_bytes * pool_pad
-        ) // shard,
+        ) // shard + int(reclaimable_weight_bytes),
         "inflight_prefill_bytes": inflight,
+        "adapter_bytes": int(adapter_bytes),
+        "reclaimable_weight_bytes": int(reclaimable_weight_bytes),
         "tp_degree": shard,
     }
 
 
 def paged_capacity_streams(
     budget_bytes: int, ctx_len: int, *, donated: bool = True,
-    inflight_prefill_tokens: int = 0, **model_kw
+    inflight_prefill_tokens: int = 0, adapter_bytes: int = 0, **model_kw
 ) -> int:
     """Max concurrent streams whose paged KV peak fits ``budget_bytes``
     at ``ctx_len`` tokens each (per-stream cost is linear in streams,
@@ -711,13 +771,22 @@ def paged_capacity_streams(
     the top of the budget BEFORE the per-stream division, because
     those pages are neither free nor reclaimable while the slices run.
     Without the term, chunked prefill let the planner admit streams
-    whose pages the chunking prompts already held."""
+    whose pages the chunking prompts already held.
+
+    The multi-LoRA adapter pool (r16) reserves off the top the same
+    way: ``adapter_bytes`` (per-shard, ``LoraPool.hbm_bytes``) is
+    resident regardless of stream count, so it must come out of the
+    budget BEFORE the per-stream division — otherwise enabling
+    adapters would silently certify KV capacity the factor pool
+    already occupies."""
     one = paged_hbm_accounting(
         streams=1, ctx_len=ctx_len, donated=donated,
-        inflight_prefill_tokens=inflight_prefill_tokens, **model_kw
+        inflight_prefill_tokens=inflight_prefill_tokens,
+        adapter_bytes=adapter_bytes, **model_kw
     )
-    per_stream = max(1, one["peak_bytes"] - one["inflight_prefill_bytes"])
-    usable = max(0, int(budget_bytes) - one["inflight_prefill_bytes"])
+    fixed = one["inflight_prefill_bytes"] + one["adapter_bytes"]
+    per_stream = max(1, one["peak_bytes"] - fixed)
+    usable = max(0, int(budget_bytes) - fixed)
     return int(usable // per_stream)
 
 
@@ -779,6 +848,7 @@ class _Stream:
         "t_decode_start", "t_first_token", "t_finish",
         "queue_depth_at_submit", "cached_len", "prefilled", "priority",
         "deadline", "preempted", "kv_export", "kv_import", "kv_payload",
+        "adapter", "adapter_slot", "adapter_pinned",
     )
 
     def __init__(self, req_id, prompt, max_new, temperature, top_k, eos_id, seed):
@@ -852,6 +922,13 @@ class _Stream:
         self.priority = 0
         self.deadline: Optional[float] = None
         self.preempted = False
+        # multi-LoRA (r16): the named adapter this stream decodes with
+        # (None = base model), its slot in the engine's factor pool
+        # (0 = the zero adapter), and whether the stream still holds a
+        # pin on that slot (released exactly once at termination)
+        self.adapter: Optional[str] = None
+        self.adapter_slot = 0
+        self.adapter_pinned = False
 
 
 class PagedEngine:
@@ -893,6 +970,9 @@ class PagedEngine:
         prefix_cache: Optional[bool] = None,
         max_queue: int = 0,
         chunk_token_budget: int = 0,
+        max_adapters: int = 0,
+        lora_rank: int = 8,
+        weight_registry: Any = None,
     ):
         import jax
         import jax.numpy as jnp
@@ -1162,6 +1242,53 @@ class PagedEngine:
                     self.chunk_token_budget, floor,
                 )
                 self.chunk_token_budget = floor
+        # batched multi-LoRA serving lane (r16, S-LoRA/Punica): a
+        # slot-granular adapter factor pool next to the KV pool, per-
+        # stream slot ids threaded through every engine program as a
+        # TRACED index (one program per wave regardless of how many
+        # distinct adapters it mixes).  0 (the default, or
+        # SELDON_TPU_MAX_ADAPTERS unset) keeps the engine byte-
+        # identical to the pre-adapter lowering: no pool is built and
+        # no program takes the extra arguments.
+        if not max_adapters:
+            max_adapters = int(_knobs.raw("SELDON_TPU_MAX_ADAPTERS", "0") or 0)
+        self.max_adapters = max(0, int(max_adapters))
+        self._registry = weight_registry
+        self._lora = None
+        if self.max_adapters:
+            from seldon_core_tpu.ops.lora import LoraPool
+
+            self._lora = LoraPool(
+                num_layers=num_layers, d_model=d_model,
+                max_adapters=self.max_adapters, rank=int(lora_rank),
+            )
+        # adapter table (guarded by _lock; _adapter_io_lock serializes
+        # the slow load/install path so concurrent cold admissions of
+        # one adapter never double-install): name -> pool slot, per-
+        # slot stream refcounts, an LRU of refcount-0 RESIDENT slots
+        # (reclaimed on demand — the prefix cache's capacity-not-cost
+        # discipline applied to weights), and temp pins covering the
+        # submit window between residency and stream attachment (the
+        # allocator audit counts them).
+        self._adapter_io_lock = threading.Lock()
+        self._adapter_table: Dict[str, int] = {}
+        self._adapter_names: Dict[int, str] = {}
+        self._adapter_ref = np.zeros((self.max_adapters + 1,), np.int32)
+        self._adapter_free: List[int] = list(range(self.max_adapters, 0, -1))
+        self._adapter_lru: "OrderedDict[int, str]" = OrderedDict()
+        self._adapter_temp_pins: Dict[int, int] = {}
+        # slots mid-install: popped from free/LRU but not yet named —
+        # the device install runs OUTSIDE _lock (it must not stall the
+        # decode loop), so the chunk-boundary audit needs this set to
+        # account for the in-flight slot instead of calling it leaked
+        self._adapter_installing: set = set()
+        # engine-held registry pins: adapter names whose weights the
+        # registry keeps pinned while they are resident in THIS pool
+        self._adapter_reg_pinned: set = set()
+        self._adapter_requests: Dict[str, int] = {}
+        # per-slot adapter ids the programs gather by (slot-major, like
+        # _block_tables; lanes without an adapter read slot 0 = zeros)
+        self._adapter_slots = np.zeros((self.max_slots,), np.int32)
         self._queue: Deque[_Stream] = deque()
         self._queued: set = set()  # identity membership (streams are unhashable-by-value)
         self._slots: List[Optional[_Stream]] = [None] * self.max_slots
@@ -1211,6 +1338,15 @@ class PagedEngine:
                           # KV-page handoff payloads, and imported
                           # payloads scatter-written into this pool
                           "kv_exports": 0, "kv_imports": 0,
+                          # multi-LoRA (r16): adapter pool-slot loads /
+                          # LRU reclaims, submit-time residency hit or
+                          # cold-load miss, and waves whose runnable
+                          # lanes mixed >= 2 distinct adapter slots
+                          # (the grouped-matmul case — still ONE
+                          # compiled program, which is the point)
+                          "adapter_loads": 0, "adapter_evictions": 0,
+                          "adapter_hits": 0, "adapter_misses": 0,
+                          "multi_adapter_chunks": 0,
                           # wall seconds inside device calls + readback,
                           # split by phase: decode-rate observability
                           # (tokens / chunk_wall_s) independent of
@@ -1339,6 +1475,7 @@ class PagedEngine:
                 self._tp_jit(
                     self._spec_chunk_fn, n_rep_in=5,
                     out_spec=("rep", "rep", "pool", "pool", "rep"),
+                    lora=True,
                 )
             )
             if self.speculative is not None else None
@@ -1366,7 +1503,8 @@ class PagedEngine:
         return materialize(params, self.quantize, dtype)
 
     def _tp_jit(self, fn, *, n_rep_in: int, out_spec: Sequence[str],
-                donate_argnums: Tuple[int, ...] = (1, 2)):
+                donate_argnums: Tuple[int, ...] = (1, 2),
+                lora: bool = False):
         """jit an engine program, annotated for GSPMD under a TP mesh.
 
         Every engine program shares one argument convention — ``(params,
@@ -1386,7 +1524,15 @@ class PagedEngine:
         ``mesh=None`` returns the EXACT historical ``jax.jit`` call —
         no annotation objects are even constructed — so TP=1 programs
         stay byte-identical to the pre-TP engine (asserted by the
-        no-collectives lowering test)."""
+        no-collectives lowering test).
+
+        ``lora=True`` marks a program that takes the multi-LoRA
+        trailing arguments ``(factor pools, adapter_idx)`` WHEN the
+        engine has adapters enabled — the pools pin the megatron-
+        following shardings ``LoraPool.shardings`` spells (A col- /
+        B row-parallel with their base layer), the index replicates.
+        With adapters off nothing is appended and the signature (and
+        lowering) is byte-identical to the pre-adapter engine."""
         jax = self._jax
         if self._mesh is None:
             return jax.jit(fn, donate_argnums=donate_argnums)
@@ -1399,10 +1545,15 @@ class PagedEngine:
         param_sh = jax.tree.map(
             lambda x: getattr(x, "sharding", rep), self.params
         )
+        in_sh: Tuple[Any, ...] = (param_sh, pool, pool) + (rep,) * n_rep_in
+        if lora and self._lora is not None:
+            in_sh = in_sh + (
+                self._lora.shardings(self._mesh, self._model_axis), rep,
+            )
         return jax.jit(
             fn,
             donate_argnums=donate_argnums,
-            in_shardings=(param_sh, pool, pool) + (rep,) * n_rep_in,
+            in_shardings=in_sh,
             out_shardings=tuple(
                 pool if o == "pool" else rep for o in out_spec
             ),
@@ -1417,14 +1568,17 @@ class PagedEngine:
         block row 0) write only the trash page."""
         jax, jnp = self._jax, self._jnp
 
-        def prefill(params, pk, pv, tokens, true_lens, block_rows):
+        def prefill(params, pk, pv, tokens, true_lens, block_rows,
+                    lora=None, adapter_idx=None):
             # tokens: (k, bucket)  true_lens: (k,)  block_rows: (k, P)
+            # lora/adapter_idx: the multi-LoRA trailing args (engines
+            # with adapters enabled only — pad rows carry slot 0)
             params = self._materialize(params)
             positions = jnp.broadcast_to(jnp.arange(bucket)[None, :], (k, bucket))
             lengths = jnp.zeros((k,), jnp.int32)
             logits, nk, nv = self.module.apply(
                 {"params": params}, tokens, positions, pk, pv,
-                block_rows, lengths,
+                block_rows, lengths, lora=lora, adapter_idx=adapter_idx,
             )
             valid = jnp.arange(bucket)[None, :] < true_lens[:, None]
             pk, pv = self._write_kv(
@@ -1435,7 +1589,8 @@ class PagedEngine:
             return last, pk, pv
 
         return self._sentinels["paged_prefill"].wrap(
-            self._tp_jit(prefill, n_rep_in=3, out_spec=("rep", "pool", "pool")),
+            self._tp_jit(prefill, n_rep_in=3, out_spec=("rep", "pool", "pool"),
+                         lora=True),
             static=f"bucket={bucket},k={k}",
         )
 
@@ -1458,7 +1613,7 @@ class PagedEngine:
         jax, jnp = self._jax, self._jnp
 
         def prefill(params, pk, pv, tokens, true_lens, cached_lens,
-                    read_rows, write_rows):
+                    read_rows, write_rows, lora=None, adapter_idx=None):
             # tokens: (k, bucket) suffix tokens  true_lens: (k,) suffix
             # lengths  cached_lens: (k,) tokens already resident in
             # shared pages  read_rows: (k, rp)  write_rows: (k, wp)
@@ -1468,6 +1623,7 @@ class PagedEngine:
                 {"params": params}, tokens,
                 jnp.minimum(positions, self.max_len - 1),
                 pk, pv, read_rows, cached_lens,
+                lora=lora, adapter_idx=adapter_idx,
             )
             valid = jnp.arange(bucket)[None, :] < true_lens[:, None]
             pk, pv = self._write_kv(
@@ -1478,7 +1634,8 @@ class PagedEngine:
             return last, pk, pv
 
         return self._sentinels["paged_prefill"].wrap(
-            self._tp_jit(prefill, n_rep_in=5, out_spec=("rep", "pool", "pool")),
+            self._tp_jit(prefill, n_rep_in=5, out_spec=("rep", "pool", "pool"),
+                         lora=True),
             static=f"cached,bucket={bucket},k={k},rp={rp}",
         )
 
@@ -1634,6 +1791,7 @@ class PagedEngine:
             body, n_rep_in=11,
             out_spec=("rep", "pool", "pool", "rep", "rep", "rep",
                       "rep", "rep"),
+            lora=True,
         )
 
     def lower_chunk(self, steps: int, buckets: Tuple[Tuple[int, int], ...]):
@@ -1679,11 +1837,20 @@ class PagedEngine:
             jnp.full((B,), -1, jnp.int32),
             jnp.arange(B, dtype=jnp.int32),
         )
+        if self._lora is not None:
+            # adapters enabled: the served program takes the factor
+            # pools + per-lane slot ids, so the audit must lower the
+            # same signature (zeros index = every lane on the zero
+            # adapter — representative, same lowering as any mix)
+            ex = ex + (
+                self._lora.device_args(), jnp.zeros((B,), jnp.int32),
+            )
         return self._chunk_program(steps, buckets).lower(*ex)
 
     def _chunk_fn(
         self, steps, buckets, params, pk, pv, logits, lengths, block_tables,
         keys, done, emitted, max_new, temps, top_ks, eos_ids, perm,
+        lora=None, adapter_idx=None,
     ):
         """``steps`` decode steps for all slots, on device — the ring
         implementation (r5 default).
@@ -1744,6 +1911,8 @@ class PagedEngine:
                     logits, lengths, block_tables, keys, done, emitted,
                     max_new, temps, top_ks, eos_ids)
             )
+            if adapter_idx is not None:
+                adapter_idx = adapter_idx[perm]
 
         len0 = lengths  # frozen at chunk start: ctx mask + write-back base
         # POOL layout: flat (L, pages, ps, d) by default (halves HBM —
@@ -1792,6 +1961,7 @@ class PagedEngine:
                 {"params": params}, token[:, None],
                 jnp.minimum(positions, self.max_len - 1),
                 ctx_k, ctx_v, ring_k, ring_v, t, len0,
+                lora=lora, adapter_idx=adapter_idx,
             )
             # ring col t <- this step's K/V: ONE uniform DUS (inactive
             # lanes write garbage there; never written back — emitted
@@ -1898,6 +2068,7 @@ class PagedEngine:
     def _chunk_fn_pool(
         self, steps, buckets, params, pk, pv, logits, lengths, block_tables,
         keys, done, emitted, max_new, temps, top_ks, eos_ids, perm,
+        lora=None, adapter_idx=None,
     ):
         """Legacy chunk implementation (SELDON_TPU_CHUNK_IMPL=pool):
         per-step pool gather + per-slot DUS writes.  Kept selectable
@@ -1920,6 +2091,8 @@ class PagedEngine:
                     logits, lengths, block_tables, keys, done, emitted,
                     max_new, temps, top_ks, eos_ids)
             )
+            if adapter_idx is not None:
+                adapter_idx = adapter_idx[perm]
             split_tables = []
             off = 0
             for nb, hb in buckets:
@@ -1947,6 +2120,7 @@ class PagedEngine:
                 {"params": params}, token[:, None],
                 jnp.minimum(positions, self.max_len - 1),
                 pk, pv, attn_tables, lengths,
+                lora=lora, adapter_idx=adapter_idx,
             )
             pk, pv = self._write_kv(
                 pk, pv, nk, nv, block_tables, lengths, active[:, None]
@@ -2007,7 +2181,7 @@ class PagedEngine:
         return toks.T  # (slots, draft_k)
 
     def _spec_chunk_fn(self, params, pk, pv, segs, n_drafts, active,
-                       block_tables, lengths):
+                       block_tables, lengths, lora=None, adapter_idx=None):
         """One verify forward for every active slot.
 
         ``segs[i]`` = [pending, d_1..d_k] (pads beyond ``n_drafts[i]``
@@ -2025,6 +2199,7 @@ class PagedEngine:
             {"params": params}, segs,
             jnp.minimum(positions, self.max_len - 1),
             pk, pv, block_tables, lengths,
+            lora=lora, adapter_idx=adapter_idx,
         )
         greedy = jnp.argmax(logits, axis=-1)  # (S, L)
         match = (greedy[:, : L - 1] == segs[:, 1:]) & (
@@ -2131,6 +2306,7 @@ class PagedEngine:
         deadline: Optional[float] = None,
         kv_export: bool = False,
         kv_import: Optional[Dict[str, Any]] = None,
+        adapter: Optional[str] = None,
     ) -> _Stream:
         """Queue one prompt (1-D int array). Returns a stream handle whose
         ``event`` fires when ``result`` (``(max_new,)`` ids) is ready.
@@ -2161,7 +2337,13 @@ class PagedEngine:
         ``kv_import`` admits a prefill worker's payload: the pages are
         scatter-written (no prefill FLOPs) and decode starts from the
         imported last-token logits.  Prefer the :meth:`prefill_export`
-        / :meth:`submit_prefilled` fronts, which validate payloads."""
+        / :meth:`submit_prefilled` fronts, which validate payloads.
+
+        ``adapter`` (multi-LoRA, r16) names the weight set this stream
+        decodes with: a resident adapter pins its pool slot for the
+        stream's lifetime, a cold one loads through the weight registry
+        first (load -> pin -> serve -> unpin).  ``None`` is the base
+        model — slot 0, the zero adapter, no lookup, no pin."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         plen = len(prompt)
         if plen < 1:
@@ -2205,6 +2387,52 @@ class PagedEngine:
             # fast-fail before queueing: a spent budget must not burn a
             # queue slot, an admission wave, or a single decode step
             raise deadline_exceeded("paged-engine submit")
+        # adapter resolution BEFORE the queue lock: a cold adapter pays
+        # registry load + device install here, on the submitting thread
+        # — never inside an engine wave.  The returned slot carries a
+        # temp pin that transfers onto the stream below (or rolls back
+        # if admission itself rejects).  CHEAP admission checks run
+        # first: an overload burst that is about to shed (or a closed
+        # engine) must not thrash warm adapters out of the pool with
+        # cold loads for requests that never serve.
+        adapter = adapter or None
+        if adapter is not None:
+            with self._lock:
+                if self._closed:
+                    raise MicroserviceError(
+                        "engine closed", status_code=503,
+                        reason="SHUTTING_DOWN",
+                    )
+                if self.max_queue and len(self._queue) >= self.max_queue:
+                    # may raise 503 SHED for this request (or make room
+                    # by shedding a lower-priority victim — the same
+                    # policy _submit_pinned re-checks after the load)
+                    self._shed_for_admission_locked(int(priority))
+        adapter_slot = (
+            self._acquire_adapter_slot(adapter) if adapter is not None else 0
+        )
+        try:
+            return self._submit_pinned(
+                prompt, max_new_tokens, temperature, top_k, eos_id, seed,
+                draft_hint, stream_tokens, trace_id, parent_span_id,
+                priority, deadline, kv_export, kv_import, adapter,
+                adapter_slot,
+            )
+        except BaseException:
+            if adapter_slot:
+                with self._lock:
+                    self._drop_temp_pin_locked(adapter_slot)
+                    self._unpin_adapter_slot_locked(adapter_slot)
+            raise
+
+    def _submit_pinned(
+        self, prompt, max_new_tokens, temperature, top_k, eos_id, seed,
+        draft_hint, stream_tokens, trace_id, parent_span_id,
+        priority, deadline, kv_export, kv_import, adapter, adapter_slot,
+    ) -> _Stream:
+        import queue as _queue
+        import time as _time
+
         with self._lock:
             if self._closed:
                 raise MicroserviceError(
@@ -2220,6 +2448,16 @@ class PagedEngine:
             stream.deadline = float(deadline) if deadline is not None else None
             stream.kv_export = bool(kv_export)
             stream.kv_import = kv_import
+            stream.adapter = adapter
+            stream.adapter_slot = int(adapter_slot)
+            if adapter_slot:
+                # the temp pin becomes the stream's pin — refcount
+                # unchanged, attribution moves (the audit counts both)
+                stream.adapter_pinned = True
+                self._drop_temp_pin_locked(adapter_slot)
+                self._adapter_requests[adapter] = (
+                    self._adapter_requests.get(adapter, 0) + 1
+                )
             if draft_hint is not None:
                 stream.draft_hint = np.asarray(draft_hint, np.int32).reshape(-1)
             if stream_tokens:
@@ -2284,6 +2522,231 @@ class PagedEngine:
             raise
         return admitted
 
+    # ---- multi-LoRA adapter pool: slots, pins, LRU reclaim (r16) ----------
+
+    def _unpin_adapter_slot_locked(self, slot: int) -> None:
+        """Drop one pin on a pool slot; the last pin parks the slot on
+        the adapter LRU (still resident — reclaimed only when a cold
+        load needs it, the capacity-not-cost discipline).  Caller holds
+        ``_lock``."""
+        r = int(self._adapter_ref[slot]) - 1
+        self._adapter_ref[slot] = max(r, 0)
+        if r <= 0 and slot in self._adapter_names:
+            self._adapter_lru[slot] = self._adapter_names[slot]
+
+    def _release_adapter_locked(self, stream: _Stream) -> None:
+        """Terminal-path unpin (finish / fail / export / queued-cancel):
+        exactly once per stream — the ``adapter_pinned`` flag guards
+        the multiple terminal paths that can race to retire one
+        stream.  Caller holds ``_lock``."""
+        if not stream.adapter_pinned:
+            return
+        stream.adapter_pinned = False
+        self._unpin_adapter_slot_locked(stream.adapter_slot)
+
+    def _install_adapter(self, name: str, params: Dict[str, Any]) -> int:
+        """Place one adapter's factors into a pool slot (called under
+        ``_adapter_io_lock``, NOT holding ``_lock``): take a free slot
+        or reclaim the LRU refcount-0 one; every slot pinned is a clean
+        503 — adapter capacity is a serving error, never a crash.  The
+        returned slot carries ONE pin (a temp pin the caller transfers
+        or drops)."""
+        victim: Optional[str] = None
+        with self._lock:
+            if self._adapter_free:
+                slot = self._adapter_free.pop()
+            elif self._adapter_lru:
+                slot, victim = self._adapter_lru.popitem(last=False)
+                del self._adapter_table[victim]
+                self._adapter_names.pop(slot, None)
+                self._counters["adapter_evictions"] += 1
+            else:
+                raise MicroserviceError(
+                    f"adapter pool exhausted: all {self.max_adapters} "
+                    "slots pinned by live streams",
+                    status_code=503, reason="ADAPTERS_EXHAUSTED",
+                )
+            self._adapter_installing.add(slot)
+        if victim is not None and victim in self._adapter_reg_pinned:
+            # the evicted adapter's registry pin drops: its host copy
+            # becomes reclaimable registry capacity (weight-page LRU)
+            self._adapter_reg_pinned.discard(victim)
+            self._registry.release(victim)
+        # device install outside _lock: .at[].set builds new factor
+        # buffers the NEXT wave reads — shapes unchanged, nothing
+        # recompiles, and no wave is in flight on this slot (it was
+        # free or refcount-0).  Shape/target validation happens BEFORE
+        # any write, so a wrong-rank or partial adapter is a clean 400
+        # with the slot returned untouched.
+        try:
+            self._lora.install(slot, params)
+        except ValueError as exc:
+            with self._lock:
+                self._adapter_installing.discard(slot)
+                self._adapter_free.append(slot)
+            raise MicroserviceError(
+                f"adapter {name!r} does not fit this engine's factor "
+                f"pool: {exc}",
+                status_code=400, reason="ADAPTER_INCOMPATIBLE",
+            ) from exc
+        except BaseException:
+            with self._lock:
+                self._adapter_installing.discard(slot)
+                self._adapter_free.append(slot)
+            raise
+        with self._lock:
+            self._adapter_installing.discard(slot)
+            self._adapter_table[name] = slot
+            self._adapter_names[slot] = name
+            self._adapter_ref[slot] = 1
+            self._adapter_temp_pins[slot] = (
+                self._adapter_temp_pins.get(slot, 0) + 1
+            )
+            self._counters["adapter_loads"] += 1
+        return slot
+
+    def _acquire_adapter_slot(self, name: str) -> int:
+        """Resolve ``name`` to a pinned pool slot — the cold-admission
+        path of the issue's load -> pin -> serve -> unpin: a resident
+        adapter is a hit (pin bumps), a cold one loads through the
+        weight registry (budget-priced) and installs.  The pin is
+        recorded as a temp pin until :meth:`submit` attaches it to the
+        stream, so the allocator audit balances at every instant."""
+        if self._lora is None:
+            raise MicroserviceError(
+                "this engine serves no adapters (max_adapters=0 / "
+                "SELDON_TPU_MAX_ADAPTERS unset)",
+                status_code=400, reason="ADAPTERS_DISABLED",
+            )
+
+        # resident fast path NEVER touches the io lock: check-and-pin
+        # is atomic under _lock (a pinned slot can't be reclaimed —
+        # eviction requires refcount 0), so warm submits must not
+        # serialize behind another adapter's slow cold load
+        with self._lock:
+            slot = self._pin_resident_adapter_locked(name)
+            if slot is not None:
+                return slot
+        with self._adapter_io_lock:
+            with self._lock:
+                # re-check: a concurrent cold load may have installed it
+                slot = self._pin_resident_adapter_locked(name)
+                if slot is not None:
+                    return slot
+                self._counters["adapter_misses"] += 1
+            if self._registry is None or not self._registry.known(name):
+                raise MicroserviceError(
+                    f"unknown adapter {name!r}: not resident and not "
+                    "registered in the weight registry",
+                    status_code=404, reason="ADAPTER_UNKNOWN",
+                )
+            params = self._registry.acquire(name)
+            try:
+                slot = self._install_adapter(name, params)
+            except BaseException:
+                self._registry.release(name)
+                raise
+            # the registry pin is held while the adapter stays resident
+            # in THIS pool (released on pool eviction / unload / close)
+            self._adapter_reg_pinned.add(name)
+            return slot
+
+    def _pin_resident_adapter_locked(self, name: str) -> Optional[int]:
+        """Hit path of adapter resolution: pin ``name``'s slot (ref +
+        temp pin) if it is resident, else None.  Caller holds
+        ``_lock``."""
+        slot = self._adapter_table.get(name)
+        if slot is None:
+            return None
+        self._counters["adapter_hits"] += 1
+        self._adapter_ref[slot] += 1
+        self._adapter_temp_pins[slot] = (
+            self._adapter_temp_pins.get(slot, 0) + 1
+        )
+        self._adapter_lru.pop(slot, None)
+        return slot
+
+    def _drop_temp_pin_locked(self, slot: int) -> None:
+        n = self._adapter_temp_pins.get(slot, 0) - 1
+        if n > 0:
+            self._adapter_temp_pins[slot] = n
+        else:
+            self._adapter_temp_pins.pop(slot, None)
+
+    def load_adapter(self, name: str, params: Optional[Dict[str, Any]] = None) -> int:
+        """Hot-load ``name`` into the pool WITHOUT serving from it
+        (warm-up / tools): direct ``params`` install, or a registry
+        pull when omitted.  Returns the slot; the adapter parks
+        refcount-0 on the LRU (resident, reclaimable)."""
+        if params is not None:
+            if self._lora is None:
+                raise MicroserviceError(
+                    "this engine serves no adapters (max_adapters=0)",
+                    status_code=400, reason="ADAPTERS_DISABLED",
+                )
+            with self._adapter_io_lock:
+                with self._lock:
+                    slot = self._adapter_table.get(name)
+                    if slot is not None:
+                        return slot
+                slot = self._install_adapter(name, params)
+                with self._lock:
+                    self._drop_temp_pin_locked(slot)
+                    self._unpin_adapter_slot_locked(slot)
+                return slot
+        slot = self._acquire_adapter_slot(name)
+        with self._lock:
+            self._drop_temp_pin_locked(slot)
+            self._unpin_adapter_slot_locked(slot)
+        return slot
+
+    def unload_adapter(self, name: str) -> None:
+        """Explicitly evict a resident adapter (rolling re-deploys).
+        Pinned adapters refuse with 409 — live streams must never have
+        their factors swapped mid-decode."""
+        with self._adapter_io_lock:
+            with self._lock:
+                slot = self._adapter_table.get(name)
+                if slot is None:
+                    return
+                if int(self._adapter_ref[slot]) > 0:
+                    raise MicroserviceError(
+                        f"adapter {name!r} is pinned by live streams",
+                        status_code=409, reason="ADAPTER_IN_USE",
+                    )
+                del self._adapter_table[name]
+                self._adapter_names.pop(slot, None)
+                self._adapter_lru.pop(slot, None)
+                self._adapter_free.append(slot)
+            if name in self._adapter_reg_pinned:
+                self._adapter_reg_pinned.discard(name)
+                self._registry.release(name)
+
+    def adapter_stats(self) -> Dict[str, Any]:
+        """The ``GET /debug/weights`` per-engine payload: residency,
+        per-slot pins, and the pool's per-shard HBM price."""
+        with self._lock:
+            resident = [
+                {
+                    "name": name,
+                    "slot": slot,
+                    "refcount": int(self._adapter_ref[slot]),
+                    "cached": slot in self._adapter_lru,
+                }
+                for name, slot in sorted(self._adapter_table.items())
+            ]
+            return {
+                "enabled": self._lora is not None,
+                "max_adapters": self.max_adapters,
+                "rank": self._lora.rank if self._lora is not None else 0,
+                "pool_bytes": (
+                    self._lora.hbm_bytes(self.tp_degree)
+                    if self._lora is not None else 0
+                ),
+                "resident": resident,
+                "requests": dict(self._adapter_requests),
+            }
+
     # ---- refcounted page allocator + prefix cache (r9) --------------------
 
     def _allocatable_locked(self) -> int:
@@ -2341,13 +2804,27 @@ class PagedEngine:
                     self._page_entry.pop(p, None)
                 self._free_pages.append(p)
 
-    def _match_prefix_locked(self, prompt: np.ndarray) -> List[_CachedPrefix]:
+    def _prefix_root_for(self, adapter: Optional[str]) -> int:
+        """Chain root per weight set (r16): adapter-selected prefill
+        writes DIFFERENT KV than the base model for the same tokens, so
+        each adapter chains off its own root — two tenants sharing a
+        system prompt share pages only within one adapter.  The base
+        model keeps the historical root (cache keys unchanged when
+        adapters are off)."""
+        if not adapter:
+            return _PREFIX_ROOT
+        return prefix_chain_key(_PREFIX_ROOT, (adapter,))
+
+    def _match_prefix_locked(
+        self, prompt: np.ndarray, root: int
+    ) -> List[_CachedPrefix]:
         """Longest cached prefix of FULL prompt pages, walked root →
         leaf through the chain-keyed index in O(pages).  The last
         prompt page is always private — even when the prompt is an
         exact page multiple — so the suffix prefill always has at least
         one token to produce the next-token logits from.  Colliding
-        keys verify token equality before sharing: a hash collision
+        keys verify parent AND token equality before sharing: a hash
+        collision (including an adapter root colliding with another's)
         degrades to a miss, never to foreign KV.  No LRU touching
         here: the caller pops every matched refcount-0 page off the
         LRU when it maps them (and its rollback re-inserts deepest
@@ -2358,12 +2835,12 @@ class PagedEngine:
         ps = self.page_size
         n_full = (len(prompt) - 1) // ps
         matched: List[_CachedPrefix] = []
-        parent = _PREFIX_ROOT
+        parent = root
         for i in range(n_full):
             toks = tuple(int(t) for t in prompt[i * ps:(i + 1) * ps])
             key = prefix_chain_key(parent, toks)
             entry = self._prefix_index.get(key)
-            if entry is None or entry.tokens != toks:
+            if entry is None or entry.parent != parent or entry.tokens != toks:
                 break
             matched.append(entry)
             parent = key
@@ -2391,7 +2868,7 @@ class PagedEngine:
         ps = self.page_size
         prompt = stream.prompt
         n_full = (len(prompt) - 1) // ps
-        parent = _PREFIX_ROOT
+        parent = self._prefix_root_for(stream.adapter)
         for i in range(n_full):
             toks = tuple(int(t) for t in prompt[i * ps:(i + 1) * ps])
             key = prefix_chain_key(parent, toks)
@@ -2402,7 +2879,7 @@ class PagedEngine:
                     e = _CachedPrefix(key, page, toks, parent)
                     self._prefix_index[key] = e
                     self._page_entry[page] = e
-            elif entry.tokens != toks:
+            elif entry.parent != parent or entry.tokens != toks:
                 break  # collision: descendants are unreachable anyway
             parent = key
 
@@ -2450,10 +2927,59 @@ class PagedEngine:
             if entry.page != p or self._prefix_index.get(entry.key) is not entry \
                     or self._page_entry.get(p) is not entry:
                 problems.append(f"LRU entry for page {p} inconsistent with index")
+        problems.extend(self._adapter_problems_locked())
         if problems:
             raise RuntimeError(
                 "paged allocator invariant violation: " + "; ".join(problems)
             )
+
+    def _adapter_problems_locked(self) -> List[str]:
+        """The SELDON_TPU_PAGED_DEBUG audit extended to WEIGHT slots
+        (r16): non-zero pool slots partition into free ∪ resident,
+        per-slot refcounts equal live-stream pins plus in-submit temp
+        pins, and the adapter LRU holds exactly the refcount-0
+        residents."""
+        if self._lora is None:
+            return []
+        problems: List[str] = []
+        free = set(self._adapter_free)
+        named = set(self._adapter_names)
+        installing = set(self._adapter_installing)
+        if free & named:
+            problems.append(
+                f"adapter slots simultaneously free and named: {sorted(free & named)}"
+            )
+        if (free | named) & installing:
+            problems.append(
+                "adapter slots simultaneously installing and free/named: "
+                f"{sorted((free | named) & installing)}"
+            )
+        if free | named | installing != set(range(1, self.max_adapters + 1)):
+            problems.append("adapter slots leaked or phantom")
+        pins: Dict[int, int] = dict(self._adapter_temp_pins)
+        for s in list(self._queue) + [s for s in self._slots if s is not None]:
+            if s.adapter_pinned:
+                pins[s.adapter_slot] = pins.get(s.adapter_slot, 0) + 1
+        for slot in range(1, self.max_adapters + 1):
+            want = pins.get(slot, 0)
+            if int(self._adapter_ref[slot]) != want:
+                problems.append(
+                    f"adapter slot {slot} refcount "
+                    f"{int(self._adapter_ref[slot])} != {want} pins"
+                )
+            cached = slot in self._adapter_lru
+            if cached and int(self._adapter_ref[slot]) > 0:
+                problems.append(f"adapter slot {slot} cached while pinned")
+            if slot in named and not cached and int(self._adapter_ref[slot]) == 0:
+                problems.append(
+                    f"adapter slot {slot} resident, unpinned, but not on the LRU"
+                )
+        for slot, name in self._adapter_lru.items():
+            if self._adapter_table.get(name) != slot:
+                problems.append(
+                    f"adapter LRU entry {name!r}@{slot} inconsistent with table"
+                )
+        return problems
 
     # ---- SLO lifecycle: shed / expire / preempt (r10) ---------------------
 
@@ -2484,6 +3010,7 @@ class PagedEngine:
             self._free_locked(stream.pages)
             stream.pages = []
         stream.slot = None
+        self._release_adapter_locked(stream)
         if stream.token_queue is not None:
             stream.token_queue.put(None)
         stream.event.set()
@@ -2570,7 +3097,9 @@ class PagedEngine:
         # allocate fresh pages and re-register afterwards instead
         matched = (
             [] if stream.kv_import is not None
-            else self._match_prefix_locked(stream.prompt)
+            else self._match_prefix_locked(
+                stream.prompt, self._prefix_root_for(stream.adapter)
+            )
         )
         for e in matched:
             if int(self._page_ref[e.page]) == 0:
@@ -2609,6 +3138,9 @@ class PagedEngine:
         row[: len(stream.pages)] = stream.pages
         self._block_tables[slot] = row
         self._lengths[slot] = plen
+        # the lane's adapter slot id: every engine program gathers this
+        # lane's low-rank factors by it (0 = the zero adapter)
+        self._adapter_slots[slot] = stream.adapter_slot
         return True
 
     def _preempt_locked(self, stream: _Stream) -> Optional[int]:
@@ -2821,6 +3353,14 @@ class PagedEngine:
         while k < len(group):
             k *= 2
         ps = self.page_size
+        # multi-LoRA trailing args: per-row adapter slots (pad rows 0 —
+        # the zero adapter, deltas exactly 0.0 into the trash page)
+        lora_args: Tuple[Any, ...] = ()
+        if self._lora is not None:
+            adapter_rows = np.zeros((k,), np.int32)
+            for i, (stream, _start, _n) in enumerate(group):
+                adapter_rows[i] = stream.adapter_slot
+            lora_args = (self._lora.device_args(), jnp.asarray(adapter_rows))
         if use_cache:
             rp = self._pages_pow2(
                 max(1, max(start // ps for _s, start, _n in group))
@@ -2852,7 +3392,7 @@ class PagedEngine:
                 self.params, self.pages_k, self.pages_v,
                 jnp.asarray(padded), jnp.asarray(true_lens),
                 jnp.asarray(cached_lens), jnp.asarray(read_rows),
-                jnp.asarray(write_rows),
+                jnp.asarray(write_rows), *lora_args,
             )
         else:
             key2 = (bucket, k)
@@ -2873,7 +3413,7 @@ class PagedEngine:
             last, self.pages_k, self.pages_v = self._prefill_jit[key2](
                 self.params, self.pages_k, self.pages_v,
                 jnp.asarray(padded), jnp.asarray(true_lens),
-                jnp.asarray(block_rows),
+                jnp.asarray(block_rows), *lora_args,
             )
         finals: List[Tuple[int, _Stream]] = []
         for i, (stream, start, n) in enumerate(group):
@@ -3038,6 +3578,7 @@ class PagedEngine:
                     self._free_locked(stream.pages)
                     stream.pages = []
                 stream.slot = None
+                self._release_adapter_locked(stream)
                 self._counters["kv_exports"] += 1
                 self._counters["completed"] += 1
                 if stream.trace_id:
@@ -3055,6 +3596,7 @@ class PagedEngine:
         priority: int = 0,
         deadline: Optional[float] = None,
         drive: bool = True,
+        adapter: Optional[str] = None,
     ) -> Dict[str, Any]:
         """Synchronous prefill-only front — the prefill WORKER's one
         call in disaggregated serving: admit ``prompt``, run its
@@ -3065,6 +3607,7 @@ class PagedEngine:
         stream = self.submit(
             np.asarray(prompt), max_new_tokens=1, seed=seed,
             priority=priority, deadline=deadline, kv_export=True,
+            adapter=adapter,
         )
         if drive:
             while not stream.event.is_set() and self.has_work():
@@ -3223,6 +3766,7 @@ class PagedEngine:
         self._free_locked(stream.pages)
         stream.pages = []
         self._lengths[slot] = 0
+        self._release_adapter_locked(stream)
         self._counters["completed"] += 1
         stream.event.set()
 
@@ -3280,6 +3824,7 @@ class PagedEngine:
                     toks + [stream.eos_id] * (stream.max_new - len(toks)),
                     np.int32,
                 )
+                self._release_adapter_locked(stream)
                 if stream.token_queue is not None:
                     stream.token_queue.put(None)
                 stream.event.set()
@@ -3370,6 +3915,14 @@ class PagedEngine:
                 # chunked-prefill co-scheduling (r15): the wave token
                 # budget this engine runs under (0 = monolithic prefill)
                 "chunk_token_budget": self.chunk_token_budget,
+                # multi-LoRA (r16): adapters resident in the factor
+                # pool (pinned + LRU-cached) and the pool's slot count;
+                # per-adapter request counts export with adapter labels
+                # straight from the bridge (the flat mapping can't
+                # carry labels — see ENGINE_STATS_EXCLUDED)
+                "adapters_resident": len(self._adapter_table),
+                "adapter_slots": self.max_adapters,
+                "adapter_requests": dict(self._adapter_requests),
                 # distinct compiled signatures seen by the jit sentinels
                 # (prometheus gets the per-program split directly from
                 # jitwatch — bridge-excluded to avoid double export)
@@ -3443,6 +3996,10 @@ class PagedEngine:
                     "streamed": int(s.streamed),
                     "stream_tokens": s.token_queue is not None,
                     "tokens_decoded": len(s.tokens),  # diagnostics only
+                    # the replayed stream must decode with the SAME
+                    # weight set; the respawned engine re-resolves the
+                    # name through its registry (cold-load on replay)
+                    "adapter": s.adapter,
                 })
             self._queue.clear()
             self._queued.clear()
@@ -3495,6 +4052,7 @@ class PagedEngine:
                     priority=int(e.get("priority", 0)),
                     deadline=deadline,
                     stream_tokens=want_stream,
+                    adapter=e.get("adapter") or None,
                 )
             except MicroserviceError as exc:
                 logger.warning(
@@ -3523,6 +4081,15 @@ class PagedEngine:
                 "engine closed", status_code=503, reason="SHUTTING_DOWN"
             )
         )
+        # drop the engine-held registry pins: a closed engine's host
+        # weight copies become reclaimable registry capacity
+        if self._registry is not None:
+            with self._adapter_io_lock:
+                pinned, self._adapter_reg_pinned = (
+                    self._adapter_reg_pinned, set()
+                )
+                for name in pinned:
+                    self._registry.release(name)
 
     def fail_all(self, exc: Exception) -> None:
         """Error out every queued and in-flight stream, returning their
@@ -3539,6 +4106,7 @@ class PagedEngine:
                     self._free_locked(stream.pages)
                     stream.pages = []
                 stream.error = exc
+                self._release_adapter_locked(stream)
                 if stream.token_queue is not None:
                     stream.token_queue.put(None)  # unblock the consumer
                 stream.event.set()
@@ -3747,6 +4315,18 @@ class PagedEngine:
             tables = jnp.asarray(self._block_tables[:, :pages_h])
             lengths = jnp.asarray(self._lengths)
             emitted0 = jnp.zeros((self.max_slots,), jnp.int32)
+            # multi-LoRA (r16): the wave's per-lane adapter slot ids —
+            # a TRACED argument, so any mix of adapters runs this same
+            # compiled program (idle lanes gather harmlessly)
+            adapter_wave = (
+                self._adapter_slots.copy() if self._lora is not None else None
+            )
+            if self._lora is not None:
+                live_slots = {
+                    int(adapter_wave[s.slot]) for s in runnable_now
+                }
+                if len(live_slots) > 1 and any(live_slots):
+                    self._counters["multi_adapter_chunks"] += 1
 
         import time as _time
 
@@ -3788,13 +4368,18 @@ class PagedEngine:
             return self._contain_chunk_fault(runnable_now, exc)
         self._profile_before_chunk()
         t_chunk = _time.perf_counter()
-        toks, self.pages_k, self.pages_v, self._logits, lengths_out, self._keys, _, emitted = (
-            self._get_chunk(steps, buckets)(
-                self.params, self.pages_k, self.pages_v, self._logits,
-                lengths, tables, self._keys, jnp.asarray(done_in),
-                emitted0, jnp.asarray(max_new), jnp.asarray(temps),
-                jnp.asarray(top_ks), jnp.asarray(eos_ids), jnp.asarray(perm),
+        chunk_args = (
+            self.params, self.pages_k, self.pages_v, self._logits,
+            lengths, tables, self._keys, jnp.asarray(done_in),
+            emitted0, jnp.asarray(max_new), jnp.asarray(temps),
+            jnp.asarray(top_ks), jnp.asarray(eos_ids), jnp.asarray(perm),
+        )
+        if self._lora is not None:
+            chunk_args = chunk_args + (
+                self._lora.device_args(), jnp.asarray(adapter_wave),
             )
+        toks, self.pages_k, self.pages_v, self._logits, lengths_out, self._keys, _, emitted = (
+            self._get_chunk(steps, buckets)(*chunk_args)
         )
         toks_np = np.asarray(toks)
         emitted_np = np.asarray(emitted)
@@ -4041,6 +4626,13 @@ class PagedEngine:
             pages_h = self._pages_horizon(runnable, self.draft_k + 1)
             tables = jnp.asarray(self._block_tables[:, :pages_h])
             lengths = jnp.asarray(self._lengths)
+            adapter_wave = (
+                self._adapter_slots.copy() if self._lora is not None else None
+            )
+            if self._lora is not None:
+                live_slots = {int(adapter_wave[s.slot]) for s in runnable}
+                if len(live_slots) > 1 and any(live_slots):
+                    self._counters["multi_adapter_chunks"] += 1
 
         if not runnable:
             # nothing to verify this wave; prefill slices (or the
@@ -4054,9 +4646,16 @@ class PagedEngine:
             return self._contain_chunk_fault(runnable, exc)
         self._profile_before_chunk()
         t_chunk = _time.perf_counter()
-        out, counts, self.pages_k, self.pages_v, lengths_out = self._spec_chunk(
+        spec_args = (
             self.params, self.pages_k, self.pages_v, jnp.asarray(segs),
             jnp.asarray(n_drafts), jnp.asarray(active_mask), tables, lengths,
+        )
+        if self._lora is not None:
+            spec_args = spec_args + (
+                self._lora.device_args(), jnp.asarray(adapter_wave),
+            )
+        out, counts, self.pages_k, self.pages_v, lengths_out = self._spec_chunk(
+            *spec_args
         )
         out_np = np.asarray(out)
         counts_np = np.asarray(counts)
@@ -4175,6 +4774,9 @@ class StreamingLM(TPUComponent):
         prefix_cache: Optional[bool] = None,
         max_queue: int = 0,
         chunk_token_budget: int = 0,
+        max_adapters: int = 0,
+        lora_rank: int = 8,
+        adapters: Any = None,
         **kwargs: Any,
     ):
         super().__init__(**kwargs)
@@ -4208,6 +4810,21 @@ class StreamingLM(TPUComponent):
             # SELDON_TPU_CHUNK_TOKEN_BUDGET; 0 = monolithic prefill)
             chunk_token_budget=int(chunk_token_budget),
         )
+        # multi-LoRA (r16): adapter pool slots (0 defers to
+        # SELDON_TPU_MAX_ADAPTERS; 0 = adapters off) + the factor rank
+        # every registered adapter must share (one pool shape), and the
+        # deployment's named adapter catalogue — dict name -> spec
+        # ({"seed": n} deterministic synthetic factors, {"uri": ...} a
+        # msgpack checkpoint) registered into the process weight
+        # registry at load (loaders: nothing materialises until a
+        # request selects it).  Deployment parameters arrive as JSON.
+        self.max_adapters = int(max_adapters)
+        self.lora_rank = int(lora_rank)
+        if isinstance(adapters, str):
+            import json as _json
+
+            adapters = _json.loads(adapters) if adapters else None
+        self.adapters = dict(adapters) if adapters else {}
         self.mesh_axes = dict(mesh_axes) if mesh_axes else None
         # tensor-parallel serving degree (r11): `tp=N` (or SELDON_TPU_TP
         # when 0) is the deployment-facing spelling of mesh_axes=
@@ -4251,11 +4868,18 @@ class StreamingLM(TPUComponent):
             from seldon_core_tpu.parallel.mesh import mesh_from_axes
 
             mesh = mesh_from_axes(self.mesh_axes)
+            # multi-LoRA: the deployment's adapter catalogue registers
+            # into the process weight registry (loaders only — cold
+            # adapters materialise on first selection, budget-priced),
+            # and the engine resolves names through it at submit
+            registry = self._register_adapters()
             # tp passed THROUGH so the engine resolves the knob exactly
             # once: an explicit tp=1 here must force single-chip even
             # with SELDON_TPU_TP exported (mesh_axes still wins)
             engine = PagedEngine(
                 params, dtype=jnp.bfloat16, mesh=mesh, tp=self.tp or None,
+                max_adapters=self.max_adapters, lora_rank=self.lora_rank,
+                weight_registry=registry,
                 **self.config, **self.engine_config,
             )
             # canonical seldon_tpu_engine_* metrics on the process
@@ -4414,6 +5038,81 @@ class StreamingLM(TPUComponent):
                 logger.exception("drain journal write failed (%s)", path)
         return entries
 
+    def _register_adapters(self):
+        """Register the deployment's adapter catalogue in the process
+        weight registry (called from load(), before the engine exists).
+        Returns the registry the engine resolves names through, or
+        None when multi-LoRA is off entirely."""
+        if not (self.adapters or self.max_adapters):
+            return None
+        from seldon_core_tpu.models.registry import get_registry
+        from seldon_core_tpu.ops.lora import target_dims
+
+        registry = get_registry()
+        dims = target_dims(self.config["d_model"])
+        hint = 4 * self.config["num_layers"] * sum(
+            (d_in + d_out) * self.lora_rank for d_in, d_out in dims.values()
+        )
+        for name, spec in self.adapters.items():
+            registry.register(
+                name, self._adapter_loader(name, spec), bytes_hint=hint,
+            )
+        return registry
+
+    def _adapter_loader(self, name: str, spec: Any):
+        """One adapter's loader closure: ``{"seed": n}`` builds
+        deterministic synthetic factors (bench/tests — deterministic so
+        drain-replay and disaggregated workers re-derive identical
+        weights), ``{"uri": ...}`` overlays a flax msgpack checkpoint
+        on the factor template, and a raw ``{target: (A, B)}`` dict
+        passes through (in-process composition)."""
+        cfg = dict(self.config)
+        rank = self.lora_rank
+
+        def loader():
+            from seldon_core_tpu.ops.lora import (
+                LORA_TARGETS,
+                make_lora_params,
+            )
+
+            if isinstance(spec, dict) and any(
+                t in spec for t in LORA_TARGETS
+            ):
+                return spec
+            if isinstance(spec, dict) and "uri" in spec:
+                from flax import serialization
+
+                from seldon_core_tpu.utils import storage
+
+                template = make_lora_params(
+                    0, num_layers=cfg["num_layers"], d_model=cfg["d_model"],
+                    rank=rank,
+                )
+                with open(storage.download(spec["uri"]), "rb") as f:
+                    return serialization.from_bytes(template, f.read())
+            seed = int(spec.get("seed", 0)) if isinstance(spec, dict) else int(spec)
+            alpha = (
+                float(spec.get("alpha", rank)) if isinstance(spec, dict)
+                else float(rank)
+            )
+            return make_lora_params(
+                seed, num_layers=cfg["num_layers"], d_model=cfg["d_model"],
+                rank=rank, alpha=alpha,
+            )
+
+        return loader
+
+    @staticmethod
+    def _request_adapter(tags) -> Optional[str]:
+        """The per-request adapter selection: ``meta.tags.adapter``
+        (the ``X-Seldon-Adapter`` header lands here at every ingress;
+        an explicit body tag wins).  Empty/None = base model.  Tag and
+        header normalize through ONE rule, so both carriers always
+        resolve one adapter to one table key."""
+        from seldon_core_tpu.utils.deadlines import normalize_adapter
+
+        return normalize_adapter(tags.get("adapter"))
+
     def _request_seed(self, tags, meta) -> int:
         """The per-request sampling seed rule shared by every serving
         front (unary, streaming, disaggregated): explicit ``seed`` tag
@@ -4486,6 +5185,7 @@ class StreamingLM(TPUComponent):
         # tag override > puid > per-process counter (GenerativeLM's rule)
         request_seed = self._request_seed(tags, meta)
         priority, deadline = self._slo_terms(tags)
+        adapter = self._request_adapter(tags)
         X = np.atleast_2d(np.asarray(X, np.int32))
         streams = []
         try:
@@ -4496,7 +5196,7 @@ class StreamingLM(TPUComponent):
                     row, max_new_tokens=max_new, temperature=temperature,
                     top_k=top_k, eos_id=self.eos_id,
                     seed=self.seed ^ (request_seed * 1000003 + i),
-                    priority=priority, deadline=deadline,
+                    priority=priority, deadline=deadline, adapter=adapter,
                 ))
             self._wake.set()
             for stream in streams:
@@ -4547,6 +5247,7 @@ class StreamingLM(TPUComponent):
             seed=self.seed ^ (request_seed * 1000003),
             stream_tokens=True,
             priority=priority, deadline=deadline,
+            adapter=self._request_adapter(tags),
         )
         self._wake.set()
         try:
@@ -4590,6 +5291,8 @@ class StreamingLM(TPUComponent):
              "value": s["prefix_tokens_saved"]},
             {"type": "GAUGE", "key": "paged_tp_degree",
              "value": s["tp_degree"]},
+            {"type": "GAUGE", "key": "paged_adapters_resident",
+             "value": s["adapters_resident"]},
         ] + (
             [
                 {"type": "GAUGE", "key": "speculative_acceptance_rate",

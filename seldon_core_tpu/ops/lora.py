@@ -1,0 +1,266 @@
+"""Batched multi-LoRA: gathered grouped matmul over a slot-granular
+adapter pool (r16).
+
+S-LoRA (arXiv:2311.03285) shows thousands of adapters can share one
+base model's HBM by paging adapter weights through the same unified
+pool discipline that holds KV; Punica (arXiv:2310.18547) shows a wave
+mixing K *different* adapters can decode in ONE batched grouped-matmul
+program instead of per-adapter lanes.  This module is both halves for
+the paged engine:
+
+* **Pool-shaped storage** — every low-rank factor lives in ONE buffer
+  per projection target, ``A: (layers, slots, d_in, r)`` /
+  ``B: (layers, slots, r, d_out)``, where a *slot* is the
+  weight-paging unit (the engine's adapter table refcounts and
+  LRU-reclaims slots exactly like KV pages).  Slot 0 is the ZERO
+  adapter — all-zero factors, so a lane with no adapter computes a
+  delta of exactly 0.0 through the same program (the trash-page idiom
+  applied to weights: no dynamic control flow, no per-mix programs).
+* **Gathered grouped matmul** — :func:`lora_delta` picks each lane's
+  factors by a TRACED per-lane slot index and computes the segment of
+  ``x @ A_i @ B_i`` for every lane in two batched einsums.  K distinct
+  adapters in one wave is the SAME compiled program as one adapter or
+  none: only the index values change.
+* **Tensor parallelism** — factors shard along the existing ``model``
+  axis with the base layer they decorate: a column-parallel base
+  (qkv, mlp_in) keeps A replicated and shards B on its output dim, a
+  row-parallel base (attn_proj, mlp_out) shards A on its input dim
+  and keeps B replicated.  No activation ever reshards, so adapters
+  add ZERO gather/scatter-class collectives; the one cost XLA's
+  partitioner emits is an all-reduce over the rank-r intermediate
+  where a row-parallel input contracts — r/d_model of one base
+  megatron reduce's bytes (audited by ``tools/profile_adapters.py``,
+  pinned by tests/test_lora.py).
+
+Scaling (``alpha / rank``) is folded into B at install time
+(:func:`scale_adapter`), so the serving programs carry no scale term
+and offline merging is plain ``W + A @ B``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+# The decode projections adapters decorate, with their megatron role
+# (the TP sharding rule above keys on it).  Embeds and the unembed head
+# stay base-only — the classic LoRA target set.
+LORA_TARGETS: Tuple[str, ...] = ("qkv", "attn_proj", "mlp_in", "mlp_out")
+_COLUMN_PARALLEL = {"qkv", "mlp_in"}  # base kernel sharded on d_out
+
+
+def target_dims(
+    d_model: int, mlp_ratio: int = 4
+) -> Dict[str, Tuple[int, int]]:
+    """``target -> (d_in, d_out)`` for the paged transformer blocks."""
+    return {
+        "qkv": (d_model, 3 * d_model),
+        "attn_proj": (d_model, d_model),
+        "mlp_in": (d_model, mlp_ratio * d_model),
+        "mlp_out": (mlp_ratio * d_model, d_model),
+    }
+
+
+def lora_delta(x, a, b, idx):
+    """Per-lane low-rank delta: ``(x @ A[idx]) @ B[idx]``.
+
+    ``x``: (B, L, d_in) activations; ``a``: (slots, d_in, r);
+    ``b``: (slots, r, d_out); ``idx``: (B,) int32 per-lane slot ids.
+    Two einsums over gathered factors — the gather is the whole
+    "grouped" part: lanes sharing a slot gather the same rows, lanes
+    with slot 0 gather zeros and contribute an exact 0.0 delta.  The
+    intermediate rank-r activation keeps ``x``'s dtype (the factors
+    cast down to it), so a zero adapter is bitwise ``y + 0.0 == y``.
+    """
+    import jax.numpy as jnp
+
+    ga = a[idx].astype(x.dtype)  # (B, d_in, r)
+    gb = b[idx].astype(x.dtype)  # (B, r, d_out)
+    xa = jnp.einsum("bld,bdr->blr", x, ga)
+    return jnp.einsum("blr,bro->blo", xa, gb)
+
+
+def make_lora_params(
+    seed: int,
+    *,
+    num_layers: int,
+    d_model: int,
+    rank: int = 8,
+    alpha: float = 8.0,
+    mlp_ratio: int = 4,
+    targets: Sequence[str] = LORA_TARGETS,
+) -> Dict[str, Tuple[np.ndarray, np.ndarray]]:
+    """Deterministic synthetic adapter (tests/bench/tools): per target,
+    ``A ~ N(0, 1/d_in)`` and ``B ~ N(0, 1/rank)`` (BOTH non-zero so the
+    adapter visibly changes outputs — classic zero-init B would make
+    every parity assertion vacuous), alpha/rank pre-folded into B."""
+    dims = target_dims(d_model, mlp_ratio)
+    rng = np.random.default_rng(seed)
+    out: Dict[str, Tuple[np.ndarray, np.ndarray]] = {}
+    scale = float(alpha) / float(rank)
+    for t in targets:
+        d_in, d_out = dims[t]
+        a = rng.normal(0.0, 1.0 / np.sqrt(d_in),
+                       (num_layers, d_in, rank)).astype(np.float32)
+        b = rng.normal(0.0, 1.0 / np.sqrt(rank),
+                       (num_layers, rank, d_out)).astype(np.float32) * scale
+        out[t] = (a, b)
+    return out
+
+
+def adapter_bytes(
+    params: Dict[str, Tuple[np.ndarray, np.ndarray]]
+) -> int:
+    """Host bytes of one adapter's factor set — the registry's budget
+    unit."""
+    return int(sum(
+        np.asarray(a).nbytes + np.asarray(b).nbytes
+        for a, b in params.values()
+    ))
+
+
+def merge_lora(
+    params: Any,
+    adapter: Dict[str, Tuple[np.ndarray, np.ndarray]],
+    num_layers: int,
+) -> Any:
+    """Offline-merged weights ``W + A @ B`` per block projection — the
+    reference tree the bit-exactness criterion compares against (an
+    engine serving the merged tree with NO adapter must greedy-match
+    the base engine serving slot-selected factors, f32)."""
+    import jax
+
+    merged = jax.tree.map(lambda x: np.array(x), params)
+    for i in range(num_layers):
+        block = merged[f"block_{i}"]
+        for t, (a, b) in adapter.items():
+            kern = np.asarray(block[t]["kernel"], np.float32)
+            block[t]["kernel"] = (
+                kern + np.asarray(a[i], np.float32) @ np.asarray(b[i], np.float32)
+            ).astype(np.asarray(block[t]["kernel"]).dtype)
+    return merged
+
+
+class LoraPool:
+    """Device-resident slot-granular adapter pool for one engine.
+
+    ``slots = max_adapters + 1`` (slot 0 = the zero adapter, never
+    allocated).  Buffers are plain jax arrays passed INTO the engine
+    programs as trailing arguments — installs swap whole slot rows via
+    ``.at[:, slot].set`` between waves, so shapes (and therefore
+    compiled programs) never change with adapter churn.
+    """
+
+    def __init__(
+        self,
+        *,
+        num_layers: int,
+        d_model: int,
+        max_adapters: int,
+        rank: int = 8,
+        mlp_ratio: int = 4,
+        targets: Sequence[str] = LORA_TARGETS,
+        param_dtype: Any = None,
+    ):
+        import jax.numpy as jnp
+
+        self.num_layers = int(num_layers)
+        self.d_model = int(d_model)
+        self.max_adapters = int(max_adapters)
+        self.slots = self.max_adapters + 1
+        self.rank = int(rank)
+        self.targets = tuple(targets)
+        self._dims = target_dims(d_model, mlp_ratio)
+        dtype = param_dtype or jnp.float32
+        self.buffers: Dict[str, Tuple[Any, Any]] = {}
+        for t in self.targets:
+            d_in, d_out = self._dims[t]
+            self.buffers[t] = (
+                jnp.zeros((self.num_layers, self.slots, d_in, self.rank), dtype),
+                jnp.zeros((self.num_layers, self.slots, self.rank, d_out), dtype),
+            )
+
+    def device_args(self) -> Dict[str, Tuple[Any, Any]]:
+        """The pytree the engine passes as a program argument."""
+        return dict(self.buffers)
+
+    def install(self, slot: int, params: Dict[str, Any]) -> None:
+        """Write one adapter's factors into ``slot`` (1-based; slot 0 is
+        the reserved zero adapter).  Runs BETWEEN waves on the host
+        control path — the update makes new buffer arrays, the next
+        wave reads them, shapes unchanged so nothing recompiles.
+
+        Every target is validated (present, right rank/dims) BEFORE the
+        first write, so a partial or wrong-rank adapter raises a
+        precise ``ValueError`` with the slot untouched — never a
+        half-installed slot or an opaque XLA shape error mid-loop."""
+        if not 1 <= slot < self.slots:
+            raise ValueError(f"adapter slot {slot} out of range 1..{self.slots - 1}")
+        staged = {}
+        for t in self.targets:
+            d_in, d_out = self._dims[t]
+            pair = params.get(t)
+            if pair is None:
+                raise ValueError(
+                    f"adapter is missing factors for target {t!r} "
+                    f"(pool targets: {', '.join(self.targets)})"
+                )
+            a = np.asarray(pair[0], np.float32)
+            b = np.asarray(pair[1], np.float32)
+            want_a = (self.num_layers, d_in, self.rank)
+            want_b = (self.num_layers, self.rank, d_out)
+            if a.shape != want_a or b.shape != want_b:
+                raise ValueError(
+                    f"target {t!r} factors shaped A{a.shape}/B{b.shape} "
+                    f"do not fit the pool's A{want_a}/B{want_b} "
+                    f"(layers, dims, rank={self.rank})"
+                )
+            staged[t] = (a, b)
+        for t, (a, b) in staged.items():
+            a_buf, b_buf = self.buffers[t]
+            self.buffers[t] = (
+                a_buf.at[:, slot].set(a),
+                b_buf.at[:, slot].set(b),
+            )
+
+    def shardings(self, mesh, model_axis: str = "model"):
+        """NamedShardings matching :meth:`device_args` under a TP mesh:
+        column-parallel targets shard B's output dim (A replicated),
+        row-parallel targets shard A's input dim (B replicated) — the
+        delta then needs no collective beyond the base layer's own
+        all-reduce (partial deltas sum inside it)."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        axis = dict(zip(mesh.axis_names, mesh.devices.shape)).get(model_axis, 1)
+        out: Dict[str, Tuple[Any, Any]] = {}
+        rep = NamedSharding(mesh, P())
+        for t in self.targets:
+            d_in, d_out = self._dims[t]
+            if axis <= 1:
+                out[t] = (rep, rep)
+            elif t in _COLUMN_PARALLEL and d_out % axis == 0:
+                out[t] = (rep, NamedSharding(mesh, P(None, None, None, model_axis)))
+            elif t not in _COLUMN_PARALLEL and d_in % axis == 0:
+                out[t] = (NamedSharding(mesh, P(None, None, model_axis, None)), rep)
+            else:  # indivisible dims degrade to replicated, like the pool
+                out[t] = (rep, rep)
+        return out
+
+    def hbm_bytes(self, tp_degree: int = 1) -> int:
+        """Bytes ONE device holds for the pool (the capacity-planning
+        term ``paged_hbm_accounting`` prices as ``adapter_bytes``):
+        under TP each target's sharded factor divides by the degree,
+        its replicated partner stays full — mirrors :meth:`shardings`."""
+        shard = max(1, int(tp_degree))
+        total = 0
+        for t in self.targets:
+            a_buf, b_buf = self.buffers[t]
+            d_in, d_out = self._dims[t]
+            a_n, b_n = int(a_buf.nbytes), int(b_buf.nbytes)
+            if shard > 1 and t in _COLUMN_PARALLEL and d_out % shard == 0:
+                b_n //= shard
+            elif shard > 1 and t not in _COLUMN_PARALLEL and d_in % shard == 0:
+                a_n //= shard
+            total += a_n + b_n
+        return total

@@ -83,6 +83,13 @@ def _wrap_unary(user_model: Any, fn, unit_id: str = ""):
                     out = await run_dispatch(fn, user_model, msgs)
                 else:
                     msg = InternalMessage.from_proto(request)
+                    # x-seldon-adapter metadata selects the LoRA weight
+                    # set (r16), REST-lane parity: body tag wins
+                    adapter = _deadlines.extract_adapter(
+                        context.invocation_metadata() or ()
+                    )
+                    if adapter and "adapter" not in msg.meta.tags:
+                        msg.meta.tags["adapter"] = adapter
                     if fn is dispatch.predict:  # async fast path for batched models
                         out = await dispatch.predict_async(user_model, msg)
                     else:

@@ -193,6 +193,14 @@ ENV_KNOBS: Dict[str, Knob] = _knobs(
          "prefill+decode cost and fast-fails deadlines it cannot meet "
          "(0 = admit everything, price nothing)",
          "architecture.md §5b-quater"),
+    Knob("SELDON_TPU_MAX_ADAPTERS", "int", "0", True,
+         "multi-LoRA serving: adapter slots in the engine's factor "
+         "pool (0 = adapters off, byte-identical pre-adapter programs)",
+         "architecture.md §5b-quinquies"),
+    Knob("SELDON_TPU_WEIGHT_BUDGET_GIB", "float", "0", True,
+         "HBM budget for the process weight registry's named weight "
+         "sets (base models + LoRA adapters; 0 = unbudgeted loads)",
+         "architecture.md §5b-quinquies"),
     Knob("SELDON_TPU_JIT_SENTINEL", "flag", "1", True,
          "XLA recompile sentinel on engine jit entry points (0 = off)",
          "architecture.md §5c"),
@@ -272,6 +280,10 @@ HEADERS: Dict[str, Header] = {
         Header("X-Seldon-Priority", "int",
                "admission priority class for the generation engine's "
                "shedding/preemption policy"),
+        Header("X-Seldon-Adapter", "str",
+               "named LoRA adapter (weight set) this request decodes "
+               "with; lands in meta.tags.adapter — an explicit tag in "
+               "the body wins"),
     )
 }
 

@@ -186,6 +186,13 @@ def _message_endpoint(user_model: Any, fn: Callable) -> Callable:
         try:
             body = await _request_body(request)
             msg = InternalMessage.from_json(body)
+            # X-Seldon-Adapter selects the LoRA weight set (r16) on the
+            # plain microservice lane too — the component reads
+            # meta.tags.adapter; an explicit body tag wins, same
+            # precedence as the gateway ingress
+            adapter = _deadlines.extract_adapter(request.headers)
+            if adapter and "adapter" not in msg.meta.tags:
+                msg.meta.tags["adapter"] = adapter
             # headers carry the caller's span context; activating it
             # here makes the dispatch span a child of the caller's
             # (run_dispatch copies the context onto the pool thread).

@@ -37,6 +37,10 @@ from typing import Any, Dict, List, Optional, Tuple
 
 DEADLINE_HEADER = "x-seldon-deadline-ms"
 PRIORITY_HEADER = "x-seldon-priority"
+# per-request LoRA adapter selection (r16) — lives next to the other
+# SLO/tag ingress headers because every ingress that extracts a
+# priority extracts this with the same carrier helper
+ADAPTER_HEADER = "x-seldon-adapter"
 
 # ceiling on an accepted budget: a header claiming days is a client bug
 # (or an attack on the queue) — clamp instead of trusting it
@@ -135,6 +139,25 @@ def extract_priority(carrier: Any) -> Optional[int]:
         return clamp_priority(int(float(raw)))
     except (TypeError, ValueError):
         return None
+
+
+def normalize_adapter(raw: Any) -> Optional[str]:
+    """ONE normalization rule for adapter names from any carrier
+    (header, gRPC metadata, body tag): strip, empty -> None, clamp to
+    256 chars — the name keys registry and engine tables, and an
+    unauthenticated wire must not grow them with megabyte keys.  Header
+    and tag extraction both delegate here, so the two carriers can
+    never normalize the same adapter to different table keys."""
+    if raw is None:
+        return None
+    raw = str(raw).strip()
+    return raw[:256] if raw else None
+
+
+def extract_adapter(carrier: Any) -> Optional[str]:
+    """The adapter name declared by a carrier (``X-Seldon-Adapter``
+    header / gRPC metadata), or None."""
+    return normalize_adapter(_carrier_get(carrier, ADAPTER_HEADER))
 
 
 @contextmanager

@@ -295,6 +295,29 @@ ENGINE_STATS_METRICS: Dict[str, Tuple[str, str, str]] = {
     "kv_imports": ("counter", "seldon_tpu_engine_kv_imports_total",
                    "KV-page payloads scatter-written into this pool "
                    "(decode-worker role)"),
+    # multi-LoRA weight multiplexing (r16): adapter pool-slot churn +
+    # submit-time residency — the AdapterThrash alert reads the
+    # eviction/hit-rate pair exactly like PrefixCacheThrash reads the
+    # prefix pair
+    "adapter_loads": ("counter", "seldon_tpu_engine_adapter_loads_total",
+                      "adapters installed into the engine's factor pool "
+                      "(cold loads + explicit warm-ups)"),
+    "adapter_evictions": ("counter",
+                          "seldon_tpu_engine_adapter_evictions_total",
+                          "refcount-0 adapters LRU-reclaimed from the "
+                          "factor pool to make room for a cold load"),
+    "adapter_hits": ("counter", "seldon_tpu_engine_adapter_hits_total",
+                     "adapter-carrying submits that found their adapter "
+                     "resident in the pool"),
+    "adapter_misses": ("counter", "seldon_tpu_engine_adapter_misses_total",
+                       "adapter-carrying submits that had to cold-load "
+                       "through the weight registry"),
+    "multi_adapter_chunks": ("counter",
+                             "seldon_tpu_engine_multi_adapter_chunks_total",
+                             "engine waves whose runnable lanes mixed >= 2 "
+                             "distinct adapter slots — served by ONE "
+                             "grouped-matmul program, never per-adapter "
+                             "lanes"),
     # self-healing lifecycle (r12): drain/handoff observability — a
     # drained engine journals its live streams for a respawned engine
     # to replay through the ordinary submit path
@@ -344,6 +367,12 @@ ENGINE_STATS_METRICS: Dict[str, Tuple[str, str, str]] = {
     "chunk_token_budget": ("gauge", "seldon_tpu_engine_chunk_token_budget",
                            "token budget one engine wave may carry "
                            "(0 = monolithic prefill)"),
+    "adapters_resident": ("gauge", "seldon_tpu_engine_adapters_resident",
+                          "adapters resident in the factor pool "
+                          "(pinned + LRU-cached slots)"),
+    "adapter_slots": ("gauge", "seldon_tpu_engine_adapter_slots",
+                      "adapter slots the factor pool was built with "
+                      "(0 = multi-LoRA off)"),
 }
 
 # keys intentionally NOT exported as their own series: the wall-clock
@@ -352,8 +381,14 @@ ENGINE_STATS_METRICS: Dict[str, Tuple[str, str, str]] = {
 # double-count the same signal under a non-canonical name;
 # jit_compiles is exported by utils/jitwatch.py itself as
 # seldon_tpu_jit_compiles_total{program=...} (per-program labels the
-# summed stat can't carry)
-ENGINE_STATS_EXCLUDED = {"chunk_wall_s", "prefill_wall_s", "jit_compiles"}
+# summed stat can't carry); adapter_requests is a name->count dict the
+# bridge exports itself as
+# seldon_tpu_engine_adapter_requests_total{adapter=...} (per-adapter
+# labels the flat mapping can't carry)
+ENGINE_STATS_EXCLUDED = {"chunk_wall_s", "prefill_wall_s", "jit_compiles",
+                         "adapter_requests"}
+
+ADAPTER_REQUESTS_METRIC = "seldon_tpu_engine_adapter_requests_total"
 
 CHUNK_DURATION_METRIC = "seldon_tpu_engine_chunk_duration_seconds"
 
@@ -404,6 +439,22 @@ class GenerationPrometheusBridge:
 
     def _collect(self) -> None:
         stats = self.engine.engine_stats()
+        # per-adapter request rate (r16): labeled export the flat
+        # mapping can't carry — same counter-delta discipline, one
+        # child per adapter name
+        for adapter, count in (stats.get("adapter_requests") or {}).items():
+            key = f"adapter_requests:{adapter}"
+            prev = self._last.get(key, 0.0)
+            cur = float(count)
+            delta = cur - prev if cur >= prev else cur
+            self._last[key] = cur
+            if delta > 0:
+                labels = dict(self._labels, adapter=adapter)
+                self._cache.get(
+                    "counter", ADAPTER_REQUESTS_METRIC,
+                    tuple(sorted(labels)),
+                    "adapter-carrying requests submitted, by adapter name",
+                ).labels(**labels).inc(delta)
         for key, value in stats.items():
             spec = ENGINE_STATS_METRICS.get(key)
             if spec is None:

@@ -153,6 +153,35 @@ def _r3_like_full_result():
                 "paged_tp_tokens_per_s": 8100.0,
                 "paged_tp_degree": 4,
                 "paged_tp_eff_pct": 46.0,
+                "multi_lora_tokens_per_s": 4100.0,
+                "multi_lora_resident_tokens_per_s": 4350.0,
+                "resident_tok_s_delta_pct": 1.14,
+                "multi_lora": {
+                    "adapters_registered": 6,
+                    "pool_slots": 4,
+                    "rank": 8,
+                    "mixed_wave_stats": {
+                        "chunks": 4, "multi_adapter_chunks": 4,
+                        "adapter_loads": 0, "adapter_evictions": 0,
+                    },
+                    "one_program": True,
+                    "churn_round_stats": {
+                        "chunks": 4, "multi_adapter_chunks": 0,
+                        "adapter_loads": 2, "adapter_evictions": 2,
+                    },
+                    "adapter_loads": 14,
+                    "adapter_evictions": 10,
+                    "adapter_hit_rate": 0.75,
+                    "registry": {
+                        "loads": 9, "evictions": 3, "hits": 5, "misses": 9,
+                        "budget_bytes": 167772160,
+                        "reclaimable_weight_bytes": 100663296,
+                    },
+                    "mix": "16 streams x 384 new tokens, K=4 distinct "
+                           "adapters cycling; churn arm loads 2 cold "
+                           "adapters per round through a 4-slot pool + "
+                           "5-set registry budget",
+                },
                 "goodput_pct": 97.2,
                 "shed_pct": 33.3,
                 "interactive_p99_ms": 240.5,
@@ -480,6 +509,45 @@ def test_compact_line_carries_tp_story(bench):
     assert isinstance(e["paged_tp_eff_pct"], float)
     assert e["paged_tp_eff_pct"] == 46.0
     assert "paged_tp_degree" not in e
+
+
+def test_compact_line_carries_multi_lora_story(bench):
+    """r16 certification keys: the K=4 mixed-adapter serving rate and
+    the N-model churn gate (resident-rate delta vs paged_tok_s);
+    adapter/registry churn details stay in bench_full.json
+    (`multi_lora`)."""
+    compact = bench._compact_result(_r3_like_full_result())
+    e = compact["extra"]
+    assert isinstance(e["multi_lora_tok_s"], float)
+    assert e["multi_lora_tok_s"] == 4100.0
+    assert isinstance(e["resident_tok_s_delta_pct"], float)
+    assert e["resident_tok_s_delta_pct"] == 1.14
+    assert "multi_lora" not in e
+    assert "multi_lora_resident_tokens_per_s" not in e
+
+
+def test_adapter_capacity_accounting_reserved_off_the_top():
+    """The factor pool's bytes reserve off the capacity budget BEFORE
+    the per-stream division, and reclaimable registry weights report
+    next to reclaimable pages, never in peak."""
+    from seldon_core_tpu.models.paged import (
+        paged_capacity_streams,
+        paged_hbm_accounting,
+    )
+
+    kw = dict(ctx_len=512, d_model=512, num_layers=8)
+    one = paged_hbm_accounting(streams=1, **kw)
+    with_pool = paged_hbm_accounting(
+        streams=1, adapter_bytes=123456, reclaimable_weight_bytes=777, **kw
+    )
+    assert with_pool["peak_bytes"] == one["peak_bytes"] + 123456
+    assert with_pool["reclaimable_bytes"] == one["reclaimable_bytes"] + 777
+    budget = 2 << 30
+    base = paged_capacity_streams(budget, 512, d_model=512, num_layers=8)
+    halved = paged_capacity_streams(
+        budget, 512, d_model=512, num_layers=8, adapter_bytes=budget // 2
+    )
+    assert halved <= (base + 1) // 2
 
 
 def test_compact_line_tp_na_on_single_chip(bench):
