@@ -1,6 +1,6 @@
 PROTOC ?= protoc
 
-.PHONY: proto test native bench lint clean
+.PHONY: proto test native bench lint chaos clean
 
 proto:
 	$(PROTOC) -Iseldon_core_tpu/proto --python_out=seldon_core_tpu/proto \
@@ -33,6 +33,13 @@ bench:
 # lint_violations on the bench compact line.
 lint:
 	python -m tools.graftlint
+
+# resilience suite: fault injection, self-healing transport, DCN chaos,
+# live migration/failover, watchdog/quarantine (fast tier only)
+chaos:
+	python -m pytest tests/test_faults.py tests/test_selfheal.py \
+		tests/test_chaos_dcn.py tests/test_migration.py \
+		tests/test_watchdog.py -q -m 'not slow'
 
 clean:
 	find . -name __pycache__ -type d -exec rm -rf {} + 2>/dev/null || true

@@ -233,6 +233,14 @@ COMPACT_PICKS = [
     ("chaos_goodput_pct", ("chaos", "chaos_goodput_pct")),
     ("breaker_fastfail_pct", ("chaos", "breaker_fastfail_pct")),
     ("hedge_win_pct", ("chaos", "hedge_win_pct")),
+    # r17 live-migration certification: streams mid-decode on engine A
+    # are SIGTERM-evacuated to engine B (KV pages + cursors + RNG
+    # state). migrate_ttr_ms = time from evacuation start to the first
+    # token resumed on the peer; migrate_token_loss MUST print 0 (the
+    # streaming consumer's queue sees an exact continuation);
+    # journal-replay TTR contrast in bench_full.json chaos.migration
+    ("migrate_ttr_ms", ("chaos", "migrate_ttr_ms")),
+    ("migrate_token_loss", ("chaos", "migrate_token_loss")),
     # r13 static-invariant certification: unsuppressed tools/graftlint
     # violations over the whole tree (jit purity, knob registry, lock
     # discipline, metrics contract, propagation, exception hygiene).
@@ -1534,6 +1542,17 @@ async def child_main() -> None:
             status["extra"]["chaos"] = await chaos_phase()
         except Exception as e:  # noqa: BLE001
             status["extra"]["chaos_error"] = str(e)[:200]
+        # r17 migration arm: in-process SIGTERM-with-evacuation — rides
+        # the chaos blob (and its compact keys) but fails independently
+        try:
+            mig = migration_arm()
+            status["extra"].setdefault("chaos", {}).update({
+                "migrate_ttr_ms": mig["migrate_ttr_ms"],
+                "migrate_token_loss": mig["migrate_token_loss"],
+                "migration": mig,
+            })
+        except Exception as e:  # noqa: BLE001
+            status["extra"]["chaos_migrate_error"] = str(e)[:200]
         _checkpoint(status)
 
     if os.environ.get("BENCH_LINT", "1") == "1":
@@ -1849,6 +1868,146 @@ async def chaos_phase() -> dict:
                 pass
         await asyncio.to_thread(sup.stop_all)
         CircuitBreaker.reset_all()
+
+
+def migration_arm() -> dict:
+    """Live-migration certification (r17): mid-decode SIGTERM-with-
+    evacuation must lose ZERO tokens and beat journal-replay TTR.
+
+    Two small in-process f32 PagedEngines (CPU probe — the arm prices
+    the migration machinery, not decode).  8 streaming requests decode
+    a few chunks on engine A; A is then "SIGTERM'd" (the drain path's
+    evacuation step, run exactly as the signal handler would) and its
+    streams live-migrate to engine B with waiter adoption.  Each
+    consumer's token queue must see an EXACT continuation:
+
+    * ``migrate_ttr_ms`` — wall time from evacuation start to the
+      first token resumed on the peer (the failover blackout);
+    * ``migrate_token_loss`` — expected minus received tokens summed
+      over all streams, compared against an uninterrupted control run
+      (MUST print 0 — tokens must also be bit-identical, asserted);
+    * ``replay_ttr_ms`` (full blob) — the same scenario recovered via
+      the r12 drain-journal replay on a fresh engine, i.e. what the
+      blackout costs when every stream re-derives from scratch.
+    """
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    from seldon_core_tpu.models.paged import PagedEngine
+    from seldon_core_tpu.models.transformer import TransformerLM
+
+    cfg = dict(vocab_size=512, d_model=64, num_layers=2, num_heads=4,
+               max_len=256)
+    lm = TransformerLM(dtype=jnp.float32, **cfg)
+    params = lm.init(jax.random.key(0), jnp.zeros((1, 8), jnp.int32))["params"]
+
+    def engine():
+        return PagedEngine(
+            params, dtype=jnp.float32, page_size=16, max_slots=8,
+            steps_per_call=4, **cfg,
+        )
+
+    n_streams = 4 if QUICK else 8
+    max_new = 24
+    rng = np.random.default_rng(17)
+    prompts = [
+        rng.integers(0, cfg["vocab_size"], size=(48,)).astype(np.int32)
+        for _ in range(n_streams)
+    ]
+
+    # control: uninterrupted run (also warms every compiled program
+    # shape, so the timed arms never pay a compile)
+    ref = engine()
+    expected = [
+        ref.generate(p, max_new_tokens=max_new, seed=i)
+        for i, p in enumerate(prompts)
+    ]
+    ref.close()
+
+    def start_streams(eng):
+        streams = [
+            eng.submit(p, max_new_tokens=max_new, seed=i, stream_tokens=True)
+            for i, p in enumerate(prompts)
+        ]
+        for _ in range(3):  # prefill + a few decode chunks, then "SIGTERM"
+            eng.step()
+        return streams
+
+    def drain_queues(streams):
+        got = [[] for _ in streams]
+        for i, s in enumerate(streams):
+            while s.token_queue.qsize():
+                item = s.token_queue.get()
+                if item:
+                    got[i].extend(item)
+        return got
+
+    # ---- migration arm ----------------------------------------------------
+    a, b = engine(), engine()
+    streams = start_streams(a)
+    got = drain_queues(streams)
+    t0 = time.perf_counter()
+    exported = a.migrate_export()
+    for payload, stream in exported:
+        b.migrate_import(payload, stream=stream)
+    ttr = None
+    while b.has_work():
+        b.step()
+        if ttr is None and any(
+            s.token_queue.qsize() for s in streams
+        ):
+            ttr = (time.perf_counter() - t0) * 1000.0
+    for i, new in enumerate(drain_queues(streams)):
+        got[i].extend(new)
+    loss = 0
+    for i, s in enumerate(streams):
+        assert s.error is None, f"stream {i} errored: {s.error}"
+        # bit-identical continuation, not just counted: a migration that
+        # resumed on the wrong token would still "lose zero tokens"
+        np.testing.assert_array_equal(
+            np.asarray(got[i], np.int32), expected[i],
+        )
+        loss += max(0, len(expected[i]) - len(got[i]))
+    a.close()
+    b.close()
+
+    # ---- journal-replay contrast ------------------------------------------
+    c, d = engine(), engine()
+    streams_c = start_streams(c)
+    t1 = time.perf_counter()
+    entries = c.drain()
+    replayed = d.replay(entries, stream_tokens=True)
+    replay_ttr = None
+    while d.has_work():
+        d.step()
+        if replay_ttr is None and any(
+            s.token_queue.qsize() for s in replayed
+        ):
+            replay_ttr = (time.perf_counter() - t1) * 1000.0
+    for i, s in enumerate(replayed):
+        np.testing.assert_array_equal(s.result, expected[i])
+    c.close()
+    d.close()
+    del streams_c
+
+    return {
+        "migrate_ttr_ms": round(ttr or 0.0, 2),
+        "migrate_token_loss": int(loss),
+        "replay_ttr_ms": round(replay_ttr or 0.0, 2),
+        "migrated": len(exported),
+        "replayed": len(replayed),
+        "streams": n_streams,
+        "max_new_tokens": max_new,
+        "mix": (
+            f"{n_streams} streaming requests, 48-token prompts, "
+            f"{max_new} new tokens; evacuated after 3 waves on engine A, "
+            "resumed on engine B with waiter adoption (f32 CPU probe; "
+            "bit-identical continuation asserted); journal arm re-derives "
+            "the same streams via drain()+replay()"
+        ),
+    }
 
 
 def generation_phase() -> dict:

@@ -237,9 +237,37 @@ int64_t srt1_payload_bytes(const uint8_t* frame, int64_t len) {
   return (int64_t)(n * (uint64_t)item);
 }
 
+// CRC32C (Castagnoli, reflected poly 0x82F63B78) — the KV-container
+// integrity trailer's checksum.  Must agree byte-for-byte with
+// codec/bufview.py crc32c (pinned by the C-ABI agreement test); the
+// python lane calls THIS when the library is loaded, so the table
+// below is the hot implementation for MB-scale containers.
+static uint32_t kCrc32cTable[256];
+static bool crc32c_table_init() {
+  for (uint32_t i = 0; i < 256; i++) {
+    uint32_t crc = i;
+    for (int j = 0; j < 8; j++)
+      crc = (crc & 1) ? (crc >> 1) ^ 0x82F63B78u : crc >> 1;
+    kCrc32cTable[i] = crc;
+  }
+  return true;
+}
+static const bool kCrc32cInit = crc32c_table_init();
+
+// trailer magic "SRTC" little-endian — codec/bufview.py SRT1_CRC_MAGIC
+uint32_t srt1_crc_magic() { return 0x43545253u; }
+
+uint32_t srt1_crc32c(const uint8_t* data, int64_t len, uint32_t crc) {
+  crc ^= 0xFFFFFFFFu;
+  for (int64_t i = 0; i < len; i++)
+    crc = kCrc32cTable[(crc ^ data[i]) & 0xFF] ^ (crc >> 8);
+  return crc ^ 0xFFFFFFFFu;
+}
+
 // v2: FsConfig gained bind_host (frontserver.cc); a stale .so built
 // before that field would silently ignore the requested bind address.
 // v3: srt1_* framing-agreement surface (zero-copy buffer-view lane).
-int32_t native_abi_version() { return 3; }
+// v4: srt1_crc_magic/srt1_crc32c (KV-container integrity trailer).
+int32_t native_abi_version() { return 4; }
 
 }  // extern "C"

@@ -43,6 +43,7 @@ from seldon_core_tpu.codec.tensor import PayloadError, ensure_little_endian, np_
 
 __all__ = [
     "SRT1_MAGIC",
+    "SRT1_CRC_MAGIC",
     "SRT1_DTYPES",
     "BufferView",
     "zero_copy_enabled",
@@ -52,12 +53,19 @@ __all__ = [
     "unpack_frames",
     "frame_header",
     "is_frame",
+    "crc32c",
+    "kv_checksum_enabled",
     "pack_kv_handoff",
     "unpack_kv_handoff",
+    "pack_kv_migration",
+    "unpack_kv_migration",
 ]
 
 SRT1_MAGIC = 0x31545253  # "SRT1" little-endian
 _MAGIC_BYTES = b"SRT1"
+# integrity-trailer magic: "SRTC" little-endian.  The C-ABI mirror is
+# srt1_crc_magic() in native/codec.cc — the agreement test pins both.
+SRT1_CRC_MAGIC = 0x43545253
 
 # dtype code -> canonical dtype name.  Codes 0-3 are the legacy table
 # native/frontserver.cc parse_raw_frame understands (its fast lane
@@ -402,6 +410,138 @@ def unpack_frames(data: Union[bytes, memoryview]) -> list:
 
 
 # ---------------------------------------------------------------------------
+# CRC32C integrity trailer (r17)
+# ---------------------------------------------------------------------------
+
+# Castagnoli CRC32 (iSCSI polynomial 0x1EDC6F41, reflected 0x82F63B78) —
+# the checksum KV containers ride DCN under.  zlib.crc32 is the OTHER
+# polynomial; a table-driven implementation keeps the trailer dependency
+# -free, and the C-ABI twin (srt1_crc32c in native/codec.cc) must agree
+# byte-for-byte (pinned by the agreement test).
+_CRC32C_POLY = 0x82F63B78
+
+
+def _crc32c_table() -> Tuple[int, ...]:
+    table = []
+    for i in range(256):
+        crc = i
+        for _ in range(8):
+            crc = (crc >> 1) ^ _CRC32C_POLY if crc & 1 else crc >> 1
+        table.append(crc)
+    return tuple(table)
+
+
+_CRC32C_TABLE = _crc32c_table()
+
+
+def _crc32c_py(data: bytes, crc: int = 0) -> int:
+    crc ^= 0xFFFFFFFF
+    table = _CRC32C_TABLE
+    for b in data:
+        crc = table[(crc ^ b) & 0xFF] ^ (crc >> 8)
+    return crc ^ 0xFFFFFFFF
+
+
+# native srt1_crc32c resolved ONCE on first use (None = unresolved,
+# False = unavailable): the checksum runs twice per KV container on the
+# evacuation hot path, so neither the import probe nor a buffer copy
+# belongs in the per-call cost
+_CRC_NATIVE: Any = None
+
+
+def crc32c(data: Union[bytes, bytearray, memoryview], crc: int = 0) -> int:
+    """CRC32C (Castagnoli) of ``data``; chainable via ``crc``.  Uses the
+    native core's ``srt1_crc32c`` when loaded (KV containers run to MBs
+    and the python table loop prices ~5 MB/s) — both implementations are
+    pinned equal by the C-ABI agreement test.  ``bytes`` input passes to
+    the C call by pointer, copy-free."""
+    global _CRC_NATIVE
+    if not isinstance(data, bytes):
+        data = bytes(data)
+    if _CRC_NATIVE is None:
+        try:
+            from seldon_core_tpu.native import get_lib
+
+            lib = get_lib()
+            _CRC_NATIVE = (
+                lib.srt1_crc32c
+                if lib is not None and hasattr(lib, "srt1_crc32c")
+                else False
+            )
+        except Exception:  # noqa: BLE001 — checksum must work without the
+            # native core; the python table is the same polynomial
+            _CRC_NATIVE = False
+    if _CRC_NATIVE:
+        return int(_CRC_NATIVE(data, len(data), crc)) & 0xFFFFFFFF
+    return _crc32c_py(data, crc)
+
+
+def kv_checksum_enabled() -> bool:
+    """SELDON_TPU_KV_CHECKSUM=0 turns the KV-container CRC32C trailer
+    off (default on: a flipped payload byte over DCN must reject as a
+    named PayloadError, never decode as garbage KV)."""
+    from seldon_core_tpu.runtime import knobs
+
+    return knobs.flag("SELDON_TPU_KV_CHECKSUM")
+
+
+def _append_crc_trailer(body: bytes) -> bytes:
+    """Pad ``body`` to 8 bytes and append the ``SRTC | crc32c`` trailer
+    (8 bytes, so the container stays 8-aligned end to end)."""
+    pad = -len(body) % 8
+    if pad:
+        body = body + b"\x00" * pad
+    return body + struct.pack("<II", SRT1_CRC_MAGIC, crc32c(body))
+
+
+def _frames_end(mv: memoryview) -> int:
+    """Byte offset where the container's frame run ends (walking the
+    SAME header structure unpack_frames follows), i.e. where a trailer
+    would start.  Payload bytes can never be mistaken for a trailer:
+    the walk is structural, not a byte scan."""
+    offset = 0
+    while offset < len(mv):
+        if len(mv) - offset < 8 or bytes(mv[offset:offset + 4]) != _MAGIC_BYTES:
+            break
+        _dt, _shape, payload_off, need = _parse_header(mv, offset)
+        if payload_off + need > len(mv):
+            break
+        offset = payload_off + need
+        pad = -offset % 8
+        if bytes(mv[offset:offset + pad]).strip(b"\x00"):
+            break  # non-zero pad: let unpack_frames raise its error
+        offset += min(pad, len(mv) - offset)
+    return offset
+
+
+def _split_crc_trailer(data) -> Tuple[memoryview, bool]:
+    """Verify-and-strip the CRC32C trailer when present.  Returns the
+    container body (frames only) and whether a trailer was seen.  A
+    mismatching checksum raises :class:`PayloadError` naming the
+    trailer offset and both sums — with the checksum knob OFF the
+    trailer is stripped unverified (mixed-fleet rollouts must not
+    wedge on the knob)."""
+    mv = _byte_view(data)
+    end = _frames_end(mv)
+    if len(mv) - end < 8:
+        return mv, False
+    magic, stored = struct.unpack_from("<II", mv, len(mv) - 8)
+    if magic != SRT1_CRC_MAGIC:
+        return mv, False
+    body = mv[: len(mv) - 8]
+    if kv_checksum_enabled():
+        actual = crc32c(body)
+        if actual != stored:
+            raise PayloadError(
+                f"KV container CRC32C mismatch at trailer offset "
+                f"{len(mv) - 8}: stored 0x{stored:08x}, computed "
+                f"0x{actual:08x} over {len(body)} bytes — payload "
+                "corrupted in transit, refusing to scatter garbage KV"
+            )
+    return body, True
+
+
+# ---------------------------------------------------------------------------
 # KV-page handoff container (disaggregated prefill/decode, r15)
 # ---------------------------------------------------------------------------
 
@@ -438,11 +578,14 @@ def pack_kv_handoff(payload: dict) -> bytes:
             f"(split) page stacks, got {k.dtype}{tuple(k.shape)} vs "
             f"{v.dtype}{tuple(v.shape)}"
         )
-    return pack_frames([
+    body = pack_frames([
         prompt.astype(np.int32, copy=False),
         np.asarray(last, np.float32).reshape(-1),
         k, v,
     ])
+    # CRC32C integrity trailer (r17): a container crossing DCN must
+    # reject a flipped byte as a NAMED error, never scatter garbage KV
+    return _append_crc_trailer(body) if kv_checksum_enabled() else body
 
 
 def unpack_kv_handoff(data) -> dict:
@@ -451,8 +594,12 @@ def unpack_kv_handoff(data) -> dict:
     alias ``data``'s payload regions (the decode engine's scatter is
     the single copy the hardware requires).  Malformed containers raise
     :class:`PayloadError` naming the defect — a handoff must never
-    scatter garbage silently."""
-    views = unpack_frames(data)
+    scatter garbage silently.  A CRC32C trailer (present whenever the
+    producer packed with ``SELDON_TPU_KV_CHECKSUM`` on, the default) is
+    verified first: a flipped byte rejects with the trailer offset and
+    both sums instead of decoding as wrong-but-shaped KV."""
+    body, _ = _split_crc_trailer(data)
+    views = unpack_frames(body)
     if len(views) != len(_KV_HANDOFF_FRAMES):
         raise PayloadError(
             f"KV handoff container carries {len(views)} frames, expected "
@@ -490,6 +637,127 @@ def unpack_kv_handoff(data) -> dict:
         "page_size": page_size,
         "layout": "flat" if k.ndim == 4 else "split",
     }
+
+
+# ---------------------------------------------------------------------------
+# live-stream migration container (r17)
+# ---------------------------------------------------------------------------
+
+# Fixed frame order of one migration container — the handoff container
+# extended with the MID-DECODE state a peer engine needs to resume at
+# the exact next token: the already-decoded token ids, the stream's raw
+# RNG key data (sampling continues on the same path), and a uint8 JSON
+# meta frame carrying the scalar recipe (sampling knobs, remaining
+# deadline, priority, streaming cursor, adapter name).  Same CRC32C
+# trailer discipline as the handoff container.
+_KV_MIGRATION_FRAMES = (
+    "prompt", "last_logits", "k", "v", "tokens", "key_data", "meta"
+)
+
+# scalar recipe fields serialized into the meta frame; everything else
+# a decode engine needs is derivable from the tensor frames
+_MIGRATION_META_FIELDS = (
+    "req_id", "max_new_tokens", "temperature", "top_k", "eos_id", "seed",
+    "priority", "deadline_remaining_ms", "streamed", "stream_tokens",
+    "adapter", "pending", "page_size", "layout",
+)
+
+
+def pack_kv_migration(payload: dict) -> bytes:
+    """Encode a ``PagedEngine.migrate_export`` payload as one SRT1
+    container — the wire form of live-stream migration.  Locally the
+    payload dict passes by reference (the container is the DCN form,
+    exactly like the prefill handoff)."""
+    import json as _json
+
+    for name in ("prompt", "k", "v", "last_logits"):
+        if name not in payload:
+            raise PayloadError(
+                f"KV migration payload is missing the {name!r} entry "
+                f"(needs {', '.join(_KV_MIGRATION_FRAMES)})"
+            )
+    prompt = np.asarray(payload["prompt"], np.int32).reshape(-1)
+    if prompt.size < 1:
+        raise PayloadError("KV migration prompt must be non-empty")
+    k, v = np.asarray(payload["k"]), np.asarray(payload["v"])
+    if k.ndim not in (4, 5) or k.shape != v.shape or k.dtype != v.dtype:
+        raise PayloadError(
+            f"KV migration k/v must be matching rank-4 (flat) or rank-5 "
+            f"(split) page stacks, got {k.dtype}{tuple(k.shape)} vs "
+            f"{v.dtype}{tuple(v.shape)}"
+        )
+    meta = {name: payload.get(name) for name in _MIGRATION_META_FIELDS}
+    meta_frame = np.frombuffer(
+        _json.dumps(meta).encode("utf-8"), np.uint8
+    )
+    body = pack_frames([
+        prompt,
+        np.asarray(payload["last_logits"], np.float32).reshape(-1),
+        k, v,
+        np.asarray(payload.get("tokens", []), np.int32).reshape(-1),
+        np.asarray(payload.get("key_data", []), np.uint32).reshape(-1),
+        meta_frame,
+    ])
+    return _append_crc_trailer(body) if kv_checksum_enabled() else body
+
+
+def unpack_kv_migration(data) -> dict:
+    """Decode one migration container into a payload dict shaped for
+    ``PagedEngine.migrate_import`` (CRC trailer verified first, same
+    rule as the handoff container).  Malformed containers raise
+    :class:`PayloadError` naming the defect."""
+    import json as _json
+
+    body, _ = _split_crc_trailer(data)
+    views = unpack_frames(body)
+    if len(views) != len(_KV_MIGRATION_FRAMES):
+        raise PayloadError(
+            f"KV migration container carries {len(views)} frames, "
+            f"expected {len(_KV_MIGRATION_FRAMES)} "
+            f"({', '.join(_KV_MIGRATION_FRAMES)})"
+        )
+    prompt, last, k, v, tokens, key_data, meta_v = views
+    if prompt.dtype != np.int32 or prompt.ndim != 1 or len(prompt) < 1:
+        raise PayloadError(
+            f"KV migration prompt frame must be 1-D int32, got "
+            f"{prompt.dtype.name}{prompt.shape}"
+        )
+    if k.ndim not in (4, 5) or k.shape != v.shape or k.dtype != v.dtype:
+        raise PayloadError(
+            f"KV migration k/v frames must be matching rank-4/5 page "
+            f"stacks, got {k.dtype.name}{k.shape} vs {v.dtype.name}{v.shape}"
+        )
+    if tokens.dtype != np.int32 or tokens.ndim != 1:
+        raise PayloadError(
+            f"KV migration tokens frame must be 1-D int32, got "
+            f"{tokens.dtype.name}{tokens.shape}"
+        )
+    try:
+        meta = _json.loads(bytes(meta_v.array()).decode("utf-8"))
+    except (UnicodeDecodeError, ValueError) as exc:
+        raise PayloadError(f"KV migration meta frame is not JSON: {exc}") from exc
+    page_size = int(k.shape[2])
+    total = len(prompt) + len(tokens)
+    if page_size < 1 or int(k.shape[1]) != -(-total // page_size):
+        raise PayloadError(
+            f"KV migration geometry mismatch: {len(prompt)} prompt + "
+            f"{len(tokens)} decoded tokens need "
+            f"{-(-total // max(1, page_size))} pages of {page_size}, "
+            f"container holds {int(k.shape[1])}"
+        )
+    out = {
+        "prompt": prompt.array(),
+        "last_logits": last.array(),
+        "k": k.array(),
+        "v": v.array(),
+        "tokens": tokens.array(),
+        "key_data": key_data.array(),
+        "page_size": page_size,
+        "layout": "flat" if k.ndim == 4 else "split",
+    }
+    out.update({f: meta.get(f) for f in _MIGRATION_META_FIELDS
+                if f not in ("page_size", "layout")})
+    return out
 
 
 def stack_views(

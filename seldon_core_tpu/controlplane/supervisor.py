@@ -251,6 +251,49 @@ def disagg_worker_specs(
     return specs
 
 
+def replica_worker_specs(
+    name: str,
+    *,
+    replicas: int = 2,
+    base_http: int = 9700,
+    base_grpc: int = 9800,
+    component: str = "seldon_core_tpu.models.paged.StreamingLM",
+    parameters_json: str = "[]",
+    env: Optional[Dict[str, str]] = None,
+    evacuate_chain: bool = True,
+) -> List[ProcessSpec]:
+    """Worker-set specs for an evacuation-chained replica group (r17):
+    N identical decode workers where replica i's
+    ``SELDON_TPU_EVACUATE_TO`` points at replica (i+1) % N — a
+    SIGTERM'd (or watchdog-evacuating) replica live-migrates its
+    mid-decode streams to its neighbour as SRT1 migration containers
+    instead of re-deriving them from a journal, and the drain journal
+    remains the fallback for streams the ship fails.  The journal path
+    stays pinned per worker exactly as in r12, so the two recovery
+    lanes compose: migrate what you can, journal the rest.
+
+    ``evacuate_chain=False`` degrades to plain replicas (journal-only
+    recovery) — the r12 topology, byte-identical env otherwise."""
+    specs: List[ProcessSpec] = []
+    n = max(1, int(replicas))
+    for i in range(n):
+        worker_env = dict(env or {})
+        if evacuate_chain and n > 1:
+            peer_grpc = base_grpc + ((i + 1) % n)
+            worker_env["SELDON_TPU_EVACUATE_TO"] = (
+                f"grpc://127.0.0.1:{peer_grpc}"
+            )
+        specs.append(ProcessSpec(
+            name=f"{name}-{i}",
+            component=component,
+            http_port=base_http + i,
+            grpc_port=base_grpc + i,
+            parameters_json=parameters_json,
+            env=worker_env,
+        ))
+    return specs
+
+
 class Supervisor:
     """Manages the full set of out-of-process nodes on this host."""
 

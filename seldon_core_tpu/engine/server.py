@@ -510,8 +510,39 @@ def build_gateway_app(gateway: Gateway, auth=None) -> web.Application:
         inferring it from connection refusals."""
         sup = gateway.supervisor
         health = sup.health() if sup is not None else {}
+        # engine health (r17): the device-health watchdog's state per
+        # local paged engine — healthy | degraded | evacuating — plus
+        # the quarantine/migration counters the evacuation layer and
+        # alerting read alongside the process lifecycle states above
+        engines: Dict[str, Dict[str, object]] = {}
+        for svc in gateway.predictors:
+            for unit in svc.graph.walk():
+                component = svc.executor.component(unit.name)
+                engine = getattr(component, "engine", None)
+                stats_fn = getattr(engine, "engine_stats", None)
+                if stats_fn is None:
+                    continue
+                try:
+                    s = stats_fn()
+                except Exception:  # noqa: BLE001 — one sick engine must
+                    # not take the whole debug surface down
+                    engines[f"{svc.name}/{unit.name}"] = {"error": True}
+                    continue
+                engines[f"{svc.name}/{unit.name}"] = {
+                    "health": s.get("health", "healthy"),
+                    "health_state": s.get("health_state", 0),
+                    "watchdog_trips": s.get("watchdog_trips", 0),
+                    "quarantined": s.get("quarantined", 0),
+                    "migrated_out": s.get("migrated_out", 0),
+                    "migrated_in": s.get("migrated_in", 0),
+                }
         return web.json_response({
             "workers": health,
+            "engines": engines,
+            "degraded": sorted(
+                name for name, h in engines.items()
+                if h.get("health") not in (None, "healthy")
+            ),
             "exhausted": sorted(
                 name for name, h in health.items() if h.get("exhausted")
             ),

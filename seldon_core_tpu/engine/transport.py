@@ -181,6 +181,27 @@ def kv_handoff_hop(unit: str, transport: str = "local"):
     hop.finish()
 
 
+@contextmanager
+def migration_hop(unit: str, transport: str = "local"):
+    """Meter one live-stream migration (evacuating engine -> healthy
+    peer) under ``method="migrate"`` — same canonical transport surface
+    as :func:`kv_handoff_hop`, so the dashboards price evacuations next
+    to the request and handoff lanes.  ``zero_copy_bytes`` for the
+    in-process adoption lane (payload passes by reference),
+    ``request_bytes`` for a DCN container ship.  Yields None when
+    telemetry is off."""
+    if not _metrics.transport_telemetry_enabled():
+        yield None
+        return
+    hop = _Hop(unit, "migrate", transport)
+    try:
+        yield hop
+    except BaseException:
+        hop.finish(error=True)
+        raise
+    hop.finish()
+
+
 def backoff_s(attempt: int, base_s: float = 0.05, cap_s: float = 2.0) -> float:
     """Full-jitter exponential backoff for attempt ``attempt`` (0-based
     retry index): uniform over [0, min(cap, base * 2^attempt)].

@@ -49,7 +49,121 @@ from seldon_core_tpu.runtime.component import MicroserviceError
 
 logger = logging.getLogger(__name__)
 
-__all__ = ["PrefillLM", "DisaggregatedLM"]
+__all__ = ["PrefillLM", "DisaggregatedLM", "evacuate_streams",
+           "migration_journal_entry"]
+
+
+# ---------------------------------------------------------------------------
+# live-stream evacuation coordinator (r17)
+# ---------------------------------------------------------------------------
+
+
+def migration_journal_entry(payload: Dict[str, Any]) -> Dict[str, Any]:
+    """A drain-journal entry from a migration payload — the fallback
+    recipe when a stream's export succeeded but no peer would take it:
+    the respawned (or surviving) engine re-derives the stream exactly
+    as an r12 journal replay would.  Schema comes from the ONE shared
+    builder (``models/paged.journal_entry``), so the two journal lanes
+    cannot drift."""
+    from seldon_core_tpu.models.paged import journal_entry
+
+    return journal_entry(
+        req_id=payload.get("req_id"),
+        prompt=[int(t) for t in np.asarray(payload["prompt"]).reshape(-1)],
+        max_new_tokens=int(payload.get("max_new_tokens", 32)),
+        temperature=float(payload.get("temperature", 0.0)),
+        top_k=int(payload.get("top_k", 0)),
+        eos_id=int(payload.get("eos_id", -1)),
+        seed=int(payload.get("seed", 0)),
+        priority=int(payload.get("priority", 0)),
+        deadline_remaining_ms=payload.get("deadline_remaining_ms"),
+        streamed=int(payload.get("streamed") or 0),
+        stream_tokens=bool(payload.get("stream_tokens")),
+        tokens_decoded=int(
+            np.asarray(payload.get("tokens", [])).reshape(-1).shape[0]
+        ),
+        adapter=payload.get("adapter"),
+    )
+
+
+def _peer_cost_s(engine: PagedEngine, payload: Dict[str, Any]) -> float:
+    """Predicted seconds for ``payload``'s REMAINING work on ``engine``
+    (the PR 13 cost model applied to evacuation placement).  A cold
+    peer prices 0.0 — an idle engine is the best destination anyway;
+    queue depth breaks ties so one peer doesn't absorb the whole
+    evacuation wave."""
+    remaining = max(
+        1,
+        int(payload.get("max_new_tokens", 32))
+        - int(np.asarray(payload.get("tokens", [])).reshape(-1).shape[0]),
+    )
+    cost = engine.predict_cost_s(0, remaining)  # KV arrives computed:
+    # the peer pays decode only, never the prompt's prefill FLOPs
+    stats = engine.engine_stats()
+    backlog = stats["queued_streams"] + stats["active_slots"]
+    return (cost or 0.0) + 0.001 * backlog
+
+
+def evacuate_streams(
+    src_engine: PagedEngine,
+    peers: List[PagedEngine],
+    *,
+    transport: str = "local",
+) -> Dict[str, Any]:
+    """Live-migrate ``src_engine``'s exportable streams onto healthy
+    ``peers`` (r17): priority-ordered (highest first — the evacuation
+    window's budget goes to the most important streams), each placed on
+    the HEALTHY peer whose predicted remaining-work cost is lowest (the
+    PR 13 cost model; degraded/evacuating peers are never targets).
+    The in-process lane adopts the source's stream objects, so waiter
+    events and token queues survive the move — zero token loss.
+
+    A stream every peer refuses (pool too small, engine closed, shed)
+    falls back to the r12 discipline: its waiter resolves 503
+    ``MIGRATING`` and its re-derivation recipe lands in the returned
+    ``journal`` list for the caller to persist.  Returns
+    ``{"migrated", "failed", "journal"}``."""
+    from seldon_core_tpu.engine.transport import migration_hop
+
+    exported = src_engine.migrate_export()
+    healthy = [
+        p for p in peers
+        if p is not src_engine
+        and p.engine_stats().get("health", "healthy") == "healthy"
+    ]
+    out: Dict[str, Any] = {"migrated": 0, "failed": 0, "journal": []}
+    err = MicroserviceError(
+        "stream could not be live-migrated during evacuation; its "
+        "recipe is journaled for re-derivation",
+        status_code=503, reason="MIGRATING",
+    )
+    for payload, stream in sorted(
+        exported, key=lambda ps: -ps[0]["priority"]
+    ):
+        placed = False
+        for peer in sorted(healthy, key=lambda p: _peer_cost_s(p, payload)):
+            try:
+                with migration_hop("evacuate", transport) as hop:
+                    if hop is not None:
+                        hop.zero_copy_bytes = (
+                            int(np.asarray(payload["k"]).nbytes)
+                            + int(np.asarray(payload["v"]).nbytes)
+                        )
+                    peer.migrate_import(payload, stream=stream)
+                placed = True
+                break
+            except MicroserviceError as exc:
+                logger.warning(
+                    "peer refused migrated req %s: %s",
+                    payload.get("req_id"), exc,
+                )
+        if placed:
+            out["migrated"] += 1
+        else:
+            out["failed"] += 1
+            out["journal"].append(migration_journal_entry(payload))
+            src_engine.fail_stream(stream, err)
+    return out
 
 
 class PrefillLM(StreamingLM):
@@ -313,10 +427,15 @@ class DisaggregatedLM(StreamingLM):
 
     def _hand_off_container(self, job: _PrefillJob, buf: bytes) -> None:
         """DCN handoff: reopen the received SRT1 container as zero-copy
-        views and admit the pages, metering the transferred bytes."""
+        views and admit the pages, metering the transferred bytes.  The
+        ``transport.corrupt`` chaos point flips payload bytes HERE —
+        the CRC32C trailer must turn the flip into a named rejection
+        the waiter sees, never a silent garbage-KV scatter."""
         from seldon_core_tpu.codec.bufview import unpack_kv_handoff
         from seldon_core_tpu.engine.transport import kv_handoff_hop
+        from seldon_core_tpu.utils import faults as _faults
 
+        buf = _faults.corrupt_bytes("transport.corrupt", buf)
         with kv_handoff_hop("disagg-prefill", "dcn") as hop:
             if hop is not None:
                 hop.request_bytes = len(buf)

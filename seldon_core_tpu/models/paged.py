@@ -834,7 +834,7 @@ class _CachedPrefix:
 # SLO lifecycle counters threaded engine_stats -> flight-recorder chunk
 # records (per-wave deltas) -> GenerationPrometheusBridge -> dashboards
 _SLO_COUNTER_KEYS = ("shed", "expired", "preempted", "restored",
-                     "drained", "replayed")
+                     "drained", "replayed", "quarantined")
 
 
 class _Stream:
@@ -848,7 +848,7 @@ class _Stream:
         "t_decode_start", "t_first_token", "t_finish",
         "queue_depth_at_submit", "cached_len", "prefilled", "priority",
         "deadline", "preempted", "kv_export", "kv_import", "kv_payload",
-        "adapter", "adapter_slot", "adapter_pinned",
+        "kv_imported", "adapter", "adapter_slot", "adapter_pinned",
     )
 
     def __init__(self, req_id, prompt, max_new, temperature, top_k, eos_id, seed):
@@ -880,6 +880,10 @@ class _Stream:
         self.kv_export = False
         self.kv_import: Optional[Dict[str, Any]] = None
         self.kv_payload: Optional[Dict[str, Any]] = None
+        # the import payload was consumed (pages scatter-written): the
+        # stream now decodes like a local one, but drain still treats
+        # it as a disaggregation stream (the r15 journal exclusion)
+        self.kv_imported = False
         # speculative mode: the next greedy token (argmax of the last
         # verified logits), decided on host between verify rounds
         self.pending: Optional[int] = None
@@ -929,6 +933,45 @@ class _Stream:
         self.adapter: Optional[str] = None
         self.adapter_slot = 0
         self.adapter_pinned = False
+
+
+def journal_entry(
+    *,
+    req_id: Any,
+    prompt: List[int],
+    max_new_tokens: int,
+    temperature: float = 0.0,
+    top_k: int = 0,
+    eos_id: int = -1,
+    seed: int = 0,
+    priority: int = 0,
+    deadline_remaining_ms: Optional[float] = None,
+    streamed: int = 0,
+    stream_tokens: bool = False,
+    tokens_decoded: int = 0,
+    adapter: Optional[str] = None,
+) -> Dict[str, Any]:
+    """THE drain-journal entry schema — the one key set
+    :meth:`PagedEngine.replay` consumes.  Both builders go through
+    here (``PagedEngine._journal_entry`` from a live stream object,
+    ``models/disagg.migration_journal_entry`` from a migration
+    payload), so a field added to the recipe cannot drift between the
+    drain lane and the migration-fallback lane."""
+    return {
+        "req_id": req_id,
+        "prompt": prompt,
+        "max_new_tokens": int(max_new_tokens),
+        "temperature": float(temperature),
+        "top_k": int(top_k),
+        "eos_id": int(eos_id),
+        "seed": int(seed),
+        "priority": int(priority),
+        "deadline_remaining_ms": deadline_remaining_ms,
+        "streamed": int(streamed),
+        "stream_tokens": bool(stream_tokens),
+        "tokens_decoded": int(tokens_decoded),
+        "adapter": adapter,
+    }
 
 
 class PagedEngine:
@@ -1338,6 +1381,14 @@ class PagedEngine:
                           # KV-page handoff payloads, and imported
                           # payloads scatter-written into this pool
                           "kv_exports": 0, "kv_imports": 0,
+                          # live migration + quarantine (r17): mid-
+                          # decode streams exported to / imported from
+                          # a peer engine without losing a token, and
+                          # streams retired by the post-chunk NaN/Inf
+                          # screen (500 NUMERIC_POISON — never
+                          # fail_all on the wave)
+                          "migrated_out": 0, "migrated_in": 0,
+                          "quarantined": 0,
                           # multi-LoRA (r16): adapter pool-slot loads /
                           # LRU reclaims, submit-time residency hit or
                           # cold-load miss, and waves whose runnable
@@ -1439,6 +1490,27 @@ class PagedEngine:
                     )
                 self._draft_module = TransformerLM(dtype=dtype, **dc)
                 self._draft_params = self.speculative["draft_params"]
+
+        # poison-stream quarantine (r17): a cheap post-chunk isfinite
+        # reduction over served logits retires ONLY the offending
+        # stream with 500 NUMERIC_POISON — one NaN lane must never
+        # stream garbage or take its wave-mates down.
+        # SELDON_TPU_NAN_GUARD=0 disables the screen.
+        self._nan_guard = _knobs.flag("SELDON_TPU_NAN_GUARD")
+        self._isfinite_jit = None  # built lazily on first screened chunk
+
+        # device-health watchdog (r17): per-wave wall time / fault rate
+        # / compile storms / allocator pressure drive the healthy ->
+        # degraded -> evacuating state machine the evacuation layer
+        # reads (utils/watchdog.py; SELDON_TPU_WATCHDOG=0 disables —
+        # the engine then always reports healthy)
+        from seldon_core_tpu.utils.watchdog import (
+            EngineWatchdog,
+            watchdog_enabled,
+        )
+
+        self._watchdog = EngineWatchdog() if watchdog_enabled() else None
+        self._wd_last_compiles = 0
 
         # recompilation sentinels: every engine jit entry point reports
         # compile events to seldon_tpu_jit_compiles_total{program=} +
@@ -2258,6 +2330,79 @@ class PagedEngine:
     def _record_chunk(self, rec: Dict[str, Any]) -> None:
         if self.recorder is not None:
             self.recorder.record(rec)
+        self._feed_watchdog(float(rec.get("wall_ms", 0.0)), fault=False)
+
+    def _feed_watchdog(self, wall_ms: float, fault: bool) -> None:
+        """One per-wave observation into the health watchdog (r17):
+        wall time (with the jitwatch sentinels' compile events exempting
+        cold/compile waves from the ceiling), chunk faults, and
+        allocator occupancy.  Runs OUTSIDE the engine lock except for
+        one cheap occupancy read."""
+        wd = self._watchdog
+        if wd is None:
+            return
+        compiles = sum(s.compiles for s in self._sentinels.values())
+        delta = compiles - self._wd_last_compiles
+        self._wd_last_compiles = compiles
+        with self._lock:
+            used = self.num_pages - 1 - len(self._free_pages) - len(self._lru)
+        total = max(1, self.num_pages - 1)
+        wd.observe(
+            wall_ms=wall_ms,
+            compiled=delta > 0,
+            fault=fault,
+            pool_used_pct=100.0 * used / total,
+            compiles_delta=delta,
+        )
+
+    def _quarantine_poisoned(self, runnable: List[_Stream]) -> List[_Stream]:
+        """Post-chunk NaN/Inf screen on the served logits (r17): fault
+        point ``paged.nan`` poisons ONE runnable lane first (chaos), the
+        screen — one jitted ``isfinite`` reduction, (max_slots,) bools
+        back — then retires every non-finite lane's stream with a 500
+        ``NUMERIC_POISON`` and a ``quarantined`` count.  Wave-mates are
+        untouched (lanes are arithmetically independent), so one sick
+        stream never becomes a ``fail_all``.  Returns the quarantined
+        streams; their slots/pages are already released.
+
+        DECODE lane only: the speculative verify program returns argmax
+        token ids — its logits never land in ``self._logits`` or reach
+        the host at all, so there is nothing to screen there (and the
+        ``paged.nan`` point, which lives here, does not fire on spec
+        engines).  Documented in §11a / utils/faults.py."""
+        jnp = self._jnp
+        if runnable and _faults.enabled() and _faults.fire("paged.nan"):
+            victim = min(runnable, key=lambda s: s.slot)
+            self._logits = self._logits.at[victim.slot].set(jnp.nan)
+            logger.warning(
+                "injected paged.nan into slot %d (req %d)",
+                victim.slot, victim.req_id,
+            )
+        if not self._nan_guard or not runnable:
+            return []
+        if self._isfinite_jit is None:
+            self._isfinite_jit = self._jax.jit(
+                lambda l: jnp.isfinite(l).all(axis=-1)
+            )
+        finite = np.asarray(self._isfinite_jit(self._logits))
+        poisoned = [s for s in runnable if not finite[s.slot]]
+        if not poisoned:
+            return []
+        with self._lock:
+            for s in poisoned:
+                self._counters["quarantined"] += 1
+                self._fail_stream_locked(s, MicroserviceError(
+                    f"stream req {s.req_id} quarantined: served logits "
+                    f"went non-finite after {len(s.tokens)} tokens "
+                    "(numeric poison contained to this stream; its "
+                    "wave-mates are unaffected)",
+                    status_code=500, reason="NUMERIC_POISON",
+                ))
+        logger.error(
+            "NaN guard quarantined %d stream(s): %s",
+            len(poisoned), [s.req_id for s in poisoned],
+        )
+        return poisoned
 
     def _profile_before_chunk(self) -> None:
         """SELDON_TPU_PROFILE_DIR hook: the first N chunk programs run
@@ -3105,7 +3250,15 @@ class PagedEngine:
             if int(self._page_ref[e.page]) == 0:
                 self._lru.pop(e.page, None)
             self._page_ref[e.page] += 1
-        fresh = self._alloc_locked(-(-plen // self.page_size) - len(matched))
+        # migration imports (r17) arrive with decoded tokens whose KV
+        # pages must be placed alongside the prompt's at admission
+        extra = 0
+        if stream.kv_import is not None:
+            toks = stream.kv_import.get("tokens")
+            extra = 0 if toks is None else len(toks)
+        fresh = self._alloc_locked(
+            -(-(plen + extra) // self.page_size) - len(matched)
+        )
         if fresh is None:
             for e in reversed(matched):
                 self._page_ref[e.page] -= 1
@@ -3508,7 +3661,13 @@ class PagedEngine:
         payload = stream.kv_import
         t0 = _time.time()
         plen = len(stream.prompt)
-        P = -(-plen // self.page_size)
+        # migration imports (r17) also carry the decoded-token pages:
+        # the peer resumes at the exact next token, so the scatter
+        # places prompt AND generated KV in one donated call
+        mig_tokens = payload.get("tokens")
+        extra = 0 if mig_tokens is None else len(mig_tokens)
+        total = plen + extra
+        P = -(-total // self.page_size)
         pages = np.asarray(stream.pages[:P], np.int32)
         fn = self._import_kv_jit.get(P)
         if fn is None:
@@ -3524,23 +3683,46 @@ class PagedEngine:
         ).reshape(-1)
         slot = stream.slot
         self._logits = self._logits.at[slot].set(jnp.asarray(last))
-        seeds = np.zeros((self.max_slots,), np.uint64)
-        seeds[0] = stream.seed % (1 << 63)
-        self._keys = self._keys.at[slot].set(
-            self._derive_keys(jnp.asarray(seeds))[0]
-        )
+        key_data = payload.get("key_data")
+        if key_data is not None and np.asarray(key_data).size:
+            # mid-decode migration: the source's post-chunk rng state
+            # resumes the SAME sample path (a re-derived key would fork
+            # a sampled stream at the migration boundary)
+            self._keys = self._keys.at[slot].set(
+                jnp.asarray(np.asarray(key_data, np.uint32))
+            )
+        else:
+            seeds = np.zeros((self.max_slots,), np.uint64)
+            seeds[0] = stream.seed % (1 << 63)
+            self._keys = self._keys.at[slot].set(
+                self._derive_keys(jnp.asarray(seeds))[0]
+            )
         if self.speculative is not None:
-            stream.pending = int(np.argmax(last))
+            pending = payload.get("pending")
+            stream.pending = (
+                int(pending) if pending is not None else int(np.argmax(last))
+            )
         stream.prefilled = plen
+        migration = bool(payload.get("migration"))
+        if extra:
+            stream.tokens = [int(t) for t in np.asarray(mig_tokens).reshape(-1)]
+        if migration:
+            stream.streamed = int(payload.get("streamed") or 0)
         stream.t_decode_start = _time.time()
         with self._lock:
-            self._counters["kv_imports"] += 1
+            if extra:
+                # decode resumes mid-sequence: lengths must count the
+                # generated tokens' KV the scatter just placed
+                self._lengths[slot] = total
+            stream.kv_import = None  # payload consumed: free the host copy
+            stream.kv_imported = True
+            self._counters["migrated_in" if migration else "kv_imports"] += 1
         if stream.trace_id:
             self._gen_span(
                 stream, "gen.prefill", t0, stream.t_decode_start - t0,
                 slot=slot, bucket=0, prompt_len=plen,
                 cached_tokens=0, pages_held=len(stream.pages),
-                group_size=1, imported=True,
+                group_size=1, imported=True, migrated=migration,
             )
 
     def _export_streams(self, streams: List[_Stream]) -> None:
@@ -3663,6 +3845,285 @@ class PagedEngine:
             kv_import={"k": k, "v": v, "last_logits": last},
             **kw,
         )
+
+    # ---- live stream migration (r17) --------------------------------------
+
+    def migrate_export(
+        self, streams: Optional[Sequence[_Stream]] = None
+    ) -> List[Tuple[Dict[str, Any], _Stream]]:
+        """Snapshot mid-decode streams for live migration to a peer
+        engine: KV pages (prompt AND generated-token pages), the decode
+        cursor (token ids so far), per-slot RNG state, sampling params,
+        remaining deadline, priority, adapter name and the streaming
+        cursor — everything :meth:`migrate_import` needs to resume at
+        the exact next token, greedy bit-exact with the uninterrupted
+        run.  Call with the step loop quiesced (no chunk in flight —
+        the same precondition as :meth:`drain`).
+
+        Exports the given ``streams`` (default: every in-slot stream)
+        that are EXPORTABLE: fully prefilled, not a disaggregation
+        export, not mid-import, and not on a speculative engine (the
+        verify pipeline's pending-draft state stays host-local; spec
+        streams fall back to the drain journal's re-derivation).
+        Exported streams are detached from this engine (slot and pages
+        released, ``migrated_out`` counted) but their waiters are NOT
+        resolved — the caller either adopts them on the peer
+        (``migrate_import(payload, stream=s)``) or fails them and
+        journals the recipe (:meth:`fail_stream` +
+        :func:`migration_journal_entry`).  Non-exportable streams are
+        left untouched for a subsequent :meth:`drain`."""
+        import time as _time
+
+        now = _time.monotonic()
+        with self._lock:
+            candidates = (
+                list(streams) if streams is not None
+                else [s for s in self._slots if s is not None]
+            )
+            exportable = [
+                s for s in candidates
+                if s.slot is not None
+                and self._slots[s.slot] is s
+                and not s.cancelled
+                and not s.kv_export
+                and s.kv_import is None
+                and s.prefilled >= len(s.prompt)
+                and self.speculative is None
+            ]
+        if not exportable:
+            return []
+        jnp = self._jnp
+        # one bulk readback each for the tiny per-slot states; the page
+        # gathers below are per-stream (each stream's table is its own)
+        keys_np = np.asarray(self._keys)
+        logits_np = np.asarray(self._logits)
+        out: List[Tuple[Dict[str, Any], _Stream]] = []
+        for s in exportable:
+            slot = s.slot
+            total = len(s.prompt) + len(s.tokens)
+            if int(self._lengths[slot]) != total:
+                # cursor/cache disagreement (should not happen outside a
+                # mid-chunk call): refuse to snapshot inconsistent state
+                logger.warning(
+                    "migrate_export skipping req %d: cache length %d != "
+                    "prompt+decoded %d", s.req_id,
+                    int(self._lengths[slot]), total,
+                )
+                continue
+            P = -(-total // self.page_size)
+            idx = jnp.asarray(np.asarray(s.pages[:P], np.int32))
+            payload = {
+                "req_id": s.req_id,
+                "prompt": np.asarray(s.prompt, np.int32),
+                "tokens": np.asarray(s.tokens, np.int32),
+                "k": np.asarray(self.pages_k[:, idx]),
+                "v": np.asarray(self.pages_v[:, idx]),
+                "last_logits": logits_np[slot].astype(np.float32, copy=False),
+                "key_data": keys_np[slot].copy(),
+                "max_new_tokens": int(s.max_new),
+                "temperature": float(s.temperature),
+                "top_k": int(s.top_k),
+                "eos_id": int(s.eos_id),
+                "seed": int(s.seed),
+                "priority": int(s.priority),
+                "deadline_remaining_ms": (
+                    max(0.0, (s.deadline - now) * 1000.0)
+                    if s.deadline is not None else None
+                ),
+                "streamed": int(s.streamed),
+                "stream_tokens": s.token_queue is not None,
+                "adapter": s.adapter,
+                "pending": s.pending,
+                "page_size": self.page_size,
+                "layout": "flat" if self._pool_flat else "split",
+            }
+            with self._lock:
+                if self._slots[slot] is not s:
+                    continue  # raced a concurrent retirement
+                self._slots[slot] = None
+                self._lengths[slot] = 0
+                if s.pages:
+                    self._free_locked(s.pages)
+                    s.pages = []
+                s.slot = None
+                self._release_adapter_locked(s)
+                self._counters["migrated_out"] += 1
+            out.append((payload, s))
+        self._flush_spans()
+        return out
+
+    def migrate_import(
+        self,
+        payload: Dict[str, Any],
+        *,
+        stream: Optional[_Stream] = None,
+        stream_tokens: Optional[bool] = None,
+    ) -> _Stream:
+        """Admit a :meth:`migrate_export` payload: the prompt AND
+        generated-token pages scatter in via the donated import path,
+        the decode cursor/RNG/logits install exactly as the source held
+        them, and decode resumes at the exact next token.
+
+        ``stream`` (in-process evacuation) adopts the SOURCE engine's
+        stream object — its waiter event and token queue keep working,
+        so a streaming consumer sees an exact continuation across the
+        migration with zero token loss.  Without it (the DCN form) a
+        fresh stream is built from the payload's recipe;
+        ``stream_tokens`` then forces/suppresses streaming (default:
+        the payload's original mode)."""
+        import time as _time
+
+        prompt = np.asarray(payload["prompt"], np.int32).reshape(-1)
+        tokens = np.asarray(payload.get("tokens", []), np.int32).reshape(-1)
+        k = np.asarray(payload["k"])
+        v = np.asarray(payload["v"])
+        last = np.asarray(payload["last_logits"], np.float32).reshape(-1)
+        ps = int(payload.get("page_size", self.page_size))
+        if ps != self.page_size:
+            raise MicroserviceError(
+                f"migration payload page_size {ps} != engine page_size "
+                f"{self.page_size}: source and target engines must share "
+                "one pool configuration",
+                status_code=400, reason="KV_LAYOUT_MISMATCH",
+            )
+        total = len(prompt) + len(tokens)
+        P = -(-total // self.page_size)
+        want = (self.module.num_layers, P) + tuple(self.pages_k.shape[2:])
+        for name, arr in (("k", k), ("v", v)):
+            if tuple(arr.shape) != want:
+                raise MicroserviceError(
+                    f"migration payload {name} shape {tuple(arr.shape)} "
+                    f"does not fit this engine's pool geometry {want} "
+                    "(layers, prompt+decoded pages, page tail)",
+                    status_code=400, reason="KV_LAYOUT_MISMATCH",
+                )
+            if arr.dtype != np.dtype(self._dtype):
+                raise MicroserviceError(
+                    f"migration payload {name} dtype {arr.dtype} != pool "
+                    f"dtype {np.dtype(self._dtype)}",
+                    status_code=400, reason="KV_LAYOUT_MISMATCH",
+                )
+        if last.shape[0] != self.vocab_size:
+            raise MicroserviceError(
+                f"migration payload last_logits carries {last.shape[0]} "
+                f"entries, engine vocab is {self.vocab_size}",
+                status_code=400, reason="KV_LAYOUT_MISMATCH",
+            )
+        kv = {
+            "k": k, "v": v, "last_logits": last, "tokens": tokens,
+            "key_data": np.asarray(
+                payload.get("key_data", []), np.uint32
+            ).reshape(-1),
+            "streamed": int(payload.get("streamed") or 0),
+            "pending": payload.get("pending"),
+            "migration": True,
+        }
+        rem = payload.get("deadline_remaining_ms")
+        deadline = (
+            _time.monotonic() + max(0.0, float(rem)) / 1000.0
+            if rem is not None else None
+        )
+        if stream is None:
+            want_stream = (
+                bool(payload.get("stream_tokens"))
+                if stream_tokens is None else bool(stream_tokens)
+            )
+            return self.submit(
+                prompt,
+                max_new_tokens=int(payload.get("max_new_tokens", 32)),
+                temperature=float(payload.get("temperature", 0.0)),
+                top_k=int(payload.get("top_k", 0)),
+                eos_id=int(payload.get("eos_id", -1)),
+                seed=int(payload.get("seed", 0)),
+                priority=int(payload.get("priority", 0)),
+                deadline=deadline,
+                stream_tokens=want_stream,
+                adapter=payload.get("adapter") or None,
+                kv_import=kv,
+            )
+        # ---- in-process adoption: the source's stream object joins
+        # THIS engine's queue, waiter/event/token-queue intact ----------
+        plen = len(prompt)
+        max_new = int(stream.max_new)
+        bucket = next((b for b in self.prompt_buckets if b >= plen), None)
+        if bucket is None or plen + max_new > self.max_len:
+            raise MicroserviceError(
+                f"prompt {plen} + max_new {max_new} exceeds max_len "
+                f"{self.max_len}",
+                status_code=400, reason="SEQUENCE_TOO_LONG",
+            )
+        need = -(-(plen + max_new) // self.page_size)
+        if need > self.num_pages - 1:
+            raise MicroserviceError(
+                f"request needs {need} pages but the pool holds "
+                f"{self.num_pages - 1}",
+                status_code=400, reason="SEQUENCE_TOO_LONG",
+            )
+        adapter = stream.adapter or None
+        if adapter is not None:
+            with self._lock:
+                if self._closed:
+                    raise MicroserviceError(
+                        "engine closed", status_code=503,
+                        reason="SHUTTING_DOWN",
+                    )
+                if self.max_queue and len(self._queue) >= self.max_queue:
+                    self._shed_for_admission_locked(int(stream.priority))
+        adapter_slot = (
+            self._acquire_adapter_slot(adapter) if adapter is not None else 0
+        )
+        try:
+            with self._lock:
+                if self._closed:
+                    raise MicroserviceError(
+                        "engine closed", status_code=503,
+                        reason="SHUTTING_DOWN",
+                    )
+                if self.max_queue and len(self._queue) >= self.max_queue:
+                    self._shed_for_admission_locked(int(stream.priority))
+                # the adopted object keeps its identity (event, token
+                # queue, streamed cursor, trace linkage) and resets the
+                # engine-local state the import wave will rebuild
+                stream.slot = None
+                stream.pages = []
+                stream.cached_len = 0
+                stream.prefilled = 0
+                stream.tokens = []
+                stream.kv_import = kv
+                stream.kv_imported = False
+                stream.kv_export = False
+                stream.kv_payload = None
+                stream.cancelled = False
+                stream.preempted = False
+                stream.error = None
+                stream.result = None
+                stream.deadline = deadline
+                stream.adapter_slot = int(adapter_slot)
+                if adapter_slot:
+                    stream.adapter_pinned = True
+                    self._drop_temp_pin_locked(adapter_slot)
+                    self._adapter_requests[adapter] = (
+                        self._adapter_requests.get(adapter, 0) + 1
+                    )
+                stream.queue_depth_at_submit = len(self._queue)
+                self._queue.append(stream)
+                self._queued.add(stream)
+            return stream
+        except BaseException:
+            if adapter_slot:
+                with self._lock:
+                    self._drop_temp_pin_locked(adapter_slot)
+                    self._unpin_adapter_slot_locked(adapter_slot)
+            raise
+
+    def fail_stream(self, stream: _Stream, exc: Exception) -> None:
+        """Error-terminate one DETACHED stream (the migration fallback:
+        an export whose peer import failed must resolve its waiter —
+        with the journal recipe covering the re-derivation)."""
+        with self._lock:
+            if stream.result is not None or stream.error is not None:
+                return
+            self._fail_stream_locked(stream, exc)
 
     def predict_cost_s(
         self, prompt_len: int, max_new: int
@@ -3875,7 +4336,11 @@ class PagedEngine:
                 self._fail_stream_locked(stream, err)
             if self._debug_invariants:
                 self._check_invariants_locked()
-            return bool(self._queue) or any(s is not None for s in self._slots)
+            more = bool(self._queue) or any(s is not None for s in self._slots)
+        # the fault is a watchdog signal: a sustained fault rate drives
+        # the engine health state machine toward degraded/evacuating
+        self._feed_watchdog(0.0, fault=True)
+        return more
 
     def has_work(self) -> bool:
         with self._lock:
@@ -3892,6 +4357,17 @@ class PagedEngine:
         counter cannot silently skip export.  ``detail=True`` adds the
         flight recorder's ring (per-chunk records) and its aggregates —
         the /debug/engine payload."""
+        # device-health watchdog (r17): state string for the debug
+        # surfaces, numeric code for the prometheus gauge (0 healthy /
+        # 1 degraded / 2 evacuating), healthy->degraded trip count
+        if self._watchdog is not None:
+            from seldon_core_tpu.utils import watchdog as _wd
+
+            health = self._watchdog.state
+            health_code = _wd.STATE_CODES[health]
+            watchdog_trips = self._watchdog.trips
+        else:
+            health, health_code, watchdog_trips = "healthy", 0, 0
         with self._lock:
             out = {
                 **self._counters,
@@ -3927,8 +4403,13 @@ class PagedEngine:
                 # (prometheus gets the per-program split directly from
                 # jitwatch — bridge-excluded to avoid double export)
                 "jit_compiles": sum(s.compiles for s in self._sentinels.values()),
+                "health": health,
+                "health_state": health_code,
+                "watchdog_trips": watchdog_trips,
             }
         if detail:
+            if self._watchdog is not None:
+                out["watchdog"] = self._watchdog.stats()
             if self.recorder is not None:
                 out["recorder"] = self.recorder.snapshot()
                 out["recorder_stats"] = self.recorder.stats()
@@ -3936,6 +4417,43 @@ class PagedEngine:
                 out["recorder"] = []
                 out["recorder_stats"] = {"records": 0, "seq": 0}
         return out
+
+    @staticmethod
+    def _journal_entry(s: _Stream, now: float) -> Dict[str, Any]:
+        """One stream's re-derivation recipe as a drain-journal entry
+        (the stream-object front of :func:`journal_entry` — the
+        migration fallback builds the same schema from a payload via
+        models/disagg.migration_journal_entry)."""
+        return journal_entry(
+            req_id=s.req_id,
+            prompt=[int(t) for t in s.prompt],
+            max_new_tokens=int(s.max_new),
+            temperature=float(s.temperature),
+            top_k=int(s.top_k),
+            eos_id=int(s.eos_id),
+            seed=int(s.seed),
+            priority=int(s.priority),
+            # absolute monotonic deadlines don't survive a
+            # process: serialize the REMAINING budget and re-mint
+            # on replay (wall time spent respawning decrements it
+            # implicitly on neither side — acceptable: the
+            # respawn window is the handoff's price)
+            deadline_remaining_ms=(
+                max(0.0, (s.deadline - now) * 1000.0)
+                if s.deadline is not None else None
+            ),
+            # streaming resume: tokens the consumer already saw —
+            # the replayed stream pushes only past this cursor,
+            # so a reconnecting SSE consumer sees an exact
+            # continuation, never a repeat
+            streamed=int(s.streamed),
+            stream_tokens=s.token_queue is not None,
+            tokens_decoded=len(s.tokens),  # diagnostics only
+            # the replayed stream must decode with the SAME
+            # weight set; the respawned engine re-resolves the
+            # name through its registry (cold-load on replay)
+            adapter=s.adapter,
+        )
 
     def drain(self) -> List[Dict[str, Any]]:
         """Drain for handoff (r12): stop admission, then serialize every
@@ -3964,43 +4482,14 @@ class PagedEngine:
             now = _time.monotonic()
             entries: List[Dict[str, Any]] = []
             for s in victims:
-                if s.kv_export or s.kv_import is not None:
+                if s.kv_export or s.kv_import is not None or s.kv_imported:
                     # disaggregated handoff streams are not journaled:
                     # the coordinating component retries the whole
                     # prefill-export / import round trip itself (a
                     # replayed import would need the payload persisted,
                     # and an export's waiter died with this process)
                     continue
-                entries.append({
-                    "req_id": s.req_id,
-                    "prompt": [int(t) for t in s.prompt],
-                    "max_new_tokens": int(s.max_new),
-                    "temperature": float(s.temperature),
-                    "top_k": int(s.top_k),
-                    "eos_id": int(s.eos_id),
-                    "seed": int(s.seed),
-                    "priority": int(s.priority),
-                    # absolute monotonic deadlines don't survive a
-                    # process: serialize the REMAINING budget and re-mint
-                    # on replay (wall time spent respawning decrements it
-                    # implicitly on neither side — acceptable: the
-                    # respawn window is the handoff's price)
-                    "deadline_remaining_ms": (
-                        max(0.0, (s.deadline - now) * 1000.0)
-                        if s.deadline is not None else None
-                    ),
-                    # streaming resume: tokens the consumer already saw —
-                    # the replayed stream pushes only past this cursor,
-                    # so a reconnecting SSE consumer sees an exact
-                    # continuation, never a repeat
-                    "streamed": int(s.streamed),
-                    "stream_tokens": s.token_queue is not None,
-                    "tokens_decoded": len(s.tokens),  # diagnostics only
-                    # the replayed stream must decode with the SAME
-                    # weight set; the respawned engine re-resolves the
-                    # name through its registry (cold-load on replay)
-                    "adapter": s.adapter,
-                })
+                entries.append(self._journal_entry(s, now))
             self._queue.clear()
             self._queued.clear()
             err = MicroserviceError(
@@ -4036,6 +4525,18 @@ class PagedEngine:
             deadline = None
             rem = e.get("deadline_remaining_ms")
             if rem is not None:
+                if float(rem) <= 0.0:
+                    # the budget died BETWEEN journal write and replay
+                    # (the respawn window ate it): skip with an expired
+                    # count — submitting would only bounce off the
+                    # fast-fail and mislabel the skip as a replay error
+                    with self._lock:
+                        self._counters["expired"] += 1
+                    logger.warning(
+                        "journal replay skipped req %s: deadline expired "
+                        "between journal write and replay", e.get("req_id"),
+                    )
+                    continue
                 deadline = _time.monotonic() + max(0.0, float(rem)) / 1000.0
             want_stream = (
                 bool(e.get("stream_tokens"))
@@ -4389,6 +4890,11 @@ class PagedEngine:
         self._lengths = np.array(lengths_out)  # copy: jax views are read-only
         chunk_wall = _time.perf_counter() - t_chunk
         self._profile_after_chunk()
+        # poison-stream quarantine BEFORE harvest: a lane whose served
+        # logits went non-finite must not deliver this chunk's tokens
+        # (they were computed alongside the poison) — it retires with
+        # 500 NUMERIC_POISON while its wave-mates harvest normally
+        self._quarantine_poisoned(runnable_now)
 
         with self._lock:
             self._counters["chunks"] += 1
@@ -4397,6 +4903,8 @@ class PagedEngine:
             chunk_tokens = 0
             t_now = _time.time()
             for stream in decoding:
+                if stream.error is not None:
+                    continue  # quarantined by the NaN screen pre-harvest
                 s = stream.slot
                 if stalled[s]:
                     continue
@@ -5008,20 +5516,18 @@ class StreamingLM(TPUComponent):
             _knobs.raw("SELDON_TPU_DRAIN_JOURNAL", "")
         if self.engine is None:
             return []
-        self._draining = True
-        self._stop = True
-        self._wake.set()
-        if self._loop_thread is not None and self._loop_thread.is_alive():
-            # the loop finishes its in-flight chunk then exits — drain
-            # must never serialize state a device call is still mutating
-            self._loop_thread.join(timeout=timeout_s)
-            if self._loop_thread.is_alive():
-                logger.error(
-                    "decode loop still running after %.0fs drain wait — "
-                    "journaling anyway (chunk results for this wave may "
-                    "be lost, re-derivation covers them)", timeout_s,
-                )
-        entries = self.engine.drain()
+        self._quiesce_loop(timeout_s)
+        # SIGTERM-with-evacuation (r17): with a peer endpoint
+        # configured, live mid-decode streams migrate THERE first —
+        # their KV pages, cursors and RNG state resume on the peer at
+        # the exact next token instead of re-deriving from scratch.
+        # Export or ship failures fall back to ordinary journal
+        # entries, so the journal remains the safety net it was in r12.
+        entries: List[Dict[str, Any]] = []
+        peer = _knobs.raw("SELDON_TPU_EVACUATE_TO", "") or ""
+        if peer:
+            entries.extend(self._evacuate_remote(peer))
+        entries.extend(self.engine.drain())
         if path and entries:
             try:
                 import json as _json
@@ -5037,6 +5543,160 @@ class StreamingLM(TPUComponent):
             except OSError:
                 logger.exception("drain journal write failed (%s)", path)
         return entries
+
+    def _quiesce_loop(self, timeout_s: float = 30.0) -> None:
+        """Stop the decode loop at the next chunk boundary (drain and
+        evacuation both require no chunk in flight — neither may
+        serialize state a device call is still mutating)."""
+        self._draining = True
+        self._stop = True
+        self._wake.set()
+        if self._loop_thread is not None and self._loop_thread.is_alive():
+            self._loop_thread.join(timeout=timeout_s)
+            if self._loop_thread.is_alive():
+                logger.error(
+                    "decode loop still running after %.0fs drain wait — "
+                    "journaling anyway (chunk results for this wave may "
+                    "be lost, re-derivation covers them)", timeout_s,
+                )
+
+    def evacuate(
+        self,
+        peers: Sequence[Any],
+        journal_path: Optional[str] = None,
+        timeout_s: float = 30.0,
+    ) -> Dict[str, Any]:
+        """In-process live evacuation (r17): quiesce the decode loop,
+        live-migrate every exportable stream to a healthy peer
+        (priority-ordered, priced by the PR 13 cost model —
+        models/disagg.evacuate_streams), journal the rest, and close
+        this engine.  ``peers`` are :class:`PagedEngine`s or components
+        exposing ``.engine``.  Streaming consumers keep their token
+        queues across the move — zero token loss."""
+        if self.engine is None:
+            return {"migrated": 0, "journaled": 0, "failed": 0}
+        from seldon_core_tpu.models.disagg import evacuate_streams
+
+        self._quiesce_loop(timeout_s)
+        engines = [getattr(p, "engine", None) or p for p in peers]
+        summary = evacuate_streams(self.engine, engines)
+        for p in peers:
+            wake = getattr(p, "_wake", None)
+            if wake is not None:
+                wake.set()  # adopted streams resume without the 0.5s poll
+        entries = list(summary.pop("journal", []))
+        entries.extend(self.engine.drain())
+        path = journal_path if journal_path is not None else \
+            _knobs.raw("SELDON_TPU_DRAIN_JOURNAL", "")
+        if path and entries:
+            try:
+                import json as _json
+                import os as _os
+
+                tmp = f"{path}.tmp"
+                with open(tmp, "w") as f:
+                    for e in entries:
+                        f.write(_json.dumps(e) + "\n")
+                _os.replace(tmp, path)
+            except OSError:
+                logger.exception("evacuation journal write failed (%s)", path)
+        summary["journaled"] = len(entries)
+        logger.info(
+            "evacuation: %d stream(s) live-migrated, %d journaled, "
+            "%d failed", summary.get("migrated", 0), len(entries),
+            summary.get("failed", 0),
+        )
+        return summary
+
+    def _evacuate_remote(self, endpoint: str) -> List[Dict[str, Any]]:
+        """Ship this engine's exportable streams to ``endpoint`` as SRT1
+        migration containers (the DCN lane: one transport-client call
+        per stream, metered as ``method="migrate"`` hops).  Returns
+        journal entries for every stream that could NOT be shipped;
+        shipped streams' local waiters resolve 503 ``MIGRATING`` (their
+        state lives on the peer now — upstream retries land there).
+
+        Semantics of the DCN lane, honestly: the zero-token-loss
+        guarantee belongs to the IN-PROCESS adoption lane (the consumer
+        keeps its token queue).  Across processes the original
+        consumer's connection dies with this process; what shipping the
+        KV buys is (a) the stream completes on the peer instead of
+        being lost, and (b) its prompt's prefix pages register into the
+        peer's cache at import — a caller retry against the peer
+        re-prefills only the suffix instead of paying the full prompt
+        FLOPs a journal replay would."""
+        import asyncio
+        import time as _time
+
+        from seldon_core_tpu.codec.bufview import pack_kv_migration
+        from seldon_core_tpu.engine.graph import Endpoint, UnitSpec
+        from seldon_core_tpu.engine.transport import (
+            GrpcClient,
+            RestClient,
+            migration_hop,
+        )
+        from seldon_core_tpu.models.disagg import migration_journal_entry
+        from seldon_core_tpu.runtime.message import InternalMessage
+
+        exported = self.engine.migrate_export()
+        if not exported:
+            return []
+        scheme, sep, rest = endpoint.partition("://")
+        if not sep:
+            scheme, rest = "grpc", endpoint
+        host, _, port = rest.partition(":")
+        spec = UnitSpec(
+            name=f"evacuate@{rest}",
+            endpoint=Endpoint(
+                host=host or "localhost", port=int(port or 9000),
+                transport="REST" if scheme == "rest" else "GRPC",
+            ),
+        )
+        client = RestClient(spec) if scheme == "rest" else GrpcClient(spec)
+        loop = asyncio.new_event_loop()
+        fallback: List[Dict[str, Any]] = []
+        migrated = 0
+        err = MicroserviceError(
+            "stream live-migrated to a peer engine during evacuation",
+            status_code=503, reason="MIGRATING",
+        )
+        try:
+            # priority-ordered: the most important streams get the
+            # evacuation window's budget first
+            for payload, stream in sorted(
+                exported, key=lambda ps: -ps[0]["priority"]
+            ):
+                try:
+                    buf = pack_kv_migration(payload)
+                    with migration_hop("streaminglm-evacuate", "dcn") as hop:
+                        if hop is not None:
+                            hop.request_bytes = len(buf)
+                        msg = InternalMessage(
+                            payload=np.frombuffer(buf, np.uint8)[None, :]
+                        )
+                        msg.meta.tags["kv_migration"] = 1
+                        loop.run_until_complete(client.transform_input(msg))
+                    migrated += 1
+                except Exception:  # noqa: BLE001 — ship failure falls back
+                    # to the journal; evacuation must not lose the recipe
+                    logger.exception(
+                        "migration ship failed for req %s — journaling",
+                        payload.get("req_id"),
+                    )
+                    fallback.append(migration_journal_entry(payload))
+                self.engine.fail_stream(stream, err)
+        finally:
+            try:
+                loop.run_until_complete(client.close())
+            except Exception:  # noqa: BLE001 — client teardown is
+                # best-effort during process exit
+                pass
+            loop.close()
+        logger.info(
+            "remote evacuation to %s: %d migrated, %d journaled",
+            endpoint, migrated, len(fallback),
+        )
+        return fallback
 
     def _register_adapters(self):
         """Register the deployment's adapter catalogue in the process
@@ -5173,11 +5833,42 @@ class StreamingLM(TPUComponent):
             )
         return priority, deadline
 
+    def _accept_migration(self, X) -> np.ndarray:
+        """Migration ingress (r17): a peer evacuating its streams POSTs
+        each one as a uint8 SRT1 migration container (CRC-checked,
+        ``transport.corrupt`` chaos applies); the stream resumes
+        decoding HERE at the exact next token.  Returns a 1x1 ack row
+        carrying the resumed stream's req id — the sender only needs
+        the admission to have succeeded (the original consumers retry
+        against this replica through the normal routing layer)."""
+        from seldon_core_tpu.codec.bufview import unpack_kv_migration
+        from seldon_core_tpu.engine.transport import migration_hop
+
+        buf = np.ascontiguousarray(
+            np.asarray(X, np.uint8).reshape(-1)
+        ).tobytes()
+        buf = _faults.corrupt_bytes("transport.corrupt", buf)
+        with migration_hop("streaminglm-ingress", "dcn") as hop:
+            if hop is not None:
+                hop.request_bytes = len(buf)
+            try:
+                payload = unpack_kv_migration(buf)
+            except Exception as exc:
+                raise MicroserviceError(
+                    f"malformed migration container: {exc}",
+                    status_code=400, reason="BAD_MIGRATION_PAYLOAD",
+                ) from exc
+            stream = self.engine.migrate_import(payload, stream_tokens=False)
+        self._wake.set()
+        return np.asarray([[stream.req_id]], np.int32)
+
     def predict(self, X, names, meta=None):
         if self.engine is None:
             self.load()  # idempotent + internally locked
         meta = meta or {}
         tags = meta.get("tags", {})
+        if tags.get("kv_migration"):
+            return self._accept_migration(X)
         max_new = int(tags.get("max_new_tokens", self.max_new_tokens))
         temperature = float(tags.get("temperature", self.temperature))
         top_k = int(tags.get("top_k", self.top_k))

@@ -137,8 +137,9 @@ def _load(so: str) -> Optional[ctypes.CDLL]:
             ctypes.POINTER(ctypes.c_char_p), ctypes.POINTER(ctypes.c_int64), ctypes.c_int64,
             ctypes.c_int64, ctypes.c_int64, ctypes.c_char_p,
         ]
-        # v3 added the srt1_* framing-agreement surface (zero-copy lane)
-        if lib.native_abi_version() != 3:  # not assert: must survive python -O
+        # v3 added the srt1_* framing-agreement surface (zero-copy
+        # lane); v4 the CRC32C integrity-trailer twins
+        if lib.native_abi_version() != 4:  # not assert: must survive python -O
             raise RuntimeError(
                 "stale libseldon_tpu_native.so (ABI mismatch): rebuild with `make -C native`"
             )
@@ -150,6 +151,14 @@ def _load(so: str) -> Optional[ctypes.CDLL]:
         lib.srt1_payload_bytes.restype = ctypes.c_int64
         lib.srt1_payload_bytes.argtypes = [
             ctypes.POINTER(ctypes.c_uint8), ctypes.c_int64,
+        ]
+        lib.srt1_crc_magic.restype = ctypes.c_uint32
+        lib.srt1_crc32c.restype = ctypes.c_uint32
+        # c_char_p: python bytes pass by POINTER (no staging copy) —
+        # the checksum runs twice per multi-MB KV container during
+        # evacuation, exactly when time and memory are tightest
+        lib.srt1_crc32c.argtypes = [
+            ctypes.c_char_p, ctypes.c_int64, ctypes.c_uint32,
         ]
         logger.info("native data-plane core loaded from %s", so)
         return lib

@@ -201,6 +201,53 @@ ENV_KNOBS: Dict[str, Knob] = _knobs(
          "HBM budget for the process weight registry's named weight "
          "sets (base models + LoRA adapters; 0 = unbudgeted loads)",
          "architecture.md §5b-quinquies"),
+    Knob("SELDON_TPU_KV_CHECKSUM", "flag", "1", True,
+         "CRC32C integrity trailer on KV handoff/migration containers "
+         "(0 = off; default on — a flipped DCN byte rejects as a named "
+         "PayloadError instead of decoding as garbage KV)",
+         "architecture.md §5b-sexies"),
+    Knob("SELDON_TPU_NAN_GUARD", "flag", "1", True,
+         "post-chunk NaN/Inf screen on served logits: a non-finite lane "
+         "retires ONLY its stream with 500 NUMERIC_POISON (0 = off; "
+         "decode lane only — speculative verify emits argmax ids, its "
+         "logits never reach the host)",
+         "operations.md evacuation-runbook"),
+    Knob("SELDON_TPU_WATCHDOG", "flag", "1", True,
+         "device-health watchdog driving the engine health state "
+         "machine healthy -> degraded -> evacuating (0 = off)",
+         "operations.md evacuation-runbook"),
+    Knob("SELDON_TPU_WATCHDOG_CHUNK_MS", "float", "0", True,
+         "chunk-wall-time ceiling (ms) the watchdog counts breaches "
+         "against; compile waves are exempt (0 = ceiling off)",
+         "operations.md evacuation-runbook"),
+    Knob("SELDON_TPU_WATCHDOG_FAULT_RATE", "float", "0.5", False,
+         "chunk-fault fraction of the watchdog window that degrades "
+         "the engine",
+         "operations.md evacuation-runbook"),
+    Knob("SELDON_TPU_WATCHDOG_COMPILES", "int", "0", True,
+         "jit-compile storm threshold per watchdog window under "
+         "traffic (0 = off; first-chunk cold compiles never count)",
+         "operations.md evacuation-runbook"),
+    Knob("SELDON_TPU_WATCHDOG_HBM_PCT", "float", "0", True,
+         "pool-page occupancy percentage counted as allocator "
+         "pressure by the watchdog (0 = off)",
+         "operations.md evacuation-runbook"),
+    Knob("SELDON_TPU_WATCHDOG_WINDOW", "int", "32", False,
+         "watchdog sliding-window length in engine waves",
+         "operations.md evacuation-runbook"),
+    Knob("SELDON_TPU_WATCHDOG_BREACHES", "int", "8", False,
+         "window breaches that drive healthy -> degraded (a clean "
+         "window recovers degraded -> healthy)",
+         "operations.md evacuation-runbook"),
+    Knob("SELDON_TPU_FORCE_EVACUATE", "flag", "0", False,
+         "force the engine health state to 'evacuating' (operator "
+         "forced-migration switch; 1 = on)",
+         "operations.md evacuation-runbook"),
+    Knob("SELDON_TPU_EVACUATE_TO", "str", "", False,
+         "peer endpoint ('grpc://host:port' | 'rest://host:port') that "
+         "drain() live-migrates streams to before exiting; failures "
+         "fall back to the drain journal (empty = journal only)",
+         "operations.md evacuation-runbook"),
     Knob("SELDON_TPU_JIT_SENTINEL", "flag", "1", True,
          "XLA recompile sentinel on engine jit entry points (0 = off)",
          "architecture.md §5c"),

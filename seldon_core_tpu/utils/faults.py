@@ -17,7 +17,8 @@ Spec grammar (``SELDON_TPU_FAULT`` or :func:`configure`)::
 Parameters per point: ``times`` (how many firings before the point
 disarms; default 1; ``times=inf`` never disarms), ``prob`` (firing
 probability per evaluation, default 1.0), ``ms`` (delay milliseconds,
-for delay-style points).
+for delay-style points), ``k`` (byte/lane count for corruption-style
+points, default 1).
 
 Registered injection points:
 
@@ -37,6 +38,15 @@ Registered injection points:
   a drop or deadline fault at its own times/prob budget: a straggler
   is latency without an error, and sharing ``transport.delay``'s one
   budget would make the two scenarios indistinguishable.
+* ``paged.nan`` — NaN is injected into ONE runnable lane's served
+  logits after a DECODE chunk: exercises the poison-stream quarantine
+  (the NaN guard must retire only that stream with 500 NUMERIC_POISON
+  while its wave-mates stay bit-identical).  Decode lane only: the
+  speculative verify program emits argmax token ids — its logits never
+  reach the host, so neither the screen nor this point applies there.
+* ``transport.corrupt`` — ``k`` bytes of a KV handoff/migration
+  container are flipped before unpack (:func:`corrupt_bytes`):
+  exercises the CRC32C integrity trailer's named rejection.
 
 Everything is a no-op (one module-level bool read) when no fault is
 configured — serving never pays for the harness.
@@ -57,9 +67,11 @@ ENV_VAR = "SELDON_TPU_FAULT"
 KNOWN_POINTS = (
     "paged.alloc",
     "paged.chunk",
+    "paged.nan",
     "transport.delay",
     "transport.drop",
     "transport.slow",
+    "transport.corrupt",
 )
 
 
@@ -86,14 +98,15 @@ class InjectedFault(ConnectionError):
 
 
 class _Fault:
-    __slots__ = ("point", "times", "prob", "delay_ms", "fired")
+    __slots__ = ("point", "times", "prob", "delay_ms", "k", "fired")
 
     def __init__(self, point: str, times: float = 1, prob: float = 1.0,
-                 delay_ms: float = 0.0):
+                 delay_ms: float = 0.0, k: int = 1):
         self.point = point
         self.times = times  # remaining firings (float to admit inf)
         self.prob = float(prob)
         self.delay_ms = float(delay_ms)
+        self.k = int(k)  # corruption-style points: bytes/lanes touched
         self.fired = 0
 
 
@@ -136,7 +149,7 @@ def _parse(spec: str) -> Dict[str, _Fault]:
             if not sep or not v:
                 raise ValueError(
                     f"malformed fault parameter {kv!r} for point "
-                    f"{point!r}: expected k=v (supported: times, prob, ms)"
+                    f"{point!r}: expected k=v (supported: times, prob, ms, k)"
                 )
             try:
                 if k == "times":
@@ -147,10 +160,12 @@ def _parse(spec: str) -> Dict[str, _Fault]:
                     kwargs["prob"] = float(v)
                 elif k == "ms":
                     kwargs["delay_ms"] = float(v)
+                elif k == "k":
+                    kwargs["k"] = int(v)
                 else:
                     raise ValueError(
                         f"unknown fault parameter {k!r} for point {point!r} "
-                        "(supported: times, prob, ms)"
+                        "(supported: times, prob, ms, k)"
                     )
             except ValueError as e:
                 if "fault parameter" in str(e):
@@ -165,6 +180,8 @@ def _parse(spec: str) -> Dict[str, _Fault]:
             raise ValueError(f"fault point {point!r}: prob must be in [0, 1]")
         if kwargs.get("delay_ms", 0.0) < 0:
             raise ValueError(f"fault point {point!r}: ms must be >= 0")
+        if kwargs.get("k", 1) < 1:
+            raise ValueError(f"fault point {point!r}: k must be >= 1")
         out[point] = _Fault(point, **kwargs)
     return out
 
@@ -194,13 +211,14 @@ def configure(spec: Optional[str] = None) -> None:
 
 
 def inject(point: str, times: float = 1, prob: float = 1.0,
-           delay_ms: float = 0.0) -> None:
+           delay_ms: float = 0.0, k: int = 1) -> None:
     """Arm one point programmatically (the test API)."""
     global _enabled
     if point not in KNOWN_POINTS:
         raise ValueError(f"unknown fault point {point!r}")
     with _lock:
-        _faults[point] = _Fault(point, times=times, prob=prob, delay_ms=delay_ms)
+        _faults[point] = _Fault(point, times=times, prob=prob,
+                                delay_ms=delay_ms, k=k)
         _enabled = True
 
 
@@ -249,6 +267,40 @@ def delay_s(point: str) -> float:
         f.fired += 1
         _fired_total[point] = _fired_total.get(point, 0) + 1
         return f.delay_ms / 1000.0
+
+
+def fire_k(point: str) -> int:
+    """``point``'s ``k`` budget when it fires NOW (decrementing its
+    times budget), else 0 — the corruption-style twin of :func:`fire`."""
+    if not _enabled:
+        return 0
+    with _lock:
+        f = _faults.get(point)
+        if f is None or f.times <= 0:
+            return 0
+        if f.prob < 1.0 and random.random() >= f.prob:
+            return 0
+        f.times -= 1
+        f.fired += 1
+        _fired_total[point] = _fired_total.get(point, 0) + 1
+        return max(1, f.k)
+
+
+def corrupt_bytes(point: str, data: bytes) -> bytes:
+    """Flip ``k`` random bytes of ``data`` when ``point`` fires (the
+    ``transport.corrupt`` chaos: a DCN bit-flip on a KV container must
+    reject as a named PayloadError, never scatter as garbage KV).
+    Returns ``data`` unchanged when the point is disarmed."""
+    k = fire_k(point)
+    if not k or not data:
+        return data
+    out = bytearray(data)
+    for _ in range(min(k, len(out))):
+        i = random.randrange(len(out))
+        out[i] ^= 0xFF
+    logger.warning("injected %s: flipped %d byte(s) of a %d-byte payload",
+                   point, min(k, len(out)), len(out))
+    return bytes(out)
 
 
 def enabled() -> bool:

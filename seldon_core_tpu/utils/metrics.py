@@ -327,6 +327,22 @@ ENGINE_STATS_METRICS: Dict[str, Tuple[str, str, str]] = {
     "replayed": ("counter", "seldon_tpu_engine_replayed_total",
                  "journaled streams re-submitted into this engine "
                  "(the restore half of drain/handoff)"),
+    # live migration + poison quarantine (r17): watchdog-driven
+    # failover observability — an evacuating engine's streams move to
+    # peers WITHOUT losing a token, and numerically-poisoned streams
+    # retire alone instead of killing their wave
+    "migrated_out": ("counter", "seldon_tpu_engine_migrated_out_total",
+                     "mid-decode streams live-exported to a peer engine "
+                     "(KV pages + cursors + RNG state)"),
+    "migrated_in": ("counter", "seldon_tpu_engine_migrated_in_total",
+                    "migrated streams imported and resumed at the exact "
+                    "next token on this engine"),
+    "quarantined": ("counter", "seldon_tpu_engine_quarantined_total",
+                    "streams retired by the post-chunk NaN/Inf screen "
+                    "(500 NUMERIC_POISON, wave-mates unaffected)"),
+    "watchdog_trips": ("counter", "seldon_tpu_engine_watchdog_trips_total",
+                       "healthy -> degraded transitions of the device-"
+                       "health watchdog"),
     # SLO lifecycle (r10): the overload/degradation observability —
     # GoodputCollapse alerts and the generation dashboard's SLO panel
     # read these
@@ -373,6 +389,9 @@ ENGINE_STATS_METRICS: Dict[str, Tuple[str, str, str]] = {
     "adapter_slots": ("gauge", "seldon_tpu_engine_adapter_slots",
                       "adapter slots the factor pool was built with "
                       "(0 = multi-LoRA off)"),
+    "health_state": ("gauge", "seldon_tpu_engine_health_state",
+                     "device-health watchdog state (0 = healthy, "
+                     "1 = degraded, 2 = evacuating)"),
 }
 
 # keys intentionally NOT exported as their own series: the wall-clock
@@ -385,8 +404,10 @@ ENGINE_STATS_METRICS: Dict[str, Tuple[str, str, str]] = {
 # bridge exports itself as
 # seldon_tpu_engine_adapter_requests_total{adapter=...} (per-adapter
 # labels the flat mapping can't carry)
+# "health" is the state STRING twin of the health_state gauge — the
+# debug surfaces read it, prometheus reads the numeric code
 ENGINE_STATS_EXCLUDED = {"chunk_wall_s", "prefill_wall_s", "jit_compiles",
-                         "adapter_requests"}
+                         "adapter_requests", "health"}
 
 ADAPTER_REQUESTS_METRIC = "seldon_tpu_engine_adapter_requests_total"
 

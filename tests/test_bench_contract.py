@@ -227,6 +227,18 @@ def _r3_like_full_result():
                 },
                 "mix": "48 unary requests round-robined over 2 remote "
                        "StreamingLM workers; worker 0 SIGKILLed at request 16",
+                "migrate_ttr_ms": 42.5,
+                "migrate_token_loss": 0,
+                "migration": {
+                    "migrate_ttr_ms": 42.5,
+                    "migrate_token_loss": 0,
+                    "replay_ttr_ms": 161.0,
+                    "migrated": 8,
+                    "replayed": 8,
+                    "streams": 8,
+                    "max_new_tokens": 24,
+                    "mix": "8 streaming requests evacuated after 3 waves",
+                },
             },
             "lint": {
                 "violations": 0,
@@ -454,6 +466,23 @@ def test_compact_line_carries_chaos_story(bench):
     assert "hedges_fired" not in e
     assert "dead_endpoint_breaker" not in e
     assert "mix" not in e
+
+
+def test_compact_line_carries_migration_story(bench):
+    """r17 certification keys: the live-migration arm's time-to-resume
+    on the peer (float ms) and the zero-token-loss gate (int, MUST be
+    0); the journal-replay contrast and raw counts stay in
+    bench_full.json chaos.migration."""
+    compact = bench._compact_result(_r3_like_full_result())
+    e = compact["extra"]
+    assert isinstance(e["migrate_ttr_ms"], float)
+    assert e["migrate_ttr_ms"] == 42.5
+    assert isinstance(e["migrate_token_loss"], int)
+    assert e["migrate_token_loss"] == 0
+    # the full migration blob (replay contrast, counts, mix) is
+    # full-blob-only
+    assert "replay_ttr_ms" not in e
+    assert "migration" not in e
 
 
 def test_compact_line_carries_zero_copy_story(bench):

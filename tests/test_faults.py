@@ -532,3 +532,143 @@ class TestConcurrentChaos:
         fired = faults.stats()
         assert fired.get("paged.alloc", 0) >= 1
         assert fired.get("paged.chunk", 0) >= 1
+
+
+# ---------------------------------------------------------------------------
+# r17 fault points: paged.nan (poison-stream quarantine) and
+# transport.corrupt (KV-container byte flips)
+# ---------------------------------------------------------------------------
+
+
+class TestR17Grammar:
+    """Strict-grammar negative tests for the new points — same
+    discipline as the PR 9 suite: every malformation errors LOUDLY."""
+
+    def test_new_points_parse_with_defaults(self):
+        faults.configure("paged.nan;transport.corrupt:k=3,times=2")
+        assert faults.fire("paged.nan")
+        assert not faults.fire("paged.nan")
+        assert faults.fire_k("transport.corrupt") == 3
+        assert faults.fire_k("transport.corrupt") == 3
+        assert faults.fire_k("transport.corrupt") == 0  # budget spent
+
+    def test_k_defaults_to_one(self):
+        faults.configure("transport.corrupt")
+        assert faults.fire_k("transport.corrupt") == 1
+
+    def test_bad_k_value_rejected(self):
+        with pytest.raises(ValueError, match=r"bad value.*'k=many'"):
+            faults.configure("transport.corrupt:k=many")
+        with pytest.raises(ValueError, match="k must be >= 1"):
+            faults.configure("transport.corrupt:k=0")
+        with pytest.raises(ValueError, match="k must be >= 1"):
+            faults.configure("transport.corrupt:k=-4")
+        assert not faults.enabled()  # nothing half-armed
+
+    def test_unknown_param_on_new_points_rejected(self):
+        with pytest.raises(ValueError, match="unknown fault parameter"):
+            faults.configure("paged.nan:bytes=1")
+
+    def test_new_points_listed_in_unknown_point_error(self):
+        with pytest.raises(ValueError) as e:
+            faults.configure("paged.everything")
+        assert "paged.nan" in str(e.value)
+        assert "transport.corrupt" in str(e.value)
+
+    def test_corrupt_bytes_noop_when_disarmed(self):
+        data = bytes(range(64))
+        assert faults.corrupt_bytes("transport.corrupt", data) == data
+
+    def test_corrupt_bytes_flips_when_armed(self):
+        faults.inject("transport.corrupt", times=1, k=2)
+        data = bytes(64)
+        out = faults.corrupt_bytes("transport.corrupt", data)
+        assert out != data and len(out) == len(data)
+        # budget spent: second call passes through untouched
+        assert faults.corrupt_bytes("transport.corrupt", data) == data
+
+
+class TestNanQuarantine:
+    def test_injected_nan_quarantines_one_stream_wave_mates_bit_identical(
+        self, params
+    ):
+        prompts = [np.arange(12) + i for i in range(3)]
+        ref = _engine(params, max_slots=4)
+        expect = [
+            ref.generate(p, max_new_tokens=10, seed=i)
+            for i, p in enumerate(prompts)
+        ]
+        eng = _engine(params, max_slots=4)
+        streams = [
+            eng.submit(p, max_new_tokens=10, seed=i)
+            for i, p in enumerate(prompts)
+        ]
+        eng.step()  # prefill + first chunk, no fault
+        fired_before = faults.stats().get("paged.nan", 0)
+        faults.inject("paged.nan", times=1)
+        eng.run()
+        poisoned = [s for s in streams if s.error is not None]
+        assert len(poisoned) == 1
+        err = poisoned[0].error
+        assert isinstance(err, MicroserviceError)
+        assert err.status_code == 500
+        assert err.reason == "NUMERIC_POISON"
+        assert eng.engine_stats()["quarantined"] == 1
+        assert faults.stats().get("paged.nan", 0) == fired_before + 1
+        # the wave-mates' outputs are bit-identical to the no-fault run
+        for s in streams:
+            if s.error is None:
+                i = streams.index(s)
+                np.testing.assert_array_equal(s.result, expect[i])
+        # the engine keeps serving bit-exact afterwards (never fail_all)
+        got = eng.generate(np.arange(12), max_new_tokens=10, seed=0)
+        np.testing.assert_array_equal(got, expect[0])
+
+    def test_nan_guard_off_skips_screen(self, params, monkeypatch):
+        monkeypatch.setenv("SELDON_TPU_NAN_GUARD", "0")
+        eng = _engine(params, max_slots=2)
+        s = eng.submit(np.arange(12), max_new_tokens=8)
+        faults.inject("paged.nan", times=1)
+        eng.run()
+        # guard off: the injected NaN lane is NOT retired — the stream
+        # completes (with whatever the poisoned argmax produced); the
+        # quarantine counter stays 0.  This is exactly the silent-
+        # garbage failure mode the default-on guard exists to close.
+        assert s.error is None
+        assert eng.engine_stats()["quarantined"] == 0
+
+    def test_quarantined_stream_drops_poisoned_chunk_tokens(self, params):
+        eng = _engine(params, max_slots=2)
+        s = eng.submit(np.arange(12), max_new_tokens=16, stream_tokens=True)
+        eng.step()  # wave 1: prefill + chunk
+        pushed_before = s.streamed
+        faults.inject("paged.nan", times=1)
+        eng.step()  # wave 2: poisoned chunk — tokens must NOT stream
+        assert s.error is not None and s.error.reason == "NUMERIC_POISON"
+        assert s.streamed == pushed_before
+        # consumer unblocks via the end-of-stream sentinel
+        items = []
+        while s.token_queue.qsize():
+            items.append(s.token_queue.get())
+        assert items[-1] is None
+
+
+class TestTransportCorrupt:
+    def test_corrupt_handoff_rejects_with_named_error(self, params):
+        from seldon_core_tpu.codec.bufview import (
+            pack_kv_handoff,
+            unpack_kv_handoff,
+        )
+        from seldon_core_tpu.codec.tensor import PayloadError
+
+        eng = _engine(params)
+        payload = eng.prefill_export(np.arange(20), seed=3)
+        buf = pack_kv_handoff(payload)
+        faults.inject("transport.corrupt", times=1, k=1)
+        bad = faults.corrupt_bytes("transport.corrupt", buf)
+        assert bad != buf
+        with pytest.raises(PayloadError):
+            unpack_kv_handoff(bad)
+        # the pristine container still decodes
+        out = unpack_kv_handoff(buf)
+        np.testing.assert_array_equal(out["prompt"], payload["prompt"])

@@ -951,7 +951,10 @@ class TestWorkerExhaustion:
                 await client.close()
 
         out = _run(scenario())
-        assert out == {"workers": {}, "exhausted": []}
+        # r17 added the engine-health keys; a supervisor-less gateway
+        # with no paged engines serves all-empty
+        assert out == {"workers": {}, "engines": {}, "degraded": [],
+                       "exhausted": []}
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,280 @@
+"""Device-health watchdog (r17): the healthy -> degraded -> evacuating
+state machine, the compile false-positive guard, engine wiring
+(engine_stats / bridge gauge / fault-rate feed), forced migration, and
+the /debug/workers health surface.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from seldon_core_tpu.models.paged import PagedEngine
+from seldon_core_tpu.models.transformer import TransformerLM
+from seldon_core_tpu.utils import faults
+from seldon_core_tpu.utils.watchdog import (
+    DEGRADED,
+    EVACUATING,
+    HEALTHY,
+    STATE_CODES,
+    EngineWatchdog,
+)
+
+CFG = dict(vocab_size=64, d_model=32, num_layers=1, num_heads=2, max_len=64)
+
+
+@pytest.fixture(scope="module")
+def params():
+    module = TransformerLM(dtype=jnp.float32, **CFG)
+    return module.init(jax.random.key(0), jnp.zeros((1, 8), jnp.int32))["params"]
+
+
+@pytest.fixture(autouse=True)
+def _disarm():
+    faults.clear()
+    yield
+    faults.clear()
+
+
+def _engine(params, **kw):
+    base = dict(dtype=jnp.float32, page_size=8, max_slots=2, steps_per_call=4)
+    base.update(kw)
+    return PagedEngine(params, **CFG, **base)
+
+
+# ---------------------------------------------------------------------------
+# unit: the state machine
+# ---------------------------------------------------------------------------
+
+
+class TestStateMachine:
+    def _wd(self, **kw):
+        base = dict(chunk_ms_ceiling=10.0, fault_rate=0.5, compile_storm=0,
+                    hbm_pct=0.0, window=4, breaches=2)
+        base.update(kw)
+        return EngineWatchdog(**base)
+
+    def test_starts_healthy_and_stays_on_clean_waves(self):
+        wd = self._wd()
+        for _ in range(20):
+            assert wd.observe(wall_ms=1.0) == HEALTHY
+        assert wd.trips == 0
+
+    def test_wall_breaches_degrade_then_clean_window_recovers(self):
+        wd = self._wd()
+        wd.observe(wall_ms=50.0)
+        assert wd.state == HEALTHY  # one breach < breaches threshold
+        assert wd.observe(wall_ms=50.0) == DEGRADED
+        assert wd.trips == 1
+        # clean waves push the breaches out of the window -> recovery
+        state = None
+        for _ in range(8):
+            state = wd.observe(wall_ms=1.0)
+        assert state == HEALTHY
+
+    def test_persistent_degradation_escalates_to_evacuating(self):
+        wd = self._wd()
+        state = None
+        for _ in range(12):  # window=4: >window waves spent degraded
+            state = wd.observe(wall_ms=50.0)
+        assert state == EVACUATING
+        # terminal: clean waves do NOT recover an evacuating engine
+        for _ in range(12):
+            assert wd.observe(wall_ms=1.0) == EVACUATING
+
+    def test_compile_waves_exempt_from_wall_ceiling(self):
+        """The false-positive guard: a wave that paid an XLA compile is
+        judged by the compile-storm signal only — seconds of cold-start
+        compilation must not read as device sickness."""
+        wd = self._wd()
+        for _ in range(20):
+            assert wd.observe(wall_ms=5000.0, compiled=True) == HEALTHY
+        assert wd.trips == 0
+
+    def test_compile_storm_signal_fires_only_above_threshold(self):
+        wd = self._wd(compile_storm=3, chunk_ms_ceiling=0.0)
+        assert wd.observe(wall_ms=1.0, compiled=True, compiles_delta=1) == HEALTHY
+        assert wd.observe(wall_ms=1.0, compiled=True, compiles_delta=1) == HEALTHY
+        assert wd.observe(wall_ms=1.0, compiled=True, compiles_delta=1) == DEGRADED
+
+    def test_fault_rate_degrades(self):
+        wd = self._wd(chunk_ms_ceiling=0.0, fault_rate=0.5)
+        states = [wd.observe(wall_ms=1.0, fault=True) for _ in range(4)]
+        assert states[-1] == DEGRADED
+
+    def test_hbm_pressure_degrades(self):
+        wd = self._wd(chunk_ms_ceiling=0.0, hbm_pct=90.0)
+        wd.observe(wall_ms=1.0, pool_used_pct=95.0)
+        assert wd.observe(wall_ms=1.0, pool_used_pct=95.0) == DEGRADED
+
+    def test_forced_evacuation_via_knob(self, monkeypatch):
+        wd = self._wd()
+        assert wd.observe(wall_ms=1.0) == HEALTHY
+        monkeypatch.setenv("SELDON_TPU_FORCE_EVACUATE", "1")
+        assert wd.observe(wall_ms=1.0) == EVACUATING
+
+    def test_clearing_force_knob_recovers_forced_engine(self, monkeypatch):
+        """A FORCED evacuation is clearable: dropping the knob steps the
+        engine back to degraded and a clean window recovers it — only
+        organically-evacuating engines are terminal until respawn."""
+        wd = self._wd()
+        monkeypatch.setenv("SELDON_TPU_FORCE_EVACUATE", "1")
+        assert wd.observe(wall_ms=1.0) == EVACUATING
+        monkeypatch.delenv("SELDON_TPU_FORCE_EVACUATE")
+        state = None
+        for _ in range(8):
+            state = wd.observe(wall_ms=1.0)
+        assert state == HEALTHY
+
+    def test_organic_evacuation_not_cleared_by_force_knob_churn(
+        self, monkeypatch
+    ):
+        wd = self._wd()
+        for _ in range(12):
+            wd.observe(wall_ms=50.0)
+        assert wd.state == EVACUATING  # organic: persisted degradation
+        monkeypatch.setenv("SELDON_TPU_FORCE_EVACUATE", "1")
+        wd.observe(wall_ms=1.0)
+        monkeypatch.delenv("SELDON_TPU_FORCE_EVACUATE")
+        # force-knob churn on an engine that was ALREADY organically
+        # evacuating must not resurrect it
+        assert wd.observe(wall_ms=1.0) == EVACUATING
+
+    def test_stats_payload_carries_signals_and_thresholds(self):
+        wd = self._wd()
+        wd.observe(wall_ms=50.0)
+        s = wd.stats()
+        assert s["state"] == HEALTHY
+        assert s["state_code"] == STATE_CODES[HEALTHY]
+        assert s["wall_breaches"] == 1
+        assert s["thresholds"]["window"] == 4
+
+    def test_disabled_ceiling_never_wall_breaches(self):
+        wd = self._wd(chunk_ms_ceiling=0.0)
+        for _ in range(20):
+            assert wd.observe(wall_ms=1e9) == HEALTHY
+
+
+# ---------------------------------------------------------------------------
+# engine wiring
+# ---------------------------------------------------------------------------
+
+
+class TestEngineWiring:
+    def test_cold_engine_never_degrades_from_compilation_alone(
+        self, params, monkeypatch
+    ):
+        """The satellite guard: the first chunks of a cold engine spend
+        their wall in XLA compilation — with a ceiling far below that
+        compile time (but far above a steady-state chunk), the engine
+        must stay healthy because the jitwatch sentinel flags those
+        waves as compile waves and the watchdog exempts them."""
+        monkeypatch.setenv("SELDON_TPU_WATCHDOG_CHUNK_MS", "500")
+        monkeypatch.setenv("SELDON_TPU_WATCHDOG_BREACHES", "1")
+        monkeypatch.setenv("SELDON_TPU_WATCHDOG_WINDOW", "4")
+        eng = _engine(params)
+        s = eng.submit(np.arange(10), max_new_tokens=12)
+        eng.run()
+        assert s.result is not None
+        stats = eng.engine_stats()
+        assert stats["jit_compiles"] >= 1  # the exemption actually fired
+        assert stats["health"] == "healthy"
+        assert stats["watchdog_trips"] == 0
+
+    def test_chunk_fault_rate_degrades_engine(self, params, monkeypatch):
+        monkeypatch.setenv("SELDON_TPU_WATCHDOG_WINDOW", "4")
+        monkeypatch.setenv("SELDON_TPU_WATCHDOG_BREACHES", "2")
+        monkeypatch.setenv("SELDON_TPU_WATCHDOG_FAULT_RATE", "0.5")
+        eng = _engine(params)
+        faults.inject("paged.chunk", times=8)
+        for i in range(6):
+            st = eng.submit(np.arange(8) + i, max_new_tokens=4)
+            eng.run()
+            assert st.event.is_set()
+        stats = eng.engine_stats()
+        assert stats["chunk_faults"] >= 2
+        assert stats["health"] in ("degraded", "evacuating")
+        assert stats["health_state"] >= 1
+        assert stats["watchdog_trips"] >= 1
+
+    def test_watchdog_off_always_healthy(self, params, monkeypatch):
+        monkeypatch.setenv("SELDON_TPU_WATCHDOG", "0")
+        eng = _engine(params)
+        assert eng._watchdog is None
+        stats = eng.engine_stats()
+        assert stats["health"] == "healthy"
+        assert stats["health_state"] == 0
+
+    def test_detail_stats_carry_watchdog_payload(self, params):
+        eng = _engine(params)
+        s = eng.engine_stats(detail=True)
+        assert "watchdog" in s
+        assert s["watchdog"]["state"] == "healthy"
+
+    def test_health_state_is_bridge_mapped_gauge(self):
+        from seldon_core_tpu.utils.metrics import ENGINE_STATS_METRICS
+
+        kind, name, _doc = ENGINE_STATS_METRICS["health_state"]
+        assert kind == "gauge"
+        assert name == "seldon_tpu_engine_health_state"
+        for key in ("quarantined", "migrated_in", "migrated_out",
+                    "watchdog_trips"):
+            kind, name, _doc = ENGINE_STATS_METRICS[key]
+            assert kind == "counter" and name.endswith("_total")
+
+
+# ---------------------------------------------------------------------------
+# /debug/workers health surface
+# ---------------------------------------------------------------------------
+
+
+class TestDebugWorkers:
+    def _gateway(self, health="degraded", code=1):
+        from seldon_core_tpu.engine.graph import UnitSpec
+        from seldon_core_tpu.engine.server import Gateway, PredictorService
+        from seldon_core_tpu.runtime import TPUComponent
+
+        class FakeEngine:
+            def engine_stats(self, detail=False):
+                return {
+                    "chunks": 1, "health": health, "health_state": code,
+                    "watchdog_trips": 1, "quarantined": 2,
+                    "migrated_out": 3, "migrated_in": 0,
+                }
+
+        class GenModel(TPUComponent):
+            def __init__(self):
+                super().__init__()
+                self.engine = FakeEngine()
+
+            def predict(self, X, names, meta=None):
+                return np.asarray(X)
+
+        svc = PredictorService(
+            UnitSpec(name="lm", type="MODEL", component=GenModel()),
+            name="main",
+        )
+        return Gateway([(svc, 1.0)])
+
+    def test_debug_workers_reports_engine_health(self):
+        import asyncio
+
+        from aiohttp.test_utils import TestClient, TestServer
+
+        from seldon_core_tpu.engine.server import build_gateway_app
+
+        async def scenario():
+            client = TestClient(TestServer(build_gateway_app(self._gateway())))
+            await client.start_server()
+            out = await (await client.get("/debug/workers")).json()
+            await client.close()
+            return out
+
+        out = asyncio.run(scenario())
+        eng = out["engines"]["main/lm"]
+        assert eng["health"] == "degraded"
+        assert eng["health_state"] == 1
+        assert eng["quarantined"] == 2
+        assert eng["migrated_out"] == 3
+        assert out["degraded"] == ["main/lm"]
