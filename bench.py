@@ -179,6 +179,15 @@ COMPACT_PICKS = [
     ("paged_bimodal_tok_s", ("generation", "paged_bimodal_tokens_per_s")),
     ("paged256_tok_s", ("generation", "paged_serving256_tokens_per_s")),
     ("paged_cap_streams", ("generation", "paged_capacity", "streams")),
+    # r18 fused-kernel-lane certification: kernel-lane tok/s over the
+    # XLA gather fallback on the same 16-stream protocol (gate >= 1.5
+    # on TPU; off-TPU hosts print the literal "n/a" — interpret-mode
+    # Pallas is a correctness harness, not a timing one), and the
+    # int8-KV capacity multiple the per-page-scaled pool buys at the
+    # same HBM budget (accounting-priced; details in bench_full.json
+    # kernel_lane / paged_capacity.streams_int8_kv)
+    ("paged_kernel_x", ("generation", "kernel_lane", "paged_kernel_x")),
+    ("int8_kv_cap_x", ("generation", "paged_capacity", "int8_capacity_x")),
     # r9 prefix-cache certification: shared-system-prompt workload
     # (16 streams, one 256-token prefix, distinct suffixes) with
     # page-granular automatic prefix caching on — gate is >=1.3x the
@@ -3221,6 +3230,18 @@ def generation_phase() -> dict:
             budget, cap_ctx, donated=True,
             inflight_prefill_tokens=cap_ctx, **cap_model
         )
+        # int8-KV contrast (r18): same budget, pool-impl layout, pages
+        # at one byte per element + the per-page f32 scale pair — the
+        # ~2x capacity claim priced by the same accounting that gates
+        # admission, not asserted in prose
+        cap_int8_model = dict(cap_model, chunk_impl="pool")
+        int8_streams = paged_capacity_streams(
+            budget, cap_ctx, donated=True, kv_dtype="int8",
+            **cap_int8_model
+        )
+        bf16_pool_streams = paged_capacity_streams(
+            budget, cap_ctx, donated=True, **cap_int8_model
+        )
         result["paged_capacity"] = {
             "streams": donated,
             "ctx_len": cap_ctx,
@@ -3228,6 +3249,11 @@ def generation_phase() -> dict:
             "accounting": "donated",
             "streams_if_copied": copied,
             "streams_with_inflight_prefill": chunking,
+            "streams_int8_kv": int8_streams,
+            "streams_bf16_pool": bf16_pool_streams,
+            "int8_capacity_x": round(
+                int8_streams / max(bf16_pool_streams, 1), 2
+            ),
             "per_stream_accounting": paged_hbm_accounting(
                 streams=1, ctx_len=cap_ctx, donated=True, **cap_model
             ),
@@ -3236,6 +3262,92 @@ def generation_phase() -> dict:
         }
     except Exception as e:  # noqa: BLE001
         result["paged_capacity_error"] = str(e)[:200]
+
+    # ---- fused paged-decode kernel lane (r18, ROADMAP 1): the Pallas
+    # flash-decode kernel is now the pool-impl DEFAULT; this blob
+    # certifies the lane against the XLA gather fallback on the same
+    # 16-stream protocol and prices the int8-KV pool's bandwidth
+    # halving.  Off-TPU the kernel only runs in interpret mode (a
+    # correctness harness, not a timing one), so the rate terms print
+    # the literal "n/a" (schema-stable compact line) and only the
+    # host-arithmetic terms — HBM bytes/step at bf16 vs int8, the
+    # Mosaic grid-step count — are numeric; the compact
+    # paged_kernel_x >= 1.5 gate is a TPU-run number.
+    try:
+        from seldon_core_tpu.models.paged import (
+            PagedEngine,
+            paged_hbm_accounting,
+        )
+
+        lane_ctx = 512
+        lane_kw = dict(
+            num_layers=cfg["num_layers"], d_model=cfg["d_model"],
+            page_size=64, ctx_len=lane_ctx, streams=serve_slots,
+            chunk_impl="pool", flat_pool=False, dtype_bytes=2,
+        )
+        bf16_acct = paged_hbm_accounting(**lane_kw)
+        int8_acct = paged_hbm_accounting(kv_dtype="int8", **lane_kw)
+        lane_pages = -(-lane_ctx // 64)
+        lane: dict = {
+            # a decode step streams every mapped page once through the
+            # online-softmax loop: the at-rest pool bytes ARE the
+            # per-step HBM traffic bound the kernel is gated by
+            "hbm_bytes_per_step_bf16": bf16_acct["pool_bytes"],
+            "hbm_bytes_per_step_int8": int8_acct["pool_bytes"],
+            "hbm_bytes_x": round(
+                bf16_acct["pool_bytes"] / max(int8_acct["pool_bytes"], 1),
+                2,
+            ),
+            # stream-impl launch shape: ONE grid step per lane with a
+            # pages-deep double-buffered DMA loop inside it (the grid
+            # impl unrolls the same work as a lanes x pages grid)
+            "mosaic_grid_steps": serve_slots * lane_pages,
+        }
+        if jax.default_backend() == "tpu":
+            def lane_point(kernel_mode: str, kv_dtype: str = "bf16"):
+                env = {
+                    "SELDON_TPU_PAGED_KERNEL": kernel_mode,
+                    "SELDON_TPU_CHUNK_IMPL": "pool",
+                    "SELDON_TPU_KV_DTYPE": kv_dtype,
+                }
+                saved = {k: os.environ.get(k) for k in env}
+                os.environ.update(env)
+                try:
+                    return measure_point(
+                        PagedEngine(
+                            params, dtype=jnp.bfloat16, page_size=64,
+                            max_slots=serve_slots, steps_per_call=8,
+                            max_steps_per_call=64 if quick else 256,
+                            tp=1, **serve_cfg,
+                        ),
+                        sprompts,
+                    )
+                finally:
+                    for k, old in saved.items():
+                        if old is None:
+                            os.environ.pop(k, None)
+                        else:
+                            os.environ[k] = old
+
+            kern = lane_point("force")
+            xla = lane_point("0")
+            kern_i8 = lane_point("force", kv_dtype="int8")
+            lane["kernel_tok_s"] = round(kern["rate"], 1)
+            lane["xla_tok_s"] = round(xla["rate"], 1)
+            lane["int8_kernel_tok_s"] = round(kern_i8["rate"], 1)
+            lane["paged_kernel_x"] = round(
+                kern["rate"] / max(xla["rate"], 1e-9), 2
+            )
+            lane["int8_kernel_x"] = round(
+                kern_i8["rate"] / max(xla["rate"], 1e-9), 2
+            )
+        else:
+            for key in ("kernel_tok_s", "xla_tok_s", "int8_kernel_tok_s",
+                        "paged_kernel_x", "int8_kernel_x"):
+                lane[key] = "n/a"
+        result["kernel_lane"] = lane
+    except Exception as e:  # noqa: BLE001
+        result["kernel_lane_error"] = str(e)[:200]
     return result
 
 

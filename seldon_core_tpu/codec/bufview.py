@@ -552,6 +552,44 @@ def _split_crc_trailer(data) -> Tuple[memoryview, bool]:
 # prompt frame — no side-channel metadata to drift from the tensors.
 _KV_HANDOFF_FRAMES = ("prompt", "last_logits", "k", "v")
 
+# int8-KV containers (r18) append the sibling per-page scale tables as
+# two extra frames.  Their PRESENCE is the layout signal: a 4/7-frame
+# container is a plain-dtype pool, a 6/9-frame container is int8 pages
+# + f32 scales ``(num_layers, pages)`` for k and v.  Scales ride the
+# same CRC32C trailer as every other frame byte.
+_KV_SCALE_FRAMES = ("k_scales", "v_scales")
+
+
+def _check_kv_scales(k, sk, sv, kind: str):
+    """Shared validation for the optional int8 scale frames: int8 pages
+    REQUIRE scales, scales require int8 pages, shapes must price every
+    page ``(num_layers, pages)`` in float32."""
+    if sk is None:
+        if k.dtype == np.int8:
+            raise PayloadError(
+                f"KV {kind} carries int8 pages but no per-page scale "
+                f"frames ({', '.join(_KV_SCALE_FRAMES)})"
+            )
+        return
+    if k.dtype != np.int8:
+        raise PayloadError(
+            f"KV {kind} carries scale frames but {k.dtype.name} pages "
+            f"(scales only accompany int8 pages)"
+        )
+    for name, s in zip(_KV_SCALE_FRAMES, (sk, sv)):
+        if s.dtype != np.float32 or s.ndim != 2 or s.shape[1] != k.shape[1]:
+            raise PayloadError(
+                f"KV {kind} {name} must be float32 (num_layers, pages="
+                f"{int(k.shape[1])}), got "
+                f"{np.dtype(s.dtype).name}{tuple(s.shape)}"
+            )
+    if sk.shape != sv.shape or sk.shape[0] != k.shape[0]:
+        raise PayloadError(
+            f"KV {kind} scale tables must both be "
+            f"({int(k.shape[0])}, {int(k.shape[1])}), got "
+            f"{tuple(sk.shape)} vs {tuple(sv.shape)}"
+        )
+
 
 def pack_kv_handoff(payload: dict) -> bytes:
     """Encode a ``PagedEngine.prefill_export`` payload as one SRT1
@@ -578,11 +616,21 @@ def pack_kv_handoff(payload: dict) -> bytes:
             f"(split) page stacks, got {k.dtype}{tuple(k.shape)} vs "
             f"{v.dtype}{tuple(v.shape)}"
         )
+    scales = None
+    if any(name in payload for name in _KV_SCALE_FRAMES) or k.dtype == np.int8:
+        try:
+            scales = [np.asarray(payload[n], np.float32) for n in _KV_SCALE_FRAMES]
+        except KeyError as exc:
+            raise PayloadError(
+                f"KV handoff int8 payload is missing the {exc.args[0]!r} "
+                f"scale entry (int8 pages need {', '.join(_KV_SCALE_FRAMES)})"
+            ) from None
+        _check_kv_scales(k, scales[0], scales[1], "handoff")
     body = pack_frames([
         prompt.astype(np.int32, copy=False),
         np.asarray(last, np.float32).reshape(-1),
         k, v,
-    ])
+    ] + (scales or []))
     # CRC32C integrity trailer (r17): a container crossing DCN must
     # reject a flipped byte as a NAMED error, never scatter garbage KV
     return _append_crc_trailer(body) if kv_checksum_enabled() else body
@@ -600,12 +648,18 @@ def unpack_kv_handoff(data) -> dict:
     both sums instead of decoding as wrong-but-shaped KV."""
     body, _ = _split_crc_trailer(data)
     views = unpack_frames(body)
-    if len(views) != len(_KV_HANDOFF_FRAMES):
+    n_plain = len(_KV_HANDOFF_FRAMES)
+    if len(views) not in (n_plain, n_plain + len(_KV_SCALE_FRAMES)):
         raise PayloadError(
             f"KV handoff container carries {len(views)} frames, expected "
-            f"{len(_KV_HANDOFF_FRAMES)} ({', '.join(_KV_HANDOFF_FRAMES)})"
+            f"{n_plain} ({', '.join(_KV_HANDOFF_FRAMES)}) or "
+            f"{n_plain + len(_KV_SCALE_FRAMES)} (+ "
+            f"{', '.join(_KV_SCALE_FRAMES)} for int8 pools)"
         )
-    prompt, last, k, v = views
+    prompt, last, k, v = views[:n_plain]
+    sk = sv = None
+    if len(views) > n_plain:
+        sk, sv = views[n_plain:]
     if prompt.dtype != np.int32 or prompt.ndim != 1 or len(prompt) < 1:
         raise PayloadError(
             f"KV handoff prompt frame must be 1-D int32, got "
@@ -621,6 +675,7 @@ def unpack_kv_handoff(data) -> dict:
             f"KV handoff k/v frames must be matching rank-4/5 page "
             f"stacks, got {k.dtype.name}{k.shape} vs {v.dtype.name}{v.shape}"
         )
+    _check_kv_scales(k, sk, sv, "handoff")
     page_size = int(k.shape[2])
     pages = int(k.shape[1])
     if page_size < 1 or pages != -(-len(prompt) // page_size):
@@ -629,7 +684,7 @@ def unpack_kv_handoff(data) -> dict:
             f"need {-(-len(prompt) // max(1, page_size))} pages of "
             f"{page_size}, container holds {pages}"
         )
-    return {
+    out = {
         "prompt": prompt.array(),
         "last_logits": last.array(),
         "k": k.array(),
@@ -637,6 +692,9 @@ def unpack_kv_handoff(data) -> dict:
         "page_size": page_size,
         "layout": "flat" if k.ndim == 4 else "split",
     }
+    if sk is not None:
+        out["k_scales"], out["v_scales"] = sk.array(), sv.array()
+    return out
 
 
 # ---------------------------------------------------------------------------
@@ -686,6 +744,16 @@ def pack_kv_migration(payload: dict) -> bytes:
             f"(split) page stacks, got {k.dtype}{tuple(k.shape)} vs "
             f"{v.dtype}{tuple(v.shape)}"
         )
+    scales = None
+    if any(name in payload for name in _KV_SCALE_FRAMES) or k.dtype == np.int8:
+        try:
+            scales = [np.asarray(payload[n], np.float32) for n in _KV_SCALE_FRAMES]
+        except KeyError as exc:
+            raise PayloadError(
+                f"KV migration int8 payload is missing the {exc.args[0]!r} "
+                f"scale entry (int8 pages need {', '.join(_KV_SCALE_FRAMES)})"
+            ) from None
+        _check_kv_scales(k, scales[0], scales[1], "migration")
     meta = {name: payload.get(name) for name in _MIGRATION_META_FIELDS}
     meta_frame = np.frombuffer(
         _json.dumps(meta).encode("utf-8"), np.uint8
@@ -697,7 +765,7 @@ def pack_kv_migration(payload: dict) -> bytes:
         np.asarray(payload.get("tokens", []), np.int32).reshape(-1),
         np.asarray(payload.get("key_data", []), np.uint32).reshape(-1),
         meta_frame,
-    ])
+    ] + (scales or []))
     return _append_crc_trailer(body) if kv_checksum_enabled() else body
 
 
@@ -710,13 +778,18 @@ def unpack_kv_migration(data) -> dict:
 
     body, _ = _split_crc_trailer(data)
     views = unpack_frames(body)
-    if len(views) != len(_KV_MIGRATION_FRAMES):
+    n_plain = len(_KV_MIGRATION_FRAMES)
+    if len(views) not in (n_plain, n_plain + len(_KV_SCALE_FRAMES)):
         raise PayloadError(
             f"KV migration container carries {len(views)} frames, "
-            f"expected {len(_KV_MIGRATION_FRAMES)} "
-            f"({', '.join(_KV_MIGRATION_FRAMES)})"
+            f"expected {n_plain} ({', '.join(_KV_MIGRATION_FRAMES)}) or "
+            f"{n_plain + len(_KV_SCALE_FRAMES)} (+ "
+            f"{', '.join(_KV_SCALE_FRAMES)} for int8 pools)"
         )
-    prompt, last, k, v, tokens, key_data, meta_v = views
+    prompt, last, k, v, tokens, key_data, meta_v = views[:n_plain]
+    sk = sv = None
+    if len(views) > n_plain:
+        sk, sv = views[n_plain:]
     if prompt.dtype != np.int32 or prompt.ndim != 1 or len(prompt) < 1:
         raise PayloadError(
             f"KV migration prompt frame must be 1-D int32, got "
@@ -732,6 +805,7 @@ def unpack_kv_migration(data) -> dict:
             f"KV migration tokens frame must be 1-D int32, got "
             f"{tokens.dtype.name}{tokens.shape}"
         )
+    _check_kv_scales(k, sk, sv, "migration")
     try:
         meta = _json.loads(bytes(meta_v.array()).decode("utf-8"))
     except (UnicodeDecodeError, ValueError) as exc:
@@ -755,6 +829,8 @@ def unpack_kv_migration(data) -> dict:
         "page_size": page_size,
         "layout": "flat" if k.ndim == 4 else "split",
     }
+    if sk is not None:
+        out["k_scales"], out["v_scales"] = sk.array(), sv.array()
     out.update({f: meta.get(f) for f in _MIGRATION_META_FIELDS
                 if f not in ("page_size", "layout")})
     return out

@@ -418,7 +418,9 @@ class DisaggregatedLM(StreamingLM):
             if hop is not None:
                 hop.zero_copy_bytes = sum(
                     int(np.asarray(payload[k]).nbytes)
-                    for k in ("k", "v", "last_logits", "prompt")
+                    for k in ("k", "v", "last_logits", "prompt",
+                              "k_scales", "v_scales")
+                    if k in payload
                 )
             job.stream = self.engine.submit_prefilled(
                 payload, **job.submit_kw

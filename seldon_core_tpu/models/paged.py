@@ -47,34 +47,66 @@ logger = logging.getLogger(__name__)
 
 
 def paged_kernel_mode() -> str:
-    """The ``SELDON_TPU_PAGED_KERNEL`` env value ("0" | "1" | "force") —
-    the ONE place its vocabulary lives.  The block's kernel gate, the
-    pool-layout decision (:func:`pool_is_flat`) and the engine's
-    chunk-impl auto-select all read through here, so a new mode string
-    cannot leave the three silently disagreeing."""
-    return _knobs.raw("SELDON_TPU_PAGED_KERNEL", "0")
+    """The ``SELDON_TPU_PAGED_KERNEL`` env value ("0" | "1" | "auto" |
+    "force") — the ONE place its vocabulary lives.  The block's kernel
+    gate, the pool-layout decision (:func:`pool_is_flat`) and the
+    engine's chunk-impl auto-select all read through here, so a new
+    mode string cannot leave the three silently disagreeing.  Since the
+    r18 default flip the unset value is "auto": the kernel lane is the
+    production decode path on single-chip TPU backends, and "0"
+    restores the XLA gather lane byte-for-byte."""
+    return _knobs.raw("SELDON_TPU_PAGED_KERNEL", "auto")
+
+
+def paged_kernel_explicit(mode: Optional[str] = None) -> bool:
+    """True when the operator EXPLICITLY opted in ("1" | "force") —
+    the modes whose ineligibility deserves a WARN.  "auto" degrading to
+    the gather lane is a default resolving, not a broken request, so it
+    stays silent (the ``kernel_active`` gauge reports which lane won)."""
+    return (mode if mode is not None else paged_kernel_mode()) in ("1", "force")
 
 
 def paged_kernel_requested(mode: Optional[str] = None) -> bool:
-    return (mode if mode is not None else paged_kernel_mode()) in ("1", "force")
+    """Whether this process WANTS the pallas decode kernel: an explicit
+    "1"/"force", or the "auto" default resolving on a TPU backend
+    (off-TPU "auto" means the gather lane, so CPU/GPU processes keep
+    the historical flat pool and programs byte-for-byte)."""
+    mode = mode if mode is not None else paged_kernel_mode()
+    if mode in ("1", "force"):
+        return True
+    if mode == "auto":
+        import jax
+
+        return jax.default_backend() == "tpu"
+    return False
 
 
 def paged_kernel_static_eligible(mode: str, mesh_absent: bool, dtype) -> bool:
     """The STATIC half of the pallas decode-kernel gate, shared by the
     block's trace-time ``use_kernel`` and the engine's chunk-impl
-    auto-select so the two cannot drift: requested by env, no TP mesh
-    (GSPMD can't partition the pallas call), bf16 pool, and a TPU
-    backend unless forced (interpret mode).  The block adds its
-    trace-local terms (decode step, split pool layout) on top."""
+    auto-select so the two cannot drift: requested by env (explicitly
+    or via the "auto" default on TPU), no TP mesh (GSPMD can't
+    partition the pallas call), a bf16 or f32 pool (f32 is the
+    exactness lane the kernel-parity tests pin), and a TPU backend
+    unless forced (interpret mode).  The block adds its trace-local
+    terms (decode step, split pool layout) on top."""
     import jax
     import jax.numpy as jnp
 
     return (
         paged_kernel_requested(mode)
         and mesh_absent
-        and dtype == jnp.bfloat16
+        and dtype in (jnp.bfloat16, jnp.float32)
         and (mode == "force" or jax.default_backend() == "tpu")
     )
+
+
+def paged_kv_dtype_mode() -> str:
+    """The ``SELDON_TPU_KV_DTYPE`` env value ("bf16" | "int8") — int8
+    stores KV pages quantised with one f32 scale per page per k/v in a
+    sibling ``(layers, num_pages)`` scale table (r18).  Anything other
+    than "int8" means the pool stores the engine dtype natively."""
+    return _knobs.raw("SELDON_TPU_KV_DTYPE", "bf16") or "bf16"
 
 from seldon_core_tpu.models.generate import _buckets_for
 from seldon_core_tpu.runtime import knobs as _knobs
@@ -129,7 +161,7 @@ def _build_modules():
 
         @nn.compact
         def __call__(self, x, pk, pv, block_tables, lengths,
-                     lora=None, adapter_idx=None):
+                     lora=None, adapter_idx=None, kv_scales=None):
             # x: (B, L, d)  pk/pv: (num_pages, ps, h, hd) split, or the
             # r5-default flat (num_pages, ps, d) — the gather below
             # reshapes either to (B, cache_len, h, hd), and the kernel
@@ -145,6 +177,12 @@ def _build_modules():
             # the gathered grouped-matmul delta (ops/lora.py), so a
             # wave mixing K adapters is ONE program; lora=None is the
             # byte-identical adapter-off path (no new ops traced)
+            # kv_scales (r18): ``(sk, sv)`` per-page f32 ``(num_pages,)``
+            # scale vectors for an int8 pool — both attention lanes
+            # dequantise through them (the kernel in-register, the
+            # gather right after the page fetch); None means the pool
+            # stores self.dtype natively and the trace is byte-identical
+            # to r17
             tables = (
                 tuple(block_tables)
                 if isinstance(block_tables, (tuple, list))
@@ -155,9 +193,45 @@ def _build_modules():
             head_dim = d_model // heads
             batch, seg_len = x.shape[:2]
 
+            # since the r18 default flip ("auto") this is the PRODUCTION
+            # decode lane on single-chip TPU backends — the r4 gather-
+            # vs-kernel measurements that kept it opt-in predate the
+            # streaming DMA rework; SELDON_TPU_PAGED_KERNEL=0 restores
+            # the XLA gather lane byte-for-byte
+            use_kernel = (
+                seg_len == 1
+                # decode_kernel=False is how the engine encodes a TP
+                # mesh; the static terms (env, dtype, backend) live in
+                # the shared predicate the chunk auto-select also uses
+                and self.decode_kernel
+                # the kernels' BlockSpecs index the SPLIT (pages, ps,
+                # h, hd) layout — a flat pool (the r5 default) takes
+                # the gather path regardless of the env opt-in
+                and pk.ndim == 4
+                and paged_kernel_static_eligible(
+                    paged_kernel_mode(), True, self.dtype
+                )
+            )
+            # r18: the per-lane qkv LoRA BGMV folds INTO the stream
+            # kernel launch (the slot-index gather rides the scalar
+            # prefetch next to the block tables) — one fused program
+            # instead of kernel + two einsums.  Sound without further
+            # care because this model applies no RoPE between the qkv
+            # projection and attention (learned positional embeddings
+            # add at the LM level), so the low-rank delta is linear in
+            # the projection output.  Grid impl keeps the outside-
+            # kernel einsum path.
+            fold_qkv = False
+            if use_kernel and lora is not None and "qkv" in lora:
+                from seldon_core_tpu.ops.kernels import paged_kernel_impl
+
+                fold_qkv = paged_kernel_impl(heads, head_dim) == "stream"
+
             def _proj(name, features, inp):
                 out = _dense(self.precision, features, self.dtype, name)(inp)
-                if lora is not None and name in lora:
+                if lora is not None and name in lora and not (
+                    fold_qkv and name == "qkv"
+                ):
                     from seldon_core_tpu.ops.lora import lora_delta
 
                     a_f, b_f = lora[name]
@@ -173,29 +247,6 @@ def _build_modules():
             q, k, v = q.reshape(shape), k.reshape(shape), v.reshape(shape)
 
             scale = 1.0 / jnp.sqrt(head_dim).astype(q.dtype)
-
-            # default OFF since r4's honest re-measurement: with
-            # value-fetch timing barriers and two-point marginal cost,
-            # XLA's gather path decodes at 1,127 us/step vs the pallas
-            # kernels' 1,345 (stream) / 1,604 (grid) at B=16 d512/L8,
-            # and the three are tied end-to-end at serving scale (3.4-
-            # 3.5k tok/s).  The kernels stay opt-in
-            # (SELDON_TPU_PAGED_KERNEL=1/force + *_IMPL=stream|grid)
-            # for toolchains where Mosaic's DMA issue overhead drops.
-            use_kernel = (
-                seg_len == 1
-                # decode_kernel=False is how the engine encodes a TP
-                # mesh; the static terms (env, dtype, backend) live in
-                # the shared predicate the chunk auto-select also uses
-                and self.decode_kernel
-                # the kernels' BlockSpecs index the SPLIT (pages, ps,
-                # h, hd) layout — a flat pool (the r5 default) takes
-                # the gather path regardless of the env opt-in
-                and pk.ndim == 4
-                and paged_kernel_static_eligible(
-                    paged_kernel_mode(), True, self.dtype
-                )
-            )
             if use_kernel:
                 # pallas flash-decoding over the paged pool
                 # (ops/kernels.py paged_attention_decode): pages stream
@@ -216,28 +267,56 @@ def _build_modules():
                 # matters more than speed.
                 from seldon_core_tpu.ops.kernels import paged_attention_decode
 
+                if fold_qkv:
+                    a_f, b_fact = lora["qkv"]
+                    # the kernel DMAs one lane's (r, D) factor rows; the
+                    # 128-aligned d minor wants A TRANSPOSED
+                    a_T = jnp.swapaxes(a_f, -1, -2)   # (slots, r, d)
+                    q_scale_f = float(head_dim) ** -0.5
                 outs = []
+                deltas = []
                 off = 0
                 for tb in tables:
                     nb = tb.shape[0]
                     sl = slice(off, off + nb)
                     q1 = (q[sl] * scale)[:, 0]  # (nb, h, hd)
-                    acc, m, l = paged_attention_decode(
-                        q1, pk, pv, tb, lengths[sl],
-                        page_size=pk.shape[1],
-                    )
-                    s_self = jnp.einsum(
-                        "bhd,bhd->bh",
-                        q1.astype(jnp.float32),
-                        k[sl][:, 0].astype(jnp.float32),
-                    )
+                    if fold_qkv:
+                        acc, m, l, delta = paged_attention_decode(
+                            q1, pk, pv, tb, lengths[sl],
+                            page_size=pk.shape[1], kv_scales=kv_scales,
+                            lora=(y[sl][:, 0], a_T, b_fact,
+                                  adapter_idx[sl], q_scale_f),
+                        )
+                        deltas.append(delta)
+                        dq, dk, dv = jnp.split(delta, 3, axis=-1)
+                        q_self = (
+                            q1.astype(jnp.float32)
+                            + q_scale_f * dq.reshape(nb, heads, head_dim)
+                        )
+                        k_self = (
+                            k[sl][:, 0].astype(jnp.float32)
+                            + dk.reshape(nb, heads, head_dim)
+                        )
+                        v_self = (
+                            v[sl][:, 0].astype(jnp.float32)
+                            + dv.reshape(nb, heads, head_dim)
+                        )
+                    else:
+                        acc, m, l = paged_attention_decode(
+                            q1, pk, pv, tb, lengths[sl],
+                            page_size=pk.shape[1], kv_scales=kv_scales,
+                        )
+                        q_self = q1.astype(jnp.float32)
+                        k_self = k[sl][:, 0].astype(jnp.float32)
+                        v_self = v[sl][:, 0].astype(jnp.float32)
+                    s_self = jnp.einsum("bhd,bhd->bh", q_self, k_self)
                     m2 = jnp.maximum(m, s_self)
                     alpha = jnp.exp(m - m2)
                     w_self = jnp.exp(s_self - m2)
                     l2 = l * alpha + w_self
                     out_b = (
                         acc * alpha[..., None]
-                        + v[sl][:, 0].astype(jnp.float32) * w_self[..., None]
+                        + v_self * w_self[..., None]
                     ) / l2[..., None]
                     outs.append(out_b[:, None].astype(self.dtype))
                     off += nb
@@ -245,6 +324,23 @@ def _build_modules():
                     outs[0] if len(outs) == 1 else jnp.concatenate(outs, axis=0)
                 )
                 attn = attn.reshape(batch, seg_len, d_model)
+                if fold_qkv:
+                    # fold the kernel's raw delta into the k/v this call
+                    # returns — the caller's pool write must store the
+                    # ADAPTED keys/values, same as the einsum path
+                    delta_all = (
+                        deltas[0] if len(deltas) == 1
+                        else jnp.concatenate(deltas, axis=0)
+                    )
+                    _, dk_all, dv_all = jnp.split(delta_all, 3, axis=-1)
+                    k = (
+                        k.astype(jnp.float32)
+                        + dk_all.reshape(batch, 1, heads, head_dim)
+                    ).astype(self.dtype)
+                    v = (
+                        v.astype(jnp.float32)
+                        + dv_all.reshape(batch, 1, heads, head_dim)
+                    ).astype(self.dtype)
             else:
                 # gather path — same arithmetic as
                 # TransformerBlock._cached_attention: bf16 scores
@@ -256,10 +352,23 @@ def _build_modules():
                     nb = tb.shape[0]
                     sl = slice(off, off + nb)
                     gk = pk[tb]  # (nb, P, ps, h, hd) split / (nb, P, ps, d) flat
+                    gv = pv[tb]
                     pages_per, page_size = gk.shape[1], gk.shape[2]
                     cache_len = pages_per * page_size
+                    if kv_scales is not None:
+                        # int8 pool: dequantise right after the page
+                        # fetch — one f32 scale per gathered page,
+                        # broadcast over its (ps, ...) token block
+                        sk_l, sv_l = kv_scales
+                        bshape = (nb, pages_per) + (1,) * (gk.ndim - 2)
+                        gk = (
+                            gk.astype(jnp.float32) * sk_l[tb].reshape(bshape)
+                        ).astype(self.dtype)
+                        gv = (
+                            gv.astype(jnp.float32) * sv_l[tb].reshape(bshape)
+                        ).astype(self.dtype)
                     gk = gk.reshape(nb, cache_len, heads, head_dim)
-                    gv = pv[tb].reshape(nb, cache_len, heads, head_dim)
+                    gv = gv.reshape(nb, cache_len, heads, head_dim)
 
                     sc = jnp.einsum("bqhd,bkhd->bhqk", q[sl] * scale, gk)
                     ss = jnp.einsum("bqhd,bkhd->bhqk", q[sl] * scale, k[sl])
@@ -474,7 +583,7 @@ def _build_modules():
 
         @nn.compact
         def __call__(self, tokens, positions, pages_k, pages_v, block_tables,
-                     lengths, lora=None, adapter_idx=None):
+                     lengths, lora=None, adapter_idx=None, kv_scales=None):
             tokens = tokens.astype(jnp.int32)
             x = nn.Embed(
                 self.vocab_size, self.d_model, dtype=self.dtype, name="tok_embed"
@@ -489,12 +598,16 @@ def _build_modules():
                     {t: (ab[0][i], ab[1][i]) for t, ab in lora.items()}
                     if lora is not None else None
                 )
+                scales_i = (
+                    (kv_scales[0][i], kv_scales[1][i])
+                    if kv_scales is not None else None
+                )
                 x, k, v = PagedTransformerBlock(
                     num_heads=self.num_heads, dtype=self.dtype,
                     precision=self.precision,
                     decode_kernel=self.decode_kernel, name=f"block_{i}"
                 )(x, pages_k[i], pages_v[i], block_tables, lengths,
-                  lora=lora_i, adapter_idx=adapter_idx)
+                  lora=lora_i, adapter_idx=adapter_idx, kv_scales=scales_i)
                 new_k.append(k)
                 new_v.append(v)
             x = nn.LayerNorm(dtype=jnp.float32)(x)
@@ -537,6 +650,36 @@ def pool_is_flat(mesh=None) -> bool:
     return not paged_kernel_requested()
 
 
+def kv_split(pool):
+    """Split a pool argument into ``(pages, scales)`` — the r18 int8
+    bundle is a 2-tuple ``(int8 pages, f32 per-page scales)``; a bare
+    array (the native-dtype pool) splits to ``(pool, None)``.  Program
+    functions call this at entry so ONE argument convention covers both
+    pool dtypes (jit treats the tuple as a pytree; donating it donates
+    both leaves)."""
+    if isinstance(pool, tuple):
+        return pool
+    return pool, None
+
+
+def kv_join(pages, scales):
+    """Inverse of :func:`kv_split`."""
+    if scales is None:
+        return pages
+    return (pages, scales)
+
+
+def kv_scales_arg(sk, sv):
+    """The ``kv_scales=`` argument for a split pool: ``None`` for a
+    native pool, ``(sk, sv)`` for the int8 bundle.  ``sk is None`` is a
+    pytree-STRUCTURE fact fixed at trace time, not a traced value — a
+    helper so jitted callers don't spell a ternary the jit-purity
+    linter cannot tell apart from tracer control flow."""
+    if sk is None:
+        return None
+    return (sk, sv)
+
+
 def write_kv(pk, pv, new_k, new_v, block_tables, start, valid, *, page_size, max_len,
              from_zero: bool = False):
     """Write (layers, B, L, h, hd) K/V into a paged pool.
@@ -563,6 +706,18 @@ def write_kv(pk, pv, new_k, new_v, block_tables, start, valid, *, page_size, max
     """
     import jax
     import jax.numpy as jnp
+
+    # r18 int8 pool: the bundled ``(pages, scales)`` form takes the
+    # quantising write path — pages are (re)quantised whole, one f32
+    # scale per page per k/v kept exact in the sibling table
+    pk_pages, sk = kv_split(pk)
+    pv_pages, sv = kv_split(pv)
+    if sk is not None:
+        pk_pages, sk, pv_pages, sv = _write_kv_int8(
+            pk_pages, sk, pv_pages, sv, new_k, new_v, block_tables, start,
+            valid, page_size=page_size, max_len=max_len, from_zero=from_zero,
+        )
+        return (pk_pages, sk), (pv_pages, sv)
 
     # Two pool storage layouts (r5): FLAT ``(L, pages, ps, d_model)`` —
     # the default, because the split (heads=8, head_dim=64) trailing
@@ -632,6 +787,132 @@ def write_kv(pk, pv, new_k, new_v, block_tables, start, valid, *, page_size, max
     return pk, pv
 
 
+def _write_kv_int8(pk, sk, pv, sv, new_k, new_v, block_tables, start, valid, *,
+                   page_size, max_len, from_zero):
+    """The quantising twin of :func:`write_kv` for the int8 pool.
+
+    Same DUS lowering discipline and trash-page redirection as the
+    native path, with one structural difference: int8 quantisation is a
+    PAGE-granular property (one f32 scale per page per k/v), so every
+    write touches whole pages —
+
+    * **prefill (``from_zero``)** — each (row, page) block quantises
+      fresh: per-layer abs-max over the block, scale = amax/127, pad
+      positions zero (they contribute nothing to the abs-max, so a
+      partial last page quantises at its live tokens' dynamic range).
+    * **decode / speculative segments** — read-modify-write requant:
+      dequantise the page at its old scale, ZERO the stale tail at or
+      past the write offset (a recycled page's dead values must not
+      inflate the new scale), insert the token, recompute the scale,
+      requantise the whole page.  NUMERIC CAVEAT: a page filling token
+      by token requantises up to ``page_size`` times, so earlier tokens'
+      dequantised values can drift by ±scale/2 as the page's dynamic
+      range grows — this is the int8 lane's documented regime
+      (docs/architecture.md §5b), bounded by the top-1 agreement test.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    if pk.ndim == 4 and new_k.ndim == 5:
+        new_k = new_k.reshape(*new_k.shape[:3], -1)
+        new_v = new_v.reshape(*new_v.shape[:3], -1)
+    tail0 = (0,) * (pk.ndim - 3)
+    tail_shape = pk.shape[3:]
+    L = pk.shape[0]
+
+    def _quant(pagef):
+        # pagef: (L, 1, ps, *tail) f32 — one scale per LAYER (the page
+        # axis is the sliced singleton)
+        amax = jnp.max(jnp.abs(pagef), axis=tuple(range(1, pagef.ndim)))
+        scale = jnp.maximum(amax / 127.0, 1e-8)  # (L,)
+        q = jnp.clip(
+            jnp.round(pagef / scale.reshape((L,) + (1,) * (pagef.ndim - 1))),
+            -127, 127,
+        ).astype(jnp.int8)
+        return q, scale
+
+    def _rmw_token(pool, scales, tok, page, off):
+        # tok: (L, *tail) f32 — requant one page with ``tok`` at ``off``
+        oldq = jax.lax.dynamic_slice(
+            pool, (0, page, 0) + tail0, (L, 1, page_size) + tail_shape
+        )
+        olds = jax.lax.dynamic_slice(scales, (0, page), (L, 1))
+        pagef = oldq.astype(jnp.float32) * olds.reshape(
+            (L, 1, 1) + (1,) * len(tail_shape)
+        )
+        live = (jnp.arange(page_size) < off).reshape(
+            (1, 1, page_size) + (1,) * len(tail_shape)
+        )
+        pagef = jnp.where(live, pagef, 0.0)
+        pagef = jax.lax.dynamic_update_slice(
+            pagef, tok[:, None, None], (0, 0, off) + tail0
+        )
+        q, scale = _quant(pagef)
+        pool = jax.lax.dynamic_update_slice(pool, q, (0, page, 0) + tail0)
+        scales = jax.lax.dynamic_update_slice(
+            scales, scale[:, None], (0, page)
+        )
+        return pool, scales
+
+    seg_len = new_k.shape[2]
+    B = new_k.shape[1]
+    new_kf = new_k.astype(jnp.float32)
+    new_vf = new_v.astype(jnp.float32)
+
+    if from_zero:
+        for s in range(B):
+            for j in range(-(-seg_len // page_size)):
+                lo = j * page_size
+                blen = min(page_size, seg_len - lo)
+                page = block_tables[s, j]
+                for pool_name, pool, scales, new in (
+                    ("k", pk, sk, new_kf), ("v", pv, sv, new_vf)
+                ):
+                    blk = new[:, s, lo:lo + blen][:, None]  # (L,1,blen,*)
+                    if blen < page_size:
+                        pad = [(0, 0)] * blk.ndim
+                        pad[2] = (0, page_size - blen)
+                        blk = jnp.pad(blk, pad)
+                    q, scale = _quant(blk)
+                    pool = jax.lax.dynamic_update_slice(
+                        pool, q, (0, page, 0) + tail0
+                    )
+                    scales = jax.lax.dynamic_update_slice(
+                        scales, scale[:, None], (0, page)
+                    )
+                    if pool_name == "k":
+                        pk, sk = pool, scales
+                    else:
+                        pv, sv = pool, scales
+        return pk, sk, pv, sv
+
+    if seg_len == 1:
+        pos = jnp.minimum(start, max_len - 1)  # (B,)
+        page_idx = pos // page_size
+        offs = pos % page_size
+        for s in range(B):
+            page = jnp.where(
+                valid[s, 0], jnp.take(block_tables[s], page_idx[s]), 0
+            )
+            pk, sk = _rmw_token(pk, sk, new_kf[:, s, 0], page, offs[s])
+            pv, sv = _rmw_token(pv, sv, new_vf[:, s, 0], page, offs[s])
+        return pk, sk, pv, sv
+
+    # short mid-sequence segments (speculative verify): token-wise RMW
+    pos = start[:, None] + jnp.arange(seg_len)[None, :]  # (B, L)
+    pos = jnp.minimum(pos, max_len - 1)
+    page_idx = pos // page_size
+    offs = pos % page_size
+    for s in range(B):
+        for t in range(seg_len):
+            page = jnp.where(
+                valid[s, t], jnp.take(block_tables[s], page_idx[s, t]), 0
+            )
+            pk, sk = _rmw_token(pk, sk, new_kf[:, s, t], page, offs[s, t])
+            pv, sv = _rmw_token(pv, sv, new_vf[:, s, t], page, offs[s, t])
+    return pk, sk, pv, sv
+
+
 def paged_hbm_accounting(
     *,
     streams: int,
@@ -651,6 +932,7 @@ def paged_hbm_accounting(
     inflight_prefill_tokens: int = 0,
     adapter_bytes: int = 0,
     reclaimable_weight_bytes: int = 0,
+    kv_dtype: str = "bf16",
 ) -> Dict[str, int]:
     """Pool-HBM bytes for ``streams`` concurrent streams at ``ctx_len``
     tokens — the capacity model the bench certifies (VERDICT r5 #3/#5).
@@ -714,6 +996,15 @@ def paged_hbm_accounting(
       (refcount-0) sets next to the prefix cache's reclaimable pages —
       capacity, never cost.
 
+    * **int8 KV pool (r18)** — ``kv_dtype="int8"`` prices pages at ONE
+      byte per element plus the sibling scale table's 8 bytes per page
+      (one f32 per page per k/v per layer): ~2x
+      ``paged_capacity_streams`` at equal budget vs bf16.  In-flight
+      prefill scratch and reclaimable prefix pages are pool pages, so
+      they reprice the same way; the ring working set does NOT — the
+      gathered ctx/ring copies hold the engine's compute dtype (and the
+      int8 pool is pool-impl-only regardless).
+
     BASE weights, activations, and the host runtime stay out of scope:
     this prices what scales with streams and adapter multiplexing.
     """
@@ -723,27 +1014,31 @@ def paged_hbm_accounting(
         # replicated pool, so one device really holds the full bytes
         shard = 1
     pages = -(-ctx_len // page_size)
-    tok_bytes = num_layers * d_model * 2 * dtype_bytes
+    kv_int8 = kv_dtype == "int8"
+    pool_elt_bytes = 1 if kv_int8 else dtype_bytes
+    tok_bytes = num_layers * d_model * 2 * pool_elt_bytes
+    # sibling scale table: one f32 per page per k/v per layer
+    page_scale_bytes = num_layers * 2 * 4 if kv_int8 else 0
     pool_pad = 1.0 if flat_pool else split_tile_pad
-    pool = int(streams * pages * page_size * tok_bytes * pool_pad) // shard
+    page_bytes = page_size * tok_bytes * pool_pad + page_scale_bytes
+    pool = int(streams * pages * page_bytes) // shard
     ws = 0
     if chunk_impl == "ring":
+        # the ring impl's gathered working set holds the COMPUTE dtype
         ws = int(
             streams * (pages * page_size + steps_per_call)
-            * tok_bytes * split_tile_pad
+            * num_layers * d_model * 2 * dtype_bytes * split_tile_pad
         ) // shard
     at_rest = pool if donated else 2 * pool
     inflight_pages = -(-int(inflight_prefill_tokens) // page_size)
-    inflight = int(
-        inflight_pages * page_size * tok_bytes * pool_pad
-    ) // shard
+    inflight = int(inflight_pages * page_bytes) // shard
     return {
         "pool_bytes": pool,
         "working_set_bytes": ws,
         "peak_bytes": at_rest + ws + inflight + int(adapter_bytes),
         "per_stream_bytes": (at_rest + ws) // max(1, streams),
         "reclaimable_bytes": int(
-            cached_prefix_pages * page_size * tok_bytes * pool_pad
+            cached_prefix_pages * page_bytes
         ) // shard + int(reclaimable_weight_bytes),
         "inflight_prefill_bytes": inflight,
         "adapter_bytes": int(adapter_bytes),
@@ -1129,15 +1424,18 @@ class PagedEngine:
                     "chunk impl (the pallas decode kernel lives in its "
                     "per-step attention; the ring chunk never reaches it)"
                 )
-            elif paged_kernel_requested(kernel_mode):
+            elif paged_kernel_explicit(kernel_mode):
+                # the "auto" default resolving to the gather lane is
+                # silent by design (r18) — only an EXPLICIT "1"/"force"
+                # that cannot fire deserves the WARN
                 logger.warning(
                     "SELDON_TPU_PAGED_KERNEL=%s requested but the kernel "
-                    "cannot run here (needs bf16, no TP mesh, and a TPU "
+                    "cannot run here (needs bf16/f32, no TP mesh, and a TPU "
                     "backend unless force) — keeping the ring chunk; note "
                     "the env still selects the split pool layout",
                     kernel_mode,
                 )
-        elif paged_kernel_requested(kernel_mode) and self._chunk_impl == "ring":
+        elif paged_kernel_explicit(kernel_mode) and self._chunk_impl == "ring":
             logger.warning(
                 "SELDON_TPU_PAGED_KERNEL is set but SELDON_TPU_CHUNK_IMPL="
                 "ring: the ring chunk never invokes the pallas decode "
@@ -1174,6 +1472,41 @@ class PagedEngine:
             if self._pool_flat
             else (num_layers, self.num_pages, self.page_size, num_heads, head_dim)
         )
+        # r18: which decode lane this replica actually runs — the
+        # kernel fires only when the pool chunk invokes it against a
+        # split pool; exported as the `kernel_active` gauge so
+        # dashboards see the lane, not just a one-shot WARN
+        self._kernel_active = bool(
+            self._chunk_impl == "pool" and kernel_eligible
+            and not self._pool_flat
+        )
+        # r18 int8 KV pool: pages rest int8 with ONE f32 scale per page
+        # per k/v in a sibling (layers, num_pages) table — half the
+        # pool bytes (≈2x paged_capacity_streams), dequantised
+        # in-register by the decode kernel and right after the fetch by
+        # the gather lane.  Single-chip pool-impl only: the ring chunk
+        # never rereads the pool per step (its ctx gather would need a
+        # third dequant site), and GSPMD sharding of the scale table is
+        # not priced — both degrade to the native pool with a WARN.
+        kv_dtype = paged_kv_dtype_mode()
+        self._kv_int8 = False
+        if kv_dtype == "int8":
+            if mesh is not None or self._chunk_impl != "pool":
+                logger.warning(
+                    "SELDON_TPU_KV_DTYPE=int8 requested but the int8 KV "
+                    "pool is single-chip pool-impl only (mesh=%s, "
+                    "chunk_impl=%s) — keeping the native pool dtype",
+                    mesh is not None, self._chunk_impl,
+                )
+            else:
+                self._kv_int8 = True
+        elif kv_dtype not in ("bf16", ""):
+            raise ValueError(
+                f"SELDON_TPU_KV_DTYPE={kv_dtype!r}: supported values are "
+                "'bf16' (native pool dtype) and 'int8'"
+            )
+        pool_dtype = jnp.int8 if self._kv_int8 else dtype
+        self._pool_dtype = pool_dtype
         # tensor-parallel decode: megatron-style param shardings + the
         # pool sharded on its heads axis (dim 3 either way — in the
         # flat layout d_model is head-major contiguous, so sharding it
@@ -1185,10 +1518,19 @@ class PagedEngine:
         from seldon_core_tpu.parallel.sharding import shard_decode_state
 
         self.params, self.pages_k, self.pages_v = shard_decode_state(
-            params, mesh, pool_shape=pool_shape, dtype=dtype,
+            params, mesh, pool_shape=pool_shape, dtype=pool_dtype,
             model_axis=model_axis, min_weight_size=shard_min_weight_size,
             num_heads=num_heads,
         )
+        # sibling per-page scale tables (int8 pool only): one f32 per
+        # page per k/v, indexed exactly like the pool's page axis — the
+        # export/migration/import paths slice them with the same page
+        # index lists the pages use
+        if self._kv_int8:
+            self.scales_k = jnp.zeros((num_layers, self.num_pages), jnp.float32)
+            self.scales_v = jnp.zeros((num_layers, self.num_pages), jnp.float32)
+        else:
+            self.scales_k = self.scales_v = None
         # TP bookkeeping: the degree this engine actually runs at and
         # the PER-SHARD bytes one device holds for the K+V pool (the
         # number HBM planning cares about — the global pool is sliced
@@ -1205,6 +1547,8 @@ class PagedEngine:
         else:
             self.tp_degree = 1
             self._pool_shard_bytes = 2 * int(self.pages_k.nbytes)
+            if self._kv_int8:
+                self._pool_shard_bytes += 2 * int(self.scales_k.nbytes)
         self._logits = jnp.zeros((self.max_slots, self.vocab_size), jnp.float32)
         # rng state kept as raw key data so masked carries can jnp.where it
         self._keys = jax.random.key_data(
@@ -1562,6 +1906,22 @@ class PagedEngine:
             page_size=self.page_size, max_len=self.max_len, from_zero=from_zero,
         )
 
+    def _kv_args(self):
+        """The pool arguments every jitted program takes: bare arrays
+        for the native pool, ``(pages, scales)`` bundles for the int8
+        pool (r18) — one argument convention, the programs split at
+        entry (:func:`kv_split`)."""
+        if self._kv_int8:
+            return (self.pages_k, self.scales_k), (self.pages_v, self.scales_v)
+        return self.pages_k, self.pages_v
+
+    def _store_kv(self, pk, pv):
+        """Inverse of :meth:`_kv_args` for a program's returned pools."""
+        if self._kv_int8:
+            (self.pages_k, self.scales_k), (self.pages_v, self.scales_v) = pk, pv
+        else:
+            self.pages_k, self.pages_v = pk, pv
+
     def _materialize(self, params):
         """Once-per-program dequant of int8 weights (no-op for fp).
         Call at program ENTRY, never inside a scan step — per-step
@@ -1648,9 +2008,12 @@ class PagedEngine:
             params = self._materialize(params)
             positions = jnp.broadcast_to(jnp.arange(bucket)[None, :], (k, bucket))
             lengths = jnp.zeros((k,), jnp.int32)
+            pk_pages, sk = kv_split(pk)
+            pv_pages, sv = kv_split(pv)
             logits, nk, nv = self.module.apply(
-                {"params": params}, tokens, positions, pk, pv,
+                {"params": params}, tokens, positions, pk_pages, pv_pages,
                 block_rows, lengths, lora=lora, adapter_idx=adapter_idx,
+                kv_scales=kv_scales_arg(sk, sv),
             )
             valid = jnp.arange(bucket)[None, :] < true_lens[:, None]
             pk, pv = self._write_kv(
@@ -1691,11 +2054,14 @@ class PagedEngine:
             # shared pages  read_rows: (k, rp)  write_rows: (k, wp)
             params = self._materialize(params)
             positions = cached_lens[:, None] + jnp.arange(bucket)[None, :]
+            pk_pages, sk = kv_split(pk)
+            pv_pages, sv = kv_split(pv)
             logits, nk, nv = self.module.apply(
                 {"params": params}, tokens,
                 jnp.minimum(positions, self.max_len - 1),
-                pk, pv, read_rows, cached_lens,
+                pk_pages, pv_pages, read_rows, cached_lens,
                 lora=lora, adapter_idx=adapter_idx,
+                kv_scales=kv_scales_arg(sk, sv),
             )
             valid = jnp.arange(bucket)[None, :] < true_lens[:, None]
             pk, pv = self._write_kv(
@@ -1885,16 +2251,20 @@ class PagedEngine:
             # ABSTRACT pool args: lowering must never allocate a second
             # full pool next to the live one (and under TP a concrete
             # jnp.zeros would materialise it unsharded on one device —
-            # exactly what shard_decode_state exists to prevent)
+            # exactly what shard_decode_state exists to prevent).  The
+            # int8 pool's (pages, scales) bundle abstracts leaf-wise.
+            if isinstance(p, tuple):
+                return tuple(pool_arg(x) for x in p)
             if self._mesh is not None:
                 return jax.ShapeDtypeStruct(p.shape, p.dtype,
                                             sharding=p.sharding)
             return jax.ShapeDtypeStruct(p.shape, p.dtype)
 
+        kv_k, kv_v = self._kv_args()
         ex = (
             self.params,
-            pool_arg(self.pages_k),
-            pool_arg(self.pages_v),
+            pool_arg(kv_k),
+            pool_arg(kv_v),
             jnp.zeros((B, self.vocab_size), jnp.float32),
             jnp.zeros((B,), jnp.int32),
             jnp.zeros((B, horizon), jnp.int32),
@@ -2188,11 +2558,14 @@ class PagedEngine:
             emitted = emitted + active.astype(jnp.int32)
             done = done | (token == eos_ids) | (emitted >= max_new)
             positions = lengths[:, None]
+            pk_pages, sk = kv_split(pk)
+            pv_pages, sv = kv_split(pv)
             new_logits, nk, nv = self.module.apply(
                 {"params": params}, token[:, None],
                 jnp.minimum(positions, self.max_len - 1),
-                pk, pv, attn_tables, lengths,
+                pk_pages, pv_pages, attn_tables, lengths,
                 lora=lora, adapter_idx=adapter_idx,
+                kv_scales=kv_scales_arg(sk, sv),
             )
             pk, pv = self._write_kv(
                 pk, pv, nk, nv, block_tables, lengths, active[:, None]
@@ -2267,11 +2640,14 @@ class PagedEngine:
         params = self._materialize(params)
         L = self.draft_k + 1
         positions = lengths[:, None] + jnp.arange(L)[None, :]
+        pk_pages, sk = kv_split(pk)
+        pv_pages, sv = kv_split(pv)
         logits, nk, nv = self.module.apply(
             {"params": params}, segs,
             jnp.minimum(positions, self.max_len - 1),
-            pk, pv, block_tables, lengths,
+            pk_pages, pv_pages, block_tables, lengths,
             lora=lora, adapter_idx=adapter_idx,
+            kv_scales=kv_scales_arg(sk, sv),
         )
         greedy = jnp.argmax(logits, axis=-1)  # (S, L)
         match = (greedy[:, : L - 1] == segs[:, 1:]) & (
@@ -2328,6 +2704,10 @@ class PagedEngine:
             self._gen_span(stream, name, start_s, duration_s, **tags)
 
     def _record_chunk(self, rec: Dict[str, Any]) -> None:
+        # every per-chunk record names its decode lane (r18): the flight
+        # recorder ring is the debug surface that answers "was the
+        # Pallas kernel live when this chunk ran?" after the fact
+        rec.setdefault("kernel_active", int(self._kernel_active))
         if self.recorder is not None:
             self.recorder.record(rec)
         self._feed_watchdog(float(rec.get("wall_ms", 0.0)), fault=False)
@@ -3541,12 +3921,13 @@ class PagedEngine:
                 cp = start // ps
                 row = self._block_tables[stream.slot, cp : cp + wp]
                 write_rows[i, : len(row)] = row
-            last, self.pages_k, self.pages_v = self._prefill_cached_jit[key3](
-                self.params, self.pages_k, self.pages_v,
+            last, pk_out, pv_out = self._prefill_cached_jit[key3](
+                self.params, *self._kv_args(),
                 jnp.asarray(padded), jnp.asarray(true_lens),
                 jnp.asarray(cached_lens), jnp.asarray(read_rows),
                 jnp.asarray(write_rows), *lora_args,
             )
+            self._store_kv(pk_out, pv_out)
         else:
             key2 = (bucket, k)
             if key2 not in self._prefill_jit:
@@ -3563,11 +3944,12 @@ class PagedEngine:
                 padded[i, :n] = stream.prompt
                 true_lens[i] = n
                 block_rows[i] = self._block_tables[stream.slot, :pages_h]
-            last, self.pages_k, self.pages_v = self._prefill_jit[key2](
-                self.params, self.pages_k, self.pages_v,
+            last, pk_out, pv_out = self._prefill_jit[key2](
+                self.params, *self._kv_args(),
                 jnp.asarray(padded), jnp.asarray(true_lens),
                 jnp.asarray(block_rows), *lora_args,
             )
+            self._store_kv(pk_out, pv_out)
         finals: List[Tuple[int, _Stream]] = []
         for i, (stream, start, n) in enumerate(group):
             stream.prefilled = start + n
@@ -3642,9 +4024,15 @@ class PagedEngine:
         ``_tp_jit`` so a TP-sharded pool round-trips without a
         resharding copy."""
 
+        jax = self._jax
+
         def imp(params, pk, pv, k, v, pages):
             del params  # present only for _tp_jit's argument convention
-            return pk.at[:, pages].set(k), pv.at[:, pages].set(v)
+            # int8 pools arrive as (pages, scales) bundles with k/v
+            # bundled the same way — the scale table indexes its page
+            # axis identically, so ONE tree-mapped scatter places both
+            place = lambda pool, val: pool.at[:, pages].set(val)  # noqa: E731
+            return jax.tree.map(place, pk, k), jax.tree.map(place, pv, v)
 
         return self._tp_jit(imp, n_rep_in=3, out_spec=("pool", "pool"))
 
@@ -3672,12 +4060,16 @@ class PagedEngine:
         fn = self._import_kv_jit.get(P)
         if fn is None:
             fn = self._import_kv_jit[P] = self._build_import_kv(P)
-        k = jnp.asarray(np.asarray(payload["k"]), self._dtype)
-        v = jnp.asarray(np.asarray(payload["v"]), self._dtype)
-        self.pages_k, self.pages_v = fn(
-            self.params, self.pages_k, self.pages_v, k, v,
+        k = jnp.asarray(np.asarray(payload["k"]), self._pool_dtype)
+        v = jnp.asarray(np.asarray(payload["v"]), self._pool_dtype)
+        if self._kv_int8:
+            k = (k, jnp.asarray(np.asarray(payload["k_scales"]), jnp.float32))
+            v = (v, jnp.asarray(np.asarray(payload["v_scales"]), jnp.float32))
+        pk_out, pv_out = fn(
+            self.params, *self._kv_args(), k, v,
             jnp.asarray(pages),
         )
+        self._store_kv(pk_out, pv_out)
         last = np.asarray(
             payload["last_logits"], np.float32
         ).reshape(-1)
@@ -3750,6 +4142,12 @@ class PagedEngine:
                 "page_size": self.page_size,
                 "layout": "flat" if self._pool_flat else "split",
             }
+            if self._kv_int8:
+                # int8 pages travel NATIVELY — the per-page scales ride
+                # as sibling frames, so the wire carries half the bytes
+                # and the importer never dequantises
+                payload["k_scales"] = np.asarray(self.scales_k[:, idx])
+                payload["v_scales"] = np.asarray(self.scales_v[:, idx])
             with self._lock:
                 stream.kv_payload = payload
                 slot = stream.slot
@@ -3828,10 +4226,10 @@ class PagedEngine:
                     "prompt pages, page tail)",
                     status_code=400, reason="KV_LAYOUT_MISMATCH",
                 )
-            if arr.dtype != np.dtype(self._dtype):
+            if arr.dtype != np.dtype(self._pool_dtype):
                 raise MicroserviceError(
                     f"KV payload {name} dtype {arr.dtype} != pool dtype "
-                    f"{np.dtype(self._dtype)}",
+                    f"{np.dtype(self._pool_dtype)}",
                     status_code=400, reason="KV_LAYOUT_MISMATCH",
                 )
         if last.shape[0] != self.vocab_size:
@@ -3840,11 +4238,44 @@ class PagedEngine:
                 f"engine vocab is {self.vocab_size}",
                 status_code=400, reason="KV_LAYOUT_MISMATCH",
             )
-        return self.submit(
-            prompt,
-            kv_import={"k": k, "v": v, "last_logits": last},
-            **kw,
-        )
+        kv = {"k": k, "v": v, "last_logits": last}
+        if self._kv_int8:
+            kv["k_scales"], kv["v_scales"] = self._validate_kv_scales(
+                payload, P, "KV payload"
+            )
+        return self.submit(prompt, kv_import=kv, **kw)
+
+    def _validate_kv_scales(self, payload: Dict[str, Any], P: int,
+                            kind: str) -> Tuple[np.ndarray, np.ndarray]:
+        """Validate an int8 container's per-page scale frames against
+        this engine's pool geometry — an int8 page without its scale
+        would serve garbage rather than raise, same reasoning as the
+        shape checks above."""
+        out = []
+        for name in ("k_scales", "v_scales"):
+            arr = payload.get(name)
+            if arr is None:
+                raise MicroserviceError(
+                    f"{kind} carries int8 pages but no {name} frame — "
+                    "int8 KV containers must carry one f32 scale per "
+                    "page per k/v",
+                    status_code=400, reason="KV_LAYOUT_MISMATCH",
+                )
+            arr = np.asarray(arr)
+            want = (self.module.num_layers, P)
+            if tuple(arr.shape) != want:
+                raise MicroserviceError(
+                    f"{kind} {name} shape {tuple(arr.shape)} does not fit "
+                    f"the scale-table geometry {want} (layers, pages)",
+                    status_code=400, reason="KV_LAYOUT_MISMATCH",
+                )
+            if arr.dtype != np.float32:
+                raise MicroserviceError(
+                    f"{kind} {name} dtype {arr.dtype} != float32",
+                    status_code=400, reason="KV_LAYOUT_MISMATCH",
+                )
+            out.append(arr)
+        return out[0], out[1]
 
     # ---- live stream migration (r17) --------------------------------------
 
@@ -3918,6 +4349,13 @@ class PagedEngine:
                 "tokens": np.asarray(s.tokens, np.int32),
                 "k": np.asarray(self.pages_k[:, idx]),
                 "v": np.asarray(self.pages_v[:, idx]),
+                **(
+                    {
+                        "k_scales": np.asarray(self.scales_k[:, idx]),
+                        "v_scales": np.asarray(self.scales_v[:, idx]),
+                    }
+                    if self._kv_int8 else {}
+                ),
                 "last_logits": logits_np[slot].astype(np.float32, copy=False),
                 "key_data": keys_np[slot].copy(),
                 "max_new_tokens": int(s.max_new),
@@ -3997,10 +4435,10 @@ class PagedEngine:
                     "(layers, prompt+decoded pages, page tail)",
                     status_code=400, reason="KV_LAYOUT_MISMATCH",
                 )
-            if arr.dtype != np.dtype(self._dtype):
+            if arr.dtype != np.dtype(self._pool_dtype):
                 raise MicroserviceError(
                     f"migration payload {name} dtype {arr.dtype} != pool "
-                    f"dtype {np.dtype(self._dtype)}",
+                    f"dtype {np.dtype(self._pool_dtype)}",
                     status_code=400, reason="KV_LAYOUT_MISMATCH",
                 )
         if last.shape[0] != self.vocab_size:
@@ -4018,6 +4456,10 @@ class PagedEngine:
             "pending": payload.get("pending"),
             "migration": True,
         }
+        if self._kv_int8:
+            kv["k_scales"], kv["v_scales"] = self._validate_kv_scales(
+                payload, P, "migration payload"
+            )
         rem = payload.get("deadline_remaining_ms")
         deadline = (
             _time.monotonic() + max(0.0, float(rem)) / 1000.0
@@ -4406,6 +4848,13 @@ class PagedEngine:
                 "health": health,
                 "health_state": health_code,
                 "watchdog_trips": watchdog_trips,
+                # fused paged-decode lane (r18): 1 when the per-step
+                # attention runs the Pallas kernel, 0 on the XLA gather
+                # fallback — dashboards must see which decode lane a
+                # replica ACTUALLY runs (the TP/layout ineligibility
+                # fallback used to degrade with only a one-shot WARN)
+                "kernel_active": int(self._kernel_active),
+                "kv_dtype_int8": int(self._kv_int8),
             }
         if detail:
             if self._watchdog is not None:
@@ -4870,7 +5319,7 @@ class PagedEngine:
         self._profile_before_chunk()
         t_chunk = _time.perf_counter()
         chunk_args = (
-            self.params, self.pages_k, self.pages_v, self._logits,
+            self.params, *self._kv_args(), self._logits,
             lengths, tables, self._keys, jnp.asarray(done_in),
             emitted0, jnp.asarray(max_new), jnp.asarray(temps),
             jnp.asarray(top_ks), jnp.asarray(eos_ids), jnp.asarray(perm),
@@ -4879,9 +5328,10 @@ class PagedEngine:
             chunk_args = chunk_args + (
                 self._lora.device_args(), jnp.asarray(adapter_wave),
             )
-        toks, self.pages_k, self.pages_v, self._logits, lengths_out, self._keys, _, emitted = (
+        toks, pk_out, pv_out, self._logits, lengths_out, self._keys, _, emitted = (
             self._get_chunk(steps, buckets)(*chunk_args)
         )
+        self._store_kv(pk_out, pv_out)
         toks_np = np.asarray(toks)
         emitted_np = np.asarray(emitted)
         # single-writer window: the chunk runs with its streams pinned
@@ -5155,16 +5605,17 @@ class PagedEngine:
         self._profile_before_chunk()
         t_chunk = _time.perf_counter()
         spec_args = (
-            self.params, self.pages_k, self.pages_v, jnp.asarray(segs),
+            self.params, *self._kv_args(), jnp.asarray(segs),
             jnp.asarray(n_drafts), jnp.asarray(active_mask), tables, lengths,
         )
         if self._lora is not None:
             spec_args = spec_args + (
                 self._lora.device_args(), jnp.asarray(adapter_wave),
             )
-        out, counts, self.pages_k, self.pages_v, lengths_out = self._spec_chunk(
+        out, counts, pk_out, pv_out, lengths_out = self._spec_chunk(
             *spec_args
         )
+        self._store_kv(pk_out, pv_out)
         out_np = np.asarray(out)
         counts_np = np.asarray(counts)
         # same single-writer window as the decode chunk: streams

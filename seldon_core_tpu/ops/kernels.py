@@ -104,20 +104,43 @@ def int8_matmul(x, w_int8, scale, block_m: int = 128, block_n: int = 128, out_dt
     from jax.experimental import pallas as pl
 
     out_dtype = out_dtype or x.dtype
+    if x.ndim != 2 or w_int8.ndim != 2:
+        raise ValueError(
+            f"int8_matmul wants 2-D operands, got x{tuple(x.shape)} @ "
+            f"w_int8{tuple(w_int8.shape)}"
+        )
     m, k = x.shape
     k2, n = w_int8.shape
-    assert k == k2, (x.shape, w_int8.shape)
-    bm = min(block_m, m)
-    bn = min(block_n, n)
-    # pad M/N up to block multiples; K stays whole (fits VMEM for serving widths)
+    if k != k2:
+        raise ValueError(
+            f"int8_matmul contraction mismatch: x is (M={m}, K={k}) but "
+            f"w_int8 is (K={k2}, N={n}) — the inner (K) dims must agree"
+        )
+    scale = jnp.asarray(scale, jnp.float32)
+    if tuple(scale.shape) != (n,):
+        raise ValueError(
+            f"int8_matmul scale must be one f32 per output column: want "
+            f"shape ({n},) to match w_int8's N={n}, got {tuple(scale.shape)}"
+        )
+    # Ragged shapes pad up to the Mosaic register tile rather than
+    # surfacing the raw Mosaic/XLA "not divisible" error: blocks are
+    # rounded to the f32 (8, 128) tile (a 100-row M becomes a 104-row
+    # block, a 70-col N a 128-col block), inputs zero-pad to the block
+    # grid, and the pad region is sliced off the output.  Zero K pad
+    # columns contribute exactly 0.0 to the contraction.
+    bm = min(block_m, -(-m // 8) * 8)
+    bn = min(block_n, -(-n // 128) * 128)
     m_pad = (-m) % bm
     n_pad = (-n) % bn
-    if m_pad:
-        x = jnp.pad(x, ((0, m_pad), (0, 0)))
+    k_pad = 0 if _use_interpret() else (-k) % 128
+    if m_pad or k_pad:
+        x = jnp.pad(x, ((0, m_pad), (0, k_pad)))
+    if n_pad or k_pad:
+        w_int8 = jnp.pad(w_int8, ((0, k_pad), (0, n_pad)))
     if n_pad:
-        w_int8 = jnp.pad(w_int8, ((0, 0), (0, n_pad)))
         scale = jnp.pad(scale, (0, n_pad))
     mp, np_ = x.shape[0], w_int8.shape[1]
+    k = x.shape[1]
     scale2d = jnp.asarray(scale, jnp.float32)[None, :]
 
     out = pl.pallas_call(
@@ -317,8 +340,8 @@ def flash_attn_fn(block_q: int = 128, block_k: int = 128):
 # paged attention decode (flash-decoding over a paged K/V pool)
 # ---------------------------------------------------------------------------
 
-def _paged_decode_kernel(tables_ref, lens_ref, q_ref, k_ref, v_ref,
-                         acc_ref, m_ref, l_ref, *, page_size):
+def _paged_decode_kernel(tables_ref, lens_ref, *refs, page_size,
+                         quantized=False):
     """One (slot, page) grid step of online-softmax decode attention.
 
     The page block arrives via a block-table-indexed BlockSpec (scalar
@@ -331,6 +354,11 @@ def _paged_decode_kernel(tables_ref, lens_ref, q_ref, k_ref, v_ref,
     import jax
     import jax.numpy as jnp
     from jax.experimental import pallas as pl
+
+    if quantized:
+        sk_ref, sv_ref, q_ref, k_ref, v_ref, acc_ref, m_ref, l_ref = refs
+    else:
+        q_ref, k_ref, v_ref, acc_ref, m_ref, l_ref = refs
 
     b = pl.program_id(0)
     p = pl.program_id(1)
@@ -349,6 +377,11 @@ def _paged_decode_kernel(tables_ref, lens_ref, q_ref, k_ref, v_ref,
         q = q_ref[0].astype(jnp.float32)          # (h, hd), pre-scaled
         k = k_ref[0].astype(jnp.float32)          # (ps, h, hd)
         v = v_ref[0].astype(jnp.float32)
+        if quantized:
+            # int8 pages dequantise in-register: one f32 scale per page
+            # per k/v, scalar-prefetched next to the block table
+            k = k * sk_ref[tables_ref[b, p]]
+            v = v * sv_ref[tables_ref[b, p]]
         # Mosaic has no batched-dot lowering — broadcast-multiply-
         # reduce on the VPU instead; the (h, ps, hd) intermediate is
         # ~128 KB of VMEM and the page DMA dominates regardless
@@ -373,9 +406,9 @@ def _paged_decode_kernel(tables_ref, lens_ref, q_ref, k_ref, v_ref,
         acc_ref[0] = acc_ref[0] * alpha[:, None] + pv_dot
 
 
-def _paged_decode_kernel_stream(tables_ref, lens_ref, q_ref, pk_hbm, pv_hbm,
-                                acc_ref, m_ref, l_ref, *, page_size, heads,
-                                head_dim):
+def _paged_decode_kernel_stream(tables_ref, lens_ref, *refs, page_size,
+                                heads, head_dim, quantized=False,
+                                fold_lora=False, q_scale=1.0):
     """One slot of streaming flash-decoding: grid=(B,), K/V stay in HBM
     and each slot's live pages arrive via double-buffered manual DMA.
 
@@ -398,11 +431,43 @@ def _paged_decode_kernel_stream(tables_ref, lens_ref, q_ref, pk_hbm, pv_hbm,
     MXU matmuls: ``s = k @ QB`` with QB[r, c] = q[c, r - c*hd] masked to
     its head's block, and the weighted value sum via ``w @ E`` where
     E[c, r] = [r // hd == c] expands per-head weights across lanes.
+
+    r18 extensions, both trace-time static flags so the base program is
+    byte-identical with them off:
+
+    * ``quantized`` — the pool stores int8 pages with one f32 scale per
+      page per k/v; the scale tables ride the scalar prefetch next to
+      the block table and pages dequantise in-register after the DMA.
+    * ``fold_lora`` — the per-lane qkv LoRA BGMV delta computes INSIDE
+      this launch: the lane's adapter slot id (scalar prefetch) indexes
+      the factor pools in HBM, one DMA brings the lane's (r, D)/(r, 3D)
+      factors into VMEM, two VPU reductions produce the (3D,) delta,
+      the q third folds into the scores in-register (``q_scale`` is the
+      1/sqrt(hd) the caller already applied to q), and the RAW delta
+      emits as a fourth output for the caller's self-term and pool
+      write.  Slot 0 holds zero factors, so no-adapter lanes compute an
+      exact 0.0 delta through the same program.
     """
     import jax
     import jax.numpy as jnp
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
+
+    pos = 0
+    if quantized:
+        sk_ref, sv_ref = refs[0], refs[1]
+        pos = 2
+    if fold_lora:
+        adapter_ref = refs[pos]
+        pos += 1
+    q_ref = refs[pos]
+    pos += 1
+    if fold_lora:
+        x_ref, a_hbm, b_hbm = refs[pos], refs[pos + 1], refs[pos + 2]
+        pos += 3
+    pk_hbm, pv_hbm = refs[pos], refs[pos + 1]
+    acc_ref, m_ref, l_ref = refs[pos + 2], refs[pos + 3], refs[pos + 4]
+    delta_ref = refs[pos + 5] if fold_lora else None
 
     b = pl.program_id(0)
     h, hd = heads, head_dim
@@ -410,12 +475,22 @@ def _paged_decode_kernel_stream(tables_ref, lens_ref, q_ref, pk_hbm, pv_hbm,
     length = lens_ref[b]
     n_pages = jax.lax.div(length + page_size - 1, page_size)
 
-    def body(k_scratch, v_scratch, sems):
+    def body(k_scratch, v_scratch, sems, a_scr=None, b_scr=None, lsems=None):
         def dma(pool, scratch, slot, i, which):
             return pltpu.make_async_copy(
                 pool.at[tables_ref[b, i]], scratch.at[slot],
                 sems.at[slot, which],
             )
+
+        if fold_lora:
+            # the lane's factor rows start streaming before the first
+            # page DMA — the slot-index gather rides the same scalar
+            # prefetch as the block table
+            lane = adapter_ref[b]
+            cp_a = pltpu.make_async_copy(a_hbm.at[lane], a_scr, lsems.at[0])
+            cp_b = pltpu.make_async_copy(b_hbm.at[lane], b_scr, lsems.at[1])
+            cp_a.start()
+            cp_b.start()
 
         @pl.when(n_pages > 0)
         def _warmup():
@@ -423,6 +498,15 @@ def _paged_decode_kernel_stream(tables_ref, lens_ref, q_ref, pk_hbm, pv_hbm,
             dma(pv_hbm, v_scratch, 0, 0, 1).start()
 
         qflat = q_ref[0, 0].astype(jnp.float32)       # (D,), pre-scaled
+        if fold_lora:
+            cp_a.wait()
+            cp_b.wait()
+            xflat = x_ref[0, 0].astype(jnp.float32)   # (D,) block input
+            # BGMV on the VPU: t = A[lane]^T x (rank,), delta = t B[lane]
+            t = (a_scr[...].astype(jnp.float32) * xflat[None, :]).sum(axis=1)
+            delta = (t[:, None] * b_scr[...].astype(jnp.float32)).sum(axis=0)
+            delta_ref[0, 0] = delta                   # (3D,) raw, unscaled
+            qflat = qflat + q_scale * delta[:D]
         # block-diagonal projectors, built once per slot
         r_over = jax.lax.broadcasted_iota(jnp.int32, (D, h), 0) // hd
         c_idx = jax.lax.broadcasted_iota(jnp.int32, (D, h), 1)
@@ -450,6 +534,10 @@ def _paged_decode_kernel_stream(tables_ref, lens_ref, q_ref, pk_hbm, pv_hbm,
 
             k = k_scratch[slot].astype(jnp.float32)   # (ps, D)
             v = v_scratch[slot].astype(jnp.float32)
+            if quantized:
+                # per-page dequant in-register (scales scalar-prefetched)
+                k = k * sk_ref[tables_ref[b, i]]
+                v = v * sv_ref[tables_ref[b, i]]
             # HIGHEST: a default-precision f32 dot runs as bf16 MXU
             # passes and costs ~0.05 absolute score error (measured
             # against a float64 host reference; the grid kernel's VPU
@@ -495,21 +583,58 @@ def _paged_decode_kernel_stream(tables_ref, lens_ref, q_ref, pk_hbm, pv_hbm,
         l_ref[0] = jnp.broadcast_to(l_fin[:, None], l_ref.shape[1:])
 
     pool_dtype = pk_hbm.dtype
-    pl.run_scoped(
-        body,
+    scope = dict(
         k_scratch=pltpu.VMEM((2, page_size, D), pool_dtype),
         v_scratch=pltpu.VMEM((2, page_size, D), pool_dtype),
         sems=pltpu.SemaphoreType.DMA((2, 2)),
     )
+    if fold_lora:
+        rank = a_hbm.shape[1]
+        scope.update(
+            a_scr=pltpu.VMEM((rank, D), a_hbm.dtype),
+            b_scr=pltpu.VMEM((rank, 3 * D), b_hbm.dtype),
+            lsems=pltpu.SemaphoreType.DMA((2,)),
+        )
+    pl.run_scoped(body, **scope)
 
 
-def paged_attention_decode(q, pk, pv, block_tables, lengths, *, page_size):
+def paged_kernel_impl(heads: int, head_dim: int) -> str:
+    """The decode-kernel implementation that will serve this geometry —
+    the env choice (``SELDON_TPU_PAGED_KERNEL_IMPL``) plus the Mosaic
+    alignment fallback: the stream kernel DMAs (ps, h*hd) page slices
+    and Mosaic requires a 128-aligned minor dim, so tiny models
+    (h*hd % 128 != 0) take the grid kernel on hardware.  Callers that
+    gate stream-only features (the in-kernel LoRA fold) resolve through
+    here so they cannot disagree with :func:`paged_attention_decode`."""
+    from seldon_core_tpu.runtime import knobs
+
+    impl = knobs.raw("SELDON_TPU_PAGED_KERNEL_IMPL", "stream")
+    if impl == "stream" and (heads * head_dim) % 128 != 0 and not _use_interpret():
+        return "grid"
+    return impl
+
+
+def paged_attention_decode(q, pk, pv, block_tables, lengths, *, page_size,
+                           kv_scales=None, lora=None):
     """Unnormalised flash state of decode attention over a paged pool.
 
     ``q`` (B, h, hd) — current-step queries, already scaled;
     ``pk``/``pv`` (num_pages, ps, h, hd); ``block_tables`` (B, P);
     ``lengths`` (B,) cached token counts.  Returns ``(acc, m, l)``
     f32 — merge with the in-segment term via the usual flash rule.
+
+    ``kv_scales`` (r18): ``(sk, sv)`` per-page f32 scale vectors
+    ``(num_pages,)`` for an int8 pool — pages dequantise in-register
+    inside the online-softmax loop (no dequantised copy of the cache
+    ever exists in HBM).
+
+    ``lora`` (r18, stream impl only): ``(x, a_T, b, adapter_idx,
+    q_scale)`` folds the per-lane qkv BGMV delta into the same launch —
+    ``x`` (B, d) block inputs, ``a_T`` (slots, r, d) TRANSPOSED first
+    factors (the DMA wants the 128-aligned d minor), ``b`` (slots, r,
+    3d), ``adapter_idx`` (B,) int32 slot ids, ``q_scale`` the static
+    1/sqrt(hd) already applied to q.  The return grows a fourth element:
+    the raw (B, 3d) f32 delta for the caller's self-term and pool write.
 
     TPU-first replacement for the ``pk[block_tables]`` gather in
     ``PagedTransformerBlock`` (models/paged.py): the gather copies the
@@ -541,79 +666,119 @@ def paged_attention_decode(q, pk, pv, block_tables, lengths, *, page_size):
             f"page_size={page_size} does not match the pool's page dim {ps}"
         )
 
-    from seldon_core_tpu.runtime import knobs
+    quantized = kv_scales is not None
+    if quantized:
+        sk, sv = kv_scales
+        sk = jnp.asarray(sk, jnp.float32)
+        sv = jnp.asarray(sv, jnp.float32)
 
-    impl = knobs.raw("SELDON_TPU_PAGED_KERNEL_IMPL", "stream")
-    if impl == "stream" and (h * hd) % 128 != 0 and not _use_interpret():
-        # the stream kernel DMAs (ps, h*hd) page slices and Mosaic
-        # requires a 128-aligned minor dim; tiny models (h*hd < 128)
-        # take the grid kernel instead
-        impl = "grid"
+    impl = paged_kernel_impl(h, hd)
+    if lora is not None and impl != "stream":
+        raise ValueError(
+            "paged_attention_decode: the in-kernel LoRA fold is a stream-impl "
+            f"feature but paged_kernel_impl resolved to {impl!r} — callers "
+            "must gate the fold on paged_kernel_impl(heads, head_dim)"
+        )
 
     if impl == "stream":
         D = h * hd
-        grid_spec = pltpu.PrefetchScalarGridSpec(
-            num_scalar_prefetch=2,  # tables, lengths
-            grid=(B,),
-            in_specs=[
-                # q/acc ride as (B, 1, D) with (1, 1, D) blocks: the
-                # (8, 128) divisibility rule applies to the LAST TWO
-                # dims, and the singleton middle dim satisfies it
-                pl.BlockSpec((1, 1, D), lambda b, tables, lens: (b, 0, 0)),
-                pl.BlockSpec(memory_space=pl.ANY),
-                pl.BlockSpec(memory_space=pl.ANY),
-            ],
-            out_specs=[
-                pl.BlockSpec((1, 1, D), lambda b, tables, lens: (b, 0, 0)),
-                pl.BlockSpec((1, h, 128), lambda b, tables, lens: (b, 0, 0)),
-                pl.BlockSpec((1, h, 128), lambda b, tables, lens: (b, 0, 0)),
-            ],
-        )
-        kernel = functools.partial(
-            _paged_decode_kernel_stream, page_size=ps, heads=h, head_dim=hd)
+        fold = lora is not None
+        scalar_args = [block_tables, lengths]
+        n_prefetch = 2
+        if quantized:
+            scalar_args += [sk, sv]
+            n_prefetch += 2
+        if fold:
+            x, a_T, b_f, adapter_idx, q_scale = lora
+            scalar_args.append(jnp.asarray(adapter_idx, jnp.int32))
+            n_prefetch += 1
         # the kernel works in the pool's flattened (ps, h*hd) layout:
         # HBM page slices need a 128-aligned minor dim and Mosaic has no
         # value shape-casts; these reshapes are free minor-dims collapses
         q = q.reshape(B, 1, D)
         pk = pk.reshape(pk.shape[0], ps, D)
         pv = pv.reshape(pv.shape[0], ps, D)
-        acc, m, l = pl.pallas_call(
+        # q/acc ride as (B, 1, D) with (1, 1, D) blocks: the (8, 128)
+        # divisibility rule applies to the LAST TWO dims, and the
+        # singleton middle dim satisfies it.  Index lambdas take the
+        # grid ids then every scalar-prefetch operand, so *prefetch
+        # absorbs the variable tail.
+        lane_spec = pl.BlockSpec((1, 1, D), lambda b, *prefetch: (b, 0, 0))
+        in_specs = [lane_spec]
+        tensor_args = [q]
+        if fold:
+            in_specs += [
+                lane_spec,                          # x — block inputs
+                pl.BlockSpec(memory_space=pl.ANY),  # A^T factor pool
+                pl.BlockSpec(memory_space=pl.ANY),  # B factor pool
+            ]
+            tensor_args += [x.reshape(B, 1, D), a_T, b_f]
+        in_specs += [
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ]
+        tensor_args += [pk, pv]
+        pad_spec = pl.BlockSpec((1, h, 128), lambda b, *prefetch: (b, 0, 0))
+        out_specs = [lane_spec, pad_spec, pad_spec]
+        out_shape = [
+            jax.ShapeDtypeStruct((B, 1, D), jnp.float32),
+            jax.ShapeDtypeStruct((B, h, 128), jnp.float32),
+            jax.ShapeDtypeStruct((B, h, 128), jnp.float32),
+        ]
+        if fold:
+            out_specs.append(
+                pl.BlockSpec((1, 1, 3 * D), lambda b, *prefetch: (b, 0, 0)))
+            out_shape.append(jax.ShapeDtypeStruct((B, 1, 3 * D), jnp.float32))
+        grid_spec = pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=n_prefetch,
+            grid=(B,),
+            in_specs=in_specs,
+            out_specs=out_specs,
+        )
+        kernel = functools.partial(
+            _paged_decode_kernel_stream, page_size=ps, heads=h, head_dim=hd,
+            quantized=quantized, fold_lora=fold,
+            q_scale=float(q_scale) if fold else 1.0)
+        outs = pl.pallas_call(
             kernel,
             grid_spec=grid_spec,
-            out_shape=[
-                jax.ShapeDtypeStruct((B, 1, D), jnp.float32),
-                jax.ShapeDtypeStruct((B, h, 128), jnp.float32),
-                jax.ShapeDtypeStruct((B, h, 128), jnp.float32),
-            ],
+            out_shape=out_shape,
             interpret=_use_interpret(),
-        )(block_tables, lengths, q, pk, pv)
-        return acc.reshape(B, h, hd), m[:, :, 0], l[:, :, 0]
+        )(*scalar_args, *tensor_args)
+        acc, m, l = outs[0], outs[1], outs[2]
+        res = (acc.reshape(B, h, hd), m[:, :, 0], l[:, :, 0])
+        if fold:
+            res = res + (outs[3].reshape(B, 3 * D),)
+        return res
 
     if impl != "grid":
         raise ValueError(
             f"unknown SELDON_TPU_PAGED_KERNEL_IMPL {impl!r}: use 'stream' or 'grid'"
         )
+    scalar_args = [block_tables, lengths]
+    n_prefetch = 2
+    if quantized:
+        scalar_args += [sk, sv]
+        n_prefetch += 2
+    lane2 = lambda b, p, *prefetch: (b, 0, 0)  # noqa: E731
+    page2 = lambda b, p, *prefetch: (prefetch[0][b, p], 0, 0, 0)  # noqa: E731
+    pad2 = lambda b, p, *prefetch: (b, 0, 0)  # noqa: E731
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=2,  # tables, lengths
+        num_scalar_prefetch=n_prefetch,
         grid=(B, P),
         in_specs=[
-            pl.BlockSpec((1, h, hd), lambda b, p, tables, lens: (b, 0, 0)),
-            pl.BlockSpec(
-                (1, ps, h, hd),
-                lambda b, p, tables, lens: (tables[b, p], 0, 0, 0),
-            ),
-            pl.BlockSpec(
-                (1, ps, h, hd),
-                lambda b, p, tables, lens: (tables[b, p], 0, 0, 0),
-            ),
+            pl.BlockSpec((1, h, hd), lane2),
+            pl.BlockSpec((1, ps, h, hd), page2),
+            pl.BlockSpec((1, ps, h, hd), page2),
         ],
         out_specs=[
-            pl.BlockSpec((1, h, hd), lambda b, p, tables, lens: (b, 0, 0)),
-            pl.BlockSpec((1, h, 128), lambda b, p, tables, lens: (b, 0, 0)),
-            pl.BlockSpec((1, h, 128), lambda b, p, tables, lens: (b, 0, 0)),
+            pl.BlockSpec((1, h, hd), lane2),
+            pl.BlockSpec((1, h, 128), pad2),
+            pl.BlockSpec((1, h, 128), pad2),
         ],
     )
-    kernel = functools.partial(_paged_decode_kernel, page_size=ps)
+    kernel = functools.partial(
+        _paged_decode_kernel, page_size=ps, quantized=quantized)
     acc, m, l = pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
@@ -623,5 +788,5 @@ def paged_attention_decode(q, pk, pv, block_tables, lengths, *, page_size):
             jax.ShapeDtypeStruct((B, h, 128), jnp.float32),
         ],
         interpret=_use_interpret(),
-    )(block_tables, lengths, q, pk, pv)
+    )(*scalar_args, q, pk, pv)
     return acc, m[:, :, 0], l[:, :, 0]

@@ -153,12 +153,19 @@ ENV_KNOBS: Dict[str, Knob] = _knobs(
          "tensor-parallel degree over the 'model' mesh axis "
          "(unset/empty/0 = single-chip)",
          "architecture.md §5b-ter"),
-    Knob("SELDON_TPU_PAGED_KERNEL", "str", "0", True,
-         "pallas decode-kernel opt-in ('0' | '1' | 'force')",
-         "architecture.md §5b"),
+    Knob("SELDON_TPU_PAGED_KERNEL", "str", "auto", True,
+         "pallas decode-kernel lane ('0' | '1' | 'auto' | 'force'; "
+         "default 'auto' = on for single-chip TPU backends, off "
+         "elsewhere — '0' restores the XLA gather lane byte-for-byte)",
+         "architecture.md §5b-septies"),
     Knob("SELDON_TPU_PAGED_KERNEL_IMPL", "str", "stream", False,
          "pallas decode kernel implementation ('stream' | 'grid')",
          "architecture.md §5b"),
+    Knob("SELDON_TPU_KV_DTYPE", "str", "bf16", False,
+         "KV pool element dtype ('bf16' | 'int8'); int8 stores pages "
+         "quantised with one f32 scale per page per k/v in a sibling "
+         "scale table — halves pool bytes, single-chip pool-impl only",
+         "architecture.md §5b-septies"),
     Knob("SELDON_TPU_CHUNK_IMPL", "str", "", False,
          "chunk program implementation ('ring' | 'pool'; empty = auto)",
          "architecture.md §5b"),

@@ -392,6 +392,12 @@ ENGINE_STATS_METRICS: Dict[str, Tuple[str, str, str]] = {
     "health_state": ("gauge", "seldon_tpu_engine_health_state",
                      "device-health watchdog state (0 = healthy, "
                      "1 = degraded, 2 = evacuating)"),
+    "kernel_active": ("gauge", "seldon_tpu_engine_kernel_active",
+                      "decode lane actually running (1 = fused Pallas "
+                      "paged-decode kernel, 0 = XLA gather fallback)"),
+    "kv_dtype_int8": ("gauge", "seldon_tpu_engine_kv_dtype_int8",
+                      "KV pool element type (1 = int8 pages with "
+                      "per-page scales, 0 = native compute dtype)"),
 }
 
 # keys intentionally NOT exported as their own series: the wall-clock

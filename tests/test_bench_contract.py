@@ -130,6 +130,17 @@ def _r3_like_full_result():
                 "paged_capacity": {
                     "streams": 220, "ctx_len": 512, "budget_gib": 8.0,
                     "accounting": "donated", "streams_if_copied": 150,
+                    "streams_int8_kv": 436, "streams_bf16_pool": 220,
+                    "int8_capacity_x": 1.98,
+                },
+                "kernel_lane": {
+                    "hbm_bytes_per_step_bf16": 268435456,
+                    "hbm_bytes_per_step_int8": 134742016,
+                    "hbm_bytes_x": 1.99,
+                    "mosaic_grid_steps": 512,
+                    "kernel_tok_s": 6600.0, "xla_tok_s": 4400.0,
+                    "int8_kernel_tok_s": 7100.0,
+                    "paged_kernel_x": 1.5, "int8_kernel_x": 1.61,
                 },
                 "paged_tokenwise_tokens_per_s": 12.7,
                 "paged_spec_oracle_tokens_per_s": 56.1,
@@ -322,6 +333,25 @@ def test_compact_line_carries_capacity_story(bench):
         "parse", "decode", "pad", "queue_wait", "forward", "serialise"
     )
     assert e["attached_p99_bound_ms"] == 14.048
+
+
+def test_compact_line_carries_kernel_lane_story(bench):
+    """r18 certification keys: the fused-kernel speedup multiple and
+    the int8-KV capacity multiple ride the compact line (glossary-typed
+    — kernel_x a float on TPU runs or the literal "n/a" off-platform,
+    capacity_x a float from host arithmetic, certifiable anywhere); the
+    per-arm rates and HBM byte terms stay in bench_full.json."""
+    full = _r3_like_full_result()
+    e = bench._compact_result(full)["extra"]
+    assert e["paged_kernel_x"] == 1.5
+    assert e["int8_kv_cap_x"] == 1.98
+    # raw arms are full-blob-only
+    assert "kernel_tok_s" not in e and "hbm_bytes_x" not in e
+    # off-platform runs keep the schema with the sentinel, never a hole
+    full["extra"]["generation"]["kernel_lane"]["paged_kernel_x"] = "n/a"
+    e2 = bench._compact_result(full)["extra"]
+    assert e2["paged_kernel_x"] == "n/a"
+    assert e2["int8_kv_cap_x"] == 1.98
 
 
 def test_compact_line_carries_observability_overhead(bench):

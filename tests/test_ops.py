@@ -65,6 +65,36 @@ class TestInt8Matmul:
         assert out.shape == (5, 10)
         np.testing.assert_allclose(np.asarray(out), expected, rtol=1e-4, atol=1e-4)
 
+    def test_block_aligned_shapes_skip_padding(self):
+        # exact tile multiples must round-trip with no pad/slice detour
+        rng = np.random.default_rng(7)
+        x = rng.normal(size=(16, 128)).astype(np.float32)
+        w = rng.normal(size=(128, 128)).astype(np.float32)
+        w_q, scale = quantize_weights(w)
+        out = int8_matmul(jnp.asarray(x), jnp.asarray(w_q), jnp.asarray(scale),
+                          block_m=8, block_n=128)
+        expected = x @ (w_q.astype(np.float32) * scale[None, :])
+        assert out.shape == (16, 128)
+        np.testing.assert_allclose(np.asarray(out), expected, rtol=1e-4,
+                                   atol=1e-4)
+
+    def test_rejects_non_2d_operands_named(self):
+        with pytest.raises(ValueError, match=r"2-D operands.*x\(3, 4, 5\)"):
+            int8_matmul(jnp.zeros((3, 4, 5)), jnp.zeros((5, 6), jnp.int8),
+                        jnp.ones((6,)))
+
+    def test_rejects_contraction_mismatch_naming_dims(self):
+        # the error must NAME the offending dims, not echo raw shapes
+        with pytest.raises(ValueError,
+                           match=r"K=16.*K=24.*\(K\) dims must agree"):
+            int8_matmul(jnp.zeros((8, 16)), jnp.zeros((24, 32), jnp.int8),
+                        jnp.ones((32,)))
+
+    def test_rejects_wrong_scale_shape_named(self):
+        with pytest.raises(ValueError, match=r"want shape \(32,\).*N=32"):
+            int8_matmul(jnp.zeros((8, 16)), jnp.zeros((16, 32), jnp.int8),
+                        jnp.ones((16,)))
+
     def test_int8_dense_layer(self):
         rng = np.random.default_rng(3)
         kernel = rng.normal(size=(32, 16)).astype(np.float32)
