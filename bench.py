@@ -120,8 +120,11 @@ FULL_RESULT_FILE = os.environ.get(
 # line outgrew it and the whole round's numbers went uncertified
 # (BENCH_r03.json parsed: null).  The final printed line is therefore a
 # compact summary hard-capped well under the window; the complete
-# result lands in bench_full.json.
-COMPACT_BUDGET = 1500
+# result lands in bench_full.json.  r19's three mesh keys consumed the
+# last of the 1500-char headroom (priority-eviction started reaching
+# keys the contract tests pin, e.g. native_model_qps), so the cap is
+# now 1600 — still 400 chars inside the certification window.
+COMPACT_BUDGET = 1600
 
 
 # (short_key, path) in priority order — earliest survive truncation.
@@ -203,6 +206,19 @@ COMPACT_PICKS = [
     # single-chip hosts print the literal "n/a" (schema-stable line)
     ("paged_tp_tok_s", ("generation", "paged_tp_tokens_per_s")),
     ("paged_tp_eff_pct", ("generation", "paged_tp_eff_pct")),
+    # r19 2-D (data x model) serving-mesh certification: the 16-stream
+    # point over resolve_mesh(dp=2, tp=2) — KV pool sharded on BOTH
+    # page (data) and heads (model) dims, weights at ONE residency for
+    # all replica groups.  paged_mesh_eff_pct = per-chip tok/s vs the
+    # TP=1 rate x 4 ideal; longctx_max_len = largest page-aligned
+    # context ONE stream admits under the certificate budget with
+    # sequence sharding (accounting-priced; per_shard < budget < full
+    # breakdown in bench_full.json longctx).  Small hosts print the
+    # literal "n/a" for the measured pair (schema-stable line);
+    # longctx_max_len is host arithmetic and always numeric.
+    ("paged_mesh_tok_s", ("generation", "paged_mesh_tokens_per_s")),
+    ("paged_mesh_eff_pct", ("generation", "paged_mesh_eff_pct")),
+    ("longctx_max_len", ("generation", "longctx_max_len")),
     # r16 multi-LoRA certification: the 16-stream protocol with lanes
     # cycling K=4 distinct adapters (every wave mixed, ONE grouped-
     # matmul program — the phase asserts a re-mixed assignment adds
@@ -2737,6 +2753,39 @@ def generation_phase() -> dict:
             result["paged_tp_tokens_per_s"] = "n/a"
             result["paged_tp_eff_pct"] = "n/a"
             result["paged_tp_degree"] = 1
+
+        # ---- 2-D (data x model) serving mesh (r19, §5b-octies): the
+        # same 16-stream protocol over resolve_mesh(dp=2, tp=2) —
+        # weights replicated over `data` (ONE residency for all replica
+        # groups), heads megatron-sharded over `model`, the KV pool
+        # sharded on BOTH its page dim (data) and heads dim (model),
+        # slot-major host lanes batch-sharded over `data`.  The gate is
+        # per-chip: (mesh rate / 4 chips) vs the TP=1 rate above.
+        # Small hosts emit "n/a" so the compact line stays
+        # schema-stable — a missing key would read as a phase crash.
+        if len(jax.devices()) >= 4:
+            mesh_eng = PagedEngine(
+                params, dtype=jnp.bfloat16, page_size=64,
+                max_slots=serve_slots, steps_per_call=8,
+                max_steps_per_call=64 if quick else 256,
+                tp=2, dp=2, **serve_cfg,
+            )
+            # certify the REAL 2-D lane: a silent shrink of either
+            # axis would measure the wrong layout and stamp it 2x2
+            assert mesh_eng.tp_degree == 2 and mesh_eng.dp_degree == 2, (
+                f"mesh engine degraded to (dp={mesh_eng.dp_degree}, "
+                f"tp={mesh_eng.tp_degree})"
+            )
+            mbest = measure_point(mesh_eng, sprompts)
+            result["paged_mesh_tokens_per_s"] = round(mbest["rate"], 1)
+            result["paged_mesh_axes"] = "2x2 (data x model)"
+            base = max(result.get("paged_serving_tokens_per_s", 0.0), 1e-9)
+            result["paged_mesh_eff_pct"] = round(
+                100.0 * (mbest["rate"] / 4) / base, 1
+            )
+        else:
+            result["paged_mesh_tokens_per_s"] = "n/a"
+            result["paged_mesh_eff_pct"] = "n/a"
     except Exception as e:  # noqa: BLE001
         result["paged_serving_error"] = str(e)[:200]
 
@@ -3262,6 +3311,83 @@ def generation_phase() -> dict:
         }
     except Exception as e:  # noqa: BLE001
         result["paged_capacity_error"] = str(e)[:200]
+
+    # ---- sequence-sharded long context (r19, §5b-octies): the 2-D
+    # mesh's capacity claim, priced by the SAME accounting that gates
+    # admission.  The certificate is a budget chosen strictly between
+    # the per-shard and full peak bytes of one 32k-token stream:
+    # per_shard < budget < full proves a (dp=2, tp=2) mesh admits a
+    # context no single chip's pool can hold.  All of that is host
+    # arithmetic (runs on every platform); the decode point itself
+    # needs a real accelerator with >= 4 devices, so small hosts print
+    # "n/a" and keep the schema stable.
+    try:
+        from seldon_core_tpu.models.paged import (
+            PagedEngine,
+            paged_hbm_accounting,
+            paged_max_context,
+        )
+
+        lc_ctx = 32 * 1024
+        lc_model = dict(
+            d_model=cfg["d_model"], num_layers=cfg["num_layers"],
+            steps_per_call=8, dtype_bytes=2,
+            flat_pool=True, chunk_impl="ring",
+        )
+        lc_full = paged_hbm_accounting(streams=1, ctx_len=lc_ctx, **lc_model)
+        lc_shard = paged_hbm_accounting(
+            streams=1, ctx_len=lc_ctx, tp_degree=2, dp_degree=2, **lc_model
+        )
+        lc_budget = (lc_shard["peak_bytes"] + lc_full["peak_bytes"]) // 2
+        assert lc_shard["peak_bytes"] < lc_budget < lc_full["peak_bytes"], (
+            "long-context certificate must sit strictly between the "
+            f"per-shard ({lc_shard['peak_bytes']}) and full "
+            f"({lc_full['peak_bytes']}) bytes"
+        )
+        result["longctx_max_len"] = paged_max_context(
+            lc_budget, tp_degree=2, dp_degree=2, **lc_model
+        )
+        result["longctx"] = {
+            "ctx_len": lc_ctx,
+            "budget_bytes": int(lc_budget),
+            "shard_peak_bytes": lc_shard["peak_bytes"],
+            "full_peak_bytes": lc_full["peak_bytes"],
+            "mesh": "dp=2 x tp=2",
+            "admits_single_chip": lc_full["peak_bytes"] <= lc_budget,
+            "admits_mesh": lc_shard["peak_bytes"] <= lc_budget,
+            "max_len_single_chip": paged_max_context(lc_budget, **lc_model),
+        }
+        if jax.default_backend() == "tpu" and len(jax.devices()) >= 4:
+            # admit + decode ONE 32k-context stream under the mesh the
+            # certificate priced (position table sized to the context,
+            # so this arm owns its params)
+            lc_cfg = dict(cfg, max_len=lc_ctx)
+            lc_lm = TransformerLM(dtype=jnp.bfloat16, **lc_cfg)
+            lc_params = lc_lm.init(
+                jax.random.key(0), jnp.zeros((1, 8), jnp.int32)
+            )["params"]
+            lc_eng = PagedEngine(
+                lc_params, dtype=jnp.bfloat16, page_size=64, max_slots=2,
+                steps_per_call=8, max_steps_per_call=64, tp=2, dp=2,
+                **lc_cfg,
+            )
+            assert lc_eng.dp_degree == 2, "long-context arm lost its mesh"
+            try:
+                lc_prompt = np.random.default_rng(7).integers(
+                    0, cfg["vocab_size"], size=(lc_ctx - 128,)
+                ).astype(np.int32)
+                stream = lc_eng.submit(lc_prompt, max_new_tokens=64)
+                t0 = _time.perf_counter()
+                lc_eng.run()
+                dt = _time.perf_counter() - t0
+                assert stream.result is not None
+                result["longctx_decode_tokens_per_s"] = round(64 / dt, 1)
+            finally:
+                lc_eng.close()
+        else:
+            result["longctx_decode_tokens_per_s"] = "n/a"
+    except Exception as e:  # noqa: BLE001
+        result["longctx_error"] = str(e)[:200]
 
     # ---- fused paged-decode kernel lane (r18, ROADMAP 1): the Pallas
     # flash-decode kernel is now the pool-impl DEFAULT; this blob

@@ -77,6 +77,7 @@ class Generator:
         prompt_buckets: Optional[Sequence[int]] = None,
         mesh: Any = None,
         tp: Optional[int] = None,
+        dp: Optional[int] = None,
         quantize: str = "",
     ):
         import jax
@@ -100,18 +101,20 @@ class Generator:
 
             params, self.quantize_manifest = quantize_params(params)
         self._compute_dtype = dtype
-        # tensor-parallel knob (r11), same precedence as PagedEngine: an
-        # explicit mesh wins; otherwise tp= / SELDON_TPU_TP builds the
-        # {"model": tp} mesh (degrading to single-chip with a WARN on
-        # small hosts).  Megatron-sharded params pin the layout; the
-        # mutable flax cache is created inside the compiled programs, so
-        # GSPMD propagates the head sharding through it and inserts the
-        # collectives — mesh=None keeps the historical single-chip path
-        # byte-identical.
+        # serving-mesh knobs (r11 tp, r19 dp), same precedence as
+        # PagedEngine: an explicit mesh wins; otherwise tp=/dp= (or
+        # SELDON_TPU_TP/SELDON_TPU_DP) build the 2-D {data, model}
+        # serving mesh (shrinking the data axis first with a WARN on
+        # small hosts).  Megatron-sharded params pin the layout —
+        # their specs only name the model axis, so weights replicate
+        # over data implicitly; the mutable flax cache is created
+        # inside the compiled programs, so GSPMD propagates the head
+        # sharding through it and inserts the collectives — mesh=None
+        # keeps the historical single-chip path byte-identical.
         if mesh is None:
-            from seldon_core_tpu.parallel.mesh import tp_mesh
+            from seldon_core_tpu.parallel.mesh import resolve_mesh
 
-            mesh = tp_mesh(tp)
+            mesh = resolve_mesh(tp=tp, dp=dp)
         self._mesh = mesh
         if mesh is not None:
             from seldon_core_tpu.parallel.mesh import mesh_shape
@@ -119,11 +122,13 @@ class Generator:
 
             self.params = shard_params(params, mesh)
             self.tp_degree = int(mesh_shape(mesh).get("model", 1))
+            self.dp_degree = int(mesh_shape(mesh).get("data", 1))
         else:
             # pin on device: surgery/msgpack trees are host numpy, and
             # numpy args to jit re-upload every call
             self.params = jax.device_put(params)
             self.tp_degree = 1
+            self.dp_degree = 1
         self.module = TransformerLM(
             vocab_size=vocab_size, d_model=d_model, num_layers=num_layers,
             num_heads=num_heads, max_len=max_len, dtype=dtype, decode=True,
@@ -326,6 +331,7 @@ class GenerativeLM(TPUComponent):
         model_uri: str = "",
         seed: int = 0,
         tp: int = 0,
+        dp: int = 0,
         quantize: str = "",
         **kwargs: Any,
     ):
@@ -335,9 +341,11 @@ class GenerativeLM(TPUComponent):
             num_layers=int(num_layers), num_heads=int(num_heads),
             max_len=int(max_len),
         )
-        # tensor-parallel serving degree (r11): 0 defers to
-        # SELDON_TPU_TP, degrading to single-chip on small hosts
+        # serving-mesh degrees (r11 tp, r19 dp): 0 defers to
+        # SELDON_TPU_TP / SELDON_TPU_DP, shrinking the data axis
+        # first on small hosts
         self.tp = int(tp)
+        self.dp = int(dp)
         self.max_new_tokens = int(max_new_tokens)
         self.temperature = float(temperature)
         self.top_k = int(top_k)
@@ -365,6 +373,7 @@ class GenerativeLM(TPUComponent):
             params = load_lm_params(self.model_uri, self.config, self.seed)
             self.generator = Generator(
                 params, quantize=self.quantize, tp=self.tp or None,
+                dp=self.dp or None,
                 **self.config,
             )
 

@@ -928,6 +928,8 @@ def paged_hbm_accounting(
     split_tile_pad: float = 2.0,
     cached_prefix_pages: int = 0,
     tp_degree: int = 1,
+    dp_degree: int = 1,
+    num_pool_pages: Optional[int] = None,
     num_heads: Optional[int] = None,
     inflight_prefill_tokens: int = 0,
     adapter_bytes: int = 0,
@@ -996,6 +998,22 @@ def paged_hbm_accounting(
       (refcount-0) sets next to the prefix cache's reclaimable pages —
       capacity, never cost.
 
+    * **data axis / sequence sharding (r19)** — ``dp_degree > 1``
+      prices the 2-D serving mesh: the pool's PAGE dim is sharded over
+      ``data`` (on top of the ``model`` heads sharding), so per-device
+      pool bytes divide by BOTH degrees — this is the long-context
+      claim: a 32k stream whose full pool bytes exceed one chip's
+      budget admits when its per-shard slice fits
+      (:func:`paged_max_context` inverts this).  Pass
+      ``num_pool_pages`` (the engine's dp-rounded pool) to carry the
+      page-divisibility constraint: an indivisible pool leaves the
+      page dim REPLICATED at engine load (``shard_decode_state``'s
+      WARN fallback), so the accounting prices full page bytes rather
+      than certifying capacity the fallback cannot deliver.  The ring
+      working set divides with the lane sharding (slot-major arrays
+      batch-shard over ``data``); tables/lengths stay out of scope as
+      under TP.
+
     * **int8 KV pool (r18)** — ``kv_dtype="int8"`` prices pages at ONE
       byte per element plus the sibling scale table's 8 bytes per page
       (one f32 per page per k/v per layer): ~2x
@@ -1013,6 +1031,12 @@ def paged_hbm_accounting(
         # mirror shard_decode_state: this configuration serves with a
         # replicated pool, so one device really holds the full bytes
         shard = 1
+    dshard = max(1, int(dp_degree))
+    if num_pool_pages is not None and num_pool_pages % dshard:
+        # mirror shard_decode_state's page-dim guard: an indivisible
+        # pool replicates over `data`, so price the full page bytes
+        dshard = 1
+    kv_shard = shard * dshard
     pages = -(-ctx_len // page_size)
     kv_int8 = kv_dtype == "int8"
     pool_elt_bytes = 1 if kv_int8 else dtype_bytes
@@ -1021,17 +1045,17 @@ def paged_hbm_accounting(
     page_scale_bytes = num_layers * 2 * 4 if kv_int8 else 0
     pool_pad = 1.0 if flat_pool else split_tile_pad
     page_bytes = page_size * tok_bytes * pool_pad + page_scale_bytes
-    pool = int(streams * pages * page_bytes) // shard
+    pool = int(streams * pages * page_bytes) // kv_shard
     ws = 0
     if chunk_impl == "ring":
         # the ring impl's gathered working set holds the COMPUTE dtype
         ws = int(
             streams * (pages * page_size + steps_per_call)
             * num_layers * d_model * 2 * dtype_bytes * split_tile_pad
-        ) // shard
+        ) // kv_shard
     at_rest = pool if donated else 2 * pool
     inflight_pages = -(-int(inflight_prefill_tokens) // page_size)
-    inflight = int(inflight_pages * page_bytes) // shard
+    inflight = int(inflight_pages * page_bytes) // kv_shard
     return {
         "pool_bytes": pool,
         "working_set_bytes": ws,
@@ -1039,11 +1063,12 @@ def paged_hbm_accounting(
         "per_stream_bytes": (at_rest + ws) // max(1, streams),
         "reclaimable_bytes": int(
             cached_prefix_pages * page_bytes
-        ) // shard + int(reclaimable_weight_bytes),
+        ) // kv_shard + int(reclaimable_weight_bytes),
         "inflight_prefill_bytes": inflight,
         "adapter_bytes": int(adapter_bytes),
         "reclaimable_weight_bytes": int(reclaimable_weight_bytes),
         "tp_degree": shard,
+        "dp_degree": dshard,
     }
 
 
@@ -1083,6 +1108,37 @@ def paged_capacity_streams(
     per_stream = max(1, one["peak_bytes"] - fixed)
     usable = max(0, int(budget_bytes) - fixed)
     return int(usable // per_stream)
+
+
+def paged_max_context(
+    budget_bytes: int, *, page_size: int = 64, max_len_cap: int = 1 << 20,
+    **model_kw,
+) -> int:
+    """Largest page-aligned context ONE stream can hold under a
+    per-chip HBM budget — :func:`paged_capacity_streams` inverted over
+    ``ctx_len`` instead of ``streams`` (the ``longctx_max_len`` bench
+    key).  Per-stream peak bytes grow monotonically with context, so a
+    binary search over page counts suffices; ``dp_degree > 1`` in
+    ``model_kw`` is the whole point — sequence sharding divides the
+    per-shard bytes, so the admissible context multiplies with the
+    data axis (the 2-D mesh's long-context claim, priced not assumed).
+    Returns 0 when not even one page fits."""
+    def fits(ctx_len: int) -> bool:
+        one = paged_hbm_accounting(
+            streams=1, ctx_len=ctx_len, page_size=page_size, **model_kw
+        )
+        return one["peak_bytes"] <= int(budget_bytes)
+
+    lo, hi = 0, max_len_cap // page_size
+    if not fits(page_size):
+        return 0
+    while lo < hi:
+        mid = (lo + hi + 1) // 2
+        if fits(mid * page_size):
+            lo = mid
+        else:
+            hi = mid - 1
+    return lo * page_size
 
 
 # ---------------------------------------------------------------------------
@@ -1300,7 +1356,9 @@ class PagedEngine:
         dtype: Any = None,
         mesh: Any = None,
         tp: Optional[int] = None,
+        dp: Optional[int] = None,
         model_axis: str = "model",
+        data_axis: str = "data",
         shard_min_weight_size: int = 16_384,
         quantize: str = "",
         precision: str = "",
@@ -1317,15 +1375,21 @@ class PagedEngine:
 
         if max_len % page_size:
             raise ValueError(f"max_len {max_len} must be a multiple of page_size {page_size}")
-        # tensor-parallel knob (r11): an explicit mesh wins; otherwise
-        # `tp=` (constructor) / SELDON_TPU_TP (env) builds the {"model":
-        # tp} serving mesh, degrading to single-chip with a WARN when
-        # the host exposes fewer devices — one deployment config rolls
-        # out across pod and dev hosts unchanged
+        # serving-mesh knobs (r11 tp, r19 dp): an explicit mesh wins;
+        # otherwise `tp=`/`dp=` (constructor) / SELDON_TPU_TP /
+        # SELDON_TPU_DP (env) resolve through the ONE precedence home
+        # (parallel.mesh.resolve_mesh) into the {"data": dp, "model":
+        # tp} serving mesh — size-1 axes dropped, so dp=1 keeps the
+        # PR 7 1-D mesh (and dp=tp=1 keeps mesh=None) byte-identical —
+        # degrading shrink-data-first with a WARN when the host exposes
+        # fewer devices: one deployment config rolls out across pod and
+        # dev hosts unchanged
         if mesh is None:
-            from seldon_core_tpu.parallel.mesh import tp_mesh
+            from seldon_core_tpu.parallel.mesh import resolve_mesh
 
-            mesh = tp_mesh(tp, axis=model_axis)
+            mesh = resolve_mesh(
+                tp=tp, dp=dp, model_axis=model_axis, data_axis=data_axis
+            )
         from seldon_core_tpu.ops.surgery import (
             quantize_mode_for,
             validate_precision,
@@ -1375,6 +1439,26 @@ class PagedEngine:
         self.num_pages = int(
             num_pages or self.max_slots * self.pages_per_stream + 1
         )
+        # data-axis degree this engine will run at (r19) — resolved
+        # here because the pool geometry below depends on it
+        if mesh is not None:
+            from seldon_core_tpu.parallel.mesh import mesh_shape as _msh
+
+            _dp = int(_msh(mesh).get(data_axis, 1))
+        else:
+            _dp = 1
+        # sequence sharding (r19): the data axis also shards the pool's
+        # PAGE dim, so one long stream's KV pages spread across the
+        # axis (per-shard residency = pool/dp — the long-context
+        # capacity claim paged_hbm_accounting(dp_degree=) prices).
+        # SELDON_TPU_SEQ_SHARD=0 keeps the pool replicated over data:
+        # pure throughput replica groups, no capacity claim.
+        self._seq_shard = _knobs.flag("SELDON_TPU_SEQ_SHARD")
+        if _dp > 1 and self._seq_shard and self.num_pages % _dp:
+            # page-dim sharding needs equal shards; rounding the pool
+            # UP never shrinks capacity and only fires under dp>1, so
+            # dp=1 pool geometry stays byte-identical
+            self.num_pages += -self.num_pages % _dp
         self.prompt_buckets = sorted(set(prompt_buckets or _buckets_for(max_len)))
         head_dim = d_model // num_heads
         module_precision = "w8a8" if self.precision == "w8a8" else "bf16"
@@ -1519,8 +1603,9 @@ class PagedEngine:
 
         self.params, self.pages_k, self.pages_v = shard_decode_state(
             params, mesh, pool_shape=pool_shape, dtype=pool_dtype,
-            model_axis=model_axis, min_weight_size=shard_min_weight_size,
-            num_heads=num_heads,
+            model_axis=model_axis, data_axis=data_axis,
+            min_weight_size=shard_min_weight_size,
+            num_heads=num_heads, seq_shard=self._seq_shard,
         )
         # sibling per-page scale tables (int8 pool only): one f32 per
         # page per k/v, indexed exactly like the pool's page axis — the
@@ -1538,6 +1623,8 @@ class PagedEngine:
         # an unshardable pool reports full bytes honestly)
         self._mesh = mesh
         self._model_axis = model_axis
+        self._data_axis = data_axis
+        self.dp_degree = _dp
         if mesh is not None:
             from seldon_core_tpu.parallel.mesh import mesh_shape
 
@@ -1549,6 +1636,26 @@ class PagedEngine:
             self._pool_shard_bytes = 2 * int(self.pages_k.nbytes)
             if self._kv_int8:
                 self._pool_shard_bytes += 2 * int(self.scales_k.nbytes)
+        # lane sharding (r19): under dp>1 the slot-major host arrays
+        # (logits, block tables, sampling knobs, rng keys) batch-shard
+        # on the data axis — each replica group carries max_slots/dp
+        # lanes.  Indivisible slot counts replicate the lanes (the
+        # pool's page sharding still holds, so the long-context
+        # capacity claim survives) with a WARN.
+        self._lane_sharded = _dp > 1 and self.max_slots % _dp == 0
+        if self._lane_sharded:
+            from jax.sharding import NamedSharding as _NS, PartitionSpec as _P
+
+            self._lane_sharding = _NS(mesh, _P(data_axis))
+        else:
+            self._lane_sharding = None
+        if _dp > 1 and not self._lane_sharded:
+            logger.warning(
+                "decode lanes NOT sharded over (%r, %r): max_slots=%d "
+                "is not divisible by mesh axis %r size %d — lane-major "
+                "arrays replicate (pool page sharding is unaffected)",
+                data_axis, model_axis, self.max_slots, data_axis, _dp,
+            )
         self._logits = jnp.zeros((self.max_slots, self.vocab_size), jnp.float32)
         # rng state kept as raw key data so masked carries can jnp.where it
         self._keys = jax.random.key_data(
@@ -1890,8 +1997,8 @@ class PagedEngine:
             self._sentinels["paged_spec_chunk"].wrap(
                 self._tp_jit(
                     self._spec_chunk_fn, n_rep_in=5,
-                    out_spec=("rep", "rep", "pool", "pool", "rep"),
-                    lora=True,
+                    out_spec=("lane", "lane", "pool", "pool", "lane"),
+                    lora=True, lane_hosts=True,
                 )
             )
             if self.speculative is not None else None
@@ -1922,6 +2029,21 @@ class PagedEngine:
         else:
             self.pages_k, self.pages_v = pk, pv
 
+    def _lane_put(self, x):
+        """Pin a carried slot-major device array to the lane sharding.
+
+        The decode chunk's in_shardings batch-shard lane arrays on the
+        ``data`` axis, but jit refuses COMMITTED args whose sharding
+        differs — and ``self._logits``/``self._keys`` arrive committed
+        from the prefill program (replicated) or from host-side
+        ``.at[].set`` edits.  Steady state this is a no-op (device_put
+        short-circuits on an equal sharding); after a prefill it is the
+        one reshard copy that moves the new lane onto its shard.
+        Single-chip and 1-D-mesh engines return ``x`` untouched."""
+        if self._lane_sharding is None:
+            return x
+        return self._jax.device_put(x, self._lane_sharding)
+
     def _materialize(self, params):
         """Once-per-program dequant of int8 weights (no-op for fp).
         Call at program ENTRY, never inside a scan step — per-step
@@ -1936,22 +2058,39 @@ class PagedEngine:
 
     def _tp_jit(self, fn, *, n_rep_in: int, out_spec: Sequence[str],
                 donate_argnums: Tuple[int, ...] = (1, 2),
-                lora: bool = False):
-        """jit an engine program, annotated for GSPMD under a TP mesh.
+                lora: bool = False, lane_hosts: bool = False):
+        """jit an engine program, annotated for GSPMD under the
+        serving mesh (1-D ``{model}`` or 2-D ``{data, model}``).
 
         Every engine program shares one argument convention — ``(params,
         pk, pv, *host_arrays)`` — so one helper covers the prefill, the
         cached-suffix prefill, the bucketed chunk, and the speculative
-        verify: params pin their megatron specs, pools pin the
-        heads-sharded layout (in AND out, so the donated buffers round-
-        trip without a resharding copy per call), and everything else
-        (tokens, block tables, lengths, rng keys, sampling knobs) is
-        explicitly replicated — block tables stay replicated because
-        every shard gathers its own head-slice of every page, and the
-        tables are KBs against the pool's GBs.  Pinning the whole
-        signature keeps the partitioner deterministic: one GSPMD
-        program, collectives inserted by XLA, no propagation choices
-        left to vary run-to-run.
+        verify: params pin their megatron specs (naming only the
+        ``model`` axis, so under a 2-D mesh ONE weight residency is
+        shared — replicated — across the data axis's replica groups),
+        pools pin the page+heads-sharded layout (in AND out, so the
+        donated buffers round-trip without a resharding copy per call),
+        and everything else is pinned per ``lane_hosts``:
+
+        * ``lane_hosts=False`` (prefills, KV import) — host arrays are
+          explicitly replicated; prefill batches are ragged joiner
+          groups, not the slot array, so they don't batch-shard.
+        * ``lane_hosts=True`` (decode chunk, speculative verify) — the
+          slot-major host arrays (and ``"lane"`` outputs) shard their
+          lane dim 0 on the ``data`` axis when the engine runs dp>1
+          with a divisible slot count; otherwise ``lane`` degenerates
+          to the replicated sharding, so 1-D-mesh programs keep the
+          PR 7 annotation spelling VALUE-IDENTICAL (the byte-identity
+          bar the lowering tests assert).
+
+        Block tables ride the lane rule: each data shard owns its own
+        lanes' tables, while the pages they index live page-sharded
+        across the axis — GSPMD partitions the pool gather/scatter
+        (partial gather + mask + all-reduce; zeros sum bit-exactly in
+        f32, which is why (2,2) greedy stays bit-exact vs TP-only).
+        Pinning the whole signature keeps the partitioner
+        deterministic: one GSPMD program, collectives inserted by XLA,
+        no propagation choices left to vary run-to-run.
 
         ``mesh=None`` returns the EXACT historical ``jax.jit`` call —
         no annotation objects are even constructed — so TP=1 programs
@@ -1971,13 +2110,17 @@ class PagedEngine:
         from jax.sharding import NamedSharding, PartitionSpec as P
 
         rep = NamedSharding(self._mesh, P())
+        lane = (
+            self._lane_sharding
+            if lane_hosts and self._lane_sharding is not None else rep
+        )
         pool = self.pages_k.sharding
         # leaves the shard_params guard left host-side have no sharding:
         # replicate them explicitly
         param_sh = jax.tree.map(
             lambda x: getattr(x, "sharding", rep), self.params
         )
-        in_sh: Tuple[Any, ...] = (param_sh, pool, pool) + (rep,) * n_rep_in
+        in_sh: Tuple[Any, ...] = (param_sh, pool, pool) + (lane,) * n_rep_in
         if lora and self._lora is not None:
             in_sh = in_sh + (
                 self._lora.shardings(self._mesh, self._model_axis), rep,
@@ -1987,7 +2130,8 @@ class PagedEngine:
             donate_argnums=donate_argnums,
             in_shardings=in_sh,
             out_shardings=tuple(
-                pool if o == "pool" else rep for o in out_spec
+                pool if o == "pool" else lane if o == "lane" else rep
+                for o in out_spec
             ),
         )
 
@@ -2227,9 +2371,9 @@ class PagedEngine:
             body = partial(self._chunk_fn, steps, buckets)
         return self._tp_jit(
             body, n_rep_in=11,
-            out_spec=("rep", "pool", "pool", "rep", "rep", "rep",
-                      "rep", "rep"),
-            lora=True,
+            out_spec=("lane", "pool", "pool", "lane", "lane", "lane",
+                      "lane", "lane"),
+            lora=True, lane_hosts=True,
         )
 
     def lower_chunk(self, steps: int, buckets: Tuple[Tuple[int, int], ...]):
@@ -4829,6 +4973,12 @@ class PagedEngine:
                 # what capacity planning prices (paged_hbm_accounting's
                 # tp_degree term)
                 "tp_degree": self.tp_degree,
+                # serving-mesh data axis (r19): replica groups sharing
+                # this engine's one weight residency; >1 also means the
+                # pool's page dim is spread across the axis (unless
+                # SELDON_TPU_SEQ_SHARD=0), which is what the
+                # long-context capacity claim prices
+                "dp_degree": self.dp_degree,
                 "pool_shard_bytes": self._pool_shard_bytes,
                 # chunked-prefill co-scheduling (r15): the wave token
                 # budget this engine runs under (0 = monolithic prefill)
@@ -5093,6 +5243,7 @@ class PagedEngine:
             "wall_ms": round(wall_s * 1000.0, 3),
             "prefill_wall_ms": round(wall_s * 1000.0, 3),
             "tp_degree": self.tp_degree,
+            "dp_degree": self.dp_degree,
             "steps": 0,
             "buckets": [],
             "occupancy": occupancy,
@@ -5319,8 +5470,9 @@ class PagedEngine:
         self._profile_before_chunk()
         t_chunk = _time.perf_counter()
         chunk_args = (
-            self.params, *self._kv_args(), self._logits,
-            lengths, tables, self._keys, jnp.asarray(done_in),
+            self.params, *self._kv_args(), self._lane_put(self._logits),
+            lengths, tables, self._lane_put(self._keys),
+            jnp.asarray(done_in),
             emitted0, jnp.asarray(max_new), jnp.asarray(temps),
             jnp.asarray(top_ks), jnp.asarray(eos_ids), jnp.asarray(perm),
         )
@@ -5386,6 +5538,7 @@ class PagedEngine:
             "wall_ms": round(chunk_wall * 1000.0, 3),
             "prefill_wall_ms": round(wave_prefill_wall * 1000.0, 3),
             "tp_degree": self.tp_degree,
+            "dp_degree": self.dp_degree,
             "steps": steps,
             "buckets": [list(b) for b in buckets],
             "occupancy": len(active),
@@ -5656,6 +5809,7 @@ class PagedEngine:
             "wall_ms": round(chunk_wall * 1000.0, 3),
             "prefill_wall_ms": round(wave_prefill_wall * 1000.0, 3),
             "tp_degree": self.tp_degree,
+            "dp_degree": self.dp_degree,
             "steps": self.draft_k + 1,
             "buckets": [],
             "occupancy": len(active),
@@ -5727,6 +5881,7 @@ class StreamingLM(TPUComponent):
         max_steps_per_call: int = 0,
         mesh_axes: Optional[Dict[str, int]] = None,
         tp: int = 0,
+        dp: int = 0,
         quantize: str = "",
         precision: str = "",
         speculative: Optional[Dict[str, Any]] = None,
@@ -5785,11 +5940,13 @@ class StreamingLM(TPUComponent):
             adapters = _json.loads(adapters) if adapters else None
         self.adapters = dict(adapters) if adapters else {}
         self.mesh_axes = dict(mesh_axes) if mesh_axes else None
-        # tensor-parallel serving degree (r11): `tp=N` (or SELDON_TPU_TP
-        # when 0) is the deployment-facing spelling of mesh_axes=
-        # {"model": N}; an explicit mesh_axes wins.  Degrades to
-        # single-chip with a WARN on hosts with fewer devices.
+        # serving-mesh degrees (r11 tp, r19 dp): `tp=N` / `dp=D` (or
+        # SELDON_TPU_TP / SELDON_TPU_DP when 0) are the deployment-
+        # facing spelling of mesh_axes={"data": D, "model": N}; an
+        # explicit mesh_axes wins.  Degrades shrink-data-first with a
+        # WARN on hosts with fewer devices (resolve_mesh).
         self.tp = int(tp)
+        self.dp = int(dp)
         self.max_new_tokens = int(max_new_tokens)
         self.temperature = float(temperature)
         self.top_k = int(top_k)
@@ -5832,11 +5989,13 @@ class StreamingLM(TPUComponent):
             # adapters materialise on first selection, budget-priced),
             # and the engine resolves names through it at submit
             registry = self._register_adapters()
-            # tp passed THROUGH so the engine resolves the knob exactly
-            # once: an explicit tp=1 here must force single-chip even
-            # with SELDON_TPU_TP exported (mesh_axes still wins)
+            # tp/dp passed THROUGH so the engine resolves the knobs
+            # exactly once: an explicit tp=1/dp=1 here must force the
+            # axis off even with SELDON_TPU_TP / SELDON_TPU_DP
+            # exported (mesh_axes still wins)
             engine = PagedEngine(
                 params, dtype=jnp.bfloat16, mesh=mesh, tp=self.tp or None,
+                dp=self.dp or None,
                 max_adapters=self.max_adapters, lora_rank=self.lora_rank,
                 weight_registry=registry,
                 **self.config, **self.engine_config,
@@ -6433,6 +6592,8 @@ class StreamingLM(TPUComponent):
              "value": s["prefix_tokens_saved"]},
             {"type": "GAUGE", "key": "paged_tp_degree",
              "value": s["tp_degree"]},
+            {"type": "GAUGE", "key": "paged_dp_degree",
+             "value": s["dp_degree"]},
             {"type": "GAUGE", "key": "paged_adapters_resident",
              "value": s["adapters_resident"]},
         ] + (

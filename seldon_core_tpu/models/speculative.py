@@ -65,8 +65,9 @@ class _PagedState:
     """Single-stream paged cache with an identity block table."""
 
     def __init__(self, module, params, *, max_len: int, page_size: int, dtype,
-                 mesh=None, model_axis: str = "model",
-                 min_weight_size: int = 16_384, quantize: str = ""):
+                 mesh=None, model_axis: str = "model", data_axis: str = "data",
+                 min_weight_size: int = 16_384, quantize: str = "",
+                 seq_shard: bool = True):
         import jax.numpy as jnp
 
         from seldon_core_tpu.ops.surgery import validate_quantize_mode
@@ -82,6 +83,15 @@ class _PagedState:
         self.max_len = max_len
         self.page_size = page_size
         num_pages = max_len // page_size + 1  # + trash page 0
+        # 2-D mesh (r19): page dim shards over the data axis, so round
+        # the pool up to a dp multiple (extra tail pages are simply
+        # never referenced by the identity table)
+        if mesh is not None and seq_shard:
+            from seldon_core_tpu.parallel.mesh import mesh_shape
+
+            _dp = mesh_shape(mesh).get(data_axis, 1)
+            if _dp > 1 and num_pages % _dp:
+                num_pages += -num_pages % _dp
         cfg = module
         head_dim = cfg.d_model // cfg.num_heads
         from seldon_core_tpu.models.paged import pool_is_flat
@@ -99,8 +109,9 @@ class _PagedState:
 
         self.params, self.pk, self.pv = shard_decode_state(
             params, mesh, pool_shape=shape, dtype=dtype,
-            model_axis=model_axis, min_weight_size=min_weight_size,
-            num_heads=cfg.num_heads,
+            model_axis=model_axis, data_axis=data_axis,
+            min_weight_size=min_weight_size,
+            num_heads=cfg.num_heads, seq_shard=seq_shard,
         )
         # logical page p lives at pool page p+1 (0 is the trash page)
         self.table = jnp.arange(1, max_len // page_size + 1, dtype=jnp.int32)[None, :]
@@ -135,7 +146,9 @@ class SpeculativeGenerator:
         dtype: Any = None,
         mesh: Any = None,
         tp: Optional[int] = None,
+        dp: Optional[int] = None,
         model_axis: str = "model",
+        data_axis: str = "data",
         shard_min_weight_size: int = 16_384,
         quantize: str = "",
         chunk_token_budget: int = 0,
@@ -143,14 +156,17 @@ class SpeculativeGenerator:
         import jax
         import jax.numpy as jnp
 
-        # tensor-parallel knob (r11), same precedence as PagedEngine:
-        # an explicit mesh wins; otherwise tp= / SELDON_TPU_TP builds
-        # the {"model": tp} serving mesh, degrading to single-chip
-        # with a WARN when the host exposes fewer devices
+        # serving-mesh knobs (r11 tp, r19 dp), same precedence as
+        # PagedEngine: an explicit mesh wins; otherwise tp=/dp= (or
+        # SELDON_TPU_TP/SELDON_TPU_DP) build the 2-D {data, model}
+        # serving mesh, shrinking the data axis first with a WARN when
+        # the host exposes fewer devices
         if mesh is None:
-            from seldon_core_tpu.parallel.mesh import tp_mesh
+            from seldon_core_tpu.parallel.mesh import resolve_mesh
 
-            mesh = tp_mesh(tp, axis=model_axis)
+            mesh = resolve_mesh(
+                tp=tp, dp=dp, model_axis=model_axis, data_axis=data_axis
+            )
         if max_len % page_size:
             raise ValueError(f"max_len {max_len} must be a multiple of page_size {page_size}")
         if draft not in ("ngram", "model"):
@@ -184,6 +200,10 @@ class SpeculativeGenerator:
             )
             self.chunk_token_budget = page_size
         self.stats = {"rounds": 0, "drafted": 0, "accepted": 0, "tokens": 0}
+        # sequence sharding of the single-stream pools over the data
+        # axis (r19) — same knob as PagedEngine, read exactly once so
+        # both lanes (target + draft) make the same layout decision
+        self._seq_shard = _knobs.flag("SELDON_TPU_SEQ_SHARD")
 
         cls = get_paged_lm_class()
         target_cfg = dict(
@@ -193,6 +213,7 @@ class SpeculativeGenerator:
         self.target = _PagedState(
             cls(**target_cfg), params, max_len=max_len, page_size=page_size,
             dtype=dtype, mesh=mesh, model_axis=model_axis,
+            data_axis=data_axis, seq_shard=self._seq_shard,
             min_weight_size=shard_min_weight_size, quantize=quantize,
         )
         self.quantize_manifest = self.target.quantize_manifest
@@ -205,6 +226,7 @@ class SpeculativeGenerator:
             self.draft_state = _PagedState(
                 cls(**cfg), draft_params, max_len=max_len, page_size=page_size,
                 dtype=dtype, mesh=mesh, model_axis=model_axis,
+                data_axis=data_axis, seq_shard=self._seq_shard,
                 min_weight_size=shard_min_weight_size, quantize=quantize,
             )
 
@@ -449,6 +471,7 @@ class SpeculativeLM(TPUComponent):
         seed: int = 0,
         mesh_axes: Optional[Dict[str, int]] = None,
         tp: int = 0,
+        dp: int = 0,
         quantize: str = "",
         chunk_token_budget: int = 0,
         **kwargs: Any,
@@ -469,11 +492,14 @@ class SpeculativeLM(TPUComponent):
         self.draft_config = dict(draft_config or {})
         self.page_size = int(page_size)
         self.seed = int(seed)
-        # same knob as StreamingLM: {"model": N} -> tensor-parallel decode;
-        # tp=N (or SELDON_TPU_TP when 0) is the deployment-facing
-        # spelling of mesh_axes={"model": N} — an explicit mesh_axes wins
+        # same knobs as StreamingLM: {"model": N} -> tensor-parallel
+        # decode; tp=N (or SELDON_TPU_TP when 0) is the
+        # deployment-facing spelling of mesh_axes={"model": N}, and
+        # dp=D (or SELDON_TPU_DP) adds the data axis of the 2-D
+        # serving mesh — an explicit mesh_axes wins over both
         self.mesh_axes = dict(mesh_axes) if mesh_axes else None
         self.tp = int(tp)
+        self.dp = int(dp)
         from seldon_core_tpu.ops.surgery import validate_quantize_mode
 
         self.quantize = validate_quantize_mode(quantize)  # fail at construction
@@ -521,7 +547,8 @@ class SpeculativeLM(TPUComponent):
             params, dtype=jnp.bfloat16, page_size=self.page_size,
             draft=self.draft, draft_k=self.draft_k, ngram=self.ngram,
             draft_params=draft_params, draft_config=self.draft_config,
-            mesh=mesh, tp=self.tp or None, quantize=self.quantize,
+            mesh=mesh, tp=self.tp or None, dp=self.dp or None,
+            quantize=self.quantize,
             chunk_token_budget=self.chunk_token_budget,
             **self.config,
         )

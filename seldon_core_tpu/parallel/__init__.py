@@ -5,7 +5,11 @@ from seldon_core_tpu.parallel.mesh import (  # noqa: F401
     MODEL_AXIS,
     create_mesh,
     mesh_shape,
+    resolve_dp,
+    resolve_mesh,
+    resolve_tp,
     single_device_mesh,
+    tp_mesh,
 )
 from seldon_core_tpu.parallel.sharding import (  # noqa: F401
     data_sharded,

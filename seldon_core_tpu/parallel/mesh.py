@@ -28,7 +28,19 @@ def create_mesh(
     """Build a ``jax.sharding.Mesh``.
 
     ``axes`` maps axis name -> size; a size of -1 means "everything
-    left" (at most one axis).  Default: all devices on ``data``.
+    left" (at most one axis).  Axis ORDER is the device-grid order:
+    list ``data`` before ``model`` (the :func:`resolve_mesh`
+    convention) so each model group spans adjacent devices — the fast
+    ICI neighbours tensor-parallel collectives want — while data
+    groups stride across them.
+
+    Default (no ``axes``): every device on ``data`` — the pure
+    replica/batch mesh the trainer uses.  Serving callers never rely
+    on this default: they go through :func:`resolve_mesh` (or its
+    1-D front :func:`tp_mesh`), THE precedence home that builds
+    ``{"data": D, "model": M}`` — dropping either axis at size 1 so a
+    degenerate request lowers byte-identically to the 1-D (or
+    single-chip) program.
     """
     import jax
     import numpy as np
@@ -88,6 +100,108 @@ def resolve_tp(tp: Optional[int] = None) -> int:
     if tp < 1:
         raise ValueError(f"tensor-parallel degree must be >= 1, got {tp}")
     return tp
+
+
+def resolve_dp(dp: Optional[int] = None) -> int:
+    """Data-parallel degree for the serving lanes — :func:`resolve_tp`'s
+    twin over the ``data`` axis: an explicit ``dp`` argument wins
+    (``1`` forces one replica group even with the env var exported);
+    ``None``/``0`` defers to ``SELDON_TPU_DP``, where unset/empty/``0``
+    all spell OFF (= 1), the fleet-wide ``=0``-disables convention."""
+    if dp is None or int(dp) == 0:
+        from seldon_core_tpu.runtime import knobs
+
+        raw = (knobs.raw("SELDON_TPU_DP", "") or "").strip()
+        dp = int(raw) if raw else 1
+        if dp == 0:
+            dp = 1
+    dp = int(dp)
+    if dp < 1:
+        raise ValueError(f"data-parallel degree must be >= 1, got {dp}")
+    return dp
+
+
+def resolve_mesh(
+    mesh=None,
+    mesh_axes: Optional[Dict[str, int]] = None,
+    tp: Optional[int] = None,
+    dp: Optional[int] = None,
+    *,
+    strict: bool = False,
+    data_axis: str = DATA_AXIS,
+    model_axis: str = MODEL_AXIS,
+):
+    """THE serving-mesh precedence home: ``{"data": D, "model": M}``.
+
+    Precedence (first hit wins, the one ordering every engine shares):
+
+    1. an explicit ``mesh`` object — returned verbatim;
+    2. ``mesh_axes`` (the StreamingLM/SpeculativeLM config spelling) —
+       built as given via :func:`create_mesh`;
+    3. constructor ``tp=`` / ``dp=`` integers;
+    4. the ``SELDON_TPU_TP`` / ``SELDON_TPU_DP`` env knobs
+       (:func:`resolve_tp` / :func:`resolve_dp`; unset/``0`` = 1).
+
+    A size-1 axis is DROPPED: ``dp=1`` yields the exact ``{model: tp}``
+    mesh :func:`tp_mesh` builds (so 1-D programs stay byte-identical),
+    and ``dp=tp=1`` yields ``None`` (the single-chip engine, no
+    annotation objects at all).  Axis order is data-major — each model
+    group spans adjacent devices (fast ICI neighbours for the per-layer
+    all-reduces), data groups stride across them.
+
+    Degrade is deterministic and shrinks the DATA axis first: a host
+    with fewer than ``dp*tp`` devices keeps the full model degree and
+    drops ``dp`` to what fits (``devices // tp``); only when even
+    ``tp`` alone cannot fit does the mesh degrade to single-chip —
+    both steps WARN naming BOTH axes, so one serving config rolls out
+    across pod and dev hosts unchanged.  ``strict=True`` raises
+    instead (dry-run / bench lanes, where a silent degrade would
+    certify the wrong thing)."""
+    if mesh is not None:
+        return mesh
+    if mesh_axes:
+        return create_mesh(dict(mesh_axes))
+    tp = resolve_tp(tp)
+    dp = resolve_dp(dp)
+    if dp <= 1:
+        return tp_mesh(tp, axis=model_axis, strict=strict)
+    import jax
+
+    devices = jax.devices()
+    avail = len(devices)
+    if tp > avail:
+        msg = (
+            f"serving mesh ({data_axis}={dp}, {model_axis}={tp}) needs "
+            f"{dp * tp} devices but the host exposes {avail} and even "
+            f"the model axis alone does not fit — degrading to "
+            f"single-chip ({data_axis}=1, {model_axis}=1)"
+        )
+        if strict:
+            raise ValueError(msg)
+        import logging
+
+        logging.getLogger(__name__).warning(msg)
+        return None
+    if dp * tp > avail:
+        fit = max(1, avail // tp)
+        msg = (
+            f"serving mesh ({data_axis}={dp}, {model_axis}={tp}) needs "
+            f"{dp * tp} devices but the host exposes {avail} — "
+            f"shrinking the data axis first: "
+            f"({data_axis}={fit}, {model_axis}={tp})"
+        )
+        if strict:
+            raise ValueError(msg)
+        import logging
+
+        logging.getLogger(__name__).warning(msg)
+        dp = fit
+        if dp <= 1:
+            return tp_mesh(tp, axis=model_axis, strict=strict)
+    axes = {data_axis: dp}
+    if tp > 1:
+        axes[model_axis] = tp
+    return create_mesh(axes, devices=devices[: dp * tp])
 
 
 def tp_mesh(

@@ -11,6 +11,16 @@ the ring size; compute overlaps the neighbour exchange.
 
 Written with ``shard_map`` so the collective schedule is explicit; the
 single-device path (`plain_attention`) is the correctness oracle.
+
+Role under the 2-D serving mesh (r19): the ``data`` axis that batch-
+shards lanes and page-shards the paged KV pool doubles as a sequence
+ring — ``ring_attention(..., seq_axis="data")`` runs this module's
+online-softmax schedule over the SAME axis the serving engine spreads
+a long stream's pages across, and ``plain_attention`` pins the
+numerics of that layout in the long-context parity tests
+(tests/test_paged_mesh.py).  The paged engine itself stays on
+annotation-only GSPMD sharding; this module is the explicit-schedule
+contrast and the oracle, not the serving data path.
 """
 
 from __future__ import annotations

@@ -144,17 +144,35 @@ def shard_decode_state(
     pool_shape,
     dtype,
     model_axis: str = MODEL_AXIS,
+    data_axis: str = DATA_AXIS,
     min_weight_size: int = 16_384,
     num_heads: Optional[int] = None,
+    seq_shard: bool = True,
 ):
-    """Tensor-parallel layout for the paged-decode lanes: megatron param
-    specs + K/V pools sharded on their heads axis — dim 3 of either
-    layout: split ``(layers, pages, page_size, heads, head_dim)`` or
-    flat ``(layers, pages, page_size, d_model)`` (d_model is head-major
-    contiguous, so a head-boundary-aligned partition of dim 3 is the
-    same sharding).  ``num_heads`` carries the divisibility constraint
-    for the flat layout (dim 3's size is d_model there, but shards must
-    align to head boundaries).
+    """Serving-mesh layout for the paged-decode lanes: megatron param
+    specs + K/V pools sharded on BOTH mesh axes.
+
+    * ``model`` axis — the heads dim (dim 3 of either layout: split
+      ``(layers, pages, page_size, heads, head_dim)`` or flat
+      ``(layers, pages, page_size, d_model)``; d_model is head-major
+      contiguous, so a head-boundary-aligned partition of dim 3 is
+      the same sharding).  ``num_heads`` carries the divisibility
+      constraint for the flat layout (dim 3's size is d_model there,
+      but shards must align to head boundaries).
+    * ``data`` axis — the PAGE dim (dim 1): every data shard owns
+      ``num_pages // dp`` pages of the global pool, which is both the
+      throughput story (each replica group's streams write their own
+      pages) and the long-context story (one 32k stream's pages spread
+      across the axis, so contexts one chip's pool cannot admit stay
+      servable).  Requires ``num_pages % dp == 0`` (the engine rounds
+      its pool up); ``seq_shard=False`` (``SELDON_TPU_SEQ_SHARD=0``)
+      replicates the pool over ``data`` — pure throughput replicas,
+      no capacity claim.
+
+    Params replicate over ``data`` implicitly: megatron specs only
+    name the ``model`` axis, so one weight residency is shared by all
+    D replica groups in the process — the whole point vs N processes
+    x N full copies.
 
     Pools are created ALREADY SHARDED (jit with out_shardings) — a
     ``jnp.zeros`` then ``device_put`` would materialise the full pool
@@ -183,25 +201,44 @@ def shard_decode_state(
     params = shard_params(
         params, mesh, model_axis=model_axis, min_weight_size=min_weight_size
     )
-    axis_size = mesh_shape(mesh).get(model_axis, 1)
+    shape = mesh_shape(mesh)
+    axis_size = shape.get(model_axis, 1)
+    dp_size = shape.get(data_axis, 1)
     if num_heads is None:
         num_heads = pool_shape[3]
     if axis_size > 1 and num_heads % axis_size == 0:
-        # trailing dims default to unsharded, so this spec covers both
-        # the rank-4 flat pool and the rank-5 split pool
-        pool_spec = P(None, None, None, model_axis)
+        heads_entry = model_axis
     else:
         if axis_size > 1:
             import logging
 
             logging.getLogger(__name__).warning(
-                "KV pool NOT sharded: num_heads=%d is not divisible by "
-                "mesh axis %r size %d — every device will hold the full "
-                "pool (no per-device memory win). Pick a head count "
-                "divisible by the model-axis size.",
-                num_heads, model_axis, axis_size,
+                "KV pool NOT sharded over (%r, %r): num_heads=%d is not "
+                "divisible by mesh axis %r size %d — every device will "
+                "hold the full head dim (no per-device memory win). Pick "
+                "a head count divisible by the model-axis size.",
+                data_axis, model_axis, num_heads, model_axis, axis_size,
             )
-        pool_spec = P()
+        heads_entry = None
+    num_pages = pool_shape[1]
+    if dp_size > 1 and seq_shard and num_pages % dp_size == 0:
+        pages_entry = data_axis
+    else:
+        if dp_size > 1 and seq_shard:
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "KV pool NOT sharded over (%r, %r): num_pages=%d is not "
+                "divisible by mesh axis %r size %d — every device will "
+                "hold the full page dim (no long-context capacity win). "
+                "Pick a pool size divisible by the data-axis size.",
+                data_axis, model_axis, num_pages, data_axis, dp_size,
+            )
+        pages_entry = None
+    # trailing dims default to unsharded, so this spec covers both the
+    # rank-4 flat pool and the rank-5 split pool; a 1-D model mesh
+    # yields the exact historical P(None, None, None, model) spelling
+    pool_spec = P(None, pages_entry, None, heads_entry)
     make_pool = jax.jit(
         lambda: jnp.zeros(pool_shape, dtype),
         out_shardings=NamedSharding(mesh, pool_spec),

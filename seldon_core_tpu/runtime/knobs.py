@@ -153,6 +153,15 @@ ENV_KNOBS: Dict[str, Knob] = _knobs(
          "tensor-parallel degree over the 'model' mesh axis "
          "(unset/empty/0 = single-chip)",
          "architecture.md §5b-ter"),
+    Knob("SELDON_TPU_DP", "int", "0", True,
+         "data-parallel degree over the 'data' mesh axis of the 2-D "
+         "serving mesh (unset/empty/0 = one replica group)",
+         "architecture.md §5b-octies"),
+    Knob("SELDON_TPU_SEQ_SHARD", "flag", "1", True,
+         "shard the KV pool's page dim over the 'data' axis (sequence/"
+         "long-context sharding; 0 = replicate the pool — pure "
+         "throughput replicas, no capacity claim)",
+         "architecture.md §5b-octies"),
     Knob("SELDON_TPU_PAGED_KERNEL", "str", "auto", True,
          "pallas decode-kernel lane ('0' | '1' | 'auto' | 'force'; "
          "default 'auto' = on for single-chip TPU backends, off "

@@ -377,6 +377,12 @@ ENGINE_STATS_METRICS: Dict[str, Tuple[str, str, str]] = {
     "tp_degree": ("gauge", "seldon_tpu_engine_tp_degree",
                   "tensor-parallel degree the engine runs at "
                   "(1 = single-chip)"),
+    # 2-D serving mesh (r19): the data-axis degree — replica groups
+    # sharing one weight residency, and (seq-shard default) the factor
+    # the pool's page dim is spread by for long-context capacity
+    "dp_degree": ("gauge", "seldon_tpu_engine_dp_degree",
+                  "data-parallel degree the engine runs at "
+                  "(1 = single replica group)"),
     "pool_shard_bytes": ("gauge", "seldon_tpu_engine_pool_shard_bytes",
                          "K+V pool bytes ONE device holds (per-shard "
                          "under tensor parallelism, full pool at tp=1)"),

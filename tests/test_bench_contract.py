@@ -164,6 +164,19 @@ def _r3_like_full_result():
                 "paged_tp_tokens_per_s": 8100.0,
                 "paged_tp_degree": 4,
                 "paged_tp_eff_pct": 46.0,
+                "paged_mesh_tokens_per_s": 7400.0,
+                "paged_mesh_axes": "2x2 (data x model)",
+                "paged_mesh_eff_pct": 42.0,
+                "longctx_max_len": 81920,
+                "longctx_decode_tokens_per_s": "n/a",
+                "longctx": {
+                    "ctx_len": 32768, "budget_bytes": 31462400,
+                    "shard_peak_bytes": 12584960,
+                    "full_peak_bytes": 50339840,
+                    "mesh": "dp=2 x tp=2",
+                    "admits_single_chip": False, "admits_mesh": True,
+                    "max_len_single_chip": 20416,
+                },
                 "multi_lora_tokens_per_s": 4100.0,
                 "multi_lora_resident_tokens_per_s": 4350.0,
                 "resident_tok_s_delta_pct": 1.14,
@@ -568,6 +581,68 @@ def test_compact_line_carries_tp_story(bench):
     assert isinstance(e["paged_tp_eff_pct"], float)
     assert e["paged_tp_eff_pct"] == 46.0
     assert "paged_tp_degree" not in e
+
+
+def test_compact_line_carries_mesh_story(bench):
+    """r19 certification keys: the (dp=2, tp=2) 16-stream serving point,
+    its per-chip efficiency vs the TP=1 ideal, and the accounting-priced
+    long-context ceiling; the axes string and the per_shard < budget <
+    full certificate stay in bench_full.json (`paged_mesh_axes` /
+    `longctx`)."""
+    compact = bench._compact_result(_r3_like_full_result())
+    e = compact["extra"]
+    assert isinstance(e["paged_mesh_tok_s"], float)
+    assert e["paged_mesh_tok_s"] == 7400.0
+    assert isinstance(e["paged_mesh_eff_pct"], float)
+    assert e["paged_mesh_eff_pct"] == 42.0
+    assert isinstance(e["longctx_max_len"], int)
+    assert e["longctx_max_len"] == 81920
+    assert "paged_mesh_axes" not in e
+    assert "longctx" not in e
+    assert "longctx_decode_tokens_per_s" not in e
+
+
+def test_compact_line_mesh_na_on_small_host(bench):
+    """Hosts under 4 devices emit the literal "n/a" for the measured
+    mesh pair while longctx_max_len stays numeric (host arithmetic runs
+    everywhere) — the compact line is schema-stable on every host."""
+    full = _r3_like_full_result()
+    full["extra"]["generation"]["paged_mesh_tokens_per_s"] = "n/a"
+    full["extra"]["generation"]["paged_mesh_eff_pct"] = "n/a"
+    compact = bench._compact_result(full)
+    assert compact["extra"]["paged_mesh_tok_s"] == "n/a"
+    assert compact["extra"]["paged_mesh_eff_pct"] == "n/a"
+    assert compact["extra"]["longctx_max_len"] == 81920
+
+
+def test_dp_hbm_accounting_per_shard():
+    """dp_degree > 1 prices the page-dim sharding of the 2-D mesh: KV
+    terms divide by tp x dp, the tp_degree key never inflates, and an
+    indivisible pool (shard_decode_state's fallback) prices FULL page
+    bytes."""
+    from seldon_core_tpu.models.paged import (
+        paged_hbm_accounting,
+        paged_max_context,
+    )
+
+    kw = dict(d_model=512, num_layers=8, page_size=64, steps_per_call=8,
+              dtype_bytes=2, flat_pool=True, chunk_impl="ring")
+    one = paged_hbm_accounting(streams=4, ctx_len=512, **kw)
+    both = paged_hbm_accounting(
+        streams=4, ctx_len=512, tp_degree=2, dp_degree=2, **kw
+    )
+    assert both["pool_bytes"] == one["pool_bytes"] // 4
+    assert both["tp_degree"] == 2 and both["dp_degree"] == 2
+    rep = paged_hbm_accounting(
+        streams=4, ctx_len=512, dp_degree=2, num_pool_pages=33, **kw
+    )
+    assert rep["pool_bytes"] == one["pool_bytes"] and rep["dp_degree"] == 1
+    # the longctx_max_len key's function: the admissible context under
+    # a fixed budget multiplies with the data axis
+    budget = 256 << 20
+    assert paged_max_context(budget, dp_degree=2, **kw) > paged_max_context(
+        budget, **kw
+    )
 
 
 def test_compact_line_carries_multi_lora_story(bench):

@@ -23,7 +23,16 @@ XLA insert the collectives.  This harness makes that visible:
    vs the TP=1 rate).
 
 Run:  python tools/profile_paged_tp.py [--tp 2] [--slots 8] [--steps 8]
-      [--measure] [--d-model 512] [--layers 8]
+      [--measure] [--d-model 512] [--layers 8] [--mesh 2x2]
+
+``--mesh DxM`` audits the 2-D (data x model) serving mesh instead: the
+collective count is SPLIT per mesh axis by classifying each op's
+``replica_groups`` device lists (the mesh is data-major, so model
+groups are contiguous id runs over fast ICI and data groups are
+strided).  The expected split is megatron ``all-reduce`` on the model
+axis plus the page-gather ``all-reduce``/``all-gather`` traffic on the
+data axis — a model-axis all-gather means the partitioner fell back to
+resharding an activation.
 
 Single-chip hosts degrade honestly: without ``--tp`` devices the tool
 prints the TP=1 audit (zero collectives — the byte-identical-program
@@ -32,6 +41,7 @@ claim, checkable) instead of crashing.
 
 import argparse
 import os
+import re
 import sys
 import time
 from collections import Counter
@@ -44,10 +54,73 @@ COLLECTIVES = (
     "all-to-all",
 )
 
+# explicit replica groups: replica_groups={{0,1},{2,3}}
+_RG_EXPLICIT = re.compile(r"replica_groups=\{(\{[^=]*?\})\}")
+# collective-permute spells its groups as source_target_pairs instead
+_STP = re.compile(r"source_target_pairs=\{(\{[^=]*?\})\}")
+# iota (v2) groups: replica_groups=[4,2]<=[8] or [2,4]<=[4,2]T(1,0)
+_RG_IOTA = re.compile(r"replica_groups=\[(\d+),(\d+)\]<=\[[\d,]+\](T\([\d,]+\))?")
 
-def collective_counts(hlo_text: str) -> Counter:
+
+def _axis_of_groups(groups) -> str:
+    """Classify replica groups against the data-major 2-D mesh: the
+    model axis is MINOR (contiguous device-id runs, adjacent/fast ICI);
+    the data axis is MAJOR (constant stride = model-axis size)."""
+    strides = set()
+    for g in groups:
+        if len(g) < 2:
+            continue
+        diffs = {b - a for a, b in zip(g, g[1:])}
+        if len(diffs) != 1:
+            return "mixed"
+        strides |= diffs
+    if not strides or strides == {1}:
+        return "model"
+    if len(strides) == 1:
+        return "data"
+    return "mixed"
+
+
+def _classify_axis(line: str) -> str:
+    """Mesh axis a collective instruction runs over, from its
+    replica_groups attribute ('?' when the spelling is unrecognised)."""
+    m = _RG_EXPLICIT.search(line)
+    if m:
+        groups = [
+            [int(x) for x in body.split(",") if x.strip()]
+            for body in re.findall(r"\{([^{}]*)\}", m.group(1))
+        ]
+        return _axis_of_groups(groups)
+    m = _RG_IOTA.search(line)
+    if m:
+        # identity iota = contiguous runs (minor/model axis); any
+        # transpose permutes ids into strided groups (major/data axis)
+        return "data" if m.group(3) else "model"
+    m = _STP.search(line)
+    if m:
+        # a permute ring over one axis hops a constant |stride| (mod
+        # wrap): minor-axis hops are +-1, major-axis hops are +-M
+        pairs = [
+            [int(x) for x in body.split(",") if x.strip()]
+            for body in re.findall(r"\{([^{}]*)\}", m.group(1))
+        ]
+        hops = {abs(p[1] - p[0]) for p in pairs if len(p) == 2}
+        hops.discard(0)
+        if hops <= {1} or not hops:
+            return "model"
+        # wrap-around edges show as a larger jump; one non-unit hop
+        # size (+ its wrap) is still a single-axis ring
+        if len(hops - {max(hops)}) <= 1:
+            return "data" if 1 not in hops else "mixed"
+        return "mixed"
+    return "?"
+
+
+def collective_counts(hlo_text: str, by_axis: bool = False) -> Counter:
     """Count collective instructions in HLO text (start/done pairs for
-    async collectives count once via the -start spelling)."""
+    async collectives count once via the -start spelling).  With
+    ``by_axis`` the keys are ``(op, axis)`` where axis is the mesh axis
+    the op's replica_groups span ('model' minor / 'data' major)."""
     counts: Counter = Counter()
     for line in hlo_text.splitlines():
         s = line.strip()
@@ -55,11 +128,11 @@ def collective_counts(hlo_text: str) -> Counter:
         # "... all-reduce-start(..."; match the op name at its call site
         for op in COLLECTIVES:
             if f" {op}(" in s or f" {op}-start(" in s:
-                counts[op] += 1
+                counts[(op, _classify_axis(s)) if by_axis else op] += 1
     return counts
 
 
-def audit_program(name: str, lowered, num_layers: int):
+def audit_program(name: str, lowered, num_layers: int, by_axis: bool = False):
     compiled = lowered.compile()
     try:
         hlo = compiled.as_text()
@@ -68,6 +141,7 @@ def audit_program(name: str, lowered, num_layers: int):
             m.to_string() for m in compiled.runtime_executable().hlo_modules()
         )
     counts = collective_counts(hlo)
+    axis_counts = collective_counts(hlo, by_axis=True) if by_axis else None
     total = sum(counts.values())
     cost = {}
     try:
@@ -86,10 +160,17 @@ def audit_program(name: str, lowered, num_layers: int):
             for op, n in sorted(counts.items())
         )
         print(f"  collectives: {total} total — {per_layer}")
+        if axis_counts:
+            for axis in ("model", "data", "mixed", "?"):
+                ops = {op: n for (op, a), n in axis_counts.items() if a == axis}
+                if ops:
+                    detail = ", ".join(
+                        f"{op}={n}" for op, n in sorted(ops.items()))
+                    print(f"    {axis} axis: {detail}")
     if flops:
         print(f"  per-chip cost: {flops / 1e9:.3f} GFLOP, "
               f"{bytes_acc / 1e6:.1f} MB accessed")
-    return counts, flops
+    return counts, flops, axis_counts
 
 
 def main():
@@ -105,6 +186,9 @@ def main():
     ap.add_argument("--page-size", type=int, default=64)
     ap.add_argument("--max-len", type=int, default=1024)
     ap.add_argument("--new", type=int, default=64)
+    ap.add_argument("--mesh", type=str, default="",
+                    help="audit a 2-D DxM (data x model) serving mesh, "
+                         "e.g. --mesh 2x2; collectives are split per axis")
     ap.add_argument("--measure", action="store_true",
                     help="also time serving TP=N vs TP=1 (min-of-3)")
     args = ap.parse_args()
@@ -123,6 +207,17 @@ def main():
         raise SystemExit(
             f"--tp {tp} needs {tp} devices, host exposes {n_dev}"
         )
+    mesh_dp = mesh_tp = 0
+    if args.mesh:
+        try:
+            mesh_dp, mesh_tp = (int(x) for x in args.mesh.lower().split("x"))
+        except ValueError:
+            raise SystemExit(f"--mesh wants DxM (e.g. 2x2), got {args.mesh!r}")
+        if mesh_dp * mesh_tp > n_dev:
+            raise SystemExit(
+                f"--mesh {args.mesh} needs {mesh_dp * mesh_tp} devices, "
+                f"host exposes {n_dev}"
+            )
 
     cfg = dict(
         vocab_size=args.vocab, d_model=args.d_model,
@@ -131,14 +226,14 @@ def main():
     lm = TransformerLM(dtype=jnp.bfloat16, **cfg)
     params = lm.init(jax.random.key(0), jnp.zeros((1, 8), jnp.int32))["params"]
 
-    def build(tp_n):
-        # tp=1 passed EXPLICITLY: it forces single-chip even when
-        # SELDON_TPU_TP is exported in the shell — the tp=1 reference
-        # audit must never silently come up tensor-parallel
+    def build(tp_n, dp_n=1):
+        # tp=1/dp=1 passed EXPLICITLY: they force single-chip even when
+        # SELDON_TPU_TP/SELDON_TPU_DP are exported in the shell — the
+        # tp=1 reference audit must never silently come up parallel
         return PagedEngine(
             params, dtype=jnp.bfloat16, page_size=args.page_size,
             max_slots=args.slots, steps_per_call=args.steps,
-            tp=tp_n, **cfg,
+            tp=tp_n, dp=dp_n, **cfg,
         )
 
     pages = -(-args.max_len // args.page_size)
@@ -154,7 +249,7 @@ def main():
           f"{args.steps}-step chunk)")
 
     eng1 = build(1)
-    c1, flops1 = audit_program(
+    c1, flops1, _ = audit_program(
         f"chunk tp=1 ({args.steps} steps)", lowered_chunk(eng1), args.layers)
     eng1.close()
 
@@ -163,7 +258,7 @@ def main():
         assert engN.tp_degree == tp, (
             f"engine degraded to tp={engN.tp_degree} — host mesh too small"
         )
-        cN, flopsN = audit_program(
+        cN, flopsN, _ = audit_program(
             f"chunk tp={tp} ({args.steps} steps)", lowered_chunk(engN),
             args.layers)
         engN.close()
@@ -171,6 +266,31 @@ def main():
         if flops1 and flopsN:
             print(f"\nper-chip flops ratio tp{tp}/tp1: {flopsN / flops1:.3f} "
                   f"(ideal {1 / tp:.3f})")
+
+    if mesh_dp:
+        eng2d = build(mesh_tp, mesh_dp)
+        assert eng2d.tp_degree == mesh_tp and eng2d.dp_degree == mesh_dp, (
+            f"engine degraded to (dp={eng2d.dp_degree}, tp={eng2d.tp_degree})"
+            f" — host mesh too small for --mesh {args.mesh}"
+        )
+        _, flops2d, axis2d = audit_program(
+            f"chunk mesh={mesh_dp}x{mesh_tp} data x model "
+            f"({args.steps} steps)",
+            lowered_chunk(eng2d), args.layers, by_axis=True)
+        eng2d.close()
+        if axis2d:
+            model_ag = sum(
+                n for (op, a), n in axis2d.items()
+                if a == "model" and op == "all-gather"
+            )
+            if model_ag:
+                print(f"  NOTE: {model_ag} model-axis all-gather(s) — the "
+                      f"partitioner reshards an activation (spec bug worth "
+                      f"chasing); megatron wants all-reduce only there")
+        if flops1 and flops2d:
+            print(f"\nper-chip flops ratio mesh/tp1: {flops2d / flops1:.3f} "
+                  f"(ideal {1 / mesh_tp:.3f} — the data axis shards KV "
+                  f"pages + lanes, not weight flops)")
 
     if args.measure:
         rng = np.random.default_rng(0)
