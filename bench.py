@@ -285,6 +285,12 @@ COMPACT_PICKS = [
     # trace_prop.trace_on/off_tok_s).  Positive = slower with
     # propagation on; the always-on posture requires < 2
     ("trace_prop_overhead_pct", ("trace_prop", "trace_prop_overhead_pct")),
+    # r20 telemetry-plane certification: serving (tok/s) cost of the
+    # replica ring + per-request cost ledger + exemplar capture vs
+    # SELDON_TPU_TELEMETRY=0 (same best-of-3 discipline; raw on/off
+    # tok/s in bench_full.json telemetry.telemetry_on/off_tok_s).
+    # Positive = slower with telemetry on; always-on requires < 2
+    ("telemetry_overhead_pct", ("telemetry", "telemetry_overhead_pct")),
     ("paged_chunk_tok_s", ("generation", "paged_chunk_tokens_per_s")),
     # NOTE: the r3 micro-comparison artifact paged_decode_tokens_per_s
     # (one device call per token, a methodology contrast — NOT a
@@ -1562,6 +1568,13 @@ async def child_main() -> None:
             status["extra"]["trace_prop_error"] = str(e)[:200]
         _checkpoint(status)
 
+    if os.environ.get("BENCH_TELEMETRY", "1") == "1":
+        try:
+            status["extra"]["telemetry"] = await telemetry_phase()
+        except Exception as e:  # noqa: BLE001
+            status["extra"]["telemetry_error"] = str(e)[:200]
+        _checkpoint(status)
+
     if os.environ.get("BENCH_CHAOS", "1") == "1":
         try:
             status["extra"]["chaos"] = await chaos_phase()
@@ -1722,6 +1735,92 @@ async def trace_prop_phase() -> dict:
             f"16-way StreamingLM graph serving, {per_worker} req/worker x "
             f"{max_new} new tokens, best-of-3 windows, full propagation + "
             "transport telemetry vs both disabled"
+        ),
+    }
+
+
+async def telemetry_phase() -> dict:
+    """Cost of the FULL r20 telemetry plane on the serving path: the
+    replica time-series ring (background sampling of engine_stats +
+    flight-recorder deltas), the per-request cost ledger (page-second
+    integrals advanced at every page transition, per-adapter counters,
+    meta.tags.cost assembly), and chunk trace-id capture for exemplars
+    — versus SELDON_TPU_TELEMETRY=0, which removes the plane entirely.
+
+    Protocol mirrors trace_prop_phase: the SAME 16-way generation
+    serving point through the full PredictorService graph path,
+    best-of-3 windows per side.  The always-on posture requires the
+    gate < 2% (telemetry_overhead_pct, §10b)."""
+    import asyncio
+
+    import numpy as np
+
+    from seldon_core_tpu.engine import PredictorService
+    from seldon_core_tpu.engine.graph import UnitSpec
+    from seldon_core_tpu.models.paged import StreamingLM
+    from seldon_core_tpu.runtime.message import InternalMessage
+
+    concurrency = 16
+    per_worker = 2 if QUICK else 4
+    max_new = 32
+    prompts = [
+        np.random.default_rng(300 + i).integers(0, 2048, size=(1, 16)).astype(np.int32)
+        for i in range(concurrency)
+    ]
+
+    async def measure_point(enabled: bool) -> float:
+        # save/restore the operator's own setting, as trace_prop does
+        prior = os.environ.get("SELDON_TPU_TELEMETRY")
+        if enabled:
+            os.environ.pop("SELDON_TPU_TELEMETRY", None)  # default on
+        else:
+            os.environ["SELDON_TPU_TELEMETRY"] = "0"
+        component = StreamingLM(
+            vocab_size=2048, d_model=256, num_layers=4, num_heads=8,
+            max_len=256, max_new_tokens=max_new, max_slots=concurrency,
+            steps_per_call=8, seed=0, tp=1,
+        )
+        svc = PredictorService(
+            UnitSpec(name="lm", type="MODEL", component=component),
+            name="telemetry-bench",
+        )
+
+        async def worker(i: int):
+            for _ in range(per_worker):
+                out = await svc.predict(
+                    InternalMessage(payload=prompts[i], kind="ndarray")
+                )
+                assert out.status["status"] == "SUCCESS", out.status
+
+        try:
+            await worker(0)  # warm: compiles prefill + chunk programs
+            best = 0.0
+            tokens = concurrency * per_worker * max_new
+            for _ in range(3):
+                t0 = time.perf_counter()
+                await asyncio.gather(*(worker(i) for i in range(concurrency)))
+                best = max(best, tokens / (time.perf_counter() - t0))
+            return best
+        finally:
+            await svc.close()
+            component.shutdown()
+            if component.engine is not None:
+                component.engine.close()
+            if prior is None:
+                os.environ.pop("SELDON_TPU_TELEMETRY", None)
+            else:
+                os.environ["SELDON_TPU_TELEMETRY"] = prior
+
+    on = await measure_point(True)
+    off = await measure_point(False)
+    return {
+        "telemetry_on_tok_s": round(on, 1),
+        "telemetry_off_tok_s": round(off, 1),
+        "telemetry_overhead_pct": round((off - on) / max(off, 1e-9) * 100.0, 2),
+        "protocol": (
+            f"16-way StreamingLM graph serving, {per_worker} req/worker x "
+            f"{max_new} new tokens, best-of-3 windows, telemetry ring + "
+            "cost ledger + exemplar capture vs SELDON_TPU_TELEMETRY=0"
         ),
     }
 

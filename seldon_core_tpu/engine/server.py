@@ -601,6 +601,87 @@ def build_gateway_app(gateway: Gateway, auth=None) -> web.Application:
             "engines": engines,
         })
 
+    async def debug_telemetry(request: web.Request) -> web.Response:
+        """This process's replica telemetry snapshot (r20): the
+        versioned time-series-ring payload, ``?window=<s>`` bounded.
+        One engine-bearing component (the common topology) serves its
+        snapshot directly — the shape the fleet aggregator polls;
+        multi-component graphs nest per-node snapshots."""
+        try:
+            window_s = float(request.query.get("window", "0") or 0.0)
+        except ValueError:
+            window_s = 0.0
+        snaps: Dict[str, object] = {}
+        for svc in gateway.predictors:
+            for unit in svc.graph.walk():
+                component = svc.executor.component(unit.name)
+                snap_fn = getattr(component, "telemetry_snapshot", None)
+                if snap_fn is None:
+                    continue
+                snap = snap_fn(window_s)
+                if snap is not None:
+                    snaps[f"{svc.name}/{unit.name}"] = snap
+        if not snaps:
+            from seldon_core_tpu.utils import telemetry as _telemetry
+
+            return web.json_response(
+                {"enabled": _telemetry.telemetry_enabled(), "components": {},
+                 "info": "no telemetry ring in this process "
+                         "(SELDON_TPU_TELEMETRY=0 or no generation engine)"},
+            )
+        if len(snaps) == 1:
+            return web.json_response(next(iter(snaps.values())))
+        from seldon_core_tpu.utils import telemetry as _telemetry
+
+        return web.json_response({
+            "schema_version": _telemetry.TELEMETRY_SCHEMA_VERSION,
+            "components": snaps,
+        })
+
+    async def debug_fleet(_r: web.Request) -> web.Response:
+        """The merged fleet view (r20): per-replica freshness +
+        saturation, adapter/prefix residency maps and the fleet rollup.
+        Endpoints come from ``SELDON_TPU_FLEET_ENDPOINTS``, else from
+        the local supervisor's workers; polls happen at most once per
+        poll interval, executor-side (urllib must not block the loop)."""
+        import asyncio as _asyncio
+        import time as _time
+
+        agg = getattr(gateway, "_fleet_aggregator", None)
+        if agg is None:
+            from seldon_core_tpu.controlplane import fleetview
+
+            endpoints = fleetview.endpoints_from_knob()
+            if not endpoints and gateway.supervisor is not None:
+                endpoints = fleetview.endpoints_from_supervisor(
+                    gateway.supervisor
+                )
+            if not endpoints:
+                return web.json_response({
+                    "enabled": False,
+                    "info": "no fleet endpoints (set "
+                            "SELDON_TPU_FLEET_ENDPOINTS or run workers "
+                            "under the local supervisor)",
+                })
+            agg = fleetview.TelemetryAggregator(endpoints)
+            try:
+                from seldon_core_tpu.utils.metrics import (
+                    FleetPrometheusBridge,
+                )
+
+                agg.bridge = FleetPrometheusBridge(agg)
+            except Exception:  # noqa: BLE001 — metrics never block the view
+                logger.exception("fleet prometheus bridge unavailable")
+            gateway._fleet_aggregator = agg
+            gateway._fleet_last_poll = 0.0
+        now = _time.monotonic()
+        if now - getattr(gateway, "_fleet_last_poll", 0.0) >= agg.poll_s:
+            gateway._fleet_last_poll = now
+            await _asyncio.get_running_loop().run_in_executor(
+                None, agg.poll_once
+            )
+        return web.json_response({"enabled": True, **agg.fleet_view()})
+
     async def debug_knobs(_r: web.Request) -> web.Response:
         """The central knob registry (runtime/knobs.py) with this
         process's effective values: "what is this gateway actually
@@ -637,6 +718,8 @@ def build_gateway_app(gateway: Gateway, auth=None) -> web.Application:
     app.router.add_get("/debug/traces", debug_traces)
     app.router.add_get("/debug/knobs", debug_knobs)
     app.router.add_get("/debug/weights", debug_weights)
+    app.router.add_get("/debug/telemetry", debug_telemetry)
+    app.router.add_get("/debug/fleet", debug_fleet)
     return app
 
 

@@ -112,6 +112,7 @@ from seldon_core_tpu.models.generate import _buckets_for
 from seldon_core_tpu.runtime import knobs as _knobs
 from seldon_core_tpu.runtime.component import MicroserviceError, TPUComponent
 from seldon_core_tpu.utils import faults as _faults
+from seldon_core_tpu.utils import telemetry as _telemetry
 from seldon_core_tpu.utils.deadlines import deadline_exceeded
 
 
@@ -1200,6 +1201,9 @@ class _Stream:
         "queue_depth_at_submit", "cached_len", "prefilled", "priority",
         "deadline", "preempted", "kv_export", "kv_import", "kv_payload",
         "kv_imported", "adapter", "adapter_slot", "adapter_pinned",
+        "cost_page_s", "cost_t", "cost_prefill_tokens",
+        "cost_decode_tokens", "cost_preempts", "cost_restores",
+        "cost_closed",
     )
 
     def __init__(self, req_id, prompt, max_new, temperature, top_k, eos_id, seed):
@@ -1284,6 +1288,20 @@ class _Stream:
         self.adapter: Optional[str] = None
         self.adapter_slot = 0
         self.adapter_pinned = False
+        # per-request cost ledger (r20): KV page-seconds accrued so far
+        # (the occupancy integral), the monotonic stamp of the last
+        # accrual (0.0 = not holding pages), prefill/decode tokens this
+        # stream's device work actually computed (re-derivation after
+        # eviction re-accrues — it is cost), preempt/restore counts,
+        # and the close guard (totals accrue into the engine EXACTLY
+        # once per stream)
+        self.cost_page_s = 0.0
+        self.cost_t = 0.0
+        self.cost_prefill_tokens = 0
+        self.cost_decode_tokens = 0
+        self.cost_preempts = 0
+        self.cost_restores = 0
+        self.cost_closed = False
 
 
 def journal_entry(
@@ -1853,7 +1871,33 @@ class PagedEngine:
                           # split by phase: decode-rate observability
                           # (tokens / chunk_wall_s) independent of
                           # admission cost
-                          "chunk_wall_s": 0.0, "prefill_wall_s": 0.0}
+                          "chunk_wall_s": 0.0, "prefill_wall_s": 0.0,
+                          # per-request cost ledger (r20): totals accrued
+                          # once per stream at termination (finish/fail/
+                          # export/migrate-out), so the per-adapter split
+                          # below sums to these EXACTLY.  page_seconds is
+                          # the KV occupancy integral (pages held x wall
+                          # seconds, stamped at every page-count change);
+                          # the token pair is work ATTRIBUTED to streams
+                          # (re-derived work after eviction counts —
+                          # it is cost, unlike the dedup'd counters
+                          # above).  Keys absent from engine_stats when
+                          # SELDON_TPU_TELEMETRY=0.
+                          "cost_page_seconds": 0.0,
+                          "cost_prefill_tokens": 0,
+                          "cost_decode_tokens": 0}
+        # per-adapter cost ledger split (adapter None -> "base"): dict
+        # name -> {page_seconds, prefill_tokens, decode_tokens, streams}
+        # exported with adapter labels by the bridge (bridge-excluded
+        # from the flat mapping, like adapter_requests)
+        self._cost_by_adapter: Dict[str, Dict[str, Any]] = {}
+        # injectable monotonic clock for the occupancy integral: the
+        # exactness test drives it manually so page-seconds compare
+        # EQUAL to a hand-computed integral, not approximately
+        import time as _time_mod
+
+        self._cost_clock = _time_mod.monotonic
+        self._telemetry_enabled = _telemetry.telemetry_enabled()
 
         # ---- observability: flight recorder + profiler hook (r7) ----
         # Per-chunk ring buffer (near-zero overhead: one dict append per
@@ -3473,6 +3517,48 @@ class PagedEngine:
                     self._page_entry.pop(p, None)
                 self._free_pages.append(p)
 
+    # ---- per-request cost ledger (r20) ------------------------------------
+
+    def _cost_touch_locked(self, stream: _Stream) -> None:
+        """Accrue the stream's KV occupancy integral up to NOW: called
+        immediately before every change to ``len(stream.pages)`` (grow,
+        free, admit) so ``cost_page_s`` is exact at page-count
+        granularity — pages-held x seconds, stamped at the boundaries
+        where the count changes.  No-op when the telemetry plane is
+        off (no clock reads on the =0 lane)."""
+        if not self._telemetry_enabled:
+            return
+        now = self._cost_clock()
+        if stream.cost_t:
+            stream.cost_page_s += (now - stream.cost_t) * len(stream.pages)
+        stream.cost_t = now
+
+    def _cost_close_locked(self, stream: _Stream) -> None:
+        """Fold one terminating stream's ledger into the engine totals
+        and the per-adapter split — exactly once per stream (the
+        ``cost_closed`` guard covers paths that can race a second
+        termination, e.g. a migrated-out stream whose peer import later
+        fails back through ``fail_stream``).  Accruing totals and the
+        split from the SAME event is what makes the per-adapter
+        counters sum to the fleet totals exactly."""
+        if not self._telemetry_enabled or stream.cost_closed:
+            return
+        self._cost_touch_locked(stream)
+        stream.cost_t = 0.0
+        stream.cost_closed = True
+        self._counters["cost_page_seconds"] += stream.cost_page_s
+        self._counters["cost_prefill_tokens"] += stream.cost_prefill_tokens
+        self._counters["cost_decode_tokens"] += stream.cost_decode_tokens
+        entry = self._cost_by_adapter.setdefault(
+            stream.adapter or "base",
+            {"page_seconds": 0.0, "prefill_tokens": 0,
+             "decode_tokens": 0, "streams": 0},
+        )
+        entry["page_seconds"] += stream.cost_page_s
+        entry["prefill_tokens"] += stream.cost_prefill_tokens
+        entry["decode_tokens"] += stream.cost_decode_tokens
+        entry["streams"] += 1
+
     def _prefix_root_for(self, adapter: Optional[str]) -> int:
         """Chain root per weight set (r16): adapter-selected prefill
         writes DIFFERENT KV than the base model for the same tokens, so
@@ -3675,6 +3761,7 @@ class PagedEngine:
         if slot is not None and self._slots[slot] is stream:
             self._slots[slot] = None
             self._lengths[slot] = 0
+        self._cost_close_locked(stream)
         if stream.pages:
             self._free_locked(stream.pages)
             stream.pages = []
@@ -3792,6 +3879,11 @@ class PagedEngine:
         self._remove_queued_locked(stream)
         stream.slot = slot
         stream.pages = [e.page for e in matched] + fresh
+        if self._telemetry_enabled:
+            # occupancy integral starts (or restarts) here: the stream
+            # now holds pages; every later page-count change touches
+            # first, so the integral is exact at change boundaries
+            stream.cost_t = self._cost_clock()
         stream.cached_len = len(matched) * self.page_size
         # chunked-prefill cursor: prefill resumes past the cached
         # prefix; slices advance it to plen (monolithic prefill jumps
@@ -3809,6 +3901,7 @@ class PagedEngine:
             # prompt pages just re-matched above — the restore half of
             # evict/restore
             stream.preempted = False
+            stream.cost_restores += 1
             self._counters["restored"] += 1
         self._slots[slot] = stream
         row = np.zeros((self.pages_per_stream,), np.int32)
@@ -3832,6 +3925,7 @@ class PagedEngine:
         slot = victim.slot
         self._counters["preempted"] += 1
         victim.preempted = True
+        victim.cost_preempts += 1
         self._evict_locked(victim)
         return slot
 
@@ -4097,6 +4191,7 @@ class PagedEngine:
         finals: List[Tuple[int, _Stream]] = []
         for i, (stream, start, n) in enumerate(group):
             stream.prefilled = start + n
+            stream.cost_prefill_tokens += n
             if stream.prefilled >= len(stream.prompt):
                 finals.append((i, stream))
         if not finals:
@@ -4298,6 +4393,7 @@ class PagedEngine:
                 if slot is not None and self._slots[slot] is stream:
                     self._slots[slot] = None
                     self._lengths[slot] = 0
+                self._cost_close_locked(stream)
                 if stream.pages:
                     self._free_locked(stream.pages)
                     stream.pages = []
@@ -4524,6 +4620,10 @@ class PagedEngine:
                     continue  # raced a concurrent retirement
                 self._slots[slot] = None
                 self._lengths[slot] = 0
+                # close the LOCAL ledger: the work this engine spent on
+                # the stream stays attributed here; the importing peer
+                # opens a fresh ledger for its own share
+                self._cost_close_locked(s)
                 if s.pages:
                     self._free_locked(s.pages)
                     s.pages = []
@@ -4748,6 +4848,8 @@ class PagedEngine:
             self.max_len,
         )
         need = -(-horizon // self.page_size)
+        if len(stream.pages) < need:
+            self._cost_touch_locked(stream)
         while len(stream.pages) < need:
             got = self._alloc_locked(1)
             if got is None:
@@ -4802,6 +4904,15 @@ class PagedEngine:
                 pages_held=len(stream.pages),
                 cancelled=stream.cancelled,
             )
+            if self._telemetry_enabled:
+                # the cost ledger as span tags: the trace view of the
+                # same numbers meta.tags.cost carries on the response
+                self._cost_close_locked(stream)
+                finish_tags["cost_page_s"] = round(stream.cost_page_s, 6)
+                finish_tags["cost_prefill_tokens"] = stream.cost_prefill_tokens
+                finish_tags["cost_decode_tokens"] = stream.cost_decode_tokens
+                if stream.adapter:
+                    finish_tags["cost_adapter"] = stream.adapter
             if self.speculative is not None:
                 drafted = self._counters["spec_drafted"]
                 finish_tags["spec_accept_rate"] = (
@@ -4809,6 +4920,7 @@ class PagedEngine:
                     if drafted else 0.0
                 )
             self._gen_span_deferred(stream, "gen.finish", now, 0.0, **finish_tags)
+        self._cost_close_locked(stream)  # idempotent with the traced close
         self._slots[slot] = None
         self._free_locked(stream.pages)
         stream.pages = []
@@ -4843,6 +4955,11 @@ class PagedEngine:
         # the submit reset above
         stream.t_first_token = 0.0
         stream.queue_depth_at_submit = len(self._queue)
+        # ledger: occupancy accrues up to the free, then pauses while
+        # queued (cost_t = 0 marks "not holding pages"); tokens already
+        # accrued stay — re-derivation after re-admission is MORE cost
+        self._cost_touch_locked(stream)
+        stream.cost_t = 0.0
         self._slots[slot] = None
         self._free_locked(stream.pages)
         stream.pages = []
@@ -5005,7 +5122,24 @@ class PagedEngine:
                 # fallback used to degrade with only a one-shot WARN)
                 "kernel_active": int(self._kernel_active),
                 "kv_dtype_int8": int(self._kv_int8),
+                # cost ledger (r20): per-adapter attribution split of
+                # the cost_* counters above — labeled export from the
+                # bridge, same shape as adapter_requests (excluded from
+                # the flat mapping)
+                "cost_by_adapter": {
+                    k: dict(v) for k, v in self._cost_by_adapter.items()
+                },
             }
+        if not self._telemetry_enabled:
+            # SELDON_TPU_TELEMETRY=0 contract: no new metric series —
+            # the bridge exports nothing it cannot see
+            for k in (
+                "cost_page_seconds",
+                "cost_prefill_tokens",
+                "cost_decode_tokens",
+                "cost_by_adapter",
+            ):
+                out.pop(k, None)
         if detail:
             if self._watchdog is not None:
                 out["watchdog"] = self._watchdog.stats()
@@ -5202,6 +5336,7 @@ class PagedEngine:
                 self._slots[i] = None
             self._lengths[:] = 0
             for stream in victims:
+                self._cost_close_locked(stream)
                 if stream.pages:
                     self._free_locked(stream.pages)
                     stream.pages = []
@@ -5513,6 +5648,7 @@ class PagedEngine:
                 n = int(emitted_np[s])
                 self._counters["tokens"] += n
                 chunk_tokens += n
+                stream.cost_decode_tokens += n
                 got = toks_np[s, :n].tolist()
                 if got and not stream.tokens and not stream.t_first_token:
                     # TTFT numerator: the stream's first decode token
@@ -5533,8 +5669,16 @@ class PagedEngine:
             prefix_saved_d = self._counters["prefix_tokens_saved"] - pre_saved
             slo_d = {k: self._counters[k] - pre_slo[k] for k in _SLO_COUNTER_KEYS}
             pages_cached = len(self._lru)
+            # exemplar seed: any traced stream in the wave links this
+            # chunk's duration observation back to one real trace
+            chunk_trace = ""
+            if self._telemetry_enabled:
+                chunk_trace = next(
+                    (s.trace_id for s in decoding if s.trace_id), ""
+                )
         self._record_chunk({
             "phase": "decode",
+            "trace_id": chunk_trace,
             "wall_ms": round(chunk_wall * 1000.0, 3),
             "prefill_wall_ms": round(wave_prefill_wall * 1000.0, 3),
             "tp_degree": self.tp_degree,
@@ -5788,6 +5932,7 @@ class PagedEngine:
                 got = out_np[s, :n].tolist()
                 self._counters["tokens"] += n
                 chunk_tokens += n
+                stream.cost_decode_tokens += n
                 self._counters["spec_accepted"] += max(0, n - 1)
                 stream.tokens.extend(got)
                 stream.pending = int(got[-1]) if got else stream.pending
@@ -5804,8 +5949,14 @@ class PagedEngine:
             prefix_saved_d = self._counters["prefix_tokens_saved"] - pre_saved
             slo_d = {k: self._counters[k] - pre_slo[k] for k in _SLO_COUNTER_KEYS}
             pages_cached = len(self._lru)
+            chunk_trace = ""
+            if self._telemetry_enabled:
+                chunk_trace = next(
+                    (s.trace_id for s in runnable if s.trace_id), ""
+                )
         self._record_chunk({
             "phase": "spec_verify",
+            "trace_id": chunk_trace,
             "wall_ms": round(chunk_wall * 1000.0, 3),
             "prefill_wall_ms": round(wave_prefill_wall * 1000.0, 3),
             "tp_degree": self.tp_degree,
@@ -5965,6 +6116,15 @@ class StreamingLM(TPUComponent):
         self._load_lock = threading.Lock()
         self._counter = 0
         self._counter_lock = threading.Lock()
+        # fleet telemetry plane (r20): per-replica sample ring, fed from
+        # the decode loop's throttled collect hook; None when
+        # SELDON_TPU_TELEMETRY=0 (no ring, no /debug/telemetry route)
+        self._telemetry_ring = None
+        # per-request cost ledger handoff: predict() leaves the request's
+        # cost totals here and the dispatcher's get_custom_tags() call
+        # (same thread, immediately after predict) picks them up via
+        # tags() — thread-local because dispatch threads run concurrently
+        self._request_cost = threading.local()
 
     def load(self) -> None:
         # IDEMPOTENT, and it must be: the executor calls load() on graph
@@ -6027,6 +6187,12 @@ class StreamingLM(TPUComponent):
                     )
                 except Exception:  # noqa: BLE001 — metrics never block serving
                     logger.exception("prometheus bridge unavailable")
+            if _telemetry.telemetry_enabled():
+                self._telemetry_ring = _telemetry.TelemetryRing(
+                    capacity=int(
+                        _knobs.raw("SELDON_TPU_TELEMETRY_RING", "256") or 256
+                    ),
+                )
             # drain/handoff replay (r12): a journal left by a drained
             # predecessor (SIGTERM → drain → exit; the supervisor keeps
             # the path stable across respawns) re-submits its live
@@ -6075,12 +6241,19 @@ class StreamingLM(TPUComponent):
             # at idle would freeze during exactly the backlog the
             # queue-depth alert exists for
             nonlocal last_collect
-            if self._prom_bridge is None:
+            if self._prom_bridge is None and self._telemetry_ring is None:
                 return
             now = _time.monotonic()
             if now - last_collect >= min_interval_s:
                 last_collect = now
-                self._prom_bridge.collect()  # internally exception-safe
+                if self._prom_bridge is not None:
+                    self._prom_bridge.collect()  # internally exception-safe
+                if self._telemetry_ring is not None:
+                    try:
+                        self._telemetry_ring.sample_engine(self.engine)
+                    except Exception:  # noqa: BLE001 — telemetry never
+                        # blocks serving
+                        logger.exception("telemetry sample failed")
 
         while not self._stop:
             self._wake.wait(timeout=0.5)
@@ -6504,6 +6677,25 @@ class StreamingLM(TPUComponent):
                 stream.event.wait()
                 if stream.error:
                     raise stream.error
+            if self.engine._telemetry_enabled:
+                # cost ledger handoff: the dispatcher reads tags() on
+                # THIS thread right after predict returns, so the
+                # request's cost totals ride meta.tags.cost on the
+                # response the caller actually sees
+                self._request_cost.value = {
+                    "page_seconds": round(
+                        sum(s.cost_page_s for s in streams), 6
+                    ),
+                    "prefill_tokens": sum(
+                        s.cost_prefill_tokens for s in streams
+                    ),
+                    "decode_tokens": sum(
+                        s.cost_decode_tokens for s in streams
+                    ),
+                    "preemptions": sum(s.cost_preempts for s in streams),
+                    "restores": sum(s.cost_restores for s in streams),
+                    "adapter": adapter or "base",
+                }
             return np.stack([s.result for s in streams])
         except BaseException:
             # one row shed/expired/errored: the siblings must not keep
@@ -6564,6 +6756,45 @@ class StreamingLM(TPUComponent):
             # stream must not keep decoding into an unread queue,
             # holding a slot and pages against live requests
             self.engine.cancel(stream)
+
+    def tags(self):
+        """Response meta tags: the LAST predict's cost-ledger totals on
+        this dispatch thread (dispatch calls get_custom_tags right after
+        predict on the same thread).  Pop-once so a later request that
+        fails before submit cannot inherit a stale ledger."""
+        cost = getattr(self._request_cost, "value", None)
+        self._request_cost.value = None
+        return {"cost": cost} if cost else {}
+
+    def telemetry_snapshot(self, window_s: float = 0.0):
+        """The versioned per-replica telemetry payload.  Takes one fresh
+        engine sample first: pollers arriving between decode-loop
+        collect ticks (or while the engine idles) must still see current
+        queue depth / residency, not the last busy-period point."""
+        if self._telemetry_ring is None:
+            return None
+        if self.engine is not None:
+            try:
+                self._telemetry_ring.sample_engine(self.engine)
+            except Exception:  # noqa: BLE001 — serve what the ring has
+                logger.exception("telemetry sample failed")
+        return self._telemetry_ring.snapshot(window_s)
+
+    def custom_routes(self):
+        """``GET /debug/telemetry`` on the worker's own REST surface —
+        what the fleet aggregator polls.  No ring (telemetry off) means
+        no route: the =0 lane serves the exact pre-telemetry routes."""
+        if self._telemetry_ring is None:
+            return {}
+
+        def debug_telemetry(request):
+            try:
+                window_s = float(request.query.get("window", "0") or 0.0)
+            except (ValueError, AttributeError):
+                window_s = 0.0
+            return self.telemetry_snapshot(window_s)
+
+        return {"/debug/telemetry": debug_telemetry}
 
     def metrics(self):
         """Paged-engine health for the dashboards.  All GAUGEs:

@@ -286,6 +286,29 @@ ENV_KNOBS: Dict[str, Knob] = _knobs(
     Knob("SELDON_TPU_PROFILE_CHUNKS", "int", "4", False,
          "how many decode chunks run under the profiler hook",
          "architecture.md §5c"),
+    # ---- fleet telemetry plane (r20) --------------------------------------
+    Knob("SELDON_TPU_TELEMETRY", "flag", "1", True,
+         "fleet telemetry plane: per-replica telemetry ring, per-request "
+         "cost ledger and histogram trace exemplars (0 = off, behaviour-"
+         "identical to the pre-telemetry build — no new metric series)",
+         "architecture.md §5c-ter"),
+    Knob("SELDON_TPU_TELEMETRY_RING", "int", "256", False,
+         "telemetry time-series ring capacity (samples per replica)",
+         "architecture.md §5c-ter"),
+    Knob("SELDON_TPU_FLEET_ENDPOINTS", "str", "", True,
+         "comma-separated replica base URLs (name=http://host:port,...) "
+         "the gateway's fleet aggregator polls for /debug/fleet (empty/0 "
+         "= derive from the local supervisor's workers, else fleet view "
+         "off)",
+         "architecture.md §5c-ter"),
+    Knob("SELDON_TPU_FLEET_POLL_S", "float", "2", False,
+         "fleet aggregator poll interval (seconds)",
+         "architecture.md §5c-ter"),
+    Knob("SELDON_TPU_FLEET_STALE_S", "float", "10", False,
+         "age after which a non-responding replica's fleet entry is "
+         "marked stale (it keeps its last snapshot; the poll loop never "
+         "fails over one dead replica)",
+         "architecture.md §5c-ter"),
 )
 
 

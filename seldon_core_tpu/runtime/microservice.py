@@ -184,6 +184,12 @@ def main(argv: Optional[List[str]] = None) -> None:
 
         jax.config.update("jax_platforms", args.platform)
 
+    if args.unit_id:
+        # export the unit identity for in-process consumers that have
+        # no CLI access (the telemetry ring's replica_id): supervised
+        # workers get --unit-id on argv, not in their environment
+        os.environ.setdefault(UNIT_ID_ENV_NAME, args.unit_id)
+
     kwargs = parse_parameters(json.loads(args.parameters))
     user_model = import_component(args.component, **kwargs)
 

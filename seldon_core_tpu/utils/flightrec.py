@@ -61,6 +61,14 @@ class FlightRecorder:
         self._lock = threading.Lock()
         self._seq = 0
         self._last_dump_s = 0.0
+        # lifetime token totals, accumulated at record() time: the
+        # window_* sums below cover only records still RESIDENT in the
+        # ring, so once the deque wraps they plateau (each append
+        # retires the head entry) and a consumer diffing successive
+        # stats() snapshots silently loses the overwritten head's
+        # tokens.  Totals never wrap — delta them instead.
+        self._total_prefill_tokens = 0
+        self._total_decode_tokens = 0
         self.dumps = 0
         self.last_dump_path: Optional[str] = None
 
@@ -73,6 +81,8 @@ class FlightRecorder:
             self._seq += 1
             rec["seq"] = self._seq
             rec.setdefault("t", self._clock())
+            self._total_prefill_tokens += int(rec.get("prefill_tokens", 0))
+            self._total_decode_tokens += int(rec.get("decode_tokens", 0))
             self._ring.append(rec)
             breached = (
                 self.dump_p99_ms > 0.0
@@ -130,6 +140,8 @@ class FlightRecorder:
             decode_toks = sum(
                 int(r.get("decode_tokens", 0)) for r in self._ring
             )
+            total_prefill = self._total_prefill_tokens
+            total_decode = self._total_decode_tokens
         return {
             "records": n,
             "seq": self._seq,
@@ -137,6 +149,12 @@ class FlightRecorder:
             "last_queue_depth": int(last.get("queue_depth", 0)),
             "window_prefill_tokens": prefill_toks,
             "window_decode_tokens": decode_toks,
+            # lifetime totals: unlike the window_* sums these survive
+            # ring wrap, so rate consumers (the telemetry ring) can
+            # delta successive snapshots without losing the head
+            # records each wrap retires
+            "total_prefill_tokens": total_prefill,
+            "total_decode_tokens": total_decode,
             "dumps": self.dumps,
         }
 

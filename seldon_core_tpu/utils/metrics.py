@@ -404,6 +404,25 @@ ENGINE_STATS_METRICS: Dict[str, Tuple[str, str, str]] = {
     "kv_dtype_int8": ("gauge", "seldon_tpu_engine_kv_dtype_int8",
                       "KV pool element type (1 = int8 pages with "
                       "per-page scales, 0 = native compute dtype)"),
+    # per-request cost ledger (r20): work attribution totals, accrued
+    # exactly once per stream at termination (finish/fail/shed/export).
+    # page_seconds is the KV occupancy INTEGRAL (pages x wall seconds,
+    # stamped at every page-count change), the capacity quantity a
+    # tenant's bill prices — tokens alone can't see a stream that sat
+    # on pages.  Keys absent when SELDON_TPU_TELEMETRY=0 (the bridge
+    # must export no new series on the off lane).
+    "cost_page_seconds": ("counter",
+                          "seldon_tpu_engine_cost_page_seconds_total",
+                          "KV page-seconds consumed by terminated "
+                          "streams (occupancy integral)"),
+    "cost_prefill_tokens": ("counter",
+                            "seldon_tpu_engine_cost_prefill_tokens_total",
+                            "prompt tokens attributed to terminated "
+                            "streams by the cost ledger"),
+    "cost_decode_tokens": ("counter",
+                           "seldon_tpu_engine_cost_decode_tokens_total",
+                           "decode tokens attributed to terminated "
+                           "streams by the cost ledger"),
 }
 
 # keys intentionally NOT exported as their own series: the wall-clock
@@ -417,13 +436,55 @@ ENGINE_STATS_METRICS: Dict[str, Tuple[str, str, str]] = {
 # seldon_tpu_engine_adapter_requests_total{adapter=...} (per-adapter
 # labels the flat mapping can't carry)
 # "health" is the state STRING twin of the health_state gauge — the
-# debug surfaces read it, prometheus reads the numeric code
+# debug surfaces read it, prometheus reads the numeric code;
+# cost_by_adapter is an adapter->totals dict the bridge exports itself
+# with adapter labels (COST_LEDGER_METRICS below — the flat mapping
+# can't carry labels, same shape as adapter_requests)
 ENGINE_STATS_EXCLUDED = {"chunk_wall_s", "prefill_wall_s", "jit_compiles",
-                         "adapter_requests", "health"}
+                         "adapter_requests", "health", "cost_by_adapter"}
 
 ADAPTER_REQUESTS_METRIC = "seldon_tpu_engine_adapter_requests_total"
 
 CHUNK_DURATION_METRIC = "seldon_tpu_engine_chunk_duration_seconds"
+
+# cost_by_adapter field -> (kind, canonical metric name, doc): the
+# per-adapter labeled split of the cost_* counters above.  COMPLETE BY
+# CONTRACT like the flat mapping (graftlint's metrics-contract checker
+# verifies naming; the per-adapter sums must equal the flat totals —
+# tests/test_telemetry.py asserts it).
+COST_LEDGER_METRICS: Dict[str, Tuple[str, str, str]] = {
+    "page_seconds": ("counter",
+                     "seldon_tpu_engine_cost_adapter_page_seconds_total",
+                     "KV page-seconds by adapter (base = no adapter)"),
+    "prefill_tokens": ("counter",
+                       "seldon_tpu_engine_cost_adapter_prefill_tokens_total",
+                       "prompt tokens by adapter"),
+    "decode_tokens": ("counter",
+                      "seldon_tpu_engine_cost_adapter_decode_tokens_total",
+                      "decode tokens by adapter"),
+    "streams": ("counter",
+                "seldon_tpu_engine_cost_adapter_streams_total",
+                "terminated streams by adapter"),
+}
+
+
+def _trace_exemplar() -> Optional[Dict[str, str]]:
+    """OpenMetrics exemplar payload for the active trace, or None when
+    telemetry is off / no span is active.  Exemplars ride histogram
+    observations on the hot lanes (chunk duration, transport hops) so a
+    latency bucket links back to ONE real request's trace id."""
+    from seldon_core_tpu.utils import telemetry as _telemetry
+
+    if not _telemetry.telemetry_enabled():
+        return None
+    from seldon_core_tpu.utils.tracing import current_span
+
+    span = current_span()
+    tid = getattr(span, "trace_id", "") if span is not None else ""
+    if not tid:
+        return None
+    # OpenMetrics caps exemplar label runes at 128 total
+    return {"trace_id": str(tid)[:100]}
 
 
 class GenerationPrometheusBridge:
@@ -488,6 +549,23 @@ class GenerationPrometheusBridge:
                     tuple(sorted(labels)),
                     "adapter-carrying requests submitted, by adapter name",
                 ).labels(**labels).inc(delta)
+        # per-adapter cost attribution (r20): labeled export of the
+        # ledger's adapter split — same counter-delta discipline.  The
+        # key is absent entirely when SELDON_TPU_TELEMETRY=0, so the
+        # off lane exports no cost series at all.
+        for adapter, fields in (stats.get("cost_by_adapter") or {}).items():
+            for field, spec in COST_LEDGER_METRICS.items():
+                kind, name, doc = spec
+                key = f"cost_adapter:{adapter}:{field}"
+                prev = self._last.get(key, 0.0)
+                cur = float(fields.get(field, 0.0))
+                delta = cur - prev if cur >= prev else cur
+                self._last[key] = cur
+                if delta > 0:
+                    labels = dict(self._labels, adapter=adapter)
+                    self._cache.get(
+                        kind, name, tuple(sorted(labels)), doc,
+                    ).labels(**labels).inc(delta)
         for key, value in stats.items():
             spec = ENGINE_STATS_METRICS.get(key)
             if spec is None:
@@ -511,11 +589,130 @@ class GenerationPrometheusBridge:
             )
             for rec in recorder.since(self._last_seq):
                 self._last_seq = max(self._last_seq, rec["seq"])
-                hist.observe(float(rec.get("wall_ms", 0.0)) / 1000.0)
+                # trace exemplar (r20): the chunk record carries the
+                # trace id of one traced stream in its wave (telemetry-
+                # gated at the engine) — an OpenMetrics scrape links
+                # the latency bucket to a real request
+                tid = str(rec.get("trace_id", "") or "")
+                hist.observe(
+                    float(rec.get("wall_ms", 0.0)) / 1000.0,
+                    exemplar={"trace_id": tid[:100]} if tid else None,
+                )
             self._metric(
                 "gauge", "seldon_tpu_engine_chunk_p99_ms",
                 "chunk-wall p99 over the flight recorder window",
             ).set(float(recorder.stats()["chunk_p99_ms"]))
+
+
+# ---------------------------------------------------------------------------
+# fleet telemetry bridge (controlplane/fleetview.py -> seldon_tpu_fleet_*)
+# ---------------------------------------------------------------------------
+
+# TelemetryAggregator.fleet_rollup() key -> (kind, metric name, doc).
+# COMPLETE BY CONTRACT like the engine bridge: every rollup key must
+# appear here or in FLEET_EXCLUDED (graftlint metrics-contract
+# GL406/GL407), so a new fleet aggregate cannot silently skip export.
+# All gauges: the rollup is a point-in-time merge, re-summed per poll.
+FLEET_METRICS: Dict[str, Tuple[str, str, str]] = {
+    "replicas_total": ("gauge", "seldon_tpu_fleet_replicas",
+                       "replica endpoints the aggregator polls"),
+    "replicas_ok": ("gauge", "seldon_tpu_fleet_replicas_ok",
+                    "replicas with a fresh telemetry snapshot"),
+    "replicas_stale": ("gauge", "seldon_tpu_fleet_replicas_stale",
+                       "replicas whose last snapshot aged past the "
+                       "staleness window (not crashed — unpolled)"),
+    "replicas_incompatible": ("gauge",
+                              "seldon_tpu_fleet_replicas_incompatible",
+                              "replicas answering with a future/invalid "
+                              "telemetry schema"),
+    "fleet_queue_depth": ("gauge", "seldon_tpu_fleet_queue_depth",
+                          "queued streams across ok replicas"),
+    "fleet_active_slots": ("gauge", "seldon_tpu_fleet_active_slots",
+                           "live decode slots across ok replicas"),
+    "fleet_slots_total": ("gauge", "seldon_tpu_fleet_slot_capacity",
+                          "decode slot capacity across ok replicas"),
+    "fleet_goodput_tok_s": ("gauge", "seldon_tpu_fleet_goodput_tok_s",
+                            "decode tokens/s served across ok replicas"),
+    "fleet_prefill_tok_s": ("gauge", "seldon_tpu_fleet_prefill_tok_s",
+                            "prefill tokens/s across ok replicas"),
+    "fleet_completed_s": ("gauge", "seldon_tpu_fleet_completed_s",
+                          "streams completed/s across ok replicas"),
+    "fleet_shed_s": ("gauge", "seldon_tpu_fleet_shed_s",
+                     "streams shed/s across ok replicas"),
+    "fleet_preempted_s": ("gauge", "seldon_tpu_fleet_preempted_s",
+                          "streams preempted/s across ok replicas"),
+    "fleet_migrated_out_s": ("gauge", "seldon_tpu_fleet_migrated_out_s",
+                             "streams live-migrated/s across ok replicas"),
+    "fleet_pool_pages_used": ("gauge", "seldon_tpu_fleet_pool_pages_used",
+                              "KV pool pages in use across ok replicas"),
+    "fleet_pool_pages_total": ("gauge", "seldon_tpu_fleet_pool_page_capacity",
+                               "KV pool page capacity across ok replicas"),
+    "fleet_cost_page_s_s": ("gauge", "seldon_tpu_fleet_cost_page_s_s",
+                            "KV page-seconds accrued per second across "
+                            "ok replicas (cost ledger burn rate)"),
+    "fleet_prefix_hit_pct": ("gauge", "seldon_tpu_fleet_prefix_hit_pct",
+                             "mean prefix-cache hit % across ok replicas"),
+    "fleet_saturation_max": ("gauge", "seldon_tpu_fleet_saturation_max",
+                             "worst replica saturation score [0,1] — the "
+                             "FleetReplicaSaturated alert reads this"),
+    "fleet_saturation_mean": ("gauge", "seldon_tpu_fleet_saturation_mean",
+                              "mean replica saturation score [0,1]"),
+    "fleet_chunk_p99_ms": ("gauge", "seldon_tpu_fleet_chunk_p99_ms",
+                           "worst per-replica chunk-wall p99 (ms)"),
+    "fleet_predict_cost_s_max": ("gauge",
+                                 "seldon_tpu_fleet_predict_cost_s_max",
+                                 "worst predicted service seconds for a "
+                                 "nominal request across ok replicas"),
+}
+
+# rollup keys not exported as their own series ("t" is the poll stamp)
+FLEET_EXCLUDED = {"t"}
+
+FLEET_REPLICA_SATURATION_METRIC = "seldon_tpu_fleet_replica_saturation"
+FLEET_REPLICA_STATE_METRIC = "seldon_tpu_fleet_replica_state"
+
+# replica freshness encoding for the per-replica state gauge
+FLEET_STATE_CODES = {"ok": 0, "stale": 1, "incompatible": 2, "never": 3}
+
+
+class FleetPrometheusBridge:
+    """TelemetryAggregator fleet view -> ``seldon_tpu_fleet_*`` gauges,
+    collected after every poll (the aggregator calls :meth:`collect`
+    when attached as its ``bridge``).  Complete-by-contract against
+    FLEET_METRICS/FLEET_EXCLUDED; per-replica saturation and state
+    export with a ``replica`` label the flat rollup can't carry."""
+
+    def __init__(self, aggregator, registry=None):
+        self.aggregator = aggregator
+        self._cache = _cache_for(registry)
+
+    def collect(self) -> None:
+        """Never raises — the bridge must not take the poll loop down."""
+        try:
+            self._collect()
+        except Exception:  # noqa: BLE001 — same discipline as the engine bridge
+            logger.exception("fleet prometheus bridge collect failed")
+
+    def _collect(self) -> None:
+        rollup = self.aggregator.fleet_rollup()
+        for key, value in rollup.items():
+            spec = FLEET_METRICS.get(key)
+            if spec is None:
+                continue  # contract-tested: unmapped => in FLEET_EXCLUDED
+            kind, name, doc = spec
+            self._cache.get(kind, name, (), doc).set(float(value))
+        for replica, row in self.aggregator.replica_states().items():
+            self._cache.get(
+                "gauge", FLEET_REPLICA_SATURATION_METRIC, ("replica",),
+                "per-replica saturation score [0,1]",
+            ).labels(replica=replica).set(float(row.get("saturation", 0.0)))
+            self._cache.get(
+                "gauge", FLEET_REPLICA_STATE_METRIC, ("replica",),
+                "replica telemetry freshness (0 ok, 1 stale, "
+                "2 incompatible, 3 never polled)",
+            ).labels(replica=replica).set(
+                FLEET_STATE_CODES.get(row.get("state"), 3)
+            )
 
 
 # ---------------------------------------------------------------------------
@@ -638,9 +835,13 @@ def record_transport_hop(
         if transport != "local":
             # the local transport has no codec or wire share by design
             # (device payloads pass by handle); observing constant 0.0
-            # would poison the histograms' lower buckets
+            # would poison the histograms' lower buckets.  The wire
+            # share carries a trace exemplar (telemetry-gated): the
+            # hop runs inside the caller's span, so the active trace
+            # IS the request this observation belongs to.
+            ex = _trace_exemplar()
             hop.serialize_seconds.observe(max(0.0, serialize_seconds))
-            hop.network_seconds.observe(max(0.0, network_seconds))
+            hop.network_seconds.observe(max(0.0, network_seconds), exemplar=ex)
     except Exception:  # noqa: BLE001 — telemetry never fails the hop
         logger.exception("transport telemetry failed for %s/%s", unit, method)
 

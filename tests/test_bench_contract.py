@@ -235,6 +235,12 @@ def _r3_like_full_result():
                 "trace_prop_overhead_pct": 1.8,
                 "protocol": "16-way StreamingLM graph serving, best-of-3",
             },
+            "telemetry": {
+                "telemetry_on_tok_s": 4390.0,
+                "telemetry_off_tok_s": 4450.0,
+                "telemetry_overhead_pct": 1.35,
+                "protocol": "16-way StreamingLM graph serving, best-of-3",
+            },
             "chaos": {
                 "chaos_goodput_pct": 95.8,
                 "breaker_fastfail_pct": 87.5,
@@ -391,6 +397,18 @@ def test_compact_line_carries_trace_prop_overhead(bench):
     assert e["trace_prop_overhead_pct"] == 1.8
     assert "trace_on_tok_s" not in e
     assert "protocol" not in e
+
+
+def test_compact_line_carries_telemetry_overhead(bench):
+    """r20 certification key: the serving cost of the full telemetry
+    plane (replica ring + cost ledger + exemplar capture) vs
+    SELDON_TPU_TELEMETRY=0, as a float percentage gated < 2; the raw
+    on/off rates stay in bench_full.json under telemetry."""
+    compact = bench._compact_result(_r3_like_full_result())
+    e = compact["extra"]
+    assert isinstance(e["telemetry_overhead_pct"], float)
+    assert e["telemetry_overhead_pct"] == 1.35
+    assert "telemetry_on_tok_s" not in e
 
 
 def test_compact_line_carries_prefix_cache_story(bench):

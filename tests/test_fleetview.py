@@ -1,0 +1,519 @@
+"""r20 telemetry plane, fleet half: the TelemetryAggregator's poll
+loop (deadline + trace headers on every hop, breaker-contained dials,
+stale-not-crashed freshness), the merged fleet view (rollup, adapter
+and prefix residency), schema-version incompatibility, and the
+FleetPrometheusBridge export.
+
+Fast tier drives stub HTTP replicas (canned snapshots, captured
+headers); the real 2-supervised-worker e2e is @slow.
+"""
+
+import http.server
+import json
+import socket
+import threading
+import time
+
+import pytest
+
+from seldon_core_tpu.controlplane import fleetview
+from seldon_core_tpu.engine.transport import CircuitBreaker
+from seldon_core_tpu.utils import telemetry
+
+
+@pytest.fixture(autouse=True)
+def _fresh_breakers():
+    CircuitBreaker.reset_all()
+    yield
+    CircuitBreaker.reset_all()
+
+
+def _point(**over):
+    p = {
+        "t": 1.0, "queue_depth": 2, "active_slots": 1,
+        "active_slots_total": 4, "goodput_tok_s": 100.0,
+        "prefill_tok_s": 40.0, "completed_s": 1.5, "prefix_hit_pct": 50.0,
+        "prefix_pages_cached": 6, "pool_pages_used": 10,
+        "pool_pages_total": 40, "adapters": [], "shed_s": 0.0,
+        "expired_s": 0.0, "preempted_s": 0.0, "restored_s": 0.0,
+        "migrated_out_s": 0.0, "migrated_in_s": 0.0, "cost_page_s_s": 2.0,
+        "chunk_p99_ms": 12.0, "predict_cost_s": 0.3, "health": "healthy",
+    }
+    p.update(over)
+    p["saturation"] = telemetry.saturation_score(p)
+    return p
+
+
+class _StubReplica:
+    """A threaded HTTP server answering /debug/telemetry with a canned
+    snapshot, capturing every request's headers."""
+
+    def __init__(self, snapshot):
+        self.snapshot = snapshot
+        self.raw_body = None  # overrides snapshot when set (garbage tests)
+        self.headers = []
+        stub = self
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802 — stdlib naming
+                stub.headers.append(dict(self.headers))
+                body = (
+                    stub.raw_body if stub.raw_body is not None
+                    else json.dumps(stub.snapshot).encode()
+                )
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):  # quiet
+                pass
+
+        self.server = http.server.ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.port = self.server.server_address[1]
+        self.url = f"http://127.0.0.1:{self.port}"
+        self._thread = threading.Thread(
+            target=self.server.serve_forever, daemon=True
+        )
+        self._thread.start()
+
+    def close(self):
+        self.server.shutdown()
+        self.server.server_close()
+
+
+def _snapshot(replica_id, point):
+    return {
+        "schema_version": telemetry.TELEMETRY_SCHEMA_VERSION,
+        "replica_id": replica_id, "t": 1.0, "window_s": 30.0,
+        "capacity": 256, "points": [point], "latest": point,
+    }
+
+
+class _Clock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+class TestEndpointParsing:
+    def test_knob_grammar_named_bare_and_off(self):
+        eps = fleetview.endpoints_from_knob(
+            "r0=http://h0:9000, http://h1:9100/,r2=https://h2:9200"
+        )
+        assert eps == {
+            "r0": "http://h0:9000",
+            "h1:9100": "http://h1:9100",
+            "r2": "https://h2:9200",
+        }
+        assert fleetview.endpoints_from_knob("") == {}
+        assert fleetview.endpoints_from_knob("0") == {}
+
+    def test_endpoints_from_supervisor_specs(self):
+        class Spec:
+            def __init__(self, port):
+                self.http_port = port
+
+        class SP:
+            def __init__(self, port):
+                self.spec = Spec(port)
+
+        class Sup:
+            processes = {"lm-0": SP(9700), "lm-1": SP(9701)}
+
+        assert fleetview.endpoints_from_supervisor(Sup()) == {
+            "lm-0": "http://127.0.0.1:9700",
+            "lm-1": "http://127.0.0.1:9701",
+        }
+
+
+class TestAggregatorPolling:
+    def test_two_replicas_merge_in_one_poll(self):
+        a = _StubReplica(_snapshot("ra", _point(adapters=["tenant-a"])))
+        b = _StubReplica(_snapshot("rb", _point(
+            goodput_tok_s=60.0, queue_depth=6, adapters=["tenant-a",
+                                                         "tenant-b"],
+            prefix_pages_cached=2,
+        )))
+        agg = fleetview.TelemetryAggregator(
+            endpoints={"a": a.url, "b": b.url}, poll_s=0.1, stale_s=5.0,
+        )
+        try:
+            view = agg.poll_once()
+            reps = view["replicas"]
+            assert reps["a"]["state"] == "ok"
+            assert reps["b"]["state"] == "ok"
+            assert reps["a"]["replica_id"] == "ra"
+            roll = view["rollup"]
+            assert roll["replicas_total"] == 2
+            assert roll["replicas_ok"] == 2
+            assert roll["fleet_goodput_tok_s"] == pytest.approx(160.0)
+            assert roll["fleet_queue_depth"] == 8
+            assert roll["fleet_cost_page_s_s"] == pytest.approx(4.0)
+            # residency maps merge across replicas
+            assert view["adapters"] == {
+                "tenant-a": ["a", "b"], "tenant-b": ["b"],
+            }
+            assert view["prefix_pages"] == {"a": 6, "b": 2}
+        finally:
+            a.close()
+            b.close()
+
+    def test_poll_hops_carry_deadline_and_trace_headers(self):
+        from seldon_core_tpu.utils import deadlines, tracing
+
+        a = _StubReplica(_snapshot("ra", _point()))
+        agg = fleetview.TelemetryAggregator(
+            endpoints={"a": a.url}, poll_s=0.1, stale_s=5.0,
+        )
+        tracer = tracing.setup_tracing("fleet-test")
+        try:
+            with deadlines.activate(deadlines.Deadline.after_ms(30000)):
+                with tracer.span("fleet.poll", trace_id="fleet-puid"):
+                    agg.poll_once()
+            hdrs = a.headers[-1]
+            assert int(hdrs["X-Seldon-Deadline-Ms"]) > 0
+            assert "traceparent" in {k.lower() for k in hdrs}
+            # window rides the query, not a header
+            assert agg.replica_states()["a"]["state"] == "ok"
+        finally:
+            tracing._tracer = None
+            a.close()
+
+    def test_killed_replica_goes_stale_not_crashed(self):
+        """The freshness criterion: a SIGKILLed replica's last snapshot
+        is retained and ages to `stale`; the poll loop neither raises
+        nor marks the surviving replica."""
+        clock = _Clock()
+        a = _StubReplica(_snapshot("ra", _point()))
+        b = _StubReplica(_snapshot("rb", _point()))
+        agg = fleetview.TelemetryAggregator(
+            endpoints={"a": a.url, "b": b.url}, poll_s=0.1, stale_s=5.0,
+            clock=clock,
+        )
+        try:
+            agg.poll_once()
+            assert {r["state"] for r in agg.replica_states().values()} == {"ok"}
+            a.close()  # the "SIGKILL": connection refused from now on
+            clock.t += 6.0  # past stale_s
+            view = agg.poll_once()  # must not raise
+            reps = view["replicas"]
+            assert reps["a"]["state"] == "stale"
+            assert reps["a"]["last_err"]  # the fault is reported
+            assert reps["a"]["latest"]["goodput_tok_s"] == 100.0  # retained
+            assert reps["b"]["state"] == "ok"
+            # stale replicas drop OUT of the capacity rollup
+            roll = view["rollup"]
+            assert roll["replicas_ok"] == 1
+            assert roll["replicas_stale"] == 1
+            assert roll["fleet_goodput_tok_s"] == pytest.approx(100.0)
+        finally:
+            b.close()
+
+    def test_future_schema_version_marks_incompatible(self):
+        snap = _snapshot("ra", _point())
+        snap["schema_version"] = telemetry.TELEMETRY_SCHEMA_VERSION + 1
+        a = _StubReplica(snap)
+        agg = fleetview.TelemetryAggregator(
+            endpoints={"a": a.url}, poll_s=0.1, stale_s=5.0,
+        )
+        try:
+            view = agg.poll_once()
+            r = view["replicas"]["a"]
+            assert r["state"] == "incompatible"
+            assert "schema_version" in r["last_err"]
+            assert view["rollup"]["replicas_incompatible"] == 1
+            assert view["rollup"]["replicas_ok"] == 0
+        finally:
+            a.close()
+
+    def test_garbage_answer_marks_incompatible_without_tripping_breaker(self):
+        a = _StubReplica(None)
+        a.raw_body = b"not json at all"
+        agg = fleetview.TelemetryAggregator(
+            endpoints={"a": a.url}, poll_s=0.1, stale_s=5.0,
+        )
+        try:
+            for _ in range(8):  # more than the breaker's trip threshold
+                agg.poll_once()
+            assert agg.replica_states()["a"]["state"] == "incompatible"
+            # an answering endpoint is breaker-healthy: garbage never
+            # opens the circuit (the replica is alive, just wrong)
+            breaker = CircuitBreaker._registry.get(f"fleet:{a.url}")
+            if breaker is not None:
+                assert breaker.counters["trips"] == 0
+        finally:
+            a.close()
+
+    def test_dead_endpoint_trips_breaker_then_fast_fails(self):
+        with socket.socket() as s:  # a port with nothing listening
+            s.bind(("127.0.0.1", 0))
+            dead = f"http://127.0.0.1:{s.getsockname()[1]}"
+        agg = fleetview.TelemetryAggregator(
+            endpoints={"a": dead}, poll_s=0.1, stale_s=5.0, timeout_s=0.5,
+        )
+        for _ in range(8):
+            agg.poll_once()  # never raises
+        breaker = CircuitBreaker._registry.get(f"fleet:{dead}")
+        assert breaker is not None
+        assert breaker.counters["trips"] >= 1
+        assert breaker.counters["fastfails"] >= 1  # open = no dial attempt
+        assert agg.replica_states()["a"]["state"] == "never"
+
+
+class TestFleetBridge:
+    def test_rollup_and_replica_gauges_export(self):
+        import prometheus_client
+
+        from seldon_core_tpu.utils.metrics import (
+            FLEET_EXCLUDED,
+            FLEET_METRICS,
+            FleetPrometheusBridge,
+        )
+
+        a = _StubReplica(_snapshot("ra", _point()))
+        registry = prometheus_client.CollectorRegistry()
+        agg = fleetview.TelemetryAggregator(
+            endpoints={"a": a.url}, poll_s=0.1, stale_s=5.0,
+        )
+        agg.bridge = FleetPrometheusBridge(agg, registry=registry)
+        try:
+            agg.poll_once()  # collects the bridge after the poll
+            text = prometheus_client.generate_latest(registry).decode()
+            rollup = agg.fleet_rollup()
+            for key, (_, metric, _) in FLEET_METRICS.items():
+                assert metric in text, f"{key} -> {metric} not exported"
+            assert 'seldon_tpu_fleet_replica_saturation{replica="a"}' in text
+            assert 'seldon_tpu_fleet_replica_state{replica="a"} 0.0' in text
+            assert f"seldon_tpu_fleet_replicas {float(rollup['replicas_ok'])}" \
+                in text
+            # the contract closes both ways: every rollup key is mapped
+            # or excluded (graftlint enforces this statically too)
+            assert set(rollup) == set(FLEET_METRICS) | FLEET_EXCLUDED
+        finally:
+            a.close()
+
+
+@pytest.mark.slow
+def test_two_supervised_workers_converge_and_survive_sigkill():
+    """The full r20 fleet loop across real processes: two supervised
+    StreamingLM replicas serve /debug/telemetry; the aggregator (fed by
+    endpoints_from_supervisor) reports BOTH ok within one poll; a
+    SIGKILLed replica transitions to `stale` without failing the poll
+    loop, while the survivor keeps reporting."""
+    import urllib.request
+
+    import numpy as np
+
+    from seldon_core_tpu.controlplane.supervisor import (
+        ProcessSpec,
+        Supervisor,
+    )
+
+    def _free_port():
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            return s.getsockname()[1]
+
+    params = json.dumps([
+        {"name": "vocab_size", "value": "256", "type": "INT"},
+        {"name": "d_model", "value": "32", "type": "INT"},
+        {"name": "num_layers", "value": "1", "type": "INT"},
+        {"name": "num_heads", "value": "2", "type": "INT"},
+        {"name": "max_len", "value": "128", "type": "INT"},
+        {"name": "max_new_tokens", "value": "8", "type": "INT"},
+        {"name": "max_slots", "value": "2", "type": "INT"},
+        {"name": "steps_per_call", "value": "4", "type": "INT"},
+        {"name": "seed", "value": "0", "type": "INT"},
+    ])
+    env = {"JAX_PLATFORMS": "cpu", "SELDON_TPU_PLATFORM": "cpu"}
+    sup = Supervisor()
+    try:
+        for i in range(2):
+            sup.add(ProcessSpec(
+                name=f"lm-{i}",
+                component="seldon_core_tpu.models.paged.StreamingLM",
+                http_port=_free_port(), grpc_port=_free_port(),
+                parameters_json=params, env=dict(env),
+            ), wait_ready_s=240.0)
+        endpoints = fleetview.endpoints_from_supervisor(sup)
+        assert set(endpoints) == {"lm-0", "lm-1"}
+
+        # drive one real predict through lm-0 so its ring has traffic
+        port0 = sup.processes["lm-0"].spec.http_port
+        prompt = (np.arange(5) % 64).tolist()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port0}/predict",
+            data=json.dumps({"data": {"ndarray": [prompt]}}).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        urllib.request.urlopen(req, timeout=60).read()
+
+        agg = fleetview.TelemetryAggregator(
+            endpoints=endpoints, poll_s=0.2, stale_s=2.0, timeout_s=10.0,
+        )
+        view = agg.poll_once()  # ONE poll reports the whole fleet
+        assert {r["state"] for r in view["replicas"].values()} == {"ok"}
+        assert view["rollup"]["replicas_ok"] == 2
+        ids = {r["replica_id"] for r in view["replicas"].values()}
+        assert ids == {"lm-0", "lm-1"}  # PREDICTIVE_UNIT_ID round-trip
+
+        # SIGKILL one replica (and stop its supervisor respawns)
+        victim = sup.processes["lm-1"]
+        victim._stop.set()
+        victim.proc.kill()
+        victim.proc.wait(timeout=30)
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline:
+            view = agg.poll_once()  # must never raise
+            if view["replicas"]["lm-1"]["state"] == "stale":
+                break
+            time.sleep(0.3)
+        assert view["replicas"]["lm-1"]["state"] == "stale"
+        assert view["replicas"]["lm-0"]["state"] == "ok"
+        assert view["rollup"]["replicas_ok"] == 1
+        assert view["rollup"]["replicas_stale"] == 1
+    finally:
+        sup.stop_all()
+
+
+class TestGatewayDebugEndpoints:
+    """The gateway's r20 /debug surface: the replica snapshot at
+    /debug/telemetry and the merged fleet view at /debug/fleet."""
+
+    def _gateway(self, component):
+        from seldon_core_tpu.engine import PredictorService, UnitSpec
+        from seldon_core_tpu.engine.server import Gateway
+
+        svc = PredictorService(
+            UnitSpec(name="lm", type="MODEL", component=component),
+            name="main",
+        )
+        return Gateway([(svc, 1.0)])
+
+    def test_debug_telemetry_serves_component_snapshot(self):
+        import asyncio
+
+        from aiohttp.test_utils import TestClient, TestServer
+
+        from seldon_core_tpu.runtime import TPUComponent
+        from seldon_core_tpu.engine.server import build_gateway_app
+
+        class RingModel(TPUComponent):
+            windows = []
+
+            def telemetry_snapshot(self, window_s=0.0):
+                self.windows.append(window_s)
+                return _snapshot("ra", _point())
+
+            def predict(self, X, names, meta=None):
+                return X
+
+        app = build_gateway_app(self._gateway(RingModel()))
+
+        async def scenario():
+            client = TestClient(TestServer(app))
+            await client.start_server()
+            snap = await (await client.get("/debug/telemetry")).json()
+            await client.get("/debug/telemetry", params={"window": "30"})
+            await client.close()
+            return snap
+
+        snap = asyncio.run(scenario())
+        assert snap["schema_version"] == telemetry.TELEMETRY_SCHEMA_VERSION
+        assert snap["replica_id"] == "ra"
+        assert RingModel.windows == [0.0, 30.0]  # ?window= reaches the ring
+
+    def test_debug_telemetry_without_ring_reports_disabled(self):
+        import asyncio
+
+        import numpy as np
+
+        from aiohttp.test_utils import TestClient, TestServer
+
+        from seldon_core_tpu.runtime import TPUComponent
+        from seldon_core_tpu.engine.server import build_gateway_app
+
+        class Plain(TPUComponent):
+            def predict(self, X, names, meta=None):
+                return np.asarray(X)
+
+        app = build_gateway_app(self._gateway(Plain()))
+
+        async def scenario():
+            client = TestClient(TestServer(app))
+            await client.start_server()
+            out = await (await client.get("/debug/telemetry")).json()
+            await client.close()
+            return out
+
+        out = asyncio.run(scenario())
+        assert out["components"] == {}
+        assert "info" in out
+
+    def test_debug_fleet_polls_knob_endpoints(self, monkeypatch):
+        import asyncio
+
+        import numpy as np
+
+        from aiohttp.test_utils import TestClient, TestServer
+
+        from seldon_core_tpu.runtime import TPUComponent
+        from seldon_core_tpu.engine.server import build_gateway_app
+
+        class Plain(TPUComponent):
+            def predict(self, X, names, meta=None):
+                return np.asarray(X)
+
+        a = _StubReplica(_snapshot("ra", _point()))
+        monkeypatch.setenv("SELDON_TPU_FLEET_ENDPOINTS",
+                           f"ra={a.url}")
+        app = build_gateway_app(self._gateway(Plain()))
+
+        async def scenario():
+            client = TestClient(TestServer(app))
+            await client.start_server()
+            view = await (await client.get("/debug/fleet")).json()
+            again = await (await client.get("/debug/fleet")).json()
+            await client.close()
+            return view, again
+
+        try:
+            view, again = asyncio.run(scenario())
+            assert view["enabled"] is True
+            assert view["replicas"]["ra"]["state"] == "ok"
+            assert view["rollup"]["replicas_ok"] == 1
+            # polls are throttled to the poll interval: the immediate
+            # second GET serves the same poll's view
+            assert again["polls"] == view["polls"] == 1
+        finally:
+            a.close()
+
+    def test_debug_fleet_without_endpoints_reports_disabled(self, monkeypatch):
+        import asyncio
+
+        import numpy as np
+
+        from aiohttp.test_utils import TestClient, TestServer
+
+        from seldon_core_tpu.runtime import TPUComponent
+        from seldon_core_tpu.engine.server import build_gateway_app
+
+        class Plain(TPUComponent):
+            def predict(self, X, names, meta=None):
+                return np.asarray(X)
+
+        monkeypatch.delenv("SELDON_TPU_FLEET_ENDPOINTS", raising=False)
+        app = build_gateway_app(self._gateway(Plain()))
+
+        async def scenario():
+            client = TestClient(TestServer(app))
+            await client.start_server()
+            out = await (await client.get("/debug/fleet")).json()
+            await client.close()
+            return out
+
+        out = asyncio.run(scenario())
+        assert out["enabled"] is False

@@ -132,6 +132,24 @@ class TestSeededFixtures:
         # mapped/excluded/plumbing recorder kwargs are clean
         assert not [v for v in vs if v.code == "GL405" and v.symbol in (
             "requests", "zero_copy_bytes", "error", "registry")]
+        # r20: COST_LEDGER_METRICS / FLEET_METRICS ride the naming pass
+        assert ("GL403", "seldon_tpu_engine_cost_adapter_page_seconds") \
+            in pairs
+        assert ("GL403", "seldon_tpu_fleet_bad_total") in pairs
+
+    def test_metrics_contract_catches_fleet_seeds(self):
+        vs = metrics_contract.CHECKER.check_fleet(
+            _fixture("bad_metrics_fleet.py"),
+            _fixture("bad_metrics_metrics.py"),
+        )
+        pairs = {(v.code, v.symbol) for v in vs}
+        # rollup key with no FLEET_METRICS mapping and no exclusion
+        assert ("GL406", "phantom_rollup") in pairs
+        # fleet-mapped key the rollup never emits
+        assert ("GL407", "never_rolled") in pairs
+        # mapped and excluded keys are clean
+        assert not [v for v in vs if v.symbol in (
+            "replicas_ok", "fleet_queue_depth", "t")]
 
     def test_propagation_catches_all_seeds(self):
         src = _fixture("bad_propagation.py")

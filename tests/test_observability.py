@@ -367,7 +367,13 @@ class TestMonitoringAssets:
         dashboards = [f for f in os.listdir(gdir) if f.endswith(".json")]
         # predictions + outliers + generation (reference ships several)
         assert len(dashboards) >= 3
-        emitted_families = ("seldon_api", "outliers_total", "paged_", "speculative_")
+        emitted_families = (
+            "seldon_api",
+            "outliers_total",
+            "paged_",
+            "speculative_",
+            "seldon_tpu_fleet_",
+        )
         for name in dashboards:
             with open(os.path.join(gdir, name)) as f:
                 dash = json.load(f)

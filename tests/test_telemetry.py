@@ -1,0 +1,363 @@
+"""r20 telemetry plane, replica half: the windowed time-series ring,
+the versioned snapshot schema, the flight recorder's wrap-safe token
+totals, and the per-request cost ledger (exact KV page-second
+integrals, per-adapter attribution, meta.tags.cost handoff) — plus the
+SELDON_TPU_TELEMETRY=0 contract: behaviour-identical serving, no new
+metric series.
+
+Fast tier: one tiny engine (the test_paged_smoke config) pays the only
+compiles; everything else is host-side.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from seldon_core_tpu.utils import telemetry
+from seldon_core_tpu.utils.flightrec import FlightRecorder
+
+
+CFG = dict(vocab_size=64, d_model=32, num_layers=1, num_heads=2, max_len=128)
+
+
+def _tiny_engine(**kw):
+    import jax
+
+    from seldon_core_tpu.models.paged import PagedEngine
+    from seldon_core_tpu.models.transformer import TransformerLM
+
+    lm = TransformerLM(dtype=jnp.float32, **CFG)
+    params = lm.init(jax.random.key(0), jnp.zeros((1, 4), jnp.int32))["params"]
+    base = dict(dtype=jnp.float32, page_size=8, max_slots=2, steps_per_call=4)
+    base.update(kw)
+    return PagedEngine(params, **CFG, **base)
+
+
+class _FakeClock:
+    def __init__(self, t=100.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+class _StubEngine:
+    """The minimal surface sample_engine() reads — no device, no lock."""
+
+    max_slots = 4
+    recorder = None
+
+    def __init__(self):
+        self.stats = {
+            "queued_streams": 3, "active_slots": 2, "completed": 0,
+            "shed": 0, "expired": 0, "preempted": 0, "restored": 0,
+            "migrated_out": 0, "migrated_in": 0, "tokens": 0,
+            "prefill_tokens": 0, "prefix_hits": 3, "prefix_misses": 1,
+            "prefix_pages_cached": 5, "pool_pages_used": 30,
+            "pool_pages_total": 40, "cost_page_seconds": 0.0,
+            "health": "healthy",
+        }
+
+    def engine_stats(self, detail=False):
+        return dict(self.stats)
+
+    def predict_cost_s(self, prefill, decode):
+        return 0.25
+
+
+class TestTelemetryRing:
+    def test_rates_are_deltas_over_the_sample_window(self):
+        clock = _FakeClock()
+        ring = telemetry.TelemetryRing(replica_id="r0", clock=clock)
+        eng = _StubEngine()
+        ring.sample_engine(eng)  # anchor sample: no window yet, rates 0
+        eng.stats["tokens"] = 500
+        eng.stats["prefill_tokens"] = 200
+        eng.stats["completed"] = 4
+        eng.stats["cost_page_seconds"] = 12.0
+        clock.advance(2.0)
+        p = ring.sample_engine(eng)
+        assert p["goodput_tok_s"] == pytest.approx(250.0)
+        assert p["prefill_tok_s"] == pytest.approx(100.0)
+        assert p["completed_s"] == pytest.approx(2.0)
+        assert p["cost_page_s_s"] == pytest.approx(6.0)
+        # level fields ride along untouched
+        assert p["queue_depth"] == 3
+        assert p["active_slots_total"] == 4
+        assert p["prefix_hit_pct"] == 75.0
+        assert p["predict_cost_s"] == 0.25
+        # saturation: max(kv 30/40, queue 3/(2*4)) = 0.75
+        assert p["saturation"] == pytest.approx(0.75)
+
+    def test_ring_is_bounded_and_window_filters(self):
+        clock = _FakeClock()
+        ring = telemetry.TelemetryRing(replica_id="r0", capacity=4,
+                                       clock=clock)
+        for i in range(10):
+            ring.sample({"i": i})
+            clock.advance(1.0)
+        pts = ring.points()
+        assert len(pts) == 4 and pts[-1]["i"] == 9
+        # trailing 2.5 s: the points stamped at t>=clock-2.5
+        assert [p["i"] for p in ring.points(window_s=2.5)] == [8, 9]
+
+    def test_snapshot_is_versioned_and_validates(self):
+        ring = telemetry.TelemetryRing(replica_id="r7")
+        ring.sample({"queue_depth": 1})
+        snap = ring.snapshot()
+        assert snap["schema_version"] == telemetry.TELEMETRY_SCHEMA_VERSION
+        assert snap["replica_id"] == "r7"
+        assert snap["latest"]["queue_depth"] == 1
+        assert telemetry.validate_snapshot(snap) is snap
+
+    def test_future_schema_version_is_rejected(self):
+        snap = {"schema_version": telemetry.TELEMETRY_SCHEMA_VERSION + 1}
+        with pytest.raises(telemetry.SchemaVersionError):
+            telemetry.validate_snapshot(snap)
+        # and SchemaVersionError is a ValueError: one except clause
+        # catches both the future-version and no-version cases
+        assert issubclass(telemetry.SchemaVersionError, ValueError)
+
+    def test_versionless_snapshot_is_rejected(self):
+        with pytest.raises(ValueError):
+            telemetry.validate_snapshot({"points": []})
+
+    def test_replica_id_prefers_unit_id_env(self, monkeypatch):
+        monkeypatch.setenv("PREDICTIVE_UNIT_ID", "worker-3")
+        assert telemetry.default_replica_id() == "worker-3"
+        monkeypatch.delenv("PREDICTIVE_UNIT_ID")
+        assert ":" in telemetry.default_replica_id()  # host:pid fallback
+
+
+class TestFlightRecorderTotals:
+    def test_token_totals_survive_ring_wrap(self):
+        """The r20 wrap fix: stats() token totals are LIFETIME
+        accumulators, not sums over the surviving window — a ring of
+        capacity 4 that saw 10 chunks reports all 10 chunks' tokens."""
+        rec = FlightRecorder(capacity=4)
+        for i in range(10):
+            rec.record({"wall_ms": 1.0, "prefill_tokens": 3,
+                        "decode_tokens": 7, "seq": i})
+        st = rec.stats()
+        assert st["total_prefill_tokens"] == 30
+        assert st["total_decode_tokens"] == 70
+        # the window itself still only holds the last 4 records
+        assert st["records"] == 4
+        assert st["window_decode_tokens"] == 28
+
+    def test_totals_exactly_at_wrap_boundary(self):
+        rec = FlightRecorder(capacity=4)
+        for i in range(4):  # fill exactly to capacity: no wrap yet
+            rec.record({"wall_ms": 1.0, "decode_tokens": 2})
+        assert rec.stats()["total_decode_tokens"] == 8
+        rec.record({"wall_ms": 1.0, "decode_tokens": 2})  # first eviction
+        assert rec.stats()["total_decode_tokens"] == 10
+
+
+class TestCostLedger:
+    def test_page_seconds_match_hand_computed_occupancy_integral(self):
+        """The exactness criterion: drive a tiny engine on a FAKE cost
+        clock advanced only between step() calls, track pages-held
+        after every step, and require cost_page_seconds to equal the
+        hand-computed integral sum(pages_i x dt_i) EXACTLY (page counts
+        only change inside steps, where the fake clock stands still)."""
+        eng = _tiny_engine()
+        try:
+            clock = _FakeClock(100.0)
+            eng._cost_clock = clock
+            s = eng.submit(np.arange(5, dtype=np.int32) % 64,
+                           max_new_tokens=6)
+            expected = 0.0
+            for _ in range(64):
+                eng.step()
+                if s.event.is_set():
+                    break
+                clock.advance(0.5)
+                expected += len(s.pages) * 0.5
+            assert s.event.is_set() and s.error is None
+            stats = eng.engine_stats()
+            assert s.cost_page_s == pytest.approx(expected)
+            assert stats["cost_page_seconds"] == pytest.approx(expected)
+            assert expected > 0.0
+            # token sides of the ledger are exact counts
+            assert stats["cost_prefill_tokens"] == 5
+            assert stats["cost_decode_tokens"] == len(s.tokens)
+        finally:
+            eng.close()
+
+    def test_per_adapter_ledger_sums_to_flat_totals(self):
+        """The per-adapter split accrues from the SAME close event as
+        the flat counters, so summing cost_by_adapter reproduces the
+        totals exactly — the chargeback invariant."""
+        eng = _tiny_engine()
+        try:
+            for seed in range(3):
+                s = eng.submit(
+                    (np.arange(4 + seed, dtype=np.int32) * (seed + 1)) % 64,
+                    max_new_tokens=4,
+                )
+                eng.run()
+                assert s.error is None
+            stats = eng.engine_stats()
+            split = stats["cost_by_adapter"]
+            assert set(split) == {"base"}
+            assert split["base"]["streams"] == 3
+            assert sum(e["page_seconds"] for e in split.values()) == \
+                pytest.approx(stats["cost_page_seconds"])
+            assert sum(e["prefill_tokens"] for e in split.values()) == \
+                stats["cost_prefill_tokens"]
+            assert sum(e["decode_tokens"] for e in split.values()) == \
+                stats["cost_decode_tokens"]
+        finally:
+            eng.close()
+
+    def test_ledger_closes_once_for_failed_streams(self):
+        eng = _tiny_engine()
+        try:
+            s = eng.submit(np.arange(5, dtype=np.int32) % 64,
+                           max_new_tokens=8)
+            eng.step()
+            eng.fail_stream(s, RuntimeError("boom"))
+            stats = eng.engine_stats()
+            assert stats["cost_by_adapter"]["base"]["streams"] == 1
+            # page-second totals folded despite the failure path
+            assert stats["cost_page_seconds"] == pytest.approx(s.cost_page_s)
+        finally:
+            eng.close()
+
+
+class TestTelemetryOffLane:
+    def test_off_lane_is_bit_exact_and_emits_no_cost_series(self, monkeypatch):
+        """SELDON_TPU_TELEMETRY=0 contract: greedy decode is bit-exact
+        vs the default lane, and engine_stats grows NO new keys (no
+        cost_* series for the bridge to export)."""
+        prompt = np.arange(6, dtype=np.int32) % 64
+
+        def run_lane():
+            eng = _tiny_engine()
+            try:
+                s = eng.submit(prompt.copy(), max_new_tokens=8)
+                eng.run()
+                assert s.error is None
+                return list(s.tokens), eng.engine_stats()
+            finally:
+                eng.close()
+
+        on_tokens, on_stats = run_lane()
+        monkeypatch.setenv("SELDON_TPU_TELEMETRY", "0")
+        off_tokens, off_stats = run_lane()
+        assert off_tokens == on_tokens  # bit-exact greedy decode
+        for key in ("cost_page_seconds", "cost_prefill_tokens",
+                    "cost_decode_tokens", "cost_by_adapter"):
+            assert key in on_stats
+            assert key not in off_stats
+        # and the off lane never read the cost clock
+        assert set(on_stats) - set(off_stats) == {
+            "cost_page_seconds", "cost_prefill_tokens",
+            "cost_decode_tokens", "cost_by_adapter",
+        }
+
+    def test_off_lane_component_has_no_ring_no_route_no_tags(self, monkeypatch):
+        monkeypatch.setenv("SELDON_TPU_TELEMETRY", "0")
+        from seldon_core_tpu.models.paged import StreamingLM
+
+        lm = StreamingLM(max_new_tokens=4, max_slots=2, steps_per_call=2,
+                         **CFG)
+        lm.load()
+        try:
+            out = lm.predict(np.arange(4, dtype=np.int32)[None, :] % 64, [])
+            assert out.shape[0] == 1
+            assert lm.tags() == {}
+            assert lm.telemetry_snapshot() is None
+            assert lm.custom_routes() == {}
+        finally:
+            lm.shutdown()
+            if lm.engine is not None:
+                lm.engine.close()
+
+
+class TestComponentTelemetry:
+    def test_predict_hands_cost_tags_to_dispatch_pop_once(self):
+        from seldon_core_tpu.models.paged import StreamingLM
+
+        lm = StreamingLM(max_new_tokens=6, max_slots=2, steps_per_call=2,
+                         **CFG)
+        lm.load()
+        try:
+            lm.predict(np.arange(5, dtype=np.int32)[None, :] % 64, [])
+            tags = lm.tags()
+            cost = tags["cost"]
+            assert cost["adapter"] == "base"
+            assert cost["prefill_tokens"] == 5
+            assert cost["decode_tokens"] == 6
+            assert cost["page_seconds"] > 0.0
+            assert cost["preemptions"] == 0
+            # pop-once: the handoff is consumed by the first reader
+            assert lm.tags() == {}
+        finally:
+            lm.shutdown()
+            if lm.engine is not None:
+                lm.engine.close()
+
+    def test_component_serves_versioned_snapshot_and_route(self):
+        from seldon_core_tpu.models.paged import StreamingLM
+
+        lm = StreamingLM(max_new_tokens=4, max_slots=2, steps_per_call=2,
+                         **CFG)
+        lm.load()
+        try:
+            lm.predict(np.arange(4, dtype=np.int32)[None, :] % 64, [])
+            snap = lm.telemetry_snapshot()
+            assert snap["schema_version"] == \
+                telemetry.TELEMETRY_SCHEMA_VERSION
+            assert snap["latest"]["goodput_tok_s"] >= 0.0
+            assert "/debug/telemetry" in lm.custom_routes()
+        finally:
+            lm.shutdown()
+            if lm.engine is not None:
+                lm.engine.close()
+
+
+class TestTraceExemplars:
+    def test_exemplar_payload_requires_span_and_telemetry(self, monkeypatch):
+        from seldon_core_tpu.utils import tracing
+        from seldon_core_tpu.utils.metrics import _trace_exemplar
+
+        assert _trace_exemplar() is None  # no active span
+        tracer = tracing.setup_tracing("exemplar-test")
+        try:
+            with tracer.span("predict", trace_id="puid-ex"):
+                assert _trace_exemplar() == {"trace_id": "puid-ex"}
+                monkeypatch.setenv("SELDON_TPU_TELEMETRY", "0")
+                assert _trace_exemplar() is None  # =0: no exemplars
+        finally:
+            tracing._tracer = None
+
+    def test_transport_hop_histogram_renders_openmetrics_exemplar(self):
+        import prometheus_client
+        from prometheus_client.openmetrics import exposition as om
+
+        from seldon_core_tpu.utils import metrics as m
+        from seldon_core_tpu.utils import tracing
+
+        registry = prometheus_client.CollectorRegistry()
+        tracer = tracing.setup_tracing("exemplar-test")
+        try:
+            with tracer.span("predict", trace_id="puid-hop-9"):
+                m.record_transport_hop(
+                    "lm", "predict", "rest", network_seconds=0.02,
+                    serialize_seconds=0.001, registry=registry,
+                )
+            text = om.generate_latest(registry).decode()
+            # the network-share bucket carries the request's trace id
+            assert 'trace_id="puid-hop-9"' in text
+            assert "seldon_tpu_transport_network_seconds_bucket" in text
+            # plain-text exposition is unaffected (no exemplar syntax)
+            plain = prometheus_client.generate_latest(registry).decode()
+            assert "trace_id" not in plain
+        finally:
+            tracing._tracer = None

@@ -23,6 +23,15 @@ Rules:
   ``TRANSPORT_METRICS`` mapping and no ``TRANSPORT_RECORD_EXCLUDED``
   entry: a per-hop measurement (e.g. the r14 ``zero_copy_bytes``
   split) that would silently skip Prometheus export.
+* GL406 — ``TelemetryAggregator.fleet_rollup()`` key neither mapped
+  (``FLEET_METRICS``) nor excluded (``FLEET_EXCLUDED``): a fleet
+  aggregate that would silently skip ``seldon_tpu_fleet_*`` export
+  (the r20 fleet-telemetry contract, same shape as GL401).
+* GL407 — ``FLEET_METRICS``/``FLEET_EXCLUDED`` key the rollup never
+  emits — dead fleet mapping (the GL402 twin).
+
+The GL403 naming pass also covers ``COST_LEDGER_METRICS`` (the
+per-adapter cost-ledger export) and ``FLEET_METRICS``.
 """
 
 from __future__ import annotations
@@ -36,6 +45,7 @@ NAME = "metrics-contract"
 
 PAGED = "seldon_core_tpu/models/paged.py"
 METRICS = "seldon_core_tpu/utils/metrics.py"
+FLEETVIEW = "seldon_core_tpu/controlplane/fleetview.py"
 
 
 def _dict_literal_keys(node: ast.Dict) -> List[str]:
@@ -122,6 +132,20 @@ def _engine_stats_keys(paged: Source) -> Set[str]:
     return keys
 
 
+def _fleet_rollup_keys(fleetview: Source) -> Set[str]:
+    """Keys ``fleet_rollup()`` emits: the literal keys of every dict
+    built inside the function (one return literal today; the walk keeps
+    the contract honest if it grows helpers)."""
+    keys: Set[str] = set()
+    for node in ast.walk(fleetview.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and node.name == "fleet_rollup":
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Dict):
+                    keys |= set(_dict_literal_keys(sub))
+    return keys
+
+
 def _hop_record_params(tree: ast.AST) -> List[Tuple[str, int]]:
     """The keyword parameters of ``record_transport_hop`` (the per-hop
     recording surface) with their line — every quantitative one must be
@@ -136,7 +160,7 @@ def _hop_record_params(tree: ast.AST) -> List[Tuple[str, int]]:
 
 class _Checker:
     name = NAME
-    codes = ("GL401", "GL402", "GL403", "GL404", "GL405")
+    codes = ("GL401", "GL402", "GL403", "GL404", "GL405", "GL406", "GL407")
     doc = __doc__
 
     def run(self, ctx: LintContext) -> Iterable[Violation]:
@@ -144,7 +168,51 @@ class _Checker:
         metrics = ctx.source(METRICS)
         if paged is None or metrics is None:
             return []
-        return self.check_pair(paged, metrics)
+        out = self.check_pair(paged, metrics)
+        fleetview = ctx.source(FLEETVIEW)
+        if fleetview is not None:
+            out += self.check_fleet(fleetview, metrics)
+        return out
+
+    def check_fleet(self, fleetview: Source, metrics: Source) -> List[Violation]:
+        """The r20 fleet-rollup contract: every fleet_rollup() key is
+        FLEET_METRICS-mapped or FLEET_EXCLUDED, and no dead mappings."""
+        out: List[Violation] = []
+        specs = _metric_specs(metrics.tree, "FLEET_METRICS")
+        excluded = _set_literal(metrics.tree, "FLEET_EXCLUDED") or set()
+        produced = _fleet_rollup_keys(fleetview)
+        if not specs or not produced:
+            out.append(Violation(
+                checker=self.name, code="GL407", path=METRICS, line=1,
+                symbol="FLEET_METRICS",
+                message=(
+                    "could not locate FLEET_METRICS / fleet_rollup keys — "
+                    "the fleet contract anchor moved; update "
+                    "tools/graftlint/checkers/metrics_contract.py"
+                ),
+            ))
+            return out
+        for key in sorted(produced - set(specs) - excluded):
+            out.append(Violation(
+                checker=self.name, code="GL406", path=FLEETVIEW, line=1,
+                symbol=key,
+                message=(
+                    f"fleet_rollup() emits {key!r} but the fleet bridge "
+                    "neither maps it (FLEET_METRICS) nor excludes it "
+                    "(FLEET_EXCLUDED) — the aggregate would silently "
+                    "skip seldon_tpu_fleet_* export"
+                ),
+            ))
+        for key in sorted((set(specs) | excluded) - produced):
+            out.append(Violation(
+                checker=self.name, code="GL407", path=METRICS, line=1,
+                symbol=key,
+                message=(
+                    f"{key!r} is fleet-mapped/excluded but fleet_rollup() "
+                    "never emits it — dead mapping (or a renamed rollup)"
+                ),
+            ))
+        return out
 
     def check_pair(self, paged: Source, metrics: Source) -> List[Violation]:
         out: List[Violation] = []
@@ -188,28 +256,36 @@ class _Checker:
             ))
 
         transport_specs = _metric_specs(metrics.tree, "TRANSPORT_METRICS")
-        for key, (kind, metric) in sorted({**specs, **transport_specs}.items()):
-            if not metric.startswith("seldon_tpu_"):
-                out.append(Violation(
-                    checker=self.name, code="GL403", path=METRICS, line=1,
-                    symbol=metric,
-                    message=f"metric {metric!r} (key {key!r}) must carry the "
-                            "seldon_tpu_ prefix",
-                ))
-            if kind == "counter" and not metric.endswith("_total"):
-                out.append(Violation(
-                    checker=self.name, code="GL403", path=METRICS, line=1,
-                    symbol=metric,
-                    message=f"counter {metric!r} (key {key!r}) must end in "
-                            "_total (Prometheus naming)",
-                ))
-            if kind == "gauge" and metric.endswith("_total"):
-                out.append(Violation(
-                    checker=self.name, code="GL403", path=METRICS, line=1,
-                    symbol=metric,
-                    message=f"gauge {metric!r} (key {key!r}) must not end in "
-                            "_total",
-                ))
+        # the r20 additions ride the same naming discipline (fixtures
+        # without them contribute nothing — _metric_specs returns {})
+        cost_specs = _metric_specs(metrics.tree, "COST_LEDGER_METRICS")
+        fleet_specs = _metric_specs(metrics.tree, "FLEET_METRICS")
+        # iterate the spec maps SEPARATELY: cost-ledger keys reuse
+        # engine-stats key names ("prefill_tokens"), and a dict merge
+        # would shadow one mapping's metric name from the naming pass
+        for spec_map in (specs, transport_specs, cost_specs, fleet_specs):
+            for key, (kind, metric) in sorted(spec_map.items()):
+                if not metric.startswith("seldon_tpu_"):
+                    out.append(Violation(
+                        checker=self.name, code="GL403", path=METRICS, line=1,
+                        symbol=metric,
+                        message=f"metric {metric!r} (key {key!r}) must carry "
+                                "the seldon_tpu_ prefix",
+                    ))
+                if kind == "counter" and not metric.endswith("_total"):
+                    out.append(Violation(
+                        checker=self.name, code="GL403", path=METRICS, line=1,
+                        symbol=metric,
+                        message=f"counter {metric!r} (key {key!r}) must end "
+                                "in _total (Prometheus naming)",
+                    ))
+                if kind == "gauge" and metric.endswith("_total"):
+                    out.append(Violation(
+                        checker=self.name, code="GL403", path=METRICS, line=1,
+                        symbol=metric,
+                        message=f"gauge {metric!r} (key {key!r}) must not "
+                                "end in _total",
+                    ))
 
         excluded_record = _set_literal(metrics.tree, "TRANSPORT_RECORD_EXCLUDED") or set()
         # internal plumbing kwargs of the recording call, not measurements

@@ -1,0 +1,12 @@
+"""Seeded metrics-contract fixture: the FLEET side (r20).  Paired with
+bad_metrics_metrics.py by tests/test_graftlint.py.  Never imported."""
+
+
+class FakeAggregator:
+    def fleet_rollup(self):
+        return {
+            "t": 0.0,                  # excluded: fine
+            "replicas_ok": 0,          # mapped: fine
+            "fleet_queue_depth": 0,    # mapped: fine
+            "phantom_rollup": 0.0,     # not mapped, not excluded -> GL406
+        }

@@ -21,6 +21,24 @@ TRANSPORT_METRICS = {
 
 TRANSPORT_RECORD_EXCLUDED = {"unit", "method", "transport", "error"}
 
+COST_LEDGER_METRICS = {
+    # GL403: counter without _total suffix (cost-ledger naming rides
+    # the same pass)
+    "page_seconds": ("counter", "seldon_tpu_engine_cost_adapter_page_seconds",
+                     "bad"),
+}
+
+FLEET_METRICS = {
+    "replicas_ok": ("gauge", "seldon_tpu_fleet_replicas_ok", "ok"),
+    "fleet_queue_depth": ("gauge", "seldon_tpu_fleet_queue_depth", "depth"),
+    # GL407: fleet-mapped but fleet_rollup never emits it
+    "never_rolled": ("gauge", "seldon_tpu_fleet_never", "ghost"),
+    # GL403: gauge ending in _total
+    "fleet_bad_gauge": ("gauge", "seldon_tpu_fleet_bad_total", "bad"),
+}
+
+FLEET_EXCLUDED = {"t"}
+
 
 def record_transport_hop(
     unit, method, transport, *,
