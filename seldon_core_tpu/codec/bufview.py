@@ -59,6 +59,8 @@ __all__ = [
     "unpack_kv_handoff",
     "pack_kv_migration",
     "unpack_kv_migration",
+    "pack_capture",
+    "unpack_capture",
 ]
 
 SRT1_MAGIC = 0x31545253  # "SRT1" little-endian
@@ -834,6 +836,82 @@ def unpack_kv_migration(data) -> dict:
     out.update({f: meta.get(f) for f in _MIGRATION_META_FIELDS
                 if f not in ("page_size", "layout")})
     return out
+
+
+# ---------------------------------------------------------------------------
+# request-capture container (r21)
+# ---------------------------------------------------------------------------
+
+# Fixed frame order of one black-box capture container: the ingress
+# payload (prompt token ids), the emitted output tokens, and a uint8
+# JSON meta frame carrying everything scalar — identity (puid, trace
+# id), the knob snapshot, sampling recipe + seed, adapter, SLO terms,
+# lifecycle phase stamps, the per-wave recorder slice, and cost-ledger
+# totals.  Same CRC32C trailer discipline as the handoff/migration
+# containers.  Unlike migration, EMPTY prompt/tokens frames are legal:
+# redaction (SELDON_TPU_CAPTURE_PAYLOADS=0) drops the payload frames
+# while keeping the forensic metadata.
+_CAPTURE_FRAMES = ("prompt", "tokens", "meta")
+
+
+def pack_capture(payload: dict) -> bytes:
+    """Encode a ``utils.capture`` payload as one SRT1 container — the
+    on-disk form of the per-request black box.  ``payload`` is the
+    ``{"prompt", "tokens", "meta"}`` dict ``RequestCapture.to_payload``
+    builds (and ``capture.redact`` filters)."""
+    import json as _json
+
+    meta = payload.get("meta")
+    if not isinstance(meta, dict):
+        raise PayloadError(
+            "capture payload needs a 'meta' dict "
+            f"(needs {', '.join(_CAPTURE_FRAMES)})"
+        )
+    prompt = np.asarray(payload.get("prompt", []), np.int32).reshape(-1)
+    tokens = np.asarray(payload.get("tokens", []), np.int32).reshape(-1)
+    try:
+        meta_frame = np.frombuffer(
+            _json.dumps(meta, sort_keys=True).encode("utf-8"), np.uint8
+        )
+    except (TypeError, ValueError) as exc:
+        raise PayloadError(
+            f"capture meta is not JSON-serializable: {exc}"
+        ) from exc
+    body = pack_frames([prompt, tokens, meta_frame])
+    return _append_crc_trailer(body) if kv_checksum_enabled() else body
+
+
+def unpack_capture(data) -> dict:
+    """Decode one capture container back into its payload dict (CRC
+    trailer verified first, same rule as the KV containers).  Malformed
+    containers raise :class:`PayloadError` naming the defect."""
+    import json as _json
+
+    body, _ = _split_crc_trailer(data)
+    views = unpack_frames(body)
+    if len(views) != len(_CAPTURE_FRAMES):
+        raise PayloadError(
+            f"capture container carries {len(views)} frames, expected "
+            f"{len(_CAPTURE_FRAMES)} ({', '.join(_CAPTURE_FRAMES)})"
+        )
+    prompt, tokens, meta_v = views
+    for name, view in (("prompt", prompt), ("tokens", tokens)):
+        if view.dtype != np.int32 or view.ndim != 1:
+            raise PayloadError(
+                f"capture {name} frame must be 1-D int32, got "
+                f"{view.dtype.name}{view.shape}"
+            )
+    try:
+        meta = _json.loads(bytes(meta_v.array()).decode("utf-8"))
+    except (UnicodeDecodeError, ValueError) as exc:
+        raise PayloadError(f"capture meta frame is not JSON: {exc}") from exc
+    if not isinstance(meta, dict):
+        raise PayloadError("capture meta frame must decode to a JSON object")
+    return {
+        "prompt": prompt.array(),
+        "tokens": tokens.array(),
+        "meta": meta,
+    }
 
 
 def stack_views(

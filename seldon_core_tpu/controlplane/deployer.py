@@ -146,7 +146,10 @@ def build_generation(spec: TpuDeployment, device_ids: Optional[List[int]] = None
         raise
     return Generation(
         spec=spec,
-        gateway=Gateway(weighted, shadows=shadows, supervisor=supervisor),
+        gateway=Gateway(
+            weighted, shadows=shadows, supervisor=supervisor,
+            request_logger=_gateway_logger_from_annotations(spec.annotations),
+        ),
         plan=plan,
         autoscalers=autoscalers,
         replicasets=replicasets,
@@ -187,6 +190,38 @@ def _request_logger_from_annotations(annotations):
                 f"got {kafka!r}")
         return KafkaPairLogger(bootstrap_servers=brokers, topic=topic)
     return None
+
+
+def _gateway_logger_from_annotations(annotations):
+    """Gateway-level pair sink (r21): ``seldon.io/request-logger``
+    names ONE sink that sees every finalized request/response pair
+    (puid + traceparent + cost stamped) regardless of which predictor
+    served it — the per-predictor annotations above keep logging graph
+    traffic.  Sink spelling by spec shape:
+
+    * ``http(s)://...``   — HttpPairLogger (CloudEvents POSTs)
+    * ``kafka:brokers/topic`` — KafkaPairLogger
+    * anything else       — a local JSONL file path
+    """
+    spec = str(annotations.get("seldon.io/request-logger", "") or "")
+    if not spec:
+        return None
+    if spec.startswith(("http://", "https://")):
+        from seldon_core_tpu.utils.reqlogger import HttpPairLogger
+
+        return HttpPairLogger(spec)
+    if spec.startswith("kafka:"):
+        from seldon_core_tpu.utils.reqlogger import KafkaPairLogger
+
+        brokers, _, topic = spec[len("kafka:"):].rpartition("/")
+        if not brokers or not topic:
+            raise DeploymentSpecError(
+                "seldon.io/request-logger kafka spec must be "
+                f"'kafka:brokers/topic', got {spec!r}")
+        return KafkaPairLogger(bootstrap_servers=brokers, topic=topic)
+    from seldon_core_tpu.utils.reqlogger import JsonlPairLogger
+
+    return JsonlPairLogger(spec)
 
 
 def _spawn_remote_workers(spec: TpuDeployment):

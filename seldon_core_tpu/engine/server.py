@@ -46,9 +46,17 @@ class Gateway:
         shadows: Sequence[PredictorService] = (),
         seed: Optional[int] = None,
         supervisor=None,
+        request_logger=None,
     ):
         if not predictors:
             raise ValueError("gateway needs at least one predictor")
+        # gateway-level request/response pair sink (r21): the
+        # `seldon.io/request-logger` annotation lands here — one logger
+        # sees every FINALIZED pair regardless of which predictor
+        # served it (the per-predictor loggers inside PredictorService
+        # see pre-routing graph traffic instead).  Pairs are stamped
+        # with puid + traceparent + cost by utils/reqlogger.build_pair.
+        self.request_logger = request_logger
         # the Supervisor owning this deployment's remote workers (None
         # when every node is in-process): /debug/workers reads through
         # it so the breaker/alert layer can see a restart-exhausted
@@ -141,7 +149,16 @@ class Gateway:
         for shadow in self.shadows:
             asyncio.ensure_future(shadow.predict(request.copy()))
         response = await svc.predict(request)
-        return self.finalize_response(response, request, svc)
+        response = self.finalize_response(response, request, svc)
+        if self.request_logger is not None:
+            # buffered sinks return immediately; the JSONL sink does
+            # one small write — either way a logging failure must lose
+            # a pair, never a request
+            try:
+                self.request_logger(request, response)
+            except Exception:  # noqa: BLE001 — lose a pair, never a request
+                logger.exception("gateway request logger failed")
+        return response
 
     async def send_feedback(self, feedback: InternalFeedback) -> InternalMessage:
         # feedback goes ONLY to the predictor that served the request
@@ -209,6 +226,11 @@ class Gateway:
 
     async def close(self) -> None:
         await asyncio.gather(*(p.close() for p in self.predictors))
+        if self.request_logger is not None and hasattr(self.request_logger, "close"):
+            try:
+                self.request_logger.close()
+            except Exception:  # noqa: BLE001 — shutdown must finish
+                logger.exception("gateway request logger close failed")
 
 
 def _http_status(out: InternalMessage) -> int:
@@ -682,6 +704,73 @@ def build_gateway_app(gateway: Gateway, auth=None) -> web.Application:
             )
         return web.json_response({"enabled": True, **agg.fleet_view()})
 
+    async def debug_request(request: web.Request) -> web.Response:
+        """One request's stitched forensics timeline (r21): the stored
+        capture container (knob snapshot, sampling recipe, five-phase
+        latency split, per-wave recorder slice, cost totals, payload
+        frames unless redacted) merged with the live span ring — the
+        "why was THIS request slow" surface.  404 only when neither
+        plane knows the puid."""
+        import dataclasses as _dc
+
+        import numpy as np
+
+        from seldon_core_tpu.utils import capture as _capture
+        from seldon_core_tpu.utils.tracing import get_tracer
+
+        puid = request.match_info["puid"]
+        cap = None
+        if _capture.capture_enabled():
+            try:
+                cap = await asyncio.get_running_loop().run_in_executor(
+                    None, _capture.default_store().get, puid
+                )
+            except Exception:  # noqa: BLE001 — a corrupt container must
+                # not take the debug surface down; spans may still match
+                logger.exception("capture load failed (puid=%s)", puid)
+        tracer = get_tracer()
+        spans = [s.to_dict() for s in tracer.find(puid)] if tracer else []
+        if cap is None and not spans:
+            return web.json_response(
+                {"puid": puid, "found": False,
+                 "info": "no capture container and no spans for this puid "
+                         "(capture off, not triggered, or evicted)"},
+                status=404,
+            )
+        capture_doc = None
+        timeline = []
+        if cap is not None:
+            capture_doc = _dc.asdict(cap)
+            capture_doc["prompt"] = (
+                np.asarray(cap.prompt).reshape(-1).tolist()
+                if cap.prompt is not None else []
+            )
+            capture_doc["tokens"] = (
+                np.asarray(cap.tokens).reshape(-1).tolist()
+                if cap.tokens is not None else []
+            )
+            stamps = (cap.phases or {}).get("stamps") or {}
+            for name, t in stamps.items():
+                if t:
+                    timeline.append(
+                        {"t": float(t), "event": name, "source": "stream"}
+                    )
+        for s in spans:
+            timeline.append({
+                "t": s["startTimeUnixNano"] / 1e9,
+                "event": f"span:{s['name']}",
+                "duration_ms": round(s["durationNano"] / 1e6, 3),
+                "source": "tracer",
+            })
+        timeline.sort(key=lambda e: e["t"])
+        return web.json_response({
+            "puid": puid,
+            "found": True,
+            "capture": capture_doc,
+            "spans": spans,
+            "timeline": timeline,
+        })
+
     async def debug_knobs(_r: web.Request) -> web.Response:
         """The central knob registry (runtime/knobs.py) with this
         process's effective values: "what is this gateway actually
@@ -720,6 +809,7 @@ def build_gateway_app(gateway: Gateway, auth=None) -> web.Application:
     app.router.add_get("/debug/weights", debug_weights)
     app.router.add_get("/debug/telemetry", debug_telemetry)
     app.router.add_get("/debug/fleet", debug_fleet)
+    app.router.add_get("/debug/request/{puid}", debug_request)
     return app
 
 

@@ -1196,8 +1196,8 @@ class _Stream:
         "req_id", "prompt", "max_new", "temperature", "top_k", "eos_id",
         "seed", "tokens", "event", "result", "error", "slot", "pages",
         "pending", "draft_hint", "token_queue", "streamed", "cancelled",
-        "trace_id", "parent_span_id", "t_submit", "t_prefill_start",
-        "t_decode_start", "t_first_token", "t_finish",
+        "trace_id", "parent_span_id", "puid", "t_submit",
+        "t_prefill_start", "t_decode_start", "t_first_token", "t_finish",
         "queue_depth_at_submit", "cached_len", "prefilled", "priority",
         "deadline", "preempted", "kv_export", "kv_import", "kv_payload",
         "kv_imported", "adapter", "adapter_slot", "adapter_pinned",
@@ -1258,6 +1258,11 @@ class _Stream:
         # threads).  Zeros/None when tracing is off: no per-stream cost.
         self.trace_id = ""
         self.parent_span_id: Optional[str] = None
+        # request identity for forensics joins (r21): the ingress puid
+        # when the submitter carries one (tracing NOT required), else
+        # the trace id — flight-recorder wave records and capture
+        # containers key on it
+        self.puid = ""
         self.t_submit = 0.0
         # wall time the stream's FIRST prefill slice started: with
         # t_submit/t_decode_start/t_first_token this decomposes a
@@ -1885,7 +1890,13 @@ class PagedEngine:
                           # SELDON_TPU_TELEMETRY=0.
                           "cost_page_seconds": 0.0,
                           "cost_prefill_tokens": 0,
-                          "cost_decode_tokens": 0}
+                          "cost_decode_tokens": 0,
+                          # black-box capture plane (r21): capture
+                          # containers written to the store.  Key absent
+                          # from engine_stats when SELDON_TPU_CAPTURE=0
+                          # (with capture_store_bytes — the off lane
+                          # sheds every new key).
+                          "captures": 0}
         # per-adapter cost ledger split (adapter None -> "base"): dict
         # name -> {page_seconds, prefill_tokens, decode_tokens, streams}
         # exported with adapter labels by the bridge (bridge-excluded
@@ -1921,6 +1932,26 @@ class PagedEngine:
                 ),
                 dump_dir=_knobs.raw("SELDON_TPU_DUMP_DIR") or None,
             )
+        # ---- per-request black-box capture (r21) ----
+        # Default-off forensics plane: when armed, terminating requests
+        # matching a trigger (every Nth via head sampling, every error,
+        # every puid active in a p99-breach window) are serialized as
+        # SRT1 capture containers into the bounded on-disk store.  The
+        # off lane carries NO capture state on the hot path.
+        from seldon_core_tpu.utils import capture as _capture_mod
+
+        self._capture_enabled = _capture_mod.capture_enabled()
+        self._capture_sample = (
+            _capture_mod.sample_every() if self._capture_enabled else 0
+        )
+        self._capture_seen = 0  # head-sampling request counter
+        self._capture_lock = threading.Lock()
+        # puids seen in breach-dump windows, pending capture at their
+        # stream's termination (bounded FIFO — a breach marks at most
+        # one ring's worth of requests)
+        self._breach_puids: "OrderedDict[str, float]" = OrderedDict()
+        if self._capture_enabled and self.recorder is not None:
+            self.recorder.on_dump = self._note_breach_puids
         # opt-in XLA-level inspection: the first N decode chunks run
         # inside jax.profiler.trace (N = SELDON_TPU_PROFILE_CHUNKS,
         # default 4) writing to SELDON_TPU_PROFILE_DIR — enough to catch
@@ -2900,6 +2931,109 @@ class PagedEngine:
             self.recorder.record(rec)
         self._feed_watchdog(float(rec.get("wall_ms", 0.0)), fault=False)
 
+    # ---- black-box capture plane (r21) ----------------------------------
+
+    def _note_breach_puids(self, records, path) -> None:
+        """Flight-recorder dump hook: index every puid active in the
+        breached window so its stream gets captured at termination —
+        the dump is joinable to requests instead of staying an
+        anonymous ring.  Runs outside the ring lock (and never takes
+        the engine lock: recorder callbacks can fire from code paths
+        that hold it)."""
+        puids = {p for rec in records for p in rec.get("puids", ()) if p}
+        if not puids:
+            return
+        with self._capture_lock:
+            now = self._cost_clock()
+            for p in puids:
+                self._breach_puids[p] = now
+            while len(self._breach_puids) > 1024:
+                self._breach_puids.popitem(last=False)
+
+    def capture_trigger(self, puid: str, error: Optional[BaseException]) -> Optional[str]:
+        """The trigger matrix, evaluated once per terminating request:
+        always-on-error > p99-breach membership > head sampling (every
+        Nth completed request).  None = no capture."""
+        if not self._capture_enabled:
+            return None
+        if error is not None:
+            return "error"
+        with self._capture_lock:
+            if puid and self._breach_puids.pop(puid, None) is not None:
+                return "breach"
+            self._capture_seen += 1
+            if self._capture_sample > 0 \
+                    and self._capture_seen % self._capture_sample == 0:
+                return "sample"
+        return None
+
+    def capture_request(self, stream: _Stream, *, puid: str, trigger: str,
+                        status: str = "ok", reason: str = "",
+                        tokens=None, extra: Optional[Dict[str, Any]] = None,
+                        ) -> Optional[str]:
+        """Assemble + store one request's black box: lifecycle phase
+        terms, the recorder's wave slice for this puid, cost-ledger
+        totals, the sampling recipe/seed, and the knob snapshot a
+        replay rebuilds from.  Runs OUTSIDE the engine lock (callers
+        sit past event.wait()); failures are contained — forensics
+        never breaks serving."""
+        if not self._capture_enabled:
+            return None
+        from seldon_core_tpu.utils import capture as _capture_mod
+
+        try:
+            waves = []
+            if self.recorder is not None:
+                waves = [r for r in self.recorder.snapshot()
+                         if puid in r.get("puids", ())]
+            extra = extra or {}
+            cap = _capture_mod.RequestCapture(
+                puid=puid,
+                trace_id=stream.trace_id,
+                status=status,
+                reason=reason,
+                trigger=trigger,
+                seed=extra.get("request_seed"),
+                max_new_tokens=stream.max_new,
+                temperature=float(stream.temperature),
+                top_k=int(stream.top_k),
+                eos_id=stream.eos_id,
+                adapter=stream.adapter,
+                priority=int(stream.priority),
+                deadline_remaining_ms=extra.get("deadline_remaining_ms"),
+                rows=int(extra.get("rows", 1)),
+                phases=_capture_mod.phase_terms(
+                    stream.t_submit, stream.t_prefill_start,
+                    stream.t_decode_start, stream.t_first_token,
+                    stream.t_finish,
+                ),
+                waves=waves,
+                cost={
+                    "page_seconds": stream.cost_page_s,
+                    "prefill_tokens": stream.cost_prefill_tokens,
+                    "decode_tokens": stream.cost_decode_tokens,
+                    "preemptions": stream.cost_preempts,
+                    "restores": stream.cost_restores,
+                    "adapter": stream.adapter or "base",
+                },
+                knobs=_capture_mod.knob_snapshot(),
+                model=dict(extra.get("model") or {}),
+                tags=dict(extra.get("tags") or {}),
+                time=_capture_mod.now(),
+                prompt=np.asarray(stream.prompt, np.int32).reshape(-1),
+                tokens=(np.asarray(tokens, np.int32).reshape(-1)
+                        if tokens is not None
+                        else np.asarray(stream.tokens, np.int32)),
+            )
+            path = _capture_mod.default_store().put(cap)
+        except Exception:  # noqa: BLE001 — forensics must not break serving
+            logger.exception("request capture failed (puid=%s)", puid)
+            return None
+        if path is not None:
+            with self._lock:
+                self._counters["captures"] += 1
+        return path
+
     def _feed_watchdog(self, wall_ms: float, fault: bool) -> None:
         """One per-wave observation into the health watchdog (r17):
         wall time (with the jitwatch sentinels' compile events exempting
@@ -3020,6 +3154,7 @@ class PagedEngine:
         kv_export: bool = False,
         kv_import: Optional[Dict[str, Any]] = None,
         adapter: Optional[str] = None,
+        puid: str = "",
     ) -> _Stream:
         """Queue one prompt (1-D int array). Returns a stream handle whose
         ``event`` fires when ``result`` (``(max_new,)`` ids) is ready.
@@ -3129,7 +3264,7 @@ class PagedEngine:
                 prompt, max_new_tokens, temperature, top_k, eos_id, seed,
                 draft_hint, stream_tokens, trace_id, parent_span_id,
                 priority, deadline, kv_export, kv_import, adapter,
-                adapter_slot,
+                adapter_slot, puid,
             )
         except BaseException:
             if adapter_slot:
@@ -3142,6 +3277,7 @@ class PagedEngine:
         self, prompt, max_new_tokens, temperature, top_k, eos_id, seed,
         draft_hint, stream_tokens, trace_id, parent_span_id,
         priority, deadline, kv_export, kv_import, adapter, adapter_slot,
+        puid="",
     ) -> _Stream:
         import queue as _queue
         import time as _time
@@ -3181,6 +3317,10 @@ class PagedEngine:
             # profile tool, tracer installed or not
             stream.t_submit = _time.time()
             stream.queue_depth_at_submit = len(self._queue)
+            # puid linkage is independent of tracing: wave records and
+            # capture containers must join to the request even when no
+            # tracer is installed (trace_id remains the fallback key)
+            stream.puid = str(puid or trace_id or "")
             from seldon_core_tpu.utils import tracing as _tracing
 
             if _tracing.get_tracer() is not None:  # one global read when off
@@ -5129,7 +5269,24 @@ class PagedEngine:
                 "cost_by_adapter": {
                     k: dict(v) for k, v in self._cost_by_adapter.items()
                 },
+                # capture plane (r21): containers written and the
+                # bounded store's on-disk footprint — popped below when
+                # SELDON_TPU_CAPTURE=0 so the off lane sheds every new
+                # stats key (same contract as the telemetry cost keys)
+                "capture_store_bytes": 0,
             }
+        if self._capture_enabled:
+            try:
+                from seldon_core_tpu.utils import capture as _capture_mod
+
+                out["capture_store_bytes"] = (
+                    _capture_mod.default_store().total_bytes()
+                )
+            except Exception:  # noqa: BLE001 — stats must not break serving
+                pass
+        else:
+            out.pop("captures", None)
+            out.pop("capture_store_bytes", None)
         if not self._telemetry_enabled:
             # SELDON_TPU_TELEMETRY=0 contract: no new metric series —
             # the bridge exports nothing it cannot see
@@ -5349,7 +5506,7 @@ class PagedEngine:
     def _record_prefill_wave(
         self, *, wall_s: float, tokens: int, occupancy: int,
         admissions: int, stalls: int, pre_hits: int, pre_saved: int,
-        pre_slo: Dict[str, int],
+        pre_slo: Dict[str, int], puids=(),
     ) -> bool:
         """Record a wave that carried ONLY prefill work — budgeted
         prefill-only waves AND waves whose streams all finished at
@@ -5375,6 +5532,9 @@ class PagedEngine:
             pages_cached = len(self._lru)
         self._record_chunk({
             "phase": "prefill",
+            # puid linkage (r21): breach dumps index the requests the
+            # wave actually carried, not just an anonymous ring slice
+            "puids": list(puids),
             "wall_ms": round(wall_s * 1000.0, 3),
             "prefill_wall_ms": round(wall_s * 1000.0, 3),
             "tp_degree": self.tp_degree,
@@ -5445,6 +5605,7 @@ class PagedEngine:
                     occupancy=0, admissions=len(admitted), stalls=0,
                     pre_hits=pre_hits, pre_saved=pre_saved,
                     pre_slo=pre_slo,
+                    puids=[s.puid for s, _ in admitted if s.puid],
                 )
             with self._lock:
                 return bool(self._queue)
@@ -5584,6 +5745,7 @@ class PagedEngine:
                     occupancy=len(active), admissions=len(admitted),
                     stalls=int(stalled.sum()), pre_hits=pre_hits,
                     pre_saved=pre_saved, pre_slo=pre_slo,
+                    puids=[s.puid for s in active if s.puid],
                 )
             with self._lock:
                 if self._debug_invariants:
@@ -5676,8 +5838,14 @@ class PagedEngine:
                 chunk_trace = next(
                     (s.trace_id for s in decoding if s.trace_id), ""
                 )
+            # puid linkage (r21): breach dumps index the requests
+            # active in the wave instead of staying an anonymous ring
+            wave_puids = sorted(
+                {s.puid for s in active if s.puid}
+            )
         self._record_chunk({
             "phase": "decode",
+            "puids": wave_puids,
             "trace_id": chunk_trace,
             "wall_ms": round(chunk_wall * 1000.0, 3),
             "prefill_wall_ms": round(wave_prefill_wall * 1000.0, 3),
@@ -5783,6 +5951,7 @@ class PagedEngine:
                     occupancy=0, admissions=len(admitted), stalls=0,
                     pre_hits=pre_hits, pre_saved=pre_saved,
                     pre_slo=pre_slo,
+                    puids=[s.puid for s, _ in admitted if s.puid],
                 )
             with self._lock:
                 return bool(self._queue)
@@ -5954,8 +6123,12 @@ class PagedEngine:
                 chunk_trace = next(
                     (s.trace_id for s in runnable if s.trace_id), ""
                 )
+            wave_puids = sorted(
+                {s.puid for s in active if s.puid}
+            )
         self._record_chunk({
             "phase": "spec_verify",
+            "puids": wave_puids,
             "trace_id": chunk_trace,
             "wall_ms": round(chunk_wall * 1000.0, 3),
             "prefill_wall_ms": round(wave_prefill_wall * 1000.0, 3),
@@ -6645,6 +6818,100 @@ class StreamingLM(TPUComponent):
         self._wake.set()
         return np.asarray([[stream.req_id]], np.int32)
 
+    def _capture_model_config(self) -> Dict[str, Any]:
+        """The StreamingLM ctor kwargs a replay needs to rebuild THIS
+        model (tools/seldon_replay.py): architecture, engine shape and
+        numeric regime.  Runtime knobs travel separately in the
+        capture's knob snapshot — this is only what the constructor
+        pins.  Every value must survive the container's JSON meta
+        frame, so non-serializable entries are dropped (a replay of
+        such a deployment reconstructs them by hand)."""
+        import json as _json
+
+        eng = self.engine_config
+        cfg = {
+            **self.config,
+            "max_new_tokens": self.max_new_tokens,
+            "temperature": self.temperature,
+            "top_k": self.top_k,
+            "eos_id": self.eos_id,
+            "model_uri": self.model_uri,
+            "seed": self.seed,
+            "page_size": eng["page_size"],
+            "num_pages": int(eng["num_pages"] or 0),
+            "max_slots": eng["max_slots"],
+            "steps_per_call": eng["steps_per_call"],
+            "max_steps_per_call": eng["max_steps_per_call"],
+            "quantize": eng["quantize"] or "",
+            "precision": eng["precision"] or "",
+            "speculative": eng["speculative"],
+            "prefix_cache": eng["prefix_cache"],
+            "max_queue": eng["max_queue"],
+            "chunk_token_budget": eng["chunk_token_budget"],
+            "mesh_axes": self.mesh_axes,
+            "tp": self.tp,
+            "dp": self.dp,
+            "max_adapters": self.max_adapters,
+            "lora_rank": self.lora_rank,
+            "adapters": self.adapters,
+        }
+        out = {}
+        for k, v in cfg.items():
+            try:
+                _json.dumps(v)
+            except (TypeError, ValueError):
+                continue
+            out[k] = v
+        return out
+
+    def _maybe_capture(self, streams, *, tags, meta, request_seed,
+                       status="ok", reason="", tokens=None) -> None:
+        """Per-request black-box write (r21): evaluate the trigger
+        matrix for the request's first stream and, when it fires,
+        store the capture container.  Multi-row requests capture row 0
+        — replay re-submits the whole request, so one container
+        recovers every row.  Contained: forensics never breaks
+        serving."""
+        engine = self.engine
+        if engine is None or not engine._capture_enabled or not streams:
+            return
+        try:
+            stream = streams[0]
+            puid = str(
+                meta.get("puid", "") or stream.puid
+                or stream.trace_id or f"req-{stream.req_id}"
+            )
+            trigger = engine.capture_trigger(
+                puid, stream.error if status != "ok" else None,
+            )
+            if trigger is None and status != "ok":
+                trigger = "error"  # raised before/around submit
+            if trigger is None:
+                return
+            deadline_remaining_ms = None
+            if stream.deadline is not None:
+                import time as _time
+
+                deadline_remaining_ms = max(
+                    0.0, (stream.deadline - _time.monotonic()) * 1000.0
+                )
+            engine.capture_request(
+                stream, puid=puid, trigger=trigger, status=status,
+                reason=reason, tokens=tokens,
+                extra={
+                    "request_seed": int(request_seed),
+                    "model": self._capture_model_config(),
+                    "tags": {
+                        k: v for k, v in tags.items()
+                        if isinstance(v, (str, int, float, bool))
+                    },
+                    "rows": len(streams),
+                    "deadline_remaining_ms": deadline_remaining_ms,
+                },
+            )
+        except Exception:  # noqa: BLE001 — forensics must not break serving
+            logger.exception("request capture failed")
+
     def predict(self, X, names, meta=None):
         if self.engine is None:
             self.load()  # idempotent + internally locked
@@ -6671,6 +6938,7 @@ class StreamingLM(TPUComponent):
                     top_k=top_k, eos_id=self.eos_id,
                     seed=self.seed ^ (request_seed * 1000003 + i),
                     priority=priority, deadline=deadline, adapter=adapter,
+                    puid=str(meta.get("puid", "")),
                 ))
             self._wake.set()
             for stream in streams:
@@ -6696,14 +6964,23 @@ class StreamingLM(TPUComponent):
                     "restores": sum(s.cost_restores for s in streams),
                     "adapter": adapter or "base",
                 }
-            return np.stack([s.result for s in streams])
-        except BaseException:
+            result = np.stack([s.result for s in streams])
+            self._maybe_capture(
+                streams, tags=tags, meta=meta, request_seed=request_seed,
+                status="ok", tokens=streams[0].result,
+            )
+            return result
+        except BaseException as exc:
             # one row shed/expired/errored: the siblings must not keep
             # decoding unread — they hold slots and KV pages exactly
             # when the engine is overloaded enough to shed
             for s in streams:
                 if s.result is None and s.error is None:
                     self.engine.cancel(s)
+            self._maybe_capture(
+                streams, tags=tags, meta=meta, request_seed=request_seed,
+                status="error", reason=repr(exc),
+            )
             raise
 
     def predict_stream(self, X, names=None, meta=None):
@@ -6741,6 +7018,7 @@ class StreamingLM(TPUComponent):
             stream_tokens=True,
             priority=priority, deadline=deadline,
             adapter=self._request_adapter(tags),
+            puid=str(meta.get("puid", "")),
         )
         self._wake.set()
         try:
@@ -6750,7 +7028,19 @@ class StreamingLM(TPUComponent):
                     break
                 yield np.asarray(got, np.int32)
             if stream.error:
-                raise stream.error
+                err = stream.error
+                self._maybe_capture(
+                    [stream], tags=tags, meta=meta,
+                    request_seed=request_seed, status="error",
+                    reason=repr(err),
+                )
+                raise err
+            # normal completion (a mid-stream disconnect skips capture:
+            # the consumer leaving is not a serving incident)
+            self._maybe_capture(
+                [stream], tags=tags, meta=meta,
+                request_seed=request_seed, status="ok",
+            )
         finally:
             # consumer gone (disconnect/cancel) or done: an abandoned
             # stream must not keep decoding into an unread queue,

@@ -309,6 +309,27 @@ ENV_KNOBS: Dict[str, Knob] = _knobs(
          "marked stale (it keeps its last snapshot; the poll loop never "
          "fails over one dead replica)",
          "architecture.md §5c-ter"),
+    # ---- per-request black-box capture + replay forensics (r21) -----------
+    Knob("SELDON_TPU_CAPTURE", "flag", "0", True,
+         "per-request black-box capture plane: head-sampled / on-error / "
+         "p99-breach requests are serialized as SRT1 capture containers "
+         "for GET /debug/request/<puid> and tools/seldon_replay.py "
+         "(0 = off, bit-exact pre-capture serving and no new stats keys)",
+         "architecture.md §5c-quater"),
+    Knob("SELDON_TPU_CAPTURE_SAMPLE", "int", "0", True,
+         "head-sampling rate: capture every Nth completed request "
+         "(0 = no head sampling; error/breach triggers still fire when "
+         "the capture plane is on)",
+         "architecture.md §5c-quater"),
+    Knob("SELDON_TPU_CAPTURE_DIR", "path", "", False,
+         "bounded on-disk capture store directory (LRU-by-bytes "
+         "eviction); empty = per-process temp directory",
+         "architecture.md §5c-quater"),
+    Knob("SELDON_TPU_CAPTURE_PAYLOADS", "flag", "1", True,
+         "keep ingress/output payload frames in capture containers; 0 = "
+         "capture.redact drops prompt/token ids (lengths and metadata "
+         "survive, replay becomes impossible — the privacy posture)",
+         "architecture.md §5c-quater"),
 )
 
 
@@ -355,6 +376,10 @@ ANNOTATIONS: Dict[str, Annotation] = _annotations(
                "request/response logger JSONL sink"),
     Annotation("seldon.io/request-log-kafka", "str",
                "request/response logger Kafka sink (broker/topic)"),
+    Annotation("seldon.io/request-logger", "str",
+               "gateway-level request/response pair logger sink spec: "
+               "http(s)://url | kafka:brokers/topic | a JSONL file path "
+               "(pairs stamped with puid + traceparent + cost)"),
 )
 
 

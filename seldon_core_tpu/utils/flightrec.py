@@ -71,6 +71,12 @@ class FlightRecorder:
         self._total_decode_tokens = 0
         self.dumps = 0
         self.last_dump_path: Optional[str] = None
+        # breach-dump hook (r21): called OUTSIDE the ring lock with
+        # (records, path) after every breach dump — the capture plane
+        # indexes the offending puids here so the requests active in
+        # the breach window get captured at termination instead of the
+        # dump staying an anonymous ring
+        self.on_dump = None
 
     # ---- hot path ---------------------------------------------------------
 
@@ -169,6 +175,13 @@ class FlightRecorder:
             )
         except Exception:  # noqa: BLE001 — forensics must not break serving
             logger.exception("flight recorder dump failed")
+            return
+        hook = self.on_dump
+        if hook is not None:
+            try:
+                hook(records, path)
+            except Exception:  # noqa: BLE001 — same containment as the dump
+                logger.exception("flight recorder dump hook failed")
 
     def dump_jsonl(
         self, path: Optional[str] = None,

@@ -423,6 +423,16 @@ ENGINE_STATS_METRICS: Dict[str, Tuple[str, str, str]] = {
                            "seldon_tpu_engine_cost_decode_tokens_total",
                            "decode tokens attributed to terminated "
                            "streams by the cost ledger"),
+    # per-request black-box capture plane (r21).  Keys absent when
+    # SELDON_TPU_CAPTURE=0 (default off — the bridge must export no
+    # new series on the off lane, same contract as the cost keys).
+    "captures": ("counter", "seldon_tpu_engine_captures_total",
+                 "request capture containers written to the bounded "
+                 "on-disk store (sample/error/breach triggers)"),
+    "capture_store_bytes": ("gauge",
+                            "seldon_tpu_engine_capture_store_bytes",
+                            "on-disk footprint of the bounded request "
+                            "capture store (LRU-evicted by bytes)"),
 }
 
 # keys intentionally NOT exported as their own series: the wall-clock

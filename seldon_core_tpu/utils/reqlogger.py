@@ -35,12 +35,34 @@ CE_HEADERS = {
 
 
 def build_pair(request: InternalMessage, response: InternalMessage) -> Dict[str, Any]:
-    return {
+    puid = response.meta.puid or request.meta.puid
+    pair = {
         "request": request.to_json(),
         "response": response.to_json(),
-        "puid": response.meta.puid or request.meta.puid,
+        "puid": puid,
         "time": time.time(),
     }
+    # trace + cost linkage (r21): each pair carries a W3C traceparent —
+    # the live span's context when one is active on this thread, else
+    # the same puid-derived ids the OTLP exporter mints — plus the
+    # response's cost-ledger totals, so an indexer can pivot
+    # pair -> trace -> capture -> bill without a join table
+    from seldon_core_tpu.utils import tracing as _tracing
+
+    span = _tracing.current_span()
+    if span is not None and not span.remote:
+        trace_hex = _tracing.w3c_trace_id(span.trace_id)
+        span_hex = span.span_id
+    else:
+        import hashlib
+
+        trace_hex = _tracing.w3c_trace_id(puid or "")
+        span_hex = hashlib.sha256((puid or "").encode()).hexdigest()[32:48]
+    pair["traceparent"] = f"00-{trace_hex}-{span_hex}-01"
+    cost = response.meta.tags.get("cost")
+    if cost:
+        pair["cost"] = cost
+    return pair
 
 
 class JsonlPairLogger:

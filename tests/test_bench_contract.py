@@ -241,6 +241,12 @@ def _r3_like_full_result():
                 "telemetry_overhead_pct": 1.35,
                 "protocol": "16-way StreamingLM graph serving, best-of-3",
             },
+            "capture": {
+                "capture_on_tok_s": 4370.0,
+                "capture_off_tok_s": 4445.0,
+                "capture_overhead_pct": 1.69,
+                "protocol": "16-way StreamingLM graph serving, best-of-3, SAMPLE=1",
+            },
             "chaos": {
                 "chaos_goodput_pct": 95.8,
                 "breaker_fastfail_pct": 87.5,
@@ -409,6 +415,19 @@ def test_compact_line_carries_telemetry_overhead(bench):
     assert isinstance(e["telemetry_overhead_pct"], float)
     assert e["telemetry_overhead_pct"] == 1.35
     assert "telemetry_on_tok_s" not in e
+
+
+def test_compact_line_carries_capture_overhead(bench):
+    """r21 certification key: the serving cost of the black-box capture
+    plane at its worst-case sampling rate (SELDON_TPU_CAPTURE_SAMPLE=1,
+    every request captured) vs SELDON_TPU_CAPTURE=0, as a float
+    percentage gated < 2; the raw on/off rates stay in bench_full.json
+    under capture."""
+    compact = bench._compact_result(_r3_like_full_result())
+    e = compact["extra"]
+    assert isinstance(e["capture_overhead_pct"], float)
+    assert e["capture_overhead_pct"] == 1.69
+    assert "capture_on_tok_s" not in e
 
 
 def test_compact_line_carries_prefix_cache_story(bench):

@@ -197,12 +197,21 @@ class TestPrometheusBridgeContract:
     canonical metric or explicitly excluded — new counters cannot
     silently skip Prometheus export."""
 
-    def test_every_engine_stats_key_mapped_or_excluded(self):
+    def test_every_engine_stats_key_mapped_or_excluded(
+        self, monkeypatch, tmp_path
+    ):
+        from seldon_core_tpu.utils import capture
         from seldon_core_tpu.utils.metrics import (
             ENGINE_STATS_EXCLUDED,
             ENGINE_STATS_METRICS,
         )
 
+        # capture on: the r21 keys are mapped, but the plane defaults
+        # OFF and engine_stats sheds them on the off lane — the
+        # phantom check below needs the full key set emitted
+        monkeypatch.setenv("SELDON_TPU_CAPTURE", "1")
+        monkeypatch.setenv("SELDON_TPU_CAPTURE_DIR", str(tmp_path))
+        capture.reset_default_store()
         eng = _tiny_engine()
         try:
             stats = eng.engine_stats()
@@ -222,6 +231,7 @@ class TestPrometheusBridgeContract:
                 assert key in stats
         finally:
             eng.close()
+            capture.reset_default_store()
 
     def test_mapping_uses_canonical_names_and_kinds(self):
         from seldon_core_tpu.utils.metrics import ENGINE_STATS_METRICS

@@ -29,6 +29,7 @@ if REPO not in sys.path:
 from tools.graftlint.checkers import (  # noqa: E402
     ALL_CHECKERS,
     BY_NAME,
+    capture_redaction,
     except_hygiene,
     jit_purity,
     knob_registry,
@@ -162,6 +163,20 @@ class TestSeededFixtures:
         assert ("GL504", "BadClient.transform_input") in pairs
         assert ("GL505", "BadClient.transform_input") in pairs
         assert not [v for v in vs if "good" in v.symbol.lower()]
+
+    def test_capture_redaction_catches_all_seeds(self):
+        vs = capture_redaction.CHECKER.check_source(
+            _fixture("bad_capture_redaction.py"))
+        assert [(v.code, v.symbol) for v in vs] == [("GL408", "bad_writer")]
+        # direct nesting AND unpack-side code are clean
+        assert not [v for v in vs if "good" in v.symbol]
+
+    def test_capture_redaction_module_level_write(self):
+        vs = capture_redaction.CHECKER.check_source(_src(
+            "from seldon_core_tpu.codec.bufview import pack_capture\n"
+            "BLOB = pack_capture({'meta': {}})\n"
+        ))
+        assert [(v.code, v.symbol) for v in vs] == [("GL408", "<module>")]
 
     def test_except_hygiene_catches_all_seeds(self):
         vs = except_hygiene.CHECKER.check_source(

@@ -303,6 +303,244 @@ class TestRequestLogConsumer:
             index.ingest({"request": {}, "response": {}})
 
 
+class TestPairStamping:
+    """r21 pair enrichment: every logged pair carries a W3C traceparent
+    and the response's cost-ledger totals, so an indexer can pivot
+    pair -> trace -> capture -> bill without a join table."""
+
+    _TRACEPARENT = r"^00-[0-9a-f]{32}-[0-9a-f]{16}-01$"
+
+    def _pair_msgs(self, puid="puid-abc", cost=None):
+        import re  # noqa: F401 — used by callers via the class regex
+
+        req = msg([[1.0]])
+        resp = msg([[2.0]])
+        resp.meta.puid = puid
+        if cost is not None:
+            resp.meta.tags["cost"] = cost
+        return req, resp
+
+    def test_traceparent_is_puid_derived_without_a_live_span(self):
+        import re
+
+        from seldon_core_tpu.utils.reqlogger import build_pair
+
+        req, resp = self._pair_msgs()
+        pair = build_pair(req, resp)
+        assert re.match(self._TRACEPARENT, pair["traceparent"])
+        # deterministic: the same puid always yields the same ids (the
+        # OTLP exporter mints the same trace id, so the pivot holds)
+        again = build_pair(*self._pair_msgs())
+        assert again["traceparent"] == pair["traceparent"]
+        other = build_pair(*self._pair_msgs(puid="puid-xyz"))
+        assert other["traceparent"] != pair["traceparent"]
+
+    def test_traceparent_uses_the_live_span_when_one_is_active(self):
+        import re
+
+        from seldon_core_tpu.utils.reqlogger import build_pair
+        from seldon_core_tpu.utils.tracing import w3c_trace_id
+
+        tracer = tracing.setup_tracing("pair-test")
+        try:
+            with tracer.span("op", trace_id="t-live") as span:
+                pair = build_pair(*self._pair_msgs())
+            assert re.match(self._TRACEPARENT, pair["traceparent"])
+            assert pair["traceparent"] == \
+                f"00-{w3c_trace_id('t-live')}-{span.span_id}-01"
+        finally:
+            tracing._tracer = None
+
+    def test_cost_totals_ride_the_pair(self):
+        from seldon_core_tpu.utils.reqlogger import build_pair
+
+        cost = {"page_seconds": 0.25, "decode_tokens": 8, "adapter": "base"}
+        pair = build_pair(*self._pair_msgs(cost=cost))
+        assert pair["cost"] == cost
+        # and a costless response (telemetry off) simply omits the key
+        assert "cost" not in build_pair(*self._pair_msgs())
+
+
+class TestHttpPairLoggerDrainClose:
+    """Satellite 3: the buffered sink's failure modes — a full queue
+    drops (counted, data plane never blocks), a dead collector loses
+    pairs without raising, close() drains then joins."""
+
+    def test_full_queue_drops_and_counts(self):
+        from seldon_core_tpu.utils.reqlogger import HttpPairLogger
+
+        lg = HttpPairLogger("http://127.0.0.1:9/", capacity=2)
+        # wedge the drain thread by filling faster than a dead-URL POST
+        # can fail: stop the thread first so the queue genuinely fills
+        lg._queue.put(None)
+        lg._thread.join(timeout=5.0)
+        req, resp = msg([[1.0]]), msg([[2.0]])
+        resp.meta.puid = "p"
+        for _ in range(4):
+            lg(req, resp)
+        assert lg.dropped == 2  # capacity 2, four offered
+
+    def test_dead_collector_never_raises_and_close_is_bounded(self):
+        import time as _time
+
+        from seldon_core_tpu.utils.reqlogger import HttpPairLogger
+
+        # port 9 (discard) refuses immediately: the POST fails fast,
+        # the drain loop logs and keeps going
+        lg = HttpPairLogger("http://127.0.0.1:9/", capacity=8,
+                            timeout_s=0.2)
+        req, resp = msg([[1.0]]), msg([[2.0]])
+        resp.meta.puid = "p"
+        for _ in range(3):
+            lg(req, resp)  # must not raise
+        t0 = _time.monotonic()
+        lg.close()
+        assert _time.monotonic() - t0 < 5.0
+        assert not lg._thread.is_alive()
+        assert lg.dropped == 0  # failures are lost downstream, not drops
+
+
+class TestGatewayRequestLogger:
+    """Satellite 1: the gateway-level pair sink — one logger sees every
+    FINALIZED pair (predictor tag already stamped) regardless of which
+    predictor served, and a sink failure never loses a request."""
+
+    def _gateway(self, request_logger):
+        from seldon_core_tpu.engine.server import Gateway
+
+        svc = PredictorService(
+            UnitSpec(name="m", type="MODEL", component=MetricModel()),
+            name="main",
+        )
+        return Gateway([(svc, 1.0)], request_logger=request_logger)
+
+    def test_pairs_logged_after_finalize(self, tmp_path):
+        import re
+
+        path = str(tmp_path / "gw-pairs.jsonl")
+        gw = self._gateway(JsonlPairLogger(path))
+        out = run(gw.predict(msg([[3.0]])))
+        pairs = [json.loads(l) for l in open(path)]
+        assert len(pairs) == 1
+        assert pairs[0]["puid"] == out.meta.puid
+        # finalize ran first: the pair records WHO served it
+        assert pairs[0]["response"]["meta"]["tags"]["predictor"] == "main"
+        assert re.match(TestPairStamping._TRACEPARENT,
+                        pairs[0]["traceparent"])
+
+    def test_sink_failure_loses_the_pair_never_the_request(self):
+        calls = []
+
+        def broken_logger(request, response):
+            calls.append(1)
+            raise RuntimeError("sink down")
+
+        gw = self._gateway(broken_logger)
+        out = run(gw.predict(msg([[3.0]])))
+        assert calls == [1]
+        assert out.payload is not None  # the request still served
+
+    def test_close_closes_the_sink(self):
+        class ClosableSink:
+            closed = False
+
+            def __call__(self, request, response):
+                pass
+
+            def close(self):
+                self.closed = True
+
+        sink = ClosableSink()
+        gw = self._gateway(sink)
+        run(gw.close())
+        assert sink.closed is True
+
+
+class TestGatewayLoggerAnnotation:
+    """`seldon.io/request-logger` resolves to a sink by spec shape:
+    http(s) URL, kafka:brokers/topic, else a JSONL path."""
+
+    def _resolve(self, spec):
+        from seldon_core_tpu.controlplane.deployer import (
+            _gateway_logger_from_annotations,
+        )
+
+        return _gateway_logger_from_annotations(
+            {} if spec is None else {"seldon.io/request-logger": spec}
+        )
+
+    def test_unset_is_none(self):
+        assert self._resolve(None) is None
+        assert self._resolve("") is None
+
+    def test_http_url_builds_http_sink(self):
+        from seldon_core_tpu.utils.reqlogger import HttpPairLogger
+
+        lg = self._resolve("http://collector:8080/")
+        try:
+            assert isinstance(lg, HttpPairLogger)
+            assert lg.url == "http://collector:8080/"
+        finally:
+            lg.close()
+
+    def test_kafka_spec_builds_kafka_sink(self):
+        from seldon_core_tpu.utils.reqlogger import KafkaPairLogger
+
+        lg = self._resolve("kafka:b1:9092,b2:9092/pairs")
+        try:
+            assert isinstance(lg, KafkaPairLogger)
+            assert lg.topic == "pairs"
+        finally:
+            lg.close(timeout_s=1.0)
+
+    def test_malformed_kafka_spec_fails_loudly(self):
+        from seldon_core_tpu.controlplane.deployer import DeploymentSpecError
+
+        with pytest.raises(DeploymentSpecError, match="kafka"):
+            self._resolve("kafka:no-topic-here")
+
+    def test_anything_else_is_a_jsonl_path(self, tmp_path):
+        from seldon_core_tpu.utils.reqlogger import JsonlPairLogger as JPL
+
+        lg = self._resolve(str(tmp_path / "x.jsonl"))
+        assert isinstance(lg, JPL)
+
+    def test_deployment_annotation_wires_gateway_logger(self, tmp_path):
+        """End to end through the deployer: the annotation lands on the
+        GATEWAY (not the per-predictor graph lane) and every served
+        request leaves a stamped pair."""
+        import re
+
+        from seldon_core_tpu.controlplane import Deployer, TpuDeployment
+
+        path = str(tmp_path / "gw.jsonl")
+        spec = TpuDeployment.from_dict({
+            "name": "gw-logged-dep",
+            "annotations": {"seldon.io/request-logger": path},
+            "predictors": [{
+                "name": "main", "traffic": 100,
+                "graph": {"name": "stub", "type": "MODEL",
+                          "implementation": "SIMPLE_MODEL"},
+            }],
+        })
+
+        async def scenario():
+            deployer = Deployer(device_ids=[0])
+            managed = await deployer.apply(spec)
+            assert isinstance(managed.gateway.request_logger,
+                              JsonlPairLogger)
+            out = await managed.gateway.predict(msg([[1.0]]))
+            await deployer.delete("gw-logged-dep")
+            return out.meta.puid
+
+        puid = asyncio.run(scenario())
+        pairs = [json.loads(l) for l in open(path)]
+        assert [p["puid"] for p in pairs] == [puid]
+        assert re.match(TestPairStamping._TRACEPARENT,
+                        pairs[0]["traceparent"])
+        assert pairs[0]["response"]["meta"]["tags"]["predictor"] == "main"
+
+
 class TestMonitoringAssets:
     """The shipped prometheus/alertmanager/grafana configs stay coherent
     with the metric names the code emits (reference analogue: the

@@ -3,6 +3,7 @@
 meta-test asserts the directory and the registry agree, so a new
 checker cannot be written and silently never run)."""
 
+from tools.graftlint.checkers.capture_redaction import CHECKER as CAPTURE_REDACTION
 from tools.graftlint.checkers.except_hygiene import CHECKER as EXCEPT_HYGIENE
 from tools.graftlint.checkers.jit_purity import CHECKER as JIT_PURITY
 from tools.graftlint.checkers.knob_registry import CHECKER as KNOB_REGISTRY
@@ -17,6 +18,7 @@ ALL_CHECKERS = (
     METRICS_CONTRACT,
     PROPAGATION,
     EXCEPT_HYGIENE,
+    CAPTURE_REDACTION,
 )
 
 BY_NAME = {c.name: c for c in ALL_CHECKERS}

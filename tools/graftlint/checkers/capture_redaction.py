@@ -1,0 +1,90 @@
+"""Checker: capture-store redaction (GL408).
+
+Invariant (r21): **every request-capture container serialized for the
+capture store routes through ``utils/capture.redact``.**  The capture
+plane persists raw prompt/output token ids to disk; ``redact`` is the
+single write-side privacy filter — it stamps payload lengths and,
+under ``SELDON_TPU_CAPTURE_PAYLOADS=0``, strips the payload frames so
+raw ids never reach the store.  A writer that calls
+``codec/bufview.pack_capture`` without routing its payload through
+``redact`` silently bypasses that filter.
+
+Rule: any function (or module-level code) calling ``pack_capture``
+must also call ``redact`` in the same scope -> GL408.  ``unpack``-side
+code and the codec's own definition are naturally exempt (they never
+call ``pack_capture``).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List
+
+from tools.graftlint.core import (
+    LintContext,
+    Source,
+    Violation,
+    call_name,
+    iter_funcs,
+)
+
+NAME = "capture-redaction"
+
+PACK_CALL = "pack_capture"
+REDACT_CALL = "redact"
+
+
+def _calls(node: ast.AST) -> List[ast.Call]:
+    return [n for n in ast.walk(node) if isinstance(n, ast.Call)]
+
+
+class _Checker:
+    name = NAME
+    codes = ("GL408",)
+    doc = __doc__
+
+    def run(self, ctx: LintContext) -> Iterable[Violation]:
+        out: List[Violation] = []
+        for src in ctx.sources:
+            out.extend(self.check_source(src))
+        return out
+
+    def check_source(self, src: Source) -> List[Violation]:
+        out: List[Violation] = []
+        in_function_calls = set()
+        for qual, fn, _cls in iter_funcs(src.tree):
+            calls = _calls(fn)
+            in_function_calls.update(id(c) for c in calls)
+            packs = [c for c in calls if call_name(c) == PACK_CALL]
+            if not packs:
+                continue
+            if any(call_name(c) == REDACT_CALL for c in calls):
+                continue
+            out.append(self._violation(src, packs[0].lineno, qual))
+        # module-level writers (scripts, constants built at import time)
+        module_calls = [
+            c for c in _calls(src.tree) if id(c) not in in_function_calls
+        ]
+        module_packs = [
+            c for c in module_calls if call_name(c) == PACK_CALL
+        ]
+        if module_packs and not any(
+            call_name(c) == REDACT_CALL for c in module_calls
+        ):
+            out.append(self._violation(src, module_packs[0].lineno, "<module>"))
+        return out
+
+    def _violation(self, src: Source, line: int, qual: str) -> Violation:
+        return Violation(
+            checker=self.name, code="GL408", path=src.path,
+            line=line, symbol=qual,
+            message=(
+                f"{qual!r} serializes a capture container "
+                "(pack_capture) without routing the payload through "
+                "capture.redact — the store's write-side privacy "
+                "filter (SELDON_TPU_CAPTURE_PAYLOADS contract)"
+            ),
+        )
+
+
+CHECKER = _Checker()

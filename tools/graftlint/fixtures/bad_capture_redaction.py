@@ -1,0 +1,24 @@
+"""Seeded-bad fixture for the capture-redaction checker (GL408)."""
+
+from seldon_core_tpu.codec.bufview import pack_capture
+from seldon_core_tpu.utils.capture import redact
+
+
+def bad_writer(payload, path):
+    # GL408: serializes for the store without the redaction filter
+    blob = pack_capture(payload)
+    with open(path, "wb") as f:
+        f.write(blob)
+
+
+def good_writer(payload, path):
+    blob = pack_capture(redact(payload))
+    with open(path, "wb") as f:
+        f.write(blob)
+
+
+def good_reader(blob):
+    # unpack-side code never packs — naturally exempt
+    from seldon_core_tpu.codec.bufview import unpack_capture
+
+    return unpack_capture(blob)

@@ -1,0 +1,285 @@
+"""Deterministic replay of a request capture container (r21).
+
+``utils/capture.py`` stores one SRT1 container per interesting request:
+the exact prompt, the per-request seed the serving component mixed, the
+sampling recipe, the adapter selection, the StreamingLM constructor
+config, and the knob snapshot of the capturing process.  This tool
+closes the forensics loop — it rebuilds that engine, re-submits the
+exact request through the SAME ingress path (``StreamingLM.predict``
+with a ``seed`` tag override, so adapter resolution and seed mixing are
+the production code, not a reimplementation), and diffs the outcome:
+
+* **tokens** — a greedy capture (``temperature == 0``) must replay
+  BIT-EXACT on the same numeric regime; sampled captures report the
+  first divergence index instead of asserting.
+* **latency terms** — the replay runs with the capture plane pointed at
+  a throwaway store, so the replayed request produces its own
+  five-phase decomposition; the report diffs queued/prefill/decode/
+  ttft/total against the original.
+
+One-numeric-regime caveat: bit-exactness is a claim about the SAME
+compiled numerics.  A capture taken on TPU bf16 replayed on CPU f32
+(or across XLA versions) can legitimately diverge on sampled runs and,
+rarely, on logit ties in greedy runs — the report carries both
+regimes' identities so a diff is attributable.
+
+Run::
+
+    python tools/seldon_replay.py /path/to/capture-<puid>-<crc>.srt1
+    python tools/seldon_replay.py <puid> --store $SELDON_TPU_CAPTURE_DIR
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import sys
+import tempfile
+from typing import Any, Dict, List, Optional
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# knobs a replay must NOT inherit from the capturing process: the
+# capture plane's own switches (the replay wires its own throwaway
+# store), journal/dump/export paths (writing into the incident
+# process's directories would contaminate the originals), and the
+# fleet-polling endpoints (a replay host has no fleet)
+_SKIP_KNOB_PREFIXES = ("SELDON_TPU_CAPTURE", "SELDON_TPU_FLEET_")
+_SKIP_KNOBS = {
+    "SELDON_TPU_DRAIN_JOURNAL",
+    "SELDON_TPU_TRACE_EXPORT",
+    "SELDON_TPU_DUMP_DIR",
+    "SELDON_TPU_PROFILE_DIR",
+}
+
+
+def _skip_knob(name: str) -> bool:
+    return name in _SKIP_KNOBS or any(
+        name.startswith(p) for p in _SKIP_KNOB_PREFIXES
+    )
+
+
+def _first_divergence(a, b) -> Optional[int]:
+    """Index of the first differing token, None when identical
+    (length differences diverge at the shorter length)."""
+    import numpy as np
+
+    a = np.asarray(a, np.int64).reshape(-1)
+    b = np.asarray(b, np.int64).reshape(-1)
+    n = min(a.size, b.size)
+    neq = np.nonzero(a[:n] != b[:n])[0]
+    if neq.size:
+        return int(neq[0])
+    if a.size != b.size:
+        return n
+    return None
+
+
+def load_capture(source: str, store_dir: str = ""):
+    """Resolve ``source`` (a container path, or a puid looked up in
+    ``store_dir`` / ``SELDON_TPU_CAPTURE_DIR``) to a RequestCapture."""
+    from seldon_core_tpu.utils.capture import CaptureStore
+
+    if os.path.isfile(source):
+        cap = CaptureStore.load(source)
+        if cap is None:
+            raise SystemExit(f"unreadable capture container: {source}")
+        return cap
+    root = store_dir or os.environ.get("SELDON_TPU_CAPTURE_DIR", "")
+    if not root:
+        raise SystemExit(
+            f"{source!r} is not a file and no store directory is set "
+            "(--store / SELDON_TPU_CAPTURE_DIR)"
+        )
+    cap = CaptureStore(root=root).get(source)
+    if cap is None:
+        raise SystemExit(f"no capture for puid {source!r} under {root}")
+    return cap
+
+
+def replay_capture(cap, *, strict: Optional[bool] = None) -> Dict[str, Any]:
+    """Re-execute one capture and return the diff report.
+
+    ``strict`` forces/suppresses the greedy bit-exact assertion
+    (default: assert exactly when the capture is greedy).  The report
+    dict carries ``bit_exact``, ``first_divergence``, the replayed
+    tokens, and the per-term latency diff.
+    """
+    import numpy as np
+
+    from seldon_core_tpu.utils import capture as capture_mod
+
+    prompt = np.asarray(
+        [] if cap.prompt is None else cap.prompt, np.int32
+    ).reshape(-1)
+    if prompt.size == 0:
+        return {
+            "puid": cap.puid,
+            "replayable": False,
+            "info": "capture has no prompt frame "
+                    "(SELDON_TPU_CAPTURE_PAYLOADS=0 at capture time)",
+        }
+    if cap.seed is None:
+        return {
+            "puid": cap.puid,
+            "replayable": False,
+            "info": "capture carries no request seed",
+        }
+
+    greedy = float(cap.temperature or 0.0) == 0.0
+    if strict is None:
+        strict = greedy
+
+    touched: Dict[str, Optional[str]] = {}
+
+    def setenv(name: str, value: Optional[str]) -> None:
+        if name not in touched:
+            touched[name] = os.environ.get(name)
+        if value is None:
+            os.environ.pop(name, None)
+        else:
+            os.environ[name] = value
+
+    replay_store = tempfile.mkdtemp(prefix="seldon-tpu-replay-")
+    lm = None
+    try:
+        # the captured process's SET knobs, minus the skip list — the
+        # engine the replay builds must resolve its env-driven shape
+        # (kernel lane, chunk budget, prefix cache, ...) exactly as the
+        # capturing engine did
+        applied: List[str] = []
+        for k in cap.knobs or []:
+            name = str(k.get("name", ""))
+            if not name or _skip_knob(name):
+                continue
+            setenv(name, str(k.get("value", "")))
+            applied.append(name)
+        # throwaway capture plane for the replay itself: the replayed
+        # request writes its own container, which is where its
+        # five-phase latency decomposition comes from
+        setenv("SELDON_TPU_CAPTURE", "1")
+        setenv("SELDON_TPU_CAPTURE_SAMPLE", "1")
+        setenv("SELDON_TPU_CAPTURE_PAYLOADS", "1")
+        setenv("SELDON_TPU_CAPTURE_DIR", replay_store)
+        capture_mod.reset_default_store()
+
+        from seldon_core_tpu.models.paged import StreamingLM
+
+        model_cfg = dict(cap.model or {})
+        lm = StreamingLM(**model_cfg)
+        tags: Dict[str, Any] = {
+            "seed": int(cap.seed),
+            "max_new_tokens": int(cap.max_new_tokens),
+            "temperature": float(cap.temperature),
+            "top_k": int(cap.top_k),
+        }
+        if cap.adapter:
+            tags["adapter"] = cap.adapter
+        if cap.priority:
+            tags["priority"] = int(cap.priority)
+        meta = {"puid": cap.puid, "tags": tags}
+        result = lm.predict(prompt.reshape(1, -1), [], meta=meta)
+        replayed = np.asarray(result[0], np.int32).reshape(-1)
+
+        captured = np.asarray(
+            [] if cap.tokens is None else cap.tokens, np.int32
+        ).reshape(-1)
+        divergence = _first_divergence(captured, replayed)
+        bit_exact = divergence is None
+
+        replay_cap = capture_mod.CaptureStore(root=replay_store).get(cap.puid)
+        latency: Dict[str, Any] = {}
+        if replay_cap is not None:
+            for term in ("queued_ms", "prefill_ms", "decode_ms",
+                         "ttft_ms", "total_ms"):
+                was = (cap.phases or {}).get(term)
+                now = (replay_cap.phases or {}).get(term)
+                latency[term] = {
+                    "captured": was,
+                    "replayed": now,
+                    "delta": (round(now - was, 3)
+                              if was is not None and now is not None
+                              else None),
+                }
+
+        report = {
+            "puid": cap.puid,
+            "replayable": True,
+            "greedy": greedy,
+            "status_at_capture": cap.status,
+            "trigger": cap.trigger,
+            "adapter": cap.adapter,
+            "seed": cap.seed,
+            "knobs_applied": applied,
+            "bit_exact": bool(bit_exact),
+            "first_divergence": divergence,
+            "captured_tokens": captured.tolist(),
+            "replayed_tokens": replayed.tolist(),
+            "latency": latency,
+        }
+        if strict and not bit_exact:
+            raise AssertionError(
+                f"greedy replay diverged at token {divergence}: "
+                f"captured={captured.tolist()} "
+                f"replayed={replayed.tolist()}"
+            )
+        return report
+    finally:
+        if lm is not None:
+            try:
+                lm.shutdown()
+            except Exception:  # noqa: BLE001 — teardown best-effort
+                pass
+        for name, old in touched.items():
+            if old is None:
+                os.environ.pop(name, None)
+            else:
+                os.environ[name] = old
+        capture_mod.reset_default_store()
+        shutil.rmtree(replay_store, ignore_errors=True)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument(
+        "source",
+        help="capture container path, or a puid resolved via --store",
+    )
+    ap.add_argument(
+        "--store", default="",
+        help="capture store directory for puid lookups "
+             "(default: $SELDON_TPU_CAPTURE_DIR)",
+    )
+    ap.add_argument(
+        "--no-strict", action="store_true",
+        help="report instead of asserting on greedy divergence",
+    )
+    ap.add_argument("--json", action="store_true", help="machine output")
+    args = ap.parse_args(argv)
+
+    cap = load_capture(args.source, store_dir=args.store)
+    report = replay_capture(cap, strict=False if args.no_strict else None)
+    if args.json:
+        print(json.dumps(report, indent=2, default=str))
+        return 0 if report.get("bit_exact", not report["replayable"]) else 1
+    if not report["replayable"]:
+        print(f"[replay] {report['puid']}: NOT replayable — {report['info']}")
+        return 2
+    print(f"[replay] puid={report['puid']} trigger={report['trigger']} "
+          f"greedy={report['greedy']} adapter={report['adapter'] or '-'}")
+    if report["bit_exact"]:
+        print(f"[replay] tokens: BIT-EXACT "
+              f"({len(report['captured_tokens'])} tokens)")
+    else:
+        print(f"[replay] tokens: DIVERGED at index "
+              f"{report['first_divergence']}")
+    for term, d in report["latency"].items():
+        print(f"[replay] {term:>11}: captured={d['captured']} "
+              f"replayed={d['replayed']} delta={d['delta']}")
+    return 0 if report["bit_exact"] else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
