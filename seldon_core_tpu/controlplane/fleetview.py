@@ -302,6 +302,12 @@ class TelemetryAggregator:
             float(p["predict_cost_s"]) for p in ok
             if p.get("predict_cost_s") is not None
         ]
+        # tier-off replicas omit the key entirely (snapshot sheds with
+        # engine_stats); only reporters shape the fleet hit rate
+        tier_rates = [
+            float(p["kv_tier_hit_rate"]) for p in ok
+            if p.get("kv_tier_hit_rate") is not None
+        ]
         return {
             "t": self._clock(),
             "replicas_total": len(replicas),
@@ -336,6 +342,10 @@ class TelemetryAggregator:
             ),
             "fleet_predict_cost_s_max": round(max(costs), 4)
             if costs else 0.0,
+            "fleet_kv_tier_host_bytes": total("kv_tier_host_bytes"),
+            "fleet_kv_tier_hit_rate": round(
+                sum(tier_rates) / len(tier_rates), 4
+            ) if tier_rates else 0.0,
         }
 
     def adapter_residency(self) -> Dict[str, List[str]]:

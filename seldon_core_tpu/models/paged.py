@@ -936,6 +936,7 @@ def paged_hbm_accounting(
     adapter_bytes: int = 0,
     reclaimable_weight_bytes: int = 0,
     kv_dtype: str = "bf16",
+    host_tier_gib: float = 0.0,
 ) -> Dict[str, int]:
     """Pool-HBM bytes for ``streams`` concurrent streams at ``ctx_len``
     tokens — the capacity model the bench certifies (VERDICT r5 #3/#5).
@@ -1024,6 +1025,14 @@ def paged_hbm_accounting(
       gathered ctx/ring copies hold the engine's compute dtype (and the
       int8 pool is pool-impl-only regardless).
 
+    * **host KV tier (r22)** — ``host_tier_gib`` prices the
+      ``SELDON_TPU_KV_OFFLOAD`` host-RAM container budget as its own
+      section: ``host_tier_bytes`` is HOST memory (never added to
+      ``peak_bytes`` — the tier exists so HBM can shed), and the whole
+      budget is ``host_reclaimable_bytes`` because every entry is a
+      re-derivable cache the OS may reclaim by dropping demoted pages
+      (they re-prefill on miss, exactly as without the tier).
+
     BASE weights, activations, and the host runtime stay out of scope:
     this prices what scales with streams and adapter multiplexing.
     """
@@ -1070,6 +1079,11 @@ def paged_hbm_accounting(
         "reclaimable_weight_bytes": int(reclaimable_weight_bytes),
         "tp_degree": shard,
         "dp_degree": dshard,
+        # host KV tier (r22): HOST bytes, never HBM — always present
+        # (0 when the tier is off) so capacity dashboards need no
+        # key-existence branch
+        "host_tier_bytes": int(float(host_tier_gib) * (1 << 30)),
+        "host_reclaimable_bytes": int(float(host_tier_gib) * (1 << 30)),
     }
 
 
@@ -1188,6 +1202,17 @@ class _CachedPrefix:
 _SLO_COUNTER_KEYS = ("shed", "expired", "preempted", "restored",
                      "drained", "replayed", "quarantined")
 
+# hierarchical KV tier (r22): the counter keys engine_stats sheds when
+# SELDON_TPU_KV_OFFLOAD=0, and the per-wave delta subset the flight
+# recorder's chunk records carry when the tier is on
+_TIER_COUNTER_KEYS = (
+    "kv_tier_demotions", "kv_tier_promotions", "kv_tier_host_hits",
+    "kv_tier_disk_hits", "kv_tier_misses", "kv_tier_evictions",
+    "kv_tier_bytes_demoted", "kv_tier_bytes_promoted",
+)
+_TIER_DELTA_KEYS = ("kv_tier_demotions", "kv_tier_promotions",
+                    "kv_tier_host_hits", "kv_tier_disk_hits")
+
 
 class _Stream:
     """One in-flight generation request bound to a slot."""
@@ -1203,7 +1228,7 @@ class _Stream:
         "kv_imported", "adapter", "adapter_slot", "adapter_pinned",
         "cost_page_s", "cost_t", "cost_prefill_tokens",
         "cost_decode_tokens", "cost_preempts", "cost_restores",
-        "cost_closed",
+        "cost_closed", "tier_promote",
     )
 
     def __init__(self, req_id, prompt, max_new, temperature, top_k, eos_id, seed):
@@ -1307,6 +1332,12 @@ class _Stream:
         self.cost_preempts = 0
         self.cost_restores = 0
         self.cost_closed = False
+        # hierarchical KV tier (r22): admission's chain walk hit the
+        # host/disk tier — {"pages": fresh HBM pages, "entries":
+        # popped tier entries}; consumed by _tier_promote_ready's
+        # donated scatter before the stream's first device work, put
+        # back into the tier if the stream dies before that
+        self.tier_promote: Optional[Dict[str, Any]] = None
 
 
 def journal_entry(
@@ -1896,7 +1927,24 @@ class PagedEngine:
                           # from engine_stats when SELDON_TPU_CAPTURE=0
                           # (with capture_store_bytes — the off lane
                           # sheds every new key).
-                          "captures": 0}
+                          "captures": 0,
+                          # hierarchical KV tier (r22): pages demoted
+                          # into the host tier / chains promoted back
+                          # through the scatter import, promoted pages
+                          # per level, uncached full pages the tier
+                          # ALSO missed (the hit-rate denominator's
+                          # other half), entries the tier byte budgets
+                          # pushed out entirely, and the container
+                          # byte flow both directions.  All keys absent
+                          # from engine_stats when
+                          # SELDON_TPU_KV_OFFLOAD=0 (with the two
+                          # kv_tier_*_bytes gauges — the off lane sheds
+                          # every new key).
+                          "kv_tier_demotions": 0, "kv_tier_promotions": 0,
+                          "kv_tier_host_hits": 0, "kv_tier_disk_hits": 0,
+                          "kv_tier_misses": 0, "kv_tier_evictions": 0,
+                          "kv_tier_bytes_demoted": 0,
+                          "kv_tier_bytes_promoted": 0}
         # per-adapter cost ledger split (adapter None -> "base"): dict
         # name -> {page_seconds, prefill_tokens, decode_tokens, streams}
         # exported with adapter labels by the bridge (bridge-excluded
@@ -1952,6 +2000,32 @@ class PagedEngine:
         self._breach_puids: "OrderedDict[str, float]" = OrderedDict()
         if self._capture_enabled and self.recorder is not None:
             self.recorder.on_dump = self._note_breach_puids
+        # ---- hierarchical KV tier (r22) ----
+        # Default-off host-RAM (+ optional disk) demotion target for
+        # LRU-reclaimed prefix pages: _evict_cached_locked stages the
+        # reclaimed page, the next flush point gathers it host-side
+        # into an SRT1 container, and a later admission's chain walk
+        # promotes it back through the donated-scatter import — no
+        # prefill FLOPs.  The off lane carries None and an always-empty
+        # staging list: no new device programs, stats keys shed.
+        self._kv_tier = None
+        self._tier_pending: List[Tuple[int, int, Tuple[int, ...], int]] = []
+        if _knobs.flag("SELDON_TPU_KV_OFFLOAD"):
+            from seldon_core_tpu.models.kvtier import HostKvTier
+
+            self._kv_tier = HostKvTier(
+                budget_bytes=int(
+                    float(
+                        _knobs.raw("SELDON_TPU_KV_HOST_BUDGET_GIB", "4")
+                        or 4
+                    ) * (1 << 30)
+                ),
+                spill_dir=_knobs.raw("SELDON_TPU_KV_SPILL_DIR") or None,
+                spill_budget_bytes=int(
+                    float(_knobs.raw("SELDON_TPU_KV_SPILL_GIB", "16") or 16)
+                    * (1 << 30)
+                ),
+            )
         # opt-in XLA-level inspection: the first N decode chunks run
         # inside jax.profiler.trace (N = SELDON_TPU_PROFILE_CHUNKS,
         # default 4) writing to SELDON_TPU_PROFILE_DIR — enough to catch
@@ -3610,10 +3684,19 @@ class PagedEngine:
 
     def _evict_cached_locked(self) -> None:
         """Reclaim the least-recently-used cached page: unregister it
-        from the prefix index and return it to the free list."""
+        from the prefix index and return it to the free list.  With the
+        KV tier on (r22) the page is STAGED for host demotion first:
+        its KV stays valid until the next pool-writing device call, and
+        every such call is preceded by a _tier_flush that gathers the
+        staged pages host-side — demote instead of discard, off the
+        allocation hot path."""
         page, entry = self._lru.popitem(last=False)  # oldest first
         self._prefix_index.pop(entry.key, None)
         self._page_entry.pop(page, None)
+        if self._kv_tier is not None:
+            self._tier_pending.append(
+                (entry.key, entry.parent, entry.tokens, page)
+            )
         self._free_pages.append(page)
         self._counters["prefix_evictions"] += 1
 
@@ -3774,6 +3857,11 @@ class PagedEngine:
                     e = _CachedPrefix(key, page, toks, parent)
                     self._prefix_index[key] = e
                     self._page_entry[page] = e
+                    if self._kv_tier is not None:
+                        # one residency per key (r22): a freshly
+                        # prefilled copy in HBM supersedes any demoted
+                        # container still parked in the tier
+                        self._kv_tier.discard(key)
             elif entry.parent != parent or entry.tokens != toks:
                 break  # collision: descendants are unreachable anyway
             parent = key
@@ -3823,6 +3911,18 @@ class PagedEngine:
                     or self._page_entry.get(p) is not entry:
                 problems.append(f"LRU entry for page {p} inconsistent with index")
         problems.extend(self._adapter_problems_locked())
+        if self._kv_tier is not None:
+            # tier partition (r22): the tier's own level/accounting
+            # invariants, plus no chain key resident in HBM AND the
+            # tier at once (register discards, promote pops — a key
+            # appearing in both means one of those paths was skipped)
+            problems.extend(self._kv_tier.audit())
+            dual = self._kv_tier.keys() & set(self._prefix_index)
+            if dual:
+                problems.append(
+                    "prefix keys resident in HBM AND the KV tier: "
+                    f"{sorted(dual)}"
+                )
         if problems:
             raise RuntimeError(
                 "paged allocator invariant violation: " + "; ".join(problems)
@@ -3902,6 +4002,7 @@ class PagedEngine:
             self._slots[slot] = None
             self._lengths[slot] = 0
         self._cost_close_locked(stream)
+        self._tier_putback_locked(stream)
         if stream.pages:
             self._free_locked(stream.pages)
             stream.pages = []
@@ -4001,6 +4102,53 @@ class PagedEngine:
             if int(self._page_ref[e.page]) == 0:
                 self._lru.pop(e.page, None)
             self._page_ref[e.page] += 1
+        # hierarchical KV tier (r22): continue the chain walk PAST the
+        # HBM match into the host/disk tier — every popped container is
+        # a full prompt page whose KV re-enters through the donated
+        # scatter (tier_promote below) instead of re-running prefill.
+        # Popped entries are owned by this admission: alloc failure
+        # puts them back, stream death before the scatter puts them
+        # back (_tier_putback_locked), success re-registers them in the
+        # prefix index after the suffix prefill.
+        tier_hits: List[Tuple[int, int, Tuple[int, ...], Dict[str, Any],
+                              bytes, str]] = []
+        tier = self._kv_tier
+        if (
+            tier is not None and stream.kv_import is None
+            and self._prefix_cache_enabled
+        ):
+            from seldon_core_tpu.codec.tensor import PayloadError
+
+            ps = self.page_size
+            n_full = (plen - 1) // ps
+            parent = (
+                matched[-1].key if matched
+                else self._prefix_root_for(stream.adapter)
+            )
+            for i in range(len(matched), n_full):
+                toks = tuple(
+                    int(t) for t in stream.prompt[i * ps:(i + 1) * ps]
+                )
+                key = prefix_chain_key(parent, toks)
+                try:
+                    got = tier.pop(key, parent, toks)
+                except PayloadError as exc:
+                    # corrupted container: the tier already dropped the
+                    # entry — this page (and the chain below it)
+                    # re-prefills, nothing scatters
+                    logger.warning(
+                        "KV tier container for chain key %d rejected: %s",
+                        key, exc,
+                    )
+                    got = None
+                if got is None:
+                    # the remaining uncached full pages re-prefill:
+                    # they are the hit-rate denominator's other half
+                    self._counters["kv_tier_misses"] += n_full - i
+                    break
+                payload, blob, level = got
+                tier_hits.append((key, parent, toks, payload, blob, level))
+                parent = key
         # migration imports (r17) arrive with decoded tokens whose KV
         # pages must be placed alongside the prompt's at admission
         extra = 0
@@ -4011,6 +4159,10 @@ class PagedEngine:
             -(-(plen + extra) // self.page_size) - len(matched)
         )
         if fresh is None:
+            for key, parent_k, toks, _payload, blob, _level in reversed(
+                tier_hits
+            ):
+                tier.put(key, parent_k, toks, blob)
             for e in reversed(matched):
                 self._page_ref[e.page] -= 1
                 if int(self._page_ref[e.page]) == 0:
@@ -4035,6 +4187,24 @@ class PagedEngine:
                 self._counters["prefix_tokens_saved"] += stream.cached_len
             else:
                 self._counters["prefix_misses"] += 1
+        if tier_hits:
+            # the tier chain scatters into the first fresh pages (they
+            # continue the matched chain in block-table order); the
+            # cached/prefilled cursors jump past them so prefill covers
+            # only the genuinely-uncached suffix.  Prefix counters
+            # above deliberately kept HBM-only semantics (cached_len at
+            # this point == len(matched) * page_size).
+            n_t = len(tier_hits)
+            stream.tier_promote = {"pages": fresh[:n_t], "entries": tier_hits}
+            stream.cached_len = (len(matched) + n_t) * self.page_size
+            stream.prefilled = stream.cached_len
+            self._counters["kv_tier_promotions"] += 1
+            for _key, _par, _toks, _payload, blob, level in tier_hits:
+                self._counters[
+                    "kv_tier_host_hits" if level == "host"
+                    else "kv_tier_disk_hits"
+                ] += 1
+                self._counters["kv_tier_bytes_promoted"] += len(blob)
         if stream.preempted:
             # a preemptively-evicted stream coming back: its decoded
             # progress re-derives deterministically and any still-cached
@@ -4176,6 +4346,9 @@ class PagedEngine:
         with their handoff payload instead of entering decode."""
         if not slices:
             return [], 0, 0.0
+        # KV tier (r22): staged demotions must gather before this
+        # wave's prefill programs can overwrite their pages
+        self._tier_flush()
         import time as _time
 
         t_start = _time.perf_counter()
@@ -4422,6 +4595,10 @@ class PagedEngine:
         indistinguishable from one that prefilled locally (same rng
         keys, same logits, same page discipline), which is what makes
         disaggregated decode bit-exact with unified serving."""
+        # KV tier (r22): the scatter below writes the pool — staged
+        # demotions gather first (no-op on the direct call path, where
+        # _run_prefill_slices already flushed)
+        self._tier_flush()
         import time as _time
 
         jnp = self._jnp
@@ -4495,6 +4672,142 @@ class PagedEngine:
                 cached_tokens=0, pages_held=len(stream.pages),
                 group_size=1, imported=True, migrated=migration,
             )
+
+    # ---- hierarchical KV tier (r22) ---------------------------------------
+
+    def _tier_flush(self) -> None:
+        """Gather every staged demotion host-side into SRT1 containers
+        and hand them to the tier.  MUST run (and does — see the call
+        sites) before any device call that writes the KV pool: a staged
+        page sits on the free list with its KV still valid, which holds
+        exactly until the next pool-writing program runs.  Called
+        OUTSIDE the engine lock (device readback + container packing);
+        single-stepper discipline makes that safe — the one step()
+        thread is the only allocator of the staged pages' next life.
+
+        Known (accepted) window: a chain demoted THIS wave cannot
+        promote on a same-wave re-admission — admission ran before the
+        flush, so the keys were neither in HBM nor yet in the tier.  It
+        promotes from the next wave on."""
+        tier = self._kv_tier
+        if tier is None:
+            return
+        with self._lock:
+            if not self._tier_pending:
+                return
+            pending, self._tier_pending = self._tier_pending, []
+            # a key re-registered since staging is HBM-resident again —
+            # demoting it too would put one key at two levels
+            pending = [e for e in pending if e[0] not in self._prefix_index]
+        if not pending:
+            return
+        from seldon_core_tpu.codec.bufview import pack_kv_handoff
+
+        jnp = self._jnp
+        idx = jnp.asarray(np.asarray([e[3] for e in pending], np.int32))
+        k = np.asarray(self.pages_k[:, idx])
+        v = np.asarray(self.pages_v[:, idx])
+        ks = vs = None
+        if self._kv_int8:
+            # int8 pages demote NATIVELY with their sibling per-page
+            # scales — the promote scatter re-places both, exactly as
+            # the disaggregation wire does
+            ks = np.asarray(self.scales_k[:, idx])
+            vs = np.asarray(self.scales_v[:, idx])
+        layout = "flat" if self._pool_flat else "split"
+        demoted = 0
+        bytes_demoted = 0
+        evicted = 0
+        for i, (key, parent, toks, _page) in enumerate(pending):
+            payload = {
+                "prompt": np.asarray(toks, np.int32),
+                # containers carry last_logits for the disaggregation
+                # handoff; a demoted page has none — promotion never
+                # reads the frame
+                "last_logits": np.zeros((1,), np.float32),
+                "k": k[:, i:i + 1],
+                "v": v[:, i:i + 1],
+                "page_size": self.page_size,
+                "layout": layout,
+            }
+            if ks is not None:
+                payload["k_scales"] = ks[:, i:i + 1]
+                payload["v_scales"] = vs[:, i:i + 1]
+            blob = pack_kv_handoff(payload)
+            evicted += tier.put(key, parent, toks, blob)
+            demoted += 1
+            bytes_demoted += len(blob)
+        with self._lock:
+            self._counters["kv_tier_demotions"] += demoted
+            self._counters["kv_tier_bytes_demoted"] += bytes_demoted
+            self._counters["kv_tier_evictions"] += evicted
+
+    def _tier_promote_ready(self) -> None:
+        """Scatter every freshly-admitted stream's promoted tier chain
+        into its fresh HBM pages — one donated ``.at[:, pages].set``
+        per stream through the SAME compiled import program the
+        disaggregation lane uses (no new program shapes on the off
+        lane, transfer cost instead of prefill FLOPs).  Runs right
+        after the admission wave, before any prefill slice or decode
+        chunk touches the streams."""
+        if self._kv_tier is None:
+            return
+        # demotions staged by this admission wave's allocations gather
+        # BEFORE the promote scatter below can overwrite their pages
+        self._tier_flush()
+        with self._lock:
+            todo: List[Tuple[_Stream, Dict[str, Any]]] = []
+            for s in self._slots:
+                if s is not None and s.tier_promote is not None:
+                    todo.append((s, s.tier_promote))
+                    s.tier_promote = None
+        if not todo:
+            return
+        jnp = self._jnp
+        for _stream, tp in todo:
+            entries = tp["entries"]
+            pages = np.asarray(tp["pages"], np.int32)
+            k = np.concatenate(
+                [np.asarray(e[3]["k"]) for e in entries], axis=1
+            )
+            v = np.concatenate(
+                [np.asarray(e[3]["v"]) for e in entries], axis=1
+            )
+            P = len(pages)
+            fn = self._import_kv_jit.get(P)
+            if fn is None:
+                fn = self._import_kv_jit[P] = self._build_import_kv(P)
+            kd = jnp.asarray(k, self._pool_dtype)
+            vd = jnp.asarray(v, self._pool_dtype)
+            if self._kv_int8:
+                kd = (kd, jnp.asarray(np.concatenate(
+                    [np.asarray(e[3]["k_scales"]) for e in entries], axis=1
+                ), jnp.float32))
+                vd = (vd, jnp.asarray(np.concatenate(
+                    [np.asarray(e[3]["v_scales"]) for e in entries], axis=1
+                ), jnp.float32))
+            pk_out, pv_out = fn(
+                self.params, *self._kv_args(), kd, vd, jnp.asarray(pages)
+            )
+            self._store_kv(pk_out, pv_out)
+
+    def _tier_putback_locked(self, stream: _Stream) -> None:
+        """Return an UNCONSUMED promotion's containers to the tier — a
+        stream that dies between admission and its promote scatter
+        (cancel, shed, fail_all, eviction) owns popped tier entries
+        whose KV never landed anywhere; dropping them would silently
+        lose demoted state the next admission could have used."""
+        tp = stream.tier_promote
+        if tp is None:
+            return
+        stream.tier_promote = None
+        tier = self._kv_tier
+        if tier is None:
+            return
+        for key, parent, toks, _payload, blob, _level in reversed(
+            tp["entries"]
+        ):
+            tier.put(key, parent, toks, blob)
 
     def _export_streams(self, streams: List[_Stream]) -> None:
         """Resolve kv_export streams with their KV-page handoff payload
@@ -5061,6 +5374,7 @@ class PagedEngine:
                 )
             self._gen_span_deferred(stream, "gen.finish", now, 0.0, **finish_tags)
         self._cost_close_locked(stream)  # idempotent with the traced close
+        self._tier_putback_locked(stream)
         self._slots[slot] = None
         self._free_locked(stream.pages)
         stream.pages = []
@@ -5100,6 +5414,7 @@ class PagedEngine:
         # accrued stay — re-derivation after re-admission is MORE cost
         self._cost_touch_locked(stream)
         stream.cost_t = 0.0
+        self._tier_putback_locked(stream)
         self._slots[slot] = None
         self._free_locked(stream.pages)
         stream.pages = []
@@ -5274,6 +5589,11 @@ class PagedEngine:
                 # SELDON_TPU_CAPTURE=0 so the off lane sheds every new
                 # stats key (same contract as the telemetry cost keys)
                 "capture_store_bytes": 0,
+                # hierarchical KV tier (r22): live bytes per level —
+                # filled (with the 8 kv_tier_* counters kept) only when
+                # SELDON_TPU_KV_OFFLOAD=1; the off lane pops all ten
+                "kv_tier_host_bytes": 0,
+                "kv_tier_disk_bytes": 0,
             }
         if self._capture_enabled:
             try:
@@ -5287,6 +5607,15 @@ class PagedEngine:
         else:
             out.pop("captures", None)
             out.pop("capture_store_bytes", None)
+        if self._kv_tier is not None:
+            tier_stats = self._kv_tier.stats()
+            out["kv_tier_host_bytes"] = tier_stats["host_bytes"]
+            out["kv_tier_disk_bytes"] = tier_stats["disk_bytes"]
+        else:
+            for k in _TIER_COUNTER_KEYS + (
+                "kv_tier_host_bytes", "kv_tier_disk_bytes",
+            ):
+                out.pop(k, None)
         if not self._telemetry_enabled:
             # SELDON_TPU_TELEMETRY=0 contract: no new metric series —
             # the bridge exports nothing it cannot see
@@ -5494,6 +5823,7 @@ class PagedEngine:
             self._lengths[:] = 0
             for stream in victims:
                 self._cost_close_locked(stream)
+                self._tier_putback_locked(stream)
                 if stream.pages:
                     self._free_locked(stream.pages)
                     stream.pages = []
@@ -5506,7 +5836,7 @@ class PagedEngine:
     def _record_prefill_wave(
         self, *, wall_s: float, tokens: int, occupancy: int,
         admissions: int, stalls: int, pre_hits: int, pre_saved: int,
-        pre_slo: Dict[str, int], puids=(),
+        pre_slo: Dict[str, int], puids=(), pre_tier=None,
     ) -> bool:
         """Record a wave that carried ONLY prefill work — budgeted
         prefill-only waves AND waves whose streams all finished at
@@ -5529,6 +5859,12 @@ class PagedEngine:
                 k: self._counters[k] - pre_slo[k]
                 for k in _SLO_COUNTER_KEYS
             }
+            # KV tier deltas ride the record only when the tier is on:
+            # the off lane's chunk records stay byte-identical
+            tier_d = (
+                {k: self._counters[k] - pre_tier[k] for k in _TIER_DELTA_KEYS}
+                if pre_tier is not None else {}
+            )
             pages_cached = len(self._lru)
         self._record_chunk({
             "phase": "prefill",
@@ -5552,6 +5888,7 @@ class PagedEngine:
             "prefix_tokens_saved": prefix_saved_d,
             "prefix_pages_cached": pages_cached,
             **slo_d,
+            **tier_d,
         })
         return more
 
@@ -5578,7 +5915,14 @@ class PagedEngine:
             pre_hits = self._counters["prefix_hits"]
             pre_saved = self._counters["prefix_tokens_saved"]
             pre_slo = {k: self._counters[k] for k in _SLO_COUNTER_KEYS}
+            pre_tier = (
+                {k: self._counters[k] for k in _TIER_DELTA_KEYS}
+                if self._kv_tier is not None else None
+            )
             admitted = self._admit_locked()
+        # KV tier (r22): admissions' promoted chains scatter before any
+        # prefill or decode work touches the wave (no-op when off)
+        self._tier_promote_ready()
         budget = self.chunk_token_budget
         wave_prefill_tokens = 0
         wave_prefill_wall = 0.0
@@ -5604,7 +5948,7 @@ class PagedEngine:
                     wall_s=wave_prefill_wall, tokens=wave_prefill_tokens,
                     occupancy=0, admissions=len(admitted), stalls=0,
                     pre_hits=pre_hits, pre_saved=pre_saved,
-                    pre_slo=pre_slo,
+                    pre_slo=pre_slo, pre_tier=pre_tier,
                     puids=[s.puid for s, _ in admitted if s.puid],
                 )
             with self._lock:
@@ -5745,6 +6089,7 @@ class PagedEngine:
                     occupancy=len(active), admissions=len(admitted),
                     stalls=int(stalled.sum()), pre_hits=pre_hits,
                     pre_saved=pre_saved, pre_slo=pre_slo,
+                    pre_tier=pre_tier,
                     puids=[s.puid for s in active if s.puid],
                 )
             with self._lock:
@@ -5764,6 +6109,10 @@ class PagedEngine:
             _faults.raise_if("paged.chunk")
         except _faults.InjectedFault as exc:
             return self._contain_chunk_fault(runnable_now, exc)
+        # KV tier (r22): decode-growth allocations above may have
+        # staged demotions — gather them before the chunk writes the
+        # pool (no-op when off)
+        self._tier_flush()
         self._profile_before_chunk()
         t_chunk = _time.perf_counter()
         chunk_args = (
@@ -5830,6 +6179,10 @@ class PagedEngine:
             prefix_hits_d = self._counters["prefix_hits"] - pre_hits
             prefix_saved_d = self._counters["prefix_tokens_saved"] - pre_saved
             slo_d = {k: self._counters[k] - pre_slo[k] for k in _SLO_COUNTER_KEYS}
+            tier_d = (
+                {k: self._counters[k] - pre_tier[k] for k in _TIER_DELTA_KEYS}
+                if pre_tier is not None else {}
+            )
             pages_cached = len(self._lru)
             # exemplar seed: any traced stream in the wave links this
             # chunk's duration observation back to one real trace
@@ -5868,6 +6221,7 @@ class PagedEngine:
             "prefix_tokens_saved": prefix_saved_d,
             "prefix_pages_cached": pages_cached,
             **slo_d,
+            **tier_d,
         })
         return more
 
@@ -5888,7 +6242,14 @@ class PagedEngine:
             pre_hits = self._counters["prefix_hits"]
             pre_saved = self._counters["prefix_tokens_saved"]
             pre_slo = {k: self._counters[k] for k in _SLO_COUNTER_KEYS}
+            pre_tier = (
+                {k: self._counters[k] for k in _TIER_DELTA_KEYS}
+                if self._kv_tier is not None else None
+            )
             admitted = self._admit_locked()
+        # KV tier (r22): promoted chains scatter before the wave's
+        # prefill/verify work (no-op when off)
+        self._tier_promote_ready()
         budget = self.chunk_token_budget
         wave_prefill_tokens = 0
         wave_prefill_wall = 0.0
@@ -5950,7 +6311,7 @@ class PagedEngine:
                     wall_s=wave_prefill_wall, tokens=wave_prefill_tokens,
                     occupancy=0, admissions=len(admitted), stalls=0,
                     pre_hits=pre_hits, pre_saved=pre_saved,
-                    pre_slo=pre_slo,
+                    pre_slo=pre_slo, pre_tier=pre_tier,
                     puids=[s.puid for s, _ in admitted if s.puid],
                 )
             with self._lock:
@@ -6068,6 +6429,9 @@ class PagedEngine:
             _faults.raise_if("paged.chunk")
         except _faults.InjectedFault as exc:
             return self._contain_chunk_fault(runnable, exc)
+        # KV tier (r22): verify-lane page growth may have staged
+        # demotions — gather before the chunk writes the pool
+        self._tier_flush()
         self._profile_before_chunk()
         t_chunk = _time.perf_counter()
         spec_args = (
@@ -6117,6 +6481,10 @@ class PagedEngine:
             prefix_hits_d = self._counters["prefix_hits"] - pre_hits
             prefix_saved_d = self._counters["prefix_tokens_saved"] - pre_saved
             slo_d = {k: self._counters[k] - pre_slo[k] for k in _SLO_COUNTER_KEYS}
+            tier_d = (
+                {k: self._counters[k] - pre_tier[k] for k in _TIER_DELTA_KEYS}
+                if pre_tier is not None else {}
+            )
             pages_cached = len(self._lru)
             chunk_trace = ""
             if self._telemetry_enabled:
@@ -6147,6 +6515,7 @@ class PagedEngine:
             "prefix_tokens_saved": prefix_saved_d,
             "prefix_pages_cached": pages_cached,
             **slo_d,
+            **tier_d,
         })
         return more
 

@@ -222,6 +222,25 @@ ENV_KNOBS: Dict[str, Knob] = _knobs(
          "(0 = off; default on — a flipped DCN byte rejects as a named "
          "PayloadError instead of decoding as garbage KV)",
          "architecture.md §5b-sexies"),
+    Knob("SELDON_TPU_KV_OFFLOAD", "flag", "0", True,
+         "hierarchical KV tier: demote LRU-reclaimed prefix/session "
+         "pages into a budgeted host-RAM store (optionally spilling to "
+         "disk) and promote them back through the donated-scatter "
+         "import on the next chain hit (0 = off, byte-identical "
+         "programs and discard-on-reclaim as before)",
+         "architecture.md §5b-nonies"),
+    Knob("SELDON_TPU_KV_HOST_BUDGET_GIB", "float", "4", False,
+         "host-RAM byte budget for the KV tier's container store "
+         "(oldest entries spill to disk or drop when exceeded)",
+         "architecture.md §5b-nonies"),
+    Knob("SELDON_TPU_KV_SPILL_DIR", "path", "", False,
+         "disk level below the host KV tier: CRC-trailered containers "
+         "written atomic tmp+rename, LRU-evicted to the spill budget "
+         "(empty = no disk level, host-budget overflow drops)",
+         "architecture.md §5b-nonies"),
+    Knob("SELDON_TPU_KV_SPILL_GIB", "float", "16", False,
+         "disk byte budget for the KV tier's spill directory",
+         "architecture.md §5b-nonies"),
     Knob("SELDON_TPU_NAN_GUARD", "flag", "1", True,
          "post-chunk NaN/Inf screen on served logits: a non-finite lane "
          "retires ONLY its stream with 500 NUMERIC_POISON (0 = off; "

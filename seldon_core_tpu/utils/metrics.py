@@ -433,6 +433,49 @@ ENGINE_STATS_METRICS: Dict[str, Tuple[str, str, str]] = {
                             "seldon_tpu_engine_capture_store_bytes",
                             "on-disk footprint of the bounded request "
                             "capture store (LRU-evicted by bytes)"),
+    # hierarchical KV tier (r22).  Keys absent when
+    # SELDON_TPU_KV_OFFLOAD=0 (default off — no new series on the off
+    # lane, same contract as the capture keys).  The KvTierThrash
+    # alert reads the demotion rate against the host/disk hit share
+    # exactly like PrefixCacheThrash reads the prefix pair.
+    "kv_tier_demotions": ("counter",
+                          "seldon_tpu_engine_kv_tier_demotions_total",
+                          "LRU-reclaimed prefix pages demoted into the "
+                          "host KV tier instead of discarded"),
+    "kv_tier_promotions": ("counter",
+                           "seldon_tpu_engine_kv_tier_promotions_total",
+                           "admissions whose chain walk promoted >= 1 "
+                           "tier page back into HBM via the scatter "
+                           "import"),
+    "kv_tier_host_hits": ("counter",
+                          "seldon_tpu_engine_kv_tier_host_hits_total",
+                          "tier pages promoted from the host-RAM level"),
+    "kv_tier_disk_hits": ("counter",
+                          "seldon_tpu_engine_kv_tier_disk_hits_total",
+                          "tier pages promoted from the disk spill level"),
+    "kv_tier_misses": ("counter",
+                       "seldon_tpu_engine_kv_tier_misses_total",
+                       "uncached full prompt pages the tier ALSO missed "
+                       "(they re-prefilled — the hit-rate denominator's "
+                       "other half)"),
+    "kv_tier_evictions": ("counter",
+                          "seldon_tpu_engine_kv_tier_evictions_total",
+                          "entries the tier byte budgets pushed out of "
+                          "host AND disk entirely"),
+    "kv_tier_bytes_demoted": ("counter",
+                              "seldon_tpu_engine_kv_tier_bytes_demoted_total",
+                              "container bytes demoted into the tier"),
+    "kv_tier_bytes_promoted": ("counter",
+                               "seldon_tpu_engine_kv_tier_bytes_promoted_total",
+                               "container bytes promoted back into HBM"),
+    "kv_tier_host_bytes": ("gauge",
+                           "seldon_tpu_engine_kv_tier_host_bytes",
+                           "live container bytes parked in the tier's "
+                           "host-RAM level"),
+    "kv_tier_disk_bytes": ("gauge",
+                           "seldon_tpu_engine_kv_tier_disk_bytes",
+                           "live container bytes parked in the tier's "
+                           "disk spill level"),
 }
 
 # keys intentionally NOT exported as their own series: the wall-clock
@@ -673,6 +716,14 @@ FLEET_METRICS: Dict[str, Tuple[str, str, str]] = {
                                  "seldon_tpu_fleet_predict_cost_s_max",
                                  "worst predicted service seconds for a "
                                  "nominal request across ok replicas"),
+    "fleet_kv_tier_host_bytes": ("gauge",
+                                 "seldon_tpu_fleet_kv_tier_host_bytes",
+                                 "demoted KV bytes parked in host RAM "
+                                 "across ok replicas (r22 KV tier)"),
+    "fleet_kv_tier_hit_rate": ("gauge",
+                               "seldon_tpu_fleet_kv_tier_hit_rate",
+                               "mean KV-tier promote hit rate [0,1] "
+                               "across replicas running the tier"),
 }
 
 # rollup keys not exported as their own series ("t" is the poll stamp)

@@ -210,6 +210,19 @@ class TelemetryRing:
             "predict_cost_s": engine.predict_cost_s(128, 64),
             "health": str(stats.get("health", "healthy")),
         }
+        # KV tier (r22): keys ride only when SELDON_TPU_KV_OFFLOAD is on
+        # — engine_stats sheds them on the off lane, and the snapshot
+        # follows suit so fleet rollups can tell "tier off" from "tier
+        # cold" (absent vs zero).
+        if "kv_tier_host_bytes" in stats:
+            t_hits = int(stats.get("kv_tier_host_hits", 0)) + int(
+                stats.get("kv_tier_disk_hits", 0)
+            )
+            t_total = t_hits + int(stats.get("kv_tier_misses", 0))
+            point["kv_tier_host_bytes"] = int(stats.get("kv_tier_host_bytes", 0))
+            point["kv_tier_hit_rate"] = (
+                round(t_hits / t_total, 4) if t_total else 0.0
+            )
         point["saturation"] = saturation_score(point)
         return self.sample(point)
 

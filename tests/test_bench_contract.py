@@ -161,6 +161,14 @@ def _r3_like_full_result():
                 "prefix_hit_pct": 100.0,
                 "prefix_tokens_saved": 12288,
                 "prefix_shared_mix": "16 streams, 256-token shared system prompt + distinct suffixes, 64 new tokens each",
+                "kv_tier_promote_x": 4.6,
+                "kv_tier_hit_pct": 100.0,
+                "kv_tier_on_revisit_ms": 120.4,
+                "kv_tier_off_revisit_ms": 553.8,
+                "kv_tier_demotions": 7,
+                "kv_tier_promotions": 6,
+                "kv_tier_resident_delta_pct": -0.8,
+                "kv_tier_mix": "2 returning sessions, 512-token history, 4 new tokens/revisit, 9-page pool",
                 "paged_tp_tokens_per_s": 8100.0,
                 "paged_tp_degree": 4,
                 "paged_tp_eff_pct": 46.0,
@@ -445,6 +453,26 @@ def test_compact_line_carries_prefix_cache_story(bench):
     assert "prefix_off_tokens_per_s" not in e
     assert "prefix_speedup_x" not in e
     assert "prefix_shared_mix" not in e
+
+
+def test_compact_line_carries_kv_tier_story(bench):
+    """r22 certification keys: the returning-session phase's promote-
+    vs-re-prefill speedup (gate >= 2.0 with promotion greedy bit-exact
+    in f32) and the warm-round promote hit rate; the raw revisit
+    walls, tier counters, resident +-5% delta, and mix description
+    stay in bench_full.json."""
+    compact = bench._compact_result(_r3_like_full_result())
+    e = compact["extra"]
+    assert isinstance(e["kv_tier_promote_x"], float)
+    assert e["kv_tier_promote_x"] == 4.6
+    assert isinstance(e["kv_tier_hit_pct"], float)
+    assert e["kv_tier_hit_pct"] == 100.0
+    # raw walls + counters + resident contrast are full-blob-only
+    assert "kv_tier_on_revisit_ms" not in e
+    assert "kv_tier_off_revisit_ms" not in e
+    assert "kv_tier_demotions" not in e
+    assert "kv_tier_resident_delta_pct" not in e
+    assert "kv_tier_mix" not in e
 
 
 def test_compact_line_carries_overload_story(bench):

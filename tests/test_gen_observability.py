@@ -211,6 +211,9 @@ class TestPrometheusBridgeContract:
         # phantom check below needs the full key set emitted
         monkeypatch.setenv("SELDON_TPU_CAPTURE", "1")
         monkeypatch.setenv("SELDON_TPU_CAPTURE_DIR", str(tmp_path))
+        # KV tier on for the same reason: the r22 kv_tier_* keys are
+        # mapped but default OFF, and the off lane sheds them
+        monkeypatch.setenv("SELDON_TPU_KV_OFFLOAD", "1")
         capture.reset_default_store()
         eng = _tiny_engine()
         try:

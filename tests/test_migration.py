@@ -468,7 +468,9 @@ class TestEvacuation:
             t = threading.Thread(target=consume)
             t.start()
             # wait until genuinely mid-decode, then evacuate A -> B
-            for _ in range(200):
+            # (generous ceiling: under a loaded tier-1 run the first
+            # chunk can take well over the uncontended couple seconds)
+            for _ in range(900):
                 if got:
                     break
                 _time.sleep(0.02)

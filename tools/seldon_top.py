@@ -50,6 +50,19 @@ def _pct(used, total) -> str:
     return f"{100.0 * float(used or 0) / total:5.1f}%" if total else "    -"
 
 
+def _bytes(n) -> str:
+    """Human bytes for the HOSTKV column; '-' when the replica runs
+    with the KV tier off (key absent from its snapshot)."""
+    if n is None:
+        return "-"
+    n = float(n)
+    for unit in ("B", "K", "M", "G", "T"):
+        if n < 1024.0 or unit == "T":
+            return f"{n:.0f}{unit}" if unit == "B" else f"{n:.1f}{unit}"
+        n /= 1024.0
+    return f"{n:.1f}T"
+
+
 def render(view: dict) -> str:
     roll = view.get("rollup", {})
     lines = [
@@ -62,9 +75,10 @@ def render(view: dict) -> str:
             roll.get("fleet_cost_page_s_s", 0.0),
         ),
         "",
-        "  {:<16} {:<6} {:>9} {:>9} {:>6} {:>7} {:>7} {:>7} {:>10}  {}".format(
+        "  {:<16} {:<6} {:>9} {:>9} {:>6} {:>7} {:>7} {:>7} {:>8} "
+        "{:>10}  {}".format(
             "REPLICA", "STATE", "GOOD t/s", "PREF t/s", "QUEUE",
-            "SLOTS", "KV%", "HIT%", "COST p-s/s", "ADAPTERS",
+            "SLOTS", "KV%", "HIT%", "HOSTKV", "COST p-s/s", "ADAPTERS",
         ),
     ]
     for name in sorted(view.get("replicas", {})):
@@ -72,7 +86,7 @@ def render(view: dict) -> str:
         p = r.get("latest") or {}
         lines.append(
             " {}{:<16} {:<6} {:>9.1f} {:>9.1f} {:>6d} {:>4d}/{:<2d} {:>7} "
-            "{:>6.1f} {:>10.3f}  {}".format(
+            "{:>6.1f} {:>8} {:>10.3f}  {}".format(
                 STATE_GLYPH.get(r.get("state"), " "), name[:16],
                 r.get("state", "?"),
                 float(p.get("goodput_tok_s", 0.0)),
@@ -82,6 +96,7 @@ def render(view: dict) -> str:
                 int(p.get("active_slots_total", 0)),
                 _pct(p.get("pool_pages_used"), p.get("pool_pages_total")),
                 float(p.get("prefix_hit_pct", 0.0)),
+                _bytes(p.get("kv_tier_host_bytes")),
                 float(p.get("cost_page_s_s", 0.0)),
                 ",".join(p.get("adapters") or []) or "-",
             )
